@@ -1,0 +1,15 @@
+from . import types
+from .column import AnyColumn, Column, ColumnBatch, Decimal128Column, StringColumn
+from .arrow import from_arrow, to_arrow, array_to_column
+
+__all__ = [
+    "types",
+    "AnyColumn",
+    "Column",
+    "ColumnBatch",
+    "Decimal128Column",
+    "StringColumn",
+    "from_arrow",
+    "to_arrow",
+    "array_to_column",
+]

@@ -1,0 +1,179 @@
+"""Host-boundary interop: Arrow <-> device ColumnBatch.
+
+This is the CPU<->TPU frontier the reference crosses with the JCUDF row
+format + cudf's Arrow interop.  Arrow validity bitmasks (LSB-first packed
+bits) are expanded to device ``bool[n]`` vectors here; ragged string buffers
+are padded into the static-shape char matrix (see ``column.StringColumn``).
+
+All transforms are vectorized numpy — no per-row Python in the hot ingest
+path except for the final object decode in ``to_arrow`` string export.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from . import types as T
+from .column import Column, ColumnBatch, Decimal128Column, StringColumn
+
+_ARROW_TO_SPARK = {
+    pa.bool_(): T.BOOLEAN,
+    pa.int8(): T.INT8,
+    pa.int16(): T.INT16,
+    pa.int32(): T.INT32,
+    pa.int64(): T.INT64,
+    pa.float32(): T.FLOAT32,
+    pa.float64(): T.FLOAT64,
+    pa.date32(): T.DATE,
+    pa.timestamp("us"): T.TIMESTAMP,
+    pa.timestamp("us", tz="UTC"): T.TIMESTAMP,
+}
+
+
+def unpack_bitmask(buf: Optional[pa.Buffer], offset: int, n: int) -> np.ndarray:
+    """Arrow LSB-first validity bitmask -> bool[n]."""
+    if buf is None:
+        return np.ones((n,), dtype=np.bool_)
+    bits = np.frombuffer(buf, dtype=np.uint8)
+    expanded = np.unpackbits(bits, bitorder="little")
+    return expanded[offset : offset + n].astype(np.bool_)
+
+
+def pack_bitmask(valid: np.ndarray) -> bytes:
+    """bool[n] -> Arrow LSB-first packed bitmask bytes."""
+    return np.packbits(valid.astype(np.uint8), bitorder="little").tobytes()
+
+
+def _string_array_to_column(arr: pa.Array, pad_to_multiple: int = 8) -> StringColumn:
+    if pa.types.is_large_string(arr.type):
+        arr = arr.cast(pa.string())
+    n = len(arr)
+    buffers = arr.buffers()
+    valid = unpack_bitmask(buffers[0], arr.offset, n)
+    offsets = np.frombuffer(buffers[1], dtype=np.int32)[
+        arr.offset : arr.offset + n + 1
+    ]
+    chars_flat = (
+        np.frombuffer(buffers[2], dtype=np.uint8)
+        if buffers[2] is not None
+        else np.zeros(0, np.uint8)
+    )
+    lengths = (offsets[1:] - offsets[:-1]).astype(np.int32)
+    lengths = np.where(valid, lengths, 0).astype(np.int32)
+    max_len = int(lengths.max()) if n else 0
+    max_len = max(1, -(-max(max_len, 1) // pad_to_multiple) * pad_to_multiple)
+    # Scatter ragged bytes into the padded matrix in one vectorized shot:
+    # row r contributes bytes [offsets[r], offsets[r]+lengths[r]).
+    chars = np.zeros((n, max_len), dtype=np.uint8)
+    if chars_flat.size:
+        row_idx = np.repeat(np.arange(n), lengths)
+        within = np.arange(lengths.sum()) - np.repeat(
+            np.cumsum(lengths) - lengths, lengths
+        )
+        src = np.repeat(offsets[:-1], lengths) + within
+        chars[row_idx, within] = chars_flat[src]
+    return StringColumn(
+        jnp.asarray(chars), jnp.asarray(lengths), jnp.asarray(valid)
+    )
+
+
+def _decimal_array_to_column(arr: pa.Array) -> Decimal128Column:
+    t = arr.type
+    n = len(arr)
+    buffers = arr.buffers()
+    valid = unpack_bitmask(buffers[0], arr.offset, n)
+    # Arrow decimal128 is 16-byte little-endian two's complement.
+    raw = np.frombuffer(buffers[1], dtype=np.uint64).reshape(-1, 2)
+    raw = raw[arr.offset : arr.offset + n]
+    return Decimal128Column(
+        jnp.asarray(np.ascontiguousarray(raw)),
+        jnp.asarray(valid),
+        T.SparkType.decimal(t.precision, t.scale),
+    )
+
+
+def array_to_column(arr):
+    """One Arrow array/chunked-array -> device column."""
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    t = arr.type
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return _string_array_to_column(arr)
+    if pa.types.is_decimal128(t) or pa.types.is_decimal(t):
+        return _decimal_array_to_column(arr)
+    if pa.types.is_timestamp(t):
+        if t.unit != "us":
+            # Spark timestamps are micros; truncate finer units (never raise).
+            arr = arr.cast(pa.timestamp("us", tz=t.tz), safe=False)
+            t = arr.type
+        spark_t = T.SparkType(T.Kind.TIMESTAMP, tz=t.tz or "")
+    else:
+        spark_t = _ARROW_TO_SPARK.get(t)
+    if spark_t is None:
+        raise NotImplementedError(f"arrow type {t} not supported yet")
+    n = len(arr)
+    buffers = arr.buffers()
+    valid = unpack_bitmask(buffers[0], arr.offset, n)
+    if pa.types.is_boolean(t):
+        data = unpack_bitmask(buffers[1], arr.offset, n)
+    else:
+        np_dtype = np.dtype(spark_t.jnp_dtype)
+        data = np.frombuffer(buffers[1], dtype=np_dtype)[
+            arr.offset : arr.offset + n
+        ]
+    return Column(
+        jnp.asarray(np.ascontiguousarray(data)), jnp.asarray(valid), spark_t
+    )
+
+
+def from_arrow(table: pa.Table) -> ColumnBatch:
+    return ColumnBatch(
+        {name: array_to_column(table.column(name)) for name in table.column_names}
+    )
+
+
+def _column_to_array(col) -> pa.Array:
+    if isinstance(col, StringColumn):
+        chars = np.asarray(jax.device_get(col.chars))
+        lengths = np.asarray(jax.device_get(col.lengths))
+        valid = np.asarray(jax.device_get(col.validity))
+        values = [
+            bytes(chars[i, : lengths[i]]).decode("utf-8", "replace")
+            if valid[i]
+            else None
+            for i in range(len(lengths))
+        ]
+        return pa.array(values, type=pa.string())
+    if isinstance(col, Decimal128Column):
+        vals = col.to_unscaled_pylist()
+        t = pa.decimal128(col.precision, col.scale)
+        scale = col.scale
+        import decimal as _d
+
+        # default decimal context is 28 digits — not enough for decimal128
+        ctx = _d.Context(prec=40)
+        return pa.array(
+            [None if v is None else _d.Decimal(v).scaleb(-scale, ctx) for v in vals],
+            type=t,
+        )
+    data = np.asarray(jax.device_get(col.data))
+    valid = np.asarray(jax.device_get(col.validity))
+    mask = ~valid  # pa.array takes an invalid mask
+    if col.dtype.kind is T.Kind.DATE:
+        return pa.array(data, type=pa.date32(), mask=mask)
+    if col.dtype.kind is T.Kind.TIMESTAMP:
+        return pa.array(
+            data, type=pa.timestamp("us", tz=col.dtype.tz or None), mask=mask
+        )
+    return pa.array(data, mask=mask)
+
+
+def to_arrow(batch: ColumnBatch) -> pa.Table:
+    return pa.table(
+        {name: _column_to_array(batch[name]) for name in batch.names}
+    )

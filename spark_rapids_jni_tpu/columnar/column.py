@@ -1,0 +1,310 @@
+"""Arrow-layout columns as JAX pytrees.
+
+The reference operates on cudf columns: a contiguous data buffer, a validity
+bitmask, and (for strings) an offsets child + chars child (see the JCUDF docs
+in reference ``RowConversion.java:57-116``).  On TPU everything under ``jit``
+must have a static shape, so the device representation differs from Arrow in
+two deliberate ways:
+
+* **Validity** is a ``bool[n]`` vector on device (one lane per row), packed
+  to/from the Arrow little-endian bitmask only at host boundaries
+  (:mod:`spark_rapids_jni_tpu.columnar.arrow`).  A byte-per-row mask
+  vectorizes on the VPU; a packed bitmask would force serial bit twiddling.
+
+* **Strings** are a padded ``uint8[n, max_len]`` char matrix plus an
+  ``int32[n]`` length vector ("bucketed padding" — the ragged (chars,
+  offsets) pair of Arrow cannot be a static-shape XLA value).  ``max_len`` is
+  static per column; batches re-bucket at host ingest.  Kernels mask lanes
+  ``>= length``.
+
+* **Decimal128** is ``uint64[n, 2]`` little-endian limbs (two's complement),
+  since neither XLA nor TPU has an int128 lane type.  Arithmetic with 256-bit
+  intermediates lives in :mod:`spark_rapids_jni_tpu.ops.decimal`.
+
+All columns are registered pytrees so whole ColumnBatches flow through
+``jax.jit`` / ``shard_map`` unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import types as T
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Column:
+    """Fixed-width column: data ``[n]`` + validity ``bool[n]``."""
+
+    data: jax.Array
+    validity: jax.Array
+    dtype: T.SparkType
+
+    def tree_flatten(self):
+        return (self.data, self.validity), self.dtype
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        data, validity = children
+        return cls(data, validity, aux)
+
+    @property
+    def num_rows(self) -> int:
+        return self.data.shape[0]
+
+    # ---- host constructors -------------------------------------------
+    @staticmethod
+    def from_pylist(values: Sequence, dtype: T.SparkType) -> "Column":
+        """Build from a host list; ``None`` entries become nulls."""
+        np_dtype = np.dtype(dtype.jnp_dtype)
+        n = len(values)
+        valid = np.array([v is not None for v in values], dtype=np.bool_)
+        filled = [v if v is not None else 0 for v in values]
+        if dtype.kind is T.Kind.BOOLEAN:
+            filled = [bool(v) for v in filled]
+        data = np.asarray(filled, dtype=np_dtype)
+        return Column(jnp.asarray(data), jnp.asarray(valid), dtype)
+
+    def to_pylist(self) -> list:
+        data = np.asarray(jax.device_get(self.data))
+        valid = np.asarray(jax.device_get(self.validity))
+        out = []
+        for i in range(data.shape[0]):
+            out.append(data[i].item() if valid[i] else None)
+        return out
+
+    def __repr__(self):
+        return f"Column({self.dtype!r}, n={self.data.shape[0]})"
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class StringColumn:
+    """Padded string column: ``chars uint8[n, max_len]``, ``lengths int32[n]``.
+
+    Bytes beyond ``lengths[i]`` are zero.  ``max_len`` is a static property
+    of the pytree structure (it is baked into traced shapes).
+    """
+
+    chars: jax.Array       # uint8 [n, max_len]
+    lengths: jax.Array     # int32 [n]
+    validity: jax.Array    # bool [n]
+
+    dtype: T.SparkType = T.STRING
+
+    def tree_flatten(self):
+        return (self.chars, self.lengths, self.validity), self.dtype
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        chars, lengths, validity = children
+        return cls(chars, lengths, validity, aux)
+
+    @property
+    def num_rows(self) -> int:
+        return self.lengths.shape[0]
+
+    @property
+    def max_len(self) -> int:
+        return self.chars.shape[1]
+
+    # ---- host constructors -------------------------------------------
+    @staticmethod
+    def from_pylist(
+        values: Sequence[Optional[str]],
+        max_len: Optional[int] = None,
+        pad_to_multiple: int = 1,
+    ) -> "StringColumn":
+        """Build from host strings (UTF-8 encoded); ``None`` → null."""
+        encoded = [v.encode("utf-8") if v is not None else b"" for v in values]
+        n = len(encoded)
+        need = max((len(b) for b in encoded), default=0)
+        if max_len is None:
+            max_len = need
+        if pad_to_multiple > 1:
+            max_len = -(-max(max_len, 1) // pad_to_multiple) * pad_to_multiple
+        max_len = max(max_len, 1)  # zero-width arrays trip XLA tiling
+        if need > max_len:
+            raise ValueError(f"string of {need} bytes exceeds max_len={max_len}")
+        chars = np.zeros((n, max_len), dtype=np.uint8)
+        lengths = np.zeros((n,), dtype=np.int32)
+        for i, b in enumerate(encoded):
+            chars[i, : len(b)] = np.frombuffer(b, dtype=np.uint8)
+            lengths[i] = len(b)
+        valid = np.array([v is not None for v in values], dtype=np.bool_)
+        return StringColumn(
+            jnp.asarray(chars), jnp.asarray(lengths), jnp.asarray(valid)
+        )
+
+    def to_pylist(self) -> list:
+        chars = np.asarray(jax.device_get(self.chars))
+        lengths = np.asarray(jax.device_get(self.lengths))
+        valid = np.asarray(jax.device_get(self.validity))
+        out = []
+        for i in range(lengths.shape[0]):
+            if not valid[i]:
+                out.append(None)
+            else:
+                out.append(bytes(chars[i, : lengths[i]]).decode("utf-8", "replace"))
+        return out
+
+    def __repr__(self):
+        return f"StringColumn(n={self.num_rows}, max_len={self.max_len})"
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Decimal128Column:
+    """Decimal128 column as two little-endian uint64 limbs per row.
+
+    ``limbs[:, 0]`` is the low 64 bits, ``limbs[:, 1]`` the high 64 bits of
+    the two's-complement 128-bit unscaled value.  The scale/precision ride on
+    ``dtype`` (a ``SparkType.decimal``).
+    """
+
+    limbs: jax.Array      # uint64 [n, 2]
+    validity: jax.Array   # bool [n]
+    dtype: T.SparkType
+
+    def tree_flatten(self):
+        return (self.limbs, self.validity), self.dtype
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        limbs, validity = children
+        return cls(limbs, validity, aux)
+
+    @property
+    def num_rows(self) -> int:
+        return self.limbs.shape[0]
+
+    @property
+    def scale(self) -> int:
+        return self.dtype.scale
+
+    @property
+    def precision(self) -> int:
+        return self.dtype.precision
+
+    # ---- host constructors -------------------------------------------
+    @staticmethod
+    def from_unscaled(
+        unscaled: Sequence[Optional[int]], precision: int, scale: int
+    ) -> "Decimal128Column":
+        """Build from host python ints (the unscaled 128-bit values)."""
+        n = len(unscaled)
+        limbs = np.zeros((n, 2), dtype=np.uint64)
+        valid = np.zeros((n,), dtype=np.bool_)
+        mask64 = (1 << 64) - 1
+        for i, v in enumerate(unscaled):
+            if v is None:
+                continue
+            valid[i] = True
+            u = v & ((1 << 128) - 1)  # two's complement
+            limbs[i, 0] = u & mask64
+            limbs[i, 1] = (u >> 64) & mask64
+        return Decimal128Column(
+            jnp.asarray(limbs), jnp.asarray(valid), T.SparkType.decimal(precision, scale)
+        )
+
+    def to_pylist(self) -> list:
+        """Unscaled 128-bit ints (None for nulls) — uniform column interface."""
+        return self.to_unscaled_pylist()
+
+    def to_unscaled_pylist(self) -> list:
+        limbs = np.asarray(jax.device_get(self.limbs), dtype=np.uint64)
+        valid = np.asarray(jax.device_get(self.validity))
+        out = []
+        for i in range(limbs.shape[0]):
+            if not valid[i]:
+                out.append(None)
+                continue
+            u = (int(limbs[i, 1]) << 64) | int(limbs[i, 0])
+            if u >= 1 << 127:
+                u -= 1 << 128
+            out.append(u)
+        return out
+
+    def __repr__(self):
+        return f"Decimal128Column({self.dtype!r}, n={self.num_rows})"
+
+
+AnyColumn = (Column, StringColumn, Decimal128Column)
+
+
+@jax.tree_util.register_pytree_node_class
+class ColumnBatch:
+    """An ordered, named collection of equal-length columns (a table slice).
+
+    The analogue of a cudf ``table``/Spark ``ColumnarBatch``.  Registered as
+    a pytree: jit/shard_map see the underlying buffers.
+    """
+
+    def __init__(self, columns: dict):
+        names = tuple(columns.keys())
+        cols = tuple(columns.values())
+        if cols:
+            n = cols[0].num_rows
+            for name, c in zip(names, cols):
+                if c.num_rows != n:
+                    raise ValueError(
+                        f"column {name!r} has {c.num_rows} rows, expected {n}"
+                    )
+        self._names = names
+        self._cols = cols
+
+    def tree_flatten(self):
+        return self._cols, self._names
+
+    @classmethod
+    def tree_unflatten(cls, names, cols):
+        obj = cls.__new__(cls)
+        obj._names = names
+        obj._cols = tuple(cols)
+        return obj
+
+    @property
+    def names(self):
+        return self._names
+
+    @property
+    def columns(self):
+        return self._cols
+
+    @property
+    def num_rows(self) -> int:
+        return self._cols[0].num_rows if self._cols else 0
+
+    @property
+    def num_columns(self) -> int:
+        return len(self._cols)
+
+    def __getitem__(self, name: str):
+        try:
+            return self._cols[self._names.index(name)]
+        except ValueError:
+            raise KeyError(name) from None
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._names
+
+    def select(self, names: Sequence[str]) -> "ColumnBatch":
+        return ColumnBatch({n: self[n] for n in names})
+
+    def with_column(self, name: str, col) -> "ColumnBatch":
+        d = dict(zip(self._names, self._cols))
+        d[name] = col
+        return ColumnBatch(d)
+
+    def to_pydict(self) -> dict:
+        return {n: c.to_pylist() for n, c in zip(self._names, self._cols)}
+
+    def __repr__(self):
+        inner = ", ".join(f"{n}={c!r}" for n, c in zip(self._names, self._cols))
+        return f"ColumnBatch({inner})"

@@ -1,0 +1,128 @@
+"""Spark logical types for TPU column batches.
+
+The reference exposes cudf type ids through the Java ColumnVector API; here we
+define a minimal Spark-centric logical type system that maps onto JAX dtypes.
+Decimal columns carry (precision, scale) exactly like Spark's DecimalType, and
+pick a storage width the way cudf does (DECIMAL32/64/128 by precision).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import jax.numpy as jnp
+
+
+class Kind(enum.Enum):
+    BOOLEAN = "boolean"
+    INT8 = "int8"
+    INT16 = "int16"
+    INT32 = "int32"
+    INT64 = "int64"
+    FLOAT32 = "float32"
+    FLOAT64 = "float64"
+    STRING = "string"
+    DECIMAL = "decimal"
+    DATE = "date"            # int32 days since epoch (proleptic Gregorian)
+    TIMESTAMP = "timestamp"  # int64 micros since epoch (UTC)
+    LIST = "list"
+    STRUCT = "struct"
+
+
+_FIXED_WIDTH_DTYPES = {
+    Kind.BOOLEAN: jnp.bool_,
+    Kind.INT8: jnp.int8,
+    Kind.INT16: jnp.int16,
+    Kind.INT32: jnp.int32,
+    Kind.INT64: jnp.int64,
+    Kind.FLOAT32: jnp.float32,
+    Kind.FLOAT64: jnp.float64,
+    Kind.DATE: jnp.int32,
+    Kind.TIMESTAMP: jnp.int64,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class SparkType:
+    """A Spark SQL data type.
+
+    ``precision``/``scale`` are only meaningful for DECIMAL.  ``children``
+    only for LIST (1 element type) and STRUCT (field types).
+    """
+
+    kind: Kind
+    precision: int = 0
+    scale: int = 0
+    children: tuple["SparkType", ...] = ()
+    field_names: tuple[str, ...] = ()
+    tz: str = ""  # TIMESTAMP only: "" = naive, else an IANA/offset tz name
+
+    # ---- constructors -------------------------------------------------
+    @staticmethod
+    def decimal(precision: int, scale: int) -> "SparkType":
+        if not (1 <= precision <= 38):
+            raise ValueError(f"decimal precision out of range: {precision}")
+        return SparkType(Kind.DECIMAL, precision=precision, scale=scale)
+
+    @staticmethod
+    def list_of(elem: "SparkType") -> "SparkType":
+        return SparkType(Kind.LIST, children=(elem,))
+
+    @staticmethod
+    def struct_of(fields: dict) -> "SparkType":
+        return SparkType(
+            Kind.STRUCT,
+            children=tuple(fields.values()),
+            field_names=tuple(fields.keys()),
+        )
+
+    # ---- predicates ---------------------------------------------------
+    @property
+    def is_fixed_width(self) -> bool:
+        return self.kind in _FIXED_WIDTH_DTYPES
+
+    @property
+    def is_nested(self) -> bool:
+        return self.kind in (Kind.LIST, Kind.STRUCT)
+
+    @property
+    def jnp_dtype(self):
+        if self.kind in _FIXED_WIDTH_DTYPES:
+            return _FIXED_WIDTH_DTYPES[self.kind]
+        raise TypeError(f"{self.kind} has no single jnp dtype")
+
+    @property
+    def decimal_storage_bits(self) -> int:
+        """cudf-style storage width selection by precision."""
+        if self.kind is not Kind.DECIMAL:
+            raise TypeError("not a decimal type")
+        if self.precision <= 9:
+            return 32
+        if self.precision <= 18:
+            return 64
+        return 128
+
+    def __repr__(self) -> str:  # compact, stable (used in error messages)
+        if self.kind is Kind.DECIMAL:
+            return f"decimal({self.precision},{self.scale})"
+        if self.kind is Kind.LIST:
+            return f"list<{self.children[0]!r}>"
+        if self.kind is Kind.STRUCT:
+            inner = ",".join(
+                f"{n}:{t!r}" for n, t in zip(self.field_names, self.children)
+            )
+            return f"struct<{inner}>"
+        return self.kind.value
+
+
+BOOLEAN = SparkType(Kind.BOOLEAN)
+INT8 = SparkType(Kind.INT8)
+INT16 = SparkType(Kind.INT16)
+INT32 = SparkType(Kind.INT32)
+INT64 = SparkType(Kind.INT64)
+FLOAT32 = SparkType(Kind.FLOAT32)
+FLOAT64 = SparkType(Kind.FLOAT64)
+STRING = SparkType(Kind.STRING)
+DATE = SparkType(Kind.DATE)
+TIMESTAMP = SparkType(Kind.TIMESTAMP)

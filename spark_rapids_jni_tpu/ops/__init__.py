@@ -1,0 +1,1 @@
+"""Spark-semantics-exact kernels over column batches."""

@@ -1,0 +1,33 @@
+"""Test config: force an 8-device virtual CPU platform BEFORE jax import.
+
+Mirrors the driver's multi-chip dry-run: sharding/collective code paths are
+exercised on a virtual CPU mesh, no TPU required (an improvement over the
+reference, whose entire test suite needs a physical GPU — SURVEY.md §4).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs[:8]
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

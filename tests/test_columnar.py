@@ -1,0 +1,183 @@
+"""Substrate tests: Column/StringColumn/Decimal128Column/ColumnBatch + Arrow interop."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_jni_tpu import columnar
+from spark_rapids_jni_tpu.columnar import types as T
+from spark_rapids_jni_tpu.columnar import (
+    Column,
+    ColumnBatch,
+    Decimal128Column,
+    StringColumn,
+    from_arrow,
+    to_arrow,
+)
+
+
+class TestColumn:
+    def test_roundtrip_with_nulls(self):
+        col = Column.from_pylist([1, None, 3, -7], T.INT32)
+        assert col.to_pylist() == [1, None, 3, -7]
+        assert col.data.dtype == jnp.int32
+
+    def test_int64(self):
+        vals = [2**40, -(2**50), None]
+        col = Column.from_pylist(vals, T.INT64)
+        assert col.to_pylist() == vals
+
+    def test_pytree_through_jit(self):
+        col = Column.from_pylist([1.5, None, 2.5], T.FLOAT64)
+
+        @jax.jit
+        def double(c):
+            return Column(c.data * 2, c.validity, c.dtype)
+
+        out = double(col)
+        assert out.to_pylist() == [3.0, None, 5.0]
+
+
+class TestStringColumn:
+    def test_roundtrip(self):
+        vals = ["hello", "", None, "wörld", "a" * 37]
+        col = StringColumn.from_pylist(vals)
+        assert col.to_pylist() == vals
+
+    def test_padding_multiple(self):
+        col = StringColumn.from_pylist(["abc"], pad_to_multiple=128)
+        assert col.max_len == 128
+        assert col.to_pylist() == ["abc"]
+
+    def test_too_long_raises(self):
+        with pytest.raises(ValueError):
+            StringColumn.from_pylist(["abcdef"], max_len=3)
+
+    def test_pytree_through_jit(self):
+        col = StringColumn.from_pylist(["ab", None, "xyz"])
+
+        @jax.jit
+        def lengths(c):
+            return c.lengths
+
+        np.testing.assert_array_equal(np.asarray(lengths(col)), [2, 0, 3])
+
+
+class TestDecimal128:
+    def test_roundtrip_extremes(self):
+        vals = [0, 1, -1, (1 << 127) - 1, -(1 << 127), None, 10**38 - 1, -(10**38 - 1)]
+        col = Decimal128Column.from_unscaled(vals, precision=38, scale=4)
+        assert col.to_unscaled_pylist() == vals
+        assert col.scale == 4 and col.precision == 38
+
+
+class TestColumnBatch:
+    def test_mixed_batch(self):
+        b = ColumnBatch(
+            {
+                "i": Column.from_pylist([1, 2, None], T.INT32),
+                "s": StringColumn.from_pylist(["x", None, "zz"]),
+            }
+        )
+        assert b.num_rows == 3 and b.num_columns == 2
+        assert b.to_pydict() == {"i": [1, 2, None], "s": ["x", None, "zz"]}
+
+    def test_mismatched_rows_raises(self):
+        with pytest.raises(ValueError):
+            ColumnBatch(
+                {
+                    "a": Column.from_pylist([1], T.INT32),
+                    "b": Column.from_pylist([1, 2], T.INT32),
+                }
+            )
+
+    def test_batch_through_jit(self):
+        b = ColumnBatch(
+            {
+                "a": Column.from_pylist([1, 2, 3], T.INT64),
+                "s": StringColumn.from_pylist(["q", "r", "s"]),
+            }
+        )
+
+        @jax.jit
+        def add_one(batch):
+            a = batch["a"]
+            return batch.with_column("a", Column(a.data + 1, a.validity, a.dtype))
+
+        out = add_one(b)
+        assert out["a"].to_pylist() == [2, 3, 4]
+        assert out["s"].to_pylist() == ["q", "r", "s"]
+
+    def test_select_and_contains(self):
+        b = ColumnBatch(
+            {
+                "a": Column.from_pylist([1], T.INT32),
+                "b": Column.from_pylist([2], T.INT32),
+            }
+        )
+        assert "a" in b and "z" not in b
+        assert b.select(["b"]).names == ("b",)
+
+
+class TestArrowInterop:
+    def test_fixed_width_roundtrip(self):
+        t = pa.table(
+            {
+                "i32": pa.array([1, None, 3], type=pa.int32()),
+                "i64": pa.array([10, 20, None], type=pa.int64()),
+                "f64": pa.array([1.5, None, 2.5], type=pa.float64()),
+                "b": pa.array([True, False, None], type=pa.bool_()),
+            }
+        )
+        batch = from_arrow(t)
+        back = to_arrow(batch)
+        assert back.equals(t)
+
+    def test_string_roundtrip(self):
+        t = pa.table({"s": pa.array(["hello", None, "", "wörld", "x" * 100])})
+        batch = from_arrow(t)
+        assert batch["s"].to_pylist() == ["hello", None, "", "wörld", "x" * 100]
+        assert to_arrow(batch).equals(t)
+
+    def test_string_sliced_offsets(self):
+        big = pa.array(["aa", "bbb", "c", None, "dddd", "ee"])
+        sliced = big.slice(2, 3)
+        col = columnar.array_to_column(sliced)
+        assert col.to_pylist() == ["c", None, "dddd"]
+
+    def test_decimal_roundtrip(self):
+        import decimal
+
+        t = pa.table(
+            {
+                "d": pa.array(
+                    [decimal.Decimal("123.45"), None, decimal.Decimal("-999.99")],
+                    type=pa.decimal128(10, 2),
+                )
+            }
+        )
+        batch = from_arrow(t)
+        assert batch["d"].to_unscaled_pylist() == [12345, None, -99999]
+        assert to_arrow(batch).equals(t)
+
+    def test_date_timestamp(self):
+        t = pa.table(
+            {
+                "d": pa.array([0, 19000, None], type=pa.date32()),
+                "ts": pa.array([0, 1_700_000_000_000_000, None], type=pa.timestamp("us")),
+            }
+        )
+        batch = from_arrow(t)
+        assert batch["d"].dtype.kind is T.Kind.DATE
+        assert batch["ts"].dtype.kind is T.Kind.TIMESTAMP
+        assert to_arrow(batch).equals(t)
+
+    def test_bitmask_helpers(self):
+        from spark_rapids_jni_tpu.columnar.arrow import pack_bitmask, unpack_bitmask
+
+        valid = np.array([True, False, True, True, False, True, True, True, False, True])
+        packed = pack_bitmask(valid)
+        buf = pa.py_buffer(packed)
+        np.testing.assert_array_equal(unpack_bitmask(buf, 0, 10), valid)
