@@ -90,6 +90,8 @@ class SparkType:
     def jnp_dtype(self):
         if self.kind in _FIXED_WIDTH_DTYPES:
             return _FIXED_WIDTH_DTYPES[self.kind]
+        if self.kind is Kind.DECIMAL and self.decimal_storage_bits < 128:
+            return jnp.int32 if self.decimal_storage_bits == 32 else jnp.int64
         raise TypeError(f"{self.kind} has no single jnp dtype")
 
     @property

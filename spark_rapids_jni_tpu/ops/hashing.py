@@ -1,0 +1,426 @@
+"""Spark-exact row hashes: MurmurHash3_32 and XXHash64.
+
+Semantics derived from the reference implementation (spark-rapids-jni
+``murmur_hash.cuh``/``murmur_hash.cu``/``xxhash64.cu``/``hash.cuh``; the Java
+surface is ``Hash.java``):
+
+* Row hash = fold over columns, the previous column's hash is the seed for
+  the next element ("serial seeding"); **null elements return the seed
+  unchanged** (Spark ignores nulls in hashes).
+* Murmur3: Spark's variant — tail bytes that don't fill a 4-byte block each
+  go through a FULL mix round with the byte **sign-extended** to 32 bits
+  (plain Murmur3 packs the tail into one k1).  bool/int8/int16 widen to a
+  4-byte block; int32/float/date are 4 bytes; int64/double/timestamp are 8
+  bytes (two little-endian blocks).  Floats normalize NaNs to the canonical
+  quiet NaN but do NOT normalize -0.0 (Java ``doubleToLongBits`` semantics).
+* XXHash64: standard XXH64 over the same widened little-endian
+  representations, but floats normalize **both** NaNs and -0.0
+  (``normalize_nans_and_zeros`` in the reference).
+* decimal32/64 hash their unscaled value sign-extended to 8 bytes.
+  decimal128 hashes the minimal big-endian two's-complement byte string of
+  the unscaled value (``java.math.BigInteger.toByteArray`` semantics,
+  reference ``hash.cuh:64-103``).
+* A struct's hash equals hashing its leaves as separate columns (reference
+  HashTest ``testSpark32BitMurmur3HashStruct``), so callers pass struct
+  leaves in order; nested *columns* are rejected until the nested substrate
+  lands.
+
+Everything is vectorized over rows: byte-string hashing runs a
+``lax.fori_loop`` over the static padded width with per-row masks, so one
+XLA loop serves every row regardless of individual string lengths.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import types as T
+from ..columnar.column import Column, ColumnBatch, Decimal128Column, StringColumn
+
+DEFAULT_XXHASH64_SEED = 42  # Hash.java:26
+
+# ---------------------------------------------------------------------------
+# Murmur3_32 primitives (vectorized over rows; everything uint32)
+# ---------------------------------------------------------------------------
+
+_MM3_C1 = jnp.uint32(0xCC9E2D51)
+_MM3_C2 = jnp.uint32(0x1B873593)
+_MM3_C3 = jnp.uint32(0xE6546B64)
+
+
+def _rotl32(x, r: int):
+    return (x << r) | (x >> (32 - r))
+
+
+def _gather_byte(chars, pos):
+    """Per-row byte gather: chars[i, pos[i]] with clamped out-of-range pos.
+
+    Callers mask out rows where pos is past the row's length, so the clamp
+    only has to keep the gather in bounds.
+    """
+    L = chars.shape[1]
+    return jnp.take_along_axis(chars, jnp.clip(pos, 0, L - 1)[:, None], axis=1)[:, 0]
+
+
+def _mm3_mix(h, k1):
+    """One full Murmur3 round: mix k1 into h."""
+    k1 = k1 * _MM3_C1
+    k1 = _rotl32(k1, 15)
+    k1 = k1 * _MM3_C2
+    h = h ^ k1
+    h = _rotl32(h, 13)
+    return h * jnp.uint32(5) + _MM3_C3
+
+
+def _fmix32(h):
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def murmur3_u32(vals_u32, seed_u32):
+    """Hash each 4-byte value (uint32[n]) with per-row seeds."""
+    h = _mm3_mix(seed_u32, vals_u32)
+    h = h ^ jnp.uint32(4)
+    return _fmix32(h)
+
+
+def murmur3_u64(vals_u64, seed_u32):
+    """Hash each 8-byte value as two little-endian 4-byte blocks."""
+    lo = (vals_u64 & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    hi = (vals_u64 >> jnp.uint64(32)).astype(jnp.uint32)
+    h = _mm3_mix(seed_u32, lo)
+    h = _mm3_mix(h, hi)
+    h = h ^ jnp.uint32(8)
+    return _fmix32(h)
+
+
+def murmur3_bytes(chars, lengths, seed_u32):
+    """Hash per-row byte strings.
+
+    chars: uint8[n, L] (padded), lengths: int32[n], seed: uint32[n].
+    4-byte little-endian blocks, then Spark's per-byte sign-extended tail.
+    """
+    n, L = chars.shape
+    nblocks = (lengths // 4).astype(jnp.int32)
+
+    def block_body(j, h):
+        blk = jax.lax.dynamic_slice(chars, (0, 4 * j), (n, 4)).astype(jnp.uint32)
+        k1 = blk[:, 0] | (blk[:, 1] << 8) | (blk[:, 2] << 16) | (blk[:, 3] << 24)
+        return jnp.where(j < nblocks, _mm3_mix(h, k1), h)
+
+    h = seed_u32
+    if L >= 4:  # fori_loop traces its body even for a zero trip count
+        h = jax.lax.fori_loop(0, L // 4, block_body, h)
+
+    tail_start = nblocks * 4
+    for t in range(min(3, L)):
+        pos = tail_start + t
+        byte = _gather_byte(chars, pos)
+        # Java byte->int sign-extends; reproduce via int8 view.
+        k1 = byte.astype(jnp.int8).astype(jnp.int32).astype(jnp.uint32)
+        h = jnp.where(pos < lengths, _mm3_mix(h, k1), h)
+
+    h = h ^ lengths.astype(jnp.uint32)
+    return _fmix32(h)
+
+
+# ---------------------------------------------------------------------------
+# XXHash64 primitives (vectorized over rows; everything uint64)
+# ---------------------------------------------------------------------------
+
+_XXH_P1 = jnp.uint64(0x9E3779B185EBCA87)
+_XXH_P2 = jnp.uint64(0xC2B2AE3D27D4EB4F)
+_XXH_P3 = jnp.uint64(0x165667B19E3779F9)
+_XXH_P4 = jnp.uint64(0x85EBCA77C2B2AE63)
+_XXH_P5 = jnp.uint64(0x27D4EB2F165667C5)
+
+
+def _rotl64(x, r: int):
+    return (x << r) | (x >> (64 - r))
+
+
+def _xxh_finalize(h):
+    h = h ^ (h >> 33)
+    h = h * _XXH_P2
+    h = h ^ (h >> 29)
+    h = h * _XXH_P3
+    h = h ^ (h >> 32)
+    return h
+
+
+def _xxh_merge_round(h, v):
+    v = v * _XXH_P2
+    v = _rotl64(v, 31)
+    v = v * _XXH_P1
+    h = h ^ v
+    return h * _XXH_P1 + _XXH_P4
+
+
+def _xxh_mix8(h, k):
+    k = k * _XXH_P2
+    k = _rotl64(k, 31)
+    k = k * _XXH_P1
+    h = h ^ k
+    return _rotl64(h, 27) * _XXH_P1 + _XXH_P4
+
+
+def _xxh_mix4(h, k_u32):
+    h = h ^ (k_u32.astype(jnp.uint64) * _XXH_P1)
+    return _rotl64(h, 23) * _XXH_P2 + _XXH_P3
+
+
+def _xxh_mix1(h, byte_u8):
+    h = h ^ (byte_u8.astype(jnp.uint64) * _XXH_P5)
+    return _rotl64(h, 11) * _XXH_P1
+
+
+def xxhash64_u32(vals_u32, seed_u64):
+    """Hash each value widened to a 4-byte block."""
+    h = seed_u64 + _XXH_P5 + jnp.uint64(4)
+    h = _xxh_mix4(h, vals_u32)
+    return _xxh_finalize(h)
+
+
+def xxhash64_u64(vals_u64, seed_u64):
+    h = seed_u64 + _XXH_P5 + jnp.uint64(8)
+    h = _xxh_mix8(h, vals_u64)
+    return _xxh_finalize(h)
+
+
+def xxhash64_bytes(chars, lengths, seed_u64):
+    """Hash per-row byte strings (uint8[n, L] padded + int32 lengths)."""
+    n, L = chars.shape
+    len64 = lengths.astype(jnp.uint64)
+
+    def get_u64(j8):
+        # 8 bytes starting at byte offset 8*j8 (little-endian)
+        blk = jax.lax.dynamic_slice(chars, (0, 8 * j8), (n, 8)).astype(jnp.uint64)
+        out = blk[:, 0]
+        for b in range(1, 8):
+            out = out | (blk[:, b] << (8 * b))
+        return out
+
+    # --- 32-byte stripe accumulation ------------------------------------
+    nstripes = (lengths // 32).astype(jnp.int32)
+    v1 = seed_u64 + _XXH_P1 + _XXH_P2
+    v2 = seed_u64 + _XXH_P2
+    v3 = seed_u64
+    v4 = seed_u64 - _XXH_P1
+
+    def stripe_body(s, vs):
+        v1, v2, v3, v4 = vs
+        m = s < nstripes
+
+        def acc(v, k):
+            return jnp.where(m, _rotl64((v + k * _XXH_P2), 31) * _XXH_P1, v)
+
+        v1 = acc(v1, get_u64(4 * s + 0))
+        v2 = acc(v2, get_u64(4 * s + 1))
+        v3 = acc(v3, get_u64(4 * s + 2))
+        v4 = acc(v4, get_u64(4 * s + 3))
+        return v1, v2, v3, v4
+
+    if L >= 32:
+        v1, v2, v3, v4 = jax.lax.fori_loop(0, L // 32, stripe_body, (v1, v2, v3, v4))
+
+    h_long = (
+        _rotl64(v1, 1) + _rotl64(v2, 7) + _rotl64(v3, 12) + _rotl64(v4, 18)
+    )
+    for v in (v1, v2, v3, v4):
+        h_long = _xxh_merge_round(h_long, v)
+    h = jnp.where(lengths >= 32, h_long, seed_u64 + _XXH_P5)
+    h = h + len64
+
+    # --- remaining 8-byte chunks ----------------------------------------
+    rem_start = nstripes * 32
+    n8 = ((lengths % 32) // 8).astype(jnp.int32)  # 0..3 eight-byte chunks
+
+    if L >= 8:
+        def chunk8_body(j, h):
+            # j-th 8-byte chunk after the stripes; per-row offset varies, so
+            # gather bytes via take_along_axis.
+            off = rem_start + 8 * j
+            out = jnp.zeros((n,), jnp.uint64)
+            for b in range(8):
+                out = out | (_gather_byte(chars, off + b).astype(jnp.uint64) << (8 * b))
+            return jnp.where(j < n8, _xxh_mix8(h, out), h)
+
+        h = jax.lax.fori_loop(0, min(3, L // 8), chunk8_body, h)
+
+    # --- one optional 4-byte chunk --------------------------------------
+    off4 = rem_start + 8 * n8
+    if L >= 4:
+        word = jnp.zeros((n,), jnp.uint32)
+        for b in range(4):
+            word = word | (_gather_byte(chars, off4 + b).astype(jnp.uint32) << (8 * b))
+        has4 = (lengths % 8) >= 4
+        h = jnp.where(has4, _xxh_mix4(h, word), h)
+
+    # --- trailing 1-3 bytes ---------------------------------------------
+    offb = off4 + jnp.where((lengths % 8) >= 4, 4, 0)
+    for t in range(min(3, L)):
+        pos = offb + t
+        h = jnp.where(pos < lengths, _xxh_mix1(h, _gather_byte(chars, pos)), h)
+
+    return _xxh_finalize(h)
+
+
+# ---------------------------------------------------------------------------
+# Value widening (shared by both hash families)
+# ---------------------------------------------------------------------------
+
+_F32_QNAN = jnp.uint32(0x7FC00000)
+_F64_QNAN = jnp.uint64(0x7FF8000000000000)
+
+
+def _f64_bits(d):
+    """f64 -> uint64 bit pattern without a 64-bit bitcast.
+
+    TPU's X64-rewrite pass can't handle bitcast-convert on 64-bit element
+    types, so bitcast to a uint32 pair (minor dim, little-endian) and
+    reassemble with uint64 arithmetic (which the rewrite does support).
+    """
+    pair = jax.lax.bitcast_convert_type(d, jnp.uint32)
+    lo = pair[..., 0].astype(jnp.uint64)
+    hi = pair[..., 1].astype(jnp.uint64)
+    return lo | (hi << 32)
+
+
+def _u64_to_i64(h):
+    """uint64 -> int64 reinterpret without a 64-bit bitcast (see _f64_bits)."""
+    lo = (h & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    hi = (h >> jnp.uint64(32)).astype(jnp.uint32)
+    hi_signed = jax.lax.bitcast_convert_type(hi, jnp.int32).astype(jnp.int64)
+    return (hi_signed << 32) | lo.astype(jnp.int64)
+
+
+def _widen_fixed(col: Column, normalize_zeros: bool):
+    """Return ('u32'|'u64', widened lanes) per reference type rules."""
+    kind = col.dtype.kind
+    d = col.data
+    if kind in (T.Kind.BOOLEAN, T.Kind.INT8, T.Kind.INT16, T.Kind.INT32, T.Kind.DATE):
+        return "u32", d.astype(jnp.int32).astype(jnp.uint32)
+    if kind in (T.Kind.INT64, T.Kind.TIMESTAMP):
+        return "u64", d.astype(jnp.int64).astype(jnp.uint64)
+    if kind is T.Kind.FLOAT32:
+        if normalize_zeros:
+            d = jnp.where(d == 0.0, jnp.float32(0.0), d)
+        bits = jax.lax.bitcast_convert_type(d, jnp.uint32)
+        bits = jnp.where(jnp.isnan(d), _F32_QNAN, bits)
+        return "u32", bits
+    if kind is T.Kind.FLOAT64:
+        if normalize_zeros:
+            d = jnp.where(d == 0.0, jnp.float64(0.0), d)
+        bits = jnp.where(jnp.isnan(d), _F64_QNAN, _f64_bits(d))
+        return "u64", bits
+    if kind is T.Kind.DECIMAL:
+        # decimal32/64 widen (sign-extended) to 8 bytes; only called for <=18
+        return "u64", d.astype(jnp.int64).astype(jnp.uint64)
+    raise NotImplementedError(f"hash of {col.dtype!r}")
+
+
+def _decimal128_java_bytes(col: Decimal128Column):
+    """Minimal big-endian two's-complement bytes (BigInteger.toByteArray).
+
+    Returns (bytes uint8[n,16] big-endian left-justified, lengths int32[n]).
+    Reference semantics: hash.cuh:64-103.
+    """
+    limbs = col.limbs  # uint64 [n, 2] little-endian
+    n = limbs.shape[0]
+    # little-endian byte matrix [n, 16]
+    le = jnp.stack(
+        [
+            ((limbs[:, k // 8] >> jnp.uint64(8 * (k % 8))) & jnp.uint64(0xFF)).astype(
+                jnp.uint8
+            )
+            for k in range(16)
+        ],
+        axis=1,
+    )
+    negative = (limbs[:, 1] >> jnp.uint64(63)) != 0
+    sign_byte = jnp.where(negative, jnp.uint8(0xFF), jnp.uint8(0x00))
+    # count leading (most-significant) bytes equal to the sign byte
+    eq = le[:, ::-1] == sign_byte[:, None]
+    lead = jnp.cumprod(eq.astype(jnp.int32), axis=1).sum(axis=1)
+    length = jnp.maximum(1, 16 - lead).astype(jnp.int32)
+    # keep one extra byte when the top retained bit doesn't match the sign
+    top_byte = jnp.take_along_axis(le, (length - 1)[:, None], axis=1)[:, 0]
+    top_bit = (top_byte & jnp.uint8(0x80)) != 0
+    need_pad = (length < 16) & (negative ^ top_bit)
+    length = length + need_pad.astype(jnp.int32)
+    # big-endian, left-justified: out[:, j] = le[:, length-1-j] for j < length
+    j = jnp.arange(16)[None, :]
+    src = jnp.clip(length[:, None] - 1 - j, 0, 15)
+    be = jnp.take_along_axis(le, src, axis=1)
+    be = jnp.where(j < length[:, None], be, jnp.uint8(0))
+    return be, length
+
+
+def _element_murmur3(col, seed_u32):
+    if isinstance(col, StringColumn):
+        return murmur3_bytes(col.chars, col.lengths, seed_u32)
+    if isinstance(col, Decimal128Column):
+        if col.dtype.decimal_storage_bits < 128:
+            # low limb is already the sign-extended two's-complement value
+            return murmur3_u64(col.limbs[:, 0], seed_u32)
+        be, length = _decimal128_java_bytes(col)
+        return murmur3_bytes(be, length, seed_u32)
+    width, vals = _widen_fixed(col, normalize_zeros=False)
+    return murmur3_u32(vals, seed_u32) if width == "u32" else murmur3_u64(vals, seed_u32)
+
+
+def _element_xxhash64(col, seed_u64):
+    if isinstance(col, StringColumn):
+        return xxhash64_bytes(col.chars, col.lengths, seed_u64)
+    if isinstance(col, Decimal128Column):
+        if col.dtype.decimal_storage_bits < 128:
+            return xxhash64_u64(col.limbs[:, 0], seed_u64)
+        be, length = _decimal128_java_bytes(col)
+        return xxhash64_bytes(be, length, seed_u64)
+    width, vals = _widen_fixed(col, normalize_zeros=True)
+    return (
+        xxhash64_u32(vals, seed_u64) if width == "u32" else xxhash64_u64(vals, seed_u64)
+    )
+
+
+Columns = Union[ColumnBatch, Sequence]
+
+
+def _as_columns(columns: Columns):
+    cols = columns.columns if isinstance(columns, ColumnBatch) else list(columns)
+    for c in cols:
+        if getattr(c, "dtype", None) is not None and c.dtype.is_nested:
+            # Nested columns land with the nested-column substrate; callers
+            # flatten struct leaves themselves until then (struct hash ==
+            # hashing the leaves in order, reference HashTest struct tests).
+            raise NotImplementedError("nested column hashing not implemented yet")
+    return cols
+
+
+def murmur_hash3_32(columns: Columns, seed: int = 42) -> Column:
+    """Spark Murmur3_32 row hash across columns (reference murmur_hash.cu:187)."""
+    cols = _as_columns(columns)
+    n = cols[0].num_rows
+    h = jnp.full((n,), jnp.uint32(seed & 0xFFFFFFFF))
+    for c in cols:
+        h = jnp.where(c.validity, _element_murmur3(c, h), h)
+    out = jax.lax.bitcast_convert_type(h, jnp.int32)
+    return Column(out, jnp.ones((n,), jnp.bool_), T.INT32)
+
+
+def xxhash64(columns: Columns, seed: int = DEFAULT_XXHASH64_SEED) -> Column:
+    """Spark XXHash64 row hash across columns (reference xxhash64.cu:330)."""
+    cols = _as_columns(columns)
+    n = cols[0].num_rows
+    h = jnp.full((n,), jnp.uint64(seed & 0xFFFFFFFFFFFFFFFF))
+    for c in cols:
+        h = jnp.where(c.validity, _element_xxhash64(c, h), h)
+    out = _u64_to_i64(h)
+    return Column(out, jnp.ones((n,), jnp.bool_), T.INT64)

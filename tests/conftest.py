@@ -1,4 +1,4 @@
-"""Test config: force an 8-device virtual CPU platform BEFORE jax import.
+"""Test config: force an 8-virtual-device CPU platform for the whole suite.
 
 Mirrors the driver's multi-chip dry-run: sharding/collective code paths are
 exercised on a virtual CPU mesh, no TPU required (an improvement over the
@@ -7,12 +7,17 @@ reference, whose entire test suite needs a physical GPU — SURVEY.md §4).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The axon sitecustomize imports jax before any test code runs, so env-var
+# overrides are too late — use config.update, which works post-import.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

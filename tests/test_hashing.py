@@ -1,0 +1,571 @@
+"""Parity tests for Spark Murmur3_32 / XXHash64.
+
+Golden values are taken from the reference test suite
+(``spark-rapids-jni/src/test/java/.../HashTest.java``), which in turn derived
+them from Apache Spark itself.  An independent pure-Python model of both hash
+functions provides randomized cross-checks (so agreement is three-way:
+Spark-derived goldens, the python model, and the XLA kernels).
+"""
+
+import struct
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.columnar import types as T
+from spark_rapids_jni_tpu.columnar.column import Column, Decimal128Column, StringColumn
+from spark_rapids_jni_tpu.ops.hashing import murmur_hash3_32, xxhash64
+
+INT_MIN, INT_MAX = -(2**31), 2**31 - 1
+
+# Java Float.intBitsToFloat test constants from the reference HashTest.java
+F_NAN_BITS = [0x7F800001, 0x7FFFFFFF, 0xFF800001, 0xFFFFFFFF]
+D_NAN_BITS = [
+    0x7FF0000000000001,
+    0x7FFFFFFFFFFFFFFF,
+    0xFFF0000000000001,
+    0xFFFFFFFFFFFFFFFF,
+]
+
+
+def f32_col(bits_or_vals, valid=None):
+    vals = [
+        np.uint32(v).view(np.float32) if isinstance(v, int) else np.float32(v)
+        for v in bits_or_vals
+    ]
+    data = np.array(vals, dtype=np.float32)
+    v = np.array(
+        [True] * len(vals) if valid is None else valid, dtype=np.bool_
+    )
+    return Column(jnp.asarray(data), jnp.asarray(v), T.FLOAT32)
+
+
+def f64_col(bits_or_vals, valid=None):
+    vals = [
+        np.uint64(v).view(np.float64) if isinstance(v, int) else np.float64(v)
+        for v in bits_or_vals
+    ]
+    data = np.array(vals, dtype=np.float64)
+    v = np.array(
+        [True] * len(vals) if valid is None else valid, dtype=np.bool_
+    )
+    return Column(jnp.asarray(data), jnp.asarray(v), T.FLOAT64)
+
+
+LONG_STR = (
+    "A very long (greater than 128 bytes/char string) to test a multi hash-step"
+    " data point in the MD5 hash function. This string needed to be longer."
+    "A 60 character string to test MD5's message padding algorithm"
+)
+MIXED_LONG_STR = (
+    "A very long (greater than 128 bytes/char string) to test a multi hash-step"
+    " data point in the MD5 hash function. This string needed to be longer."
+)
+
+
+class TestMurmur3Golden:
+    def test_strings(self):
+        col = StringColumn.from_pylist(
+            [
+                "a",
+                "B\nc",
+                'dE"Ā\tā 휠휡\\Fg2'  # noqa: W605
+                "'",
+                LONG_STR,
+                "hiJ휠휡휠휡",
+                None,
+            ]
+        )
+        out = murmur_hash3_32([col], seed=42)
+        assert out.to_pylist() == [
+            1485273170,
+            1709559900,
+            1423943036,
+            176121990,
+            1199621434,
+            42,
+        ]
+
+    def test_ints_two_columns(self):
+        v0 = Column.from_pylist([0, 100, None, None, INT_MIN, None], T.INT32)
+        v1 = Column.from_pylist([0, None, -100, None, None, INT_MAX], T.INT32)
+        out = murmur_hash3_32([v0, v1], seed=42)
+        assert out.to_pylist() == [
+            59727262,
+            751823303,
+            -1080202046,
+            42,
+            723455942,
+            133916647,
+        ]
+
+    def test_doubles_default_seed(self):
+        col = f64_col(
+            [
+                0.0,
+                0.0,
+                100.0,
+                -100.0,
+                2.2250738585072014e-308,
+                1.7976931348623157e308,
+            ]
+            + D_NAN_BITS
+            + [float("inf"), float("-inf")],
+            valid=[True, False] + [True] * 10,
+        )
+        out = murmur_hash3_32([col], seed=0)
+        assert out.to_pylist() == [
+            1669671676,
+            0,
+            -544903190,
+            -1831674681,
+            150502665,
+            474144502,
+            1428788237,
+            1428788237,
+            1428788237,
+            1428788237,
+            420913893,
+            1915664072,
+        ]
+
+    def test_timestamps(self):
+        col = Column.from_pylist(
+            [0, None, 100, -100, 0x123456789ABCDEF, None, -0x123456789ABCDEF],
+            T.TIMESTAMP,
+        )
+        out = murmur_hash3_32([col], seed=42)
+        assert out.to_pylist() == [
+            -1670924195,
+            42,
+            1114849490,
+            904948192,
+            657182333,
+            42,
+            -57193045,
+        ]
+
+    def test_decimal64(self):
+        col = Column.from_pylist(
+            [0, 100, -100, 0x123456789ABCDEF, -0x123456789ABCDEF],
+            T.SparkType.decimal(18, 7),
+        )
+        out = murmur_hash3_32([col], seed=42)
+        assert out.to_pylist() == [
+            -1670924195,
+            1114849490,
+            904948192,
+            657182333,
+            -57193045,
+        ]
+
+    def test_decimal32(self):
+        col = Column.from_pylist(
+            [0, 100, -100, 0x12345678, -0x12345678], T.SparkType.decimal(9, 3)
+        )
+        out = murmur_hash3_32([col], seed=42)
+        assert out.to_pylist() == [
+            -1670924195,
+            1114849490,
+            904948192,
+            -958054811,
+            -1447702630,
+        ]
+
+    def test_dates(self):
+        col = Column.from_pylist(
+            [0, None, 100, -100, 0x12345678, None, -0x12345678], T.DATE
+        )
+        out = murmur_hash3_32([col], seed=42)
+        assert out.to_pylist() == [
+            933211791,
+            42,
+            751823303,
+            -1080202046,
+            -1721170160,
+            42,
+            1852996993,
+        ]
+
+    def test_floats_seed_411(self):
+        col = f32_col(
+            [0.0, 100.0, -100.0, 1.17549435e-38, 3.4028235e38, 0.0]
+            + F_NAN_BITS
+            + [float("inf"), float("-inf")],
+            valid=[True] * 5 + [False] + [True] * 6,
+        )
+        out = murmur_hash3_32([col], seed=411)
+        assert out.to_pylist() == [
+            -235179434,
+            1812056886,
+            2028471189,
+            1775092689,
+            -1531511762,
+            411,
+            -1053523253,
+            -1053523253,
+            -1053523253,
+            -1053523253,
+            -1526256646,
+            930080402,
+        ]
+
+    def test_bools_two_columns(self):
+        v0 = Column.from_pylist([None, True, False, True, None, False], T.BOOLEAN)
+        v1 = Column.from_pylist([None, True, False, None, False, True], T.BOOLEAN)
+        out = murmur_hash3_32([v0, v1], seed=0)
+        assert out.to_pylist() == [
+            0,
+            -1589400010,
+            -239939054,
+            -68075478,
+            593689054,
+            -1194558265,
+        ]
+
+    def test_mixed_five_columns(self):
+        strings = StringColumn.from_pylist(
+            ["a", "B\n", 'dE"Ā\tā 휠휡', MIXED_LONG_STR, None, None]
+        )
+        integers = Column.from_pylist(
+            [0, 100, -100, INT_MIN, INT_MAX, None], T.INT32
+        )
+        doubles = f64_col(
+            [0.0, 100.0, -100.0, D_NAN_BITS[0], D_NAN_BITS[1], 0.0],
+            valid=[True] * 5 + [False],
+        )
+        floats = f32_col(
+            [0.0, 100.0, -100.0, F_NAN_BITS[2], F_NAN_BITS[3], 0.0],
+            valid=[True] * 5 + [False],
+        )
+        bools = Column.from_pylist([True, False, None, False, True, None], T.BOOLEAN)
+        out = murmur_hash3_32([strings, integers, doubles, floats, bools], seed=1868)
+        assert out.to_pylist() == [
+            1936985022,
+            720652989,
+            339312041,
+            1400354989,
+            769988643,
+            1868,
+        ]
+
+
+class TestXXHash64Golden:
+    def test_strings(self):
+        col = StringColumn.from_pylist(
+            [
+                "a",
+                "B\nc",
+                'dE"Ā\tā 휠휡\\Fg2' "'",
+                LONG_STR,
+                "hiJ휠휡휠휡",
+                None,
+            ]
+        )
+        out = xxhash64([col])
+        assert out.to_pylist() == [
+            -8582455328737087284,
+            2221214721321197934,
+            5798966295358745941,
+            -4834097201550955483,
+            -3782648123388245694,
+            42,
+        ]
+
+    def test_ints(self):
+        v0 = Column.from_pylist([0, 100, None, None, INT_MIN, None], T.INT32)
+        v1 = Column.from_pylist([0, None, -100, None, None, INT_MAX], T.INT32)
+        out = xxhash64([v0, v1])
+        assert out.to_pylist() == [
+            1151812168208346021,
+            -7987742665087449293,
+            8990748234399402673,
+            42,
+            2073849959933241805,
+            1508894993788531228,
+        ]
+
+    def test_doubles(self):
+        col = f64_col(
+            [
+                0.0,
+                0.0,
+                100.0,
+                -100.0,
+                2.2250738585072014e-308,
+                1.7976931348623157e308,
+            ]
+            + D_NAN_BITS
+            + [float("inf"), float("-inf")],
+            valid=[True, False] + [True] * 10,
+        )
+        out = xxhash64([col])
+        assert out.to_pylist() == [
+            -5252525462095825812,
+            42,
+            -7996023612001835843,
+            5695175288042369293,
+            6181148431538304986,
+            -4222314252576420879,
+            -3127944061524951246,
+            -3127944061524951246,
+            -3127944061524951246,
+            -3127944061524951246,
+            5810986238603807492,
+            5326262080505358431,
+        ]
+
+    def test_timestamps(self):
+        col = Column.from_pylist(
+            [0, None, 100, -100, 0x123456789ABCDEF, None, -0x123456789ABCDEF],
+            T.TIMESTAMP,
+        )
+        out = xxhash64([col])
+        assert out.to_pylist() == [
+            -5252525462095825812,
+            42,
+            8713583529807266080,
+            5675770457807661948,
+            1941233597257011502,
+            42,
+            -1318946533059658749,
+        ]
+
+    def test_decimal64(self):
+        col = Column.from_pylist(
+            [0, 100, -100, 0x123456789ABCDEF, -0x123456789ABCDEF],
+            T.SparkType.decimal(18, 7),
+        )
+        out = xxhash64([col])
+        assert out.to_pylist() == [
+            -5252525462095825812,
+            8713583529807266080,
+            5675770457807661948,
+            1941233597257011502,
+            -1318946533059658749,
+        ]
+
+    def test_decimal32(self):
+        col = Column.from_pylist(
+            [0, 100, -100, 0x12345678, -0x12345678], T.SparkType.decimal(9, 3)
+        )
+        out = xxhash64([col])
+        assert out.to_pylist() == [
+            -5252525462095825812,
+            8713583529807266080,
+            5675770457807661948,
+            -7728554078125612835,
+            3142315292375031143,
+        ]
+
+    def test_dates(self):
+        col = Column.from_pylist(
+            [0, None, 100, -100, 0x12345678, None, -0x12345678], T.DATE
+        )
+        out = xxhash64([col])
+        assert out.to_pylist() == [
+            3614696996920510707,
+            42,
+            -7987742665087449293,
+            8990748234399402673,
+            6954428822481665164,
+            42,
+            -4294222333805341278,
+        ]
+
+    def test_floats(self):
+        col = f32_col(
+            [0.0, 100.0, -100.0, 1.17549435e-38, 3.4028235e38, 0.0]
+            + F_NAN_BITS
+            + [float("inf"), float("-inf")],
+            valid=[True] * 5 + [False] + [True] * 6,
+        )
+        out = xxhash64([col])
+        assert out.to_pylist() == [
+            3614696996920510707,
+            -8232251799677946044,
+            -6625719127870404449,
+            -6699704595004115126,
+            -1065250890878313112,
+            42,
+            2692338816207849720,
+            2692338816207849720,
+            2692338816207849720,
+            2692338816207849720,
+            -5940311692336719973,
+            -7580553461823983095,
+        ]
+
+    def test_bools(self):
+        v0 = Column.from_pylist([None, True, False, True, None, False], T.BOOLEAN)
+        v1 = Column.from_pylist([None, True, False, None, False, True], T.BOOLEAN)
+        out = xxhash64([v0, v1])
+        assert out.to_pylist() == [
+            42,
+            9083826852238114423,
+            1151812168208346021,
+            -6698625589789238999,
+            3614696996920510707,
+            7945966957015589024,
+        ]
+
+
+# ---------------------------------------------------------------------------
+# Independent pure-Python models for randomized cross-checks
+# ---------------------------------------------------------------------------
+
+M32 = 0xFFFFFFFF
+M64 = 0xFFFFFFFFFFFFFFFF
+
+
+def py_murmur3(data: bytes, seed: int) -> int:
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h = seed & M32
+
+    def rotl(x, r):
+        return ((x << r) | (x >> (32 - r))) & M32
+
+    def mix(h, k1):
+        k1 = (k1 * c1) & M32
+        k1 = rotl(k1, 15)
+        k1 = (k1 * c2) & M32
+        h ^= k1
+        h = rotl(h, 13)
+        return (h * 5 + 0xE6546B64) & M32
+
+    nblocks = len(data) // 4
+    for i in range(nblocks):
+        (k1,) = struct.unpack_from("<I", data, i * 4)
+        h = mix(h, k1)
+    for b in data[nblocks * 4 :]:
+        signed = b - 256 if b >= 128 else b  # java byte sign extension
+        h = mix(h, signed & M32)
+    h ^= len(data)
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & M32
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & M32
+    h ^= h >> 16
+    return h - (1 << 32) if h >= 1 << 31 else h
+
+
+P1, P2, P3, P4, P5 = (
+    0x9E3779B185EBCA87,
+    0xC2B2AE3D27D4EB4F,
+    0x165667B19E3779F9,
+    0x85EBCA77C2B2AE63,
+    0x27D4EB2F165667C5,
+)
+
+
+def py_xxhash64(data: bytes, seed: int) -> int:
+    def rotl(x, r):
+        return ((x << r) | (x >> (64 - r))) & M64
+
+    n = len(data)
+    off = 0
+    if n >= 32:
+        v1, v2, v3, v4 = (
+            (seed + P1 + P2) & M64,
+            (seed + P2) & M64,
+            seed & M64,
+            (seed - P1) & M64,
+        )
+        while off <= n - 32:
+            for i, v in enumerate((v1, v2, v3, v4)):
+                (k,) = struct.unpack_from("<Q", data, off)
+                v = (v + k * P2) & M64
+                v = (rotl(v, 31) * P1) & M64
+                if i == 0:
+                    v1 = v
+                elif i == 1:
+                    v2 = v
+                elif i == 2:
+                    v3 = v
+                else:
+                    v4 = v
+                off += 8
+        h = (rotl(v1, 1) + rotl(v2, 7) + rotl(v3, 12) + rotl(v4, 18)) & M64
+        for v in (v1, v2, v3, v4):
+            v = (v * P2) & M64
+            v = (rotl(v, 31) * P1) & M64
+            h ^= v
+            h = (h * P1 + P4) & M64
+    else:
+        h = (seed + P5) & M64
+    h = (h + n) & M64
+    while off + 8 <= n:
+        (k,) = struct.unpack_from("<Q", data, off)
+        k = (k * P2) & M64
+        k = (rotl(k, 31) * P1) & M64
+        h ^= k
+        h = (rotl(h, 27) * P1 + P4) & M64
+        off += 8
+    if off + 4 <= n:
+        (k,) = struct.unpack_from("<I", data, off)
+        h ^= (k * P1) & M64
+        h = (rotl(h, 23) * P2 + P3) & M64
+        off += 4
+    while off < n:
+        h ^= (data[off] * P5) & M64
+        h = (rotl(h, 11) * P1) & M64
+        off += 1
+    h ^= h >> 33
+    h = (h * P2) & M64
+    h ^= h >> 29
+    h = (h * P3) & M64
+    h ^= h >> 32
+    return h - (1 << 64) if h >= 1 << 63 else h
+
+
+def java_bigint_bytes(v: int) -> bytes:
+    """java.math.BigInteger.toByteArray of a 128-bit value."""
+    length = max(1, (v.bit_length() + 8) // 8) if v >= 0 else max(
+        1, ((v + 1).bit_length() + 8) // 8
+    )
+    return v.to_bytes(length, "big", signed=True)
+
+
+class TestRandomizedCrossCheck:
+    def test_strings_random(self, rng):
+        words = [
+            rng.integers(0, 256, size=int(k)).astype(np.uint8).tobytes().decode("latin-1")
+            for k in rng.integers(0, 80, size=64)
+        ]
+        col = StringColumn.from_pylist(words)
+        out32 = murmur_hash3_32([col], seed=42).to_pylist()
+        out64 = xxhash64([col], seed=42).to_pylist()
+        for w, got32, got64 in zip(words, out32, out64):
+            # StringColumn stores UTF-8, so the oracle hashes the UTF-8 bytes
+            raw = w.encode("utf-8")
+            assert got32 == py_murmur3(raw, 42), f"murmur mismatch for {raw!r}"
+            assert got64 == py_xxhash64(raw, 42), f"xxh64 mismatch for {raw!r}"
+
+    def test_longs_random(self, rng):
+        vals = rng.integers(-(2**63), 2**63 - 1, size=256, dtype=np.int64)
+        col = Column(jnp.asarray(vals), jnp.ones(256, jnp.bool_), T.INT64)
+        out = murmur_hash3_32([col], seed=7).to_pylist()
+        for v, got in zip(vals, out):
+            assert got == py_murmur3(struct.pack("<q", v), 7)
+
+    def test_decimal128_vs_java_biginteger(self, rng):
+        cases = [0, 1, -1, 127, 128, -128, -129, 255, 256, -(2**127), 2**127 - 1]
+        cases += [int(x) * 10**k for x in rng.integers(-(10**6), 10**6, 20) for k in (0, 9, 20)]
+        col = Decimal128Column.from_unscaled(cases, precision=38, scale=2)
+        out32 = murmur_hash3_32([col], seed=42).to_pylist()
+        out64 = xxhash64([col], seed=42).to_pylist()
+        for v, got32, got64 in zip(cases, out32, out64):
+            raw = java_bigint_bytes(v)
+            assert got32 == py_murmur3(raw, 42), f"murmur mismatch for {v}"
+            assert got64 == py_xxhash64(raw, 42), f"xxh64 mismatch for {v}"
+
+    def test_xxh64_length_boundaries(self):
+        # every interesting length near the 4/8/32-byte chunk boundaries
+        for length in [0, 1, 3, 4, 5, 7, 8, 9, 12, 15, 16, 31, 32, 33, 40, 63, 64, 65, 100]:
+            s = "".join(chr(65 + (i % 26)) for i in range(length))
+            col = StringColumn.from_pylist([s])
+            got = xxhash64([col], seed=42).to_pylist()[0]
+            assert got == py_xxhash64(s.encode(), 42), f"len={length}"
+            got32 = murmur_hash3_32([col], seed=42).to_pylist()[0]
+            assert got32 == py_murmur3(s.encode(), 42), f"len={length}"
