@@ -1,0 +1,43 @@
+"""Shared per-row char helpers for string kernels."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def char_at(chars, pos):
+    """chars[i, pos[i]] with clamped gather; 0 where pos is out of range."""
+    L = chars.shape[1]
+    c = jnp.take_along_axis(chars, jnp.clip(pos, 0, L - 1)[:, None], axis=1)[:, 0]
+    return jnp.where((pos >= 0) & (pos < L), c, jnp.uint8(0))
+
+
+def is_ws(c):
+    """Whitespace or C0 control code (reference cast_string.cu:46-56)."""
+    return c <= jnp.uint8(0x20)
+
+
+def is_digit(c):
+    return (c >= jnp.uint8(ord("0"))) & (c <= jnp.uint8(ord("9")))
+
+
+def strip_and_sign(chars, lengths, strip: bool):
+    """Locate the value start: optional stripped whitespace then one sign.
+
+    Returns (start, has_sign, negative) where ``start`` indexes the first
+    content char after whitespace and sign.  All three casts share this
+    preamble (reference cast_string.cu:184-198, cast_string_to_float.cu:99-102).
+    """
+    n, L = chars.shape
+    idx = jnp.arange(L)[None, :]
+    in_range = idx < lengths[:, None]
+    if strip:
+        nonws = in_range & ~is_ws(chars)
+        any_nonws = nonws.any(axis=1)
+        s0 = jnp.where(any_nonws, jnp.argmax(nonws, axis=1), lengths).astype(jnp.int32)
+    else:
+        s0 = jnp.zeros((n,), jnp.int32)
+    sc = char_at(chars, s0)
+    has_sign = (sc == ord("+")) | (sc == ord("-"))
+    negative = sc == ord("-")
+    return s0 + has_sign.astype(jnp.int32), has_sign, negative

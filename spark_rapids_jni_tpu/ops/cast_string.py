@@ -1,0 +1,663 @@
+"""Spark-exact string -> numeric casts.
+
+Behavioral contract extracted from the reference kernels
+(``cast_string.cu:159-246`` string->int, ``cast_string_to_float.cu:58-658``
+string->float).  Both are faithful to Spark quirks, including:
+
+* whitespace = C0 control codes (<= 0x1F) plus space (``is_whitespace``);
+* string->int truncates at a decimal point in non-ANSI mode but still
+  validates the characters after it ("20.5" -> 20, "7.8.3" -> null), and a
+  bare "." parses as 0;
+* string->float keeps at most 19 significant digits (further digits become
+  trailing zeros of the exponent), loses values whose first 19 counted
+  digits are all zeros ("0.0000000000000000000123" -> 0.0), accepts one
+  trailing f/F/d/D after a nonzero number but NOT after a zero ("1f" -> 1.0
+  but "0f" -> null), treats "nan" with junk as an ANSI error but "inf" with
+  junk as a plain null, and rejects "-nan";
+* the final float value is assembled in float64 arithmetic (digits * 10^exp)
+  exactly like the reference, so last-ulp behavior matches the GPU path
+  rather than a correctly-rounded strtod.
+
+Ints run a ``fori_loop`` char scan (state machine vectorized across rows);
+floats are fully positional (masks + cumulative ops over the padded char
+axis) — both shapes keep every row on the VPU with no per-row Python.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import types as T
+from ..columnar.column import Column, StringColumn
+from ._util import char_at as _char_at
+from ._util import is_digit as _is_digit
+from ._util import is_ws as _is_ws
+from ._util import strip_and_sign
+
+
+class CastException(RuntimeError):
+    """ANSI-mode cast failure; carries the first offending row.
+
+    Mirrors the reference ``CastException`` (cast_string.hpp:28-58), which
+    reports the first invalid string and its row index.
+    """
+
+    def __init__(self, string_with_error: str, row_with_error: int):
+        super().__init__(
+            f"Error casting data on row {row_with_error}: {string_with_error}"
+        )
+        self.string_with_error = string_with_error
+        self.row_with_error = row_with_error
+
+
+_INT_BOUNDS = {
+    T.Kind.INT8: (-(2**7), 2**7 - 1),
+    T.Kind.INT16: (-(2**15), 2**15 - 1),
+    T.Kind.INT32: (-(2**31), 2**31 - 1),
+    T.Kind.INT64: (-(2**63), 2**63 - 1),
+}
+
+
+def string_to_integer(
+    col: StringColumn,
+    dtype: T.SparkType,
+    ansi_mode: bool = False,
+    strip: bool = True,
+) -> Column:
+    """Spark-exact string -> int8/16/32/64 (reference cast_string.cu:159).
+
+    Scans characters left to right with the reference's exact state
+    machine: optional stripped whitespace, one optional sign, digits with
+    incremental overflow checks (accumulating negatively for '-', so MIN
+    values parse), '.'-truncation in non-ANSI mode, trailing whitespace
+    (strip only), everything else invalid.
+    """
+    kind = dtype.kind
+    if kind not in _INT_BOUNDS:
+        raise TypeError(f"not an integer type: {dtype!r}")
+    tmin, tmax = _INT_BOUNDS[kind]
+
+    chars, lengths = col.chars, col.lengths
+    n, L = chars.shape
+    idx = jnp.arange(L)[None, :]
+    in_range = idx < lengths[:, None]
+
+    start, has_sign, negative = strip_and_sign(chars, lengths, strip)
+
+    valid0 = col.validity & (lengths > 0) & (start < lengths)
+
+    min64 = jnp.int64(tmin)
+    max64 = jnp.int64(tmax)
+    min_div10 = jnp.int64(int(tmin / 10))  # C truncation toward zero
+    max_div10 = jnp.int64(tmax // 10)
+
+    def body(j, state):
+        val, valid, truncating, trailing_ws, seen = state
+        c = chars[:, j]
+        active = valid0 & valid & (j >= start) & (j < lengths)
+        is_d = _is_digit(c)
+        ws = _is_ws(c)
+
+        # ordered rules from the reference scan loop
+        kill_after_ws = trailing_ws & ~ws
+        to_truncate = ~truncating & (c == ord(".")) & (not ansi_mode) & ~kill_after_ws
+        plain = ~kill_after_ws & ~to_truncate
+        allowed_ws = ws & (j != start) & strip
+        to_trailing = plain & ~is_d & allowed_ws
+        invalid_char = plain & ~is_d & ~allowed_ws
+
+        digit = (c - ord("0")).astype(jnp.int64)
+        first = ~seen
+        # accumulate toward -inf for negatives so MIN parses (reference
+        # process_value: adding=sign>0)
+        mul_ovf = ~first & jnp.where(negative, val < min_div10, val > max_div10)
+        val10 = jnp.where(first, val, val * 10)
+        add_ovf = jnp.where(negative, val10 < min64 + digit, val10 > max64 - digit)
+        ovf = mul_ovf | add_ovf
+        newval = jnp.where(negative, val10 - digit, val10 + digit)
+
+        do_digit = active & plain & is_d & ~truncating & ~trailing_ws
+        val = jnp.where(do_digit & ~ovf, newval, val)
+        seen = seen | do_digit
+        valid = valid & ~(active & (kill_after_ws | invalid_char | (do_digit & ovf)))
+        truncating = truncating | (active & to_truncate)
+        trailing_ws = trailing_ws | (active & to_trailing)
+        return val, valid, truncating, trailing_ws, seen
+
+    init = (
+        jnp.zeros((n,), jnp.int64),
+        jnp.ones((n,), jnp.bool_),
+        jnp.zeros((n,), jnp.bool_),
+        jnp.zeros((n,), jnp.bool_),
+        jnp.zeros((n,), jnp.bool_),
+    )
+    val, scan_valid, _, _, _ = jax.lax.fori_loop(0, L, body, init)
+    valid = valid0 & scan_valid
+
+    out = Column(val.astype(dtype.jnp_dtype), valid, dtype)
+    if ansi_mode:
+        _raise_on_invalid(col, valid)
+    return out
+
+
+def _raise_on_invalid(col: StringColumn, valid):
+    """ANSI mode: surface the first failed row as a CastException.
+
+    Fails only for rows that were non-null on input (a null input row stays
+    null, it is not an error — reference CastStringJni ANSI handling).
+    """
+    bad = np.asarray(jax.device_get(col.validity & ~valid))
+    if bad.any():
+        row = int(np.argmax(bad))
+        s = col.to_pylist()[row]
+        raise CastException(s if s is not None else "<null>", row)
+
+
+# ---------------------------------------------------------------------------
+# string -> float
+# ---------------------------------------------------------------------------
+
+# correctly-rounded signed powers of ten: 1e-340 .. 1e309 (inf past the top,
+# 0.0 past the bottom), indexed by e + _POW10_OFF
+_POW10_OFF = 340
+_POW10_F64 = jnp.asarray(
+    [float(f"1e{k}") for k in range(-_POW10_OFF, 310)], dtype=jnp.float64
+)
+
+
+def _pow10f(e):
+    """10.0**e in float64 (the reference computes exp10() in double)."""
+    return _POW10_F64[jnp.clip(e + _POW10_OFF, 0, _POW10_OFF + 309)]
+
+
+_POW10_U64 = jnp.asarray([10**k for k in range(0, 19)], dtype=jnp.uint64)
+
+
+def _all_ws_from(chars, lengths, pos):
+    """True where every char in [pos, len) is whitespace."""
+    idx = jnp.arange(chars.shape[1])[None, :]
+    region = (idx >= pos[:, None]) & (idx < lengths[:, None])
+    return ~(region & ~_is_ws(chars)).any(axis=1)
+
+
+def string_to_float(
+    col: StringColumn, dtype: T.SparkType, ansi_mode: bool = False
+) -> Column:
+    """Spark-exact string -> float32/float64 (reference cast_string_to_float.cu).
+
+    Fully positional: leading/trailing regions, the digit+dot run, the
+    19-significant-digit budget, and the optional exponent are all derived
+    with masks and cumulative sums over the padded char axis — no scan.
+    """
+    if dtype.kind not in (T.Kind.FLOAT32, T.Kind.FLOAT64):
+        raise TypeError(f"not a float type: {dtype!r}")
+
+    chars, lengths = col.chars, col.lengths
+    n, L = chars.shape
+    idx = jnp.arange(L)[None, :]
+    in_range = idx < lengths[:, None]
+    lower = chars | jnp.uint8(0x20)  # ASCII lowercase for letter comparisons
+
+    s, has_sign, negative = strip_and_sign(chars, lengths, strip=True)
+    sign = jnp.where(negative, jnp.float64(-1.0), jnp.float64(1.0))
+
+    base_valid = col.validity & (lengths > 0)
+
+    def lc_at(pos):
+        c = _char_at(chars, pos)
+        return c | jnp.uint8(0x20)
+
+    def match(pos, word):
+        m = jnp.ones((n,), jnp.bool_)
+        for k, ch in enumerate(word):
+            m = m & (lc_at(pos + k) == ord(ch))
+        return m
+
+    # ---- nan ----------------------------------------------------------
+    is_nan_word = match(s, "nan") & (s + 3 <= lengths)
+    nan_clean = _all_ws_from(chars, lengths, s + 3)
+    nan_ok = is_nan_word & nan_clean & ~negative
+    nan_bad = is_nan_word & ~(nan_clean & ~negative)  # ANSI error (ref :239-266)
+
+    # ---- inf / infinity ----------------------------------------------
+    is_inf3 = match(s, "inf") & (s + 3 <= lengths) & ~is_nan_word
+    is_inf8 = is_inf3 & match(s + 3, "inity") & (s + 8 <= lengths)
+    inf_end = jnp.where(is_inf8, s + 8, s + 3)
+    inf_clean = _all_ws_from(chars, lengths, inf_end)
+    inf_ok = is_inf3 & inf_clean
+    inf_bad = is_inf3 & ~inf_clean  # plain null, NOT an ANSI error (ref :286-327)
+
+    word_path = is_nan_word | is_inf3
+
+    # ---- digit run [s, q) --------------------------------------------
+    digit = _is_digit(chars)
+    dot = chars == ord(".")
+    ok = (digit | dot) & in_range
+    # run_ok[j] == all positions in [s, j] are ok  (positions < s are free)
+    run_ok = jnp.cumprod(
+        jnp.where(idx < s[:, None], True, ok).astype(jnp.int32), axis=1
+    ).astype(bool)
+    run = run_ok & (idx >= s[:, None])
+    run_len = run.sum(axis=1).astype(jnp.int32)
+    q = s + run_len
+
+    ndots = (dot & run).sum(axis=1)
+    multi_dot = ndots > 1
+    has_dot = ndots == 1
+    dot_in_run = dot & run
+    dot_pos = jnp.where(
+        has_dot, jnp.argmax(dot_in_run, axis=1).astype(jnp.int32), q
+    )
+
+    digit_in_run = digit & run
+    any_digit = digit_in_run.any(axis=1)
+
+    # counted digits: post-dot digits always count; pre-dot digits count
+    # from the first nonzero on (leading-zero strip, ref :345-361)
+    nz_pre = digit_in_run & (chars != ord("0")) & (idx < dot_pos[:, None])
+    any_nz_pre = nz_pre.any(axis=1)
+    first_nz_pre = jnp.where(
+        any_nz_pre, jnp.argmax(nz_pre, axis=1).astype(jnp.int32), q
+    )
+    counted = digit_in_run & (
+        (idx > dot_pos[:, None]) | (idx >= first_nz_pre[:, None])
+    )
+    total_counted = counted.sum(axis=1).astype(jnp.int32)
+    real = jnp.minimum(total_counted, 19)
+    truncated = total_counted - real
+
+    # value of the first 19 counted digits (uint64), by per-digit rank
+    rank = jnp.cumsum(counted.astype(jnp.int32), axis=1)  # 1-based at digits
+    contrib_mask = counted & (rank <= 19)
+    exp_k = jnp.clip(real[:, None] - rank, 0, 18)
+    digitval = (chars - ord("0")).astype(jnp.uint64)
+    digits = jnp.where(
+        contrib_mask, digitval * _POW10_U64[exp_k], jnp.uint64(0)
+    ).sum(axis=1)
+
+    decimal_pos_counted = (counted & (idx < dot_pos[:, None])).sum(axis=1).astype(
+        jnp.int32
+    )
+    exp_base = truncated - jnp.where(
+        has_dot, total_counted - decimal_pos_counted, 0
+    )
+
+    # ---- manual exponent ---------------------------------------------
+    has_e = (lc_at(q) == ord("e")) & (q < lengths)
+    esc = _char_at(chars, q + 1)
+    has_esign = has_e & ((esc == ord("+")) | (esc == ord("-")))
+    eneg = has_esign & (esc == ord("-"))
+    ed_start = q + 1 + has_esign.astype(jnp.int32)
+    # leading digit run after the exponent marker, capped at 4 digits read
+    ed_ok = jnp.cumprod(
+        jnp.where(idx < ed_start[:, None], True, digit & in_range).astype(jnp.int32),
+        axis=1,
+    ).astype(bool)
+    ed_run_len = (ed_ok & (idx >= ed_start[:, None])).sum(axis=1).astype(jnp.int32)
+    ed_count = jnp.minimum(ed_run_len, 4)
+    e_digit_mask = (idx >= ed_start[:, None]) & (idx < (ed_start + ed_count)[:, None])
+    e_rank = jnp.cumsum(e_digit_mask.astype(jnp.int32), axis=1)
+    e_val = jnp.where(
+        e_digit_mask,
+        (chars - ord("0")).astype(jnp.int32)
+        * jnp.asarray([10**k for k in range(4)], jnp.int32)[
+            jnp.clip(ed_count[:, None] - e_rank, 0, 3)
+        ],
+        0,
+    ).sum(axis=1)
+    manual_exp = jnp.where(has_e, jnp.where(eneg, -e_val, e_val), 0)
+    exp_bad = has_e & (ed_count == 0)  # "1e" / "1e+" -> ANSI error (ref :533-537)
+    after_exp = jnp.where(has_e, ed_start + ed_count, q)
+
+    # ---- zero-value quirk path ---------------------------------------
+    is_zero = digits == jnp.uint64(0)
+    zero_clean = _all_ws_from(chars, lengths, after_exp)  # no f/d allowed
+    # ---- nonzero trailing: one optional f/F/d/D then whitespace ------
+    tc = lc_at(after_exp)
+    has_fd = ((tc == ord("f")) | (tc == ord("d"))) & (after_exp < lengths)
+    after_fd = after_exp + has_fd.astype(jnp.int32)
+    tail_clean = _all_ws_from(chars, lengths, after_fd)
+
+    seen_valid_digit = any_digit  # a digit anywhere in the run
+    num_invalid = (
+        multi_dot
+        | ~seen_valid_digit
+        | exp_bad
+        | (is_zero & ~zero_clean)
+        | (~is_zero & ~tail_clean)
+    )
+    num_ok = ~word_path & ~num_invalid
+
+    # ---- final value (float64 arithmetic, reference :154-197) --------
+    digitsf = sign * digits.astype(jnp.float64)
+    exp_ten = exp_base + manual_exp
+    # subnormal pre-scaling (reference :181-189)
+    sub_shift = -307 - exp_ten
+    num_digits10 = jnp.where(
+        is_zero,
+        1,
+        (jnp.floor(jnp.log10(jnp.maximum(digits.astype(jnp.float64), 1.0))) + 1).astype(
+            jnp.int32
+        ),
+    )
+    sub_digitsf = digitsf / _pow10f(num_digits10 - 1 + sub_shift)
+    sub_exp = exp_ten + num_digits10 - 1
+    sub_val = sub_digitsf * _pow10f(sub_exp + sub_shift)
+    plain_pow = _pow10f(jnp.abs(exp_ten))
+    plain_val = jnp.where(exp_ten < 0, digitsf / plain_pow, digitsf * plain_pow)
+    number = jnp.where(
+        exp_ten > 308,
+        sign * jnp.float64(jnp.inf),
+        jnp.where(sub_shift > 0, sub_val, plain_val),
+    )
+    number = jnp.where(is_zero, sign * jnp.float64(0.0), number)
+
+    value = jnp.where(
+        nan_ok,
+        jnp.float64(jnp.nan),
+        jnp.where(inf_ok, sign * jnp.float64(jnp.inf), number),
+    )
+    valid = base_valid & (nan_ok | inf_ok | num_ok)
+    # ANSI "except" flag: digit-path errors (including empty/all-whitespace
+    # strings, which fail the seen-valid-digit check, ref :400-405) and
+    # nan-with-junk raise; a bad inf is a plain null without an exception
+    # (reference check_for_inf sets only _valid) — replicated quirk.
+    except_flag = col.validity & (nan_bad | (~word_path & num_invalid))
+    _ = inf_bad  # inf junk: plain null (documented above)
+
+    out = Column(value.astype(dtype.jnp_dtype), valid, dtype)
+    if ansi_mode:
+        bad = np.asarray(jax.device_get(except_flag))
+        if bad.any():
+            row = int(np.argmax(bad))
+            s_err = col.to_pylist()[row]
+            raise CastException(s_err if s_err is not None else "<null>", row)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# string -> decimal
+# ---------------------------------------------------------------------------
+
+
+def string_to_decimal(
+    col: StringColumn,
+    precision: int,
+    scale: int,
+    ansi_mode: bool = False,
+    strip: bool = True,
+) -> Column:
+    """Spark-exact string -> decimal (reference cast_string.cu:247-582).
+
+    ``scale`` follows the cudf/JNI convention of the reference API: negative
+    scale means fraction digits (``string_to_decimal(precision=3, scale=-1)``
+    of "9.23" gives unscaled 92).  The returned column's SparkType carries
+    the Spark-style scale (``-scale``).
+
+    Semantics replicated from the two-phase reference kernel:
+
+    * phase A validates (optional stripped whitespace, sign, digits, one
+      '.', exponent with sign) and finds the virtual decimal location =
+      (digit count before '.'|'e'|ws) + exponent.  Quirks preserved: a bare
+      trailing "e" or "e+" is VALID with exponent 0, "1e5 " is invalid
+      (nothing may follow exponent digits), "." parses as 0.
+    * phase B walks digits accumulating into the storage type, rounding
+      half-up (away from zero) at the first digit beyond ``precision`` or
+      beyond ``decimal_location - scale``, tracking whether rounding added
+      a digit (999 -> 1000), then zero-pads up to the decimal location and
+      down to the scale, failing on overflow or when more integer digits
+      are required than ``precision + scale`` allows.
+
+    Only precision <= 18 (decimal32/64 storage) is supported until the
+    decimal128 limb arithmetic lands.
+    """
+    if precision > 18:
+        raise NotImplementedError(
+            "string_to_decimal with precision > 18 needs decimal128 limb math"
+        )
+    if precision <= 9:
+        tmin, tmax = -(2**31), 2**31 - 1
+    else:
+        tmin, tmax = -(2**63), 2**63 - 1
+
+    chars, lengths = col.chars, col.lengths
+    n, L = chars.shape
+    idx = jnp.arange(L)[None, :]
+    in_range = idx < lengths[:, None]
+
+    first_digit, has_sign, _neg = strip_and_sign(chars, lengths, strip)
+    positive = ~_neg
+    base_valid = col.validity & (lengths > 0) & (first_digit < lengths)
+
+    # state machine over [first_digit, len): states as in the reference
+    ST_DIGITS, ST_EXP_OR_SIGN, ST_EXP_SIGN, ST_EXP, ST_TRAIL_WS, ST_INVALID = range(6)
+    min64 = jnp.int64(tmin)
+    max64 = jnp.int64(tmax)
+    min_div10 = jnp.int64(int(tmin / 10))
+    max_div10 = jnp.int64(tmax // 10)
+
+    def phase_a(j, st):
+        state, dec_loc, exp_val, exp_pos, last_digit, seen_exp_digit = st
+        c = chars[:, j]
+        active = base_valid & (j >= first_digit) & (j < lengths)
+        rel = j - first_digit  # chr_idx in the reference
+        is_d = _is_digit(c)
+        ws = _is_ws(c)
+        allowed_ws = ws & (rel != 0) & strip
+
+        in_digits = state == ST_DIGITS
+        to_decimal = in_digits & (c == ord(".")) & (dec_loc < 0)
+        to_exp_or_sign = in_digits & ((c == ord("e")) | (c == ord("E")))
+        to_trail_from_digits = in_digits & ~is_d & ~to_decimal & ~to_exp_or_sign & allowed_ws
+        digits_invalid = in_digits & ~is_d & ~to_decimal & ~to_exp_or_sign & ~allowed_ws
+
+        in_eos = state == ST_EXP_OR_SIGN
+        eos_sign = in_eos & ((c == ord("+")) | (c == ord("-")))
+        eos_trail = in_eos & ~eos_sign & allowed_ws
+        eos_digit = in_eos & ~eos_sign & ~eos_trail & is_d
+        eos_invalid = in_eos & ~eos_sign & ~eos_trail & ~is_d
+
+        in_exp = (state == ST_EXP) | (state == ST_EXP_SIGN)
+        exp_digit = in_exp & is_d
+        exp_invalid = in_exp & ~is_d
+
+        trail_invalid = (state == ST_TRAIL_WS) & ~ws
+
+        new_state = jnp.where(
+            to_decimal | (in_digits & is_d),
+            ST_DIGITS,
+            jnp.where(
+                to_exp_or_sign,
+                ST_EXP_OR_SIGN,
+                jnp.where(
+                    eos_sign,
+                    ST_EXP_SIGN,
+                    jnp.where(
+                        eos_digit | exp_digit,
+                        ST_EXP,
+                        jnp.where(
+                            to_trail_from_digits | eos_trail, ST_TRAIL_WS, state
+                        ),
+                    ),
+                ),
+            ),
+        )
+        invalid_now = (
+            digits_invalid | eos_invalid | exp_invalid | trail_invalid
+        )
+        new_state = jnp.where(invalid_now, ST_INVALID, new_state)
+        # decimal location: index (relative) of the '.'
+        dec_loc = jnp.where(active & to_decimal, rel, dec_loc)
+        # leaving DIGITS (state was digits, new is exp-or-sign or trailing):
+        # record the end of the digit run (reference :353-356)
+        leaving = in_digits & (to_exp_or_sign | to_trail_from_digits)
+        last_digit = jnp.where(active & leaving, j, last_digit)
+        exp_pos = jnp.where(active & eos_sign & (c == ord("-")), False, exp_pos)
+
+        # exponent accumulation with the same overflow rules as digits
+        d = (c - ord("0")).astype(jnp.int64)
+        is_exp_dig = active & (eos_digit | exp_digit)
+        first = ~seen_exp_digit
+        mul_ovf = ~first & jnp.where(exp_pos, exp_val > max_div10, exp_val < min_div10)
+        e10 = jnp.where(first, exp_val, exp_val * 10)
+        add_ovf = jnp.where(exp_pos, e10 > max64 - d, e10 < min64 + d)
+        newexp = jnp.where(exp_pos, e10 + d, e10 - d)
+        new_state = jnp.where(is_exp_dig & (mul_ovf | add_ovf), ST_INVALID, new_state)
+        exp_val = jnp.where(is_exp_dig & ~(mul_ovf | add_ovf), newexp, exp_val)
+        seen_exp_digit = seen_exp_digit | is_exp_dig
+
+        state = jnp.where(active, new_state, state)
+        return state, dec_loc, exp_val, exp_pos, last_digit, seen_exp_digit
+
+    init_a = (
+        jnp.full((n,), ST_DIGITS, jnp.int32),
+        jnp.full((n,), -1, jnp.int32),       # decimal '.' relative index
+        jnp.zeros((n,), jnp.int64),          # exponent value
+        jnp.ones((n,), jnp.bool_),           # exponent positive
+        jnp.full((n,), -1, jnp.int32),       # absolute end of digit run
+        jnp.zeros((n,), jnp.bool_),
+    )
+    state, dot_rel, exp_val, _, last_digit_abs, _ = jax.lax.fori_loop(
+        0, L, phase_a, init_a
+    )
+    a_valid = base_valid & (state != ST_INVALID)
+    last_digit_abs = jnp.where(last_digit_abs < 0, lengths, last_digit_abs)
+    dec_loc = jnp.where(
+        dot_rel >= 0, dot_rel.astype(jnp.int64), (last_digit_abs - first_digit).astype(jnp.int64)
+    )
+    dec_loc = dec_loc + exp_val
+
+    # ---- significant digits before the decimal location (reference :425-441)
+    digit = _is_digit(chars)
+    after_first = (idx >= first_digit[:, None]) & in_range
+    # stop at e/E
+    is_e = (chars == ord("e")) | (chars == ord("E"))
+    before_e = jnp.cumsum((is_e & after_first).astype(jnp.int32), axis=1) == 0
+    scan_region = after_first & before_e
+    digits_found = jnp.cumsum((digit & scan_region).astype(jnp.int64), axis=1)
+    # digit qualifies if its ordinal <= dec_loc
+    qualifying = digit & scan_region & (digits_found <= dec_loc[:, None])
+    # significant = from first nonzero qualifying digit on
+    nz_qual = qualifying & (chars != ord("0"))
+    any_nzq = nz_qual.any(axis=1)
+    first_nzq = jnp.where(any_nzq, jnp.argmax(nz_qual, axis=1), L).astype(jnp.int32)
+    sig_before_in_string = (qualifying & (idx >= first_nzq[:, None])).sum(axis=1).astype(jnp.int64)
+
+    # ---- phase B: build the value with rounding ----------------------
+    last_digit_cnt = dec_loc - scale  # digits to keep (reference :452)
+    pow10_i64 = jnp.asarray([10**k for k in range(19)], jnp.int64)
+
+    def count_digits(v):
+        a = jnp.abs(v)
+        return jnp.searchsorted(pow10_i64, a, side="right").astype(jnp.int32)
+
+    def phase_b(j, st):
+        val, total, precise, found_sig, rounding, done, bvalid, dloc = st
+        c = chars[:, j]
+        active = (
+            a_valid
+            & bvalid
+            & ~done
+            & (j >= first_digit)
+            & (j < lengths)
+            & (last_digit_cnt >= 0)
+        )
+        is_dot = c == ord(".")
+        is_d = _is_digit(c)
+        brk = active & ~is_dot & ~is_d
+        done = done | brk
+        process = active & is_d & ~brk
+
+        d = (c - ord("0")).astype(jnp.int64)
+        need_round = (precise + 1 > precision) | (total + 1 > last_digit_cnt)
+
+        # rounding path (reference :474-512)
+        inc_ovf = jnp.where(positive, val > max64 - 1, val < min64 + 1)
+        rounded = jnp.where(positive, val + 1, val - 1)
+        adds_digit = (val != 0) & (count_digits(rounded) > count_digits(val))
+        do_round = process & need_round & (d >= 5)
+        round_fail = do_round & inc_ovf
+        val = jnp.where(do_round & ~inc_ovf, rounded, val)
+        grow = do_round & ~inc_ovf & adds_digit
+        total = total + grow.astype(jnp.int64)
+        precise = precise + grow.astype(jnp.int64)
+        dloc = dloc + grow.astype(jnp.int64)
+        rounding = rounding + grow.astype(jnp.int64)
+        done = done | (process & need_round)
+        bvalid = bvalid & ~round_fail
+
+        # normal digit accumulation
+        acc = process & ~need_round
+        total = total + acc.astype(jnp.int64)
+        newly_sig = found_sig | (total > dloc) | (d != 0)
+        first = j == first_digit
+        mul_ovf = ~first & jnp.where(positive, val > max_div10, val < min_div10)
+        v10 = jnp.where(first, val, val * 10)
+        add_ovf = jnp.where(positive, v10 > max64 - d, v10 < min64 + d)
+        ovf = acc & (mul_ovf | add_ovf)
+        val = jnp.where(acc & ~ovf, jnp.where(positive, v10 + d, v10 - d), val)
+        precise = precise + (acc & newly_sig).astype(jnp.int64)
+        found_sig = jnp.where(acc, newly_sig, found_sig)
+        bvalid = bvalid & ~ovf
+        done = done | ovf
+        return val, total, precise, found_sig, rounding, done, bvalid, dloc
+
+    init_b = (
+        jnp.zeros((n,), jnp.int64),
+        jnp.zeros((n,), jnp.int64),
+        jnp.zeros((n,), jnp.int64),
+        jnp.zeros((n,), jnp.bool_),
+        jnp.zeros((n,), jnp.int64),
+        jnp.zeros((n,), jnp.bool_),
+        jnp.ones((n,), jnp.bool_),
+        dec_loc,
+    )
+    val, total, precise, _, rounding, _, b_valid, dec_loc2 = jax.lax.fori_loop(
+        0, L, phase_b, init_b
+    )
+
+    # ---- padding & precision checks (reference :531-573) --------------
+    sig_preceding_zeros = jnp.maximum(0, -dec_loc2)
+    zeros_to_decimal = jnp.maximum(
+        0,
+        jnp.where(scale > 0, dec_loc2 - total - scale, dec_loc2 - total),
+    )
+    sig_before = sig_before_in_string + zeros_to_decimal + rounding
+    fits = (precision + scale) >= sig_before
+
+    # pad up to the decimal location: val *= 10 zeros_to_decimal times
+    def pad_loop(k, st):
+        val, precise, ok = st
+        do = (k < zeros_to_decimal) & ok
+        ovf = jnp.where(positive, val > max_div10, val < min_div10)
+        val = jnp.where(do & ~ovf, val * 10, val)
+        precise = precise + (do & ~ovf).astype(jnp.int64)
+        ok = ok & ~(do & ovf)
+        return val, precise, ok
+
+    max_pad = int(precision + abs(scale) + 2)
+    val, precise, pad_ok = jax.lax.fori_loop(
+        0, max_pad, pad_loop, (val, precise, jnp.ones((n,), jnp.bool_))
+    )
+
+    digits_after = precise - sig_before + sig_preceding_zeros
+    needed_after = jnp.minimum(precision - sig_before, -scale)
+
+    def pad2_loop(k, st):
+        val, ok = st
+        do = ((digits_after + k) < needed_after) & ok
+        ovf = jnp.where(positive, val > max_div10, val < min_div10)
+        val = jnp.where(do & ~ovf, val * 10, val)
+        ok = ok & ~(do & ovf)
+        return val, ok
+
+    val, pad2_ok = jax.lax.fori_loop(0, max_pad, pad2_loop, (val, jnp.ones((n,), jnp.bool_)))
+
+    valid = a_valid & b_valid & fits & pad_ok & pad2_ok
+    dtype = T.SparkType.decimal(precision, -scale)
+    out = Column(val.astype(dtype.jnp_dtype), valid, dtype)
+    if ansi_mode:
+        _raise_on_invalid(col, valid)
+    return out

@@ -55,14 +55,7 @@ def _rotl32(x, r: int):
     return (x << r) | (x >> (32 - r))
 
 
-def _gather_byte(chars, pos):
-    """Per-row byte gather: chars[i, pos[i]] with clamped out-of-range pos.
-
-    Callers mask out rows where pos is past the row's length, so the clamp
-    only has to keep the gather in bounds.
-    """
-    L = chars.shape[1]
-    return jnp.take_along_axis(chars, jnp.clip(pos, 0, L - 1)[:, None], axis=1)[:, 0]
+from ._util import char_at as _gather_byte  # noqa: E402
 
 
 def _mm3_mix(h, k1):
@@ -404,10 +397,22 @@ def _as_columns(columns: Columns):
     return cols
 
 
+def _validate(cols):
+    if not cols:
+        raise ValueError("hashing requires at least 1 column of input")
+    n = cols[0].num_rows
+    for c in cols:
+        if c.num_rows != n:
+            raise ValueError(
+                f"row count mismatch: {c.num_rows} vs {n}; all columns must be the same size"
+            )
+    return n
+
+
 def murmur_hash3_32(columns: Columns, seed: int = 42) -> Column:
     """Spark Murmur3_32 row hash across columns (reference murmur_hash.cu:187)."""
     cols = _as_columns(columns)
-    n = cols[0].num_rows
+    n = _validate(cols)
     h = jnp.full((n,), jnp.uint32(seed & 0xFFFFFFFF))
     for c in cols:
         h = jnp.where(c.validity, _element_murmur3(c, h), h)
@@ -418,7 +423,7 @@ def murmur_hash3_32(columns: Columns, seed: int = 42) -> Column:
 def xxhash64(columns: Columns, seed: int = DEFAULT_XXHASH64_SEED) -> Column:
     """Spark XXHash64 row hash across columns (reference xxhash64.cu:330)."""
     cols = _as_columns(columns)
-    n = cols[0].num_rows
+    n = _validate(cols)
     h = jnp.full((n,), jnp.uint64(seed & 0xFFFFFFFFFFFFFFFF))
     for c in cols:
         h = jnp.where(c.validity, _element_xxhash64(c, h), h)

@@ -1,0 +1,357 @@
+"""Parity tests for string->int and string->float casts.
+
+Golden cases from the reference CastStringsTest.java plus a randomized
+cross-check against a host oracle implementing the same contract.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.columnar import types as T
+from spark_rapids_jni_tpu.columnar.column import StringColumn
+from spark_rapids_jni_tpu.ops.cast_string import (
+    CastException,
+    string_to_float,
+    string_to_integer,
+)
+
+
+def cast_int(vals, dtype=T.INT32, ansi=False, strip=True):
+    col = StringColumn.from_pylist(vals)
+    return string_to_integer(col, dtype, ansi_mode=ansi, strip=strip).to_pylist()
+
+
+def cast_float(vals, dtype=T.FLOAT64, ansi=False):
+    col = StringColumn.from_pylist(vals)
+    return string_to_float(col, dtype, ansi_mode=ansi).to_pylist()
+
+
+class TestStringToIntegerGolden:
+    """castToIntegerTest / castToIntegerNoStripTest from the reference."""
+
+    def test_strip_int64(self):
+        got = cast_int(
+            [" 3", "9", "4", "2", "20.5", None, "7.6asd", "\x00 \x1f1\x14"],
+            T.INT64,
+        )
+        assert got == [3, 9, 4, 2, 20, None, None, 1]
+
+    def test_strip_int32(self):
+        got = cast_int(["5", "1  ", "0", "2", "7.1", None, "asdf", "\x00 \x1f1\x14"])
+        assert got == [5, 1, 0, 2, 7, None, None, 1]
+
+    def test_strip_int8(self):
+        got = cast_int(
+            ["2", "3", " 4 ", "5", " 9.2 ", None, "7.8.3", "\x00 \x1f1\x14"], T.INT8
+        )
+        assert got == [2, 3, 4, 5, 9, None, None, 1]
+
+    def test_nostrip_int64(self):
+        got = cast_int(
+            [" 3", "9", "4", "2", "20.5", None, "7.6asd"], T.INT64, strip=False
+        )
+        assert got == [None, 9, 4, 2, 20, None, None]
+
+    def test_nostrip_int32(self):
+        got = cast_int(["5", "1 ", "0", "2", "7.1", None, "asdf"], strip=False)
+        assert got == [5, None, 0, 2, 7, None, None]
+
+    def test_nostrip_int8(self):
+        got = cast_int(
+            ["2", "3", " 4 ", "5.6", " 9.2 ", None, "7.8.3"], T.INT8, strip=False
+        )
+        assert got == [2, 3, None, 5, None, None, None]
+
+
+class TestStringToIntegerSemantics:
+    def test_bounds_and_overflow(self):
+        got = cast_int(
+            ["127", "128", "-128", "-129"], T.INT8
+        )
+        assert got == [127, None, -128, None]
+        got = cast_int(
+            ["2147483647", "2147483648", "-2147483648", "-2147483649"], T.INT32
+        )
+        assert got == [2**31 - 1, None, -(2**31), None]
+        got = cast_int(
+            [
+                "9223372036854775807",
+                "9223372036854775808",
+                "-9223372036854775808",
+                "-9223372036854775809",
+            ],
+            T.INT64,
+        )
+        assert got == [2**63 - 1, None, -(2**63), None]
+
+    def test_dot_quirks(self):
+        # "." parses as 0 in non-ANSI mode (truncation with no digits)
+        assert cast_int([".", "+.", ".5", "5.", "1.2.3"]) == [0, 0, 0, 5, None]
+
+    def test_signs(self):
+        assert cast_int(["+5", "-5", "+-5", "+", "-", "- 5"]) == [
+            5,
+            -5,
+            None,
+            None,
+            None,
+            None,
+        ]
+
+    def test_empty_and_ws(self):
+        assert cast_int(["", " ", "  1  ", "1 1"]) == [None, None, 1, None]
+
+    def test_mid_string_dot_validation(self):
+        # chars after the truncation point are still validated
+        assert cast_int(["20.5x", "20.55", "20.5 "]) == [None, 20, 20]
+
+    def test_ansi_dot_invalid(self):
+        with pytest.raises(CastException) as e:
+            cast_int(["3", "20.5"], ansi=True)
+        assert e.value.row_with_error == 1
+        assert e.value.string_with_error == "20.5"
+
+    def test_ansi_null_passthrough(self):
+        # null inputs are not errors in ANSI mode
+        assert cast_int(["3", None], ansi=True) == [3, None]
+
+    def test_ansi_first_bad_row(self):
+        with pytest.raises(CastException) as e:
+            cast_int(["1", "x", "y"], ansi=True)
+        assert e.value.row_with_error == 1
+
+
+class TestStringToFloatGolden:
+    def test_trim_c0_controls(self):
+        # row 5 ends in U+009F (not whitespace: >= 0x80) -> null;
+        # row 6 ends in '!' -> null (reference castToFloatsTrimTest)
+        got = cast_float(
+            [
+                "1.1\x00",
+                "1.2\x14",
+                "1.3\x1f",
+                "\x00\x001.4\x00",
+                "1.5\x00 \x00",
+                "1.6\u009f",
+                "1.7\u0021",
+            ]
+        )
+        assert got == [1.1, 1.2, 1.3, 1.4, 1.5, None, None]
+
+    def test_nan(self):
+        got = cast_float(
+            ["nan", "nan ", " nan ", "NAN", "nAn ", " NAn ", "Nan 0", "nan  nan"]
+        )
+        assert [np.isnan(x) if x is not None else None for x in got] == [
+            True,
+            True,
+            True,
+            True,
+            True,
+            True,
+            None,
+            None,
+        ]
+
+    def test_inf(self):
+        inf = float("inf")
+        got = cast_float(
+            ["INFINITY ", "inf", "+inf ", " -INF  ", "INFINITY AND BEYOND", "INF"]
+        )
+        assert got == [inf, inf, inf, -inf, None, inf]
+
+
+class TestStringToFloatSemantics:
+    def test_basic_values(self):
+        got = cast_float(
+            ["0", "-0", "1", "-1.5", "3.14159", "1e10", "1E-10", "1.5e3", "2e+2"]
+        )
+        assert got == [0.0, -0.0, 1.0, -1.5, 3.14159, 1e10, 1e-10, 1500.0, 200.0]
+        # -0.0 sign preserved
+        assert np.signbit(got[1])
+
+    def test_trailing_fd(self):
+        # one trailing f/F/d/D allowed after a nonzero number...
+        assert cast_float(["1.5f", "1.5F", "2d", "2D", "1.5f ", "1.5ff"]) == [
+            1.5,
+            1.5,
+            2.0,
+            2.0,
+            1.5,
+            None,
+        ]
+        # ...but not after a zero (reference quirk: digits==0 path skips it)
+        assert cast_float(["0f", "0.0d"]) == [None, None]
+
+    def test_19_digit_truncation(self):
+        # 20 significant digits: the 20th is dropped (becomes a trailing zero)
+        assert cast_float(["12345678901234567890"]) == [
+            float(1234567890123456789) * 10.0
+        ]
+        # all-zero counted digits beyond budget collapse to 0.0 (quirk)
+        assert cast_float(["0." + "0" * 19 + "123"]) == [0.0]
+
+    def test_exponent_rules(self):
+        assert cast_float(["1e", "1e+", "1e-", "1e5x", "1ee5"]) == [
+            None,
+            None,
+            None,
+            None,
+            None,
+        ]
+        # max 4 exponent digits are consumed; a 5th is trailing junk
+        assert cast_float(["1e12345"]) == [None]
+        assert cast_float(["1e309", "-1e309"]) == [float("inf"), float("-inf")]
+        assert cast_float(["1e-310"])[0] == pytest.approx(1e-310)
+
+    def test_dot_rules(self):
+        assert cast_float([".", "1.2.3", ".5", "5.", "-.5"]) == [
+            None,
+            None,
+            0.5,
+            5.0,
+            -0.5,
+        ]
+
+    def test_neg_nan_rejected(self):
+        assert cast_float(["-nan", "+nan"])[0] is None
+        assert np.isnan(cast_float(["+nan"])[0])
+
+    def test_float32_narrowing(self):
+        got = cast_float(["1.1", "3.4028235e38", "1e39"], T.FLOAT32)
+        assert got[0] == np.float32("1.1")
+        assert got[1] == np.float32(3.4028235e38)
+        assert got[2] == float("inf")
+
+    def test_empty_and_garbage(self):
+        assert cast_float(["", " ", "abc", "--1", "++1", "1-1"]) == [None] * 6
+
+    def test_ansi_inf_junk_no_throw(self):
+        # bad inf is a plain null even in ANSI mode (reference quirk)
+        assert cast_float(["inf junk"], ansi=True) == [None]
+
+    def test_ansi_garbage_throws(self):
+        with pytest.raises(CastException) as e:
+            cast_float(["1.0", "abc"], ansi=True)
+        assert e.value.row_with_error == 1
+
+    def test_ansi_neg_nan_throws(self):
+        with pytest.raises(CastException):
+            cast_float(["-nan"], ansi=True)
+
+
+class TestStringToFloatOracle:
+    """Randomized cross-check vs python float() on well-formed inputs."""
+
+    def test_roundtrip_simple_numbers(self, rng):
+        vals = []
+        for _ in range(200):
+            mant = rng.integers(-(10**15), 10**15)
+            exp = rng.integers(-30, 30)
+            vals.append(f"{mant}e{exp}")
+        got = cast_float(vals)
+        for s, g in zip(vals, got):
+            expect = float(s)
+            assert g == pytest.approx(expect, rel=1e-15), s
+
+    def test_roundtrip_decimals(self, rng):
+        vals = [
+            f"{rng.integers(-10**6, 10**6)}.{rng.integers(0, 10**9)}"
+            for _ in range(200)
+        ]
+        got = cast_float(vals)
+        for s, g in zip(vals, got):
+            assert g == pytest.approx(float(s), rel=1e-15), s
+
+    def test_int_oracle_random(self, rng):
+        vals = [str(v) for v in rng.integers(-(2**62), 2**62, size=200)]
+        got = cast_int(vals, T.INT64)
+        assert got == [int(v) for v in vals]
+
+
+class TestStringToDecimalGolden:
+    """castToDecimalTest / castToDecimalNoStripTest from the reference."""
+
+    def cast_dec(self, vals, precision, scale, ansi=False, strip=True):
+        col = StringColumn.from_pylist(vals)
+        from spark_rapids_jni_tpu.ops.cast_string import string_to_decimal
+
+        return string_to_decimal(
+            col, precision, scale, ansi_mode=ansi, strip=strip
+        ).to_pylist()
+
+    def test_strip_columns(self):
+        got = self.cast_dec(
+            [" 3", "9", "4", "2", "20.5", None, "7.6asd", "\x00 \x1f1\x14"], 2, 0
+        )
+        assert got == [3, 9, 4, 2, 21, None, None, 1]
+        got = self.cast_dec(
+            ["5", "1 ", "0", "2", "7.1", None, "asdf", "\x00 \x1f1\x14"], 10, 0
+        )
+        assert got == [5, 1, 0, 2, 7, None, None, 1]
+        got = self.cast_dec(
+            ["2", "3", " 4 ", "5.07", "9.23", None, "7.8.3", "\x00 \x1f1\x14"], 3, -1
+        )
+        assert got == [20, 30, 40, 51, 92, None, None, 10]
+
+    def test_nostrip_columns(self):
+        got = self.cast_dec(
+            [" 3", "9", "4", "2", "20.5", None, "7.6asd"], 2, 0, strip=False
+        )
+        assert got == [None, 9, 4, 2, 21, None, None]
+        got = self.cast_dec(
+            ["5", "1 ", "0", "2", "7.1", None, "asdf"], 10, 0, strip=False
+        )
+        assert got == [5, None, 0, 2, 7, None, None]
+        got = self.cast_dec(
+            ["2", "3", " 4 ", "5.07", "9.23", None, "7.8.3"], 3, -1, strip=False
+        )
+        assert got == [20, 30, None, 51, 92, None, None]
+
+
+class TestStringToDecimalSemantics:
+    def cast_dec(self, vals, precision, scale, **kw):
+        return TestStringToDecimalGolden().cast_dec(vals, precision, scale, **kw)
+
+    def test_rounding_half_up(self):
+        assert self.cast_dec(["1.4", "1.5", "-1.5", "-1.4"], 2, 0) == [1, 2, -2, -1]
+        assert self.cast_dec(["0.05", "0.04"], 2, -1) == [1, 0]
+
+    def test_rounding_adds_digit(self):
+        # 9.99 -> 10 at scale 0 still fits precision 2
+        assert self.cast_dec(["9.99"], 2, 0) == [10]
+        # but overflows precision 1
+        assert self.cast_dec(["9.99"], 1, 0) == [None]
+
+    def test_precision_overflow(self):
+        assert self.cast_dec(["100", "99"], 2, 0) == [None, 99]
+        # scale 2 means two implied trailing zeros: 123456 -> 1235 (rounded
+        # at 4 kept digits), 1234.5 -> 12 (i.e. 1200)
+        assert self.cast_dec(["123456", "1234.5"], 4, 2) == [1235, 12]
+
+    def test_exponent(self):
+        assert self.cast_dec(["1e2", "1.5e3", "15e-1"], 5, 0) == [100, 1500, 2]
+        # bare trailing e / e+ are VALID with exponent 0 (reference quirk)
+        assert self.cast_dec(["1e", "1e+", "1e-"], 5, 0) == [1, 1, 1]
+        # nothing may follow exponent digits, not even whitespace
+        assert self.cast_dec(["1e5 ", "1e5x"], 9, 0) == [None, None]
+        # but "1e " is fine (whitespace from the exp-or-sign state)
+        assert self.cast_dec(["1e "], 5, 0) == [1]
+
+    def test_scale_padding(self):
+        # decimal(6,-5): 0.012 -> 1200 (pad to scale)
+        assert self.cast_dec(["0.012"], 6, -5) == [1200]
+        # decimal(6,2): 123456 -> 1235 (x100 implied)
+        assert self.cast_dec(["123456"], 6, 2) == [1235]
+
+    def test_dot_and_signs(self):
+        assert self.cast_dec([".", "-.5", "+.5", ".5."], 3, -1) == [0, -5, 5, None]
+
+    def test_negative_dec_loc(self):
+        # 0.00123 at scale -5 -> 123
+        assert self.cast_dec(["0.00123"], 5, -5) == [123]
+        assert self.cast_dec(["1e-3"], 5, -5) == [100]
+
+    def test_ansi_throws(self):
+        with pytest.raises(CastException):
+            self.cast_dec(["1.5", "abc"], 5, 0, ansi=True)
