@@ -32,5 +32,6 @@ jax.config.update("jax_enable_x64", True)
 
 from . import columnar  # noqa: E402
 from . import ops  # noqa: E402
+from . import relational  # noqa: E402
 
 __version__ = "0.1.0"

@@ -1,0 +1,36 @@
+"""Relational operators (filter / sort / aggregate / join), TPU-first.
+
+The reference repo delegates these to libcudf (SURVEY.md §2 preamble); for the
+TPU framework they are in-tree, built on three primitives chosen for the XLA
+compilation model:
+
+* **Order-preserving radix keys** (:mod:`keys`): every Spark key column maps
+  to one or more ``uint32`` arrays whose lexicographic unsigned order equals
+  Spark's SQL ordering (nulls placement included).  32-bit lanes are native
+  to the TPU VPU; 64-bit compares would be emulated.
+* **Static shapes everywhere**: filters/joins return padded outputs plus a
+  device row count instead of dynamically-shaped arrays, so everything stays
+  inside one ``jit`` region.
+* **Sort-based grouping/joining**: ``lax.sort`` + segmented reductions and
+  binary-search probes, instead of a pointer-chasing hash table — the MXU/VPU
+  have no efficient scatter-chase, but bitonic sort and vectorized gathers
+  pipeline well.
+"""
+
+from .filter import apply_mask, compact
+from .gather import gather_batch, gather_column
+from .sort import SortKey, sort_by
+from .aggregate import AggSpec, group_by
+from .join import hash_join
+
+__all__ = [
+    "apply_mask",
+    "compact",
+    "gather_batch",
+    "gather_column",
+    "SortKey",
+    "sort_by",
+    "AggSpec",
+    "group_by",
+    "hash_join",
+]

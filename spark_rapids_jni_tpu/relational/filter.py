@@ -1,0 +1,55 @@
+"""Filter: boolean-mask row selection with static-shape compaction.
+
+XLA demands static shapes, so ``compact`` keeps the input length and returns
+``(batch, count)``: selected rows are moved (stably) to the front, ``count``
+is a device scalar, and trailing rows are nulled out.  Downstream kernels
+either honor ``count`` or operate harmlessly on null padding — the same
+discipline the reference applies to its ≤2GB batch splits (SURVEY.md §5
+"long-context analogues").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from ..columnar.column import ColumnBatch
+from .gather import gather_batch
+
+
+def selection_indices(mask):
+    """(idx int32[n], count int32): stable front-compaction of True rows.
+
+    ``idx[:count]`` are the positions of the True rows in order; the tail is
+    filled with an arbitrary (clipped) index and masked invalid by callers.
+    """
+    n = mask.shape[0]
+    mask = mask.astype(jnp.bool_)
+    count = mask.sum(dtype=jnp.int32)
+    # stable argsort of (not mask): True rows first, original order preserved
+    idx = jnp.argsort(~mask, stable=True).astype(jnp.int32)
+    return idx, count
+
+
+def compact(batch: ColumnBatch, mask) -> tuple:
+    """Move rows where ``mask`` is True to the front; null out the tail."""
+    idx, count = selection_indices(mask)
+    valid = jnp.arange(idx.shape[0], dtype=jnp.int32) < count
+    return gather_batch(batch, idx, valid), count
+
+
+def apply_mask(batch: ColumnBatch, mask) -> ColumnBatch:
+    """Null out rows where ``mask`` is False (no movement).
+
+    The cheap filter: keeps shapes and row positions, so it fuses into
+    surrounding elementwise work; use ``compact`` only when downstream cost
+    depends on live row count.
+    """
+    mask = mask.astype(jnp.bool_)
+    return ColumnBatch(
+        {
+            name: dataclasses.replace(col, validity=col.validity & mask)
+            for name, col in zip(batch.names, batch.columns)
+        }
+    )

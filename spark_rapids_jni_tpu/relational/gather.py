@@ -1,0 +1,43 @@
+"""Row gather for every column representation.
+
+The workhorse behind sort / filter-compaction / join materialization: one
+permutation (or index) vector applied to each buffer of each column.  On TPU
+this lowers to XLA gathers, which vectorize on the VPU; the string char
+matrix gathers whole padded rows (a 2-D gather with a broadcast index).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..columnar.column import Column, ColumnBatch, Decimal128Column, StringColumn
+
+
+def gather_column(col, idx, valid=None):
+    """Take rows ``idx`` (int32[m], clipped); rows where ``valid`` is False
+    become nulls (used for padded filter/join outputs)."""
+    n = col.num_rows
+    idx = jnp.clip(idx, 0, max(n - 1, 0))
+    if isinstance(col, StringColumn):
+        v = col.validity[idx]
+        if valid is not None:
+            v = v & valid
+        return StringColumn(col.chars[idx], col.lengths[idx] * v, v, col.dtype)
+    if isinstance(col, Decimal128Column):
+        v = col.validity[idx]
+        if valid is not None:
+            v = v & valid
+        return Decimal128Column(col.limbs[idx], v, col.dtype)
+    v = col.validity[idx]
+    if valid is not None:
+        v = v & valid
+    return Column(col.data[idx], v, col.dtype)
+
+
+def gather_batch(batch: ColumnBatch, idx, valid=None) -> ColumnBatch:
+    return ColumnBatch(
+        {
+            name: gather_column(col, idx, valid)
+            for name, col in zip(batch.names, batch.columns)
+        }
+    )

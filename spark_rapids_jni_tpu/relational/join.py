@@ -1,0 +1,149 @@
+"""Joins: sorted-build + binary-search probe, static-shape outputs.
+
+libcudf joins use a GPU hash table; on TPU pointer-chasing scatters serialize
+on the VPU, while sort + vectorized lexicographic binary search (log2(n)
+gather rounds, every probe row in flight at once) pipelines well and needs
+no dynamic shapes.  Matches expand via the classic offsets/searchsorted
+expansion, padded to a static ``capacity``.
+
+Spark semantics: SQL equality join keys — ``null`` matches nothing (inner
+drops null-keyed rows, left outer emits them with a null right side, left
+anti *keeps* them); float keys normalize -0.0/NaN (equality domain of
+:mod:`keys`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.column import Column, ColumnBatch, Decimal128Column, StringColumn
+from . import keys as K
+from .filter import compact
+from .gather import gather_batch
+
+_HOWS = ("inner", "left", "semi", "anti")
+
+
+def _one_null_row_like(batch: ColumnBatch) -> ColumnBatch:
+    """A 1-row all-null batch with the same schema (empty-build-side pad).
+
+    The padding row can never match: its null flag differs from every valid
+    probe key, and ``counts`` is forced to zero anyway.
+    """
+    out = {}
+    for name, col in zip(batch.names, batch.columns):
+        invalid = jnp.zeros((1,), jnp.bool_)
+        if isinstance(col, StringColumn):
+            out[name] = StringColumn(
+                jnp.zeros((1, col.max_len), jnp.uint8),
+                jnp.zeros((1,), jnp.int32),
+                invalid,
+                col.dtype,
+            )
+        elif isinstance(col, Decimal128Column):
+            out[name] = Decimal128Column(
+                jnp.zeros((1, 2), jnp.uint64), invalid, col.dtype
+            )
+        else:
+            out[name] = Column(
+                jnp.zeros((1,), col.data.dtype), invalid, col.dtype
+            )
+    return ColumnBatch(out)
+
+
+def hash_join(
+    left: ColumnBatch,
+    right: ColumnBatch,
+    left_on: Sequence[str],
+    right_on: Sequence[str],
+    how: str = "inner",
+    capacity: Optional[int] = None,
+    suffixes: tuple = ("", "_r"),
+) -> tuple:
+    """Equality join; returns ``(result_batch, count)``.
+
+    ``capacity`` is the static output row budget for inner/left joins
+    (default: ``left.num_rows``, exact whenever the build side is unique,
+    e.g. joining a fact table to a key-unique dimension).  ``count`` is the
+    true match total; ``count > capacity`` signals truncation and callers
+    re-run with a bigger budget — the TPU analogue of the reference's
+    split-and-retry contract on output-size overflow.
+
+    semi/anti return filtered left rows (padded + count, like ``compact``).
+    """
+    if how not in _HOWS:
+        raise ValueError(f"unknown join type {how!r}")
+    if len(left_on) != len(right_on):
+        raise ValueError("left_on/right_on length mismatch")
+
+    nl, nr = left.num_rows, right.num_rows
+    if nr == 0:
+        # pad the build side with one unmatchable null row: downstream
+        # gathers stay in-bounds and every probe misses (count semantics of
+        # an empty build: inner/semi -> 0 rows, left -> all-null right, anti
+        # -> all left rows)
+        right = _one_null_row_like(right)
+        nr = 1
+    lcols, rcols = K.align_string_key_columns(
+        [left[k] for k in left_on], [right[k] for k in right_on]
+    )
+
+    # build: sort right by (null-flag, radix keys); nulls sort last and can
+    # never equal a valid probe (flag mismatch)
+    rkeys = K.batch_radix_keys(rcols, equality=True, nulls_first=False)
+    iota_r = jnp.arange(nr, dtype=jnp.int32)
+    sorted_ops = jax.lax.sort(
+        tuple(rkeys) + (iota_r,), num_keys=len(rkeys), is_stable=True
+    )
+    sorted_rkeys, rperm = sorted_ops[:-1], sorted_ops[-1]
+
+    lkeys = K.batch_radix_keys(lcols, equality=True, nulls_first=False)
+    lo, hi = K.equal_range(sorted_rkeys, lkeys)
+
+    l_null = jnp.zeros((nl,), jnp.bool_)
+    for c in lcols:
+        l_null = l_null | ~c.validity
+    counts = jnp.where(l_null, 0, hi - lo).astype(jnp.int32)
+
+    if how == "semi":
+        return compact(left, counts > 0)
+    if how == "anti":
+        return compact(left, counts == 0)
+
+    outer = how == "left"
+    counts_out = jnp.maximum(counts, 1) if outer else counts
+    cum = jnp.cumsum(counts_out)  # inclusive
+    total = cum[-1] if nl else jnp.int32(0)
+    offsets = cum - counts_out
+
+    if capacity is None:
+        capacity = nl
+    j = jnp.arange(capacity, dtype=jnp.int32)
+    # source left row for each output slot
+    li = jnp.searchsorted(cum, j, side="right").astype(jnp.int32)
+    li = jnp.clip(li, 0, max(nl - 1, 0))
+    k = j - offsets[li] if nl else jnp.zeros_like(j)
+    pos = jnp.clip(lo[li] + k, 0, max(nr - 1, 0))
+    ri = rperm[pos] if nr else jnp.zeros_like(j)
+
+    out_valid = j < total
+    matched = (counts[li] > 0) & out_valid if nl else jnp.zeros_like(out_valid)
+
+    lpart = gather_batch(left, li, out_valid)
+    right_names = [n for n in right.names if n not in right_on]
+    rpart = gather_batch(
+        right.select(right_names) if right_names else ColumnBatch({}),
+        ri,
+        matched if outer else out_valid,
+    )
+
+    collisions = set(lpart.names) & set(rpart.names)
+    merged = {}
+    for name, col in zip(lpart.names, lpart.columns):
+        merged[name + suffixes[0] if name in collisions else name] = col
+    for name, col in zip(rpart.names, rpart.columns):
+        merged[name + suffixes[1] if name in collisions else name] = col
+    return ColumnBatch(merged), total

@@ -1,0 +1,279 @@
+"""Order-preserving radix keys for sort / group-by / join.
+
+Each key column is lowered to a list of ``uint32`` arrays such that comparing
+rows by the concatenated arrays in unsigned lexicographic order reproduces
+Spark's SQL ordering:
+
+* signed ints: XOR the sign bit (``x ^ 0x80000000`` reinterpreted unsigned).
+* floats: IEEE-754 total-order transform — negative values flip all bits,
+  non-negative flip only the sign bit.  For *equality domains* (group/join)
+  Spark first normalizes ``-0.0`` to ``0.0`` and every NaN to the canonical
+  quiet NaN (NormalizeFloatingNumbers); for ordering, NaN sorts greater than
+  +Inf, which the total-order transform already gives.
+* 64-bit values emit (hi, lo) uint32 pairs — native 32-bit lanes on the VPU.
+* strings: big-endian 4-byte words of the padded char matrix.  Trailing
+  padding is zero, and a shorter string is a prefix of nothing else on equal
+  words, so unsigned word order == byte order (cudf strings compare bytewise
+  the same way).
+* decimal128: sign-flipped high limb then lower limbs (values of one Spark
+  decimal column share a scale, so unscaled-value order == value order).
+* validity: one leading flag array placing nulls first or last.
+
+The same lowering feeds ``lax.sort`` operands (sort), segment-boundary
+detection (group-by) and lexicographic binary search (join probe).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import types as T
+from ..columnar.column import Column, Decimal128Column, StringColumn
+
+_SIGN32 = jnp.uint32(0x80000000)
+_F64_QNAN = jnp.uint64(0x7FF8000000000000)
+
+
+def _split64(u64):
+    """uint64[n] -> (hi, lo) uint32 pair."""
+    return (u64 >> jnp.uint64(32)).astype(jnp.uint32), (
+        u64 & jnp.uint64(0xFFFFFFFF)
+    ).astype(jnp.uint32)
+
+
+_F32_QNAN = jnp.uint32(0x7FC00000)
+
+
+def _f32_total_order(d, normalize_zero: bool):
+    if normalize_zero:
+        d = jnp.where(d == 0.0, jnp.float32(0.0), d)
+    bits = jax.lax.bitcast_convert_type(d, jnp.uint32)
+    # all NaNs canonicalize (Java Double.compare semantics: one NaN, greatest)
+    bits = jnp.where(jnp.isnan(d), _F32_QNAN, bits)
+    neg = (bits & _SIGN32) != 0
+    return jnp.where(neg, ~bits, bits ^ _SIGN32)
+
+
+def _f64_total_order(d, normalize_zero: bool):
+    if normalize_zero:
+        d = jnp.where(d == 0.0, jnp.float64(0.0), d)
+    # bitcast via uint32 pair: TPU X64 rewrite can't bitcast 64-bit lanes
+    pair = jax.lax.bitcast_convert_type(d, jnp.uint32)
+    lo = pair[..., 0].astype(jnp.uint64)
+    hi = pair[..., 1].astype(jnp.uint64)
+    bits = lo | (hi << 32)
+    bits = jnp.where(jnp.isnan(d), _F64_QNAN, bits)
+    neg = (bits >> jnp.uint64(63)) != 0
+    sign64 = jnp.uint64(1) << jnp.uint64(63)
+    return jnp.where(neg, ~bits, bits ^ sign64)
+
+
+def column_radix_keys(col, *, equality: bool = False) -> list:
+    """Lower one column to its list of uint32 key arrays (nulls not encoded).
+
+    ``equality=True`` applies Spark's equality-domain float normalization
+    (NormalizeFloatingNumbers: -0.0 -> 0.0 for group-by / join / partition
+    keys).  Ordering domains (sort) keep -0.0 < 0.0, matching Java
+    ``Double.compare``.  NaNs canonicalize in both domains (Java has one NaN,
+    greater than +Inf).
+    """
+    if isinstance(col, StringColumn):
+        chars, L = col.chars, col.max_len
+        nwords = max(1, -(-L // 4))
+        pad = nwords * 4 - L
+        if pad:
+            chars = jnp.pad(chars, ((0, 0), (0, pad)))
+        w = chars.astype(jnp.uint32).reshape(chars.shape[0], nwords, 4)
+        words = (w[:, :, 0] << 24) | (w[:, :, 1] << 16) | (w[:, :, 2] << 8) | w[:, :, 3]
+        # trailing length key: padding is zero bytes, so equal-word prefixes
+        # fall through to the length — distinguishes 'a' from 'a\x00'
+        return [words[:, i] for i in range(nwords)] + [
+            col.lengths.astype(jnp.uint32)
+        ]
+    if isinstance(col, Decimal128Column):
+        if col.dtype.decimal_storage_bits < 128:
+            lo_limb = col.limbs[:, 0]
+            hi, lo = _split64(lo_limb ^ (jnp.uint64(1) << jnp.uint64(63)))
+            return [hi, lo]
+        hi_limb = col.limbs[:, 1] ^ (jnp.uint64(1) << jnp.uint64(63))
+        parts = _split64(hi_limb) + _split64(col.limbs[:, 0])
+        return list(parts)
+
+    kind = col.dtype.kind
+    d = col.data
+    if kind is T.Kind.BOOLEAN:
+        return [d.astype(jnp.uint32)]
+    if kind in (T.Kind.INT8, T.Kind.INT16, T.Kind.INT32, T.Kind.DATE):
+        return [d.astype(jnp.int32).astype(jnp.uint32) ^ _SIGN32]
+    if kind in (T.Kind.INT64, T.Kind.TIMESTAMP):
+        u = d.astype(jnp.int64).astype(jnp.uint64) ^ (jnp.uint64(1) << jnp.uint64(63))
+        return list(_split64(u))
+    if kind is T.Kind.FLOAT32:
+        return [_f32_total_order(d, normalize_zero=equality)]
+    if kind is T.Kind.FLOAT64:
+        return list(_split64(_f64_total_order(d, normalize_zero=equality)))
+    raise NotImplementedError(f"radix keys for {col.dtype!r}")
+
+
+def null_flag(col, nulls_first: bool) -> jax.Array:
+    """Leading key array encoding null placement (0 sorts before 1)."""
+    v = col.validity
+    return jnp.where(v, jnp.uint32(1), jnp.uint32(0)) if nulls_first else jnp.where(
+        v, jnp.uint32(0), jnp.uint32(1)
+    )
+
+
+def batch_radix_keys(
+    cols: Sequence, *, equality: bool, nulls_first: bool = True
+) -> list:
+    """Key arrays for a composite key across columns, nulls flag included.
+
+    Data keys of null rows are zeroed so every null row carries identical
+    keys: padded/filtered batches keep residual payload data under a False
+    validity bit, and Spark groups all nulls as ONE group.
+    """
+    out = []
+    for c in cols:
+        out.append(null_flag(c, nulls_first))
+        v = c.validity
+        out.extend(
+            jnp.where(v, k, jnp.zeros((), k.dtype))
+            for k in column_radix_keys(c, equality=equality)
+        )
+    return out
+
+
+def rows_equal_adjacent(key_arrays: Sequence[jax.Array]) -> jax.Array:
+    """bool[n]: row i has identical keys to row i-1 (row 0 -> False)."""
+    n = key_arrays[0].shape[0]
+    eq = jnp.ones((n,), jnp.bool_)
+    for k in key_arrays:
+        eq = eq & (k == jnp.roll(k, 1))
+    return eq.at[0].set(False)
+
+
+def _lex_less(a_keys, b_keys, or_equal: bool):
+    """Vectorized lexicographic a < b (or a <= b) over parallel key lists."""
+    res = jnp.full(a_keys[0].shape, or_equal)
+    for a, b in zip(reversed(a_keys), reversed(b_keys)):
+        res = jnp.where(a == b, res, a < b)
+    return res
+
+
+def _search(sorted_keys, query_keys, *, lower: bool):
+    """Vectorized lexicographic binary search over sorted composite keys.
+
+    Returns int32 positions in [0, n].  ``lower=True`` gives the first index
+    whose key is >= query (lower bound); else first index > query.
+    """
+    if len(sorted_keys) != len(query_keys):
+        raise ValueError(
+            f"composite key arity mismatch: {len(sorted_keys)} sorted vs "
+            f"{len(query_keys)} query arrays (string key columns must be "
+            "width-aligned first — see align_string_key_columns)"
+        )
+    n = sorted_keys[0].shape[0]
+    m = query_keys[0].shape[0]
+    if n == 0:
+        return jnp.zeros((m,), jnp.int32)
+    lo = jnp.zeros((m,), jnp.int32)
+    hi = jnp.full((m,), n, jnp.int32)
+    steps = n.bit_length() + 1
+
+    def body(_, lohi):
+        lo, hi = lohi
+        active = lo < hi
+        mid = (lo + hi) >> 1
+        mid_keys = [jnp.take(k, mid, mode="clip") for k in sorted_keys]
+        # advance when sorted[mid] < q (lower) / sorted[mid] <= q (upper)
+        adv = _lex_less(mid_keys, query_keys, or_equal=not lower)
+        lo = jnp.where(active & adv, mid + 1, lo)
+        hi = jnp.where(active & ~adv, mid, hi)
+        return lo, hi
+
+    lo, hi = jax.lax.fori_loop(0, steps, body, (lo, hi))
+    return lo
+
+
+def lower_bound(sorted_keys, query_keys):
+    return _search(sorted_keys, query_keys, lower=True)
+
+
+def upper_bound(sorted_keys, query_keys):
+    return _search(sorted_keys, query_keys, lower=False)
+
+
+def equal_range(sorted_keys, query_keys):
+    """(lower, upper) bounds in one fused loop — both carried as state, so
+    the probe pays one round of composite-key gathers per bisection step
+    instead of two (the join's dominant cost)."""
+    if len(sorted_keys) != len(query_keys):
+        raise ValueError(
+            f"composite key arity mismatch: {len(sorted_keys)} sorted vs "
+            f"{len(query_keys)} query arrays (string key columns must be "
+            "width-aligned first — see align_string_key_columns)"
+        )
+    n = sorted_keys[0].shape[0]
+    m = query_keys[0].shape[0]
+    if n == 0:
+        z = jnp.zeros((m,), jnp.int32)
+        return z, z
+    init = (
+        jnp.zeros((m,), jnp.int32),
+        jnp.full((m,), n, jnp.int32),
+        jnp.zeros((m,), jnp.int32),
+        jnp.full((m,), n, jnp.int32),
+    )
+    steps = n.bit_length() + 1
+
+    def body(_, st):
+        llo, lhi, ulo, uhi = st
+        # two bisections share each round's gather when their mids coincide
+        # (XLA CSEs the duplicate takes); state stays a flat 4-tuple
+        lmid = (llo + lhi) >> 1
+        umid = (ulo + uhi) >> 1
+        lkeys = [jnp.take(k, lmid, mode="clip") for k in sorted_keys]
+        ukeys = [jnp.take(k, umid, mode="clip") for k in sorted_keys]
+        ladv = _lex_less(lkeys, query_keys, or_equal=False)
+        uadv = _lex_less(ukeys, query_keys, or_equal=True)
+        lact = llo < lhi
+        uact = ulo < uhi
+        llo = jnp.where(lact & ladv, lmid + 1, llo)
+        lhi = jnp.where(lact & ~ladv, lmid, lhi)
+        ulo = jnp.where(uact & uadv, umid + 1, ulo)
+        uhi = jnp.where(uact & ~uadv, umid, uhi)
+        return llo, lhi, ulo, uhi
+
+    llo, _, ulo, _ = jax.lax.fori_loop(0, steps, body, init)
+    return llo, ulo
+
+
+def align_string_key_columns(lcols: Sequence, rcols: Sequence):
+    """Pad paired string key columns to a common char-matrix width.
+
+    Radix-key arity is derived from ``max_len``; comparing keys across two
+    batches (join probe) requires both sides to lower to the same number of
+    word arrays, else words would misalign against the trailing length key.
+    """
+    from ..columnar.column import StringColumn as _S
+
+    lout, rout = [], []
+    for lc, rc in zip(lcols, rcols):
+        if isinstance(lc, _S) != isinstance(rc, _S):
+            raise TypeError(f"join key type mismatch: {lc.dtype!r} vs {rc.dtype!r}")
+        if isinstance(lc, _S) and lc.max_len != rc.max_len:
+            width = max(lc.max_len, rc.max_len)
+
+            def pad(c):
+                if c.max_len == width:
+                    return c
+                chars = jnp.pad(c.chars, ((0, 0), (0, width - c.max_len)))
+                return _S(chars, c.lengths, c.validity, c.dtype)
+
+            lc, rc = pad(lc), pad(rc)
+        lout.append(lc)
+        rout.append(rc)
+    return lout, rout

@@ -1,0 +1,394 @@
+"""Relational operator tests against pure-Python oracles.
+
+The reference delegates these operators to libcudf and tests them upstream;
+here they are in-tree, so the tests are too.  Spark semantics under test:
+null ordering, null-safe grouping (nulls form a group), join keys where
+null matches nothing, and float normalization (-0.0 == 0.0, one NaN).
+"""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.columnar.column import Column, ColumnBatch, StringColumn
+from spark_rapids_jni_tpu.columnar import types as T
+from spark_rapids_jni_tpu.relational import (
+    AggSpec,
+    SortKey,
+    apply_mask,
+    compact,
+    group_by,
+    hash_join,
+    sort_by,
+)
+
+
+def ints(vals, dtype=T.INT32):
+    return Column.from_pylist(vals, dtype)
+
+
+def strs(vals, **kw):
+    return StringColumn.from_pylist(vals, **kw)
+
+
+def trimmed(batch, count):
+    """Host-side: first `count` rows as dict of lists."""
+    c = int(count)
+    return {k: v[:c] for k, v in batch.to_pydict().items()}
+
+
+# ---------------------------------------------------------------------------
+# sort
+# ---------------------------------------------------------------------------
+
+class TestSort:
+    def test_ints_asc_nulls_first(self):
+        b = ColumnBatch({"a": ints([3, None, 1, 2, None, -5])})
+        out = sort_by(b, [SortKey("a")])
+        assert out.to_pydict()["a"] == [None, None, -5, 1, 2, 3]
+
+    def test_ints_desc_nulls_last(self):
+        b = ColumnBatch({"a": ints([3, None, 1, 2, None, -5])})
+        out = sort_by(b, [SortKey("a", ascending=False, nulls_first=False)])
+        assert out.to_pydict()["a"] == [3, 2, 1, -5, None, None]
+
+    def test_ints_desc_nulls_first(self):
+        b = ColumnBatch({"a": ints([3, None, 1])})
+        out = sort_by(b, [SortKey("a", ascending=False, nulls_first=True)])
+        assert out.to_pydict()["a"] == [None, 3, 1]
+
+    def test_two_keys_stable(self):
+        b = ColumnBatch(
+            {
+                "k": ints([2, 1, 2, 1, 2]),
+                "v": ints([10, 20, 30, 40, 50]),
+            }
+        )
+        out = sort_by(b, [SortKey("k")])
+        assert out.to_pydict() == {
+            "k": [1, 1, 2, 2, 2],
+            "v": [20, 40, 10, 30, 50],
+        }
+
+    def test_strings(self):
+        b = ColumnBatch({"s": strs(["pear", "", None, "apple", "app", "z"])})
+        out = sort_by(b, [SortKey("s")])
+        assert out.to_pydict()["s"] == [None, "", "app", "apple", "pear", "z"]
+
+    def test_floats_total_order(self):
+        vals = [1.5, float("nan"), -0.0, 0.0, float("-inf"), float("inf"), None]
+        b = ColumnBatch({"f": Column.from_pylist(vals, T.FLOAT64)})
+        out = sort_by(b, [SortKey("f")])
+        got = out.to_pydict()["f"]
+        assert got[0] is None
+        assert got[1] == float("-inf")
+        assert got[2] == 0.0 and got[3] == 0.0  # -0.0 normalized to equal 0.0
+        assert got[4] == 1.5
+        assert got[5] == float("inf")
+        assert math.isnan(got[6])  # NaN sorts greater than +inf (Spark)
+
+    def test_int64_wide_range(self):
+        vals = [2**62, -(2**62), 0, None, 7, -7]
+        b = ColumnBatch({"a": ints(vals, T.INT64)})
+        out = sort_by(b, [SortKey("a", nulls_first=False)])
+        assert out.to_pydict()["a"] == [-(2**62), -7, 0, 7, 2**62, None]
+
+
+# ---------------------------------------------------------------------------
+# filter
+# ---------------------------------------------------------------------------
+
+class TestFilter:
+    def test_compact(self):
+        b = ColumnBatch(
+            {"a": ints([1, 2, 3, 4, 5]), "s": strs(["a", "b", "c", "d", "e"])}
+        )
+        mask = jnp.asarray([True, False, True, False, True])
+        out, count = compact(b, mask)
+        assert int(count) == 3
+        assert trimmed(out, count) == {"a": [1, 3, 5], "s": ["a", "c", "e"]}
+        # tail rows are nulled
+        assert out.to_pydict()["a"][3:] == [None, None]
+
+    def test_apply_mask(self):
+        b = ColumnBatch({"a": ints([1, None, 3])})
+        out = apply_mask(b, jnp.asarray([True, True, False]))
+        assert out.to_pydict()["a"] == [1, None, None]
+
+
+# ---------------------------------------------------------------------------
+# group_by
+# ---------------------------------------------------------------------------
+
+class TestGroupBy:
+    def test_sum_count_min_max_mean(self):
+        b = ColumnBatch(
+            {
+                "k": ints([1, 2, 1, 2, 1, None]),
+                "v": ints([10, 20, None, 40, 30, 99]),
+            }
+        )
+        out, ng = group_by(
+            b,
+            ["k"],
+            [
+                AggSpec("sum", "v", "s"),
+                AggSpec("count", "v", "c"),
+                AggSpec("count", None, "cstar"),
+                AggSpec("min", "v", "mn"),
+                AggSpec("max", "v", "mx"),
+                AggSpec("mean", "v", "avg"),
+            ],
+        )
+        assert int(ng) == 3
+        got = trimmed(out, ng)
+        # group order: nulls first, then 1, 2
+        assert got["k"] == [None, 1, 2]
+        assert got["s"] == [99, 40, 60]
+        assert got["c"] == [1, 2, 2]
+        assert got["cstar"] == [1, 3, 2]
+        assert got["mn"] == [99, 10, 20]
+        assert got["mx"] == [99, 30, 40]
+        assert got["avg"] == [99.0, 20.0, 30.0]
+
+    def test_all_null_group_sum_is_null(self):
+        b = ColumnBatch(
+            {"k": ints([7, 7]), "v": ints([None, None])}
+        )
+        out, ng = group_by(b, ["k"], [AggSpec("sum", "v", "s"),
+                                      AggSpec("count", "v", "c")])
+        assert int(ng) == 1
+        got = trimmed(out, ng)
+        assert got["s"] == [None]
+        assert got["c"] == [0]
+
+    def test_string_keys(self):
+        b = ColumnBatch(
+            {
+                "k": strs(["b", "a", "b", None, "a", "a"]),
+                "v": ints([1, 2, 3, 4, 5, 6], T.INT64),
+            }
+        )
+        out, ng = group_by(b, ["k"], [AggSpec("sum", "v", "s")])
+        assert int(ng) == 3
+        got = trimmed(out, ng)
+        assert got["k"] == [None, "a", "b"]
+        assert got["s"] == [4, 13, 4]
+
+    def test_multi_key(self):
+        b = ColumnBatch(
+            {
+                "k1": ints([1, 1, 2, 1]),
+                "k2": strs(["x", "y", "x", "x"]),
+                "v": Column.from_pylist([1.0, 2.0, 3.0, 4.0], T.FLOAT64),
+            }
+        )
+        out, ng = group_by(b, ["k1", "k2"], [AggSpec("sum", "v", "s")])
+        assert int(ng) == 3
+        got = trimmed(out, ng)
+        assert got["k1"] == [1, 1, 2]
+        assert got["k2"] == ["x", "y", "x"]
+        assert got["s"] == [5.0, 2.0, 3.0]
+
+    def test_float_key_normalization(self):
+        vals = [0.0, -0.0, float("nan"), float("nan")]
+        b = ColumnBatch(
+            {
+                "k": Column.from_pylist(vals, T.FLOAT64),
+                "v": ints([1, 1, 1, 1], T.INT64),
+            }
+        )
+        out, ng = group_by(b, ["k"], [AggSpec("count", None, "c")])
+        assert int(ng) == 2  # {0.0} and {NaN}
+        assert trimmed(out, ng)["c"] == [2, 2]
+
+    def test_sum_int_is_long(self):
+        b = ColumnBatch(
+            {"k": ints([1, 1]), "v": ints([2**30, 2**30])}
+        )
+        out, _ = group_by(b, ["k"], [AggSpec("sum", "v", "s")])
+        assert out["s"].dtype == T.INT64
+        assert out.to_pydict()["s"][0] == 2**31
+
+
+# ---------------------------------------------------------------------------
+# join
+# ---------------------------------------------------------------------------
+
+class TestJoin:
+    def _l(self):
+        return ColumnBatch(
+            {
+                "k": ints([1, 2, 3, None, 2]),
+                "lv": ints([10, 20, 30, 40, 50]),
+            }
+        )
+
+    def _r(self):
+        return ColumnBatch(
+            {
+                "k": ints([2, 3, 4, None]),
+                "rv": ints([200, 300, 400, 999]),
+            }
+        )
+
+    def test_inner_unique(self):
+        out, count = hash_join(self._l(), self._r(), ["k"], ["k"], "inner")
+        assert int(count) == 3
+        got = trimmed(out, count)
+        assert got["k"] == [2, 3, 2]
+        assert got["lv"] == [20, 30, 50]
+        assert got["rv"] == [200, 300, 200]
+
+    def test_left_outer(self):
+        out, count = hash_join(self._l(), self._r(), ["k"], ["k"], "left")
+        assert int(count) == 5
+        got = trimmed(out, count)
+        assert got["lv"] == [10, 20, 30, 40, 50]
+        assert got["rv"] == [None, 200, 300, None, 200]
+
+    def test_semi_anti(self):
+        out, count = hash_join(self._l(), self._r(), ["k"], ["k"], "semi")
+        assert trimmed(out, count) == {"k": [2, 3, 2], "lv": [20, 30, 50]}
+        out, count = hash_join(self._l(), self._r(), ["k"], ["k"], "anti")
+        # null-keyed left rows are KEPT by anti join (Spark semantics)
+        assert trimmed(out, count) == {"k": [1, None], "lv": [10, 40]}
+
+    def test_many_to_many(self):
+        left = ColumnBatch({"k": ints([1, 2]), "lv": ints([10, 20])})
+        right = ColumnBatch({"k": ints([1, 1, 1, 2]), "rv": ints([1, 2, 3, 4])})
+        out, count = hash_join(left, right, ["k"], ["k"], "inner", capacity=8)
+        assert int(count) == 4
+        got = trimmed(out, count)
+        assert got["lv"] == [10, 10, 10, 20]
+        assert sorted(got["rv"][:3]) == [1, 2, 3]
+        assert got["rv"][3] == 4
+
+    def test_capacity_overflow_reported(self):
+        left = ColumnBatch({"k": ints([1])})
+        right = ColumnBatch({"k": ints([1, 1, 1])})
+        out, count = hash_join(left, right, ["k"], ["k"], "inner", capacity=2)
+        assert int(count) == 3  # true total; output truncated at capacity=2
+
+    def test_multi_key_string(self):
+        left = ColumnBatch(
+            {
+                "a": ints([1, 1, 2]),
+                "b": strs(["x", "y", "x"]),
+                "lv": ints([7, 8, 9]),
+            }
+        )
+        right = ColumnBatch(
+            {
+                "a": ints([1, 2]),
+                "b": strs(["y", "x"]),
+                "rv": ints([100, 200]),
+            }
+        )
+        out, count = hash_join(left, right, ["a", "b"], ["a", "b"], "inner")
+        got = trimmed(out, count)
+        assert got["lv"] == [8, 9]
+        assert got["rv"] == [100, 200]
+
+    def test_name_collision_suffix(self):
+        left = ColumnBatch({"k": ints([1]), "v": ints([1])})
+        right = ColumnBatch({"k": ints([1]), "v": ints([2])})
+        out, _ = hash_join(left, right, ["k"], ["k"], "inner")
+        assert set(out.names) == {"k", "v", "v_r"}
+
+    def test_jit_composes(self):
+        import jax
+
+        left, right = self._l(), self._r()
+
+        @jax.jit
+        def f(l, r):
+            out, count = hash_join(l, r, ["k"], ["k"], "inner")
+            return out, count
+
+        out, count = f(left, right)
+        assert int(count) == 3
+
+
+class TestReviewRegressions:
+    """Regressions from the first relational-layer review pass."""
+
+    def test_null_rows_one_group_after_mask(self):
+        # padded/filtered rows keep payload under validity=False; they must
+        # still land in ONE null group
+        b = ColumnBatch({"k": ints([1, 2, 3]), "v": ints([1, 1, 1], T.INT64)})
+        masked = apply_mask(b, jnp.asarray([True, False, False]))
+        out, ng = group_by(masked, ["k"], [AggSpec("count", None, "c")])
+        assert int(ng) == 2
+        got = trimmed(out, ng)
+        assert got["k"] == [None, 1]
+        assert got["c"] == [2, 1]
+
+    def test_empty_build_side(self):
+        left = ColumnBatch({"k": ints([1, 2]), "lv": ints([10, 20])})
+        right = ColumnBatch({"k": ints([]), "rv": ints([])})
+        out, count = hash_join(left, right, ["k"], ["k"], "inner")
+        assert int(count) == 0
+        out, count = hash_join(left, right, ["k"], ["k"], "left")
+        assert trimmed(out, count) == {"k": [1, 2], "lv": [10, 20], "rv": [None, None]}
+        out, count = hash_join(left, right, ["k"], ["k"], "anti")
+        assert trimmed(out, count)["lv"] == [10, 20]
+
+    def test_float_min_skips_nan_max_takes_nan(self):
+        b = ColumnBatch(
+            {
+                "k": ints([1, 1, 2]),
+                "v": Column.from_pylist([float("nan"), 1.0, float("nan")], T.FLOAT64),
+            }
+        )
+        out, ng = group_by(b, ["k"], [AggSpec("min", "v", "mn"),
+                                      AggSpec("max", "v", "mx")])
+        got = trimmed(out, ng)
+        assert got["mn"][0] == 1.0          # NaN skipped for min
+        assert math.isnan(got["mx"][0])     # NaN is the max (Spark ordering)
+        assert math.isnan(got["mn"][1])     # all-NaN group -> NaN
+        assert math.isnan(got["mx"][1])
+
+    def test_bool_minmax(self):
+        b = ColumnBatch(
+            {
+                "k": ints([1, 1, 2]),
+                "v": Column.from_pylist([True, False, True], T.BOOLEAN),
+            }
+        )
+        out, ng = group_by(b, ["k"], [AggSpec("min", "v", "mn"),
+                                      AggSpec("max", "v", "mx")])
+        got = trimmed(out, ng)
+        assert got["mn"] == [False, True]
+        assert got["mx"] == [True, True]
+
+    def test_trailing_nul_strings_distinct(self):
+        b = ColumnBatch(
+            {
+                "k": strs(["a", "a\x00"]),
+                "v": ints([1, 1], T.INT64),
+            }
+        )
+        out, ng = group_by(b, ["k"], [AggSpec("count", None, "c")])
+        assert int(ng) == 2  # 'a' and 'a\x00' are different keys
+
+    def test_sort_minus_zero_before_zero(self):
+        # ordering domain: Java Double.compare puts -0.0 before 0.0
+        b = ColumnBatch({"f": Column.from_pylist([0.0, -0.0], T.FLOAT64)})
+        out = sort_by(b, [SortKey("f")])
+        got = np.asarray([math.copysign(1.0, x) for x in out.to_pydict()["f"]])
+        assert got.tolist() == [-1.0, 1.0]
+
+    def test_string_key_width_mismatch(self):
+        left = ColumnBatch({"k": strs(["apple", "x"]), "lv": ints([1, 2])})
+        right = ColumnBatch({"k": strs(["x", "y"]), "rv": ints([10, 20])})
+        out, count = hash_join(left, right, ["k"], ["k"], "inner")
+        assert trimmed(out, count) == {"k": ["x"], "lv": [2], "rv": [10]}
+
+    def test_left_suffix_applied(self):
+        left = ColumnBatch({"k": ints([1]), "v": ints([1])})
+        right = ColumnBatch({"k": ints([1]), "v": ints([2])})
+        out, _ = hash_join(left, right, ["k"], ["k"], "inner", suffixes=("_l", "_r"))
+        assert set(out.names) == {"k", "v_l", "v_r"}
