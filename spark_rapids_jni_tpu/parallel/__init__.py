@@ -1,0 +1,36 @@
+"""Multi-chip parallelism: hash-partitioned shuffle over a device mesh.
+
+The reference repo contributes only format-parity pieces to Spark's
+distributed story (murmur3 partition hashing ``murmur_hash.cu:187``,
+Spark-serializable bloom filters, JCUDF rows); the exchange itself lives in
+the spark-rapids plugin (UCX shuffle manager) and NCCL (SURVEY.md §2.6).
+For the TPU framework the exchange is in-tree and first-class:
+
+* **Partitioning** (:mod:`partition`): Spark's exact partition assignment —
+  ``pmod(murmur3_32(keys, seed=42), P)`` — so every row lands on the same
+  partition a CPU/GPU Spark cluster would pick (bit-identical shuffles).
+* **Exchange** (:mod:`shuffle`): a static-shape all-to-all inside
+  ``shard_map``: rows are bucketed by partition id into per-destination
+  slots, exchanged with one ``lax.all_to_all`` riding the ICI mesh axis, and
+  re-compacted on the receiver.  No host round-trip, no dynamic shapes.
+* **Distributed operators** (:mod:`distributed`): shuffle + local relational
+  ops composed under one ``jit``: distributed group-by (partial/final) and
+  the mesh helpers used by the driver's multi-chip dry run.
+
+Scaling note: one process drives the whole slice (SPMD); the mesh axis here
+is the Spark-shuffle "partition" axis.  Cross-pod (DCN) scale-out uses the
+same code over a larger mesh — XLA lowers the collective onto ICI within a
+slice and DCN across.
+"""
+
+from .partition import spark_partition_id
+from .shuffle import exchange
+from .distributed import data_mesh, distributed_group_by, shard_batch
+
+__all__ = [
+    "spark_partition_id",
+    "exchange",
+    "data_mesh",
+    "distributed_group_by",
+    "shard_batch",
+]

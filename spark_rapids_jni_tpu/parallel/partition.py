@@ -1,0 +1,34 @@
+"""Spark-exact shuffle partition assignment.
+
+Spark's ``HashPartitioning`` computes ``Pmod(Murmur3Hash(keys, 42), P)``;
+the reference repo's murmur3 kernel exists precisely to keep this assignment
+bit-identical between CPU and accelerator (reference ``murmur_hash.cu:187``,
+``Hash.java``).  We reuse :func:`ops.hashing.murmur_hash3_32` and apply
+Spark's ``pmod`` (non-negative remainder) on the int32 hash.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from ..ops.hashing import murmur_hash3_32
+
+
+def spark_partition_id(
+    key_columns: Sequence,
+    num_partitions: int,
+    row_valid=None,
+) -> jnp.ndarray:
+    """int32[n] partition ids in [0, P); padding rows get P (routed nowhere).
+
+    ``row_valid`` marks occupied rows (compaction/filter padding is sent to
+    the out-of-range pseudo-partition so the exchange drops it).
+    """
+    h = murmur_hash3_32(key_columns, seed=42).data  # int32, Spark seed
+    p = jnp.int32(num_partitions)
+    pid = ((h % p) + p) % p  # pmod: Java % keeps sign of dividend
+    if row_valid is not None:
+        pid = jnp.where(row_valid, pid, p)
+    return pid

@@ -1,0 +1,72 @@
+"""Static-shape all-to-all row exchange (the shuffle data plane).
+
+Runs *inside* ``shard_map``: every device holds a local batch of R rows and
+a partition id per row; after :func:`exchange` every device holds the rows
+whose partition id names it.  The XLA-friendly formulation:
+
+1. stable-sort local rows by destination (padding keys sort last),
+2. gather rows into a ``[P, C]`` slot grid (destination-major; C slots per
+   destination, unfilled slots are null rows),
+3. one ``lax.all_to_all`` over the mesh axis transposes the grid globally —
+   device d receives slot-row p = what device p bucketed for d,
+4. the receiver keeps the ``[P*C]`` layout plus an occupancy mask; callers
+   pass that mask to group_by/compact downstream.
+
+C (``capacity``) is the static per-(sender,destination) slot count — the TPU
+analogue of the reference's fixed 2GB batch discipline
+(``row_conversion.cu:93-98``): shapes are decided before the data is seen.
+Rows beyond C for one destination are dropped and counted in ``dropped``
+(callers size C for their skew; C = R is always lossless).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.column import ColumnBatch
+from ..relational.gather import gather_batch
+
+
+def exchange(
+    batch: ColumnBatch,
+    pid,
+    axis_name: str,
+    num_partitions: int,
+    capacity: int | None = None,
+):
+    """All-to-all rows by partition id. Must run inside ``shard_map``.
+
+    ``pid`` is int32[R] in [0, P]; P routes nowhere (padding).  Returns
+    ``(out_batch [P*C rows], occupancy bool[P*C], dropped int32)``.
+    """
+    R = batch.num_rows
+    P = num_partitions
+    C = R if capacity is None else capacity
+
+    pid = jnp.clip(pid.astype(jnp.int32), 0, P)
+    perm = jnp.argsort(pid, stable=True).astype(jnp.int32)
+    pid_sorted = jnp.take(pid, perm)
+    counts = jax.ops.segment_sum(
+        jnp.ones((R,), jnp.int32), pid_sorted, num_segments=P + 1,
+        indices_are_sorted=True,
+    )[:P]
+    offsets = jnp.cumsum(counts) - counts  # exclusive
+
+    # destination-major slot grid: slot (p, c) <- sorted row offsets[p] + c
+    p_ids = jnp.repeat(jnp.arange(P, dtype=jnp.int32), C)
+    c_ids = jnp.tile(jnp.arange(C, dtype=jnp.int32), P)
+    slot_occ = c_ids < jnp.take(counts, p_ids)
+    src = jnp.take(offsets, p_ids) + c_ids
+    send_idx = jnp.take(perm, jnp.clip(src, 0, max(R - 1, 0)))
+    send = gather_batch(batch, send_idx, valid=slot_occ)
+    dropped = jnp.maximum(counts - C, 0).sum(dtype=jnp.int32)
+
+    def a2a(x):
+        grid = x.reshape((P, C) + x.shape[1:])
+        out = jax.lax.all_to_all(grid, axis_name, split_axis=0, concat_axis=0)
+        return out.reshape((P * C,) + x.shape[1:])
+
+    out_batch = jax.tree_util.tree_map(a2a, send)
+    occupancy = a2a(slot_occ)
+    return out_batch, occupancy, dropped

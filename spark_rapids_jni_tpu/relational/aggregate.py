@@ -101,25 +101,43 @@ def group_by(
     batch: ColumnBatch,
     key_names: Sequence[str],
     aggs: Sequence[AggSpec],
+    row_valid=None,
 ) -> tuple:
     """Group ``batch`` by ``key_names``; returns (result_batch, num_groups).
 
     The result batch has the key columns (group order = key sort order,
     deterministic) followed by one column per AggSpec, padded to the input
     row count with null rows past ``num_groups``.
+
+    ``row_valid`` (bool[n], optional) marks rows that exist: padding rows of
+    an upstream compaction/shuffle are excluded from every group (without it
+    they would merge into the null-key group).  They sort as one trailing
+    pseudo-group masked out of the result.
     """
     n = batch.num_rows
     key_cols = [batch[k] for k in key_names]
     karr = K.batch_radix_keys(key_cols, equality=True, nulls_first=True)
+    if row_valid is not None:
+        occ = row_valid.astype(jnp.bool_)
+        karr = [jnp.where(occ, jnp.uint32(0), jnp.uint32(1))] + [
+            jnp.where(occ, k, jnp.zeros((), k.dtype)) for k in karr
+        ]
     iota = jnp.arange(n, dtype=jnp.int32)
     res = jax.lax.sort(tuple(karr) + (iota,), num_keys=len(karr), is_stable=True)
     sorted_keys, perm = res[:-1], res[-1]
 
     boundary = ~K.rows_equal_adjacent(sorted_keys)
     gid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
-    num_groups = boundary.sum(dtype=jnp.int32)
+    if row_valid is not None:
+        sorted_occ = jnp.take(row_valid.astype(jnp.bool_), perm)
+        num_groups = (boundary & sorted_occ).sum(dtype=jnp.int32)
+    else:
+        num_groups = boundary.sum(dtype=jnp.int32)
 
-    sorted_batch = gather_batch(batch, perm)
+    needed = list(dict.fromkeys(
+        list(key_names) + [a.column for a in aggs if a.column is not None]
+    ))
+    sorted_batch = gather_batch(batch.select(needed), perm)
 
     # group-start row positions in group order (stable front-compaction)
     start_pos = jnp.argsort(~boundary, stable=True).astype(jnp.int32)

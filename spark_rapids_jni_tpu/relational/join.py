@@ -145,5 +145,10 @@ def hash_join(
     for name, col in zip(lpart.names, lpart.columns):
         merged[name + suffixes[0] if name in collisions else name] = col
     for name, col in zip(rpart.names, rpart.columns):
-        merged[name + suffixes[1] if name in collisions else name] = col
+        out = name + suffixes[1] if name in collisions else name
+        if out in merged:
+            raise ValueError(
+                f"join output name collision: {out!r} (suffixes={suffixes!r})"
+            )
+        merged[out] = col
     return ColumnBatch(merged), total

@@ -1,0 +1,160 @@
+"""Shuffle / distributed-operator tests on the 8-virtual-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_jni_tpu.columnar import types as T
+from spark_rapids_jni_tpu.columnar.column import Column, ColumnBatch, StringColumn
+from spark_rapids_jni_tpu.ops.hashing import murmur_hash3_32
+from spark_rapids_jni_tpu.parallel import (
+    data_mesh,
+    distributed_group_by,
+    exchange,
+    shard_batch,
+    spark_partition_id,
+)
+from spark_rapids_jni_tpu.parallel.distributed import collect_groups
+from spark_rapids_jni_tpu.relational import AggSpec, group_by
+
+
+def _ints(vals, dtype=T.INT64):
+    return Column.from_pylist(vals, dtype)
+
+
+class TestPartitionId:
+    def test_pmod_of_murmur3(self):
+        vals = [1, 2, None, 4, -5, 6, 7, 8]
+        col = _ints(vals)
+        pid = np.asarray(spark_partition_id([col], 8))
+        h = np.asarray(murmur_hash3_32([col], seed=42).data)
+        expect = ((h % 8) + 8) % 8
+        np.testing.assert_array_equal(pid, expect)
+        assert (pid >= 0).all() and (pid < 8).all()
+
+    def test_padding_rows_route_nowhere(self):
+        col = _ints([1, 2, 3, 4])
+        rv = jnp.array([True, False, True, False])
+        pid = np.asarray(spark_partition_id([col], 4, rv))
+        assert pid[1] == 4 and pid[3] == 4
+
+
+class TestExchange:
+    def test_all_rows_arrive_at_their_partition(self, eight_devices):
+        mesh = data_mesh(8)
+        n = 64  # 8 rows/device
+        vals = list(range(n))
+        batch = ColumnBatch({"v": _ints(vals)})
+        batch = shard_batch(batch, mesh)
+        P = 8
+
+        @jax.jit
+        @jax.shard_map(
+            mesh=mesh,
+            in_specs=jax.sharding.PartitionSpec("data"),
+            out_specs=(
+                jax.sharding.PartitionSpec("data"),
+                jax.sharding.PartitionSpec("data"),
+                jax.sharding.PartitionSpec("data"),
+            ),
+            check_vma=False,
+        )
+        def run(b):
+            pid = (b["v"].data % P).astype(jnp.int32)
+            out, occ, dropped = exchange(b, pid, "data", P)
+            return out, occ, dropped[None]
+
+        out, occ, dropped = run(batch)
+        assert int(np.asarray(dropped).sum()) == 0
+        occ = np.asarray(occ)
+        got = np.asarray(out["v"].data)
+        rows_per_dev = got.shape[0] // P
+        for d in range(P):
+            sl = slice(d * rows_per_dev, (d + 1) * rows_per_dev)
+            live = got[sl][occ[sl]]
+            assert sorted(live.tolist()) == [v for v in vals if v % P == d]
+
+    def test_capacity_overflow_counted(self, eight_devices):
+        mesh = data_mesh(8)
+        n = 64
+        batch = ColumnBatch({"v": _ints([0] * n)})  # all rows -> partition 0
+        batch = shard_batch(batch, mesh)
+
+        @jax.jit
+        @jax.shard_map(
+            mesh=mesh,
+            in_specs=jax.sharding.PartitionSpec("data"),
+            out_specs=(
+                jax.sharding.PartitionSpec("data"),
+                jax.sharding.PartitionSpec("data"),
+                jax.sharding.PartitionSpec("data"),
+            ),
+            check_vma=False,
+        )
+        def run(b):
+            pid = jnp.zeros((b.num_rows,), jnp.int32)
+            out, occ, dropped = exchange(b, pid, "data", 8, capacity=4)
+            return out, occ, dropped[None]
+
+        out, occ, dropped = run(batch)
+        # each device had 8 rows for partition 0, slot capacity 4 -> 4 dropped
+        np.testing.assert_array_equal(np.asarray(dropped), [4] * 8)
+        assert int(np.asarray(occ)[:32].sum()) == 32  # device 0 got 8x4 rows
+
+
+class TestDistributedGroupBy:
+    def _batch(self, rng, n):
+        keys = rng.integers(0, 10, n).tolist()
+        vals = rng.integers(-100, 100, n).tolist()
+        nulls = rng.random(n) < 0.1
+        keys = [None if nulls[i] else keys[i] for i in range(n)]
+        return ColumnBatch(
+            {"k": _ints(keys, T.INT32), "v": _ints(vals, T.INT64)}
+        )
+
+    def test_matches_single_device(self, rng, eight_devices):
+        mesh = data_mesh(8)
+        n = 128
+        batch = self._batch(rng, n)
+        aggs = [
+            AggSpec("sum", "v", "s"),
+            AggSpec("count", None, "c"),
+            AggSpec("min", "v", "lo"),
+            AggSpec("max", "v", "hi"),
+        ]
+        sharded = shard_batch(batch, mesh)
+        res, ng, dropped = distributed_group_by(sharded, ["k"], aggs, mesh)
+        assert int(np.asarray(dropped).sum()) == 0
+        got = collect_groups(res, ng)
+
+        ref, ref_ng = group_by(batch, ["k"], aggs)
+        ref_rows = {
+            name: vals[: int(ref_ng)] for name, vals in ref.to_pydict().items()
+        }
+        key = lambda d: sorted(
+            zip(*(d[c] for c in ("k", "s", "c", "lo", "hi"))),
+            key=lambda t: (t[0] is None, t[0]),
+        )
+        assert key(got) == key(ref_rows)
+
+    def test_string_keys(self, eight_devices):
+        mesh = data_mesh(8)
+        words = ["apple", "pear", None, "fig", "apple", "fig", "pear", "apple"] * 4
+        vals = list(range(32))
+        batch = ColumnBatch(
+            {
+                "k": StringColumn.from_pylist(words),
+                "v": _ints(vals),
+            }
+        )
+        sharded = shard_batch(batch, mesh)
+        res, ng, dropped = distributed_group_by(
+            sharded, ["k"], [AggSpec("sum", "v", "s")], mesh
+        )
+        got = collect_groups(res, ng)
+        ref, ref_ng = group_by(batch, ["k"], [AggSpec("sum", "v", "s")])
+        ref_rows = {n_: v[: int(ref_ng)] for n_, v in ref.to_pydict().items()}
+        key = lambda d: sorted(
+            zip(d["k"], d["s"]), key=lambda t: (t[0] is None, t[0])
+        )
+        assert key(got) == key(ref_rows)
