@@ -1,0 +1,74 @@
+"""Flagship benchmark: TPC-DS q6-shaped pipeline throughput on one chip.
+
+Filter (selectivity ~0.5) → group-by(100 keys) with sum/count/avg over N
+rows, the minimum end-to-end slice from SURVEY.md §7 Phase 1.  The reference
+publishes no numbers (BASELINE.md), so ``vs_baseline`` is measured against a
+numpy single-core implementation of the identical pipeline run in-process —
+a stand-in for the CPU Spark executor this layer accelerates.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "Mrows/s", "vs_baseline": N}
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+N_ROWS = 1 << 21  # 2M
+REPS = 20
+
+
+def _numpy_pipeline(k, v, price):
+    mask = price < 50.0
+    ks, vs, ps = k[mask], v[mask], price[mask]
+    uniq, inv = np.unique(ks, return_inverse=True)
+    sums = np.bincount(inv, weights=vs.astype(np.float64))
+    cnts = np.bincount(inv)
+    avgs = np.bincount(inv, weights=ps) / cnts
+    return uniq, sums, cnts, avgs
+
+
+def main():
+    import jax
+
+    import __graft_entry__ as ge
+
+    fn, (batch,) = ge.entry()
+    batch = ge._example_batch(N_ROWS)
+
+    jfn = jax.jit(fn)
+    out = jfn(batch)  # compile + warm
+    jax.block_until_ready(out)
+
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = jfn(batch)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / REPS
+    tpu_mrows = N_ROWS / dt / 1e6
+
+    k = np.asarray(jax.device_get(batch["k"].data))
+    v = np.asarray(jax.device_get(batch["v"].data))
+    price = np.asarray(jax.device_get(batch["price"].data))
+    t0 = time.perf_counter()
+    for _ in range(3):
+        _numpy_pipeline(k, v, price)
+    cpu_dt = (time.perf_counter() - t0) / 3
+    cpu_mrows = N_ROWS / cpu_dt / 1e6
+
+    print(
+        json.dumps(
+            {
+                "metric": "q6_pipeline_throughput",
+                "value": round(tpu_mrows, 2),
+                "unit": "Mrows/s",
+                "vs_baseline": round(tpu_mrows / cpu_mrows, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

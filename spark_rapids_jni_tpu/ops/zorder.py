@@ -1,0 +1,137 @@
+"""Data-clustering indexes: DeltaLake ``interleave_bits`` and Hilbert index.
+
+Semantics from the reference ``zorder.cu``:
+
+* ``interleave_bits`` (zorder.cu:137): C same-type fixed-width columns ->
+  per-row binary of ``C * sizeof(T)`` bytes.  Output bit k (MSB-first across
+  the whole row) comes from column ``k % C`` (column 0 most significant),
+  bit ``k // C`` of the value read big-endian.  Null values read as 0.
+* ``hilbert_index`` (zorder.cu:224): C int32 columns, ``num_bits_per_entry``
+  bits each (``bits*C <= 64``) -> int64 Hilbert distance, Skilling's
+  transpose algorithm (same lineage as the davidmoten/hilbert-curve library
+  the reference tests compare against).  Null values read as 0.
+
+Both vectorize naturally: every loop bound (bit counts, dimensions) is
+static, so the "loops" unroll into pure elementwise uint32 ops on [n] lanes.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import types as T
+from ..columnar.column import Column, StringColumn
+
+
+def _value_bits(col: Column):
+    """(bits uint8[n, w*8] MSB-first, byte width) for a fixed-width column."""
+    kind = col.dtype.kind
+    d = col.data
+    if kind is T.Kind.BOOLEAN:
+        u = d.astype(jnp.uint8)
+    elif kind in (T.Kind.INT8,):
+        u = d.astype(jnp.uint8)
+    elif kind is T.Kind.INT16:
+        u = d.astype(jnp.uint16)
+    elif kind in (T.Kind.INT32, T.Kind.DATE):
+        u = d.astype(jnp.uint32)
+    elif kind in (T.Kind.INT64, T.Kind.TIMESTAMP):
+        u = d.astype(jnp.uint64)
+    elif kind is T.Kind.FLOAT32:
+        u = jax.lax.bitcast_convert_type(d, jnp.uint32)
+    elif kind is T.Kind.FLOAT64:
+        pair = jax.lax.bitcast_convert_type(d, jnp.uint32)
+        lo = pair[..., 0].astype(jnp.uint64)
+        hi = pair[..., 1].astype(jnp.uint64)
+        u = lo | (hi << 32)
+    else:
+        raise NotImplementedError(f"interleave_bits over {col.dtype!r}")
+    u = jnp.where(col.validity, u, jnp.zeros((), u.dtype))
+    nbits = u.dtype.itemsize * 8
+    shifts = jnp.arange(nbits - 1, -1, -1, dtype=u.dtype)
+    bits = ((u[:, None] >> shifts[None, :]) & jnp.ones((), u.dtype)).astype(jnp.uint8)
+    return bits, u.dtype.itemsize
+
+
+def interleave_bits(columns: Sequence[Column]) -> StringColumn:
+    """Byte-interleaved z-order key as a binary column (reference zorder.cu:137)."""
+    if not columns:
+        raise ValueError("interleave_bits requires at least one column")
+    kinds = {c.dtype.kind for c in columns}
+    if len(kinds) > 1:
+        raise ValueError("all columns must share one type")
+    per_col = [_value_bits(c) for c in columns]
+    width = per_col[0][1]
+    C = len(columns)
+    n = columns[0].num_rows
+    # [n, C, nbits] -> [n, nbits, C] -> flat bit stream, column 0 first
+    stacked = jnp.stack([b for b, _ in per_col], axis=1)
+    stream = jnp.transpose(stacked, (0, 2, 1)).reshape(n, width * 8 * C)
+    weights = jnp.array([128, 64, 32, 16, 8, 4, 2, 1], jnp.uint8)
+    by = stream.reshape(n, width * C, 8) * weights[None, None, :]
+    out_bytes = by.sum(axis=2, dtype=jnp.uint8)
+    lengths = jnp.full((n,), width * C, jnp.int32)
+    return StringColumn(out_bytes, lengths, jnp.ones((n,), jnp.bool_))
+
+
+def hilbert_index(num_bits_per_entry: int, columns: Sequence[Column]) -> Column:
+    """Hilbert distance of int32 points (reference zorder.cu:224).
+
+    Skilling's algorithm on C uint32 lanes: inverse-undo from the top bit
+    down, gray encode, then bit-interleave the transposed index.
+    """
+    if not (0 < num_bits_per_entry <= 32):
+        raise ValueError("num_bits_per_entry must be in (0, 32]")
+    C = len(columns)
+    if C * num_bits_per_entry > 64:
+        raise ValueError("only up to 64 output bits supported")
+    if C == 0:
+        raise ValueError("at least one column is required")
+    for c in columns:
+        if c.dtype.kind is not T.Kind.INT32:
+            raise ValueError("all columns must be INT32")
+    n = columns[0].num_rows
+    mask_entry = jnp.uint32((1 << num_bits_per_entry) - 1)
+    x = [
+        jnp.where(c.validity, c.data.astype(jnp.uint32), jnp.uint32(0)) & mask_entry
+        for c in columns
+    ]
+
+    M = 1 << (num_bits_per_entry - 1)
+    q = M
+    while q > 1:  # inverse undo (hilbert_transposed_index, zorder.cu:94)
+        p = jnp.uint32(q - 1)
+        for i in range(C):
+            hi = (x[i] & jnp.uint32(q)) != 0
+            t = (x[0] ^ x[i]) & p
+            inv_x0 = x[0] ^ p
+            x0_new = jnp.where(hi, inv_x0, x[0] ^ t)
+            xi_new = jnp.where(hi, x[i], x[i] ^ t)
+            # i == 0: the else-branch t is 0, both branches only touch x[0]
+            x[0] = x0_new
+            if i != 0:
+                x[i] = xi_new
+        q >>= 1
+
+    for i in range(1, C):  # gray encode
+        x[i] = x[i] ^ x[i - 1]
+    t = jnp.zeros((n,), jnp.uint32)
+    q = M
+    while q > 1:
+        t = jnp.where((x[C - 1] & jnp.uint32(q)) != 0, t ^ jnp.uint32(q - 1), t)
+        q >>= 1
+    x = [xi ^ t for xi in x]
+
+    # to_hilbert_index (zorder.cu:75): interleave MSB-first, column 0 first
+    b = jnp.zeros((n,), jnp.uint64)
+    b_index = num_bits_per_entry * C - 1
+    for i in range(num_bits_per_entry):
+        mask = jnp.uint32(1 << (num_bits_per_entry - 1 - i))
+        for j in range(C):
+            bit = ((x[j] & mask) != 0).astype(jnp.uint64)
+            b = b | (bit << jnp.uint64(b_index))
+            b_index -= 1
+    return Column(b.astype(jnp.int64), jnp.ones((n,), jnp.bool_), T.INT64)

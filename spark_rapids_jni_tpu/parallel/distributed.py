@@ -55,31 +55,30 @@ def distributed_group_by(
     per-device group counts, ``dropped`` int32[P] counts rows lost to slot
     overflow (0 unless ``capacity`` was undersized for the key skew).
     """
-    spec = PartitionSpec(axis_name)
-    if row_valid is None:
-        row_valid = jnp.ones((batch.num_rows,), jnp.bool_)
-        row_valid = jax.device_put(row_valid, NamedSharding(mesh, spec))
     step = _group_by_step(
-        mesh, axis_name, tuple(key_names), tuple(aggs), capacity
+        mesh, axis_name, tuple(key_names), tuple(aggs), capacity,
+        row_valid is None,
     )
-    return step(batch, row_valid)
+    return step(batch) if row_valid is None else step(batch, row_valid)
 
 
 @lru_cache(maxsize=None)
-def _group_by_step(mesh, axis_name, key_names, aggs, capacity):
+def _group_by_step(mesh, axis_name, key_names, aggs, capacity, all_valid):
     """Jitted shuffle+group step, cached so repeated batches don't retrace."""
     P = mesh.shape[axis_name]
     spec = PartitionSpec(axis_name)
+    n_in = 1 if all_valid else 2
 
     # check_vma off: kernel fori_loops seed carries from replicated constants
     # (hash seeds, zero accumulators), which the varying-axis checker rejects
     # inside shard_map even though the program is correct SPMD.
     @partial(
         jax.shard_map, mesh=mesh,
-        in_specs=(spec, spec), out_specs=(spec, spec, spec),
+        in_specs=(spec,) * n_in, out_specs=(spec, spec, spec),
         check_vma=False,
     )
-    def step(b: ColumnBatch, rv):
+    def step(b: ColumnBatch, *rv):
+        rv = jnp.ones((b.num_rows,), jnp.bool_) if all_valid else rv[0]
         pid = spark_partition_id([b[k] for k in key_names], P, rv)
         shuffled, occ, dropped = exchange(b, pid, axis_name, P, capacity)
         res, ng = group_by(shuffled, key_names, aggs, row_valid=occ)
@@ -89,14 +88,22 @@ def _group_by_step(mesh, axis_name, key_names, aggs, capacity):
 
 
 def collect_groups(result: ColumnBatch, num_groups) -> dict:
-    """Host-side: concatenate each device-shard's live group rows."""
+    """Host-side: concatenate each device-shard's live group rows.
+
+    Slices the live rows out of each shard (device-side gathers on index
+    arrays) before any host conversion, so cost scales with actual group
+    count, not the padded P*rows_per_dev result shape.
+    """
+    from ..relational.gather import gather_column
+
     ng = np.asarray(jax.device_get(num_groups))
     P = ng.shape[0]
-    data = result.to_pydict()
     rows_per_dev = result.num_rows // P
-    out = {name: [] for name in result.names}
-    for d in range(P):
-        lo = d * rows_per_dev
-        for name in result.names:
-            out[name].extend(data[name][lo : lo + int(ng[d])])
-    return out
+    idx = np.concatenate(
+        [d * rows_per_dev + np.arange(int(ng[d])) for d in range(P)]
+    ).astype(np.int32)
+    idx_dev = jnp.asarray(idx)
+    return {
+        name: gather_column(col, idx_dev).to_pylist()
+        for name, col in zip(result.names, result.columns)
+    }

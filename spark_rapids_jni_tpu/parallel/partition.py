@@ -28,7 +28,9 @@ def spark_partition_id(
     """
     h = murmur_hash3_32(key_columns, seed=42).data  # int32, Spark seed
     p = jnp.int32(num_partitions)
-    pid = ((h % p) + p) % p  # pmod: Java % keeps sign of dividend
+    # Spark's pmod(h, p): jnp % already yields a non-negative remainder for
+    # p > 0 (sign of divisor), which equals pmod exactly
+    pid = h % p
     if row_valid is not None:
         pid = jnp.where(row_valid, pid, p)
     return pid

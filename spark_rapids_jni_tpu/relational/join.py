@@ -142,13 +142,12 @@ def hash_join(
 
     collisions = set(lpart.names) & set(rpart.names)
     merged = {}
-    for name, col in zip(lpart.names, lpart.columns):
-        merged[name + suffixes[0] if name in collisions else name] = col
-    for name, col in zip(rpart.names, rpart.columns):
-        out = name + suffixes[1] if name in collisions else name
-        if out in merged:
-            raise ValueError(
-                f"join output name collision: {out!r} (suffixes={suffixes!r})"
-            )
-        merged[out] = col
+    for part, suffix in ((lpart, suffixes[0]), (rpart, suffixes[1])):
+        for name, col in zip(part.names, part.columns):
+            out = name + suffix if name in collisions else name
+            if out in merged:
+                raise ValueError(
+                    f"join output name collision: {out!r} (suffixes={suffixes!r})"
+                )
+            merged[out] = col
     return ColumnBatch(merged), total

@@ -1,0 +1,340 @@
+"""Decimal128 arithmetic vs a pure-python int oracle + reference goldens.
+
+Golden values come from the reference DecimalUtilsTest.java (multiply bug
+case, remainder/integer-divide examples); the oracle reimplements the
+chunked256 algorithms with unbounded python ints for randomized checks.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.columnar.column import Decimal128Column
+from spark_rapids_jni_tpu.ops import decimal as D
+
+# ---------------------------------------------------------------------------
+# oracle (python ints)
+# ---------------------------------------------------------------------------
+
+
+def prec10(v):
+    v = abs(v)
+    p = 0
+    while 10**p < v:
+        p += 1
+    return p
+
+
+def trunc_div(n, d):
+    q = abs(n) // abs(d)
+    return -q if (n < 0) != (d < 0) else q
+
+
+def round_half_up(n, d):
+    """n/d with HALF_UP; d > 0 expected from pow10 use; handles signed n/d."""
+    q, r = divmod(abs(n), abs(d))
+    if 2 * r >= abs(d):
+        q += 1
+    return -q if (n < 0) != (d < 0) else q
+
+
+def oracle_add_sub(a, sa, b, sb, rs, sub):
+    inter = max(sa, sb)
+    a2 = a * 10 ** (inter - sa)
+    b2 = b * 10 ** (inter - sb)
+    if sub:
+        b2 = -b2
+    s = a2 + b2
+    if rs > inter:
+        s *= 10 ** (rs - inter)
+    elif rs < inter:
+        s = round_half_up(s, 10 ** (inter - rs))
+    return abs(s) >= 10**38, s
+
+
+def oracle_multiply(a, sa, b, sb, ps, interim=True):
+    product = a * b
+    sm = sa + sb
+    if interim:
+        fdp = prec10(product) - 38
+        if fdp > 0:
+            product = round_half_up(product, 10**fdp)
+            sm -= fdp
+    exp = sm - ps
+    if exp < 0:
+        if prec10(product) - exp > 38:
+            return True, None
+        product *= 10**-exp
+    elif exp > 0:
+        product = round_half_up(product, 10**exp)
+    return abs(product) >= 10**38, product
+
+
+def oracle_divide(a, sa, b, sb, qs):
+    if b == 0:
+        return True, 0
+    shift = qs - (sa - sb)
+    if shift < 0:
+        q = round_half_up(trunc_div(a, b), 10**-shift)
+    else:
+        q = round_half_up(a * 10**shift, b)
+    return abs(q) >= 10**38, q
+
+
+def oracle_int_divide(a, sa, b, sb):
+    if b == 0:
+        return True, 0
+    shift = sb - sa
+    if shift < 0:
+        q = trunc_div(trunc_div(a, b), 10**-shift)
+    else:
+        q = trunc_div(a * 10**shift, b)
+    over = abs(q) >= 10**38
+    # as_64_bits narrowing: low 64 bits, two's complement
+    u = q & ((1 << 64) - 1)
+    if u >= 1 << 63:
+        u -= 1 << 64
+    return over, u
+
+
+def oracle_remainder(a, sa, b, sb, rs):
+    if b == 0:
+        return True, 0
+    d_shift = rs - sb
+    n_shift = rs - sa
+    abs_d = abs(b)
+    if d_shift < 0:
+        abs_d = round_half_up(abs_d, 10**-d_shift)
+        if abs_d == 0:
+            return None, None  # rescaled divisor vanished: UB in the reference
+    else:
+        n_shift -= d_shift
+    abs_n = abs(a)
+    if n_shift < 0:
+        int_div = (abs_n // abs_d) // 10**-n_shift
+    else:
+        abs_n *= 10**n_shift
+        int_div = abs_n // abs_d
+    less = int_div * abs_d
+    if d_shift > 0:
+        less *= 10**d_shift
+    res = abs_n - less
+    if a < 0:
+        res = -res
+    return abs(res) >= 10**38, res
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def col(vals, precision, scale):
+    return Decimal128Column.from_unscaled(vals, precision, scale)
+
+
+def unscaled(s, scale):
+    """'123.45' at scale -> int; mirrors BigDecimal(s).setScale(scale)."""
+    from decimal import Decimal, localcontext
+
+    with localcontext() as ctx:
+        ctx.prec = 80
+        return int(Decimal(s).scaleb(scale))
+
+
+def check(op_result, expect_pairs):
+    ov_col, res_col = op_result
+    ov = ov_col.to_pylist()
+    res = res_col.to_pylist()
+    for i, exp in enumerate(expect_pairs):
+        if exp is None:
+            assert res[i] is None and ov[i] is None or not ov[i]
+            continue
+        e_ov, e_val = exp
+        assert bool(ov[i]) == bool(e_ov), f"row {i}: overflow {ov[i]} != {e_ov}"
+        if not e_ov and e_val is not None:
+            assert res[i] == e_val, f"row {i}: {res[i]} != {e_val}"
+
+
+def rand128(rng, n, bits=100):
+    out = []
+    for _ in range(n):
+        nbits = int(rng.integers(1, bits))
+        v = int(rng.integers(0, 2**31)) | (int(rng.integers(0, 2**62)) << 31)
+        v = (v << 40) | int(rng.integers(0, 2**40))
+        v &= (1 << nbits) - 1
+        if rng.random() < 0.5:
+            v = -v
+        out.append(v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# golden vectors (reference DecimalUtilsTest.java)
+# ---------------------------------------------------------------------------
+
+
+class TestGoldens:
+    def test_multiply_interim_cast_bug(self):
+        # DecimalUtils.java:33-37 documented bug case
+        a = col([unscaled("-8533444864753048107770677711.1312637916", 10)], 38, 10)
+        b = col([unscaled("-12.0000000000", 10)], 38, 10)
+        ov, res = D.multiply_decimal128(a, b, 6, cast_interim_result=True)
+        assert res.to_pylist()[0] == unscaled(
+            "102401338377036577293248132533.575166", 6
+        )
+        assert not ov.to_pylist()[0]
+
+        ov, res = D.multiply_decimal128(a, b, 6, cast_interim_result=False)
+        assert res.to_pylist()[0] == unscaled(
+            "102401338377036577293248132533.575165", 6
+        )
+
+    def test_simple_multiply(self):
+        a = col([unscaled("1.0", 1), unscaled("3.7", 1)], 38, 1)
+        b = col([unscaled("1.0", 1), unscaled("1.5", 1)], 38, 1)
+        ov, res = D.multiply_decimal128(a, b, 1)
+        assert res.to_pylist() == [unscaled("1.0", 1), unscaled("5.6", 1)]
+        assert ov.to_pylist() == [False, False]
+
+    def test_remainder_golden(self):
+        # reference DecimalUtilsTest remainder1 (scale 1)
+        big = "2775750723350045263458396405825339066"
+        div = "4890990637589340307512622401149178814.1"
+        a = col([unscaled(s, 0) for s in (big, big, "-" + big, "-" + big)], 38, 0)
+        b = col(
+            [unscaled(s, 1) for s in ("-" + div, div, "-" + div, div)], 38, 1
+        )
+        ov, res = D.remainder_decimal128(a, b, 1)
+        assert ov.to_pylist() == [False] * 4
+        e = unscaled(big + ".0", 1)
+        assert res.to_pylist() == [e, e, -e, -e]
+
+    def test_remainder7_divisor_rescale(self):
+        # reference remainder7: d_shift < 0 exercises the divisor rounding
+        a = col([unscaled("5776949384953805890688943467625198736", 0)], 38, 0)
+        b = col([unscaled("-67337920196996830.354487679299", 12)], 38, 12)
+        ov, res = D.remainder_decimal128(a, b, 7)
+        assert not ov.to_pylist()[0]
+        assert res.to_pylist()[0] == unscaled("16310460742282291.8108019", 7)
+
+    def test_remainder10(self):
+        a = col([unscaled("5776949384953805890688943467625198736", 0)], 38, 0)
+        b = col([unscaled("-6733792019699683035.4487679299", 10)], 38, 10)
+        ov, res = D.remainder_decimal128(a, b, 10)
+        assert not ov.to_pylist()[0]
+        assert res.to_pylist()[0] == unscaled("3585222007130884413.9709383255", 10)
+
+    def test_integer_divide_golden(self):
+        # reference intDivideNotOverflow: overflow judged on the wide value
+        a = col(
+            [
+                unscaled("451635271134476686911387864.48", 2),
+                unscaled("5313675970270560086329837153.18", 2),
+            ],
+            38, 2,
+        )
+        b = col([unscaled("-961.110", 3), unscaled("181.958", 3)], 38, 3)
+        ov, res = D.integer_divide_decimal128(a, b)
+        assert res.to_pylist() == [2284624887606872042, -2928582767902049472]
+        assert ov.to_pylist() == [False, False]
+
+    def test_divide_by_zero(self):
+        a = col([100], 38, 2)
+        b = col([0], 38, 2)
+        ov, res = D.divide_decimal128(a, b, 2)
+        assert ov.to_pylist() == [True]
+
+    def test_null_propagation(self):
+        a = col([100, None], 38, 2)
+        b = col([None, 7], 38, 2)
+        for op in (
+            lambda: D.add_decimal128(a, b, 2),
+            lambda: D.multiply_decimal128(a, b, 2),
+            lambda: D.divide_decimal128(a, b, 2),
+            lambda: D.remainder_decimal128(a, b, 2),
+        ):
+            ov, res = op()
+            assert res.to_pylist() == [None, None]
+
+
+# ---------------------------------------------------------------------------
+# randomized oracle comparison
+# ---------------------------------------------------------------------------
+
+
+SCALES = [(10, 10, 6), (2, 3, 2), (0, 0, 0), (18, 2, 10), (2, 18, 4), (6, 0, 38 - 10)]
+
+
+class TestRandomized:
+    @pytest.mark.parametrize("sa,sb,rs", SCALES)
+    def test_add_sub(self, rng, sa, sb, rs):
+        n = 32
+        av, bv = rand128(rng, n), rand128(rng, n)
+        a, b = col(av, 38, sa), col(bv, 38, sb)
+        for sub in (False, True):
+            op = D.sub_decimal128 if sub else D.add_decimal128
+            ov_col, res_col = op(a, b, rs)
+            ov, res = ov_col.to_pylist(), res_col.to_pylist()
+            for i in range(n):
+                e_ov, e_val = oracle_add_sub(av[i], sa, bv[i], sb, rs, sub)
+                assert bool(ov[i]) == e_ov, (i, av[i], bv[i])
+                if not e_ov:
+                    assert res[i] == e_val, (i, av[i], bv[i], sub)
+
+    @pytest.mark.parametrize("sa,sb,rs", SCALES)
+    @pytest.mark.parametrize("interim", [True, False])
+    def test_multiply(self, rng, sa, sb, rs, interim):
+        n = 32
+        av, bv = rand128(rng, n, bits=90), rand128(rng, n, bits=40)
+        a, b = col(av, 38, sa), col(bv, 38, sb)
+        ov_col, res_col = D.multiply_decimal128(a, b, rs, cast_interim_result=interim)
+        ov, res = ov_col.to_pylist(), res_col.to_pylist()
+        for i in range(n):
+            e_ov, e_val = oracle_multiply(av[i], sa, bv[i], sb, rs, interim)
+            assert bool(ov[i]) == e_ov, (i, av[i], bv[i])
+            if not e_ov:
+                assert res[i] == e_val, (i, av[i], bv[i])
+
+    @pytest.mark.parametrize("sa,sb,rs", SCALES)
+    def test_divide(self, rng, sa, sb, rs):
+        n = 32
+        av, bv = rand128(rng, n), rand128(rng, n, bits=60)
+        bv[0] = 0
+        a, b = col(av, 38, sa), col(bv, 38, sb)
+        ov_col, res_col = D.divide_decimal128(a, b, rs)
+        ov, res = ov_col.to_pylist(), res_col.to_pylist()
+        for i in range(n):
+            e_ov, e_val = oracle_divide(av[i], sa, bv[i], sb, rs)
+            assert bool(ov[i]) == e_ov, (i, av[i], bv[i])
+            if not e_ov:
+                assert res[i] == e_val, (i, av[i], bv[i])
+
+    @pytest.mark.parametrize("sa,sb", [(2, 3), (10, 0), (0, 10), (18, 18)])
+    def test_integer_divide(self, rng, sa, sb):
+        n = 32
+        av, bv = rand128(rng, n), rand128(rng, n, bits=60)
+        bv[1] = 0
+        a, b = col(av, 38, sa), col(bv, 38, sb)
+        ov_col, res_col = D.integer_divide_decimal128(a, b)
+        ov, res = ov_col.to_pylist(), res_col.to_pylist()
+        for i in range(n):
+            e_ov, e_val = oracle_int_divide(av[i], sa, bv[i], sb)
+            assert bool(ov[i]) == e_ov, (i, av[i], bv[i])
+            if not e_ov:
+                assert res[i] == e_val, (i, av[i], bv[i])
+
+    @pytest.mark.parametrize("sa,sb,rs", SCALES)
+    def test_remainder(self, rng, sa, sb, rs):
+        n = 32
+        av, bv = rand128(rng, n), rand128(rng, n, bits=60)
+        bv[2] = 0
+        a, b = col(av, 38, sa), col(bv, 38, sb)
+        ov_col, res_col = D.remainder_decimal128(a, b, rs)
+        ov, res = ov_col.to_pylist(), res_col.to_pylist()
+        for i in range(n):
+            e_ov, e_val = oracle_remainder(av[i], sa, bv[i], sb, rs)
+            if e_ov is None:
+                continue
+            assert bool(ov[i]) == e_ov, (i, av[i], bv[i])
+            if not e_ov:
+                assert res[i] == e_val, (i, av[i], bv[i])

@@ -1,0 +1,139 @@
+"""interleave_bits / hilbert_index vs python oracles + anchor values."""
+
+import numpy as np
+
+from spark_rapids_jni_tpu.columnar import types as T
+from spark_rapids_jni_tpu.columnar.column import Column
+from spark_rapids_jni_tpu.ops.zorder import hilbert_index, interleave_bits
+
+# ---------------------------------------------------------------------------
+# oracles
+# ---------------------------------------------------------------------------
+
+
+def oracle_interleave(rows, width):
+    """rows: list of per-column int values (nulls already 0); width bytes."""
+    C = len(rows)
+    nbits = width * 8
+    out_bits = []
+    for k in range(nbits * C):
+        col = k % C
+        bit = k // C
+        v = rows[col] & ((1 << nbits) - 1)
+        out_bits.append((v >> (nbits - 1 - bit)) & 1)
+    out = bytearray()
+    for j in range(width * C):
+        byte = 0
+        for b in range(8):
+            byte = (byte << 1) | out_bits[8 * j + b]
+        out.append(byte)
+    return bytes(out)
+
+
+def oracle_hilbert(point, bits):
+    """Skilling transpose -> index (davidmoten/hilbert-curve semantics)."""
+    n = len(point)
+    x = [p & ((1 << bits) - 1) for p in point]
+    M = 1 << (bits - 1)
+    q = M
+    while q > 1:
+        p = q - 1
+        for i in range(n):
+            if x[i] & q:
+                x[0] ^= p
+            else:
+                t = (x[0] ^ x[i]) & p
+                x[0] ^= t
+                x[i] ^= t
+        q >>= 1
+    for i in range(1, n):
+        x[i] ^= x[i - 1]
+    t = 0
+    q = M
+    while q > 1:
+        if x[n - 1] & q:
+            t ^= q - 1
+        q >>= 1
+    x = [xi ^ t for xi in x]
+    b = 0
+    for i in range(bits):
+        for j in range(n):
+            b = (b << 1) | ((x[j] >> (bits - 1 - i)) & 1)
+    return b
+
+
+def ints(vals, dtype=T.INT32):
+    return Column.from_pylist(vals, dtype)
+
+
+class TestInterleaveBits:
+    def test_single_int32(self):
+        vals = [0, 1, -1, 0x12345678, None]
+        raw = interleave_bits([ints(vals)])
+        chars = np.asarray(raw.chars)
+        for i, v in enumerate(vals):
+            exp = oracle_interleave([v if v is not None else 0], 4)
+            assert bytes(chars[i, :4]) == exp, (i, v)
+
+    def test_two_int32(self, rng):
+        a = rng.integers(-(2**31), 2**31, 16).tolist()
+        b = rng.integers(-(2**31), 2**31, 16).tolist()
+        raw = interleave_bits([ints(a), ints(b)])
+        chars = np.asarray(raw.chars)
+        for i in range(16):
+            assert bytes(chars[i, :8]) == oracle_interleave([a[i], b[i]], 4)
+
+    def test_known_two_col(self):
+        # 0xFF000000 x 0x00000000 -> alternating 10101010 for the top 2 bytes
+        raw = interleave_bits([ints([-16777216]), ints([0])])
+        chars = np.asarray(raw.chars)[0, :8]
+        assert bytes(chars) == bytes([0xAA, 0xAA, 0, 0, 0, 0, 0, 0])
+
+    def test_three_int16(self, rng):
+        a = rng.integers(-(2**15), 2**15, 8).tolist()
+        b = rng.integers(-(2**15), 2**15, 8).tolist()
+        c = rng.integers(-(2**15), 2**15, 8).tolist()
+        raw = interleave_bits(
+            [ints(a, T.INT16), ints(b, T.INT16), ints(c, T.INT16)]
+        )
+        chars = np.asarray(raw.chars)
+        for i in range(8):
+            assert bytes(chars[i, :6]) == oracle_interleave([a[i], b[i], c[i]], 2)
+
+    def test_int64(self, rng):
+        a = rng.integers(-(2**62), 2**62, 8).tolist()
+        raw = interleave_bits([ints(a, T.INT64)])
+        chars = np.asarray(raw.chars)
+        for i in range(8):
+            assert bytes(chars[i, :8]) == oracle_interleave([a[i]], 8)
+
+
+class TestHilbertIndex:
+    def test_first_order_2d(self):
+        # 1-bit 2-D curve: (0,0)->0 (0,1)->1 (1,1)->2 (1,0)->3
+        a = ints([0, 0, 1, 1])
+        b = ints([0, 1, 1, 0])
+        out = hilbert_index(1, [a, b]).to_pylist()
+        assert out == [0, 1, 2, 3]
+
+    def test_matches_oracle_2d(self, rng):
+        a = rng.integers(0, 1024, 32).tolist()
+        b = rng.integers(0, 1024, 32).tolist()
+        out = hilbert_index(10, [ints(a), ints(b)]).to_pylist()
+        for i in range(32):
+            assert out[i] == oracle_hilbert([a[i], b[i]], 10), i
+
+    def test_matches_oracle_3d_nulls(self, rng):
+        a = [None, 4, 1, 0, 1023, 512]
+        b = [1, 8, None, 0, 1023, 512]
+        c = [2, 0, 4, 0, 1023, None]
+        out = hilbert_index(10, [ints(a), ints(b), ints(c)]).to_pylist()
+        z = lambda v: 0 if v is None else v
+        for i in range(6):
+            assert out[i] == oracle_hilbert([z(a[i]), z(b[i]), z(c[i])], 10), i
+
+    def test_single_dim(self):
+        vals = [1, 2, 3, 4, 5]
+        out = hilbert_index(3, [ints(vals)]).to_pylist()
+        for i, v in enumerate(vals):
+            assert out[i] == oracle_hilbert([v], 3)
