@@ -35,7 +35,7 @@ def main():
 
     import __graft_entry__ as ge
 
-    fn, (batch,) = ge.entry()
+    fn = ge._q6_step
     batch = ge._example_batch(N_ROWS)
 
     jfn = jax.jit(fn)

@@ -1,0 +1,148 @@
+"""Spark ``BloomFilterImpl``-bit-compatible bloom filter.
+
+Reference: ``bloom_filter.cu``.  The serialized form is Spark's: a
+big-endian header {version=1, num_hashes, num_longs} followed by the bit
+array as big-endian longs — interchangeable with Spark CPU
+(``bloom_filter.cu:46-60`` derives a word/byte swizzle so its
+little-endian device words dump to that exact byte stream).
+
+TPU design: the filter lives as ``bool[num_longs * 64]`` — one lane per
+bit, indexed in the reference's swizzled order, so "set" is a plain
+scatter of True (idempotent — no atomics needed) and "probe" is a gather.
+Packing to the serialized bytes happens only at host boundaries.
+
+Hashing (``gpu_bloom_filter_put``, bloom_filter.cu:63-87): h1 =
+murmur3(long, seed=0), h2 = murmur3(long, seed=h1); bit k of probe i uses
+``combined = h1 + i*h2`` (int32 wrap), flipped if negative, mod num_bits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import types as T
+from ..columnar.column import Column
+from .hashing import murmur3_u64
+
+SPARK_BLOOM_FILTER_VERSION = 1
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class BloomFilter:
+    """num_longs*64 bits in serialized-buffer bit order (see module doc)."""
+
+    bits: jax.Array  # bool[num_longs * 64]
+    num_hashes: int
+    num_longs: int
+
+    def tree_flatten(self):
+        return (self.bits,), (self.num_hashes, self.num_longs)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], *aux)
+
+
+def bloom_filter_create(num_hashes: int, num_longs: int) -> BloomFilter:
+    """Empty filter (reference bloom_filter_create, bloom_filter.cu:225)."""
+    if num_hashes <= 0 or num_longs <= 0:
+        raise ValueError("num_hashes and num_longs must be positive")
+    return BloomFilter(
+        jnp.zeros((num_longs * 64,), jnp.bool_), num_hashes, num_longs
+    )
+
+
+def _probe_positions(col: Column, num_hashes: int, num_longs: int):
+    """Swizzled bit positions [n, num_hashes]; invalid rows out-of-range."""
+    if col.dtype.kind is not T.Kind.INT64:
+        raise TypeError("bloom filter input must be INT64")
+    n = col.num_rows
+    bits = jnp.uint32(num_longs * 64)
+    el = col.data.astype(jnp.int64).astype(jnp.uint64)
+    zero = jnp.zeros((n,), jnp.uint32)
+    h1 = murmur3_u64(el, zero)
+    h2 = murmur3_u64(el, h1)
+    pos = []
+    for i in range(1, num_hashes + 1):
+        combined = h1 + jnp.uint32(i) * h2  # int32 wraparound semantics
+        neg = (combined >> 31) != 0
+        iv = jnp.where(neg, ~combined, combined)
+        index = iv % bits
+        word = (index >> 5) ^ jnp.uint32(1)  # 64-bit-long word swizzle
+        bit = (index & jnp.uint32(31)) ^ jnp.uint32(0x18)  # byte swizzle
+        pos.append((word << 5) | bit)
+    out = jnp.stack(pos, axis=1).astype(jnp.int32)
+    return jnp.where(col.validity[:, None], out, jnp.int32(num_longs * 64))
+
+
+def bloom_filter_put(bf: BloomFilter, col: Column) -> BloomFilter:
+    """Insert non-null longs (reference gpu_bloom_filter_put); functional —
+    returns the updated filter."""
+    pos = _probe_positions(col, bf.num_hashes, bf.num_longs).reshape(-1)
+    bits = bf.bits.at[pos].set(True, mode="drop")
+    return BloomFilter(bits, bf.num_hashes, bf.num_longs)
+
+
+def bloom_filter_build(
+    num_hashes: int, num_longs: int, col: Column
+) -> BloomFilter:
+    return bloom_filter_put(bloom_filter_create(num_hashes, num_longs), col)
+
+
+def bloom_filter_merge(filters: Sequence[BloomFilter]) -> BloomFilter:
+    """Bitwise OR (reference bloom_filter_merge, bloom_filter.cu:277)."""
+    first = filters[0]
+    for f in filters[1:]:
+        if (f.num_hashes, f.num_longs) != (first.num_hashes, first.num_longs):
+            raise ValueError("mismatched bloom filter parameters")
+    bits = first.bits
+    for f in filters[1:]:
+        bits = bits | f.bits
+    return BloomFilter(bits, first.num_hashes, first.num_longs)
+
+
+def bloom_filter_probe(bf: BloomFilter, col: Column) -> Column:
+    """Membership test per row (reference bloom_filter_probe,
+    bloom_filter.cu:339); null rows stay null."""
+    pos = _probe_positions(col, bf.num_hashes, bf.num_longs)
+    hit = jnp.take(bf.bits, jnp.clip(pos, 0, bf.num_longs * 64 - 1), axis=0)
+    found = hit.all(axis=1)
+    return Column(found, col.validity, T.BOOLEAN)
+
+
+# ---------------------------------------------------------------------------
+# host (de)serialization — Spark interchange format
+# ---------------------------------------------------------------------------
+
+
+def bloom_filter_serialize(bf: BloomFilter) -> bytes:
+    """Header + bit array, byte-compatible with Spark's BloomFilterImpl."""
+    header = struct.pack(
+        ">iii", SPARK_BLOOM_FILTER_VERSION, bf.num_hashes, bf.num_longs
+    )
+    bits = np.asarray(jax.device_get(bf.bits)).astype(np.uint8)
+    # position p = word*32 + bit; device words are little-endian uint32s
+    # dumped in order, so byte b of the payload holds bits 8*(b%4)..+7 of
+    # word b//4, LSB-first
+    by = bits.reshape(bf.num_longs * 8, 8)
+    weights = (1 << np.arange(8)).astype(np.uint8)
+    payload = (by * weights[None, :]).sum(axis=1).astype(np.uint8)
+    return header + payload.tobytes()
+
+
+def bloom_filter_deserialize(buf: bytes) -> BloomFilter:
+    version, num_hashes, num_longs = struct.unpack(">iii", buf[:12])
+    if version != SPARK_BLOOM_FILTER_VERSION:
+        raise ValueError(f"unsupported bloom filter version {version}")
+    payload = np.frombuffer(buf[12 : 12 + num_longs * 8], dtype=np.uint8)
+    bits = (payload[:, None] >> np.arange(8)[None, :]) & 1
+    return BloomFilter(
+        jnp.asarray(bits.reshape(-1).astype(np.bool_)), num_hashes, num_longs
+    )

@@ -1,0 +1,126 @@
+"""Proleptic-Gregorian ⇄ hybrid-Julian calendar rebase for days/micros.
+
+Matches Spark's ``localRebaseGregorianToJulianDays`` /
+``rebaseGregorianToJulianMicros`` (UTC) family as implemented by the
+reference ``datetime_rebase.cu``:
+
+* A date >= 1582-10-15 (Gregorian adoption) is identical in both calendars.
+* Dates in the adoption gap (1582-10-05 .. 1582-10-14, which never existed
+  in the hybrid calendar) collapse to 1582-10-15 → day -141427.
+* Older dates: reinterpret the local y/m/d in the other calendar and
+  recompute days-since-epoch.  Civil-date math follows Howard Hinnant's
+  ``days_from_civil``/``civil_from_days`` algorithms (as the reference does,
+  datetime_rebase.cu:40-52,110-126), which are pure integer arithmetic and
+  vectorize directly; jnp's floor division replaces the reference's manual
+  negative-value fixups.
+
+Micros variants split into (days, time-of-day) with floor/pmod semantics
+(``get_time_components``, datetime_rebase.cu:198-222) and reuse the day
+rebase on the date part; time-of-day passes through unchanged.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..columnar import types as T
+from ..columnar.column import Column
+
+_GREGORIAN_START_DAYS = -141427  # 1582-10-15
+_JULIAN_END_DAYS = -141438  # 1582-10-04 in proleptic Gregorian days
+_CUTOVER_MICROS = -12219292800000000  # 1582-10-15T00:00:00Z
+_MICROS_PER_DAY = 86400 * 1000000
+
+
+def _civil_from_days(z):
+    """Gregorian days-since-epoch -> (y, m, d)."""
+    z = z + 719468
+    era = z // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = mp + jnp.where(mp < 10, 3, -9)
+    return y + (m <= 2), m, d
+
+
+def _days_from_civil(y, m, d):
+    """(y, m, d) Gregorian -> days-since-epoch."""
+    y = y - (m <= 2)
+    era = y // 400
+    yoe = y - era * 400
+    doy = (153 * (m + jnp.where(m > 2, -3, 9)) + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def _days_from_julian(y, m, d):
+    """(y, m, d) Julian calendar -> days-since-epoch (reference
+    days_from_julian, datetime_rebase.cu:40)."""
+    y = y - (m <= 2)
+    era = y // 4
+    yoe = y - era * 4
+    doy = (153 * (m + jnp.where(m > 2, -3, 9)) + 2) // 5 + d - 1
+    doe = yoe * 365 + doy
+    return era * 1461 + doe - 719470
+
+
+def _julian_from_days(z):
+    """days-since-epoch -> (y, m, d) in the Julian calendar (reference
+    julian_from_days, datetime_rebase.cu:110)."""
+    z = z + 719470
+    era = z // 1461
+    doe = z - era * 1461
+    yoe = (doe - doe // 1460) // 365
+    y = yoe + era * 4
+    doy = doe - 365 * yoe
+    mp = (5 * doy + 2) // 153
+    m = mp + jnp.where(mp < 10, 3, -9)
+    d = doy - (153 * mp + 2) // 5 + 1
+    return y + (m <= 2), m, d
+
+
+def _rebase_days_g2j(days):
+    y, m, d = _civil_from_days(days)
+    julian = _days_from_julian(y, m, d)
+    out = jnp.where(days > _JULIAN_END_DAYS, _GREGORIAN_START_DAYS, julian)
+    return jnp.where(days >= _GREGORIAN_START_DAYS, days, out).astype(days.dtype)
+
+
+def _rebase_days_j2g(days):
+    y, m, d = _julian_from_days(days)
+    greg = _days_from_civil(y, m, d)
+    return jnp.where(days >= _GREGORIAN_START_DAYS, days, greg).astype(days.dtype)
+
+
+def _rebase_micros(micros, day_fn):
+    days = micros // _MICROS_PER_DAY
+    tod = micros - days * _MICROS_PER_DAY  # [0, day) — floor/pmod semantics
+    out = day_fn(days) * _MICROS_PER_DAY + tod
+    return jnp.where(micros >= _CUTOVER_MICROS, micros, out)
+
+
+def rebase_gregorian_to_julian(col: Column) -> Column:
+    """DATE/TIMESTAMP rebase (reference rebase_gregorian_to_julian,
+    datetime_rebase.cu:346)."""
+    if col.dtype.kind is T.Kind.DATE:
+        return Column(_rebase_days_g2j(col.data), col.validity, col.dtype)
+    if col.dtype.kind is T.Kind.TIMESTAMP:
+        return Column(
+            _rebase_micros(col.data, _rebase_days_g2j), col.validity, col.dtype
+        )
+    raise TypeError(f"rebase expects DATE or TIMESTAMP, got {col.dtype!r}")
+
+
+def rebase_julian_to_gregorian(col: Column) -> Column:
+    """Inverse rebase (reference rebase_julian_to_gregorian,
+    datetime_rebase.cu:361)."""
+    if col.dtype.kind is T.Kind.DATE:
+        return Column(_rebase_days_j2g(col.data), col.validity, col.dtype)
+    if col.dtype.kind is T.Kind.TIMESTAMP:
+        return Column(
+            _rebase_micros(col.data, _rebase_days_j2g), col.validity, col.dtype
+        )
+    raise TypeError(f"rebase expects DATE or TIMESTAMP, got {col.dtype!r}")

@@ -1,0 +1,139 @@
+"""Spark ``percentile`` over (value, frequency) histograms.
+
+Reference: ``histogram.cu`` — ``create_histogram_if_valid`` (:283) validates
+frequencies (negative -> error) and nulls out entries with freq <= 0;
+``percentile_from_histogram`` (:429) segment-sorts each histogram's
+elements, computes inclusive cumulative frequencies, and linearly
+interpolates ``position = (total_freq - 1) * percentage`` between the two
+straddling elements (``fill_percentile_fn``, :50).
+
+Here a batch of H histograms is (values Column, freqs int64 Column,
+offsets int32[H+1]) — the flattened LIST layout.  The sort is one
+``lax.sort`` keyed (segment, validity, value); cumulative counts are a
+segmented cumsum (global cumsum minus per-segment base — scan + gather, no
+scatter); the per-(histogram, percentage) rank search is a vectorized
+binary search over the cumulative array restricted to each segment.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import types as T
+from ..columnar.column import Column
+from ..relational import keys as K
+
+
+def create_histogram_if_valid(
+    values: Column, frequencies: Column
+) -> Tuple[Column, Column]:
+    """Validate and pack (value, freq) pairs (reference histogram.cu:283).
+
+    Negative frequencies raise; entries with freq <= 0 or null value become
+    null elements.  Returns the masked (values, frequencies).
+    """
+    if frequencies.dtype.kind is not T.Kind.INT64:
+        raise TypeError("frequencies must be INT64")
+    if values.num_rows != frequencies.num_rows:
+        raise ValueError("values and frequencies must have the same size")
+    # mask null-frequency rows: their buffer lanes may hold residual values
+    freq = jnp.where(frequencies.validity, frequencies.data, jnp.int64(0))
+    if bool(jnp.any(freq < 0)):  # host sync, same as the reference's check
+        raise ValueError("The input frequencies must not contain negative values.")
+    valid = values.validity & (freq > 0)
+    return (
+        Column(values.data, valid, values.dtype),
+        Column(freq, frequencies.validity, frequencies.dtype),
+    )
+
+
+def percentile_from_histogram(
+    values: Column,
+    frequencies: Column,
+    offsets,
+    percentages: Sequence[float],
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact percentiles per histogram (reference histogram.cu:429).
+
+    ``offsets``: int32[H+1] flattened-list boundaries.  Returns
+    ``(out float64[H, P], histogram_valid bool[H])``; all-null histograms
+    yield invalid rows.
+    """
+    offsets = jnp.asarray(offsets, jnp.int32)
+    H = offsets.shape[0] - 1
+    P = len(percentages)
+    n = values.num_rows
+    pct = jnp.asarray(np.asarray(percentages, np.float64))
+
+    seg = (jnp.searchsorted(offsets, jnp.arange(n, dtype=jnp.int32), side="right") - 1
+           ).astype(jnp.int32)
+    invalid = ~values.validity
+
+    ops = (
+        [seg.astype(jnp.uint32), invalid.astype(jnp.uint32)]
+        + [
+            jnp.where(values.validity, k, jnp.zeros((), k.dtype))
+            for k in K.column_radix_keys(values, equality=False)
+        ]
+        + [jnp.arange(n, dtype=jnp.int32)]
+    )
+    res = jax.lax.sort(tuple(ops), num_keys=len(ops) - 1, is_stable=True)
+    perm = res[-1]
+
+    s_vals = jnp.take(values.data, perm).astype(jnp.float64)
+    s_valid = jnp.take(values.validity, perm)
+    s_freq = jnp.take(frequencies.data, perm) * s_valid.astype(jnp.int64)
+
+    total = jnp.cumsum(s_freq)
+    starts = offsets[:H]
+    base = jnp.where(starts > 0, jnp.take(total, jnp.maximum(starts - 1, 0)), 0)
+    acc = total - jnp.take(base, seg)  # per-segment inclusive cumulative
+
+    valid_counts = jax.ops.segment_sum(
+        s_valid.astype(jnp.int32), seg, num_segments=H
+    )
+    ends = starts + valid_counts  # nulls sorted to each segment's tail
+    hist_valid = valid_counts > 0
+
+    total_freq = jnp.where(
+        hist_valid, jnp.take(acc, jnp.maximum(ends - 1, 0)), jnp.int64(1)
+    )
+    max_positions = (total_freq - 1).astype(jnp.float64)
+
+    # per (h, p) rank positions
+    position = max_positions[:, None] * pct[None, :]  # [H, P]
+    lower = jnp.floor(position).astype(jnp.int64)
+    higher = jnp.ceil(position).astype(jnp.int64)
+
+    def search(rank):  # first idx in [start, end) with acc[idx] >= rank
+        lo = jnp.broadcast_to(starts[:, None], rank.shape)
+        hi = jnp.broadcast_to(ends[:, None], rank.shape)
+        steps = max(1, int(n).bit_length() + 1)
+
+        def body(_, lohi):
+            lo, hi = lohi
+            active = lo < hi
+            mid = (lo + hi) >> 1
+            v = jnp.take(acc, jnp.clip(mid, 0, max(n - 1, 0)))
+            adv = v < rank
+            lo = jnp.where(active & adv, mid + 1, lo)
+            hi = jnp.where(active & ~adv, mid, hi)
+            return lo, hi
+
+        lo, _ = jax.lax.fori_loop(0, steps, body, (lo, hi))
+        return lo
+
+    idx_lo = search(lower + 1)
+    idx_hi = search(higher + 1)
+    el_lo = jnp.take(s_vals, jnp.clip(idx_lo, 0, max(n - 1, 0)))
+    el_hi = jnp.take(s_vals, jnp.clip(idx_hi, 0, max(n - 1, 0)))
+
+    same = (higher == lower) | (el_hi == el_lo)
+    lower_part = (higher.astype(jnp.float64) - position) * el_lo
+    higher_part = (position - lower.astype(jnp.float64)) * el_hi
+    out = jnp.where(same, el_lo, lower_part + higher_part)
+    return out, hist_valid

@@ -1,0 +1,212 @@
+"""Calendar rebase + timezone conversion tests.
+
+Rebase oracle: independent Fliegel–Van Flandern JDN formulas (different
+derivation than the kernel's Hinnant-style math).  Timezone oracle: python
+zoneinfo (reads the same IANA data the JVM uses in the reference's
+TimeZoneTest).
+"""
+
+from datetime import datetime, timezone
+from zoneinfo import ZoneInfo
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.columnar import types as T
+from spark_rapids_jni_tpu.columnar.column import Column
+from spark_rapids_jni_tpu.ops.datetime_rebase import (
+    rebase_gregorian_to_julian,
+    rebase_julian_to_gregorian,
+)
+from spark_rapids_jni_tpu.ops.timezones import (
+    TimeZoneDB,
+    convert_timestamp_to_utc,
+    convert_utc_to_timezone,
+)
+
+EPOCH_JDN = 2440588
+MICROS_PER_DAY = 86400 * 10**6
+
+
+# ---------------------------------------------------------------------------
+# oracle: JDN formulas
+# ---------------------------------------------------------------------------
+
+
+def greg_ymd_from_days(days):
+    jdn = days + EPOCH_JDN
+    a = jdn + 32044
+    b = (4 * a + 3) // 146097
+    c = a - 146097 * b // 4
+    d2 = (4 * c + 3) // 1461
+    e = c - 1461 * d2 // 4
+    m2 = (5 * e + 2) // 153
+    day = e - (153 * m2 + 2) // 5 + 1
+    month = m2 + 3 - 12 * (m2 // 10)
+    year = 100 * b + d2 - 4800 + m2 // 10
+    return year, month, day
+
+
+def julian_days_from_ymd(y, m, d):
+    a = (14 - m) // 12
+    y2 = y + 4800 - a
+    m2 = m + 12 * a - 3
+    jdn = d + (153 * m2 + 2) // 5 + 365 * y2 + y2 // 4 - 32083
+    return jdn - EPOCH_JDN
+
+
+def julian_ymd_from_days(days):
+    c = days + EPOCH_JDN + 32082
+    d2 = (4 * c + 3) // 1461
+    e = c - 1461 * d2 // 4
+    m2 = (5 * e + 2) // 153
+    day = e - (153 * m2 + 2) // 5 + 1
+    month = m2 + 3 - 12 * (m2 // 10)
+    year = d2 - 4800 + m2 // 10
+    return year, month, day
+
+
+def greg_days_from_ymd(y, m, d):
+    a = (14 - m) // 12
+    y2 = y + 4800 - a
+    m2 = m + 12 * a - 3
+    jdn = d + (153 * m2 + 2) // 5 + 365 * y2 + y2 // 4 - y2 // 100 + y2 // 400 - 32045
+    return jdn - EPOCH_JDN
+
+
+def oracle_g2j(days):
+    if days >= -141427:
+        return days
+    if days > -141438:
+        return -141427
+    return julian_days_from_ymd(*greg_ymd_from_days(days))
+
+
+def oracle_j2g(days):
+    if days >= -141427:
+        return days
+    return greg_days_from_ymd(*julian_ymd_from_days(days))
+
+
+def dates(vals):
+    return Column.from_pylist(vals, T.DATE)
+
+
+def tss(vals):
+    return Column.from_pylist(vals, T.TIMESTAMP)
+
+
+class TestRebaseDays:
+    def test_anchors(self):
+        # Julian 1582-10-04 == Gregorian 1582-10-14 (same instant):
+        # rebasing the *local date* 1582-10-04 from Gregorian to Julian
+        # yields the day number of Julian 1582-10-04.
+        g_1582_10_04 = greg_days_from_ymd(1582, 10, 4)
+        out = rebase_gregorian_to_julian(dates([g_1582_10_04])).to_pylist()
+        assert out == [greg_days_from_ymd(1582, 10, 14)]
+        # gap dates collapse to 1582-10-15
+        gap = [g_1582_10_04 + i for i in range(1, 11)]
+        out = rebase_gregorian_to_julian(dates(gap)).to_pylist()
+        assert out == [-141427] * 10
+        # modern dates unchanged
+        assert rebase_gregorian_to_julian(dates([0, 19000])).to_pylist() == [0, 19000]
+        assert rebase_julian_to_gregorian(dates([0, -141427])).to_pylist() == [0, -141427]
+
+    def test_random_roundtrip_vs_oracle(self, rng):
+        days = rng.integers(-1_000_000, 100_000, 200).tolist()
+        g2j = rebase_gregorian_to_julian(dates(days)).to_pylist()
+        j2g = rebase_julian_to_gregorian(dates(days)).to_pylist()
+        for i, d in enumerate(days):
+            assert g2j[i] == oracle_g2j(d), d
+            assert j2g[i] == oracle_j2g(d), d
+
+    def test_micros(self, rng):
+        days = rng.integers(-600_000, -141_500, 50).tolist()
+        tods = rng.integers(0, MICROS_PER_DAY, 50).tolist()
+        micros = [d * MICROS_PER_DAY + t for d, t in zip(days, tods)]
+        out = rebase_gregorian_to_julian(tss(micros)).to_pylist()
+        for i in range(50):
+            assert out[i] == oracle_g2j(days[i]) * MICROS_PER_DAY + tods[i]
+        out = rebase_julian_to_gregorian(tss(micros)).to_pylist()
+        for i in range(50):
+            assert out[i] == oracle_j2g(days[i]) * MICROS_PER_DAY + tods[i]
+
+    def test_micros_after_cutover_unchanged(self):
+        vals = [-12219292800000000, 0, 1690000000000000]
+        assert rebase_gregorian_to_julian(tss(vals)).to_pylist() == vals
+        assert rebase_julian_to_gregorian(tss(vals)).to_pylist() == vals
+
+
+# ---------------------------------------------------------------------------
+# timezones
+# ---------------------------------------------------------------------------
+
+
+ZONES = ["Asia/Shanghai", "Asia/Tokyo", "America/Phoenix", "UTC", "+08:00", "-09:30"]
+
+
+def zi_offset_micros(zone_id, utc_micros):
+    if zone_id == "UTC":
+        return 0
+    m = utc_micros
+    dt = datetime.fromtimestamp(m // 10**6, tz=timezone.utc)
+    if zone_id.startswith(("+", "-")):
+        sign = 1 if zone_id[0] == "+" else -1
+        hh, mm = zone_id[1:].split(":")
+        return sign * (int(hh) * 3600 + int(mm) * 60) * 10**6
+    off = ZoneInfo(zone_id).utcoffset(dt)
+    return int(off.total_seconds()) * 10**6
+
+
+class TestTimezones:
+    @pytest.mark.parametrize("zone", ZONES)
+    def test_utc_to_local_vs_zoneinfo(self, zone, rng):
+        db = TimeZoneDB()
+        utc = rng.integers(-2_000_000_000, 2_000_000_000, 100) * 10**6
+        utc = utc + rng.integers(0, 10**6, 100)  # sub-second parts
+        col = tss(utc.tolist())
+        out = convert_utc_to_timezone(col, zone, db).to_pylist()
+        for i, u in enumerate(utc.tolist()):
+            assert out[i] == u + zi_offset_micros(zone, u), (zone, u)
+
+    @pytest.mark.parametrize("zone", ZONES)
+    def test_local_to_utc_roundtrip(self, zone, rng):
+        # sample instants, derive unambiguous local times, convert back
+        db = TimeZoneDB()
+        utc = (rng.integers(-1_000_000_000, 2_000_000_000, 100) * 10**6).tolist()
+        local = [u + zi_offset_micros(zone, u) for u in utc]
+        out = convert_timestamp_to_utc(tss(local), zone, db).to_pylist()
+        mismatch = sum(1 for i in range(100) if out[i] != utc[i])
+        # ambiguous/skipped local times may legitimately resolve to the other
+        # side of a transition; random samples nearly never land there
+        assert mismatch <= 2, f"{zone}: {mismatch} mismatches"
+
+    def test_shanghai_historic_transition(self):
+        # 1940-06-01: Shanghai switched UTC+8 -> UTC+9 (DST gap)
+        db = TimeZoneDB()
+        z = db.zone("Asia/Shanghai")
+        # find the 1940 transition in the parsed table
+        import numpy as np
+
+        i = int(np.searchsorted(z.utc_instants, -934000000))
+        t = int(z.utc_instants[i])
+        off_before = int(z.offsets[i - 1])
+        off_after = int(z.offsets[i])
+        assert off_after != off_before
+        # instants straddling the transition map with the right offsets
+        for u, off in [((t - 10) * 10**6, off_before), ((t + 10) * 10**6, off_after)]:
+            out = convert_utc_to_timezone(tss([u]), "Asia/Shanghai", db).to_pylist()
+            assert out[0] == u + off * 10**6
+
+    def test_unsupported_zone_raises(self):
+        db = TimeZoneDB()
+        assert not db.is_supported("America/New_York")  # recurring DST rules
+        with pytest.raises(ValueError):
+            convert_timestamp_to_utc(tss([0]), "America/New_York", db)
+
+    def test_fixed_offset_formats(self):
+        db = TimeZoneDB()
+        # Spark pre-3.0 single-digit forms normalize
+        assert db.is_supported("+8:00")
+        out = convert_utc_to_timezone(tss([0]), "+8:00", db).to_pylist()
+        assert out == [8 * 3600 * 10**6]

@@ -1,0 +1,93 @@
+"""Histogram percentile vs the direct expanded-array definition."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.columnar import types as T
+from spark_rapids_jni_tpu.columnar.column import Column
+from spark_rapids_jni_tpu.ops.histogram import (
+    create_histogram_if_valid,
+    percentile_from_histogram,
+)
+
+
+def oracle_percentile(pairs, pct):
+    """pairs: [(value, freq)] with None values dropped; Spark percentile
+    definition: sort, expand by frequency, interpolate at (N-1)*pct."""
+    expanded = []
+    for v, f in sorted((p for p in pairs if p[0] is not None)):
+        expanded.extend([v] * f)
+    if not expanded:
+        return None
+    pos = (len(expanded) - 1) * pct
+    lo, hi = int(np.floor(pos)), int(np.ceil(pos))
+    if lo == hi:
+        return float(expanded[lo])
+    return (hi - pos) * expanded[lo] + (pos - lo) * expanded[hi]
+
+
+def build(hists, dtype=T.INT64):
+    """hists: list of [(value|None, freq)] -> (values, freqs, offsets)."""
+    values, freqs, offsets = [], [], [0]
+    for h in hists:
+        for v, f in h:
+            values.append(v)
+            freqs.append(f)
+        offsets.append(len(values))
+    v, f = create_histogram_if_valid(
+        Column.from_pylist(values, dtype),
+        Column.from_pylist(freqs, T.INT64),
+    )
+    return v, f, np.array(offsets, np.int32)
+
+
+class TestPercentileFromHistogram:
+    def test_basic_median(self):
+        v, f, off = build([[(1, 2), (2, 1), (3, 1)]])
+        out, valid = percentile_from_histogram(v, f, off, [0.5])
+        # expanded: 1 1 2 3 -> median (pos 1.5) = 1.5
+        assert bool(valid[0])
+        assert float(out[0, 0]) == pytest.approx(1.5)
+
+    def test_multiple_histograms_and_pcts(self, rng):
+        hists = []
+        for _ in range(20):
+            k = int(rng.integers(0, 6))
+            h = [
+                (
+                    None if rng.random() < 0.15 else int(rng.integers(-50, 50)),
+                    int(rng.integers(1, 5)),
+                )
+                for _ in range(k)
+            ]
+            hists.append(h)
+        pcts = [0.0, 0.1, 0.5, 0.9, 1.0]
+        v, f, off = build(hists)
+        out, valid = percentile_from_histogram(v, f, off, pcts)
+        for h_i, h in enumerate(hists):
+            for p_i, p in enumerate(pcts):
+                exp = oracle_percentile(h, p)
+                if exp is None:
+                    assert not bool(valid[h_i])
+                else:
+                    assert bool(valid[h_i])
+                    assert float(out[h_i, p_i]) == pytest.approx(exp), (h, p)
+
+    def test_zero_freq_dropped(self):
+        v, f, off = build([[(1, 0), (5, 2), (9, 2)]])
+        out, valid = percentile_from_histogram(v, f, off, [0.0, 1.0])
+        assert float(out[0, 0]) == 5.0 and float(out[0, 1]) == 9.0
+
+    def test_negative_freq_raises(self):
+        with pytest.raises(ValueError):
+            create_histogram_if_valid(
+                Column.from_pylist([1], T.INT64),
+                Column.from_pylist([-1], T.INT64),
+            )
+
+    def test_double_values(self, rng):
+        hists = [[(float(rng.normal()), int(rng.integers(1, 4))) for _ in range(5)]]
+        v, f, off = build(hists, T.FLOAT64)
+        out, valid = percentile_from_histogram(v, f, off, [0.25, 0.75])
+        for p_i, p in enumerate([0.25, 0.75]):
+            assert float(out[0, p_i]) == pytest.approx(oracle_percentile(hists[0], p))
