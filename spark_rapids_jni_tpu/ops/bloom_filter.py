@@ -98,6 +98,9 @@ def bloom_filter_build(
 
 def bloom_filter_merge(filters: Sequence[BloomFilter]) -> BloomFilter:
     """Bitwise OR (reference bloom_filter_merge, bloom_filter.cu:277)."""
+    filters = list(filters)
+    if not filters:
+        raise ValueError("bloom_filter_merge requires at least one filter")
     first = filters[0]
     for f in filters[1:]:
         if (f.num_hashes, f.num_longs) != (first.num_hashes, first.num_longs):
@@ -138,9 +141,20 @@ def bloom_filter_serialize(bf: BloomFilter) -> bytes:
 
 
 def bloom_filter_deserialize(buf: bytes) -> BloomFilter:
+    if len(buf) < 12:
+        raise ValueError("bloom filter buffer too short for header")
     version, num_hashes, num_longs = struct.unpack(">iii", buf[:12])
     if version != SPARK_BLOOM_FILTER_VERSION:
         raise ValueError(f"unsupported bloom filter version {version}")
+    if num_hashes <= 0 or num_longs <= 0:
+        raise ValueError(
+            f"corrupt bloom filter header: num_hashes={num_hashes} "
+            f"num_longs={num_longs}"
+        )
+    if len(buf) < 12 + num_longs * 8:
+        raise ValueError(
+            f"bloom filter buffer truncated: header claims {num_longs} longs"
+        )
     payload = np.frombuffer(buf[12 : 12 + num_longs * 8], dtype=np.uint8)
     bits = (payload[:, None] >> np.arange(8)[None, :]) & 1
     return BloomFilter(

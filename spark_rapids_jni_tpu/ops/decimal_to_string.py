@@ -1,0 +1,158 @@
+"""decimal -> string, Java ``BigDecimal.toString`` rules (non-ANSI).
+
+Reference: ``cast_decimal_to_string.cu:211`` (``decimal_to_non_ansi_string``).
+With Spark scale s and digit count D, adjusted exponent a = D - 1 - s:
+
+* s == 0: plain integer.
+* s > 0 and a >= -6: ``[-]integer.fraction`` (fraction zero-padded to s).
+* otherwise (negative scale or a < -6): scientific ``d[.frac]E±a``.
+
+128-bit digit extraction: base-2^32 schoolbook division by 10^9 (each step
+is u64 lane math), five passes -> base-1e9 groups -> per-group digit
+unpack.  No 256-bit loops needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import types as T
+from ..columnar.column import Column, Decimal128Column, StringColumn
+
+_M32 = jnp.uint64(0xFFFFFFFF)
+_BILLION = jnp.uint64(10**9)
+_MAX_DIGITS = 45  # 5 groups of 9 (2^128 has 39 decimal digits)
+_WIDTH = 88
+
+
+def _u128_digits(lo, hi):
+    """|value| digit matrix [n, 45] MSB-first + digit count (>= 1)."""
+    limbs = [lo & _M32, lo >> 32, hi & _M32, hi >> 32]
+    groups = []
+    for _ in range(5):
+        rem = jnp.zeros_like(lo)
+        new = [None] * 4
+        for i in range(3, -1, -1):
+            cur = (rem << jnp.uint64(32)) | limbs[i]
+            new[i] = cur // _BILLION
+            rem = cur % _BILLION
+        groups.append(rem)  # least-significant group first
+        limbs = new
+    digs = []
+    for g in groups:
+        x = g
+        for _ in range(9):
+            digs.append((x % jnp.uint64(10)).astype(jnp.int32))
+            x = x // jnp.uint64(10)
+    dig_lsb = jnp.stack(digs, axis=1)  # [n, 45] least-significant first
+    nonzero = dig_lsb != 0
+    k = jnp.arange(_MAX_DIGITS)[None, :]
+    ndigits = jnp.maximum(
+        jnp.max(jnp.where(nonzero, k + 1, 0), axis=1), 1
+    ).astype(jnp.int32)
+    # MSB-first view
+    idx = ndigits[:, None] - 1 - k
+    dig = jnp.where(
+        k < ndigits[:, None],
+        jnp.take_along_axis(dig_lsb, jnp.clip(idx, 0, _MAX_DIGITS - 1), axis=1),
+        0,
+    )
+    return dig, ndigits
+
+
+def decimal_to_string(col: Decimal128Column) -> StringColumn:
+    """Spark CAST(decimal AS STRING), non-ANSI (reference
+    cast_decimal_to_string.cu:211)."""
+    s = col.scale
+    limbs = col.limbs
+    neg = (limbs[:, 1] >> jnp.uint64(63)) != 0
+    # two's-complement abs: ~x + 1, carry into hi exactly when lo was 0
+    lo0, hi0 = limbs[:, 0], limbs[:, 1]
+    lo = jnp.where(neg, ~lo0 + jnp.uint64(1), lo0)
+    hi = jnp.where(neg, ~hi0 + (lo0 == 0).astype(jnp.uint64), hi0)
+
+    dig, nd = _u128_digits(lo, hi)
+    n = limbs.shape[0]
+    adjusted = nd - 1 - s
+
+    j = jnp.arange(_WIDTH, dtype=jnp.int32)[None, :]
+    sign_len = neg.astype(jnp.int32)[:, None]
+    p = j - sign_len
+    out = jnp.full((n, _WIDTH), ord(" "), jnp.int32)
+    out = jnp.where((j == 0) & neg[:, None], ord("-"), out)
+
+    def dig_at(q):
+        return jnp.take_along_axis(dig, jnp.clip(q, 0, _MAX_DIGITS - 1), axis=1)
+
+    plain = (s >= 0) & (adjusted >= -6)
+    if s == 0:
+        m = (p >= 0) & (p < nd[:, None])
+        out = jnp.where(m, ord("0") + dig_at(p), out)
+        length = sign_len[:, 0] + nd
+        chars = out.astype(jnp.uint8)
+        chars = jnp.where(j < length[:, None], chars, jnp.uint8(0))
+        return StringColumn(chars, length * col.validity, col.validity)
+
+    plain_m = plain[:, None]
+    if s > 0:
+        # ---- plain layout: int part (nd - s digits, or "0") . frac ------
+        ip_digits = jnp.maximum(nd - s, 0)
+        ip_len = jnp.maximum(ip_digits, 1)  # "0" when value < 1
+        m_int = plain_m & (p >= 0) & (p < ip_len[:, None])
+        int_char = jnp.where(
+            ip_digits[:, None] == 0, ord("0"), ord("0") + dig_at(p)
+        )
+        out = jnp.where(m_int, int_char, out)
+        out = jnp.where(plain_m & (p == ip_len[:, None]), ord("."), out)
+        # fraction: s chars = zero padding (when nd < s) then trailing digits
+        fpos = p - ip_len[:, None] - 1
+        pad = (s - jnp.minimum(nd, s))[:, None]
+        fchar = jnp.where(
+            fpos < pad,
+            ord("0"),
+            ord("0") + dig_at(ip_digits[:, None] + fpos - pad),
+        )
+        m_frac = plain_m & (fpos >= 0) & (fpos < s)
+        out = jnp.where(m_frac, fchar, out)
+        len_plain = sign_len[:, 0] + ip_len + 1 + s
+    else:
+        len_plain = jnp.zeros((n,), jnp.int32)
+
+    # ---- scientific: d[.frac]E±adj --------------------------------------
+    msci = ~plain_m
+    has_frac = nd > 1
+    out = jnp.where(msci & (p == 0), ord("0") + dig[:, 0:1], out)
+    out = jnp.where(msci & has_frac[:, None] & (p == 1), ord("."), out)
+    spos = p - 2
+    m_sf = msci & has_frac[:, None] & (spos >= 0) & (spos < (nd - 1)[:, None])
+    out = jnp.where(m_sf, ord("0") + dig_at(1 + spos), out)
+    e_at = jnp.where(has_frac, nd + 1, 1)[:, None]
+    out = jnp.where(msci & (p == e_at), ord("E"), out)
+    out = jnp.where(
+        msci & (p == e_at + 1),
+        jnp.where((adjusted < 0)[:, None], ord("-"), ord("+")),
+        out,
+    )
+    absA = jnp.abs(adjusted)[:, None]
+    a_len = 1 + (absA >= 10)  # |adjusted| < 45 + 38 < 100
+    a_digs = jnp.concatenate([absA // 10 % 10, absA % 10], axis=1)
+    ap = p - e_at - 2
+    m_a = msci & (ap >= 0) & (ap < a_len)
+    out = jnp.where(
+        m_a,
+        ord("0") + jnp.take_along_axis(a_digs, jnp.clip(2 - a_len + ap, 0, 1), axis=1),
+        out,
+    )
+    len_sci = (
+        sign_len[:, 0]
+        + jnp.where(has_frac, nd + 1, 1)
+        + 2
+        + a_len[:, 0]
+    )
+
+    length = jnp.where(plain, len_plain, len_sci)
+    chars = out.astype(jnp.uint8)
+    chars = jnp.where(j < length[:, None], chars, jnp.uint8(0))
+    return StringColumn(chars, length * col.validity, col.validity)

@@ -1,0 +1,552 @@
+"""Float/double -> string matching Java ``Double.toString`` semantics.
+
+The reference ports Ryu (shortest round-trip decimal) to CUDA
+(``ftos_converter.cuh``: ``floating_decimal_64/32``, d2s tables) and
+formats per Java rules (``cast_float_to_string.cu:110``): plain decimal
+for 1e-3 <= |v| < 1e7, otherwise ``d.dddE±x``; always at least one
+fractional digit; NaN -> "NaN", infinities -> "[-]Infinity", zeros ->
+"[-]0.0".
+
+This is an independent vectorized implementation of the published Ryu
+algorithm (Ulf Adams, "Ryū: fast float-to-string conversion", PLDI 2018):
+
+* the 125-bit power-of-five tables are *computed* at import time from
+  python bigints (not copied), one ``uint64`` pair per entry;
+* the 64x128-bit ``mulShift`` runs on 32-bit limb products in uint64
+  lanes (TPU-friendly: every op is a vector op; 64-bit ints are XLA
+  uint32-pair emulation);
+* Ryu's variable-length digit-removal loops become one fixed-trip masked
+  loop (<= 20 iterations — the max removable digits for binary64), the
+  standard TPU rewrite for data-dependent while loops.
+
+String assembly builds a ``uint8[n, 26]`` char matrix from the digit
+array with positional ``where`` cascades — no scatters.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import types as T
+from ..columnar.column import Column, StringColumn
+
+# ---------------------------------------------------------------------------
+# tables (computed, 125-bit double / 59-61-bit float splits)
+# ---------------------------------------------------------------------------
+
+_DOUBLE_POW5_INV_BITCOUNT = 125
+_DOUBLE_POW5_BITCOUNT = 125
+_FLOAT_POW5_INV_BITCOUNT = 59
+_FLOAT_POW5_BITCOUNT = 61
+
+
+def _pow5bits(e: int) -> int:
+    return ((e * 1217359) >> 19) + 1
+
+
+def _build_double_tables():
+    inv = np.zeros((342, 2), np.uint64)
+    for q in range(342):
+        pow5 = 5**q
+        inv_val = (1 << (_pow5bits(q) - 1 + _DOUBLE_POW5_INV_BITCOUNT)) // pow5 + 1
+        inv[q, 0] = inv_val & 0xFFFFFFFFFFFFFFFF
+        inv[q, 1] = inv_val >> 64
+    split = np.zeros((326, 2), np.uint64)
+    for i in range(326):
+        s = _pow5bits(i) - _DOUBLE_POW5_BITCOUNT
+        val = 5**i >> s if s > 0 else 5**i << -s  # normalize to 125 bits
+        split[i, 0] = val & 0xFFFFFFFFFFFFFFFF
+        split[i, 1] = val >> 64
+    return inv, split
+
+
+def _build_float_tables():
+    inv = np.zeros((31,), np.uint64)
+    for q in range(31):
+        inv[q] = (1 << (_pow5bits(q) - 1 + _FLOAT_POW5_INV_BITCOUNT)) // 5**q + 1
+    split = np.zeros((48,), np.uint64)
+    for i in range(48):
+        s = _pow5bits(i) - _FLOAT_POW5_BITCOUNT
+        split[i] = 5**i >> s if s > 0 else 5**i << -s
+    return inv, split
+
+
+_D_INV, _D_SPLIT = _build_double_tables()
+_F_INV, _F_SPLIT = _build_float_tables()
+
+_U64 = jnp.uint64
+
+
+def _log10pow2(e):
+    return (e * 78913) >> 18  # floor(e * log10(2)), e in [0, 1650]
+
+
+def _log10pow5(e):
+    return (e * 732923) >> 20  # floor(e * log10(5))
+
+
+def _pow5bits_arr(e):
+    return ((e * 1217359) >> 19) + 1
+
+
+def _umul64_128(a, b):
+    """uint64 * uint64 -> (hi, lo) via 32-bit limb products."""
+    a_lo = a & _U64(0xFFFFFFFF)
+    a_hi = a >> _U64(32)
+    b_lo = b & _U64(0xFFFFFFFF)
+    b_hi = b >> _U64(32)
+    ll = a_lo * b_lo
+    lh = a_lo * b_hi
+    hl = a_hi * b_lo
+    hh = a_hi * b_hi
+    mid = (ll >> _U64(32)) + (lh & _U64(0xFFFFFFFF)) + (hl & _U64(0xFFFFFFFF))
+    lo = (ll & _U64(0xFFFFFFFF)) | (mid << _U64(32))
+    hi = hh + (lh >> _U64(32)) + (hl >> _U64(32)) + (mid >> _U64(32))
+    return hi, lo
+
+
+def _shr128(hi, lo, s):
+    """(hi:lo) >> s for per-row s in [1, 127] with result < 2**64."""
+    s = s.astype(jnp.uint64)
+    lt64 = s < _U64(64)
+    s_lo = jnp.where(lt64, s, _U64(0))
+    s_hi = jnp.where(lt64, _U64(0), s - _U64(64))
+    lo_part = (lo >> s_lo) | jnp.where(
+        (s_lo > 0), hi << (_U64(64) - s_lo), _U64(0)
+    )
+    return jnp.where(lt64, lo_part, hi >> s_hi)
+
+
+def _mul_shift_64(m, mul_lo, mul_hi, j):
+    """(m * (mul_hi:mul_lo)) >> j, j in (64, 191), result < 2**64."""
+    hi1, lo1 = _umul64_128(m, mul_lo)
+    hi2, lo2 = _umul64_128(m, mul_hi)
+    # sum = (hi2:lo2) << 64 + (hi1:lo1); only bits >= 64 matter after >> j
+    mid = hi1 + lo2
+    carry = (mid < hi1).astype(jnp.uint64)
+    top = hi2 + carry
+    return _shr128(top, mid, j - 64)
+
+
+def _pow5_factor_ge(value, p, max_iter):
+    """value divisible by 5**p (p <= max_iter)?  Fixed-trip factor count."""
+    count = jnp.zeros_like(value, dtype=jnp.int32)
+    v = value
+    for _ in range(max_iter):
+        div = v % _U64(5) == 0
+        v = jnp.where(div, v // _U64(5), v)
+        count = count + div.astype(jnp.int32)
+    return count >= p
+
+
+def _d2d(bits):
+    """Core Ryu shortest-decimal for binary64 (vectorized).
+
+    bits: uint64[n] (finite, nonzero).  Returns (digits u64, exp10 i32).
+    """
+    m = bits & _U64((1 << 52) - 1)
+    e = ((bits >> _U64(52)) & _U64(0x7FF)).astype(jnp.int32)
+
+    is_sub = e == 0
+    e2 = jnp.where(is_sub, 1, e) - 1075 - 2
+    m2 = jnp.where(is_sub, m, m | _U64(1 << 52))
+
+    even = (m2 & _U64(1)) == 0
+    accept = even
+    mv = m2 * _U64(4)
+    mm_shift = ((m != 0) | (e <= 1)).astype(jnp.uint64)
+
+    pos = e2 >= 0
+    # ---- e2 >= 0 branch ------------------------------------------------
+    q_p = jnp.maximum(_log10pow2(jnp.maximum(e2, 0)) - (e2 > 3), 0)
+    k_p = _DOUBLE_POW5_INV_BITCOUNT + _pow5bits_arr(q_p) - 1
+    i_p = -e2 + q_p + k_p
+    inv = jnp.asarray(_D_INV)
+    mul_lo_p = jnp.take(inv[:, 0], jnp.clip(q_p, 0, 341))
+    mul_hi_p = jnp.take(inv[:, 1], jnp.clip(q_p, 0, 341))
+    # ---- e2 < 0 branch -------------------------------------------------
+    ne2 = jnp.maximum(-e2, 0)
+    q_n = jnp.maximum(_log10pow5(ne2) - (ne2 > 1), 0)
+    i_n = ne2 - q_n
+    k_n = _pow5bits_arr(i_n) - _DOUBLE_POW5_BITCOUNT
+    j_n = q_n - k_n
+    spl = jnp.asarray(_D_SPLIT)
+    mul_lo_n = jnp.take(spl[:, 0], jnp.clip(i_n, 0, 325))
+    mul_hi_n = jnp.take(spl[:, 1], jnp.clip(i_n, 0, 325))
+
+    e10 = jnp.where(pos, q_p, q_n + e2)
+    mul_lo = jnp.where(pos, mul_lo_p, mul_lo_n)
+    mul_hi = jnp.where(pos, mul_hi_p, mul_hi_n)
+    j = jnp.where(pos, i_p, j_n)
+
+    vr = _mul_shift_64(mv, mul_lo, mul_hi, j)
+    vp = _mul_shift_64(mv + _U64(2), mul_lo, mul_hi, j)
+    vm = _mul_shift_64(mv - _U64(1) - mm_shift, mul_lo, mul_hi, j)
+
+    # trailing-zero tracking
+    q = jnp.where(pos, q_p, q_n)
+    vr_tz = jnp.zeros_like(even)
+    vm_tz = jnp.zeros_like(even)
+    # e2 >= 0, q <= 21 cases
+    c_p = pos & (q_p <= 21)
+    mv_mod5 = (mv % _U64(5)) == 0
+    vr_tz = jnp.where(c_p & mv_mod5, _pow5_factor_ge(mv, q_p, 22), vr_tz)
+    vm_tz = jnp.where(
+        c_p & ~mv_mod5 & accept,
+        _pow5_factor_ge(mv - _U64(1) - mm_shift, q_p, 22),
+        vm_tz,
+    )
+    vp = jnp.where(
+        c_p & ~mv_mod5 & ~accept,
+        vp - _pow5_factor_ge(mv + _U64(2), q_p, 22).astype(jnp.uint64),
+        vp,
+    )
+    # e2 < 0, q <= 1: vr trailing; vm trailing iff mm_shift == 1
+    c_n1 = ~pos & (q_n <= 1)
+    vr_tz = jnp.where(c_n1, True, vr_tz)
+    vm_tz = jnp.where(c_n1 & accept, mm_shift == _U64(1), vm_tz)
+    vp = jnp.where(c_n1 & ~accept, vp - _U64(1), vp)
+    # e2 < 0, q < 63: vr_tz = multipleOfPowerOf2(mv, q)
+    c_n2 = ~pos & (q_n > 1) & (q_n < 63)
+    mask_q = (_U64(1) << q.astype(jnp.uint64)) - _U64(1)
+    vr_tz = jnp.where(c_n2, (mv & mask_q) == _U64(0), vr_tz)
+
+    # ---- digit removal (fixed-trip masked loop) ------------------------
+    removed = jnp.zeros(bits.shape, jnp.int32)
+    last_removed = jnp.zeros(bits.shape, jnp.uint64)
+
+    def body(_, st):
+        vr, vp, vm, vr_tz, vm_tz, removed, last_removed = st
+        cond_main = (vp // _U64(10)) > (vm // _U64(10))
+        vm_mod = vm % _U64(10)
+        cond_extra = ~cond_main & vm_tz & (vm_mod == 0)
+        active = cond_main | cond_extra
+        vm_tz_new = vm_tz & (vm_mod == 0)
+        vr_tz_new = vr_tz & (last_removed == 0)
+        lr_new = vr % _U64(10)
+        vr_n = vr // _U64(10)
+        vp_n = vp // _U64(10)
+        vm_n = vm // _U64(10)
+        return (
+            jnp.where(active, vr_n, vr),
+            jnp.where(active, vp_n, vp),
+            jnp.where(active, vm_n, vm),
+            jnp.where(active, vr_tz_new, vr_tz),
+            jnp.where(active, vm_tz_new, vm_tz),
+            removed + active.astype(jnp.int32),
+            jnp.where(active, lr_new, last_removed),
+        )
+
+    vr, vp, vm, vr_tz, vm_tz, removed, last_removed = jax.lax.fori_loop(
+        0, 20, body, (vr, vp, vm, vr_tz, vm_tz, removed, last_removed)
+    )
+
+    last_removed = jnp.where(
+        vr_tz & (last_removed == 5) & (vr % _U64(2) == 0),
+        _U64(4),
+        last_removed,
+    )
+    round_up = ((vr == vm) & (~accept | ~vm_tz)) | (last_removed >= 5)
+    output = vr + round_up.astype(jnp.uint64)
+    return output, e10 + removed
+
+
+def _f2d(bits32):
+    """Core Ryu for binary32 (vectorized, 64-bit arithmetic suffices)."""
+    bits = bits32.astype(jnp.uint32)
+    m = (bits & jnp.uint32((1 << 23) - 1)).astype(jnp.uint64)
+    e = ((bits >> 23) & jnp.uint32(0xFF)).astype(jnp.int32)
+
+    is_sub = e == 0
+    e2 = jnp.where(is_sub, 1, e) - 150 - 2
+    m2 = jnp.where(is_sub, m, m | _U64(1 << 23))
+
+    even = (m2 & _U64(1)) == 0
+    accept = even
+    mv = m2 * _U64(4)
+    mm_shift = ((m != 0) | (e <= 1)).astype(jnp.uint64)
+
+    def mul_shift_32(mx, factor, shift):
+        # (mx * factor) >> shift; mx < 2**26, factor < 2**64, shift > 32
+        f_lo = factor & _U64(0xFFFFFFFF)
+        f_hi = factor >> _U64(32)
+        lo = mx * f_lo
+        hi = mx * f_hi
+        sum_ = (lo >> _U64(32)) + hi
+        return sum_ >> (shift.astype(jnp.uint64) - _U64(32))
+
+    pos = e2 >= 0
+    q_p = _log10pow2(jnp.maximum(e2, 0))
+    k_p = _FLOAT_POW5_INV_BITCOUNT + _pow5bits_arr(q_p) - 1
+    i_p = -e2 + q_p + k_p
+    inv = jnp.asarray(_F_INV)
+    fac_p = jnp.take(inv, jnp.clip(q_p, 0, 30))
+
+    ne2 = jnp.maximum(-e2, 0)
+    q_n = _log10pow5(ne2)
+    i_n = ne2 - q_n
+    k_n = _pow5bits_arr(i_n) - _FLOAT_POW5_BITCOUNT
+    j_n = q_n - k_n
+    spl = jnp.asarray(_F_SPLIT)
+    fac_n = jnp.take(spl, jnp.clip(i_n, 0, 47))
+
+    e10 = jnp.where(pos, q_p, q_n + e2)
+    factor = jnp.where(pos, fac_p, fac_n)
+    j = jnp.where(pos, i_p, j_n)
+
+    vr = mul_shift_32(mv, factor, j)
+    vp = mul_shift_32(mv + _U64(2), factor, j)
+    vm = mul_shift_32(mv - _U64(1) - mm_shift, factor, j)
+
+    q = jnp.where(pos, q_p, q_n)
+    vr_tz = jnp.zeros_like(even)
+    vm_tz = jnp.zeros_like(even)
+
+    # f2s pre-step: when the loop below may remove no digit, the rounding
+    # digit comes from one extra decimal of precision (f2s.c q != 0 case)
+    c_pre = (q != 0) & (((vp - _U64(1)) // _U64(10)) <= vm // _U64(10))
+    # pos: mulPow5InvDivPow2(mv, q-1, -e2 + (q-1) + l), l from q-1
+    qm1 = jnp.maximum(q_p - 1, 0)
+    l_p = _FLOAT_POW5_INV_BITCOUNT + _pow5bits_arr(qm1) - 1
+    fac_pre_p = jnp.take(inv, jnp.clip(qm1, 0, 30))
+    j_pre_p = -e2 + qm1 + l_p
+    lr_p = mul_shift_32(mv, fac_pre_p, jnp.maximum(j_pre_p, 33)) % _U64(10)
+    # neg: mulPow5divPow2(mv, i+1, q - 1 - (pow5bits(i+1) - BITCOUNT))
+    i1 = i_n + 1
+    fac_pre_n = jnp.take(spl, jnp.clip(i1, 0, 47))
+    j_pre_n = q_n - 1 - (_pow5bits_arr(i1) - _FLOAT_POW5_BITCOUNT)
+    lr_n = mul_shift_32(mv, fac_pre_n, jnp.maximum(j_pre_n, 33)) % _U64(10)
+    last_removed = jnp.where(
+        c_pre, jnp.where(pos, lr_p, lr_n), _U64(0)
+    )
+
+    c_p = pos & (q_p <= 9)
+    mv_mod5 = (mv % _U64(5)) == 0
+    vr_tz = jnp.where(c_p & mv_mod5, _pow5_factor_ge(mv, q_p, 11), vr_tz)
+    vm_tz = jnp.where(
+        c_p & ~mv_mod5 & accept,
+        _pow5_factor_ge(mv - _U64(1) - mm_shift, q_p, 11),
+        vm_tz,
+    )
+    vp = jnp.where(
+        c_p & ~mv_mod5 & ~accept,
+        vp - _pow5_factor_ge(mv + _U64(2), q_p, 11).astype(jnp.uint64),
+        vp,
+    )
+    c_n1 = ~pos & (q_n <= 1)
+    vr_tz = jnp.where(c_n1, True, vr_tz)
+    vm_tz = jnp.where(c_n1 & accept, mm_shift == _U64(1), vm_tz)
+    vp = jnp.where(c_n1 & ~accept, vp - _U64(1), vp)
+    c_n2 = ~pos & (q_n > 1) & (q_n < 31)
+    mask_q = (_U64(1) << jnp.maximum(q - 1, 0).astype(jnp.uint64)) - _U64(1)
+    vr_tz = jnp.where(c_n2, (mv & mask_q) == _U64(0), vr_tz)
+
+    removed = jnp.zeros(bits.shape, jnp.int32)
+
+    def body(_, st):
+        vr, vp, vm, vr_tz, vm_tz, removed, last_removed = st
+        cond_main = (vp // _U64(10)) > (vm // _U64(10))
+        vm_mod = vm % _U64(10)
+        cond_extra = ~cond_main & vm_tz & (vm_mod == 0)
+        active = cond_main | cond_extra
+        vm_tz_new = vm_tz & (vm_mod == 0)
+        vr_tz_new = vr_tz & (last_removed == 0)
+        lr_new = vr % _U64(10)
+        return (
+            jnp.where(active, vr // _U64(10), vr),
+            jnp.where(active, vp // _U64(10), vp),
+            jnp.where(active, vm // _U64(10), vm),
+            jnp.where(active, vr_tz_new, vr_tz),
+            jnp.where(active, vm_tz_new, vm_tz),
+            removed + active.astype(jnp.int32),
+            jnp.where(active, lr_new, last_removed),
+        )
+
+    vr, vp, vm, vr_tz, vm_tz, removed, last_removed = jax.lax.fori_loop(
+        0, 11, body, (vr, vp, vm, vr_tz, vm_tz, removed, last_removed)
+    )
+
+    last_removed = jnp.where(
+        vr_tz & (last_removed == 5) & (vr % _U64(2) == 0), _U64(4), last_removed
+    )
+    round_up = ((vr == vm) & (~accept | ~vm_tz)) | (last_removed >= 5)
+    output = vr + round_up.astype(jnp.uint64)
+    return output, e10 + removed
+
+
+# ---------------------------------------------------------------------------
+# Java-style formatting
+# ---------------------------------------------------------------------------
+
+_MAX_CHARS = 26
+
+
+def _digit_count(v):
+    count = jnp.ones(v.shape, jnp.int32)
+    x = v
+    for _ in range(19):
+        x = x // _U64(10)
+        count = count + (x > 0).astype(jnp.int32)
+    return count
+
+
+def _format(digits, exp10, negative, is_nan, is_inf, is_zero):
+    """Assemble Java toString chars: digits u64[n], exp10 = power of the
+    LAST digit; value = digits * 10^exp10."""
+    n = digits.shape[0]
+    olength = _digit_count(digits)
+    # E = exponent of the leading digit
+    E = exp10 + olength - 1
+    plain = (E >= -3) & (E < 7)
+
+    # digit characters MSB-first: dig[k] = k-th most significant digit
+    digs = []
+    x = digits
+    for _ in range(17):
+        digs.append((x % _U64(10)).astype(jnp.uint8))
+        x = x // _U64(10)
+    dig_rev = jnp.stack(digs, axis=1)  # [n, 17] LSB-first
+    kk = jnp.arange(17)[None, :]
+    msb_idx = olength[:, None] - 1 - kk  # index into dig_rev for MSB-first
+    dig = jnp.take_along_axis(dig_rev, jnp.clip(msb_idx, 0, 16), axis=1)
+    dig = jnp.where(kk < olength[:, None], dig, 0).astype(jnp.int32)
+
+    j = jnp.arange(_MAX_CHARS)[None, :]
+    sign_len = negative.astype(jnp.int32)[:, None]
+    out = jnp.full((n, _MAX_CHARS), ord(" "), jnp.int32)
+
+    def put(out, pos_mask, ch):
+        return jnp.where(pos_mask, ch, out)
+
+    out = put(out, (j == 0) & negative[:, None], ord("-"))
+    p = j - sign_len  # position net of sign
+
+    # ---------- plain, E >= 0: digits[0..E] '.' frac ----------
+    ip_len = E[:, None] + 1  # integer digits
+    has_frac = olength[:, None] > ip_len
+    frac_len = jnp.maximum(olength[:, None] - ip_len, 1)
+    m_int = plain[:, None] & (E >= 0)[:, None] & (p >= 0) & (p < ip_len)
+    out = put(out, m_int, ord("0") + jnp.take_along_axis(
+        dig, jnp.clip(p, 0, 16), axis=1))
+    m_dot = plain[:, None] & (E >= 0)[:, None] & (p == ip_len)
+    out = put(out, m_dot, ord("."))
+    fpos = p - ip_len - 1
+    m_frac = plain[:, None] & (E >= 0)[:, None] & (fpos >= 0) & (fpos < frac_len)
+    fdig = jnp.where(
+        has_frac,
+        jnp.take_along_axis(dig, jnp.clip(ip_len + fpos, 0, 16), axis=1),
+        0,
+    )
+    out = put(out, m_frac, ord("0") + fdig)
+    len_plain_pos = sign_len + ip_len + 1 + frac_len
+
+    # ---------- plain, E < 0: "0." zeros digits ----------
+    zeros = (-E[:, None]) - 1
+    m0 = plain[:, None] & (E < 0)[:, None]
+    out = put(out, m0 & (p == 0), ord("0"))
+    out = put(out, m0 & (p == 1), ord("."))
+    out = put(out, m0 & (p >= 2) & (p < 2 + zeros), ord("0"))
+    dpos = p - 2 - zeros
+    m_d = m0 & (dpos >= 0) & (dpos < olength[:, None])
+    out = put(out, m_d, ord("0") + jnp.take_along_axis(
+        dig, jnp.clip(dpos, 0, 16), axis=1))
+    len_plain_neg = sign_len + 2 + zeros + olength[:, None]
+
+    # ---------- scientific: d '.' frac 'E' [-] expdigits ----------
+    msci = (~plain)[:, None]
+    out = put(out, msci & (p == 0), ord("0") + dig[:, 0:1])
+    out = put(out, msci & (p == 1), ord("."))
+    sfrac_len = jnp.maximum(olength[:, None] - 1, 1)
+    spos = p - 2
+    sdig = jnp.where(
+        olength[:, None] > 1,
+        jnp.take_along_axis(dig, jnp.clip(1 + spos, 0, 16), axis=1),
+        0,
+    )
+    out = put(out, msci & (spos >= 0) & (spos < sfrac_len), ord("0") + sdig)
+    epos0 = 2 + sfrac_len
+    out = put(out, msci & (p == epos0), ord("E"))
+    eneg = (E < 0)[:, None]
+    out = put(out, msci & eneg & (p == epos0 + 1), ord("-"))
+    absE = jnp.abs(E)[:, None]
+    e_len = 1 + (absE >= 10) + (absE >= 100)
+    e_start = epos0 + 1 + eneg.astype(jnp.int32)
+    ep = p - e_start
+    e_digs = jnp.concatenate(
+        [absE // 100 % 10, absE // 10 % 10, absE % 10], axis=1
+    )  # [n,3] MSB-first (padded)
+    e_idx = 3 - e_len + ep
+    m_e = msci & (ep >= 0) & (ep < e_len)
+    out = put(out, m_e, ord("0") + jnp.take_along_axis(
+        e_digs, jnp.clip(e_idx, 0, 2), axis=1))
+    len_sci = sign_len + 2 + sfrac_len + 1 + eneg.astype(jnp.int32) + e_len
+
+    length = jnp.where(
+        plain[:, None] & (E >= 0)[:, None],
+        len_plain_pos,
+        jnp.where(plain[:, None], len_plain_neg, len_sci),
+    )[:, 0]
+
+    # ---------- specials ----------
+    chars = out.astype(jnp.uint8)
+    length = length.astype(jnp.int32)
+
+    def literal(s):
+        buf = np.zeros((_MAX_CHARS,), np.uint8)
+        raw = s.encode()
+        buf[: len(raw)] = np.frombuffer(raw, np.uint8)
+        return jnp.asarray(buf)[None, :], len(raw)
+
+    nan_c, nan_l = literal("NaN")
+    inf_c, inf_l = literal("Infinity")
+    ninf_c, ninf_l = literal("-Infinity")
+    z_c, z_l = literal("0.0")
+    nz_c, nz_l = literal("-0.0")
+
+    for mask, c, l in (
+        (is_zero & ~negative, z_c, z_l),
+        (is_zero & negative, nz_c, nz_l),
+        (is_inf & ~negative, inf_c, inf_l),
+        (is_inf & negative, ninf_c, ninf_l),
+        (is_nan, nan_c, nan_l),
+    ):
+        chars = jnp.where(mask[:, None], c, chars)
+        length = jnp.where(mask, l, length)
+
+    idx = jnp.arange(_MAX_CHARS)[None, :]
+    chars = jnp.where(idx < length[:, None], chars, jnp.uint8(0))
+    return chars, length
+
+
+def float_to_string(col: Column) -> StringColumn:
+    """Java Float/Double.toString per row (reference
+    ``cast_float_to_string.cu:110``)."""
+    kind = col.dtype.kind
+    if kind is T.Kind.FLOAT64:
+        pair = jax.lax.bitcast_convert_type(col.data, jnp.uint32)
+        bits = pair[..., 0].astype(jnp.uint64) | (
+            pair[..., 1].astype(jnp.uint64) << 32
+        )
+        negative = (bits >> _U64(63)) != 0
+        exp_field = (bits >> _U64(52)) & _U64(0x7FF)
+        mant = bits & _U64((1 << 52) - 1)
+        is_nan = (exp_field == 0x7FF) & (mant != 0)
+        is_inf = (exp_field == 0x7FF) & (mant == 0)
+        is_zero = (exp_field == 0) & (mant == 0)
+        digits, exp10 = _d2d(bits & _U64((1 << 63) - 1))
+    elif kind is T.Kind.FLOAT32:
+        bits = jax.lax.bitcast_convert_type(col.data, jnp.uint32)
+        negative = (bits >> 31) != 0
+        exp_field = (bits >> 23) & jnp.uint32(0xFF)
+        mant = bits & jnp.uint32((1 << 23) - 1)
+        is_nan = (exp_field == 0xFF) & (mant != 0)
+        is_inf = (exp_field == 0xFF) & (mant == 0)
+        is_zero = (exp_field == 0) & (mant == 0)
+        digits, exp10 = _f2d(bits & jnp.uint32((1 << 31) - 1))
+    else:
+        raise TypeError(f"float_to_string expects FLOAT32/64, got {col.dtype!r}")
+
+    chars, length = _format(digits, exp10, negative, is_nan, is_inf, is_zero)
+    return StringColumn(chars, length * col.validity, col.validity)

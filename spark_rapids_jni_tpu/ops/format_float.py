@@ -1,0 +1,252 @@
+"""Spark ``format_number``-style float formatting (#,###,###.##).
+
+Reference: ``format_float.cu`` + ``ftos_converter.cuh:1247-1476``.  The
+value's *shortest* decimal digits (Ryu core, shared with
+:mod:`float_to_string`) are rounded half-even to ``digits`` decimal places
+and grouped with thousands separators.  Specials: NaN -> U+FFFD
+(replacement char), ±Inf -> [-]U+221E, ±0 -> [-]0.000…
+
+All three layout branches of the reference's ``to_formatted_chars`` are
+computed for every row and selected by mask; the integer part is carried as
+a digit *vector* (values up to 1e308 overflow any integer lane type) and
+the comma grouping is a pure position-arithmetic gather.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import types as T
+from ..columnar.column import Column, StringColumn
+from .float_to_string import _d2d, _f2d, _digit_count, _U64
+
+_MAX_INT_DIGITS = 310  # 1.8e308
+
+
+def _pow10_u64(e):
+    """10**e for e int32[n] in [0, 19] as uint64 (gather from a table)."""
+    table = jnp.asarray(np.array([10**k for k in range(20)], dtype=np.uint64))
+    return jnp.take(table, jnp.clip(e, 0, 19))
+
+
+def _round_half_even(mant, olength, keep):
+    """Round the olength-digit integer to its leading ``keep`` digits
+    (reference round_half_even, ftos_converter.cuh:1247)."""
+    drop = olength - keep
+    no_round = drop <= 0
+    div = _pow10_u64(jnp.maximum(drop, 0))
+    mod = mant % div
+    num = mant // div
+    half = div // _U64(2)
+    inc = (mod > half) | ((mod == half) & (num % _U64(2) == 1) & (mod != 0))
+    return jnp.where(no_round, mant, num + inc.astype(jnp.uint64))
+
+
+def format_float(col: Column, digits: int) -> StringColumn:
+    """Format with ``digits`` decimal places (reference format_float.cu:112)."""
+    if digits < 0:
+        raise ValueError("digits must be >= 0")
+    kind = col.dtype.kind
+    if kind is T.Kind.FLOAT64:
+        pair = jax.lax.bitcast_convert_type(col.data, jnp.uint32)
+        bits = pair[..., 0].astype(jnp.uint64) | (
+            pair[..., 1].astype(jnp.uint64) << 32
+        )
+        negative = (bits >> _U64(63)) != 0
+        exp_f = (bits >> _U64(52)) & _U64(0x7FF)
+        mant_f = bits & _U64((1 << 52) - 1)
+        is_nan = (exp_f == 0x7FF) & (mant_f != 0)
+        is_inf = (exp_f == 0x7FF) & (mant_f == 0)
+        is_zero = (exp_f == 0) & (mant_f == 0)
+        mant, e10 = _d2d(bits & _U64((1 << 63) - 1))
+    elif kind is T.Kind.FLOAT32:
+        bits = jax.lax.bitcast_convert_type(col.data, jnp.uint32)
+        negative = (bits >> 31) != 0
+        exp_f = (bits >> 23) & jnp.uint32(0xFF)
+        mant_f = bits & jnp.uint32((1 << 23) - 1)
+        is_nan = (exp_f == 0xFF) & (mant_f != 0)
+        is_inf = (exp_f == 0xFF) & (mant_f == 0)
+        is_zero = (exp_f == 0) & (mant_f == 0)
+        mant, e10 = _f2d(bits & jnp.uint32((1 << 31) - 1))
+    else:
+        raise TypeError(f"format_float expects FLOAT32/64, got {col.dtype!r}")
+
+    n = col.num_rows
+    olength = _digit_count(mant)
+    exp = e10 + olength - 1
+
+    # digit vector of the mantissa, MSB-first [n, 17]
+    digs = []
+    x = mant
+    for _ in range(17):
+        digs.append((x % _U64(10)).astype(jnp.int32))
+        x = x // _U64(10)
+    dig_rev = jnp.stack(digs, axis=1)  # LSB-first
+
+    d = digits
+
+    # ---------- branch A: exp < 0 ----------
+    zeros_cnt = jnp.clip(-exp - 1, 0, d)  # leading fractional zeros
+    actual_round = d - zeros_cnt
+    a_olength = jnp.minimum(olength, actual_round)
+    a_rounded = _round_half_even(mant, olength, actual_round)
+    a_carry = a_rounded >= _pow10_u64(a_olength)
+    a_rounded = jnp.where(a_carry, a_rounded - _pow10_u64(a_olength), a_rounded)
+    # carry only propagates when the zeros run reaches the digits (i == exp+1)
+    a_has_carry = a_carry & ((-exp - 1) <= d)
+
+    # ---------- branch C: 0 <= exp < olength-1 ----------
+    temp_d = jnp.minimum(jnp.int32(d), olength - exp - 1)
+    tailing_zero = d - temp_d
+    c_rounded = _round_half_even(mant, olength, exp + temp_d + 1)
+    c_pow = _pow10_u64(temp_d)
+    c_integer = c_rounded // c_pow
+    c_decimal = c_rounded % c_pow
+
+    branch_a = exp < 0
+    branch_b = (~branch_a) & (exp + 1 >= olength)
+    branch_c = ~branch_a & ~branch_b
+
+    # ---------- integer part as digit vector [n, MAXI], MSB-first --------
+    # A: "0" or "1" (carry with no leading zeros); B: mantissa digits +
+    # zero padding; C: digits of c_integer
+    int_len = jnp.where(
+        branch_a,
+        1,
+        jnp.where(branch_b, exp + 1, _digit_count(c_integer)),
+    )
+    j_int = jnp.arange(_MAX_INT_DIGITS, dtype=jnp.int32)[None, :]
+    # digit index from most-significant: B reads mantissa digit j (0 pad
+    # beyond olength); C reads c_integer digit j; A constant
+    b_dig = jnp.where(
+        j_int < olength[:, None],
+        jnp.take_along_axis(
+            dig_rev, jnp.clip(olength[:, None] - 1 - j_int, 0, 16), axis=1
+        ),
+        0,
+    )
+    c_digs = []
+    x = c_integer
+    for _ in range(18):
+        c_digs.append((x % _U64(10)).astype(jnp.int32))
+        x = x // _U64(10)
+    c_rev = jnp.stack(c_digs, axis=1)
+    c_ilen = _digit_count(c_integer)
+    c_dig = jnp.take_along_axis(
+        c_rev, jnp.clip(c_ilen[:, None] - 1 - j_int, 0, 17), axis=1
+    )
+    a_int0 = jnp.where(a_has_carry & (zeros_cnt == 0), 1, 0)
+    int_dig = jnp.where(
+        branch_a[:, None],
+        jnp.where(j_int == 0, a_int0[:, None], 0),
+        jnp.where(branch_b[:, None], b_dig, c_dig),
+    )
+
+    # ---------- fractional part [n, d] -----------------------------------
+    if d > 0:
+        j_f = jnp.arange(d, dtype=jnp.int32)[None, :]
+        # A: zeros_cnt zeros (last may carry to 1), then a_olength rounded
+        # digits, then zeros
+        a_digs = []
+        x = a_rounded
+        for _ in range(18):
+            a_digs.append((x % _U64(10)).astype(jnp.int32))
+            x = x // _U64(10)
+        a_rev = jnp.stack(a_digs, axis=1)
+        a_pos = j_f - zeros_cnt[:, None]
+        a_frac = jnp.where(
+            (a_pos >= 0) & (a_pos < a_olength[:, None]),
+            jnp.take_along_axis(
+                a_rev, jnp.clip(a_olength[:, None] - 1 - a_pos, 0, 17), axis=1
+            ),
+            0,
+        )
+        a_frac = jnp.where(
+            (j_f == zeros_cnt[:, None] - 1) & a_has_carry[:, None], 1, a_frac
+        )
+        # C: c_decimal zero-padded to temp_d, then tailing zeros
+        d_digs = []
+        x = c_decimal
+        for _ in range(18):
+            d_digs.append((x % _U64(10)).astype(jnp.int32))
+            x = x // _U64(10)
+        d_rev = jnp.stack(d_digs, axis=1)
+        c_frac = jnp.where(
+            j_f < temp_d[:, None],
+            jnp.take_along_axis(
+                d_rev, jnp.clip(temp_d[:, None] - 1 - j_f, 0, 17), axis=1
+            ),
+            0,
+        )
+        frac = jnp.where(
+            branch_a[:, None], a_frac, jnp.where(branch_b[:, None], 0, c_frac)
+        )
+    else:
+        frac = jnp.zeros((n, 0), jnp.int32)
+
+    # ---------- assemble: sign + grouped integer + '.' + frac ------------
+    fmt_int_len = int_len + (int_len - 1) // 3
+    sign_len = negative.astype(jnp.int32)
+    width = 1 + _MAX_INT_DIGITS + (_MAX_INT_DIGITS - 1) // 3 + 1 + d
+    j = jnp.arange(width, dtype=jnp.int32)[None, :]
+    p = j - sign_len[:, None]
+
+    # grouped integer: reverse position r from the right end of the group
+    r = fmt_int_len[:, None] - 1 - p
+    in_int = (p >= 0) & (r >= 0)
+    is_comma = (r % 4 == 3)
+    dr = r - r // 4  # digit index from the right
+    int_char = jnp.where(
+        is_comma,
+        ord(","),
+        ord("0")
+        + jnp.take_along_axis(
+            int_dig, jnp.clip(int_len[:, None] - 1 - dr, 0, _MAX_INT_DIGITS - 1),
+            axis=1,
+        ),
+    )
+    out = jnp.where(in_int, int_char, ord(" "))
+    out = jnp.where((j == 0) & negative[:, None], ord("-"), out)
+
+    if d > 0:
+        dot_pos = fmt_int_len[:, None]
+        out = jnp.where(p == dot_pos, ord("."), out)
+        fpos = p - dot_pos - 1
+        m_frac = (fpos >= 0) & (fpos < d)
+        fchar = ord("0") + jnp.take_along_axis(
+            jnp.pad(frac, ((0, 0), (0, 1))), jnp.clip(fpos, 0, d - 1), axis=1
+        )
+        out = jnp.where(m_frac, fchar, out)
+        length = sign_len + fmt_int_len + 1 + d
+    else:
+        length = sign_len + fmt_int_len
+
+    chars = out.astype(jnp.uint8)
+
+    # ---------- specials --------------------------------------------------
+    def literal(s: bytes):
+        buf = np.zeros((width,), np.uint8)
+        buf[: len(s)] = np.frombuffer(s, np.uint8)
+        return jnp.asarray(buf)[None, :], len(s)
+
+    zero_str = b"0." + b"0" * d if d > 0 else b"0"
+    nzero_str = b"-" + zero_str
+    nan_c, nan_l = literal(b"\xef\xbf\xbd")
+    inf_c, inf_l = literal(b"\xe2\x88\x9e")
+    ninf_c, ninf_l = literal(b"-\xe2\x88\x9e")
+    z_c, z_l = literal(zero_str)
+    nz_c, nz_l = literal(nzero_str)
+    for mask, c, l in (
+        (is_zero & ~negative, z_c, z_l),
+        (is_zero & negative, nz_c, nz_l),
+        (is_inf & ~negative, inf_c, inf_l),
+        (is_inf & negative, ninf_c, ninf_l),
+        (is_nan, nan_c, nan_l),
+    ):
+        chars = jnp.where(mask[:, None], c, chars)
+        length = jnp.where(mask, l, length)
+
+    chars = jnp.where(j < length[:, None], chars, jnp.uint8(0))
+    return StringColumn(chars, length * col.validity, col.validity)

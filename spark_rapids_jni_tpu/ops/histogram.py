@@ -63,6 +63,8 @@ def percentile_from_histogram(
     ``(out float64[H, P], histogram_valid bool[H])``; all-null histograms
     yield invalid rows.
     """
+    if any(not (0.0 <= p <= 1.0) for p in percentages):
+        raise ValueError("percentages must be in [0, 1]")
     offsets = jnp.asarray(offsets, jnp.int32)
     H = offsets.shape[0] - 1
     P = len(percentages)

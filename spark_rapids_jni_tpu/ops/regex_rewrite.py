@@ -1,0 +1,91 @@
+"""Regex fast-path: ``literal[start-end]{len,}`` containment check.
+
+Reference: ``regex_rewrite_utils.cu:65-121`` (``literal_range_pattern``).
+The plugin rewrites regexes of this shape into a direct scan instead of a
+regex engine: does any position hold ``literal`` followed by at least
+``len`` characters whose code points lie in ``[start, end]``?
+
+Vectorized over (row, byte position): the literal match is ``m`` shifted
+byte comparisons; the character-range run walks ``len`` steps of
+per-position UTF-8 char-length gathers (characters, not bytes — matching
+the reference's ``utf8_to_codepoint`` semantics).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..columnar import types as T
+from ..columnar.column import Column, StringColumn
+
+
+def _decode_utf8(chars):
+    """Per byte position: (codepoint, char byte length, is_char_start).
+
+    Values at continuation-byte positions are garbage; ``is_start`` masks
+    them.  Truncated sequences at the padded tail decode from zero pad
+    bytes (harmless: the in-range check fails or length mask cuts them).
+    """
+    n, L = chars.shape
+    b = [chars]
+    for k in range(1, 4):
+        b.append(
+            jnp.pad(chars, ((0, 0), (0, k)))[:, k : L + k]
+        )
+    b0, b1, b2, b3 = (x.astype(jnp.int32) for x in b)
+    is1 = b0 < 0x80
+    is2 = (b0 >= 0xC0) & (b0 < 0xE0)
+    is3 = (b0 >= 0xE0) & (b0 < 0xF0)
+    is4 = b0 >= 0xF0
+    cp = jnp.where(
+        is1,
+        b0,
+        jnp.where(
+            is2,
+            ((b0 & 0x1F) << 6) | (b1 & 0x3F),
+            jnp.where(
+                is3,
+                ((b0 & 0x0F) << 12) | ((b1 & 0x3F) << 6) | (b2 & 0x3F),
+                ((b0 & 0x07) << 18) | ((b1 & 0x3F) << 12)
+                | ((b2 & 0x3F) << 6) | (b3 & 0x3F),
+            ),
+        ),
+    )
+    clen = jnp.where(is1, 1, jnp.where(is2, 2, jnp.where(is3, 3, 4)))
+    is_start = is1 | is2 | is3 | is4
+    return cp, clen, is_start
+
+
+def literal_range_pattern(
+    col: StringColumn, literal: str, range_len: int, start: int, end: int
+) -> Column:
+    """bool per row; nulls stay null (reference regex_rewrite_utils.cu:121)."""
+    lit = literal.encode("utf-8")
+    m = len(lit)
+    chars, lengths = col.chars, col.lengths
+    n, L = chars.shape
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    in_str = pos < lengths[:, None]
+
+    cp, clen, is_start = _decode_utf8(chars)
+    ok_char = is_start & (cp >= start) & (cp <= end) & in_str
+
+    # literal byte match at each starting byte position
+    lit_match = jnp.ones((n, L), jnp.bool_)
+    for j, byte in enumerate(lit):
+        shifted = jnp.pad(chars, ((0, 0), (0, j)))[:, j : L + j] if j else chars
+        lit_match = lit_match & (shifted == byte)
+    lit_match = lit_match & is_start & ((pos + m) <= lengths[:, None])
+
+    # range run of `range_len` characters starting right after the literal
+    run_ok = jnp.ones((n, L), jnp.bool_)
+    cursor = jnp.broadcast_to(pos + m, (n, L))
+    for _ in range(range_len):
+        cur_clip = jnp.clip(cursor, 0, L - 1)
+        ok_here = jnp.take_along_axis(ok_char, cur_clip, axis=1) & (cursor < L)
+        run_ok = run_ok & ok_here
+        step = jnp.take_along_axis(clen, cur_clip, axis=1)
+        cursor = cursor + step
+
+    found = (lit_match & run_ok).any(axis=1)
+    return Column(found & col.validity, col.validity, T.BOOLEAN)

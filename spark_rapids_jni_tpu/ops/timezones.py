@@ -76,8 +76,9 @@ def _parse_tzif(path: str) -> Optional[_ZoneData]:
         off += 44
         tsize = 8
     else:
-        off = 44
-        tsize = 4
+        # v1 files carry no footer TZ string, so recurring-DST rules can't
+        # be ruled out — treat as unsupported (modern tzdata is all v2+)
+        return None
 
     times = np.frombuffer(
         data, dtype=f">i{tsize}", count=timecnt, offset=off
@@ -175,13 +176,21 @@ class TimeZoneDB:
         self._tzpath = tzpath
         self._zones: Dict[str, Optional[_ZoneData]] = {}
 
+    _ZONE_ID_RE = re.compile(r"^[A-Za-z][A-Za-z0-9_+\-]*(/[A-Za-z0-9_+\-]+)*$")
+
     def zone(self, zone_id: str) -> _ZoneData:
         z = self._zones.get(zone_id)
         if z is None and zone_id not in self._zones:
             z = _fixed_offset_zone(zone_id)
-            if z is None:
+            if z is None and self._ZONE_ID_RE.match(zone_id):
+                # the id grammar forbids '.' components, so the join below
+                # cannot escape tzpath
                 path = os.path.join(self._tzpath, *zone_id.split("/"))
-                z = _parse_tzif(path) if os.path.exists(path) else None
+                if os.path.isfile(path):
+                    try:
+                        z = _parse_tzif(path)
+                    except (struct.error, ValueError, OSError):
+                        z = None
             self._zones[zone_id] = z
         if z is None:
             raise ValueError(f"unsupported time zone: {zone_id!r}")
@@ -191,7 +200,7 @@ class TimeZoneDB:
         try:
             self.zone(zone_id)
             return True
-        except (ValueError, OSError):
+        except ValueError:
             return False
 
 

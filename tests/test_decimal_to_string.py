@@ -1,0 +1,67 @@
+"""decimal -> string vs Java BigDecimal.toString oracle (python Decimal)."""
+
+from decimal import Decimal, localcontext
+
+import pytest
+
+from spark_rapids_jni_tpu.columnar.column import Decimal128Column
+from spark_rapids_jni_tpu.ops.decimal_to_string import decimal_to_string
+
+
+def oracle(unscaled: int, scale: int) -> str:
+    """Java BigDecimal(unscaled, scale).toString()."""
+    with localcontext() as ctx:
+        ctx.prec = 80
+        d = Decimal(unscaled).scaleb(-scale)
+    # python Decimal string rules match Java BigDecimal.toString (both
+    # switch to scientific when adjusted exponent < -6 or scale < 0)
+    return str(d)
+
+
+def col(vals, scale, precision=38):
+    return Decimal128Column.from_unscaled(vals, precision, scale)
+
+
+class TestDecimalToString:
+    @pytest.mark.parametrize("scale", [0, 1, 2, 6, 10, 37])
+    def test_random_vs_oracle(self, rng, scale):
+        vals = []
+        for _ in range(40):
+            bits = int(rng.integers(1, 120))
+            v = int(rng.integers(0, 2**60)) << (bits // 2) | int(
+                rng.integers(0, 2**30)
+            )
+            v &= (1 << bits) - 1
+            if rng.random() < 0.5:
+                v = -v
+            vals.append(v)
+        vals += [0, 1, -1, 10**scale if scale else 1]
+        got = decimal_to_string(col(vals, scale)).to_pylist()
+        for g, v in zip(got, vals):
+            assert g == oracle(v, scale), (v, scale, g, oracle(v, scale))
+
+    def test_goldens(self):
+        assert decimal_to_string(col([123456], 2)).to_pylist() == ["1234.56"]
+        assert decimal_to_string(col([-123456], 2)).to_pylist() == ["-1234.56"]
+        assert decimal_to_string(col([5], 3)).to_pylist() == ["0.005"]
+        assert decimal_to_string(col([0], 2)).to_pylist() == ["0.00"]
+        assert decimal_to_string(col([7], 0)).to_pylist() == ["7"]
+        # adjusted exponent < -6 -> scientific
+        assert decimal_to_string(col([1], 8)).to_pylist() == ["1E-8"]
+        assert decimal_to_string(col([12], 9)).to_pylist() == ["1.2E-8"]
+        assert decimal_to_string(col([123], 10)).to_pylist() == ["1.23E-8"]
+        # boundary: adjusted == -6 stays plain
+        assert decimal_to_string(col([1], 6)).to_pylist() == ["0.000001"]
+        assert decimal_to_string(col([1], 7)).to_pylist() == ["1E-7"]
+
+    def test_nulls(self):
+        assert decimal_to_string(col([123, None], 1)).to_pylist() == ["12.3", None]
+
+    def test_full_precision(self):
+        v = 12345678901234567890123456789012345678
+        assert decimal_to_string(col([v], 10)).to_pylist() == [
+            "1234567890123456789012345678.9012345678"
+        ]
+        assert decimal_to_string(col([-v], 0)).to_pylist() == [
+            "-12345678901234567890123456789012345678"
+        ]

@@ -1,0 +1,120 @@
+"""float/double -> string vs Java-format oracle built on shortest-repr.
+
+Shortest round-trip digit sequences are unique (both Ryu and Python/numpy's
+repr produce them), so the oracle derives Java's output from python repr
+digits re-formatted under Java's plain/scientific rules.
+"""
+
+import math
+from decimal import Decimal
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.columnar import types as T
+from spark_rapids_jni_tpu.columnar.column import Column
+from spark_rapids_jni_tpu.ops.float_to_string import float_to_string
+
+
+def java_format(digits: str, E: int, neg: bool) -> str:
+    sign = "-" if neg else ""
+    if -3 <= E < 7:
+        if E >= 0:
+            ip = digits[: E + 1].ljust(E + 1, "0")
+            frac = digits[E + 1 :] or "0"
+            return f"{sign}{ip}.{frac}"
+        return f"{sign}0." + "0" * (-E - 1) + digits
+    frac = digits[1:] or "0"
+    return f"{sign}{digits[0]}.{frac}E{E}"
+
+
+def shortest_digits(s: str):
+    d = Decimal(s)
+    _, digits, exp = d.as_tuple()
+    ds = "".join(map(str, digits))
+    while len(ds) > 1 and ds.endswith("0"):
+        ds = ds[:-1]
+        exp += 1
+    return ds, exp + len(ds) - 1
+
+
+def oracle_double(v: float) -> str:
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "Infinity" if v > 0 else "-Infinity"
+    if v == 0:
+        return "-0.0" if math.copysign(1, v) < 0 else "0.0"
+    ds, E = shortest_digits(repr(abs(v)))
+    return java_format(ds, E, v < 0)
+
+
+def oracle_float(v: np.float32) -> str:
+    f = float(v)
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "Infinity" if f > 0 else "-Infinity"
+    if f == 0:
+        return "-0.0" if math.copysign(1, f) < 0 else "0.0"
+    s = np.format_float_scientific(abs(v), unique=True, trim="-")
+    ds, E = shortest_digits(s.replace("e", "E"))
+    return java_format(ds, E, f < 0)
+
+
+class TestDoubleToString:
+    def test_goldens(self):
+        vals = [
+            0.0, -0.0, 1.0, -1.0, 3.14, 0.001, 0.0001, 1e7, 9999999.0,
+            1e-323, 1.7976931348623157e308, 123.456, 1 / 3,
+            float("nan"), float("inf"), float("-inf"), 2.0, 1e16,
+        ]
+        col = Column.from_pylist(vals, T.FLOAT64)
+        got = float_to_string(col).to_pylist()
+        for g, v in zip(got, vals):
+            assert g == oracle_double(v), (v, g, oracle_double(v))
+
+    def test_random_bits(self, rng):
+        bits = rng.integers(0, 2**64, 500, dtype=np.uint64)
+        vals = bits.view(np.float64)
+        col = Column(
+            __import__("jax.numpy", fromlist=["asarray"]).asarray(vals),
+            __import__("jax.numpy", fromlist=["ones"]).ones(500, bool),
+            T.FLOAT64,
+        )
+        got = float_to_string(col).to_pylist()
+        for g, v in zip(got, vals.tolist()):
+            assert g == oracle_double(v), (v, g)
+
+    def test_round_trip(self, rng):
+        vals = (rng.normal(size=100) * 10.0 ** rng.integers(-300, 300, 100)).tolist()
+        col = Column.from_pylist(vals, T.FLOAT64)
+        got = float_to_string(col).to_pylist()
+        for g, v in zip(got, vals):
+            s = g.replace("E", "e")
+            assert float(s) == v, (v, g)
+
+    def test_nulls(self):
+        col = Column.from_pylist([1.5, None], T.FLOAT64)
+        assert float_to_string(col).to_pylist() == ["1.5", None]
+
+
+class TestFloatToString:
+    def test_goldens(self):
+        vals = [0.0, 1.0, -1.5, 3.14, 0.001, 1e7, 1e-4, 1e38, 1e-45,
+                float("nan"), float("inf")]
+        f32 = [np.float32(v) for v in vals]
+        col = Column.from_pylist([float(v) for v in f32], T.FLOAT32)
+        got = float_to_string(col).to_pylist()
+        for g, v in zip(got, f32):
+            assert g == oracle_float(v), (float(v), g, oracle_float(v))
+
+    def test_random_bits(self, rng):
+        bits = rng.integers(0, 2**32, 500, dtype=np.uint32)
+        vals = bits.view(np.float32)
+        import jax.numpy as jnp
+
+        col = Column(jnp.asarray(vals), jnp.ones(500, bool), T.FLOAT32)
+        got = float_to_string(col).to_pylist()
+        for g, v in zip(got, vals):
+            assert g == oracle_float(v), (float(v), g)

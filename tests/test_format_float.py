@@ -1,0 +1,64 @@
+"""format_float vs reference FormatFloatTests goldens (format_float.cpp)."""
+
+import numpy as np
+
+from spark_rapids_jni_tpu.columnar import types as T
+from spark_rapids_jni_tpu.columnar.column import Column
+from spark_rapids_jni_tpu.ops.format_float import format_float
+
+
+class TestFormatFloat:
+    def test_reference_goldens_float32(self):
+        vals = [100.0, 654321.25, -12761.125, 0.0, 5.0, -4.0,
+                float("nan"), 123456789012.34, -0.0]
+        f32 = [float(np.float32(v)) for v in vals]
+        col = Column.from_pylist(f32, T.FLOAT32)
+        got = format_float(col, 5).to_pylist()
+        assert got == [
+            "100.00000",
+            "654,321.25000",
+            "-12,761.12500",
+            "0.00000",
+            "5.00000",
+            "-4.00000",
+            "�",
+            "123,456,790,000.00000",
+            "-0.00000",
+        ]
+
+    def test_reference_goldens_float64(self):
+        vals = [100.0, 654321.25, -12761.125, 1.123456789123456789,
+                0.000000000000000000123456789123456789, 0.0, 5.0, -4.0,
+                float("nan"), 839542223232.794248339, 3232.794248339,
+                11234000000.0, -0.0]
+        col = Column.from_pylist(vals, T.FLOAT64)
+        got = format_float(col, 5).to_pylist()
+        assert got == [
+            "100.00000",
+            "654,321.25000",
+            "-12,761.12500",
+            "1.12346",
+            "0.00000",
+            "0.00000",
+            "5.00000",
+            "-4.00000",
+            "�",
+            "839,542,223,232.79420",
+            "3,232.79425",
+            "11,234,000,000.00000",
+            "-0.00000",
+        ]
+
+    def test_infinity_and_digits0(self):
+        col = Column.from_pylist([float("inf"), float("-inf"), 1234.5], T.FLOAT64)
+        got = format_float(col, 0).to_pylist()
+        assert got == ["∞", "-∞", "1,234"]  # 1234.5 -> 1234 half-even
+
+    def test_rounding_carry(self):
+        col = Column.from_pylist([0.95, 0.009, 9.999, 0.0005], T.FLOAT64)
+        assert format_float(col, 1).to_pylist() == ["1.0", "0.0", "10.0", "0.0"]
+        assert format_float(col, 2).to_pylist() == ["0.95", "0.01", "10.00", "0.00"]
+
+    def test_nulls(self):
+        col = Column.from_pylist([1.5, None], T.FLOAT64)
+        assert format_float(col, 2).to_pylist() == ["1.50", None]

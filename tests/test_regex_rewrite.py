@@ -1,0 +1,51 @@
+"""literal_range_pattern vs reference RegexRewriteUtilsTest vectors + oracle."""
+
+import re
+
+from spark_rapids_jni_tpu.columnar.column import StringColumn
+from spark_rapids_jni_tpu.ops.regex_rewrite import literal_range_pattern
+
+
+def oracle(s, literal, d, start, end):
+    """Direct python recheck over characters."""
+    if s is None:
+        return None
+    chars = list(s)
+    m = len(literal)
+    lit = list(literal)
+    for i in range(len(chars) - m - d + 1):
+        if chars[i : i + m] == lit and all(
+            start <= ord(c) <= end for c in chars[i + m : i + m + d]
+        ):
+            return True
+    return False
+
+
+class TestLiteralRangePattern:
+    def test_reference_vectors_ascii(self):
+        vals = ["abc123", "aabc123", "aabc12", "abc1232", "aabc1232"]
+        col = StringColumn.from_pylist(vals)
+        got = literal_range_pattern(col, "abc", 3, 48, 57).to_pylist()
+        assert got == [True, True, False, True, True]
+
+    def test_reference_vectors_chinese(self):
+        vals = ["数据砖块", "火花-急流英伟达", "英伟达Nvidia", "火花-急流"]
+        col = StringColumn.from_pylist(vals)
+        got = literal_range_pattern(col, "英", 2, 19968, 40869).to_pylist()
+        assert got == [False, True, True, False]
+
+    def test_nulls_and_empty(self):
+        col = StringColumn.from_pylist(["abc12", None, ""])
+        got = literal_range_pattern(col, "abc", 2, 48, 57).to_pylist()
+        assert got == [True, None, False]
+
+    def test_random_oracle(self, rng):
+        alphabet = "ab1x"
+        vals = [
+            "".join(rng.choice(list(alphabet), size=rng.integers(0, 12)))
+            for _ in range(100)
+        ]
+        col = StringColumn.from_pylist(vals, max_len=16)
+        got = literal_range_pattern(col, "ab", 2, 48, 57).to_pylist()
+        for g, s in zip(got, vals):
+            assert g == oracle(s, "ab", 2, 48, 57), s

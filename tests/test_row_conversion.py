@@ -1,0 +1,138 @@
+"""JCUDF row conversion: layout goldens + round-trips (reference
+RowConversionTest pattern: convert to rows, back, compare)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu.columnar import types as T
+from spark_rapids_jni_tpu.columnar.column import (
+    Column,
+    ColumnBatch,
+    Decimal128Column,
+    StringColumn,
+)
+from spark_rapids_jni_tpu.ops.row_conversion import (
+    convert_from_rows,
+    convert_to_rows,
+    row_layout,
+)
+
+
+class TestLayout:
+    def test_doc_example(self):
+        # RowConversion.java:78-90: BOOL8, INT16, INT32 -> 16-byte rows
+        b = ColumnBatch(
+            {
+                "a": Column.from_pylist([True], T.BOOLEAN),
+                "b": Column.from_pylist([0x0201], T.INT16),
+                "c": Column.from_pylist([0x06050403], T.INT32),
+            }
+        )
+        rows = convert_to_rows(b)
+        assert int(rows.lengths[0]) == 16
+        got = bytes(np.asarray(rows.chars)[0, :16])
+        #  A0 P  B0 B1 C0 C1 C2 C3 V0 P*7
+        assert got == bytes([1, 0, 1, 2, 3, 4, 5, 6, 0x07] + [0] * 7)
+
+    def test_ordered_no_padding(self):
+        # C, B, A order: | C0..C3 | B0 B1 | A0 | V0 | -> 8 bytes
+        b = ColumnBatch(
+            {
+                "c": Column.from_pylist([0x04030201], T.INT32),
+                "b": Column.from_pylist([0x0605], T.INT16),
+                "a": Column.from_pylist([None], T.BOOLEAN),
+            }
+        )
+        rows = convert_to_rows(b)
+        assert int(rows.lengths[0]) == 8
+        got = bytes(np.asarray(rows.chars)[0, :8])
+        assert got == bytes([1, 2, 3, 4, 5, 6, 0, 0x03])  # a null -> bit 2 unset
+
+    def test_alignment_padding(self):
+        # INT8 then INT64: int64 aligns to offset 8
+        offs, voff, fixed_end, nv = row_layout(
+            [
+                Column.from_pylist([1], T.INT8),
+                Column.from_pylist([2], T.INT64),
+            ]
+        )
+        assert offs == [0, 8] and voff == 16 and nv == 1
+
+
+class TestRoundTrip:
+    def test_fixed_width_mixed(self, rng):
+        n = 64
+        vals = {
+            "i8": ([int(x) for x in rng.integers(-128, 128, n)], T.INT8),
+            "i64": ([int(x) for x in rng.integers(-(2**60), 2**60, n)], T.INT64),
+            "f32": ([float(np.float32(x)) for x in rng.normal(size=n)], T.FLOAT32),
+            "f64": ([float(x) for x in rng.normal(size=n)], T.FLOAT64),
+            "b": ([bool(x) for x in rng.random(n) < 0.5], T.BOOLEAN),
+            "d": ([int(x) for x in rng.integers(-10000, 10000, n)], T.DATE),
+        }
+        cols = {}
+        for name, (v, t) in vals.items():
+            v = [None if rng.random() < 0.1 else x for x in v]
+            vals[name] = (v, t)
+            cols[name] = Column.from_pylist(v, t)
+        batch = ColumnBatch(cols)
+        rows = convert_to_rows(batch)
+        back = convert_from_rows(rows, {k: t for k, (v, t) in vals.items()})
+        for name, (v, t) in vals.items():
+            assert back[name].to_pylist() == v, name
+
+    def test_strings_round_trip(self):
+        words = ["hello", "", None, "a longer string here", "x"]
+        nums = [1, None, 3, 4, 5]
+        batch = ColumnBatch(
+            {
+                "s": StringColumn.from_pylist(words),
+                "v": Column.from_pylist(nums, T.INT32),
+                "t": StringColumn.from_pylist(["A", "BB", "CCC", None, ""]),
+            }
+        )
+        rows = convert_to_rows(batch)
+        # row bytes are 8-aligned
+        assert all(int(x) % 8 == 0 for x in np.asarray(rows.lengths))
+        back = convert_from_rows(
+            rows,
+            {"s": (T.STRING, 32), "v": T.INT32, "t": (T.STRING, 8)},
+        )
+        assert back["s"].to_pylist() == [w if w is not None else None for w in words]
+        assert back["v"].to_pylist() == nums
+        assert back["t"].to_pylist() == ["A", "BB", "CCC", None, ""]
+
+    def test_decimal128_round_trip(self):
+        vals = [0, 12345678901234567890123456789, -1, None]
+        batch = ColumnBatch({"d": Decimal128Column.from_unscaled(vals, 38, 4)})
+        rows = convert_to_rows(batch)
+        back = convert_from_rows(rows, {"d": T.SparkType.decimal(38, 4)})
+        assert back["d"].to_pylist() == vals
+
+    def test_string_offsets_in_fixed_slot(self):
+        # string slot holds (offset, length); offset of first string = fixed_end
+        batch = ColumnBatch({"s": StringColumn.from_pylist(["abc"])})
+        rows = convert_to_rows(batch)
+        raw = np.asarray(rows.chars)[0]
+        off = int.from_bytes(bytes(raw[0:4]), "little")
+        ln = int.from_bytes(bytes(raw[4:8]), "little")
+        assert ln == 3
+        assert bytes(raw[off : off + 3]) == b"abc"
+
+    def test_small_decimal_round_trip(self):
+        from spark_rapids_jni_tpu.columnar import types as T2
+
+        vals = [12345, -9, None]
+        batch = ColumnBatch(
+            {
+                "d9": Decimal128Column.from_unscaled(vals, 9, 2),
+                "d18": Decimal128Column.from_unscaled(vals, 18, 4),
+            }
+        )
+        rows = convert_to_rows(batch)
+        back = convert_from_rows(
+            rows,
+            {"d9": T2.SparkType.decimal(9, 2), "d18": T2.SparkType.decimal(18, 4)},
+        )
+        assert back["d9"].to_pylist() == vals
+        assert back["d18"].to_pylist() == vals
