@@ -6,21 +6,39 @@ publishes no numbers (BASELINE.md), so ``vs_baseline`` is measured against a
 numpy single-core implementation of the identical pipeline run in-process —
 a stand-in for the CPU Spark executor this layer accelerates.
 
-Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": "Mrows/s", "vs_baseline": N}
+Robustness: round 1 died inside TPU backend init before any kernel ran
+(BENCH_r01.json), so the orchestration is now fail-soft.  The parent
+process launches the measurement in a child (``--child``); if the child
+fails or hangs on the accelerator backend, the parent relaunches it pinned
+to CPU (``JAX_PLATFORMS=cpu``).  One JSON line is printed either way:
+
+  {"metric": ..., "value": N, "unit": "Mrows/s", "vs_baseline": N,
+   "platform": "tpu"|"cpu"}
+
+``python bench.py --micro`` additionally runs per-kernel microbenchmarks
+mirroring the reference's five nvbench targets (BASELINE.md): row
+conversion, string→float, bloom build+probe, murmur3/xxhash64, group-by.
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import numpy as np
+N_ROWS = int(os.environ.get("BENCH_N_ROWS", 1 << 21))  # 2M
+REPS = int(os.environ.get("BENCH_REPS", 20))
+TPU_TIMEOUT_S = int(os.environ.get("BENCH_TPU_TIMEOUT_S", "900"))
+CPU_TIMEOUT_S = int(os.environ.get("BENCH_CPU_TIMEOUT_S", "900"))
 
 
-N_ROWS = 1 << 21  # 2M
-REPS = 20
-
+# --------------------------------------------------------------------------
+# child: actual measurement (runs on whatever backend JAX resolves)
+# --------------------------------------------------------------------------
 
 def _numpy_pipeline(k, v, price):
+    import numpy as np
+
     mask = price < 50.0
     ks, vs, ps = k[mask], v[mask], price[mask]
     uniq, inv = np.unique(ks, return_inverse=True)
@@ -30,24 +48,47 @@ def _numpy_pipeline(k, v, price):
     return uniq, sums, cnts, avgs
 
 
-def main():
+def _bench_one(jfn, args, n_rows, reps):
+    """Compile+warm then time ``reps`` steady-state executions."""
     import jax
+
+    out = jfn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = jfn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps
+    return n_rows / dt / 1e6  # Mrows/s
+
+
+def child_main():
+    import numpy as np
+
+    import jax
+
+    # The axon sitecustomize imports jax before env vars are consulted, so
+    # JAX_PLATFORMS=cpu in the environment is ignored; config.update works
+    # post-import (same trick as tests/conftest.py).
+    if os.environ.get("BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    # Resolve the backend before touching the framework so a hard failure
+    # here is distinguishable (rc=17) from a kernel bug.
+    try:
+        devs = jax.devices()
+        platform = devs[0].platform
+        print(f"# devices: {devs}", file=sys.stderr, flush=True)
+    except Exception as e:  # backend init failure → parent falls back
+        print(f"# backend init failed: {e}", file=sys.stderr, flush=True)
+        return 17
 
     import __graft_entry__ as ge
 
     fn = ge._q6_step
     batch = ge._example_batch(N_ROWS)
-
     jfn = jax.jit(fn)
-    out = jfn(batch)  # compile + warm
-    jax.block_until_ready(out)
-
-    t0 = time.perf_counter()
-    for _ in range(REPS):
-        out = jfn(batch)
-    jax.block_until_ready(out)
-    dt = (time.perf_counter() - t0) / REPS
-    tpu_mrows = N_ROWS / dt / 1e6
+    tpu_mrows = _bench_one(jfn, (batch,), N_ROWS, REPS)
 
     k = np.asarray(jax.device_get(batch["k"].data))
     v = np.asarray(jax.device_get(batch["v"].data))
@@ -65,9 +106,187 @@ def main():
                 "value": round(tpu_mrows, 2),
                 "unit": "Mrows/s",
                 "vs_baseline": round(tpu_mrows / cpu_mrows, 2),
+                "platform": platform,
             }
-        )
+        ),
+        flush=True,
     )
+    return 0
+
+
+# --------------------------------------------------------------------------
+# microbenchmarks (mirror the reference's nvbench targets; --micro)
+# --------------------------------------------------------------------------
+
+def micro_main():
+    import numpy as np
+
+    import jax
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from spark_rapids_jni_tpu.columnar import types as T
+    from spark_rapids_jni_tpu.columnar.column import (
+        Column,
+        ColumnBatch,
+        StringColumn,
+    )
+    from spark_rapids_jni_tpu.ops import bloom_filter as bf
+    from spark_rapids_jni_tpu.ops import cast_string, hashing, row_conversion
+
+    rng = np.random.default_rng(42)
+    results = []
+
+    def run(name, jfn, args, n, unit="Mrows/s", reps=10):
+        try:
+            mrows = _bench_one(jfn, args, n, reps)
+            results.append({"metric": name, "value": round(mrows, 2), "unit": unit})
+        except Exception as e:  # pragma: no cover - diagnostic path
+            results.append({"metric": name, "error": f"{type(e).__name__}: {e}"})
+
+    n = 1 << 20
+    ones = jnp.ones((n,), jnp.bool_)
+    # hash: murmur3 + xxhash64 over int64 column
+    vals = Column(jnp.asarray(rng.integers(-(2**62), 2**62, n)), ones, T.INT64)
+    run("murmur3_int64", jax.jit(lambda c: hashing.murmur_hash3_32([c])), (vals,), n)
+    run("xxhash64_int64", jax.jit(lambda c: hashing.xxhash64([c])), (vals,), n)
+
+    # string→float over padded numeric strings
+    strs = ["%.6f" % x for x in rng.random(1 << 18) * 1e6]
+    sc = StringColumn.from_pylist(strs)
+    run(
+        "string_to_float",
+        jax.jit(lambda c: cast_string.string_to_float(c, T.FLOAT64)),
+        (sc,),
+        len(strs),
+    )
+
+    # bloom build + probe (1M-bit filter)
+    items = Column(jnp.asarray(rng.integers(0, 1 << 40, n)), ones, T.INT64)
+    run(
+        "bloom_build",
+        jax.jit(lambda c: bf.bloom_filter_build(5, 1 << 14, c).bits),
+        (items,),
+        n,
+    )
+    built = bf.bloom_filter_build(5, 1 << 14, items)
+    run(
+        "bloom_probe",
+        jax.jit(lambda b, c: bf.bloom_filter_probe(b, c)),
+        (built, items),
+        n,
+    )
+
+    # row conversion (8 int64 cols → JCUDF rows)
+    m = 1 << 16
+    mones = jnp.ones((m,), jnp.bool_)
+    cb = ColumnBatch(
+        {
+            f"c{i}": Column(jnp.asarray(rng.integers(0, 1 << 30, m)), mones, T.INT64)
+            for i in range(8)
+        }
+    )
+    run(
+        "columns_to_rows_8xi64",
+        jax.jit(lambda b: row_conversion.convert_to_rows(b)),
+        (cb,),
+        m,
+    )
+
+    # group-by (100 keys, sum+count) — mirrors the q6 aggregate stage
+    from spark_rapids_jni_tpu.relational import AggSpec, group_by
+
+    gb = ColumnBatch(
+        {
+            "k": Column(jnp.asarray(rng.integers(0, 100, m)), mones, T.INT32),
+            "v": Column(jnp.asarray(rng.integers(0, 1000, m)), mones, T.INT64),
+        }
+    )
+    run(
+        "group_by_100keys",
+        jax.jit(
+            lambda b: group_by(
+                b, ["k"], [AggSpec("sum", "v", "s"), AggSpec("count", None, "c")]
+            )
+        ),
+        (gb,),
+        m,
+    )
+
+    for r in results:
+        print(json.dumps(r), flush=True)
+    # any per-kernel failure → non-zero rc so the parent retries on CPU
+    return 18 if any("error" in r for r in results) else 0
+
+
+# --------------------------------------------------------------------------
+# parent: fail-soft orchestration
+# --------------------------------------------------------------------------
+
+def _run_child(extra_env, timeout_s, mode):
+    env = dict(os.environ)
+    env.update(extra_env)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), mode],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired as e:
+        if e.stderr:
+            err_txt = e.stderr if isinstance(e.stderr, str) else e.stderr.decode(
+                "utf-8", "replace"
+            )
+            sys.stderr.write(err_txt[-4000:])
+        return None, "timeout"
+    sys.stderr.write(proc.stderr[-4000:])
+    lines = [
+        ln for ln in proc.stdout.splitlines() if ln.startswith("{") and '"metric"' in ln
+    ]
+    if proc.returncode == 0 and lines:
+        return lines, None
+    return None, f"rc={proc.returncode}"
+
+
+def main():
+    mode = sys.argv[1] if len(sys.argv) > 1 else ""
+    if mode == "--child":
+        sys.exit(child_main())
+    if mode == "--child-micro":
+        sys.exit(micro_main())
+
+    run_micro = mode == "--micro"
+    child_mode = "--child-micro" if run_micro else "--child"
+
+    # 1st attempt: whatever backend the environment provides (TPU via axon).
+    lines, err = _run_child({}, TPU_TIMEOUT_S, child_mode)
+    if lines is None:
+        print(f"# accelerator attempt failed ({err}); falling back to CPU",
+              file=sys.stderr, flush=True)
+        lines, err = _run_child(
+            {"BENCH_FORCE_CPU": "1", "JAX_TRACEBACK_FILTERING": "off"},
+            CPU_TIMEOUT_S,
+            child_mode,
+        )
+    if lines is None:
+        # Last resort: still emit a valid line so the harness records
+        # *something*, labeled for the mode that actually failed.
+        metric = "micro_suite" if run_micro else "q6_pipeline_throughput"
+        print(json.dumps({
+            "metric": metric,
+            "value": 0.0,
+            "unit": "Mrows/s",
+            "vs_baseline": 0.0,
+            "error": err,
+        }))
+        sys.exit(0)
+    for ln in lines:
+        print(ln)
+    sys.exit(0)
 
 
 if __name__ == "__main__":
