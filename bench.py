@@ -48,15 +48,21 @@ def _numpy_pipeline(k, v, price):
     return uniq, sums, cnts, avgs
 
 
-def _bench_one(jfn, args, n_rows, reps):
-    """Compile+warm then time ``reps`` steady-state executions."""
+def _bench_one(jfn, args, n_rows, reps, variants=None):
+    """Compile+warm then time ``reps`` steady-state executions.
+
+    The axon backend dedupes identical executions (same fn + same buffers
+    returns in ~30us without running), so reps must cycle through
+    ``variants`` — distinct argument tuples — to measure real work.
+    """
     import jax
 
-    out = jfn(*args)
-    jax.block_until_ready(out)
+    variants = list(variants) if variants else [args]
+    for v in variants:
+        jax.block_until_ready(jfn(*v))
     t0 = time.perf_counter()
-    for _ in range(reps):
-        out = jfn(*args)
+    for r in range(reps):
+        out = jfn(*variants[r % len(variants)])
     jax.block_until_ready(out)
     dt = (time.perf_counter() - t0) / reps
     return n_rows / dt / 1e6  # Mrows/s
@@ -87,8 +93,9 @@ def child_main():
 
     fn = ge._q6_step
     batch = ge._example_batch(N_ROWS)
+    variants = [(ge._example_batch(N_ROWS, seed=7 + i),) for i in range(3)]
     jfn = jax.jit(fn)
-    tpu_mrows = _bench_one(jfn, (batch,), N_ROWS, REPS)
+    tpu_mrows = _bench_one(jfn, (batch,), N_ROWS, REPS, variants=variants)
 
     k = np.asarray(jax.device_get(batch["k"].data))
     v = np.asarray(jax.device_get(batch["v"].data))
@@ -138,10 +145,11 @@ def micro_main():
 
     rng = np.random.default_rng(42)
     results = []
+    V = 3  # input variants per kernel (the backend dedupes identical calls)
 
-    def run(name, jfn, args, n, unit="Mrows/s", reps=10):
+    def run(name, jfn, variants, n, unit="Mrows/s", reps=10):
         try:
-            mrows = _bench_one(jfn, args, n, reps)
+            mrows = _bench_one(jfn, variants[0], n, reps, variants=variants)
             results.append({"metric": name, "value": round(mrows, 2), "unit": unit})
         except Exception as e:  # pragma: no cover - diagnostic path
             results.append({"metric": name, "error": f"{type(e).__name__}: {e}"})
@@ -149,61 +157,77 @@ def micro_main():
     n = 1 << 20
     ones = jnp.ones((n,), jnp.bool_)
     # hash: murmur3 + xxhash64 over int64 column
-    vals = Column(jnp.asarray(rng.integers(-(2**62), 2**62, n)), ones, T.INT64)
-    run("murmur3_int64", jax.jit(lambda c: hashing.murmur_hash3_32([c])), (vals,), n)
-    run("xxhash64_int64", jax.jit(lambda c: hashing.xxhash64([c])), (vals,), n)
+    vals = [
+        (Column(jnp.asarray(rng.integers(-(2**62), 2**62, n)), ones, T.INT64),)
+        for _ in range(V)
+    ]
+    run("murmur3_int64", jax.jit(lambda c: hashing.murmur_hash3_32([c])), vals, n)
+    run("xxhash64_int64", jax.jit(lambda c: hashing.xxhash64([c])), vals, n)
 
     # string→float over padded numeric strings
-    strs = ["%.6f" % x for x in rng.random(1 << 18) * 1e6]
-    sc = StringColumn.from_pylist(strs)
+    scs = [
+        (StringColumn.from_pylist(
+            ["%.6f" % x for x in rng.random(1 << 18) * 1e6], max_len=13),)
+        for _ in range(V)
+    ]
     run(
         "string_to_float",
         jax.jit(lambda c: cast_string.string_to_float(c, T.FLOAT64)),
-        (sc,),
-        len(strs),
+        scs,
+        1 << 18,
     )
 
     # bloom build + probe (1M-bit filter)
-    items = Column(jnp.asarray(rng.integers(0, 1 << 40, n)), ones, T.INT64)
+    items = [
+        (Column(jnp.asarray(rng.integers(0, 1 << 40, n)), ones, T.INT64),)
+        for _ in range(V)
+    ]
     run(
         "bloom_build",
         jax.jit(lambda c: bf.bloom_filter_build(5, 1 << 14, c).bits),
-        (items,),
+        items,
         n,
     )
-    built = bf.bloom_filter_build(5, 1 << 14, items)
+    built = bf.bloom_filter_build(5, 1 << 14, items[0][0])
     run(
         "bloom_probe",
         jax.jit(lambda b, c: bf.bloom_filter_probe(b, c)),
-        (built, items),
+        [(built, it[0]) for it in items],
         n,
     )
 
     # row conversion (8 int64 cols → JCUDF rows)
     m = 1 << 16
     mones = jnp.ones((m,), jnp.bool_)
-    cb = ColumnBatch(
-        {
-            f"c{i}": Column(jnp.asarray(rng.integers(0, 1 << 30, m)), mones, T.INT64)
-            for i in range(8)
-        }
-    )
+    cbs = [
+        (ColumnBatch(
+            {
+                f"c{i}": Column(jnp.asarray(rng.integers(0, 1 << 30, m)), mones,
+                                T.INT64)
+                for i in range(8)
+            }
+        ),)
+        for _ in range(V)
+    ]
     run(
         "columns_to_rows_8xi64",
         jax.jit(lambda b: row_conversion.convert_to_rows(b)),
-        (cb,),
+        cbs,
         m,
     )
 
     # group-by (100 keys, sum+count) — mirrors the q6 aggregate stage
     from spark_rapids_jni_tpu.relational import AggSpec, group_by
 
-    gb = ColumnBatch(
-        {
-            "k": Column(jnp.asarray(rng.integers(0, 100, m)), mones, T.INT32),
-            "v": Column(jnp.asarray(rng.integers(0, 1000, m)), mones, T.INT64),
-        }
-    )
+    gbs = [
+        (ColumnBatch(
+            {
+                "k": Column(jnp.asarray(rng.integers(0, 100, m)), mones, T.INT32),
+                "v": Column(jnp.asarray(rng.integers(0, 1000, m)), mones, T.INT64),
+            }
+        ),)
+        for _ in range(V)
+    ]
     run(
         "group_by_100keys",
         jax.jit(
@@ -211,14 +235,14 @@ def micro_main():
                 b, ["k"], [AggSpec("sum", "v", "s"), AggSpec("count", None, "c")]
             )
         ),
-        (gb,),
+        gbs,
         m,
     )
 
     for r in results:
         print(json.dumps(r), flush=True)
-    # any per-kernel failure → non-zero rc so the parent retries on CPU
-    return 18 if any("error" in r for r in results) else 0
+    # retry on CPU only if NOTHING measured; partial results are kept
+    return 18 if all("error" in r for r in results) else 0
 
 
 # --------------------------------------------------------------------------

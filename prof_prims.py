@@ -1,0 +1,94 @@
+"""Primitive timings on the axon TPU backend.
+
+Methodology: the backend dedupes identical executions (same jitted fn +
+same buffers returns in ~30us), so every rep must vary its input — each
+benchmarked fn takes a `salt` scalar folded into the data — and consume
+the result via a small reduction.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_enable_x64", True)
+
+N = 1 << 21
+rng = np.random.default_rng(0)
+
+
+def bench(name, f, *args, reps=10):
+    jf = jax.jit(f)
+    jax.block_until_ready(jf(jnp.uint32(999), *args))
+    t0 = time.perf_counter()
+    for r in range(reps):
+        out = jf(jnp.uint32(r), *args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name:32s} {dt*1e3:8.2f} ms   {N/dt/1e6:8.1f} Mrows/s", flush=True)
+
+
+key = jnp.asarray(rng.integers(0, 100, N, dtype=np.uint32))
+iota = jnp.arange(N, dtype=jnp.int32)
+pay = [jnp.asarray(rng.integers(0, 2**32, N, dtype=np.uint32)) for _ in range(4)]
+i64 = jnp.asarray(rng.integers(-(2**40), 2**40, N, dtype=np.int64))
+f64 = jnp.asarray(rng.random(N))
+bnd = jnp.asarray(rng.random(N) < 0.01)
+ridx = jnp.asarray(rng.integers(0, N, N, dtype=np.int32))
+perm = jnp.asarray(rng.permutation(N).astype(np.int32))
+
+bench("sort_1key_iota",
+      lambda s, k, i: jax.lax.sort((k ^ s, i), num_keys=1)[0][::65536].sum(),
+      key, iota)
+bench("sort_1key_5pay",
+      lambda s, k, i, *p: jax.lax.sort((k ^ s, i) + p, num_keys=1)[0][::65536].sum(),
+      key, iota, *pay)
+bench("sort_3key_4pay",
+      lambda s, k, i, *p: jax.lax.sort((k ^ s, p[0], p[1], i, p[2], p[3], p[0]),
+                                       num_keys=3)[0][::65536].sum(),
+      key, iota, *pay)
+bench("cumsum_i64",
+      lambda s, v: jnp.cumsum(v ^ jnp.int64(s))[::65536].sum(), i64)
+bench("cumsum_f64",
+      lambda s, v: jnp.cumsum(v + s)[::65536].sum(), f64)
+bench("cumsum_i32",
+      lambda s, v: jnp.cumsum((v ^ s).astype(jnp.int32))[::65536].sum(), key)
+
+
+def seg_cummax(s, v, boundary):
+    def comb(a, b):
+        av, ab = a
+        bv, bb = b
+        return jnp.where(bb, bv, jnp.maximum(av, bv)), ab | bb
+    out, _ = jax.lax.associative_scan(comb, (v ^ jnp.int64(s), boundary))
+    return out[::65536].sum()
+
+
+bench("assoc_segmax_i64", seg_cummax, i64, bnd)
+
+bench("gather_rand_i64",
+      lambda s, i, v: (v ^ jnp.int64(s))[i][::65536].sum(), ridx, i64)
+bench("gather_rand_u32",
+      lambda s, i, v: (v ^ s)[i][::65536].sum(), ridx, key)
+bench("gather_perm_u32",
+      lambda s, i, v: (v ^ s)[i][::65536].sum(), perm, key)
+bench("scatter_set_perm_u32",
+      lambda s, i, v: jnp.zeros((N,), jnp.uint32).at[i].set(v ^ s)[::65536].sum(),
+      perm, key)
+bench("scatter_add_128_u32",
+      lambda s, g, v: jnp.zeros((128,), jnp.uint32).at[(g ^ s) % 128].add(v).sum(),
+      key, key)
+gid = jnp.asarray(rng.integers(0, 128, N, dtype=np.int32))
+bench("segment_sum_128_f32",
+      lambda s, g, v: jax.ops.segment_sum((v + s).astype(jnp.float32), g,
+                                          num_segments=128).sum(), gid, f64)
+
+
+def onehot_f32(s, g, v):
+    oh = (g[:, None] == jnp.arange(128, dtype=jnp.int32)[None, :]).astype(jnp.float32)
+    return ((v + s).astype(jnp.float32) @ oh).sum()
+
+
+bench("onehot_matmul_f32_K128", onehot_f32, gid, f64)
+bench("elementwise_mul", lambda s, v: (v * (1.0 + s)).sum(), f64)
+bench("reduce_sum", lambda s, v: (v + s).sum(), f64)

@@ -1,0 +1,82 @@
+"""Stage-by-stage timing of the q6 pipeline on whatever backend resolves."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import __graft_entry__ as ge
+from spark_rapids_jni_tpu.relational import AggSpec, compact, group_by
+from spark_rapids_jni_tpu.relational import keys as K
+from spark_rapids_jni_tpu.relational.aggregate import _elect_representatives, _hash_words
+
+N = 1 << 21
+batch = ge._example_batch(N)
+
+
+def bench(name, f, *args, reps=10):
+    jf = jax.jit(f)
+    out = jf(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = jf(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name:28s} {dt*1e3:8.2f} ms   {N/dt/1e6:8.1f} Mrows/s", flush=True)
+
+
+print("devices:", jax.devices(), flush=True)
+
+bench("mask_only", lambda b: b["price"].data < 50.0, batch)
+bench("compact", lambda b: compact(b, b["price"].data < 50.0), batch)
+
+
+def elect(b):
+    karr = K.batch_radix_keys([b["k"]], equality=True, nulls_first=True)
+    return _elect_representatives(karr, jnp.ones((N,), jnp.bool_), N)
+
+
+bench("radix+elect", elect, batch)
+
+
+def elect_one_round(b):
+    karr = K.batch_radix_keys([b["k"]], equality=True, nulls_first=True)
+    S = 1 << (2 * N - 1).bit_length()
+    S = min(S, 1 << 22)
+    iota = jnp.arange(N, dtype=jnp.int32)
+    h = _hash_words(karr, jnp.uint32(0))
+    b_ = (h & jnp.uint32(S - 1)).astype(jnp.int32)
+    table = jnp.full((S + 1,), jnp.int32(2**31 - 1), jnp.int32).at[b_].min(iota)
+    cand = jnp.clip(jnp.take(table, b_), 0, N - 1)
+    eq = jnp.ones((N,), jnp.bool_)
+    for k in karr:
+        eq = eq & (k == jnp.take(k, cand))
+    return eq
+
+
+bench("one_election_round", elect_one_round, batch)
+
+
+def segsum(b):
+    gid = (b["k"].data % 100).astype(jnp.int32)
+    return jax.ops.segment_sum(b["v"].data.astype(jnp.int64), gid, num_segments=N + 1)[:N]
+
+
+bench("segment_sum_bigseg", segsum, batch)
+
+
+def segsum_small(b):
+    gid = (b["k"].data % 100).astype(jnp.int32)
+    return jax.ops.segment_sum(b["v"].data.astype(jnp.int64), gid, num_segments=128)
+
+
+bench("segment_sum_128seg", segsum_small, batch)
+
+bench("cumsum_i32", lambda b: jnp.cumsum((b["price"].data < 50.0).astype(jnp.int32)), batch)
+
+bench("group_by_only", lambda b: group_by(b, ["k"], [
+    AggSpec("sum", "v", "s"), AggSpec("count", None, "c"),
+    AggSpec("mean", "price", "m")]), batch)
+
+bench("full_q6", ge._q6_step, batch)

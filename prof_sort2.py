@@ -1,0 +1,58 @@
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = 1 << 21
+rng = np.random.default_rng(0)
+u32 = jnp.asarray(rng.integers(0, 2**32, N, dtype=np.uint32))
+iota = jnp.arange(N, dtype=jnp.int32)
+
+f = jax.jit(lambda x, i: jax.lax.sort((x, i), num_keys=1))
+out = f(u32, iota)
+jax.block_until_ready(out)
+# verify correctness on host
+s = np.asarray(out[0])
+assert (np.diff(s.astype(np.int64)) >= 0).all(), "not sorted!"
+assert (np.sort(np.asarray(u32)) == s).all(), "wrong content!"
+print("sort correct")
+
+for reps in (10, 50):
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = f(u32, iota)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps
+    print(f"sort_u32_pair reps={reps}: {dt*1e3:.3f} ms  {N/dt/1e6:.0f} Mrows/s")
+
+# same but consume output via a cheap reduction to defeat any caching
+g = jax.jit(lambda x, i: jax.lax.sort((x, i), num_keys=1)[0][::65536].sum())
+out = g(u32, iota); jax.block_until_ready(out)
+t0 = time.perf_counter()
+for _ in range(20):
+    out = g(u32, iota)
+jax.block_until_ready(out)
+dt = (time.perf_counter() - t0) / 20
+print(f"sort+reduce: {dt*1e3:.3f} ms  {N/dt/1e6:.0f} Mrows/s")
+
+# varying input each rep (defeat result caching if any)
+h = jax.jit(lambda x, s, i: jax.lax.sort((x ^ s, i), num_keys=1)[0][::65536].sum())
+out = h(u32, jnp.uint32(1), iota); jax.block_until_ready(out)
+t0 = time.perf_counter()
+for r in range(20):
+    out = h(u32, jnp.uint32(r), iota)
+jax.block_until_ready(out)
+dt = (time.perf_counter() - t0) / 20
+print(f"sort varying: {dt*1e3:.3f} ms  {N/dt/1e6:.0f} Mrows/s")
+
+# 8 operands like batch_radix_keys group-by
+ops = tuple(jnp.asarray(rng.integers(0, 2**32, N, dtype=np.uint32)) for _ in range(4))
+k = jax.jit(lambda *a: jax.lax.sort(a + (iota,), num_keys=4)[-1][::65536].sum())
+out = k(*ops); jax.block_until_ready(out)
+t0 = time.perf_counter()
+for _ in range(10):
+    out = k(*ops)
+jax.block_until_ready(out)
+dt = (time.perf_counter() - t0) / 10
+print(f"sort 4keys+payload: {dt*1e3:.3f} ms  {N/dt/1e6:.0f} Mrows/s")

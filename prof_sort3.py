@@ -1,0 +1,53 @@
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_enable_x64", True)
+
+N = 1 << 21
+rng = np.random.default_rng(0)
+
+
+def bench(name, f, *args, reps=20):
+    jf = jax.jit(f)
+    out = jf(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = jf(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name:32s} {dt*1e3:8.2f} ms   {N/dt/1e6:8.1f} Mrows/s", flush=True)
+
+
+key = jnp.asarray(rng.integers(0, 100, N, dtype=np.uint32))
+iota = jnp.arange(N, dtype=jnp.int32)
+pay = [jnp.asarray(rng.integers(0, 2**32, N, dtype=np.uint32)) for _ in range(4)]
+i64 = jnp.asarray(rng.integers(-(2**40), 2**40, N, dtype=np.int64))
+f64 = jnp.asarray(rng.random(N))
+b = jnp.asarray(rng.random(N) < 0.01)
+
+bench("sort_1key_iota", lambda k, i: jax.lax.sort((k, i), num_keys=1)[0], key, iota)
+bench("sort_1key_5payload",
+      lambda k, i, *p: jax.lax.sort((k, i) + p, num_keys=1)[0], key, iota, *pay)
+bench("sort_2key_5payload",
+      lambda k, k2, i, *p: jax.lax.sort((k, k2, i) + p[1:], num_keys=2)[0],
+      key, pay[0], iota, *pay)
+bench("cumsum_i64", lambda v: jnp.cumsum(v), i64)
+bench("cumsum_f64", lambda v: jnp.cumsum(v), f64)
+bench("cumsum_i32", lambda v: jnp.cumsum(v.astype(jnp.int32)), key)
+
+
+def seg_cummax(v, boundary):
+    def comb(a, b):
+        av, ab = a
+        bv, bb = b
+        return jnp.where(bb, bv, jnp.maximum(av, bv)), ab | bb
+    out, _ = jax.lax.associative_scan(comb, (v, boundary))
+    return out
+
+
+bench("assoc_scan_segmax_i64", seg_cummax, i64, b)
+bench("take_small", lambda v: jnp.take(v, jnp.arange(4096, dtype=jnp.int32) * 17), i64)

@@ -21,14 +21,20 @@ from .gather import gather_batch
 def selection_indices(mask):
     """(idx int32[n], count int32): stable front-compaction of True rows.
 
-    ``idx[:count]`` are the positions of the True rows in order; the tail is
-    filled with an arbitrary (clipped) index and masked invalid by callers.
+    ``idx`` is a true permutation: ``idx[:count]`` are the positions of the
+    True rows in order, ``idx[count:]`` the False rows' positions in order.
     """
     n = mask.shape[0]
     mask = mask.astype(jnp.bool_)
     count = mask.sum(dtype=jnp.int32)
-    # stable argsort of (not mask): True rows first, original order preserved
-    idx = jnp.argsort(~mask, stable=True).astype(jnp.int32)
+    # destination of each row: selected rows pack to the front by prefix
+    # count, unselected rows follow — one permutation scatter instead of an
+    # argsort (TPU sorts are the pipeline bottleneck; cumsum+scatter is not)
+    sel_pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+    unsel_pos = count + jnp.cumsum((~mask).astype(jnp.int32)) - 1
+    pos = jnp.where(mask, sel_pos, unsel_pos)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    idx = jnp.zeros((n,), jnp.int32).at[pos].set(iota)
     return idx, count
 
 

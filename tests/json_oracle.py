@@ -1,0 +1,817 @@
+"""Host-side oracle for Spark's ``get_json_object`` semantics.
+
+A direct, readable Python model of the reference's device JSON machinery —
+the pull tokenizer (``/root/reference/src/main/cpp/src/json_parser.cuh``:
+json format, escapes, number validation), and the JSONPath evaluator's
+12-case context-stack machine (``get_json_object.cu:360-788``).  Used ONLY
+as a test oracle: the deliverable TPU kernel (`ops/get_json_object.py`) is
+validated against this model on the reference's golden vectors plus random
+corpora.  Semantics notes mirrored from the reference:
+
+* whitespace is exactly space/tab/newline/carriage-return
+* strings quote with " or ', escapes: \\" \\' \\\\ \\/ \\b \\f \\n \\r \\t
+  and \\uXXXX (each code unit encoded to UTF-8 independently, no surrogate
+  pairing — ``json_parser.cuh:952-991``)
+* a field name containing a ``\\u`` escape never matches a path name
+  (replicates the reference's comparison quirk in ``try_skip_unicode``,
+  ``json_parser.cuh:983-988``)
+* numbers: no leading zeros, '.' needs digits both sides, <=1000 digits
+* max nesting depth 64 (``json_parser.cuh:46``), path depth <=16
+* normalization on output: ints verbatim ("-0" -> "0"); floats through
+  Java ``Double.toString`` (Ryu shortest round-trip), ±Inf as quoted
+  "Infinity"/"-Infinity" (``ftos_converter.cuh:1154-1200``)
+"""
+
+from __future__ import annotations
+
+MAX_DEPTH = 64
+MAX_NUM_LEN = 1000
+MAX_PATH_DEPTH = 16
+
+# tokens
+INIT, SUCCESS, ERROR = "INIT", "SUCCESS", "ERROR"
+START_OBJECT, END_OBJECT = "START_OBJECT", "END_OBJECT"
+START_ARRAY, END_ARRAY = "START_ARRAY", "END_ARRAY"
+FIELD_NAME, VALUE_STRING = "FIELD_NAME", "VALUE_STRING"
+VALUE_NUMBER_INT, VALUE_NUMBER_FLOAT = "VALUE_NUMBER_INT", "VALUE_NUMBER_FLOAT"
+VALUE_TRUE, VALUE_FALSE, VALUE_NULL = "VALUE_TRUE", "VALUE_FALSE", "VALUE_NULL"
+
+# styles
+RAW, QUOTED, FLATTEN = 0, 1, 2
+
+_WS = b" \t\n\r"
+_HEX = b"0123456789abcdefABCDEF"
+_ESC_SHORT = {8: b"\\b", 9: b"\\t", 10: b"\\n", 12: b"\\f", 13: b"\\r"}
+
+
+def java_double_to_json(d: float) -> str:
+    """Java Double.toString, with JSON tweaks: ±Inf quoted, ±0 -> "0.0"."""
+    if d != d:  # NaN cannot arise from a valid JSON number
+        return '"NaN"'
+    if d == float("inf"):
+        return '"Infinity"'
+    if d == float("-inf"):
+        return '"-Infinity"'
+    return java_double_to_string(d)
+
+
+def java_double_to_string(d: float) -> str:
+    """Java ``Double.toString``: shortest round-trip digits, Java layout."""
+    import math
+
+    if d != d:
+        return "NaN"
+    if d == float("inf"):
+        return "Infinity"
+    if d == float("-inf"):
+        return "-Infinity"
+    sign = "-" if (d < 0 or (d == 0 and math.copysign(1.0, d) < 0)) else ""
+    a = abs(d)
+    if a == 0.0:
+        return sign + "0.0"
+    # shortest round-trip digits via repr (Python repr is also shortest)
+    r = repr(a)
+    if "e" in r or "E" in r:
+        mant, _, exp = r.lower().partition("e")
+        exp10 = int(exp)
+    else:
+        mant, exp10 = r, 0
+    if "." in mant:
+        ip, _, fp = mant.partition(".")
+        digits = (ip + fp).lstrip("0")
+        exp10 += len(ip.lstrip("0")) - 1 if ip.lstrip("0") else -(
+            len(fp) - len(fp.lstrip("0")) + 1
+        )
+        digits = digits.lstrip("0") or "0"
+    else:
+        digits = mant.lstrip("0") or "0"
+        exp10 += len(digits) - 1
+    digits = digits.rstrip("0") or "0"
+    # exp10 = floor(log10(a)); Java: plain format iff 1e-3 <= a < 1e7
+    if -3 <= exp10 < 7:
+        if exp10 >= 0:
+            ip = digits[: exp10 + 1].ljust(exp10 + 1, "0")
+            fp = digits[exp10 + 1:] or "0"
+            return f"{sign}{ip}.{fp}"
+        fp = "0" * (-exp10 - 1) + digits
+        return f"{sign}0.{fp}"
+    ip = digits[0]
+    fp = digits[1:] or "0"
+    return f"{sign}{ip}.{fp}E{exp10}"
+
+
+def _codepoint_to_utf8(cp: int) -> bytes:
+    """UTF-8 encode one code unit, surrogates included (matches reference)."""
+    if cp < 0x80:
+        return bytes([cp])
+    if cp < 0x800:
+        return bytes([0xC0 | (cp >> 6), 0x80 | (cp & 0x3F)])
+    return bytes([0xE0 | (cp >> 12), 0x80 | ((cp >> 6) & 0x3F), 0x80 | (cp & 0x3F)])
+
+
+class Tokenizer:
+    """Pull parser over a byte string; mirrors json_parser.cuh semantics."""
+
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+        self.token = INIT
+        self.stack: list[bool] = []  # True=object, False=array
+        self.tok_start = 0
+        self.num_len = 0
+
+    # -- low-level ------------------------------------------------------
+    def _eof(self) -> bool:
+        return self.pos >= len(self.data)
+
+    def _cur(self) -> int:
+        return self.data[self.pos]
+
+    def _skip_ws(self):
+        while not self._eof() and self.data[self.pos] in _WS:
+            self.pos += 1
+
+    # -- string ---------------------------------------------------------
+    def _scan_string(self, start: int):
+        """Validate a quoted string starting at ``start``.
+
+        Returns (ok, end_pos) where end_pos is one past the close quote.
+        """
+        p = start
+        if p >= len(self.data):
+            return False, p
+        quote = self.data[p]
+        p += 1
+        while p < len(self.data):
+            c = self.data[p]
+            if c == quote:
+                return True, p + 1
+            if c == 0x5C:  # backslash
+                p += 1
+                if p >= len(self.data):
+                    return False, p
+                e = self.data[p]
+                if e in b"\"'\\/bfnrt":
+                    p += 1
+                elif e == 0x75:  # u
+                    p += 1
+                    for _ in range(4):
+                        if p >= len(self.data) or self.data[p] not in _HEX:
+                            return False, p
+                        p += 1
+                else:
+                    return False, p
+            else:
+                p += 1  # safe code point or unescaped control char
+        return False, p
+
+    def _string_units(self, start: int, end: int):
+        """Decode string content (between quotes) into semantic units.
+
+        Yields tuples (kind, payload): kind 'raw' = source byte, 'esc' =
+        short escape decoded byte, 'uni' = \\uXXXX code point.
+        """
+        p = start + 1
+        e = end - 1
+        data = self.data
+        while p < e:
+            c = data[p]
+            if c == 0x5C:
+                k = data[p + 1]
+                if k == 0x75:
+                    cp = int(data[p + 2: p + 6].decode("ascii"), 16)
+                    yield "uni", cp
+                    p += 6
+                else:
+                    dec = {
+                        0x22: 0x22, 0x27: 0x27, 0x5C: 0x5C, 0x2F: 0x2F,
+                        0x62: 8, 0x66: 12, 0x6E: 10, 0x72: 13, 0x74: 9,
+                    }[k]
+                    yield ("esc", dec) if k != 0x27 and k != 0x2F else ("raw", dec)
+                    p += 2
+            else:
+                yield "raw", c
+                p += 1
+
+    def _write_string(self, start: int, end: int, escaped: bool) -> bytes:
+        """Reference write_string: unescape source, optionally re-escape."""
+        out = bytearray()
+        if escaped:
+            out.append(0x22)
+        for kind, v in self._string_units(start, end):
+            if kind == "uni":
+                out += _codepoint_to_utf8(v)  # written raw in both styles
+            elif kind == "esc":
+                if escaped:
+                    if v in _ESC_SHORT:
+                        out += _ESC_SHORT[v]
+                    elif v < 32:
+                        out += b"\\u%04X" % v if v >= 16 else b"\\u000" + (
+                            b"%X" % v
+                        )
+                    elif v == 0x22:
+                        out += b'\\"'
+                    elif v == 0x5C:
+                        out += b"\\\\"
+                    else:
+                        out.append(v)
+                else:
+                    out.append(v)
+            else:  # raw source byte
+                if escaped:
+                    if v < 32:
+                        out += _ESC_SHORT.get(v, b"\\u%04X" % v)
+                    elif v == 0x22:
+                        out += b'\\"'
+                    else:
+                        out.append(v)
+                else:
+                    out.append(v)
+        if escaped:
+            out.append(0x22)
+        return bytes(out)
+
+    def match_field_name(self, name: bytes) -> bool:
+        """Compare current FIELD_NAME token against ``name`` (unescaped).
+
+        Replicates the reference quirk: any \\uXXXX escape in the source
+        field name fails the match (json_parser.cuh:983-988).
+        """
+        if self.token != FIELD_NAME:
+            return False
+        got = bytearray()
+        for kind, v in self._string_units(self.tok_start, self.pos):
+            if kind == "uni":
+                return False  # reference comparison quirk
+            got.append(v)
+        return bytes(got) == name
+
+    # -- numbers --------------------------------------------------------
+    def _scan_number(self, start: int):
+        """Validate a number at ``start``; returns (ok, end, is_float)."""
+        data, n = self.data, len(self.data)
+        p = start
+        digits = 0
+        is_float = False
+        if p < n and data[p] == 0x2D:  # '-'
+            p += 1
+        if p >= n:
+            return False, p, False
+        c = data[p]
+        if c == 0x30:  # '0'
+            p += 1
+            digits += 1
+            if p < n and 0x30 <= data[p] <= 0x39:
+                return False, p, False  # leading zero
+        elif 0x31 <= c <= 0x39:
+            while p < n and 0x30 <= data[p] <= 0x39:
+                p += 1
+                digits += 1
+        else:
+            return False, p, False
+        if p < n and data[p] == 0x2E:  # '.'
+            is_float = True
+            p += 1
+            d0 = p
+            while p < n and 0x30 <= data[p] <= 0x39:
+                p += 1
+                digits += 1
+            if p == d0:
+                return False, p, False
+        if p < n and data[p] in b"eE":
+            is_float = True
+            p += 1
+            if p < n and data[p] in b"+-":
+                p += 1
+            d0 = p
+            while p < n and 0x30 <= data[p] <= 0x39:
+                p += 1
+                digits += 1
+            if p == d0:
+                return False, p, False
+        if digits > MAX_NUM_LEN:
+            return False, p, False
+        return True, p, is_float
+
+    # -- value dispatch -------------------------------------------------
+    def _first_token_in_value(self):
+        self.tok_start = self.pos
+        c = self._cur()
+        if c == 0x7B:  # {
+            if len(self.stack) >= MAX_DEPTH:
+                self.token = ERROR
+                return
+            self.stack.append(True)
+            self.pos += 1
+            self.token = START_OBJECT
+        elif c == 0x5B:  # [
+            if len(self.stack) >= MAX_DEPTH:
+                self.token = ERROR
+                return
+            self.stack.append(False)
+            self.pos += 1
+            self.token = START_ARRAY
+        elif c in (0x22, 0x27):
+            ok, end = self._scan_string(self.pos)
+            if ok:
+                self.pos = end
+                self.token = VALUE_STRING
+            else:
+                self.token = ERROR
+        elif c == 0x74:  # t
+            if self.data[self.pos: self.pos + 4] == b"true":
+                self.pos += 4
+                self.token = VALUE_TRUE
+            else:
+                self.token = ERROR
+        elif c == 0x66:  # f
+            if self.data[self.pos: self.pos + 5] == b"false":
+                self.pos += 5
+                self.token = VALUE_FALSE
+            else:
+                self.token = ERROR
+        elif c == 0x6E:  # n
+            if self.data[self.pos: self.pos + 4] == b"null":
+                self.pos += 4
+                self.token = VALUE_NULL
+            else:
+                self.token = ERROR
+        else:
+            ok, end, is_float = self._scan_number(self.pos)
+            if ok:
+                self.num_len = end - self.pos
+                self.pos = end
+                self.token = (
+                    VALUE_NUMBER_FLOAT if is_float else VALUE_NUMBER_INT
+                )
+            else:
+                self.token = ERROR
+
+    def _field_name(self):
+        self.tok_start = self.pos
+        if self._eof() or self._cur() not in (0x22, 0x27):
+            self.token = ERROR
+            return
+        ok, end = self._scan_string(self.pos)
+        if ok:
+            self.pos = end
+            self.token = FIELD_NAME
+        else:
+            self.token = ERROR
+
+    def next_token(self):
+        if self.token == ERROR:
+            return ERROR
+        self._skip_ws()
+        if not self._eof():
+            c = self._cur()
+            if not self.stack:
+                if self.token == INIT:
+                    self._first_token_in_value()
+                else:
+                    self.token = SUCCESS  # trailing content ignored
+            elif self.stack[-1]:  # object context
+                if self.token == START_OBJECT:
+                    if c == 0x7D:  # }
+                        self.tok_start = self.pos
+                        self.pos += 1
+                        self.stack.pop()
+                        self.token = END_OBJECT
+                    else:
+                        self._field_name()
+                elif self.token == FIELD_NAME:
+                    if c == 0x3A:  # :
+                        self.pos += 1
+                        self._skip_ws()
+                        if self._eof():
+                            self.token = ERROR
+                        else:
+                            self._first_token_in_value()
+                    else:
+                        self.token = ERROR
+                else:
+                    if c == 0x7D:
+                        self.tok_start = self.pos
+                        self.pos += 1
+                        self.stack.pop()
+                        self.token = END_OBJECT
+                    elif c == 0x2C:  # ,
+                        self.pos += 1
+                        self._skip_ws()
+                        self._field_name()
+                    else:
+                        self.token = ERROR
+            else:  # array context
+                if self.token == START_ARRAY:
+                    if c == 0x5D:  # ]
+                        self.tok_start = self.pos
+                        self.pos += 1
+                        self.stack.pop()
+                        self.token = END_ARRAY
+                    else:
+                        self._first_token_in_value()
+                else:
+                    if c == 0x2C:
+                        self.pos += 1
+                        self._skip_ws()
+                        if self._eof():
+                            self.token = ERROR
+                        else:
+                            self._first_token_in_value()
+                    elif c == 0x5D:
+                        self.tok_start = self.pos
+                        self.pos += 1
+                        self.stack.pop()
+                        self.token = END_ARRAY
+                    else:
+                        self.token = ERROR
+        else:
+            if not self.stack and self.token != INIT:
+                self.token = SUCCESS
+            else:
+                self.token = ERROR
+        return self.token
+
+    # -- writers --------------------------------------------------------
+    def write_current(self, escaped: bool) -> bytes:
+        """write_unescaped_text / write_escaped_text for the current token."""
+        t = self.token
+        if t in (VALUE_STRING, FIELD_NAME):
+            return self._write_string(self.tok_start, self.pos, escaped)
+        if t == VALUE_NUMBER_INT:
+            span = self.data[self.tok_start: self.pos]
+            if span == b"-0":
+                return b"0"
+            return span
+        if t == VALUE_NUMBER_FLOAT:
+            d = float(self.data[self.tok_start: self.pos])
+            return java_double_to_json(d).encode()
+        if t == VALUE_TRUE:
+            return b"true"
+        if t == VALUE_FALSE:
+            return b"false"
+        if t == VALUE_NULL:
+            return b"null"
+        if t == START_ARRAY:
+            return b"["
+        if t == END_ARRAY:
+            return b"]"
+        if t == START_OBJECT:
+            return b"{"
+        if t == END_OBJECT:
+            return b"}"
+        return b""
+
+    def try_skip_children(self) -> bool:
+        if self.token in (ERROR, INIT, SUCCESS):
+            return False
+        if self.token not in (START_OBJECT, START_ARRAY):
+            return True
+        open_ = 1
+        while True:
+            t = self.next_token()
+            if t in (START_OBJECT, START_ARRAY):
+                open_ += 1
+            elif t in (END_OBJECT, END_ARRAY):
+                open_ -= 1
+                if open_ == 0:
+                    return True
+            elif t == ERROR:
+                return False
+
+    def copy_current_structure(self, gen: "Generator") -> bool:
+        """Copy current token subtree in normalized escaped form."""
+        t = self.token
+        if t in (INIT, ERROR, SUCCESS, FIELD_NAME, END_ARRAY, END_OBJECT):
+            return False
+        if t not in (START_ARRAY, START_OBJECT):
+            gen.out += self.write_current(escaped=True)
+            return True
+        depth0 = len(self.stack)
+        gen.out += self.write_current(escaped=True)
+        prev = self.token
+        while True:
+            self._skip_ws()
+            comma = colon = False
+            # peek separators the same way parse_next_token does
+            if not self._eof() and self.stack:
+                c = self._cur()
+                if self.stack[-1] and self.token == FIELD_NAME and c == 0x3A:
+                    colon = True
+                elif c == 0x2C and self.token not in (START_OBJECT, START_ARRAY):
+                    comma = True
+            t = self.next_token()
+            if t == ERROR:
+                return False
+            if comma:
+                gen.out += b","
+            if colon:
+                gen.out += b":"
+            gen.out += self.write_current(escaped=True)
+            if len(self.stack) == depth0 - 1:
+                return True
+            prev = t
+
+
+class Generator:
+    """json_generator: array-context comma tracking + child buffering."""
+
+    def __init__(self):
+        self.out = bytearray()
+        self.array_depth = 0
+        self.curr_empty = True
+
+    def need_comma(self) -> bool:
+        return self.array_depth > 0 and not self.curr_empty
+
+    def try_write_comma(self):
+        if self.need_comma():
+            self.out += b","
+
+    def write_start_array(self):
+        self.try_write_comma()
+        self.out += b"["
+        self.array_depth += 1
+        self.curr_empty = True
+
+    def write_end_array(self):
+        self.out += b"]"
+        self.array_depth -= 1
+        self.curr_empty = False
+
+    def mark_written(self):
+        if self.array_depth > 0:
+            self.curr_empty = False
+
+
+def _parse_path(path: str):
+    """'$.a[3].b[*]' -> [('named', b'a'), ('index', 3), ('named', b'b'),
+    ('wildcard',)] — the instruction list JSONUtils.java ships to native."""
+    out = []
+    i = 0
+    if path.startswith("$"):
+        i = 1
+    while i < len(path):
+        c = path[i]
+        if c == ".":
+            i += 1
+            j = i
+            while j < len(path) and path[j] not in ".[":
+                j += 1
+            name = path[i:j]
+            if name == "*":
+                out.append(("wildcard",))
+            else:
+                out.append(("named", name.encode()))
+            i = j
+        elif c == "[":
+            j = path.index("]", i)
+            inner = path[i + 1: j].strip()
+            if inner == "*":
+                out.append(("wildcard",))
+            elif inner.startswith("'"):
+                out.append(("named", inner.strip("'").encode()))
+            else:
+                out.append(("index", int(inner)))
+            i = j + 1
+        else:
+            raise ValueError(f"bad path {path!r} at {i}")
+    return out
+
+
+def get_json_object(json_str, path: str):
+    """Oracle entry: returns the extracted string or None (Spark NULL)."""
+    if json_str is None:
+        return None
+    instructions = _parse_path(path) if isinstance(path, str) else list(path)
+    if len(instructions) > MAX_PATH_DEPTH:
+        return None
+    data = json_str.encode() if isinstance(json_str, str) else bytes(json_str)
+    p = Tokenizer(data)
+    if p.next_token() == ERROR:
+        return None
+    root = Generator()
+    # root context dirty tracking needs the final dirty of the root ctx;
+    # evaluate via a wrapper that records it
+    ok, dirty = _evaluate_root(p, root, RAW, instructions)
+    if not ok or dirty <= 0:
+        return None
+    return bytes(root.out).decode("utf-8", "replace")
+
+
+def _evaluate_root(parser, root_gen, style, path):
+    """evaluate_path returning (valid, root_dirty)."""
+
+    # reuse evaluate_path but capture root dirty: re-implement the pop for
+    # the root by pushing a sentinel parent
+    class Root:
+        dirty = 0
+
+    sentinel = Root()
+
+    ok = _evaluate(parser, root_gen, style, path, sentinel)
+    return ok, sentinel.dirty
+
+
+def _evaluate(parser, root_gen, root_style, root_path, sentinel):
+    # Wrap evaluate_path's machinery, but record the root context's dirty
+    # into sentinel before returning.
+    class _G(Generator):
+        pass
+
+    # evaluate_path above returns only validity; replicate with root dirty:
+    p = parser
+
+    class Ctx:
+        __slots__ = ("token", "case_path", "g", "style", "path", "done",
+                     "dirty", "first", "child_g")
+
+        def __init__(self, token, case_path, g, style, path):
+            self.token = token
+            self.case_path = case_path
+            self.g = g
+            self.style = style
+            self.path = tuple(path)
+            self.done = False
+            self.dirty = 0
+            self.first = True
+            self.child_g = None
+
+    root_ctx = Ctx(p.token, -1, root_gen, root_style, root_path)
+    stack = [root_ctx]
+    # identical body to evaluate_path, kept in one place:
+    result = _run_machine(p, stack, Ctx)
+    sentinel.dirty = root_ctx.dirty
+    return result
+
+
+def _run_machine(p, stack, Ctx):
+    while stack:
+        ctx = stack[-1]
+        if not ctx.done:
+            path = ctx.path
+            tok = ctx.token
+            if tok == VALUE_STRING and not path and ctx.style == RAW:
+                ctx.g.mark_written()
+                ctx.g.out += p.write_current(escaped=False)
+                ctx.dirty = 1
+                ctx.done = True
+            elif tok == START_ARRAY and not path and ctx.style == FLATTEN:
+                if p.next_token() != END_ARRAY:
+                    if p.token == ERROR:
+                        return False
+                    stack.append(Ctx(p.token, 2, ctx.g, ctx.style, ()))
+                else:
+                    ctx.done = True
+            elif not path:
+                ctx.g.try_write_comma()
+                ctx.g.mark_written()
+                if not p.copy_current_structure(ctx.g):
+                    return False
+                ctx.dirty = 1
+                ctx.done = True
+            elif tok == START_OBJECT and path[0][0] == "named":
+                if not ctx.first:
+                    if ctx.dirty > 0:
+                        while p.next_token() != END_OBJECT:
+                            if p.token == ERROR:
+                                return False
+                            p.next_token()
+                            if p.token == ERROR:
+                                return False
+                            if not p.try_skip_children():
+                                return False
+                        ctx.done = True
+                    else:
+                        return False
+                else:
+                    ctx.first = False
+                    found = False
+                    while p.next_token() != END_OBJECT:
+                        if p.token == ERROR:
+                            return False
+                        if p.match_field_name(path[0][1]):
+                            p.next_token()
+                            if p.token == ERROR:
+                                return False
+                            if p.token == VALUE_NULL:
+                                return False
+                            stack.append(
+                                Ctx(p.token, 4, ctx.g, ctx.style, path[1:]))
+                            found = True
+                            break
+                        else:
+                            p.next_token()
+                            if p.token == ERROR:
+                                return False
+                            if not p.try_skip_children():
+                                return False
+                    if not found:
+                        ctx.done = True
+                        ctx.dirty = 0
+            elif (tok == START_ARRAY and len(path) >= 2
+                  and path[0][0] == "wildcard" and path[1][0] == "wildcard"):
+                if ctx.first:
+                    ctx.first = False
+                    ctx.g.write_start_array()
+                if p.next_token() != END_ARRAY:
+                    if p.token == ERROR:
+                        return False
+                    stack.append(Ctx(p.token, 5, ctx.g, FLATTEN, path[2:]))
+                else:
+                    ctx.g.write_end_array()
+                    ctx.done = True
+            elif (tok == START_ARRAY and path[0][0] == "wildcard"
+                  and ctx.style != QUOTED):
+                next_style = QUOTED if ctx.style == RAW else FLATTEN
+                if ctx.first:
+                    ctx.first = False
+                    child = Generator()
+                    child.array_depth = 1
+                    child.curr_empty = True
+                    ctx.child_g = child
+                child = ctx.child_g
+                if p.next_token() != END_ARRAY:
+                    if p.token == ERROR:
+                        return False
+                    stack.append(Ctx(p.token, 6, child, next_style, path[1:]))
+                else:
+                    body = bytes(child.out)
+                    if ctx.dirty > 1:
+                        ctx.g.try_write_comma()
+                        ctx.g.mark_written()
+                        ctx.g.out += b"[" + body + b"]"
+                        ctx.done = True
+                    elif ctx.dirty == 1:
+                        ctx.g.try_write_comma()
+                        ctx.g.mark_written()
+                        ctx.g.out += body
+                        ctx.done = True
+                    else:
+                        return False
+            elif tok == START_ARRAY and path[0][0] == "wildcard":
+                if ctx.first:
+                    ctx.first = False
+                    ctx.g.write_start_array()
+                if p.next_token() != END_ARRAY:
+                    if p.token == ERROR:
+                        return False
+                    stack.append(Ctx(p.token, 7, ctx.g, QUOTED, path[1:]))
+                else:
+                    ctx.g.write_end_array()
+                    ctx.done = True
+            elif (tok == START_ARRAY and len(path) >= 2
+                  and path[0][0] == "index" and path[1][0] == "wildcard"):
+                idx = path[0][1]
+                p.next_token()
+                if p.token == ERROR:
+                    return False
+                ctx.first = False
+                for _ in range(idx):
+                    if p.token == END_ARRAY:
+                        return False
+                    if not p.try_skip_children():
+                        return False
+                    p.next_token()
+                    if p.token == ERROR:
+                        return False
+                stack.append(Ctx(p.token, 8, ctx.g, QUOTED, path[1:]))
+            elif tok == START_ARRAY and path[0][0] == "index":
+                idx = path[0][1]
+                p.next_token()
+                if p.token == ERROR:
+                    return False
+                for _ in range(idx):
+                    if p.token == END_ARRAY:
+                        return False
+                    if not p.try_skip_children():
+                        return False
+                    p.next_token()
+                    if p.token == ERROR:
+                        return False
+                stack.append(Ctx(p.token, 9, ctx.g, ctx.style, path[1:]))
+            else:
+                if not p.try_skip_children():
+                    return False
+                ctx.dirty = 0
+                ctx.done = True
+        else:
+            stack.pop()
+            if stack:
+                parent = stack[-1]
+                if ctx.case_path in (2, 5, 7):
+                    parent.dirty += ctx.dirty
+                elif ctx.case_path == 4:
+                    parent.dirty = ctx.dirty
+                elif ctx.case_path == 6:
+                    parent.dirty += ctx.dirty
+                    parent.child_g = ctx.g
+                elif ctx.case_path in (8, 9):
+                    parent.dirty += ctx.dirty
+                    while p.next_token() != END_ARRAY:
+                        if p.token == ERROR:
+                            return False
+                        if not p.try_skip_children():
+                            return False
+                    parent.done = True
+    return True

@@ -1,0 +1,178 @@
+"""get_json_object golden vectors.
+
+Vectors transcribed from the reference's JUnit suite
+(``/root/reference/src/test/java/com/nvidia/spark/rapids/jni/GetJsonObjectTest.java``)
+— each case lists (json, path, expected).  They are run twice: against the
+host oracle (tests/json_oracle.py) and against the device kernel
+(ops/get_json_object.py) once it lands.
+"""
+
+import pytest
+
+from tests import json_oracle as J
+
+W = ("wildcard",)
+
+
+def N(s):
+    return ("named", s.encode())
+
+
+def I(i):
+    return ("index", i)
+
+
+BAIDU_JSON = (
+    '{"brand":"ssssss","duratRon":15,"eqTosuresurl":"","RsZxarthrl":false,'
+    '"xonRtorsurl":"","xonRtorsurlstOTe":0,"TRctures":[{"RxaGe":"VttTs:\\/\\/'
+    'feed-RxaGe.baRdu.cox\\/0\\/TRc\\/-196588744s840172444s-773690137.zTG"}],'
+    '"Toster":"VttTs:\\/\\/feed-RxaGe.baRdu.cox\\/0\\/TRc\\/-196588744s8401724'
+    '44s-773690137.zTG","reserUed":{"bRtLate":391.79,"xooUZRke":26876,"nahrlIe'
+    'neratRonNOTe":0,"useJublRc":6,"URdeoRd":821284086},"tRtle":"ssssssssssmM'
+    'sssssssssssssssssss","url":"s{storehrl}","usersTortraRt":"VttTs:\\/\\/fee'
+    'd-RxaGe.baRdu.cox\\/0\\/TRc\\/-6971178959s-664926866s-6096674871.zTG",'
+    '"URdeosurl":"http:\\/\\/nadURdeo2.baRdu.cox\\/5fa3893aed7fc0f8231dab7be23'
+    'efc75s820s6240.xT3","URdeoRd":821284086}'
+)
+
+# (json, path_instructions, expected)
+GOLDEN = [
+    # getJsonObjectTest: $.k
+    ('{"k": "v"}', [N("k")], "v"),
+    # getJsonObjectTest2/3/4: deep named paths
+    ('{"k1":{"k2":"v2"}}', [N("k1"), N("k2")], "v2"),
+    (
+        '{"k1":{"k2":{"k3":{"k4":{"k5":{"k6":{"k7":{"k8":"v8"}}}}}}}}',
+        [N(f"k{i}") for i in range(1, 9)],
+        "v8",
+    ),
+    # Baidu unescape case
+    (
+        BAIDU_JSON,
+        [N("URdeosurl")],
+        "http://nadURdeo2.baRdu.cox/5fa3893aed7fc0f8231dab7be23efc75s820s6240.xT3",
+    ),
+    (BAIDU_JSON, [N("Vgdezsurl")], None),
+    # escape tests
+    ('{ "a": "A" }', [], '{"a":"A"}'),
+    ("{'a':'A\"'}", [], '{"a":"A\\""}'),
+    ("{'a':\"B'\"}", [], '{"a":"B\'"}'),
+    ("['a','b','\"C\"']", [], '["a","b","\\"C\\""]'),
+    (
+        "'\\u4e2d\\u56FD\\\"\\'\\\\\\/\\b\\f\\n\\r\\t\\b'",
+        [],
+        "中国\"'\\/\b\f\n\r\t\b",
+    ),
+    (
+        "['\\u4e2d\\u56FD\\\"\\'\\\\\\/\\b\\f\\n\\r\\t\\b']",
+        [],
+        '["中国\\"\'\\\\/\\b\\f\\n\\r\\t\\b"]',
+    ),
+    # number normalization
+    ("[100.0,200.000,351.980]", [], "[100.0,200.0,351.98]"),
+    ("[12345678900000000000.0]", [], "[1.23456789E19]"),
+    ("[0.0]", [], "[0.0]"),
+    ("[-0.0]", [], "[-0.0]"),
+    ("[-0]", [], "[0]"),
+    ("[12345678999999999999999999]", [], "[12345678999999999999999999]"),
+    ("[9.299999257686047e-0005603333574677677]", [], "[0.0]"),
+    ("9.299999257686047e0005603333574677677", [], '"Infinity"'),
+    ("[1E308]", [], "[1.0E308]"),
+    ("[1.0E309,-1E309,1E5000]", [], '["Infinity","-Infinity","Infinity"]'),
+    ("0.3", [], "0.3"),
+    ("0.03", [], "0.03"),
+    ("0.003", [], "0.003"),
+    ("0.0003", [], "3.0E-4"),
+    ("0.00003", [], "3.0E-5"),
+    # leading zeros invalid
+    ("00", [], None),
+    ("01", [], None),
+    ("02", [], None),
+    ("000", [], None),
+    ("-01", [], None),
+    ("-00", [], None),
+    ("-02", [], None),
+    # index paths
+    (
+        "[ [0, 1, 2] , [10, [11], [121, 122, 123], 13] ,  [20, 21, 22]]",
+        [I(1)],
+        "[10,[11],[121,122,123],13]",
+    ),
+    (
+        "[ [0, 1, 2] , [10, [11], [121, 122, 123], 13] ,  [20, 21, 22]]",
+        [I(1), I(2)],
+        "[121,122,123]",
+    ),
+    # case path 1
+    ("'abc'", [], "abc"),
+    # case path 2 ($[*][*] flatten)
+    (
+        "[ [11, 12], [21, [221, [2221, [22221, 22222]]]], [31, 32] ]",
+        [W, W],
+        "[11,12,21,221,2221,22221,22222,31,32]",
+    ),
+    # case path 3
+    ("123", [], "123"),
+    # case path 4
+    ("{ 'k' : 'v'  }", [N("k")], "v"),
+    # case path 5
+    (
+        "[  [[[ {'k': 'v1'} ], {'k': 'v2'}]], [[{'k': 'v3'}], {'k': 'v4'}], "
+        "{'k': 'v5'}  ]",
+        [W, W, N("k")],
+        '["v5"]',
+    ),
+    # case path 6
+    ("[1, [21, 22], 3]", [W], "[1,[21,22],3]"),
+    ("[1]", [W], "1"),
+    # case path 7
+    (
+        "[ {'k': [0, 1, 2]}, {'k': [10, 11, 12]}, {'k': [20, 21, 22]}  ]",
+        [W, N("k"), W],
+        "[[0,1,2],[10,11,12],[20,21,22]]",
+    ),
+    # case path 8
+    ("[ [0], [10, 11, 12], [2] ]", [I(1), W], "[10,11,12]"),
+    # case path 9
+    (
+        "[[0, 1, 2], [10, [111, 112, 113], 12], [20, 21, 22]]",
+        [I(1), I(1), W],
+        "[111,112,113]",
+    ),
+    ("[[0, 1, 2], [10, [], 12], [20, 21, 22]]", [I(1), I(1), W], None),
+    # case path 10
+    ("{'k' : [0,1,2]}", [N("k"), I(1)], "1"),
+    ("{'k' : null}", [N("k"), I(1)], None),
+    # case path 11 ($.* over object)
+    ("{'k' : [0,1,2]}", [W], None),
+    ("{'k' : null}", [W], None),
+    # case path 12
+    ("123", [W], None),
+    # comma / outer array insertion
+    ("[ [11, 12], [21, 22]]", [W, W, W], "[[11,12],[21,22]]"),
+    ("[ [11], [22] ]", [W, W, W], "[11,22]"),
+    # unterminated string
+    ("{'a':'v1'}", [N("a")], "v1"),
+    ("{'a':\"b\"c\"}", [N("a")], None),
+]
+
+
+@pytest.mark.parametrize("json,path,expected", GOLDEN)
+def test_oracle_golden(json, path, expected):
+    assert J.get_json_object(json, path) == expected
+
+
+def test_oracle_long_key():
+    k = "k1_" + "1" * 97
+    v = "v1_" + "1" * 97
+    json = '{"%s":"%s"}' % (k, v)
+    assert J.get_json_object(json, [("named", k.encode())]) == v
+
+
+def test_oracle_none_input():
+    assert J.get_json_object(None, [N("k")]) is None
+
+
+def test_oracle_path_depth_cap():
+    json = "{}"
+    assert J.get_json_object(json, [N("k")] * 17) is None

@@ -144,14 +144,14 @@ class TestGroupBy:
         )
         assert int(ng) == 3
         got = trimmed(out, ng)
-        # group order: nulls first, then 1, 2
-        assert got["k"] == [None, 1, 2]
-        assert got["s"] == [99, 40, 60]
-        assert got["c"] == [1, 2, 2]
-        assert got["cstar"] == [1, 3, 2]
-        assert got["mn"] == [99, 10, 20]
-        assert got["mx"] == [99, 30, 40]
-        assert got["avg"] == [99.0, 20.0, 30.0]
+        # group order: first occurrence — 1, 2, then the null group
+        assert got["k"] == [1, 2, None]
+        assert got["s"] == [40, 60, 99]
+        assert got["c"] == [2, 2, 1]
+        assert got["cstar"] == [3, 2, 1]
+        assert got["mn"] == [10, 20, 99]
+        assert got["mx"] == [30, 40, 99]
+        assert got["avg"] == [20.0, 30.0, 99.0]
 
     def test_all_null_group_sum_is_null(self):
         b = ColumnBatch(
@@ -174,7 +174,7 @@ class TestGroupBy:
         out, ng = group_by(b, ["k"], [AggSpec("sum", "v", "s")])
         assert int(ng) == 3
         got = trimmed(out, ng)
-        assert got["k"] == [None, "a", "b"]
+        assert got["k"] == ["b", "a", None]
         assert got["s"] == [4, 13, 4]
 
     def test_multi_key(self):
@@ -323,8 +323,8 @@ class TestReviewRegressions:
         out, ng = group_by(masked, ["k"], [AggSpec("count", None, "c")])
         assert int(ng) == 2
         got = trimmed(out, ng)
-        assert got["k"] == [None, 1]
-        assert got["c"] == [2, 1]
+        assert got["k"] == [1, None]
+        assert got["c"] == [1, 2]
 
     def test_empty_build_side(self):
         left = ColumnBatch({"k": ints([1, 2]), "lv": ints([10, 20])})
