@@ -27,8 +27,8 @@ import sys
 import time
 
 N_ROWS = int(os.environ.get("BENCH_N_ROWS", 1 << 21))  # 2M
-REPS = int(os.environ.get("BENCH_REPS", 20))
-TPU_TIMEOUT_S = int(os.environ.get("BENCH_TPU_TIMEOUT_S", "900"))
+REPS = int(os.environ.get("BENCH_REPS", 8))
+TPU_TIMEOUT_S = int(os.environ.get("BENCH_TPU_TIMEOUT_S", "1500"))
 CPU_TIMEOUT_S = int(os.environ.get("BENCH_CPU_TIMEOUT_S", "900"))
 
 
@@ -93,7 +93,7 @@ def child_main():
 
     fn = ge._q6_step
     batch = ge._example_batch(N_ROWS)
-    variants = [(ge._example_batch(N_ROWS, seed=7 + i),) for i in range(3)]
+    variants = [(ge._example_batch(N_ROWS, seed=7 + i),) for i in range(2)]
     jfn = jax.jit(fn)
     tpu_mrows = _bench_one(jfn, (batch,), N_ROWS, REPS, variants=variants)
 

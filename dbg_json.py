@@ -1,0 +1,35 @@
+"""Debug driver: device get_json_object vs oracle on non-wildcard goldens."""
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import sys
+
+sys.path.insert(0, "tests")
+import json_oracle as J  # noqa: E402
+
+from spark_rapids_jni_tpu.columnar.column import StringColumn  # noqa: E402
+from spark_rapids_jni_tpu.ops.get_json_object import get_json_object  # noqa: E402
+
+sys.path.insert(0, ".")
+from tests.test_get_json_object import GOLDEN  # noqa: E402
+
+cases = [(j, p, e) for (j, p, e) in GOLDEN
+         if not any(ins[0] == "wildcard" for ins in p)]
+print(f"{len(cases)} non-wildcard golden cases")
+
+fails = 0
+for jsn, path, expected in cases:
+    got_oracle = J.get_json_object(jsn, path)
+    col = StringColumn.from_pylist([jsn])
+    try:
+        out = get_json_object(col, path)
+        got = out.to_pylist()[0]
+    except Exception as e:
+        got = f"<EXC {type(e).__name__}: {e}>"
+    ok = got == expected
+    if not ok:
+        fails += 1
+        print(f"FAIL json={jsn!r:60.60} path={path!r}")
+        print(f"     expected={expected!r} got={got!r} oracle={got_oracle!r}")
+print(f"{len(cases) - fails}/{len(cases)} pass")

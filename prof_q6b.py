@@ -1,0 +1,31 @@
+"""q6 step timing via slope method at two batch sizes."""
+import time
+
+import jax
+import numpy as np
+
+import __graft_entry__ as ge
+
+
+def slope(jfn, batches):
+    for b in batches:
+        np.asarray(jax.device_get(jfn(b)[1]))  # warm + force input residency
+
+    def run(k):
+        t0 = time.perf_counter()
+        outs = [jfn(b) for b in batches[:k]]
+        for o in outs:
+            np.asarray(jax.device_get(o[1]))
+        return time.perf_counter() - t0
+
+    t2, t8 = run(2), run(len(batches))
+    return (t8 - t2) / (len(batches) - 2)
+
+
+jfn = jax.jit(ge._q6_step)
+for logn in (21, 23):
+    N = 1 << logn
+    batches = [ge._example_batch(N, seed=s) for s in range(8)]
+    per = slope(jfn, batches)
+    print(f"q6 N=2^{logn}: {per*1e3:8.1f} ms/exec  {N/per/1e6:8.1f} Mrows/s",
+          flush=True)

@@ -1,0 +1,48 @@
+"""Scaling sanity: if measured time doesn't scale with N, measurement is broken."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_enable_x64", True)
+
+
+def bench(name, N, f, *args, reps=10):
+    jf = jax.jit(f)
+    jax.block_until_ready(jf(jnp.uint32(999), *args))
+    t0 = time.perf_counter()
+    for r in range(reps):
+        out = jf(jnp.uint32(r), *args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name:34s} {dt*1e3:9.3f} ms   {N/dt/1e6:9.1f} Mrows/s", flush=True)
+
+
+rng = np.random.default_rng(0)
+for logn in (21, 24):
+    N = 1 << logn
+    key = jnp.asarray(rng.integers(0, 2**32, N, dtype=np.uint32))
+    iota = jnp.arange(N, dtype=jnp.int32)
+    i64 = jnp.asarray(rng.integers(-(2**40), 2**40, N, dtype=np.int64))
+    ridx = jnp.asarray(rng.integers(0, N, N, dtype=np.int32))
+    gid = jnp.asarray(rng.integers(0, 100, N, dtype=np.int32))
+
+    bench(f"sort_pair_N=2^{logn}", N,
+          lambda s, k, i: jax.lax.sort((k ^ s, i), num_keys=1)[0][::4096].sum(),
+          key, iota)
+    bench(f"gather_rand_N=2^{logn}", N,
+          lambda s, i, v: (v ^ jnp.int64(s))[i][::4096].sum(), ridx, i64)
+    bench(f"segsum_bigseg_N=2^{logn}", N,
+          lambda s, g, v: jax.ops.segment_sum(v ^ jnp.int64(s), g,
+                                              num_segments=N)[::4096].sum(),
+          gid, i64)
+    bench(f"segsum_128_N=2^{logn}", N,
+          lambda s, g, v: jax.ops.segment_sum(v ^ jnp.int64(s), g,
+                                              num_segments=128).sum(),
+          gid, i64)
+    bench(f"scatter_min_tbl_N=2^{logn}", N,
+          lambda s, g, v: jnp.full((N,), jnp.int32(2**31 - 1), jnp.int32)
+          .at[(v ^ jnp.int64(s)).astype(jnp.uint32) & jnp.uint32(N - 1)]
+          .min(jnp.arange(N, dtype=jnp.int32))[::4096].sum(),
+          gid, i64)

@@ -1,0 +1,36 @@
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+t0 = time.time()
+
+
+def log(m):
+    print(f"[{time.time()-t0:7.1f}s] {m}", file=sys.stderr, flush=True)
+
+
+log(f"devices {jax.devices()}")
+N = 1 << 18
+rng = np.random.default_rng(0)
+k = jnp.asarray(rng.integers(0, 100, N, dtype=np.uint32))
+iota = jnp.arange(N, dtype=jnp.int32)
+v64 = jnp.asarray(rng.integers(-(2**40), 2**40, N, dtype=np.int64))
+f64 = jnp.asarray(rng.random(N))
+b = jnp.asarray(rng.random(N) < 0.5)
+
+for name, ops, nk in [
+    ("u32key+iota", (k, iota), 1),
+    ("u32key+i64pay", (k, iota, v64), 1),
+    ("u32key+bool", (k, iota, b), 1),
+    ("u32key+f64", (k, iota, f64), 1),
+    ("full_mix", (k, iota, v64, b, f64, b), 1),
+]:
+    try:
+        f = jax.jit(lambda *a: jax.lax.sort(a, num_keys=nk, is_stable=True)[1][::4096].sum())
+        r = np.asarray(jax.device_get(f(*ops)))
+        log(f"{name}: OK {r.ravel()[0]}")
+    except Exception as e:
+        log(f"{name}: FAIL {type(e).__name__}: {str(e)[:200]}")

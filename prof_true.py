@@ -1,0 +1,63 @@
+"""Ground-truth timing on the axon tunnel backend.
+
+Protocol: the tunnel has a ~64ms fixed round-trip and dedupes identical
+executions, and block_until_ready alone under-reports.  So: dispatch K
+executions with K DISTINCT inputs, then device_get ALL results once; the
+slope (T(K2)-T(K1))/(K2-K1) is the true per-execution device time.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_enable_x64", True)
+
+N = 1 << 21
+rng = np.random.default_rng(0)
+
+
+def bench(name, f, arg_sets):
+    jf = jax.jit(f)
+    np.asarray(jax.device_get(jf(*arg_sets[-1])))  # warm/compile
+
+    def run(k):
+        t0 = time.perf_counter()
+        outs = [jf(*a) for a in arg_sets[:k]]
+        for o in outs:
+            np.asarray(jax.device_get(o))
+        return time.perf_counter() - t0
+
+    t4, t16 = run(4), run(16)
+    per = (t16 - t4) / 12
+    print(f"{name:28s} {per*1e3:9.2f} ms/exec   {N/per/1e6:9.1f} Mrows/s"
+          f"   (t4={t4*1e3:.0f}ms t16={t16*1e3:.0f}ms)", flush=True)
+
+
+R = 16
+u32s = [jnp.asarray(rng.integers(0, 2**32, N, dtype=np.uint32)) for _ in range(R + 1)]
+i64s = [jnp.asarray(rng.integers(-(2**40), 2**40, N, dtype=np.int64)) for _ in range(R + 1)]
+gids = [jnp.asarray(rng.integers(0, 100, N, dtype=np.int32)) for _ in range(R + 1)]
+ridxs = [jnp.asarray(rng.integers(0, N, N, dtype=np.int32)) for _ in range(R + 1)]
+iota = jnp.arange(N, dtype=jnp.int32)
+
+bench("elementwise", lambda v: (v * 3)[::4096].sum(), [(x,) for x in i64s])
+bench("sort_pair", lambda k: jax.lax.sort((k, iota), num_keys=1)[0][::4096].sum(),
+      [(x,) for x in u32s])
+bench("sort_6ops", lambda k, v: jax.lax.sort(
+    (k, iota, v, v, v, v), num_keys=1)[2][::4096].sum(),
+    list(zip(u32s, u32s)))
+bench("gather_rand", lambda i, v: v[i][::4096].sum(), list(zip(ridxs, i64s)))
+bench("segsum_128", lambda g, v: jax.ops.segment_sum(v, g, num_segments=128).sum(),
+      list(zip(gids, i64s)))
+bench("segsum_big",
+      lambda g, v: jax.ops.segment_sum(v, g, num_segments=N + 1)[::4096].sum(),
+      list(zip(gids, i64s)))
+bench("scatter_min_tbl",
+      lambda h, _: jnp.full((2 * N,), jnp.int32(2**31 - 1), jnp.int32)
+      .at[(h & jnp.uint32(2 * N - 1)).astype(jnp.int32)]
+      .min(iota)[::4096].min(),
+      list(zip(u32s, i64s)))
+bench("cumsum_i64", lambda v: jnp.cumsum(v)[::4096].sum(), [(x,) for x in i64s])
+bench("cumsum_i32", lambda v: jnp.cumsum(v.astype(jnp.int32))[::4096].sum(),
+      [(x,) for x in u32s])

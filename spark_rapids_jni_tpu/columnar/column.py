@@ -235,7 +235,7 @@ class Decimal128Column:
         return f"Decimal128Column({self.dtype!r}, n={self.num_rows})"
 
 
-AnyColumn = (Column, StringColumn, Decimal128Column)
+AnyColumn = (Column, StringColumn, Decimal128Column)  # extended below
 
 
 @jax.tree_util.register_pytree_node_class
@@ -308,3 +308,149 @@ class ColumnBatch:
     def __repr__(self):
         inner = ", ".join(f"{n}={c!r}" for n, c in zip(self._names, self._cols))
         return f"ColumnBatch({inner})"
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class ListColumn:
+    """LIST column: ``offsets int32[n+1]`` into a child column.
+
+    Arrow/cudf layout (list child + offsets, reference murmur_hash.cu:63
+    and map_utils.hpp outputs are LIST<...>): offsets are monotonically
+    non-decreasing, row i's elements are ``child[offsets[i]:offsets[i+1]]``.
+    The child row count is static (padded); ``offsets[n]`` gives the live
+    element count.  Null rows have ``offsets[i] == offsets[i+1]``.
+    """
+
+    offsets: jax.Array     # int32 [n+1]
+    child: "object"        # any column type (recursively nested allowed)
+    validity: jax.Array    # bool [n]
+    dtype: T.SparkType = None  # filled by __post_init__ when None
+
+    def __post_init__(self):
+        if self.dtype is None:
+            self.dtype = T.SparkType.list_of(self.child.dtype)
+
+    def tree_flatten(self):
+        return (self.offsets, self.child, self.validity), self.dtype
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        offsets, child, validity = children
+        return cls(offsets, child, validity, aux)
+
+    @property
+    def num_rows(self) -> int:
+        return self.offsets.shape[0] - 1
+
+    @staticmethod
+    def from_pylist(values, elem_type: T.SparkType) -> "ListColumn":
+        """Build from host lists-of-scalars (None entries become nulls)."""
+        offsets = [0]
+        flat = []
+        valid = []
+        for v in values:
+            if v is None:
+                valid.append(False)
+                offsets.append(offsets[-1])
+            else:
+                valid.append(True)
+                flat.extend(v)
+                offsets.append(offsets[-1] + len(v))
+        if elem_type.kind is T.Kind.STRING:
+            child = StringColumn.from_pylist(flat)
+        else:
+            child = Column.from_pylist(flat, elem_type)
+        return ListColumn(
+            jnp.asarray(np.asarray(offsets, np.int32)),
+            child,
+            jnp.asarray(np.asarray(valid, np.bool_)),
+        )
+
+    def to_pylist(self) -> list:
+        offs = np.asarray(jax.device_get(self.offsets))
+        valid = np.asarray(jax.device_get(self.validity))
+        elems = self.child.to_pylist()
+        out = []
+        for i in range(self.num_rows):
+            if not valid[i]:
+                out.append(None)
+            else:
+                out.append(elems[offs[i]: offs[i + 1]])
+        return out
+
+    def __repr__(self):
+        return f"ListColumn({self.dtype!r}, n={self.num_rows})"
+
+
+@jax.tree_util.register_pytree_node_class
+class StructColumn:
+    """STRUCT column: named child columns + a struct-level validity."""
+
+    def __init__(self, fields: dict, validity, dtype: T.SparkType = None):
+        self._names = tuple(fields.keys())
+        self._children = tuple(fields.values())
+        self.validity = validity
+        self.dtype = dtype or T.SparkType.struct_of(
+            {k: v.dtype for k, v in fields.items()}
+        )
+
+    def tree_flatten(self):
+        return (self._children, self.validity), (self._names, self.dtype)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        names, dtype = aux
+        kids, validity = children
+        obj = cls.__new__(cls)
+        obj._names = names
+        obj._children = tuple(kids)
+        obj.validity = validity
+        obj.dtype = dtype
+        return obj
+
+    @property
+    def num_rows(self) -> int:
+        return self._children[0].num_rows if self._children else \
+            self.validity.shape[0]
+
+    @property
+    def field_names(self):
+        return self._names
+
+    def field(self, name: str):
+        return self._children[self._names.index(name)]
+
+    @property
+    def children(self):
+        return self._children
+
+    @staticmethod
+    def from_pylist(values, field_types: dict) -> "StructColumn":
+        """Build from host dicts (None entries become null structs)."""
+        valid = np.array([v is not None for v in values], np.bool_)
+        fields = {}
+        for fname, ftype in field_types.items():
+            col_vals = [None if v is None else v.get(fname) for v in values]
+            if ftype.kind is T.Kind.STRING:
+                fields[fname] = StringColumn.from_pylist(col_vals)
+            else:
+                fields[fname] = Column.from_pylist(col_vals, ftype)
+        return StructColumn(fields, jnp.asarray(valid))
+
+    def to_pylist(self) -> list:
+        valid = np.asarray(jax.device_get(self.validity))
+        cols = {n: c.to_pylist() for n, c in zip(self._names, self._children)}
+        out = []
+        for i in range(self.num_rows):
+            if not valid[i]:
+                out.append(None)
+            else:
+                out.append({n: cols[n][i] for n in self._names})
+        return out
+
+    def __repr__(self):
+        return f"StructColumn({self.dtype!r}, n={self.num_rows})"
+
+
+AnyColumn = (Column, StringColumn, Decimal128Column, ListColumn, StructColumn)

@@ -1,0 +1,28 @@
+"""Per-task memory-pressure scheduler (the SparkResourceAdaptor equivalent).
+
+The native state machine lives in ``native/resource_adaptor.cpp`` (C++17,
+C ABI); this package is the host facade mirroring the reference's Java
+surface (``RmmSpark.java``, ``SparkResourceAdaptor.java``,
+``ThreadStateRegistry.java``, and the OOM exception family):
+
+* :class:`SparkResourceAdaptor` — owns the native handle, runs the 100ms
+  deadlock watchdog daemon, and routes the native blocked-thread callback
+  to :class:`ThreadStateRegistry`.
+* :mod:`~spark_rapids_jni_tpu.mem.rmm_spark` — the static task/thread
+  registration + allocate/deallocate + OOM-injection + metrics API.
+* :class:`RetryOOM` / :class:`SplitAndRetryOOM` / … — unchecked-exception
+  equivalents the query engine catches to roll back, spill, and retry.
+"""
+
+from .rmm_spark import (  # noqa: F401
+    CpuRetryOOM,
+    CpuSplitAndRetryOOM,
+    InjectedException,
+    OOMError,
+    RetryOOM,
+    RmmSpark,
+    SparkResourceAdaptor,
+    SplitAndRetryOOM,
+    ThreadStateRegistry,
+    ThreadState,
+)

@@ -371,8 +371,11 @@ class ResourceAdaptor {
       return SPLIT_AND_RETRY_OOM;
     }
     if (consume(t.inject_retry)) {
+      // injected OOMs throw without a state transition (the reference's
+      // pre_alloc injections leave the thread RUNNING, :1265-1304) so a
+      // following block_thread_until_ready returns immediately
       bump_metric(t, &TaskMetrics::num_retry);
-      set_state(t, State::BUFN_WAIT, "injected_retry");
+      log_op("injected_retry", t.thread_id, -1, t.state, t.state, "");
       return RETRY_OOM;
     }
 

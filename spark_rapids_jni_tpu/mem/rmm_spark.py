@@ -1,0 +1,410 @@
+"""Host facade over the native TPU resource adaptor.
+
+Mirrors the reference Java API surface (file:line refs into
+/root/reference/src/main/java/com/nvidia/spark/rapids/jni/):
+
+* ``RmmSpark.java:59-664``   — static facade: thread-role registration,
+  retry-block demarcation, OOM injection, task metrics.
+* ``SparkResourceAdaptor.java:35-79`` — handle owner + daemon watchdog
+  polling ``checkAndBreakDeadlocks`` every 100ms.
+* ``ThreadStateRegistry.java:44-66`` — native→host callback classifying
+  threads blocked outside the allocator.
+* the ``GpuRetryOOM``/``GpuSplitAndRetryOOM``/… exception family.
+
+The native arena is *logical*: it schedules tasks against a byte budget
+(HBM pressure) while XLA owns the physical buffers — exactly the role the
+RMM interposer plays for the plugin (SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import enum
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libtpu_resource_adaptor.so")
+
+
+# ---------------------------------------------------------------------------
+# the OOM exception family (reference: GpuRetryOOM.java etc.)
+# ---------------------------------------------------------------------------
+
+class RetryOOM(MemoryError):
+    """Roll back to the last checkpoint, make inputs spillable, call
+    ``RmmSpark.block_thread_until_ready()``, retry (GpuRetryOOM)."""
+
+
+class SplitAndRetryOOM(MemoryError):
+    """Like :class:`RetryOOM` but the input must also be split — the
+    scheduler guarantees this thread is the only one running
+    (GpuSplitAndRetryOOM)."""
+
+
+class CpuRetryOOM(RetryOOM):
+    """Host-memory flavor (CpuRetryOOM)."""
+
+
+class CpuSplitAndRetryOOM(SplitAndRetryOOM):
+    """Host-memory flavor (CpuSplitAndRetryOOM)."""
+
+
+class OOMError(MemoryError):
+    """Hard OOM: the retry ladder is exhausted (GpuOOM)."""
+
+
+class InjectedException(RuntimeError):
+    """Test-injected failure (forceCudfException equivalent)."""
+
+
+class ThreadState(enum.IntEnum):
+    """Mirror of the native enum (reference RmmSparkThreadState.java)."""
+
+    UNKNOWN = 0
+    RUNNING = 1
+    ALLOC = 2
+    ALLOC_FREE = 3
+    BLOCKED = 4
+    BUFN_THROW = 5
+    BUFN_WAIT = 6
+    BUFN = 7
+    SPLIT_THROW = 8
+    REMOVE_THROW = 9
+
+
+_OK = 0
+_RETRY_OOM = 1
+_SPLIT_AND_RETRY_OOM = 2
+_OOM = 3
+_INJECTED = 4
+_UNKNOWN_THREAD = 5
+
+
+def _raise_for(code: int, cpu: bool = False):
+    if code == _OK:
+        return
+    if code == _RETRY_OOM:
+        raise (CpuRetryOOM if cpu else RetryOOM)()
+    if code == _SPLIT_AND_RETRY_OOM:
+        raise (CpuSplitAndRetryOOM if cpu else SplitAndRetryOOM)()
+    if code == _OOM:
+        raise OOMError()
+    if code == _INJECTED:
+        raise InjectedException()
+    raise RuntimeError(f"thread not registered with the resource adaptor "
+                       f"(native code {code})")
+
+
+# ---------------------------------------------------------------------------
+# native library
+# ---------------------------------------------------------------------------
+
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+_BLOCKED_CB = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_long)
+
+
+def _load_lib() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                           capture_output=True)
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.tra_create.restype = ctypes.c_void_p
+        lib.tra_create.argtypes = [ctypes.c_long, ctypes.c_char_p]
+        lib.tra_destroy.argtypes = [ctypes.c_void_p]
+        lib.tra_set_blocked_callback.argtypes = [ctypes.c_void_p, _BLOCKED_CB]
+        lib.tra_start_dedicated_task_thread.argtypes = [
+            ctypes.c_void_p, ctypes.c_long, ctypes.c_long]
+        lib.tra_pool_thread_working_on_tasks.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_long), ctypes.c_int]
+        lib.tra_pool_thread_finished_for_tasks.argtypes = [
+            ctypes.c_void_p, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_long), ctypes.c_int]
+        lib.tra_remove_thread_association.argtypes = [
+            ctypes.c_void_p, ctypes.c_long, ctypes.c_long]
+        lib.tra_task_done.argtypes = [ctypes.c_void_p, ctypes.c_long]
+        lib.tra_allocate.restype = ctypes.c_int
+        lib.tra_allocate.argtypes = [ctypes.c_void_p, ctypes.c_long,
+                                     ctypes.c_long]
+        lib.tra_deallocate.argtypes = [ctypes.c_void_p, ctypes.c_long,
+                                       ctypes.c_long]
+        lib.tra_block_thread_until_ready.restype = ctypes.c_int
+        lib.tra_block_thread_until_ready.argtypes = [ctypes.c_void_p,
+                                                     ctypes.c_long]
+        lib.tra_get_state_of.restype = ctypes.c_int
+        lib.tra_get_state_of.argtypes = [ctypes.c_void_p, ctypes.c_long]
+        lib.tra_check_and_break_deadlocks.restype = ctypes.c_int
+        lib.tra_check_and_break_deadlocks.argtypes = [ctypes.c_void_p]
+        for f in ("tra_force_retry_oom", "tra_force_split_retry_oom",
+                  "tra_force_cudf_exception"):
+            fn = getattr(lib, f)
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_long, ctypes.c_int,
+                           ctypes.c_int]
+        lib.tra_get_and_reset_metric.restype = ctypes.c_long
+        lib.tra_get_and_reset_metric.argtypes = [ctypes.c_void_p,
+                                                 ctypes.c_long, ctypes.c_int]
+        lib.tra_total_allocated.restype = ctypes.c_long
+        lib.tra_total_allocated.argtypes = [ctypes.c_void_p]
+        lib.tra_max_allocated.restype = ctypes.c_long
+        lib.tra_max_allocated.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+# ---------------------------------------------------------------------------
+# ThreadStateRegistry: host threads report blocked-ness to the native scan
+# ---------------------------------------------------------------------------
+
+class ThreadStateRegistry:
+    """Marks threads as blocked in *host* code so the native deadlock scan
+    counts them (the JVM inspects Thread.getState(); Python can't, so host
+    code brackets its waits with :meth:`blocked_section`)."""
+
+    _lock = threading.Lock()
+    _blocked: set = set()
+
+    @classmethod
+    def set_blocked(cls, tid: int, blocked: bool):
+        with cls._lock:
+            (cls._blocked.add if blocked else cls._blocked.discard)(tid)
+
+    @classmethod
+    def is_blocked(cls, tid: int) -> bool:
+        with cls._lock:
+            return tid in cls._blocked
+
+    class blocked_section:
+        """``with ThreadStateRegistry.blocked_section(): lock.wait()``"""
+
+        def __enter__(self):
+            self.tid = threading.get_ident()
+            ThreadStateRegistry.set_blocked(self.tid, True)
+            return self
+
+        def __exit__(self, *exc):
+            ThreadStateRegistry.set_blocked(self.tid, False)
+            return False
+
+
+@_BLOCKED_CB
+def _is_blocked_cb(tid):
+    return 1 if ThreadStateRegistry.is_blocked(tid) else 0
+
+
+# ---------------------------------------------------------------------------
+# SparkResourceAdaptor: handle + watchdog
+# ---------------------------------------------------------------------------
+
+class SparkResourceAdaptor:
+    """Owns one native adaptor; a daemon watchdog breaks deadlocks every
+    ``poll_ms`` (reference SparkResourceAdaptor.java:35-79)."""
+
+    def __init__(self, pool_bytes: int, log_path: Optional[str] = None,
+                 poll_ms: float = 100.0):
+        self._lib = _load_lib()
+        self._h = self._lib.tra_create(
+            ctypes.c_long(pool_bytes),
+            (log_path or "").encode())
+        self._lib.tra_set_blocked_callback(self._h, _is_blocked_cb)
+        self._closed = threading.Event()
+        self._watchdog = threading.Thread(
+            target=self._watch, args=(poll_ms / 1000.0,),
+            name="tra-watchdog", daemon=True)
+        self._watchdog.start()
+
+    def _watch(self, period_s: float):
+        while not self._closed.wait(period_s):
+            try:
+                self._lib.tra_check_and_break_deadlocks(self._h)
+            except Exception:
+                return
+
+    def close(self):
+        if not self._closed.is_set():
+            self._closed.set()
+            self._watchdog.join(timeout=2.0)
+            self._lib.tra_destroy(self._h)
+            self._h = None
+
+    # -- raw operations (tid defaults to the calling thread) -----------
+    @staticmethod
+    def _tid(tid: Optional[int]) -> int:
+        return threading.get_ident() if tid is None else tid
+
+    def start_dedicated_task_thread(self, task_id: int,
+                                    tid: Optional[int] = None):
+        self._lib.tra_start_dedicated_task_thread(
+            self._h, self._tid(tid), task_id)
+
+    def pool_thread_working_on_tasks(self, is_shuffle: bool,
+                                     task_ids: Sequence[int],
+                                     tid: Optional[int] = None):
+        arr = (ctypes.c_long * len(task_ids))(*task_ids)
+        self._lib.tra_pool_thread_working_on_tasks(
+            self._h, int(is_shuffle), self._tid(tid), arr, len(task_ids))
+
+    def pool_thread_finished_for_tasks(self, task_ids: Sequence[int],
+                                       tid: Optional[int] = None):
+        arr = (ctypes.c_long * len(task_ids))(*task_ids)
+        self._lib.tra_pool_thread_finished_for_tasks(
+            self._h, self._tid(tid), arr, len(task_ids))
+
+    def remove_thread_association(self, task_id: int = -1,
+                                  tid: Optional[int] = None):
+        self._lib.tra_remove_thread_association(
+            self._h, self._tid(tid), task_id)
+
+    def task_done(self, task_id: int):
+        self._lib.tra_task_done(self._h, task_id)
+
+    def allocate(self, nbytes: int, tid: Optional[int] = None):
+        """Draw ``nbytes`` from the arena; raises the OOM family."""
+        _raise_for(self._lib.tra_allocate(self._h, self._tid(tid), nbytes))
+
+    def deallocate(self, nbytes: int, tid: Optional[int] = None):
+        self._lib.tra_deallocate(self._h, self._tid(tid), nbytes)
+
+    def block_thread_until_ready(self, tid: Optional[int] = None):
+        _raise_for(self._lib.tra_block_thread_until_ready(
+            self._h, self._tid(tid)))
+
+    def get_state_of(self, tid: Optional[int] = None) -> ThreadState:
+        return ThreadState(self._lib.tra_get_state_of(self._h,
+                                                      self._tid(tid)))
+
+    def check_and_break_deadlocks(self) -> bool:
+        return bool(self._lib.tra_check_and_break_deadlocks(self._h))
+
+    # -- injection ------------------------------------------------------
+    def force_retry_oom(self, tid=None, num_ooms=1, skip_count=0):
+        self._lib.tra_force_retry_oom(self._h, self._tid(tid), num_ooms,
+                                      skip_count)
+
+    def force_split_and_retry_oom(self, tid=None, num_ooms=1, skip_count=0):
+        self._lib.tra_force_split_retry_oom(self._h, self._tid(tid),
+                                            num_ooms, skip_count)
+
+    def force_exception(self, tid=None, num_times=1, skip_count=0):
+        self._lib.tra_force_cudf_exception(self._h, self._tid(tid),
+                                           num_times, skip_count)
+
+    # -- metrics --------------------------------------------------------
+    def get_and_reset_num_retry(self, task_id: int) -> int:
+        return self._lib.tra_get_and_reset_metric(self._h, task_id, 0)
+
+    def get_and_reset_num_split_retry(self, task_id: int) -> int:
+        return self._lib.tra_get_and_reset_metric(self._h, task_id, 1)
+
+    def get_and_reset_block_time_ns(self, task_id: int) -> int:
+        return self._lib.tra_get_and_reset_metric(self._h, task_id, 2)
+
+    def get_and_reset_compute_time_lost_ns(self, task_id: int) -> int:
+        return self._lib.tra_get_and_reset_metric(self._h, task_id, 3)
+
+    def get_max_memory_allocated(self, task_id: int) -> int:
+        return self._lib.tra_get_and_reset_metric(self._h, task_id, 4)
+
+    def total_allocated(self) -> int:
+        return self._lib.tra_total_allocated(self._h)
+
+    def max_allocated(self) -> int:
+        return self._lib.tra_max_allocated(self._h)
+
+
+# ---------------------------------------------------------------------------
+# RmmSpark: the process-wide static facade (reference RmmSpark.java)
+# ---------------------------------------------------------------------------
+
+class RmmSpark:
+    """Static facade, one installed adaptor per process."""
+
+    _adaptor: Optional[SparkResourceAdaptor] = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def set_event_handler(cls, pool_bytes: int, log_path=None,
+                          poll_ms: float = 100.0) -> SparkResourceAdaptor:
+        """Install the adaptor (reference RmmSpark.setEventHandler)."""
+        with cls._lock:
+            if cls._adaptor is not None:
+                raise RuntimeError("adaptor already installed")
+            cls._adaptor = SparkResourceAdaptor(pool_bytes, log_path, poll_ms)
+            return cls._adaptor
+
+    @classmethod
+    def clear_event_handler(cls):
+        with cls._lock:
+            if cls._adaptor is not None:
+                cls._adaptor.close()
+                cls._adaptor = None
+
+    @classmethod
+    def _a(cls) -> SparkResourceAdaptor:
+        a = cls._adaptor
+        if a is None:
+            raise RuntimeError("no adaptor installed; call set_event_handler")
+        return a
+
+    # thread-role registration -----------------------------------------
+    @classmethod
+    def current_thread_is_dedicated_to_task(cls, task_id: int):
+        cls._a().start_dedicated_task_thread(task_id)
+
+    @classmethod
+    def shuffle_thread_working_on_tasks(cls, task_ids: Sequence[int]):
+        cls._a().pool_thread_working_on_tasks(True, task_ids)
+
+    @classmethod
+    def pool_thread_working_on_tasks(cls, task_ids: Sequence[int]):
+        cls._a().pool_thread_working_on_tasks(False, task_ids)
+
+    @classmethod
+    def pool_thread_finished_for_tasks(cls, task_ids: Sequence[int]):
+        cls._a().pool_thread_finished_for_tasks(task_ids)
+
+    @classmethod
+    def remove_current_thread_association(cls):
+        cls._a().remove_thread_association()
+
+    @classmethod
+    def task_done(cls, task_id: int):
+        cls._a().task_done(task_id)
+
+    # allocation --------------------------------------------------------
+    @classmethod
+    def allocate(cls, nbytes: int):
+        cls._a().allocate(nbytes)
+
+    @classmethod
+    def deallocate(cls, nbytes: int):
+        cls._a().deallocate(nbytes)
+
+    @classmethod
+    def block_thread_until_ready(cls):
+        cls._a().block_thread_until_ready()
+
+    @classmethod
+    def get_state_of(cls, tid: int) -> ThreadState:
+        return cls._a().get_state_of(tid)
+
+    # injection ---------------------------------------------------------
+    @classmethod
+    def force_retry_oom(cls, tid, num_ooms=1, skip_count=0):
+        cls._a().force_retry_oom(tid, num_ooms, skip_count)
+
+    @classmethod
+    def force_split_and_retry_oom(cls, tid, num_ooms=1, skip_count=0):
+        cls._a().force_split_and_retry_oom(tid, num_ooms, skip_count)
+
+    @classmethod
+    def force_exception(cls, tid, num_times=1, skip_count=0):
+        cls._a().force_exception(tid, num_times, skip_count)

@@ -550,3 +550,42 @@ def float_to_string(col: Column) -> StringColumn:
 
     chars, length = _format(digits, exp10, negative, is_nan, is_inf, is_zero)
     return StringColumn(chars, length * col.validity, col.validity)
+
+
+def double_to_json_string(data):
+    """Java Double.toString with the JSON tweaks of the reference's
+    ``ftos_converter.cuh:1154-1200``: ±Infinity and NaN come back QUOTED
+    (bare Infinity is not valid JSON), ±0.0 as "0.0"/"-0.0".
+
+    Takes a raw float64 array; returns (chars uint8[n, 28], lengths int32).
+    Used by get_json_object's number normalization.
+    """
+    pair = jax.lax.bitcast_convert_type(data, jnp.uint32)
+    bits = pair[..., 0].astype(jnp.uint64) | (pair[..., 1].astype(jnp.uint64) << 32)
+    negative = (bits >> _U64(63)) != 0
+    exp_field = (bits >> _U64(52)) & _U64(0x7FF)
+    mant = bits & _U64((1 << 52) - 1)
+    is_nan = (exp_field == 0x7FF) & (mant != 0)
+    is_inf = (exp_field == 0x7FF) & (mant == 0)
+    is_zero = (exp_field == 0) & (mant == 0)
+    digits, exp10 = _d2d(bits & _U64((1 << 63) - 1))
+    chars, length = _format(digits, exp10, negative, is_nan, is_inf, is_zero)
+
+    # quote the non-JSON specials
+    n = chars.shape[0]
+    chars = jnp.pad(chars, ((0, 0), (0, 2)))
+
+    def qlit(s):
+        raw = ('"' + s + '"').encode()
+        buf = np.zeros((chars.shape[1],), np.uint8)
+        buf[: len(raw)] = np.frombuffer(raw, np.uint8)
+        return jnp.asarray(buf)[None, :], len(raw)
+
+    for mask, (c, l) in (
+        (is_inf & ~negative, qlit("Infinity")),
+        (is_inf & negative, qlit("-Infinity")),
+        (is_nan, qlit("NaN")),
+    ):
+        chars = jnp.where(mask[:, None], c, chars)
+        length = jnp.where(mask, l, length)
+    return chars, length.astype(jnp.int32)

@@ -34,6 +34,8 @@ from __future__ import annotations
 
 from typing import Sequence, Union
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 
@@ -387,14 +389,84 @@ Columns = Union[ColumnBatch, Sequence]
 
 
 def _as_columns(columns: Columns):
+    """Expand top-level structs into their children (the reference's JNI
+    layer decomposes structs before the kernel — HashTest struct tests
+    assert struct hash == hashing the leaves in order).  A null struct row
+    nulls its children, so the fold skips them (seed passes through)."""
+    from ..columnar.column import StructColumn
+
     cols = columns.columns if isinstance(columns, ColumnBatch) else list(columns)
+    out = []
+
+    def expand(c, parent_valid=None):
+        if isinstance(c, StructColumn):
+            v = c.validity if parent_valid is None else (c.validity & parent_valid)
+            for child in c.children:
+                expand(child, v)
+        else:
+            if parent_valid is not None:
+                c = dataclasses.replace(c, validity=c.validity & parent_valid)
+            out.append(c)
+
     for c in cols:
-        if getattr(c, "dtype", None) is not None and c.dtype.is_nested:
-            # Nested columns land with the nested-column substrate; callers
-            # flatten struct leaves themselves until then (struct hash ==
-            # hashing the leaves in order, reference HashTest struct tests).
-            raise NotImplementedError("nested column hashing not implemented yet")
-    return cols
+        expand(c)
+    return out
+
+
+def _drill_list(col):
+    """Leaf column + per-row [start, end) leaf-element ranges.
+
+    Mirrors the reference adapter's drill loop (murmur_hash.cu:122-131):
+    LIST composes offsets; STRUCT inside a list must be decomposed (single
+    child) — multi-field structs inside lists are unsupported there too.
+    """
+    from ..columnar.column import ListColumn, StructColumn
+
+    start = col.offsets[:-1]
+    end = col.offsets[1:]
+    cur = col.child
+    while isinstance(cur, (ListColumn, StructColumn)):
+        if isinstance(cur, StructColumn):
+            if len(cur.children) != 1:
+                raise NotImplementedError(
+                    "hash of a multi-field STRUCT inside a LIST (the "
+                    "reference kernel assumes decomposed single-child "
+                    "structs, murmur_hash.cu:128)"
+                )
+            cur = cur.children[0]
+        else:
+            start = jnp.take(cur.offsets, jnp.clip(start, 0, cur.num_rows))
+            end = jnp.take(cur.offsets, jnp.clip(end, 0, cur.num_rows))
+            cur = cur.child
+    return cur, start.astype(jnp.int32), end.astype(jnp.int32)
+
+
+def _list_fold(col, h, element_fn):
+    """Chained element fold: h = hash(elem, seed=h), nulls pass through.
+
+    The loop trip count is the batch's longest list (a device scalar via
+    ``while_loop``); cost is O(max-row-length * n) gathers — fine for the
+    short lists these row hashes see (partition keys).
+    """
+    from ..relational.gather import gather_column
+
+    leaf, start, end = _drill_list(col)
+    max_len = jnp.maximum((end - start).max(), 0)
+
+    def cond(st):
+        k, _ = st
+        return k < max_len
+
+    def body(st):
+        k, h = st
+        idx = start + k
+        active = idx < end
+        g = gather_column(leaf, jnp.clip(idx, 0, max(leaf.num_rows - 1, 0)))
+        eh = element_fn(g, h)
+        return k + 1, jnp.where(active & g.validity, eh, h)
+
+    _, h = jax.lax.while_loop(cond, body, (jnp.int32(0), h))
+    return h
 
 
 def _validate(cols):
@@ -413,19 +485,30 @@ def murmur_hash3_32(columns: Columns, seed: int = 42) -> Column:
     """Spark Murmur3_32 row hash across columns (reference murmur_hash.cu:187)."""
     cols = _as_columns(columns)
     n = _validate(cols)
+    from ..columnar.column import ListColumn
+
     h = jnp.full((n,), jnp.uint32(seed & 0xFFFFFFFF))
     for c in cols:
-        h = jnp.where(c.validity, _element_murmur3(c, h), h)
+        if isinstance(c, ListColumn):
+            h = jnp.where(c.validity, _list_fold(c, h, _element_murmur3), h)
+        else:
+            h = jnp.where(c.validity, _element_murmur3(c, h), h)
     out = jax.lax.bitcast_convert_type(h, jnp.int32)
     return Column(out, jnp.ones((n,), jnp.bool_), T.INT32)
 
 
 def xxhash64(columns: Columns, seed: int = DEFAULT_XXHASH64_SEED) -> Column:
     """Spark XXHash64 row hash across columns (reference xxhash64.cu:330)."""
+    from ..columnar.column import ListColumn
+
     cols = _as_columns(columns)
     n = _validate(cols)
     h = jnp.full((n,), jnp.uint64(seed & 0xFFFFFFFFFFFFFFFF))
     for c in cols:
+        if isinstance(c, ListColumn):
+            # the reference's xxhash64 has no nested support (Hash.java:78)
+            raise NotImplementedError(
+                "xxhash64 over LIST columns (unsupported in the reference)")
         h = jnp.where(c.validity, _element_xxhash64(c, h), h)
     out = _u64_to_i64(h)
     return Column(out, jnp.ones((n,), jnp.bool_), T.INT64)

@@ -1,0 +1,515 @@
+"""Spark ``parse_url`` (PROTOCOL/HOST/QUERY/PATH[, key]) on TPU.
+
+Reference: the RFC-3986-ish device validator/extractor
+``/root/reference/src/main/cpp/src/parse_uri.cu:94-1005`` (semantics also
+modeled by ``tests/uri_oracle.py``, which mirrors java.net.URI).  The
+reference runs a thread-per-row two-pass kernel; here everything is
+whole-column vectorized over the padded char matrix:
+
+* component boundaries (first ``:/#?``, authority internals, last colon /
+  bracket) are masked min/max reductions and pure position arithmetic;
+* per-chunk character-class validation is one vectorized pass with
+  neighbor-window logic for ``%XX`` escapes and UTF-8 multi-byte
+  whitespace (the reference's ``skip_and_validate_special``);
+* the three stateful validators (IPv4 / IPv6 / domain-name) run as a
+  single fused ``lax.scan`` over the extracted host window — the only
+  sequential axis in the kernel, with a ~12-int vector state.
+
+Outputs match Spark's null semantics: a fatally invalid URI nulls every
+part; an invalid-but-tolerated host nulls only HOST (parse_uri.cu:74-79).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.column import StringColumn
+
+PROTOCOL, HOST, AUTHORITY, PATH, FRAGMENT, QUERY, USERINFO, PORT, OPAQUE = \
+    range(9)
+_PARTS = {"PROTOCOL": PROTOCOL, "HOST": HOST, "QUERY": QUERY, "PATH": PATH,
+          "AUTHORITY": AUTHORITY, "FRAGMENT": FRAGMENT, "USERINFO": USERINFO,
+          "PORT": PORT, "OPAQUE": OPAQUE}
+
+
+def _first_pos(mask, pos, L):
+    """First position where mask holds, else L (int32[n])."""
+    return jnp.min(jnp.where(mask, pos, L), axis=1).astype(jnp.int32)
+
+
+def _last_pos(mask, pos):
+    """Last position where mask holds, else -1."""
+    return jnp.max(jnp.where(mask, pos, -1), axis=1).astype(jnp.int32)
+
+
+def _is_alpha(c):
+    return ((c >= ord("a")) & (c <= ord("z"))) | ((c >= ord("A")) & (c <= ord("Z")))
+
+
+def _is_num(c):
+    return (c >= ord("0")) & (c <= ord("9"))
+
+
+def _is_hexd(c):
+    return _is_num(c) | ((c >= ord("a")) & (c <= ord("f"))) \
+        | ((c >= ord("A")) & (c <= ord("F")))
+
+
+# ---------------------------------------------------------------------------
+# chunk validation: char classes + escape/UTF-8 "special" handling
+# ---------------------------------------------------------------------------
+
+def _special_masks(chars, nxt1, nxt2, allow_invalid_escapes):
+    """Per-position exemption + validity for the reference's
+    skip_and_validate_special.
+
+    Returns (exempt, bad): ``exempt`` marks positions the per-chunk char
+    predicate must NOT see (escape hex pairs, UTF-8 sequences); ``bad``
+    marks positions that invalidate the whole chunk when inside it.
+    """
+    c = chars.astype(jnp.int32)
+    n1 = nxt1.astype(jnp.int32)
+    n2 = nxt2.astype(jnp.int32)
+    is_pct = c == ord("%")
+    pct_ok = _is_hexd(n1) & _is_hexd(n2)
+    prev_pct = jnp.pad(is_pct, ((0, 0), (1, 0)))[:, :-1]
+    prev2_pct = jnp.pad(is_pct, ((0, 0), (2, 0)))[:, :-2]
+    in_escape = (is_pct | prev_pct | prev2_pct) & ~allow_invalid_escapes
+
+    lead2 = (c >> 5) == 0b110
+    lead3 = (c >> 4) == 0b1110
+    lead4 = (c >> 3) == 0b11110
+    contb = (c >> 6) == 0b10
+    is_lead = lead2 | lead3 | lead4
+    prev_lead2p = jnp.pad(is_lead, ((0, 0), (1, 0)))[:, :-1]
+    prev_lead34 = jnp.pad(lead3 | lead4, ((0, 0), (2, 0)))[:, :-2]
+    prev_lead4 = jnp.pad(lead4, ((0, 0), (3, 0)))[:, :-3]
+    in_mb = is_lead | ((prev_lead2p | prev_lead34 | prev_lead4) & contb)
+
+    # packed code checks (the reference packs the char bytes big-endian)
+    code2 = (c << 8) | n1
+    code3 = (c << 16) | (n1 << 8) | n2
+    cont_bad = (lead2 & ((n1 >> 6) != 0b10)) \
+        | (lead3 & (((n1 >> 6) != 0b10) | ((n2 >> 6) != 0b10))) \
+        | (lead4 & (((n1 >> 6) != 0b10) | ((n2 >> 6) != 0b10)))
+    ws_bad = (lead2 & (code2 >= 0xC280) & (code2 <= 0xC2A0)) \
+        | (lead3 & ((code3 == 0xE19A80)
+                    | ((code3 >= 0xE28080) & (code3 <= 0xE2808A))
+                    | (code3 == 0xE280AF) | (code3 == 0xE280A8)
+                    | (code3 == 0xE2819F) | (code3 == 0xE38080)))
+    esc_bad = is_pct & ~pct_ok & ~allow_invalid_escapes
+    bad = esc_bad | (is_lead & (cont_bad | ws_bad))
+    exempt = in_escape | in_mb
+    return exempt, bad
+
+
+def _chunk_valid(ok_char, chars, nxt1, nxt2, pos, start, end,
+                 allow_invalid_escapes=False):
+    """Vectorized validate_chunk over the [start, end) span of each row."""
+    if isinstance(allow_invalid_escapes, bool):
+        allow = jnp.full((chars.shape[0], 1), allow_invalid_escapes)
+    else:
+        allow = allow_invalid_escapes[:, None]
+    exempt, bad = _special_masks(chars, nxt1, nxt2, allow)
+    inside = (pos >= start[:, None]) & (pos < end[:, None])
+    fn_bad = inside & ~exempt & ~ok_char(chars.astype(jnp.int32))
+    return ~jnp.any(inside & bad, axis=1) & ~jnp.any(fn_bad, axis=1)
+
+
+def _scheme_ok(chars, pos, start, end):
+    c = chars.astype(jnp.int32)
+    inside = (pos >= start[:, None]) & (pos < end[:, None])
+    first = pos == start[:, None]
+    ok = jnp.where(
+        first, _is_alpha(c),
+        _is_alpha(c) | _is_num(c) | (c == ord("+")) | (c == ord("-"))
+        | (c == ord(".")))
+    nonempty = end > start
+    return nonempty & ~jnp.any(inside & ~ok, axis=1)
+
+
+def _q_ok(c):
+    return ((c == ord("!")) | (c == ord('"')) | (c == ord("$"))
+            | ((c >= ord("&")) & (c <= ord(";"))) | (c == ord("="))
+            | ((c >= ord("?")) & (c <= ord("]")) & (c != ord("\\")))
+            | ((c >= ord("a")) & (c <= ord("z"))) | (c == ord("_"))
+            | (c == ord("~")))
+
+
+def _auth_ok(c):
+    # '%' is appended conditionally by the caller via allow_invalid_escapes
+    return ((c == ord("!")) | (c == ord("$"))
+            | ((c >= ord("&")) & (c <= ord(";")) & (c != ord("/")))
+            | (c == ord("="))
+            | ((c >= ord("@")) & (c <= ord("_")) & (c != ord("^"))
+               & (c != ord("\\")))
+            | ((c >= ord("a")) & (c <= ord("z"))) | (c == ord("~")))
+
+
+def _path_ok(c):
+    return ((c == ord("!")) | (c == ord("$"))
+            | ((c >= ord("&")) & (c <= ord(";"))) | (c == ord("="))
+            | ((c >= ord("@")) & (c <= ord("Z"))) | (c == ord("_"))
+            | ((c >= ord("a")) & (c <= ord("z"))) | (c == ord("~")))
+
+
+def _opaque_ok(c):
+    return ((c == ord("!")) | (c == ord("$"))
+            | ((c >= ord("&")) & (c <= ord(";"))) | (c == ord("="))
+            | ((c >= ord("?")) & (c <= ord("]")) & (c != ord("\\")))
+            | (c == ord("_")) | (c == ord("~"))
+            | ((c >= ord("a")) & (c <= ord("z"))))
+
+
+def _userinfo_ok(c):
+    return (c != ord("[")) & (c != ord("]"))
+
+
+# ---------------------------------------------------------------------------
+# host validation (the one sequential piece: fused ipv4/ipv6/domain scan)
+# ---------------------------------------------------------------------------
+
+def _validate_host(chars, lengths):
+    """(valid, fatal) over extracted host windows [n, H].
+
+    Port of validate_host + validate_ipv4/ipv6/domain (parse_uri.cu:
+    165-398) as one scan with all three machines running in parallel.
+    """
+    n, H = chars.shape
+    pos = jnp.arange(H, dtype=jnp.int32)[None, :]
+    inside = pos < lengths[:, None]
+    c0 = chars[:, 0].astype(jnp.int32)
+    last = jnp.take_along_axis(
+        chars, jnp.clip(lengths - 1, 0, H - 1)[:, None], axis=1)[:, 0]
+    empty = lengths <= 0
+    is_br = (c0 == ord("[")) & ~empty
+    br_closed = last == ord("]")
+
+    has_brackets = jnp.any(
+        inside & ((chars == ord("[")) | (chars == ord("]"))), axis=1)
+    last_period = _last_pos(inside & (chars == ord(".")),
+                            jnp.broadcast_to(pos, chars.shape))
+    after_lp = jnp.take_along_axis(
+        chars, jnp.clip(last_period + 1, 0, H - 1)[:, None], axis=1)[:, 0]
+    # domain-name route iff no period / trailing period / non-digit after
+    domain_route = (last_period < 0) | (last_period == lengths - 1) \
+        | ~_is_num(after_lp.astype(jnp.int32))
+
+    def step(st, x):
+        (j, c) = x
+        c = c.astype(jnp.int32)
+        act = (j < st["len"])
+        isd = _is_num(c)
+        # ---- ipv6 ----
+        v6 = st["v6ok"]
+        colon = c == ord(":")
+        period = c == ord(".")
+        pct = c == ord("%")
+        openb = c == ord("[")
+        closeb = c == ord("]")
+        dc_now = colon & (st["prev"] == ord(":"))
+        v6 = v6 & ~(act & openb & (st["nopen"] >= 1))
+        v6 = v6 & ~(act & closeb & (st["nclose"] >= 1))
+        v6 = v6 & ~(act & closeb & (st["nper"] > 0)
+                    & (st["ahex"] | (st["addr"] > 255)))
+        ncolon = st["ncol"] + (act & colon)
+        v6 = v6 & ~(act & dc_now & st["dc"])
+        dc = st["dc"] | (act & dc_now)
+        v6 = v6 & ~(act & colon & ((ncolon > 8) | ((ncolon == 8) & ~dc)))
+        v6 = v6 & ~(act & colon & ((st["nper"] > 0) | (st["npct"] > 0)))
+        nper = st["nper"] + (act & period)
+        v6 = v6 & ~(act & period & (
+            (st["npct"] > 0) | (nper > 3) | st["ahex"] | (st["addr"] > 255)
+            | ((st["ncol"] != 6) & ~st["dc"]) | (st["ncol"] >= 8)))
+        npct = st["npct"] + (act & pct)
+        v6 = v6 & ~(act & pct & (npct > 1))
+        v6 = v6 & ~(act & pct & (st["nper"] > 0)
+                    & (st["ahex"] | (st["addr"] > 255)))
+        is_af = ((c >= ord("a")) & (c <= ord("f")))
+        is_AZ = ((c >= ord("A")) & (c <= ord("Z")))
+        other6 = act & ~(colon | period | pct | openb | closeb)
+        digit_like = other6 & (st["npct"] == 0)
+        v6 = v6 & ~(digit_like & (st["achars"] > 3))
+        v6 = v6 & ~(digit_like & ~(is_af | is_AZ | isd))
+        reset = act & (colon | period | pct)
+        addr = jnp.where(reset, 0, st["addr"])
+        ahex = jnp.where(reset, False, st["ahex"])
+        achars = jnp.where(reset, 0, st["achars"])
+        addr = jnp.where(digit_like,
+                         addr * 10 + jnp.where(is_af, 10 + c - ord("a"),
+                                 jnp.where(is_AZ, 10 + c - ord("A"),
+                                           c - ord("0"))),
+                         addr)
+        ahex = ahex | (digit_like & (is_af | is_AZ))
+        achars = jnp.where(digit_like, achars + 1, achars)
+        # ---- ipv4 ----
+        v4 = st["v4ok"]
+        v4 = v4 & ~(act & ~isd & ((j == 0) | ~period))
+        v4 = v4 & ~(act & period & (st["a4chars"] == 0))
+        a4 = jnp.where(act & period, 0,
+                       jnp.where(act & isd, st["a4"] * 10 + c - ord("0"),
+                                 st["a4"]))
+        a4chars = jnp.where(act & period, 0,
+                            jnp.where(act & isd, st["a4chars"] + 1,
+                                      st["a4chars"]))
+        v4 = v4 & ~(act & isd & (a4 > 255))
+        ndots = st["ndots"] + (act & period)
+        # ---- domain ----
+        dm = st["dmok"]
+        alnum = _is_alpha(c) | isd
+        dash = c == ord("-")
+        dm = dm & ~(act & ~(alnum | dash | period))
+        numeric_start = act & st["lastper"] & isd
+        dm = dm & ~(act & dash & (st["lastper"] | (j == 0)
+                                  | (j == st["len"] - 1)))
+        dm = dm & ~(act & period & (st["lastdash"] | st["lastper"]
+                                    | (st["nbefore"] == 0)))
+        lastper = jnp.where(act, period, st["lastper"])
+        lastdash = jnp.where(act, dash, st["lastdash"])
+        nbefore = jnp.where(act & period, 0,
+                            jnp.where(act & alnum, st["nbefore"] + 1,
+                                      st["nbefore"]))
+        numstart = jnp.where(act, numeric_start, st["numstart"])
+        prev = jnp.where(act, c, st["prev"])
+        return {
+            "len": st["len"], "prev": prev,
+            "v6ok": v6, "dc": dc, "ncol": ncolon, "nper": nper,
+            "npct": npct, "nopen": st["nopen"] + (act & openb),
+            "nclose": st["nclose"] + (act & closeb),
+            "addr": addr, "ahex": ahex, "achars": achars,
+            "v4ok": v4, "a4": a4, "a4chars": a4chars, "ndots": ndots,
+            "dmok": dm, "lastper": lastper, "lastdash": lastdash,
+            "nbefore": nbefore, "numstart": numstart,
+        }, None
+
+    z = jnp.zeros((n,), jnp.int32)
+    f = jnp.zeros((n,), jnp.bool_)
+    t = jnp.ones((n,), jnp.bool_)
+    init = {
+        "len": lengths.astype(jnp.int32), "prev": z,
+        "v6ok": t, "dc": f, "ncol": z, "nper": z, "npct": z,
+        "nopen": z, "nclose": z, "addr": z, "ahex": f, "achars": z,
+        "v4ok": t, "a4": z, "a4chars": z, "ndots": z,
+        "dmok": t, "lastper": f, "lastdash": f, "nbefore": z, "numstart": f,
+    }
+    st, _ = jax.lax.scan(step, init,
+                         (jnp.arange(H, dtype=jnp.int32), chars.T))
+    v6 = st["v6ok"] & (lengths >= 2)
+    v4 = st["v4ok"] & (st["a4chars"] > 0) & (st["ndots"] == 3)
+    dm = st["dmok"] & ~st["numstart"]
+
+    fatal = is_br & (~br_closed | ~v6)
+    valid_br = is_br & br_closed & v6
+    fatal = fatal | (~is_br & has_brackets & ~empty)
+    valid_nb = ~is_br & ~has_brackets & jnp.where(domain_route, dm, v4)
+    valid = ~empty & (valid_br | (~is_br & ~has_brackets & valid_nb))
+    fatal = fatal & ~empty
+    return valid, fatal
+
+
+# ---------------------------------------------------------------------------
+# main
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("part", "key"))
+def _parse(chars, lengths, validity, part, key):
+    n, L = chars.shape
+    i32 = jnp.int32
+    pos = jnp.arange(L, dtype=i32)[None, :]
+    inside = pos < lengths[:, None]
+    cpad = jnp.pad(chars, ((0, 0), (0, 2)))
+    nxt1 = cpad[:, 1: L + 1]
+    nxt2 = cpad[:, 2: L + 2]
+    c = jnp.where(inside, chars, jnp.uint8(0))
+
+    length = lengths.astype(i32)
+    col = _first_pos(inside & (c == ord(":")), pos, L)
+    slash = _first_pos(inside & (c == ord("/")), pos, L)
+    hash_ = _first_pos(inside & (c == ord("#")), pos, L)
+    question = _first_pos(inside & (c == ord("?")), pos, L)
+    NOPE = i32(L)
+
+    valid = jnp.ones((n,), jnp.bool_)  # not-yet-fatally-invalid
+    has = {k: jnp.zeros((n,), jnp.bool_) for k in range(9)}
+    spans = {k: (jnp.zeros((n,), i32), jnp.zeros((n,), i32)) for k in range(9)}
+
+    # ---- fragment ------------------------------------------------------
+    has_hash = hash_ < length
+    frag_s, frag_e = hash_ + 1, length
+    frag_ok = _chunk_valid(_opaque_ok, chars, nxt1, nxt2, pos, frag_s, frag_e)
+    valid = valid & (~has_hash | frag_ok)
+    has[FRAGMENT] = has_hash
+    spans[FRAGMENT] = (frag_s, frag_e)
+    length = jnp.where(has_hash, hash_, length)
+    col = jnp.where(col > length, NOPE, col)
+    slash = jnp.where(slash > length, NOPE, slash)
+    question = jnp.where(question > length, NOPE, question)
+
+    # ---- scheme --------------------------------------------------------
+    has_scheme = (col < L) & (col < slash) & (col < hash_)
+    scheme_ok = _scheme_ok(chars, pos, jnp.zeros((n,), i32), col)
+    valid = valid & (~has_scheme | scheme_ok)
+    has[PROTOCOL] = has_scheme
+    spans[PROTOCOL] = (jnp.zeros((n,), i32), col)
+    start = jnp.where(has_scheme, col + 1, 0)
+
+    # ---- empty remainder: only an (empty) path survives, scheme dies ---
+    empty_rest = length - start <= 0
+    valid = valid & (~empty_rest | ~has_scheme)
+    only_path = empty_rest & ~has_scheme
+
+    # ---- hierarchical vs opaque ----------------------------------------
+    first_c = jnp.take_along_axis(cpad, jnp.clip(start, 0, L)[:, None],
+                                  axis=1)[:, 0].astype(i32)
+    hier = ~empty_rest & ((first_c == ord("/")) | (start == 0))
+    opaque = ~empty_rest & ~hier
+    op_ok = _chunk_valid(_opaque_ok, chars, nxt1, nxt2, pos, start, length)
+    valid = valid & (~opaque | op_ok)
+    has[OPAQUE] = opaque
+    spans[OPAQUE] = (start, length)
+
+    # ---- query ----------------------------------------------------------
+    has_q = hier & (question < length) & (question >= start)
+    q_s, q_e = question + 1, length
+    q_ok = _chunk_valid(_q_ok, chars, nxt1, nxt2, pos, q_s, q_e)
+    valid = valid & (~has_q | q_ok)
+    has[QUERY] = has_q
+    spans[QUERY] = (q_s, q_e)
+    path_end = jnp.where(has_q, question, length)
+
+    # ---- authority // --------------------------------------------------
+    second_c = jnp.take_along_axis(cpad, jnp.clip(start + 1, 0, L)[:, None],
+                                   axis=1)[:, 0].astype(i32)
+    has_auth = hier & (first_c == ord("/")) & (second_c == ord("/")) \
+        & (start + 1 < length)
+    auth_s = start + 2
+    next_slash = _first_pos(inside & (c == ord("/")) & (pos >= auth_s[:, None])
+                            & (pos < path_end[:, None]), pos, L)
+    have_ns = has_auth & (next_slash < path_end)
+    auth_e = jnp.where(have_ns, next_slash, jnp.minimum(path_end, length))
+    auth_nonempty = has_auth & (auth_e > auth_s)
+    # ipv6-style authorities tolerate bare % (device routing suffix)
+    a_first = jnp.take_along_axis(cpad, jnp.clip(auth_s, 0, L)[:, None],
+                                  axis=1)[:, 0].astype(i32)
+    ipv6_auth = auth_nonempty & (auth_e - auth_s > 2) & (a_first == ord("["))
+    auth_ok = _chunk_valid(
+        lambda ch: _auth_ok(ch) | (ipv6_auth[:, None] & (ch == ord("%"))),
+        chars, nxt1, nxt2, pos, auth_s, auth_e,
+        allow_invalid_escapes=ipv6_auth)
+    valid = valid & (~auth_nonempty | auth_ok)
+    has[AUTHORITY] = auth_nonempty
+    spans[AUTHORITY] = (auth_s, auth_e)
+
+    # path: from next_slash (if any) else empty
+    path_s = jnp.where(has_auth, jnp.where(have_ns, next_slash, length),
+                       start)
+    path_e = jnp.where(has_auth, jnp.where(have_ns, path_end, length),
+                       path_end)
+    path_s = jnp.where(only_path, 0, path_s)
+    path_e = jnp.where(only_path, 0, path_e)
+    has_path = hier | only_path
+    p_ok = _chunk_valid(_path_ok, chars, nxt1, nxt2, pos, path_s, path_e)
+    valid = valid & (~has_path | p_ok)
+    has[PATH] = has_path
+    spans[PATH] = (path_s, path_e)
+
+    # ---- userinfo / host / port inside the authority --------------------
+    in_auth = inside & (pos >= auth_s[:, None]) & (pos < auth_e[:, None])
+    amp = _first_pos(in_auth & (c == ord("@")), pos, L)
+    has_amp = auth_nonempty & (amp < auth_e) & (amp > auth_s)  # amp>0 rel.
+    ui_s, ui_e = auth_s, amp
+    ui_ok = _chunk_valid(_userinfo_ok, chars, nxt1, nxt2, pos, ui_s, ui_e)
+    valid = valid & (~has_amp | ui_ok)
+    has[USERINFO] = has_amp
+    spans[USERINFO] = (ui_s, ui_e)
+    host_s = jnp.where(has_amp, amp + 1, auth_s)
+    # last ':' and ']' at positions after userinfo
+    in_host_zone = inside & (pos >= host_s[:, None]) & (pos < auth_e[:, None])
+    last_colon = _last_pos(in_host_zone & (c == ord(":")),
+                           jnp.broadcast_to(pos, chars.shape))
+    last_brk = _last_pos(in_host_zone & (c == ord("]")),
+                         jnp.broadcast_to(pos, chars.shape))
+    # the reference computes last_colon relative (i or i-amp-1) and tests
+    # last_colon > 0: a colon at relative 0 does NOT make a port
+    rel0 = last_colon == host_s
+    has_port = auth_nonempty & (last_colon >= 0) & ~rel0 \
+        & ((last_brk < 0) | (last_colon > last_brk))
+    port_s, port_e = last_colon + 1, auth_e
+    # (reference validate_port accepts any char — a preserved quirk)
+    has[PORT] = has_port
+    spans[PORT] = (port_s, port_e)
+    host_e = jnp.where(has_port, last_colon, auth_e)
+    # extract host window and validate
+    H = min(L, 256)
+    hidx = jnp.clip(host_s[:, None], 0, L) + jnp.arange(H, dtype=i32)[None, :]
+    hwin = jnp.take_along_axis(jnp.pad(chars, ((0, 0), (0, H))),
+                               jnp.clip(hidx, 0, L + H - 1), axis=1)
+    hlen = jnp.clip(host_e - host_s, 0, H)
+    hwin = jnp.where(jnp.arange(H, dtype=i32)[None, :] < hlen[:, None],
+                     hwin, jnp.uint8(0))
+    host_valid, host_fatal = _validate_host(hwin, hlen)
+    valid = valid & (~auth_nonempty | ~host_fatal)
+    has[HOST] = auth_nonempty & host_valid
+    spans[HOST] = (host_s, host_e)
+
+    # ---- select the requested part --------------------------------------
+    part_id = _PARTS[part]
+    out_has = has[part_id] & valid & validity
+    s, e = spans[part_id]
+
+    if part_id == QUERY and key is not None:
+        kb = key.encode()
+        klen = len(kb)
+        karr = jnp.asarray(list(kb), jnp.uint8) if klen else None
+        q_s_, q_e_ = spans[QUERY]
+        in_q = inside & (pos >= q_s_[:, None]) & (pos < q_e_[:, None])
+        # match at param starts: q_s or after '&'; needle then '='
+        prev_chars = jnp.pad(chars, ((0, 0), (1, 0)))[:, :L]
+        at_start = (pos == q_s_[:, None]) | (
+            in_q & (prev_chars == ord("&")))
+        match = jnp.ones((n, L), jnp.bool_)
+        cp2 = jnp.pad(chars, ((0, 0), (0, klen + 1)))
+        for k in range(klen):
+            match = match & (cp2[:, k: L + k] == karr[k])
+        match = match & (cp2[:, klen: L + klen] == ord("="))
+        # reference stops the search once p + klen >= q_e
+        match = match & at_start & ((pos + klen) < q_e_[:, None])
+        mpos = _first_pos(match, pos, L)
+        found = out_has & (mpos < L)
+        v_s = mpos + klen + 1
+        after_amp = _first_pos(
+            inside & (c == ord("&")) & (pos >= v_s[:, None])
+            & (pos < q_e_[:, None]), pos, L)
+        v_e = jnp.minimum(after_amp, q_e_)
+        out_has = found
+        s, e = v_s, v_e
+
+    out_len = jnp.clip(e - s, 0, L)
+    W = L
+    oidx = jnp.clip(s[:, None], 0, L) + jnp.arange(W, dtype=i32)[None, :]
+    out = jnp.take_along_axis(jnp.pad(chars, ((0, 0), (0, W))),
+                              jnp.clip(oidx, 0, L + W - 1), axis=1)
+    out = jnp.where(jnp.arange(W, dtype=i32)[None, :] < out_len[:, None],
+                    out, jnp.uint8(0))
+    return out, jnp.where(out_has, out_len, 0), out_has
+
+
+def parse_uri(col: StringColumn, part: str,
+              key: Optional[str] = None) -> StringColumn:
+    """Extract one URI component per row; invalid rows -> null.
+
+    ``part`` is one of PROTOCOL/HOST/QUERY/PATH (plus the internal
+    AUTHORITY/FRAGMENT/USERINFO/PORT/OPAQUE chunks); ``key`` filters the
+    query to one parameter's value (Spark ``parse_url(url, 'QUERY', k)``).
+    """
+    part = part.upper()
+    if part not in _PARTS:
+        raise ValueError(f"unknown URI part {part!r}")
+    if key is not None and part != "QUERY":
+        raise ValueError("key filter is only valid with QUERY")
+    out, lens, has = _parse(col.chars, col.lengths, col.validity, part, key)
+    return StringColumn(out, lens, has)

@@ -18,7 +18,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from ..columnar.column import ColumnBatch
 from ..relational.aggregate import AggSpec, group_by
 from .partition import spark_partition_id
-from .shuffle import exchange
+from .shuffle import exchange, plan_capacity
 
 
 def data_mesh(num_devices: Optional[int] = None, axis_name: str = "data") -> Mesh:
@@ -55,11 +55,42 @@ def distributed_group_by(
     per-device group counts, ``dropped`` int32[P] counts rows lost to slot
     overflow (0 unless ``capacity`` was undersized for the key skew).
     """
+    if capacity is None:
+        capacity = plan_exchange_capacity(batch, key_names, mesh, axis_name,
+                                          row_valid)
     step = _group_by_step(
         mesh, axis_name, tuple(key_names), tuple(aggs), capacity,
         row_valid is None,
     )
     return step(batch) if row_valid is None else step(batch, row_valid)
+
+
+def plan_exchange_capacity(batch, key_names, mesh, axis_name="data",
+                           row_valid=None, bucket: int = 256):
+    """Host-side planning: the exact global max bucket size, rounded up to
+    ``bucket`` so repeated batches reuse one compiled exchange."""
+    plan = _plan_step(mesh, axis_name, tuple(key_names), row_valid is None)
+    cmax = int(np.asarray(jax.device_get(
+        plan(batch) if row_valid is None else plan(batch, row_valid)))[0])
+    return max(bucket, -(-cmax // bucket) * bucket)
+
+
+@lru_cache(maxsize=None)
+def _plan_step(mesh, axis_name, key_names, all_valid):
+    P = mesh.shape[axis_name]
+    spec = PartitionSpec(axis_name)
+    n_in = 1 if all_valid else 2
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(spec,) * n_in, out_specs=spec, check_vma=False,
+    )
+    def plan(b, *rv):
+        rv = jnp.ones((b.num_rows,), jnp.bool_) if all_valid else rv[0]
+        pid = spark_partition_id([b[k] for k in key_names], P, rv)
+        return plan_capacity(pid, axis_name, P)[None]
+
+    return jax.jit(plan)
 
 
 @lru_cache(maxsize=None)
@@ -107,3 +138,165 @@ def collect_groups(result: ColumnBatch, num_groups) -> dict:
         name: gather_column(col, idx_dev).to_pylist()
         for name, col in zip(result.names, result.columns)
     }
+
+
+def distributed_hash_join(
+    left: ColumnBatch,
+    right: ColumnBatch,
+    left_on: Sequence[str],
+    right_on: Sequence[str],
+    how: str,
+    mesh: Mesh,
+    axis_name: str = "data",
+    capacity: Optional[int] = None,
+    out_capacity: Optional[int] = None,
+):
+    """Shuffle both sides by key hash, then join each partition locally.
+
+    Spark semantics hold globally because matching keys land on the same
+    device (identical murmur3 partition ids on both sides).  Returns
+    ``(result, counts int32[P], dropped int32[P*2])`` — result rows are
+    device-local with each shard's matches in front.
+    """
+    if capacity is None:
+        capacity = max(
+            plan_exchange_capacity(left, left_on, mesh, axis_name),
+            plan_exchange_capacity(right, right_on, mesh, axis_name),
+        )
+    step = _join_step(mesh, axis_name, tuple(left_on), tuple(right_on), how,
+                      capacity, out_capacity)
+    return step(left, right)
+
+
+@lru_cache(maxsize=None)
+def _join_step(mesh, axis_name, left_on, right_on, how, capacity,
+               out_capacity):
+    from ..relational.join import hash_join
+
+    P = mesh.shape[axis_name]
+    spec = PartitionSpec(axis_name)
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(spec, spec), out_specs=(spec, spec, spec), check_vma=False,
+    )
+    def step(lb: ColumnBatch, rb: ColumnBatch):
+        lv = jnp.ones((lb.num_rows,), jnp.bool_)
+        rv = jnp.ones((rb.num_rows,), jnp.bool_)
+        lpid = spark_partition_id([lb[k] for k in left_on], P, lv)
+        rpid = spark_partition_id([rb[k] for k in right_on], P, rv)
+        ls, locc, ldrop = exchange(lb, lpid, axis_name, P, capacity)
+        rs, rocc, rdrop = exchange(rb, rpid, axis_name, P, capacity)
+        # dead slots neither match nor emit: hash_join's left_valid zeroes
+        # probe counts and right_valid nulls build keys
+        out, count = hash_join(ls, rs, list(left_on), list(right_on), how,
+                               capacity=out_capacity,
+                               left_valid=locc, right_valid=rocc)
+        return out, count[None], jnp.stack([ldrop, rdrop])[None]
+
+    return jax.jit(step)
+
+
+def distributed_sort(
+    batch: ColumnBatch,
+    key_names: Sequence[str],
+    mesh: Mesh,
+    axis_name: str = "data",
+    capacity: Optional[int] = None,
+):
+    """Global sort: range-partition by sampled splitters, then sort locally.
+
+    Returns ``(result, occupancy bool rows, dropped)`` — device d holds the
+    d-th global key range in sorted order (with slot padding interleaved).
+    Splitters are sampled on the host from the first key column's radix
+    words, the classic sample-sort plan pass.
+    """
+    from ..relational import keys as K
+    from ..relational.sort import SortKey, sort_by
+
+    P = mesh.shape[axis_name]
+    # host-side splitter sampling: a strided SAMPLE of the radix key words
+    # (not the full column — sample-sort needs a few hundred rows per
+    # device, not an O(n log n) host sort of everything)
+    kcols = [batch[k] for k in key_names]
+    karr = K.batch_radix_keys(kcols, equality=False, nulls_first=True)
+    n = karr[0].shape[0]
+    sample_n = min(n, max(P * 64, 1024))
+    stride = max(n // sample_n, 1)
+    words = np.stack(
+        [np.asarray(jax.device_get(a[::stride])) for a in karr], axis=1)
+    order = np.lexsort(words[:, ::-1].T)
+    m = words.shape[0]
+    picks = order[np.linspace(0, m - 1, P + 1).astype(np.int64)[1:-1]]
+    splitters = jnp.asarray(words[picks])  # [P-1, nw]
+
+    if capacity is None:
+        # plan: count destinations per device
+        plan = _sort_plan_step(mesh, axis_name, tuple(key_names),
+                               splitters.shape)
+        cmax = int(np.asarray(jax.device_get(plan(batch, splitters)))[0])
+        capacity = max(256, -(-cmax // 256) * 256)
+    step = _sort_step(mesh, axis_name, tuple(key_names), splitters.shape,
+                      capacity)
+    return step(batch, splitters)
+
+
+def _range_pid(b, key_names, splitters, P):
+    from ..relational import keys as K
+
+    karr = K.batch_radix_keys([b[k] for k in key_names], equality=False,
+                              nulls_first=True)
+    R = karr[0].shape[0]
+    pid = jnp.zeros((R,), jnp.int32)
+    for s in range(P - 1):
+        gt = jnp.zeros((R,), jnp.bool_)
+        lt = jnp.zeros((R,), jnp.bool_)
+        for w, a in enumerate(karr):
+            sw = splitters[s, w]
+            gt = gt | (~lt & (a > sw))
+            lt = lt | (~gt & (a < sw))
+        pid = pid + gt.astype(jnp.int32)
+    return pid
+
+
+@lru_cache(maxsize=None)
+def _sort_plan_step(mesh, axis_name, key_names, splitter_shape):
+    P = mesh.shape[axis_name]
+    spec = PartitionSpec(axis_name)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(spec, PartitionSpec()),
+             out_specs=spec, check_vma=False)
+    def plan(b, splitters):
+        pid = _range_pid(b, key_names, splitters, P)
+        return plan_capacity(pid, axis_name, P)[None]
+
+    return jax.jit(plan)
+
+
+@lru_cache(maxsize=None)
+def _sort_step(mesh, axis_name, key_names, splitter_shape, capacity):
+    from ..relational.sort import SortKey, sort_by
+
+    P = mesh.shape[axis_name]
+    spec = PartitionSpec(axis_name)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(spec, PartitionSpec()),
+             out_specs=(spec, spec, spec), check_vma=False)
+    def step(b, splitters):
+        pid = _range_pid(b, key_names, splitters, P)
+        shuffled, occ, dropped = exchange(b, pid, axis_name, P, capacity)
+        # local sort with dead slots last: seed an occupancy pre-key by
+        # sorting on (~occ, keys...) — reuse sort_by with an extra column
+        from ..columnar import types as T
+        from ..columnar.column import Column
+
+        aug = shuffled.with_column(
+            "__occ", Column(occ.astype(jnp.int32), jnp.ones_like(occ), T.INT32)
+        )
+        out = sort_by(aug, [SortKey("__occ", ascending=False)]
+                      + [SortKey(k) for k in key_names])
+        occ_sorted = out["__occ"].data == 1
+        out = out.select([n for n in out.names if n != "__occ"])
+        return out, occ_sorted, dropped[None]
+
+    return jax.jit(step)

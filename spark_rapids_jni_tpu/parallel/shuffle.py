@@ -70,3 +70,21 @@ def exchange(
     out_batch = jax.tree_util.tree_map(a2a, send)
     occupancy = a2a(slot_occ)
     return out_batch, occupancy, dropped
+
+
+def plan_capacity(pid, axis_name: str, num_partitions: int):
+    """Per-device max (sender,destination) bucket size, maxed over the mesh.
+
+    The lossless-shuffle planning pass: run this (inside ``shard_map``)
+    first, fetch the scalar, and size :func:`exchange`'s static ``capacity``
+    with it — shapes stay static, no rows can drop.  The host round-trip is
+    the TPU analogue of the reference's size-then-write two-pass kernels.
+    """
+    R = pid.shape[0]
+    P = num_partitions
+    pid = jnp.clip(pid.astype(jnp.int32), 0, P)
+    counts = jax.ops.segment_sum(
+        jnp.ones((R,), jnp.int32), pid, num_segments=P + 1
+    )[:P]
+    local_max = counts.max()
+    return jax.lax.pmax(local_max, axis_name)

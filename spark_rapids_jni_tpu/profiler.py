@@ -1,0 +1,195 @@
+"""Always-attachable profiler with the reference's lifecycle + writer API.
+
+Reference: the CUPTI-based profiler (``Profiler.java:37-124``: init/start/
+stop/shutdown with a ``DataWriter`` sink; ``profiler_serializer.cpp`` emits
+size-prefixed flatbuffer records; ``spark_rapids_profile_converter`` turns
+captures into JSON offline).  The TPU equivalent wraps the XLA profiler
+(xplane/trace collection via ``jax.profiler``):
+
+* :class:`Profiler` — ``init(writer)`` / ``start()`` / ``stop()`` /
+  ``shutdown()``.  Each start/stop cycle collects a trace and streams it to
+  the writer as size-prefixed framed chunks, so a Spark executor can route
+  profiles to distributed storage exactly like the reference's
+  ``DataWriter`` path.
+* :func:`convert_profile` — the offline converter: reads a captured
+  stream back into per-event records (kernel/op name, start, duration),
+  decoding the Chrome-trace JSON the XLA profiler produces.
+
+Frame format: ``b"SPTPUPRF" u32(version) [u32(len) bytes]*`` — the same
+size-prefixed-records idea as ``profiler.fbs`` (``ProfileHeader`` magic +
+``ActivityRecords``), carrying trace files instead of CUPTI activities.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import io
+import json
+import os
+import shutil
+import struct
+import tempfile
+import threading
+from typing import Callable, List, Optional
+
+MAGIC = b"SPTPUPRF"
+VERSION = 1
+
+
+class ProfilerError(RuntimeError):
+    pass
+
+
+class Profiler:
+    """Process-wide profiler facade (mirrors Profiler.java's static API)."""
+
+    _lock = threading.Lock()
+    _writer: Optional[Callable[[bytes], None]] = None
+    _dir: Optional[str] = None
+    _running = False
+    _initialized = False
+    _wrote_header = False
+
+    @classmethod
+    def init(cls, data_writer: Callable[[bytes], None]):
+        """Install the sink; profiling stays off until :meth:`start`."""
+        with cls._lock:
+            if cls._initialized:
+                raise ProfilerError("profiler already initialized")
+            cls._writer = data_writer
+            cls._dir = tempfile.mkdtemp(prefix="sptpu_prof_")
+            cls._initialized = True
+            cls._wrote_header = False
+
+    @classmethod
+    def start(cls):
+        """Begin collecting (cuProfilerStart equivalent)."""
+        import jax
+
+        with cls._lock:
+            if not cls._initialized:
+                raise ProfilerError("profiler not initialized")
+            if cls._running:
+                return
+            jax.profiler.start_trace(cls._dir)
+            cls._running = True
+
+    @classmethod
+    def stop(cls):
+        """Stop collecting and flush the capture to the writer."""
+        import jax
+
+        with cls._lock:
+            if not cls._initialized or not cls._running:
+                return
+            jax.profiler.stop_trace()
+            cls._running = False
+            cls._flush_locked()
+
+    @classmethod
+    def shutdown(cls):
+        """Stop if needed, flush, and release the sink."""
+        with cls._lock:
+            if not cls._initialized:
+                return
+            if cls._running:
+                import jax
+
+                jax.profiler.stop_trace()
+                cls._running = False
+                cls._flush_locked()
+            shutil.rmtree(cls._dir, ignore_errors=True)
+            cls._writer = None
+            cls._dir = None
+            cls._initialized = False
+
+    # -- internals -------------------------------------------------------
+    @classmethod
+    def _flush_locked(cls):
+        buf = io.BytesIO()
+        if not cls._wrote_header:
+            buf.write(MAGIC)
+            buf.write(struct.pack("<I", VERSION))
+            cls._wrote_header = True
+        for path in sorted(
+            glob.glob(os.path.join(cls._dir, "**", "*"), recursive=True)
+        ):
+            if not os.path.isfile(path):
+                continue
+            name = os.path.relpath(path, cls._dir).encode()
+            with open(path, "rb") as f:
+                payload = f.read()
+            rec = struct.pack("<I", len(name)) + name + payload
+            buf.write(struct.pack("<I", len(rec)))
+            buf.write(rec)
+            os.remove(path)
+        data = buf.getvalue()
+        if data:
+            cls._writer(data)
+
+
+class FileWriter:
+    """A DataWriter that appends frames to one capture file."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "ab")
+
+    def __call__(self, data: bytes):
+        self._f.write(data)
+        self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+
+def _iter_frames(data: bytes):
+    off = 0
+    if data[:8] == MAGIC:
+        off = 12
+    while off + 4 <= len(data):
+        (ln,) = struct.unpack_from("<I", data, off)
+        off += 4
+        rec = data[off: off + ln]
+        off += ln
+        (nlen,) = struct.unpack_from("<I", rec, 0)
+        name = rec[4: 4 + nlen].decode()
+        payload = rec[4 + nlen:]
+        yield name, payload
+
+
+def convert_profile(capture_path: str) -> List[dict]:
+    """Offline converter: capture stream -> flat event records.
+
+    Equivalent role to ``spark_rapids_profile_converter`` (flatbuffer ->
+    JSON); decodes the Chrome-trace JSON (``*.trace.json.gz``) inside the
+    capture into ``{"name", "ts_us", "dur_us", "tid", "pid"}`` records.
+    """
+    with open(capture_path, "rb") as f:
+        data = f.read()
+    if data[:8] != MAGIC:
+        raise ProfilerError(f"{capture_path}: not a SPTPUPRF capture")
+    events: List[dict] = []
+    for name, payload in _iter_frames(data):
+        if name.endswith(".trace.json.gz"):
+            doc = json.loads(gzip.decompress(payload))
+            for ev in doc.get("traceEvents", []):
+                if ev.get("ph") == "X" and "name" in ev:
+                    events.append(
+                        {
+                            "name": ev["name"],
+                            "ts_us": ev.get("ts", 0),
+                            "dur_us": ev.get("dur", 0),
+                            "pid": ev.get("pid"),
+                            "tid": ev.get("tid"),
+                        }
+                    )
+    return events
+
+
+def list_capture_files(capture_path: str) -> List[str]:
+    """Names of the raw trace artifacts inside a capture (xplane etc.)."""
+    with open(capture_path, "rb") as f:
+        data = f.read()
+    return [name for name, _ in _iter_frames(data)]

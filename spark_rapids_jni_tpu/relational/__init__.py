@@ -22,6 +22,7 @@ from .gather import gather_batch, gather_column
 from .sort import SortKey, sort_by
 from .aggregate import AggSpec, group_by
 from .join import hash_join
+from .window import WindowSpec, window
 
 __all__ = [
     "apply_mask",
@@ -33,4 +34,6 @@ __all__ = [
     "AggSpec",
     "group_by",
     "hash_join",
+    "WindowSpec",
+    "window",
 ]

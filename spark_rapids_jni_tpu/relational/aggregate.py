@@ -1,22 +1,27 @@
-"""Hash-based group-by aggregation (Spark hash-aggregate semantics).
+"""Sort-scan group-by aggregation (Spark hash-aggregate semantics).
 
-Round 1 used radix-sort + segment boundaries; on real TPU hardware the sort
-dominated the whole q6 pipeline (BENCH_r02 micro: group_by 3.2 Mrows/s vs
-murmur3 160 Mrows/s).  This is now a true hash aggregate, formulated for the
-VPU with no serial probe chains:
+Three designs were measured on the real chip this round:
 
-1. lower keys to uint32 radix words (:mod:`keys`, equality domain),
-2. elect one *representative row* per distinct key by iterated bucket
-   election: hash → ``scatter-min`` of row ids into a 2n-slot table →
-   exact key compare against the winner → resolved rows drop out, colliding
-   keys re-hash with a new seed (``lax.while_loop``; expected O(1) rounds —
-   a round only repeats for distinct keys whose 32-bit mix collided),
-3. group id = prefix-count of representatives (first-occurrence order),
-4. ``jax.ops.segment_*`` scatter reductions per aggregate.
+* radix-sort + argsort + segment ops (round 1): 3.2 Mrows/s — the two
+  sorts and the scatter-backed ``segment_*`` ops each cost 95-630ms at 2M
+  rows on this TPU;
+* scatter-min bucket election + segment ops: no better — XLA scatters are
+  the single slowest primitive on this chip (~150ms per 2M-row scatter);
+* THIS design: **one multi-operand sort, then only scans and gathers** —
+  no scatter anywhere, and agg values ride the sort as extra payload
+  operands so no full-width random gather is needed afterwards either.
 
-No sort anywhere.  Output is padded to the input row count with a device
-``num_groups`` scalar (same discipline as :mod:`filter`); groups appear in
-first-occurrence order of their representative row (deterministic).
+Pipeline: lower keys to uint32 radix words (:mod:`keys`, equality domain)
+-> one ``lax.sort`` carrying [keys..., row-id, agg-value words...] ->
+adjacent-compare boundaries on the sorted key words -> per-agg prefix
+``cumsum`` (or segmented min/max ``associative_scan``) -> group result =
+scan value at each group's last row minus the previous group's, fetched
+with one small gather at the compacted group-end positions.
+
+Output is padded to the input row count with a device ``num_groups``
+scalar (same discipline as :mod:`filter`); groups appear in key-sorted
+order, nulls first (Spark does not define a group order; this one is
+deterministic).
 
 Spark null/type semantics implemented here (mirrors what the plugin gets
 from cudf groupby + Spark's type promotion):
@@ -26,7 +31,9 @@ from cudf groupby + Spark's type promotion):
 * sum/min/max ignore null inputs; all-null group -> null result.
 * count(col) counts non-nulls, count(*) counts rows; never null.
 * sum(int*) -> int64 (non-ANSI wraparound), sum(float*) -> float64,
-  avg(*) -> float64.
+  avg(*) -> float64.  Float sums are computed as prefix-sum differences;
+  they are not bit-identical to a per-group left-fold (Spark itself is
+  order-nondeterministic under shuffles).
 """
 
 from __future__ import annotations
@@ -67,106 +74,16 @@ def _sum_dtype(dtype: T.SparkType) -> T.SparkType:
     raise NotImplementedError(f"sum of {dtype!r}")
 
 
-def _segment_minmax(data, valid, gid, n, op: str):
-    """Null-ignoring segmented min/max with Spark float/bool semantics.
+def _seg_scan_minmax(vals, boundary, op):
+    """Segmented running min/max: resets at rows where boundary is True."""
+    def comb(a, b):
+        av, ab = a
+        bv, bb = b
+        m = jnp.minimum(av, bv) if op == "min" else jnp.maximum(av, bv)
+        return jnp.where(bb, bv, m), ab | bb
 
-    Spark orders NaN greater than every number (Java compare): max of a
-    group containing NaN is NaN; min skips NaNs unless the group is all-NaN.
-    """
-    is_float = jnp.issubdtype(data.dtype, jnp.floating)
-    was_bool = data.dtype == jnp.bool_
-    if is_float:
-        fill = jnp.array(jnp.inf if op == "min" else -jnp.inf, data.dtype)
-        nan_in = valid & jnp.isnan(data)
-        valid_num = valid & ~jnp.isnan(data)
-    elif was_bool:
-        data = data.astype(jnp.uint8)
-        fill = jnp.uint8(1 if op == "min" else 0)
-        valid_num = valid
-    else:
-        info = jnp.iinfo(data.dtype)
-        fill = jnp.array(info.max if op == "min" else info.min, data.dtype)
-        valid_num = valid
-    masked = jnp.where(valid_num, data, fill)
-    f = jax.ops.segment_min if op == "min" else jax.ops.segment_max
-    res = f(masked, gid, num_segments=n + 1)[:n]
-    if is_float:
-        seg_has_nan = (
-            jax.ops.segment_sum(nan_in.astype(jnp.int32), gid,
-                                num_segments=n + 1)[:n] > 0
-        )
-        seg_has_num = (
-            jax.ops.segment_sum(valid_num.astype(jnp.int32), gid,
-                                num_segments=n + 1)[:n] > 0
-        )
-        nan = jnp.array(jnp.nan, res.dtype)
-        if op == "max":
-            res = jnp.where(seg_has_nan, nan, res)
-        else:
-            res = jnp.where(seg_has_nan & ~seg_has_num, nan, res)
-    if was_bool:
-        res = res.astype(jnp.bool_)
-    return res
-
-
-def _mix32(h):
-    """murmur3 finalizer: full-avalanche 32-bit mix."""
-    h = h ^ (h >> jnp.uint32(16))
-    h = h * jnp.uint32(0x85EBCA6B)
-    h = h ^ (h >> jnp.uint32(13))
-    h = h * jnp.uint32(0xC2B2AE35)
-    h = h ^ (h >> jnp.uint32(16))
-    return h
-
-
-def _hash_words(karr, seed_u32):
-    """Combine uint32 key word arrays into one well-mixed uint32[n]."""
-    h = jnp.broadcast_to(_mix32(seed_u32 ^ jnp.uint32(0x9E3779B9)),
-                         karr[0].shape).astype(jnp.uint32)
-    for w in karr:
-        h = _mix32((h * jnp.uint32(31)) ^ w.astype(jnp.uint32))
-    return h
-
-
-def _elect_representatives(karr, occ, n):
-    """(rep_row int32[n], is_rep bool[n]): one representative per distinct key.
-
-    Iterated bucket election (no sort): each round, unresolved rows
-    scatter-min their row id into ``table[hash(keys, round) mod S]``; rows
-    whose keys exactly equal the bucket winner's keys resolve to that winner.
-    All rows of one key share every bucket, so the winner for a key is always
-    its minimum (first-occurrence) row — representatives are round-invariant.
-    A round only repeats for *distinct* keys that collided in a 2n-slot
-    table, so expected rounds are O(1); the loop runs until empty.
-    """
-    S = 1 << max(3, (2 * n - 1).bit_length() if n > 1 else 3)
-    S = min(S, 1 << 22)
-    iota = jnp.arange(n, dtype=jnp.int32)
-    BIG = jnp.int32(2**31 - 1)
-
-    def cond(st):
-        _, unres, _ = st
-        return unres.any()
-
-    def body(st):
-        rep, unres, r = st
-        h = _hash_words(karr, r.astype(jnp.uint32))
-        b = jnp.where(unres, (h & jnp.uint32(S - 1)).astype(jnp.int32),
-                      jnp.int32(S))
-        table = jnp.full((S + 1,), BIG, jnp.int32).at[b].min(
-            jnp.where(unres, iota, BIG)
-        )
-        cand = jnp.clip(jnp.take(table, b), 0, n - 1)
-        eq = unres
-        for k in karr:
-            eq = eq & (k == jnp.take(k, cand))
-        rep = jnp.where(eq, cand, rep)
-        return rep, unres & ~eq, r + jnp.uint32(1)
-
-    rep0 = jnp.full((n,), -1, jnp.int32)
-    rep, _, _ = jax.lax.while_loop(cond, body, (rep0, occ, jnp.uint32(0)))
-    is_rep = occ & (rep == iota)
-    return rep, is_rep
+    out, _ = jax.lax.associative_scan(comb, (vals, boundary))
+    return out
 
 
 def group_by(
@@ -177,69 +94,144 @@ def group_by(
 ) -> tuple:
     """Group ``batch`` by ``key_names``; returns (result_batch, num_groups).
 
-    The result batch has the key columns (group order = first occurrence of
-    each key, deterministic) followed by one column per AggSpec, padded to
-    the input row count with null rows past ``num_groups``.
+    The result batch has the key columns (group order = key sort order,
+    nulls first, deterministic) followed by one column per AggSpec, padded
+    to the input row count with null rows past ``num_groups``.
 
-    ``row_valid`` (bool[n], optional) marks rows that exist: padding rows of
-    an upstream compaction/shuffle are excluded from every group (without it
-    they would merge into the null-key group).  Their aggregates route to a
-    trash segment that is sliced off.
+    ``row_valid`` (bool[n], optional) marks rows that exist: padding rows
+    of an upstream filter/shuffle are excluded from every group.  They
+    sort to the back as one trailing pseudo-run that the group count and
+    end positions simply never reach.
     """
     n = batch.num_rows
     key_cols = [batch[k] for k in key_names]
     karr = K.batch_radix_keys(key_cols, equality=True, nulls_first=True)
-    occ = (jnp.ones((n,), jnp.bool_) if row_valid is None
-           else row_valid.astype(jnp.bool_))
+    have_rv = row_valid is not None
+    if have_rv:
+        occ = row_valid.astype(jnp.bool_)
+        karr = [jnp.where(occ, jnp.uint32(0), jnp.uint32(1))] + [
+            jnp.where(occ, k, jnp.zeros((), k.dtype)) for k in karr
+        ]
     iota = jnp.arange(n, dtype=jnp.int32)
 
-    rep, is_rep = _elect_representatives(karr, occ, n)
-    gid_of_row = jnp.cumsum(is_rep.astype(jnp.int32)) - 1  # valid at rep rows
-    num_groups = is_rep.sum(dtype=jnp.int32)
-    # every live row inherits its representative's group id; dead rows route
-    # to trash segment n (sliced off below)
-    gid = jnp.where(occ, jnp.take(gid_of_row, jnp.clip(rep, 0, n - 1)),
-                    jnp.int32(n))
-    # inverse permutation: row index of group g's representative
-    pos = jnp.where(is_rep, gid_of_row, jnp.int32(n))
-    rep_rows = jnp.zeros((n + 1,), jnp.int32).at[pos].set(iota)[:n]
+    # agg columns ride the sort as payload words (no post-sort gathers)
+    agg_cols = []
+    for spec in aggs:
+        if spec.column is not None and spec.column not in agg_cols:
+            col = batch[spec.column]
+            if isinstance(col, (StringColumn, Decimal128Column)):
+                raise NotImplementedError(
+                    f"{spec.op} over {col.dtype!r} groups not implemented yet"
+                )
+            agg_cols.append(spec.column)
+    # agg data rides the sort in its native dtype (the TPU X64-rewrite
+    # pass legalizes 64-bit sort payloads but not u32-pair bitcasts)
+    payload = [iota]
+    spans = {}
+    for name in agg_cols:
+        col = batch[name]
+        spans[name] = len(payload)
+        payload.extend([col.data, col.validity])
+
+    nk = len(karr)
+    res = jax.lax.sort(tuple(karr) + tuple(payload), num_keys=nk,
+                       is_stable=True)
+    skeys = res[:nk]
+    sperm = res[nk]
+    spay = res[nk + 1:]
+
+    boundary = ~K.rows_equal_adjacent(skeys)
+    sorted_occ = (skeys[0] == 0) if have_rv else jnp.ones((n,), jnp.bool_)
+    num_groups = (boundary & sorted_occ).sum(dtype=jnp.int32)
+
+    # last row of each live group: next row starts a new group / is dead /
+    # doesn't exist
+    nxt_boundary = jnp.concatenate(
+        [boundary[1:], jnp.ones((1,), jnp.bool_)])
+    nxt_occ = jnp.concatenate([sorted_occ[1:], jnp.zeros((1,), jnp.bool_)])
+    is_end = sorted_occ & (nxt_boundary | ~nxt_occ)
+    # compact end positions to the front (2-operand flag sort, no scatter)
+    ends = jax.lax.sort(
+        ((~is_end).astype(jnp.uint32), iota), num_keys=1, is_stable=True
+    )[1]
+    prev_ends = jnp.roll(ends, 1)
     out_valid = iota < num_groups
 
-    def seg_sum(vals):
-        return jax.ops.segment_sum(vals, gid, num_segments=n + 1)[:n]
+    def at_ends_diff(cs):
+        """Per-group total from a prefix scan: cs[end_g] - cs[end_{g-1}]."""
+        ce = jnp.take(cs, ends)
+        cp = jnp.where(iota == 0, jnp.zeros((), cs.dtype),
+                       jnp.take(cs, prev_ends))
+        return ce - cp
 
     out = {}
+    starts = jnp.where(iota == 0, 0, prev_ends + 1)
+    rows0 = jnp.take(sperm, jnp.clip(starts, 0, n - 1))
     for name in key_names:
-        out[name] = gather_column(batch[name], rep_rows, out_valid)
+        out[name] = gather_column(batch[name], rows0, out_valid)
+
+    def sorted_col(name):
+        off = spans[name]
+        data = spay[off - 1]  # payload[0] is iota (== sperm)
+        valid = spay[off] & sorted_occ
+        return data, valid
 
     for spec in aggs:
         if spec.op == "count":
             if spec.column is None:
-                ones = occ.astype(jnp.int64)
+                ones = sorted_occ.astype(jnp.int64)
             else:
-                ones = (batch[spec.column].validity & occ).astype(jnp.int64)
-            out[spec.out_name] = Column(seg_sum(ones), out_valid, T.INT64)
+                _, valid = sorted_col(spec.column)
+                ones = valid.astype(jnp.int64)
+            out[spec.out_name] = Column(at_ends_diff(jnp.cumsum(ones)),
+                                        out_valid, T.INT64)
             continue
 
-        col = batch[spec.column]
-        if isinstance(col, (StringColumn, Decimal128Column)):
-            raise NotImplementedError(
-                f"{spec.op} over {col.dtype!r} groups not implemented yet"
-            )
-        data, valid = col.data, col.validity & occ
-        nn = seg_sum(valid.astype(jnp.int32))
+        data, valid = sorted_col(spec.column)
+        col_dtype = batch[spec.column].dtype
+        nn = at_ends_diff(jnp.cumsum(valid.astype(jnp.int32)))
         has_any = nn > 0
 
         if spec.op in ("sum", "mean"):
-            out_t = T.FLOAT64 if spec.op == "mean" else _sum_dtype(col.dtype)
-            acc = data.astype(out_t.jnp_dtype if spec.op == "sum" else jnp.float64)
+            out_t = T.FLOAT64 if spec.op == "mean" else _sum_dtype(col_dtype)
+            acc = data.astype(out_t.jnp_dtype if spec.op == "sum"
+                              else jnp.float64)
             acc = jnp.where(valid, acc, jnp.zeros((), acc.dtype))
-            s = seg_sum(acc)
+            s = at_ends_diff(jnp.cumsum(acc))
             if spec.op == "mean":
                 s = s / jnp.maximum(nn, 1).astype(jnp.float64)
             out[spec.out_name] = Column(s, out_valid & has_any, out_t)
-        else:  # min / max
-            r = _segment_minmax(data, valid, gid, n, spec.op)
-            out[spec.out_name] = Column(r, out_valid & has_any, col.dtype)
+        else:  # min / max — Spark float semantics: NaN greatest, one NaN
+            is_float = jnp.issubdtype(data.dtype, jnp.floating)
+            was_bool = data.dtype == jnp.bool_
+            if is_float:
+                fill = jnp.array(jnp.inf if spec.op == "min" else -jnp.inf,
+                                 data.dtype)
+                nan_in = valid & jnp.isnan(data)
+                valid_num = valid & ~jnp.isnan(data)
+            elif was_bool:
+                data = data.astype(jnp.uint8)
+                fill = jnp.uint8(1 if spec.op == "min" else 0)
+                valid_num = valid
+            else:
+                info = jnp.iinfo(data.dtype)
+                fill = jnp.array(info.max if spec.op == "min" else info.min,
+                                 data.dtype)
+                valid_num = valid
+            masked = jnp.where(valid_num, data, fill)
+            run = _seg_scan_minmax(masked, boundary, spec.op)
+            r = jnp.take(run, ends)
+            if is_float:
+                seg_nan = at_ends_diff(jnp.cumsum(nan_in.astype(jnp.int32))) > 0
+                seg_num = at_ends_diff(
+                    jnp.cumsum(valid_num.astype(jnp.int32))) > 0
+                nan = jnp.array(jnp.nan, r.dtype)
+                if spec.op == "max":
+                    r = jnp.where(seg_nan, nan, r)
+                else:
+                    r = jnp.where(seg_nan & ~seg_num, nan, r)
+            if was_bool:
+                r = r.astype(jnp.bool_)
+            out[spec.out_name] = Column(r, out_valid & has_any, col_dtype)
 
     return ColumnBatch(out), num_groups

@@ -62,6 +62,8 @@ def hash_join(
     how: str = "inner",
     capacity: Optional[int] = None,
     suffixes: tuple = ("", "_r"),
+    left_valid=None,
+    right_valid=None,
 ) -> tuple:
     """Equality join; returns ``(result_batch, count)``.
 
@@ -73,6 +75,11 @@ def hash_join(
     split-and-retry contract on output-size overflow.
 
     semi/anti return filtered left rows (padded + count, like ``compact``).
+
+    ``left_valid``/``right_valid`` (bool[n], optional) mark live rows when
+    the inputs carry shuffle slot padding: dead right rows never match,
+    dead left rows produce no output (not even for left/anti joins, where
+    Spark WOULD keep a live null-keyed row).
     """
     if how not in _HOWS:
         raise ValueError(f"unknown join type {how!r}")
@@ -90,6 +97,11 @@ def hash_join(
     lcols, rcols = K.align_string_key_columns(
         [left[k] for k in left_on], [right[k] for k in right_on]
     )
+    if right_valid is not None:
+        import dataclasses as _dc
+
+        rcols = [_dc.replace(c, validity=c.validity & right_valid)
+                 for c in rcols]
 
     # build: sort right by (null-flag, radix keys); nulls sort last and can
     # never equal a valid probe (flag mismatch)
@@ -107,14 +119,18 @@ def hash_join(
     for c in lcols:
         l_null = l_null | ~c.validity
     counts = jnp.where(l_null, 0, hi - lo).astype(jnp.int32)
+    l_live = (jnp.ones((nl,), jnp.bool_) if left_valid is None
+              else left_valid.astype(jnp.bool_))
+    counts = jnp.where(l_live, counts, 0)
 
     if how == "semi":
-        return compact(left, counts > 0)
+        return compact(left, (counts > 0) & l_live)
     if how == "anti":
-        return compact(left, counts == 0)
+        return compact(left, (counts == 0) & l_live)
 
     outer = how == "left"
-    counts_out = jnp.maximum(counts, 1) if outer else counts
+    counts_out = jnp.where(l_live, jnp.maximum(counts, 1), 0) if outer \
+        else counts
     cum = jnp.cumsum(counts_out)  # inclusive
     total = cum[-1] if nl else jnp.int32(0)
     offsets = cum - counts_out

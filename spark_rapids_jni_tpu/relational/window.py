@@ -1,0 +1,175 @@
+"""Window functions over sorted partitions (the TPC-DS q67 shape).
+
+The reference repo itself carries no window kernels (they live in libcudf),
+but q67 — sort + window + rollup — is one of the five driver benchmark
+configs (BASELINE.md), so the relational layer needs them.  TPU-first
+formulation: one multi-operand ``lax.sort`` by (partition keys, order
+keys) carrying payload values, then every window primitive is either a
+segmented ``associative_scan`` (running sum/min/max/count) or pure
+boundary arithmetic (row_number / rank / dense_rank) — no scatters, same
+design as :mod:`aggregate`.
+
+Results come back in the SORTED row order together with the permutation
+(``sorted_row``), matching Spark's window-operator output contract where
+rows flow on in partition order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import types as T
+from ..columnar.column import Column, ColumnBatch
+from . import keys as K
+from .gather import gather_batch
+
+_WINDOW_OPS = ("row_number", "rank", "dense_rank", "sum", "min", "max",
+               "count", "avg")
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowSpec:
+    op: str                    # row_number | rank | dense_rank | sum | ...
+    column: Optional[str]      # None for row_number/rank/dense_rank/count(*)
+    out_name: str
+
+    def __post_init__(self):
+        if self.op not in _WINDOW_OPS:
+            raise ValueError(f"unknown window op {self.op!r}")
+        if self.column is None and self.op in ("sum", "min", "max", "avg"):
+            raise ValueError(f"{self.op} needs a value column")
+
+
+def _seg_scan(vals, boundary, combine):
+    """Inclusive segmented scan; segments restart where boundary is True."""
+    def comb(a, b):
+        av, ab = a
+        bv, bb = b
+        return jnp.where(bb, bv, combine(av, bv)), ab | bb
+
+    out, _ = jax.lax.associative_scan(comb, (vals, boundary))
+    return out
+
+
+def window(
+    batch: ColumnBatch,
+    partition_by: Sequence[str],
+    order_by: Sequence[str],
+    specs: Sequence[WindowSpec],
+    descending: Sequence[bool] = (),
+) -> ColumnBatch:
+    """Evaluate window functions; running frame = UNBOUNDED PRECEDING..CURRENT
+    ROW for aggregates (Spark's default with ORDER BY).
+
+    Returns the input columns in sorted order plus one column per spec.
+    """
+    n = batch.num_rows
+    pkeys = [batch[k] for k in partition_by]
+    okeys = [batch[k] for k in order_by]
+    desc = list(descending) if descending else [False] * len(order_by)
+
+    if len(desc) != len(order_by):
+        raise ValueError(
+            f"descending has {len(desc)} entries for {len(order_by)} "
+            "order-by columns")
+    karr = K.batch_radix_keys(pkeys, equality=True, nulls_first=True)
+    np_part = len(karr)
+    for col, d in zip(okeys, desc):
+        # Spark default: ASC -> NULLS FIRST, DESC -> NULLS LAST.  Only the
+        # DATA words invert for descending; the null flag already encodes
+        # its placement and must not be flipped again.
+        arrs = [K.null_flag(col, nulls_first=not d)] + [
+            ~a if d else a
+            for a in (
+                jnp.where(col.validity, w, jnp.zeros((), w.dtype))
+                for w in K.column_radix_keys(col, equality=False)
+            )
+        ]
+        karr.extend(arrs)
+
+    iota = jnp.arange(n, dtype=jnp.int32)
+    res = jax.lax.sort(tuple(karr) + (iota,), num_keys=len(karr),
+                       is_stable=True)
+    skeys = res[:-1]
+    perm = res[-1]
+    sorted_batch = gather_batch(batch, perm)
+
+    part_boundary = ~K.rows_equal_adjacent(skeys[:np_part])
+    full_boundary = ~K.rows_equal_adjacent(skeys)  # partition + order change
+
+    ones = jnp.ones((n,), jnp.int64)
+    # row_number: 1-based position within partition
+    rn = _seg_scan(ones, part_boundary, lambda a, b: a + b)
+    # dense_rank: count of order-key changes within the partition
+    order_change = full_boundary & ~part_boundary
+    dr = _seg_scan(order_change.astype(jnp.int64), part_boundary,
+                   lambda a, b: a + b) + 1
+    # rank: row_number of the first peer — propagate rn at order changes
+    first_of_peers = part_boundary | order_change
+    rank = _seg_scan(jnp.where(first_of_peers, rn, 0), part_boundary,
+                     lambda a, b: jnp.maximum(a, b))
+
+    out = {name: col for name, col in
+           zip(sorted_batch.names, sorted_batch.columns)}
+    out["sorted_row"] = Column(perm, jnp.ones((n,), jnp.bool_), T.INT32)
+
+    for spec in specs:
+        if spec.op == "row_number":
+            out[spec.out_name] = Column(rn, jnp.ones((n,), jnp.bool_), T.INT64)
+            continue
+        if spec.op == "rank":
+            out[spec.out_name] = Column(rank, jnp.ones((n,), jnp.bool_),
+                                        T.INT64)
+            continue
+        if spec.op == "dense_rank":
+            out[spec.out_name] = Column(dr, jnp.ones((n,), jnp.bool_),
+                                        T.INT64)
+            continue
+
+        if spec.op == "count" and spec.column is None:
+            out[spec.out_name] = Column(rn, jnp.ones((n,), jnp.bool_),
+                                        T.INT64)
+            continue
+
+        col = sorted_batch[spec.column]
+        data, valid = col.data, col.validity
+        if spec.op == "count":
+            cnt = _seg_scan(valid.astype(jnp.int64), part_boundary,
+                            lambda a, b: a + b)
+            out[spec.out_name] = Column(cnt, jnp.ones((n,), jnp.bool_),
+                                        T.INT64)
+            continue
+
+        nn = _seg_scan(valid.astype(jnp.int64), part_boundary,
+                       lambda a, b: a + b)
+        has_any = nn > 0
+        if spec.op in ("sum", "avg"):
+            from .aggregate import _sum_dtype
+
+            out_t = T.FLOAT64 if spec.op == "avg" else _sum_dtype(col.dtype)
+            acc = data.astype(out_t.jnp_dtype if spec.op == "sum"
+                              else jnp.float64)
+            acc = jnp.where(valid, acc, jnp.zeros((), acc.dtype))
+            s = _seg_scan(acc, part_boundary, lambda a, b: a + b)
+            if spec.op == "avg":
+                s = s / jnp.maximum(nn, 1).astype(jnp.float64)
+            out[spec.out_name] = Column(s, has_any, out_t)
+        else:  # min / max running
+            is_float = jnp.issubdtype(data.dtype, jnp.floating)
+            if is_float:
+                fill = jnp.array(jnp.inf if spec.op == "min" else -jnp.inf,
+                                 data.dtype)
+            else:
+                info = jnp.iinfo(data.dtype)
+                fill = jnp.array(info.max if spec.op == "min" else info.min,
+                                 data.dtype)
+            masked = jnp.where(valid, data, fill)
+            f = jnp.minimum if spec.op == "min" else jnp.maximum
+            r = _seg_scan(masked, part_boundary, f)
+            out[spec.out_name] = Column(r, has_any, col.dtype)
+
+    return ColumnBatch(out)

@@ -176,3 +176,75 @@ def test_oracle_none_input():
 def test_oracle_path_depth_cap():
     json = "{}"
     assert J.get_json_object(json, [N("k")] * 17) is None
+
+
+# ---------------------------------------------------------------------------
+# device kernel (ops/get_json_object.py) — non-wildcard subset
+# ---------------------------------------------------------------------------
+
+def _device_get_json_object(rows, path):
+    from spark_rapids_jni_tpu.columnar.column import StringColumn
+    from spark_rapids_jni_tpu.ops.get_json_object import get_json_object
+
+    col = StringColumn.from_pylist(rows, pad_to_multiple=16)
+    return get_json_object(col, path).to_pylist()
+
+
+def test_device_golden_batch():
+    """Every golden vector, grouped by path so each runs as one batch."""
+    by_path = {}
+    for j, p, e in GOLDEN:
+        by_path.setdefault(tuple(p), []).append((j, e))
+    for path, cases in by_path.items():
+        rows = [j for j, _ in cases]
+        expected = [e for _, e in cases]
+        got = _device_get_json_object(rows, list(path))
+        assert got == expected, (path, rows, got, expected)
+
+
+def test_device_fuzz_vs_oracle():
+    """Random JSON docs (valid and broken) must match the oracle exactly."""
+    import random
+
+    rng = random.Random(42)
+
+    def rand_value(depth):
+        k = rng.randrange(8 if depth < 3 else 6)
+        if k == 0:
+            return rng.choice(["1", "-5", "0", "123456", "-0"])
+        if k == 1:
+            return rng.choice(["1.5", "-0.25", "2e3", "1.25E-2", "100.000"])
+        if k == 2:
+            return rng.choice(["true", "false", "null"])
+        if k == 3:
+            return rng.choice(['"ab"', "'c d'", '"x\\ny"', '"\\u0041b"',
+                               '"q\\"r"', "''"])
+        if k == 4:
+            return rng.choice(['"', "{", "[1,", "01", "1.", "tru", '{"a" 1}'])
+        if k == 5:
+            return rng.choice([" 1 ", "  {}  ", "[ ]"])
+        if k == 6:
+            items = [rand_value(depth + 1) for _ in range(rng.randrange(3))]
+            return "[" + ",".join(items) + "]"
+        names = ["a", "b", "k1", "zz"]
+        fields = [
+            f'"{rng.choice(names)}":{rand_value(depth + 1)}'
+            for _ in range(rng.randrange(3))
+        ]
+        return "{" + ",".join(fields) + "}"
+
+    paths = ["$", "$.a", "$.b.a", "$[0]", "$[1]", "$.a[0]", "$[2].k1", "$.zz",
+             "$[*]", "$[*][*]", "$.a[*]", "$[*].a", "$[0][*]", "$[*].a[*]"]
+    docs = [rand_value(0) for _ in range(200)]
+    for path in paths:
+        expected = [J.get_json_object(d, _to_ins(path)) for d in docs]
+        got = _device_get_json_object(docs, path)
+        assert got == expected, [
+            (d, g, e) for d, g, e in zip(docs, got, expected) if g != e
+        ][:5]
+
+
+def _to_ins(path):
+    from spark_rapids_jni_tpu.ops.get_json_object import parse_path
+
+    return parse_path(path)

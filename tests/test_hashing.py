@@ -569,3 +569,94 @@ class TestRandomizedCrossCheck:
             assert got == py_xxhash64(s.encode(), 42), f"len={length}"
             got32 = murmur_hash3_32([col], seed=42).to_pylist()[0]
             assert got32 == py_murmur3(s.encode(), 42), f"len={length}"
+
+
+# ---------------------------------------------------------------------------
+# nested columns (reference HashTest.java:174-263: struct/list parity with
+# the equivalent flat columns)
+# ---------------------------------------------------------------------------
+
+class TestNestedMurmur3:
+    def _flat_cols(self):
+        strings = StringColumn.from_pylist(["a", "B\n", 'dE"Ā\tā 휠휡', LONG_STR,
+                     None, None])
+        integers = Column.from_pylist([0, 100, -100, -(2**31), 2**31 - 1, None], T.INT32)
+        doubles = Column.from_pylist([0.0, 100.0, -100.0, float("nan"), float("nan"), None],
+                    T.FLOAT64)
+        bools = Column.from_pylist([True, False, None, False, True, None], T.BOOLEAN)
+        return strings, integers, doubles, bools
+
+    def test_struct_equals_flat(self):
+        from spark_rapids_jni_tpu.columnar.column import StructColumn
+
+        strings, integers, doubles, bools = self._flat_cols()
+        import jax.numpy as jnp
+
+        allv = jnp.ones((6,), jnp.bool_)
+        st = StructColumn({"s": strings, "i": integers, "d": doubles,
+                           "b": bools}, allv)
+        expected = murmur_hash3_32(
+            [strings, integers, doubles, bools], seed=1868).to_pylist()
+        got = murmur_hash3_32([st], seed=1868).to_pylist()
+        assert got == expected
+
+    def test_nested_struct_equals_flat(self):
+        from spark_rapids_jni_tpu.columnar.column import StructColumn
+
+        strings, integers, doubles, bools = self._flat_cols()
+        import jax.numpy as jnp
+
+        allv = jnp.ones((6,), jnp.bool_)
+        s1 = StructColumn({"s": strings, "i": integers}, allv)
+        s2 = StructColumn({"s1": s1, "d": doubles}, allv)
+        s3 = StructColumn({"b": bools}, allv)
+        top = StructColumn({"s2": s2, "s3": s3}, allv)
+        expected = murmur_hash3_32(
+            [strings, integers, doubles, bools], seed=1868).to_pylist()
+        got = murmur_hash3_32([top], seed=1868).to_pylist()
+        assert got == expected
+
+    def test_int_list_equals_position_columns(self):
+        from spark_rapids_jni_tpu.columnar.column import ListColumn
+
+        lists = [None, [0, -2, 3], [2**31 - 1], [5, -6, None], [-(2**31)],
+                 None]
+        lc = ListColumn.from_pylist(lists, T.INT32)
+        i1 = Column.from_pylist([None, 0, None, 5, -(2**31), None], T.INT32)
+        i2 = Column.from_pylist([None, -2, 2**31 - 1, None, None, None], T.INT32)
+        i3 = Column.from_pylist([None, 3, None, -6, None, None], T.INT32)
+        expected = murmur_hash3_32([i1, i2, i3], seed=1868).to_pylist()
+        got = murmur_hash3_32([lc], seed=1868).to_pylist()
+        assert got == expected
+
+    def test_string_list_equals_struct(self):
+        from spark_rapids_jni_tpu.columnar.column import ListColumn, StringColumn
+
+        lists = [[None, "a"], ["B\n", ""],
+                 ['dE"Ā\tā', " 휠휡"], [LONG_STR], [""],
+                 None]
+        # build LIST<STRING> by hand: child = flattened strings
+        flat = [x for row in lists if row is not None for x in row]
+        child = StringColumn.from_pylist(flat)
+        import jax.numpy as jnp
+        import numpy as np
+
+        offs = [0]
+        valid = []
+        for row in lists:
+            if row is None:
+                valid.append(False)
+                offs.append(offs[-1])
+            else:
+                valid.append(True)
+                offs.append(offs[-1] + len(row))
+        lc = ListColumn(jnp.asarray(np.asarray(offs, np.int32)), child,
+                        jnp.asarray(np.asarray(valid)))
+        s1 = StringColumn.from_pylist(["a", "B\n", 'dE"Ā\tā', LONG_STR, None, None])
+        s2 = StringColumn.from_pylist([None, "", " 휠휡", None, "", None])
+        # order: within each row, elements chain left to right; nulls skip
+        e1 = StringColumn.from_pylist([None, "B\n", 'dE"Ā\tā', LONG_STR, "", None])
+        e2 = StringColumn.from_pylist(["a", "", " 휠휡", None, None, None])
+        expected = murmur_hash3_32([e1, e2], seed=1868).to_pylist()
+        got = murmur_hash3_32([lc], seed=1868).to_pylist()
+        assert got == expected

@@ -1,0 +1,311 @@
+"""Memory-runtime state-machine tests.
+
+Ports the distinctive scenarios of the reference's ``RmmSparkTest.java``
+(scriptable task threads driven through BLOCKED/BUFN/split states, with
+state polling) and a seeded Monte-Carlo oversubscription fuzz
+(``RmmSparkMonteCarlo.java``, ``ci/fuzz-test.sh``: tasks allocating up to
+2/3 over budget must all complete without deadlock/livelock).
+"""
+
+import queue
+import random
+import threading
+import time
+
+import pytest
+
+from spark_rapids_jni_tpu.mem import (
+    InjectedException,
+    OOMError,
+    RetryOOM,
+    RmmSpark,
+    SparkResourceAdaptor,
+    SplitAndRetryOOM,
+    ThreadState,
+)
+
+MB = 1 << 20
+
+
+@pytest.fixture
+def adaptor():
+    a = SparkResourceAdaptor(10 * MB, poll_ms=20.0)
+    yield a
+    a.close()
+
+
+def poll_for_state(adaptor, tid, want, timeout=5.0):
+    """RmmSparkTest.pollForState equivalent."""
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        s = adaptor.get_state_of(tid)
+        if s == want:
+            return s
+        time.sleep(0.005)
+    return adaptor.get_state_of(tid)
+
+
+class TaskThread(threading.Thread):
+    """Scriptable worker: feed it closures, read results (RmmSparkTest's
+    TaskThread op-queue pattern)."""
+
+    def __init__(self, adaptor, task_id, dedicated=True, shuffle=False):
+        super().__init__(daemon=True)
+        self.adaptor = adaptor
+        self.task_id = task_id
+        self.dedicated = dedicated
+        self.shuffle = shuffle
+        self.ops = queue.Queue()
+        self.results = queue.Queue()
+        self.tid = None
+        self._ready = threading.Event()
+        self.start()
+        self._ready.wait(5.0)
+
+    def run(self):
+        self.tid = threading.get_ident()
+        if self.dedicated:
+            self.adaptor.start_dedicated_task_thread(self.task_id)
+        else:
+            self.adaptor.pool_thread_working_on_tasks(
+                self.shuffle, [self.task_id])
+        self._ready.set()
+        while True:
+            fn = self.ops.get()
+            if fn is None:
+                return
+            try:
+                self.results.put(("ok", fn()))
+            except BaseException as e:  # noqa: BLE001 - test harness
+                self.results.put(("exc", e))
+
+    def do(self, fn):
+        self.ops.put(fn)
+
+    def expect(self, timeout=10.0):
+        kind, val = self.results.get(timeout=timeout)
+        return kind, val
+
+    def finish(self):
+        self.ops.put(None)
+        self.join(timeout=5.0)
+
+
+class TestBasics:
+    def test_alloc_dealloc_metrics(self, adaptor):
+        t = TaskThread(adaptor, 1)
+        t.do(lambda: adaptor.allocate(4 * MB, tid=t.tid))
+        assert t.expect()[0] == "ok"
+        assert adaptor.total_allocated() == 4 * MB
+        t.do(lambda: adaptor.deallocate(4 * MB, tid=t.tid))
+        assert t.expect()[0] == "ok"
+        assert adaptor.total_allocated() == 0
+        assert adaptor.get_max_memory_allocated(1) == 4 * MB
+        t.finish()
+
+    def test_unregistered_thread_raises(self, adaptor):
+        with pytest.raises(RuntimeError):
+            adaptor.allocate(MB)  # calling thread never registered
+
+    def test_state_polling(self, adaptor):
+        t = TaskThread(adaptor, 1)
+        assert poll_for_state(adaptor, t.tid, ThreadState.RUNNING) \
+            == ThreadState.RUNNING
+        t.finish()
+
+
+class TestBlocking:
+    def test_second_task_blocks_until_free(self, adaptor):
+        a = TaskThread(adaptor, 1)
+        b = TaskThread(adaptor, 2)
+        a.do(lambda: adaptor.allocate(8 * MB, tid=a.tid))
+        assert a.expect()[0] == "ok"
+        # b wants 4MB; only 2MB free -> BLOCKED
+        b.do(lambda: adaptor.allocate(4 * MB, tid=b.tid))
+        assert poll_for_state(adaptor, b.tid, ThreadState.BLOCKED) \
+            == ThreadState.BLOCKED
+        # freeing unblocks b
+        a.do(lambda: adaptor.deallocate(8 * MB, tid=a.tid))
+        assert a.expect()[0] == "ok"
+        assert b.expect()[0] == "ok"
+        assert adaptor.get_and_reset_block_time_ns(2) > 0
+        for t in (a, b):
+            t.finish()
+
+    def test_deadlock_breaks_lowest_priority(self, adaptor):
+        """Both tasks blocked -> the youngest task (highest id = lowest
+        priority) gets RetryOOM (BUFN escalation, reference :1622-1631)."""
+        a = TaskThread(adaptor, 1)
+        b = TaskThread(adaptor, 2)
+        a.do(lambda: adaptor.allocate(5 * MB, tid=a.tid))
+        b.do(lambda: adaptor.allocate(5 * MB, tid=b.tid))
+        assert a.expect()[0] == "ok"
+        assert b.expect()[0] == "ok"
+        # both now ask for more than remains -> deadlock
+        a.do(lambda: adaptor.allocate(2 * MB, tid=a.tid))
+        b.do(lambda: adaptor.allocate(2 * MB, tid=b.tid))
+        # task 2 is younger -> lower priority -> it must get RetryOOM
+        kind, exc = b.expect()
+        assert kind == "exc" and isinstance(exc, RetryOOM)
+        # b rolls back per the contract: free, then block until ready
+        b.do(lambda: adaptor.deallocate(5 * MB, tid=b.tid))
+        assert b.expect()[0] == "ok"
+        assert a.expect()[0] == "ok"  # a's alloc proceeds
+        assert adaptor.get_and_reset_num_retry(2) >= 1
+        for t in (a, b):
+            t.finish()
+
+    def test_split_and_retry_when_all_bufn(self, adaptor):
+        """If every task is BUFN the highest-priority one gets
+        SplitAndRetryOOM (reference :1647-1669)."""
+        a = TaskThread(adaptor, 1)
+        b = TaskThread(adaptor, 2)
+        a.do(lambda: adaptor.allocate(5 * MB, tid=a.tid))
+        b.do(lambda: adaptor.allocate(5 * MB, tid=b.tid))
+        assert a.expect()[0] == "ok" and b.expect()[0] == "ok"
+        a.do(lambda: adaptor.allocate(2 * MB, tid=a.tid))
+        b.do(lambda: adaptor.allocate(2 * MB, tid=b.tid))
+        kind, exc = b.expect()
+        assert kind == "exc" and isinstance(exc, RetryOOM)
+        # b has nothing spillable and parks in BUFN; a is now the only
+        # non-BUFN thread, so the next escalation hands IT a RetryOOM too
+        b.do(lambda: adaptor.block_thread_until_ready(tid=b.tid))
+        kind, exc = a.expect()
+        assert kind == "exc" and isinstance(exc, RetryOOM)
+        # a also parks without freeing: now EVERY task is BUFN, so the
+        # highest-priority (oldest) task is told to split
+        a.do(lambda: adaptor.block_thread_until_ready(tid=a.tid))
+        kind, exc = a.expect()
+        assert kind == "exc" and isinstance(exc, SplitAndRetryOOM)
+        assert adaptor.get_and_reset_num_split_retry(1) >= 1
+        # a halves its request; 0 free -> must free something first
+        a.do(lambda: adaptor.deallocate(5 * MB, tid=a.tid))
+        assert a.expect()[0] == "ok"
+        a.do(lambda: adaptor.allocate(1 * MB, tid=a.tid))
+        assert a.expect()[0] == "ok"
+        assert b.expect()[0] == "ok"  # b's BUFN was rescued by the free
+        for t in (a, b):
+            t.finish()
+
+    def test_shuffle_thread_outranks_tasks(self, adaptor):
+        """A blocked shuffle thread wakes before older task threads."""
+        a = TaskThread(adaptor, 1)
+        s = TaskThread(adaptor, 2, dedicated=False, shuffle=True)
+        a.do(lambda: adaptor.allocate(9 * MB, tid=a.tid))
+        assert a.expect()[0] == "ok"
+        s.do(lambda: adaptor.allocate(2 * MB, tid=s.tid))
+        assert poll_for_state(adaptor, s.tid, ThreadState.BLOCKED) \
+            == ThreadState.BLOCKED
+        a.do(lambda: adaptor.deallocate(9 * MB, tid=a.tid))
+        assert a.expect()[0] == "ok"
+        assert s.expect()[0] == "ok"
+        for t in (a, s):
+            t.finish()
+
+
+class TestInjection:
+    def test_force_retry_oom_count_skip(self, adaptor):
+        t = TaskThread(adaptor, 1)
+        adaptor.force_retry_oom(t.tid, num_ooms=2, skip_count=1)
+        t.do(lambda: adaptor.allocate(MB, tid=t.tid))  # skipped
+        assert t.expect()[0] == "ok"
+        for _ in range(2):
+            t.do(lambda: adaptor.allocate(MB, tid=t.tid))
+            kind, exc = t.expect()
+            assert kind == "exc" and isinstance(exc, RetryOOM)
+            t.do(lambda: adaptor.block_thread_until_ready(tid=t.tid))
+            assert t.expect()[0] == "ok"
+        t.do(lambda: adaptor.allocate(MB, tid=t.tid))  # injection exhausted
+        assert t.expect()[0] == "ok"
+        assert adaptor.get_and_reset_num_retry(1) == 2
+        t.finish()
+
+    def test_force_split_and_exception(self, adaptor):
+        t = TaskThread(adaptor, 1)
+        adaptor.force_split_and_retry_oom(t.tid, num_ooms=1)
+        t.do(lambda: adaptor.allocate(MB, tid=t.tid))
+        kind, exc = t.expect()
+        assert kind == "exc" and isinstance(exc, SplitAndRetryOOM)
+        adaptor.force_exception(t.tid, num_times=1)
+        t.do(lambda: adaptor.allocate(MB, tid=t.tid))
+        kind, exc = t.expect()
+        assert kind == "exc" and isinstance(exc, InjectedException)
+        t.finish()
+
+
+class TestRetryCap:
+    def test_oversized_request_hard_ooms(self, adaptor):
+        """A single task asking for more than the pool must end in a hard
+        OOM (after the 500-retry livelock bound), not hang."""
+        t = TaskThread(adaptor, 1)
+        t.do(lambda: adaptor.allocate(11 * MB, tid=t.tid))
+        kind, exc = t.expect(timeout=30.0)
+        assert kind == "exc" and isinstance(exc, (OOMError, RetryOOM,
+                                                  SplitAndRetryOOM))
+        t.finish()
+
+
+class TestMonteCarlo:
+    """Seeded oversubscription fuzz (RmmSparkMonteCarlo.java semantics:
+    taskMax ~2048MiB vs pool 3072MiB, scaled down)."""
+
+    @pytest.mark.parametrize("seed", [11, 42])
+    def test_oversubscribed_tasks_all_complete(self, seed):
+        pool = 3 * MB
+        task_max = 2 * MB
+        n_tasks = 6
+        adaptor = SparkResourceAdaptor(pool, poll_ms=10.0)
+        failures = []
+        retries = [0]
+
+        def task_fn(task_id):
+            rng = random.Random(seed * 1000 + task_id)
+            adaptor.start_dedicated_task_thread(task_id)
+            held = []  # (nbytes)
+            try:
+                ops = 0
+                budget = task_max
+                while ops < 40:
+                    want = rng.randrange(1, max(2, budget // 4))
+                    try:
+                        adaptor.allocate(want)
+                        held.append(want)
+                        ops += 1
+                        if rng.random() < 0.4 and held:
+                            adaptor.deallocate(
+                                held.pop(rng.randrange(len(held))))
+                        if sum(held) > task_max - want:
+                            while held:
+                                adaptor.deallocate(held.pop())
+                    except RetryOOM:
+                        retries[0] += 1
+                        while held:
+                            adaptor.deallocate(held.pop())
+                        adaptor.block_thread_until_ready()
+                    except SplitAndRetryOOM:
+                        retries[0] += 1
+                        while held:
+                            adaptor.deallocate(held.pop())
+                        budget = max(budget // 2, 4)
+                while held:
+                    adaptor.deallocate(held.pop())
+            except BaseException as e:  # noqa: BLE001
+                failures.append((task_id, e))
+            finally:
+                adaptor.task_done(task_id)
+
+        threads = [threading.Thread(target=task_fn, args=(i + 1,),
+                                    daemon=True) for i in range(n_tasks)]
+        for th in threads:
+            th.start()
+        deadline = time.monotonic() + 120.0  # generous: CI boxes are noisy
+        for th in threads:
+            th.join(timeout=max(0.1, deadline - time.monotonic()))
+        alive = [th for th in threads if th.is_alive()]
+        states = [adaptor.get_state_of(tid=th.ident) for th in threads]
+        adaptor.close()
+        assert not alive, (
+            f"deadlocked/livelocked threads: {len(alive)}, states={states}, "
+            f"retries={retries[0]}")
+        assert not failures, failures
+        assert adaptor._h is None

@@ -1,0 +1,67 @@
+"""Profiler lifecycle + offline conversion (reference Profiler.java API)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from spark_rapids_jni_tpu.profiler import (
+    MAGIC,
+    FileWriter,
+    Profiler,
+    ProfilerError,
+    convert_profile,
+    list_capture_files,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_profiler():
+    yield
+    Profiler.shutdown()
+
+
+def test_lifecycle_and_convert(tmp_path):
+    cap = str(tmp_path / "capture.bin")
+    w = FileWriter(cap)
+    Profiler.init(w)
+    Profiler.start()
+    x = jnp.arange(1 << 16)
+    y = jax.jit(lambda v: (v * 3 + 1).sum())(x)
+    jax.block_until_ready(y)
+    Profiler.stop()
+    Profiler.shutdown()
+    w.close()
+
+    with open(cap, "rb") as f:
+        head = f.read(8)
+    assert head == MAGIC
+    files = list_capture_files(cap)
+    assert files, "capture contains no trace artifacts"
+    events = convert_profile(cap)
+    assert isinstance(events, list)
+    # XLA's CPU trace should contain at least one named duration event
+    assert any(e["dur_us"] >= 0 and e["name"] for e in events)
+
+
+def test_double_init_raises(tmp_path):
+    w = FileWriter(str(tmp_path / "c.bin"))
+    Profiler.init(w)
+    with pytest.raises(ProfilerError):
+        Profiler.init(w)
+    Profiler.shutdown()
+    w.close()
+
+
+def test_start_without_init_raises():
+    with pytest.raises(ProfilerError):
+        Profiler.start()
+
+
+def test_stop_idempotent(tmp_path):
+    w = FileWriter(str(tmp_path / "c.bin"))
+    Profiler.init(w)
+    Profiler.stop()  # never started: no-op
+    Profiler.shutdown()
+    w.close()

@@ -144,14 +144,14 @@ class TestGroupBy:
         )
         assert int(ng) == 3
         got = trimmed(out, ng)
-        # group order: first occurrence — 1, 2, then the null group
-        assert got["k"] == [1, 2, None]
-        assert got["s"] == [40, 60, 99]
-        assert got["c"] == [2, 2, 1]
-        assert got["cstar"] == [3, 2, 1]
-        assert got["mn"] == [10, 20, 99]
-        assert got["mx"] == [30, 40, 99]
-        assert got["avg"] == [20.0, 30.0, 99.0]
+        # group order: key-sorted, nulls first
+        assert got["k"] == [None, 1, 2]
+        assert got["s"] == [99, 40, 60]
+        assert got["c"] == [1, 2, 2]
+        assert got["cstar"] == [1, 3, 2]
+        assert got["mn"] == [99, 10, 20]
+        assert got["mx"] == [99, 30, 40]
+        assert got["avg"] == [99.0, 20.0, 30.0]
 
     def test_all_null_group_sum_is_null(self):
         b = ColumnBatch(
@@ -174,7 +174,7 @@ class TestGroupBy:
         out, ng = group_by(b, ["k"], [AggSpec("sum", "v", "s")])
         assert int(ng) == 3
         got = trimmed(out, ng)
-        assert got["k"] == ["b", "a", None]
+        assert got["k"] == [None, "a", "b"]
         assert got["s"] == [4, 13, 4]
 
     def test_multi_key(self):
@@ -323,8 +323,8 @@ class TestReviewRegressions:
         out, ng = group_by(masked, ["k"], [AggSpec("count", None, "c")])
         assert int(ng) == 2
         got = trimmed(out, ng)
-        assert got["k"] == [1, None]
-        assert got["c"] == [1, 2]
+        assert got["k"] == [None, 1]
+        assert got["c"] == [2, 1]
 
     def test_empty_build_side(self):
         left = ColumnBatch({"k": ints([1, 2]), "lv": ints([10, 20])})
@@ -392,3 +392,116 @@ class TestReviewRegressions:
         right = ColumnBatch({"k": ints([1]), "v": ints([2])})
         out, _ = hash_join(left, right, ["k"], ["k"], "inner", suffixes=("_l", "_r"))
         assert set(out.names) == {"k", "v_l", "v_r"}
+
+
+# ---------------------------------------------------------------------------
+# window functions
+# ---------------------------------------------------------------------------
+
+class TestWindow:
+    def test_rank_row_number_dense(self):
+        from spark_rapids_jni_tpu.relational import WindowSpec, window
+
+        b = ColumnBatch(
+            {
+                "p": ints([1, 1, 1, 2, 2, 1]),
+                "o": ints([10, 20, 20, 5, 5, 30]),
+                "v": ints([1, 2, 3, 4, 5, 6], T.INT64),
+            }
+        )
+        out = window(
+            b, ["p"], ["o"],
+            [
+                WindowSpec("row_number", None, "rn"),
+                WindowSpec("rank", None, "rk"),
+                WindowSpec("dense_rank", None, "dr"),
+                WindowSpec("sum", "v", "rs"),
+            ],
+        )
+        d = out.to_pydict()
+        # sorted: p=1 o=10,20,20,30 then p=2 o=5,5
+        assert d["p"] == [1, 1, 1, 1, 2, 2]
+        assert d["o"] == [10, 20, 20, 30, 5, 5]
+        assert d["rn"] == [1, 2, 3, 4, 1, 2]
+        assert d["rk"] == [1, 2, 2, 4, 1, 1]
+        assert d["dr"] == [1, 2, 2, 3, 1, 1]
+        # running sums in sorted order: v sorted = [1,2,3,6,4,5]
+        assert d["rs"] == [1, 3, 6, 12, 4, 9]
+
+    def test_running_min_max_nulls(self):
+        from spark_rapids_jni_tpu.relational import WindowSpec, window
+
+        b = ColumnBatch(
+            {
+                "p": ints([1, 1, 1]),
+                "o": ints([1, 2, 3]),
+                "v": ints([5, None, 2], T.INT64),
+            }
+        )
+        out = window(b, ["p"], ["o"],
+                     [WindowSpec("min", "v", "mn"),
+                      WindowSpec("max", "v", "mx"),
+                      WindowSpec("count", "v", "c")])
+        d = out.to_pydict()
+        assert d["mn"] == [5, 5, 2]
+        assert d["mx"] == [5, 5, 5]
+        assert d["c"] == [1, 1, 2]
+
+    def test_q67_shape(self):
+        """sort + window(rank over partition) + filter rank<=k — the q67
+        pipeline skeleton."""
+        import numpy as np
+
+        from spark_rapids_jni_tpu.relational import WindowSpec, window
+
+        rng = np.random.default_rng(0)
+        n = 256
+        cat = rng.integers(0, 8, n)
+        sales = rng.integers(1, 1000, n)
+        b = ColumnBatch(
+            {
+                "cat": ints(list(cat)),
+                "sales": ints(list(sales), T.INT64),
+            }
+        )
+        out = window(b, ["cat"], ["sales"],
+                     [WindowSpec("rank", None, "rk")],
+                     descending=[True])
+        d = out.to_pydict()
+        # verify against numpy: rank of each row within its category by
+        # descending sales
+        got_top = {
+            c: [s for s, cc, r in zip(d["sales"], d["cat"], d["rk"])
+                if cc == c and r <= 3]
+            for c in range(8)
+        }
+        for c in range(8):
+            want = sorted([int(s) for s, cc in zip(sales, cat) if cc == c],
+                          reverse=True)[:3]
+            assert sorted(got_top[c], reverse=True)[:len(want)] == want
+
+    def test_desc_order_nulls_last(self):
+        """Spark default: DESC ordering puts nulls LAST (review regression:
+        the null-flag word must not be bit-inverted with the data words)."""
+        from spark_rapids_jni_tpu.relational import WindowSpec, window
+
+        b = ColumnBatch(
+            {
+                "p": ints([1, 1, 1]),
+                "o": ints([10, None, 30]),
+            }
+        )
+        out = window(b, ["p"], ["o"], [WindowSpec("row_number", None, "rn")],
+                     descending=[True])
+        d = out.to_pydict()
+        assert d["o"] == [30, 10, None]
+        assert d["rn"] == [1, 2, 3]
+
+    def test_descending_arity_mismatch_raises(self):
+        from spark_rapids_jni_tpu.relational import WindowSpec, window
+
+        b = ColumnBatch({"p": ints([1]), "o1": ints([1]), "o2": ints([2])})
+        with pytest.raises(ValueError):
+            window(b, ["p"], ["o1", "o2"],
+                   [WindowSpec("row_number", None, "rn")],
+                   descending=[True])

@@ -1,0 +1,434 @@
+"""Host oracle for Spark ``parse_url`` semantics.
+
+A direct Python model of the reference's URI validator/extractor
+(``/root/reference/src/main/cpp/src/parse_uri.cu:94-740``), which itself
+is validated against ``java.net.URI`` by ``ParseURITest.java``.  Used to
+generate golden expectations and as the fuzz oracle for the device kernel
+(``ops/parse_uri.py``).  Operates on byte strings.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+PROTOCOL, HOST, AUTHORITY, PATH, FRAGMENT, QUERY, USERINFO, PORT, OPAQUE = \
+    range(9)
+
+VALID, INVALID, FATAL = 0, 1, 2
+
+_WS_CODEPOINTS = set()  # multi-byte whitespace first-bytes handled inline
+
+
+def _is_alpha(c):
+    return (ord("a") <= c <= ord("z")) or (ord("A") <= c <= ord("Z"))
+
+
+def _is_num(c):
+    return ord("0") <= c <= ord("9")
+
+
+def _is_alnum(c):
+    return _is_alpha(c) or _is_num(c)
+
+
+def _is_hex(c):
+    return _is_num(c) or (ord("a") <= c <= ord("f")) or (ord("A") <= c <= ord("F"))
+
+
+def _utf8_char_at(s: bytes, i: int):
+    """(code, nbytes) packed the way cudf string_view yields chars: the
+    raw bytes of the character interpreted big-endian (e.g. ✪ = 0xE29CAA)."""
+    c = s[i]
+    if c < 0x80:
+        return c, 1
+    if c >> 5 == 0b110:
+        n = 2
+    elif c >> 4 == 0b1110:
+        n = 3
+    elif c >> 3 == 0b11110:
+        n = 4
+    else:
+        return c, 1  # invalid lead byte: treated as 1-byte char
+    code = 0
+    for k in range(n):
+        code = (code << 8) | (s[i + k] if i + k < len(s) else 0)
+    return code, n
+
+
+def _skip_and_validate_special(s, i, allow_invalid_escapes=False):
+    """Returns (ok, next_i): consumes %XX escapes and multi-byte chars."""
+    while i < len(s):
+        code, nb = _utf8_char_at(s, i)
+        if s[i] == ord("%") and not allow_invalid_escapes:
+            for _ in range(2):
+                i += 1
+                if i >= len(s) or not _is_hex(s[i]):
+                    return False, i
+        elif nb > 1:
+            # continuation-byte checks on the packed code
+            if (code & 0xC0) != 0x80:
+                return False, i
+            if nb > 2 and (code & 0xC000) != 0x8000:
+                return False, i
+            if nb > 3 and (code & 0xC00000) != 0x800000:
+                return False, i
+            if (0xC280 <= code <= 0xC2A0) or code == 0xE19A80 \
+                    or (0xE28080 <= code <= 0xE2808A) or code in (
+                        0xE280AF, 0xE280A8, 0xE2819F, 0xE38080):
+                return False, i
+            i += nb - 1
+        else:
+            break
+        i += 1
+    return True, i
+
+
+def _validate_chunk(s, ok_char, allow_invalid_escapes=False):
+    i = 0
+    valid, i = _skip_and_validate_special(s, i, allow_invalid_escapes)
+    if not valid:
+        return False
+    while i < len(s):
+        if not ok_char(s[i]):
+            return False
+        i += 1
+        valid, i = _skip_and_validate_special(s, i, allow_invalid_escapes)
+        if not valid:
+            return False
+    return True
+
+
+def _validate_scheme(s):
+    if not s or not _is_alpha(s[0]):
+        return False
+    return all(_is_alnum(c) or c in b"+-." for c in s[1:])
+
+
+def _validate_ipv6(s):
+    if len(s) < 2:
+        return False
+    found_dc = False
+    openb = closeb = periods = colons = percents = 0
+    prev = 0
+    address = 0
+    addr_chars = 0
+    addr_hex = False
+    for c in s:
+        if c == ord("["):
+            openb += 1
+            if openb > 1:
+                return False
+        elif c == ord("]"):
+            closeb += 1
+            if closeb > 1:
+                return False
+            if periods > 0 and (addr_hex or address > 255):
+                return False
+        elif c == ord(":"):
+            colons += 1
+            if prev == ord(":"):
+                if found_dc:
+                    return False
+                found_dc = True
+            address = 0
+            addr_hex = False
+            addr_chars = 0
+            if colons > 8 or (colons == 8 and not found_dc):
+                return False
+            if periods > 0 or percents > 0:
+                return False
+        elif c == ord("."):
+            periods += 1
+            if percents > 0 or periods > 3 or addr_hex or address > 255:
+                return False
+            if colons != 6 and not found_dc:
+                return False
+            if colons >= 8:
+                return False
+            address = 0
+            addr_hex = False
+            addr_chars = 0
+        elif c == ord("%"):
+            percents += 1
+            if percents > 1:
+                return False
+            if periods > 0 and (addr_hex or address > 255):
+                return False
+            address = 0
+            addr_hex = False
+            addr_chars = 0
+        else:
+            if percents == 0:
+                if addr_chars > 3:
+                    return False
+                addr_chars += 1
+                address *= 10
+                if ord("a") <= c <= ord("f"):
+                    address += 10 + c - ord("a")
+                    addr_hex = True
+                elif ord("A") <= c <= ord("Z"):
+                    address += 10 + c - ord("A")
+                    addr_hex = True
+                elif _is_num(c):
+                    address += c - ord("0")
+                else:
+                    return False
+        prev = c
+    return True
+
+
+def _validate_ipv4(s):
+    address = addr_chars = dots = 0
+    for i, c in enumerate(s):
+        if not _is_num(c) and (i == 0 or c != ord(".")):
+            return False
+        if c == ord("."):
+            if addr_chars == 0:
+                return False
+            address = addr_chars = 0
+            dots += 1
+            continue
+        addr_chars += 1
+        address = address * 10 + (c - ord("0"))
+        if address > 255:
+            return False
+    return addr_chars > 0 and dots == 3
+
+
+def _validate_domain(s):
+    last_dash = last_period = numeric_start = False
+    before_period = 0
+    for i, c in enumerate(s):
+        if not _is_alnum(c) and c not in b"-.":
+            return False
+        numeric_start = last_period and _is_num(c)
+        if c == ord("-"):
+            if last_period or i == 0 or i == len(s) - 1:
+                return False
+            last_dash, last_period = True, False
+        elif c == ord("."):
+            if last_dash or last_period or before_period == 0:
+                return False
+            last_period, last_dash = True, False
+            before_period = 0
+        else:
+            last_period = last_dash = False
+            before_period += 1
+    return not numeric_start
+
+
+def _validate_host(s):
+    if not s:
+        return INVALID
+    if s[0] == ord("["):
+        if s[-1] != ord("]"):
+            return FATAL
+        return VALID if _validate_ipv6(s) else FATAL
+    last_period = -1
+    for i, c in enumerate(s):
+        if c in b"[]":
+            return FATAL
+        if c == ord("."):
+            last_period = i
+    if last_period < 0 or last_period == len(s) - 1 \
+            or not _is_num(s[last_period + 1]):
+        if _validate_domain(s):
+            return VALID
+    elif _validate_ipv4(s):
+        return VALID
+    return INVALID
+
+
+def _q_ok(c):
+    return (c == ord("!") or c == ord('"') or c == ord("$")
+            or (ord("&") <= c <= ord(";")) or c == ord("=")
+            or (ord("?") <= c <= ord("]") and c != ord("\\"))
+            or (ord("a") <= c <= ord("z")) or c == ord("_") or c == ord("~"))
+
+
+def _auth_ok_factory(allow_invalid_escapes):
+    def ok(c):
+        if (c == ord("!") or c == ord("$")
+                or (ord("&") <= c <= ord(";") and c != ord("/"))
+                or c == ord("=")
+                or (ord("@") <= c <= ord("_") and c not in (ord("^"), ord("\\")))
+                or (ord("a") <= c <= ord("z")) or c == ord("~")):
+            return True
+        return allow_invalid_escapes and c == ord("%")
+    return ok
+
+
+def _path_ok(c):
+    return (c == ord("!") or c == ord("$") or (ord("&") <= c <= ord(";"))
+            or c == ord("=") or (ord("@") <= c <= ord("Z")) or c == ord("_")
+            or (ord("a") <= c <= ord("z")) or c == ord("~"))
+
+
+def _opaque_ok(c):
+    return (c == ord("!") or c == ord("$") or (ord("&") <= c <= ord(";"))
+            or c == ord("=") or (ord("?") <= c <= ord("]") and c != ord("\\"))
+            or c == ord("_") or c == ord("~") or (ord("a") <= c <= ord("z")))
+
+
+def validate_uri(data: bytes):
+    """Port of validate_uri (parse_uri.cu:534-740): dict chunk->bytes."""
+    parts = {}
+    s = data
+    original_start = 0
+    pos = 0
+    length = len(s)
+
+    col = slash = hash_ = question = -1
+    for i, c in enumerate(s):
+        if c == ord(":") and col == -1:
+            col = i
+        elif c == ord("/") and slash == -1:
+            slash = i
+        elif c == ord("#") and hash_ == -1:
+            hash_ = i
+        elif c == ord("?") and question == -1:
+            question = i
+
+    if hash_ >= 0:
+        frag = s[hash_ + 1: length]
+        if not _validate_chunk(frag, _opaque_ok):  # fragment rule == opaque
+            return {}
+        parts[FRAGMENT] = frag
+        length = hash_
+        if col > hash_:
+            col = -1
+        if slash > hash_:
+            slash = -1
+        if question > hash_:
+            question = -1
+
+    has_scheme = (col != -1 and (slash == -1 or col < slash)
+                  and (hash_ == -1 or col < hash_))
+    if has_scheme:
+        scheme = s[:col]
+        if not _validate_scheme(scheme):
+            return {}
+        parts[PROTOCOL] = scheme
+        pos = col + 1
+        question -= pos
+        slash -= pos
+    # note: hash_ not adjusted further; parsing below uses pos..length
+
+    if length - pos <= 0:
+        # reference: ret.valid is OVERWRITTEN here (:608-614) — a scheme
+        # with nothing after it invalidates everything; otherwise only an
+        # empty-but-present path survives (even the fragment bit is lost)
+        return {} if has_scheme else {PATH: b""}
+
+    sub = s[pos:length]
+    hierarchical = sub[0:1] == b"/" or pos == original_start
+    if hierarchical:
+        q = question if question >= 0 else -1
+        if q >= 0:
+            query = sub[q + 1:]
+            if not _validate_chunk(query, _q_ok):
+                return {}
+            parts[QUERY] = query
+        path_len = q if q >= 0 else len(sub)
+
+        path = b""
+        if sub[0:2] == b"//":
+            next_slash = -1
+            for i in range(2, path_len):
+                if sub[i] == ord("/"):
+                    next_slash = i
+                    break
+            auth_end = (next_slash if next_slash != -1
+                        else (q if q >= 0 else len(sub)))
+            authority = sub[2:auth_end]
+            if next_slash > 0:
+                path = sub[next_slash:path_len]
+            if len(authority) > 0:
+                ipv6 = len(authority) > 2 and authority[0] == ord("[")
+                if not _validate_chunk(authority, _auth_ok_factory(ipv6),
+                                       allow_invalid_escapes=ipv6):
+                    return {}
+                parts[AUTHORITY] = authority
+                amp = -1
+                closingbracket = -1
+                last_colon = -1
+                for i, c in enumerate(authority):
+                    if c == ord("@"):
+                        if amp == -1:
+                            amp = i
+                            if last_colon > 0:
+                                last_colon = -1
+                            if closingbracket > 0:
+                                closingbracket = -1
+                    elif c == ord(":"):
+                        last_colon = i - amp - 1 if amp > 0 else i
+                    elif c == ord("]"):
+                        if closingbracket == -1:
+                            closingbracket = i - amp if amp > 0 else i
+                auth = authority
+                if amp > 0:
+                    userinfo = auth[:amp]
+                    if not _validate_chunk(
+                            userinfo,
+                            lambda c: c not in (ord("["), ord("]"))):
+                        return {}
+                    parts[USERINFO] = userinfo
+                    auth = auth[amp + 1:]
+                if last_colon > 0 and last_colon > closingbracket:
+                    port = auth[last_colon + 1:]
+                    # note reference port check (c<'0' && c>'9') is
+                    # vacuously true — any char passes (a spark quirk)
+                    parts[PORT] = port
+                    host = auth[:last_colon]
+                else:
+                    host = auth
+                hv = _validate_host(host)
+                if hv == FATAL:
+                    return {}
+                if hv == VALID:
+                    parts[HOST] = host
+        else:
+            path = sub[:path_len]
+        if not _validate_chunk(path, _path_ok):
+            return {}
+        parts[PATH] = path
+    else:
+        opaque = sub
+        if not _validate_chunk(opaque, _opaque_ok):
+            return {}
+        parts[OPAQUE] = opaque
+    return parts
+
+
+def _find_query_part(query: bytes, needle: bytes) -> Optional[bytes]:
+    """Port of find_query_part (parse_uri.cu:494-532)."""
+    n = len(needle)
+    h = 0
+    end = len(query)
+    while h + n < end:
+        if query[h:h + n] == needle and query[h + n] == ord("="):
+            h += n + 1
+            start = h
+            while h < end and query[h] != ord("&"):
+                h += 1
+            return query[start:h]
+        while h + n < end and query[h] != ord("&"):
+            h += 1
+        h += 1
+    return None
+
+
+def parse_uri(url: Optional[str], part: int,
+              query_key: Optional[str] = None) -> Optional[str]:
+    """Oracle entry: PROTOCOL/HOST/QUERY/PATH extraction, or None."""
+    if url is None:
+        return None
+    parts = validate_uri(url.encode())
+    if part == QUERY and query_key is not None:
+        q = parts.get(QUERY)
+        if q is None:
+            return None
+        sub = _find_query_part(q, query_key.encode())
+        return None if sub is None else sub.decode("utf-8", "replace")
+    v = parts.get(part)
+    return None if v is None else v.decode("utf-8", "replace")
