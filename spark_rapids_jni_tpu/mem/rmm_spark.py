@@ -231,7 +231,11 @@ class SparkResourceAdaptor:
     def close(self):
         if not self._closed.is_set():
             self._closed.set()
-            self._watchdog.join(timeout=2.0)
+            self._watchdog.join(timeout=10.0)
+            if self._watchdog.is_alive():
+                # never free the native adaptor under a thread still inside
+                # it — leaking one handle beats a use-after-free
+                return
             self._lib.tra_destroy(self._h)
             self._h = None
 

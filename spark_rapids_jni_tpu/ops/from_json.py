@@ -1,0 +1,208 @@
+"""Spark ``from_json`` -> MAP<STRING,STRING> extraction.
+
+Reference: ``/root/reference/src/main/cpp/src/map_utils.cu`` (FST token
+stream over concatenated rows -> node tree -> LIST<STRUCT<STRING,STRING>>
+of the top-level key/value pairs, values as RAW substrings).  Here the
+char-level tokenizer scan from :mod:`get_json_object` is reused with a
+tiny pair recorder instead of the JSONPath evaluator:
+
+* at each top-level FIELD token, remember the key span (quotes stripped);
+* at the completion of its value (terminal token or the END event of a
+  depth-1 container), emit a (key span, raw value span) pair event;
+* post-scan, pair events flatten row-major and front-compact via a
+  2-operand flag sort (no scatter), the spans gather into padded key /
+  value char matrices, and per-row counts prefix-sum into list offsets.
+
+Output matches MapUtilsTest.java: string values keep their raw content
+(no unescaping), container values are verbatim substrings including inner
+whitespace, ``{}`` -> empty list, null/non-object/invalid rows -> null.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.column import ListColumn, StringColumn, StructColumn
+from .get_json_object import (
+    EV_FIELD,
+    EV_NULL,
+    EV_SARR,
+    EV_SOBJ,
+    EV_STR,
+    M_DONE,
+    M_VALUE,
+    _pack_path,
+    _step,
+)
+
+
+def _recorder_step(P, ptypes, pindexes, pnames, pnamelens, carry, xs):
+    """Tokenizer step + top-level key/value pair recorder.
+
+    Runs the full _step (its evaluator runs with an empty path; its
+    emissions are ignored) and layers the map recorder on the raw token
+    events it now exports (ev_a/ev_b + spans).
+    """
+    (j, c) = xs
+    rec = {k: carry[k] for k in ("key_s", "key_e", "val_s", "root_obj")}
+    tok_carry = {k: v for k, v in carry.items() if k not in rec}
+    out, ys = _step(P, ptypes, pindexes, pnames, pnamelens, tok_carry, xs)
+    ev_a, ev_b = ys["ev_a"], ys["ev_b"]
+    span_s, span_len = ys["span_s"], ys["span_len"]
+    depth_before = tok_carry["depth"]
+
+    root_obj = rec["root_obj"] | ((ev_a == EV_SOBJ) & (depth_before == 0))
+
+    # top-level field: remember the key content span (quotes stripped)
+    fieldev = (ev_a == EV_FIELD) & (depth_before == 1)
+    key_s = jnp.where(fieldev, span_s + 1, rec["key_s"])
+    key_e = jnp.where(fieldev, span_s + span_len - 1, rec["key_e"])
+
+    # the value: terminals complete in one event; containers open at
+    # depth 1 and close via the END event returning to depth 1
+    is_term = (ev_a >= EV_STR) & (ev_a <= EV_NULL)
+    t_done = is_term & (depth_before == 1) & root_obj
+    c_open = ((ev_a == EV_SOBJ) | (ev_a == EV_SARR)) & (depth_before == 1)
+    val_s = jnp.where(c_open, j, rec["val_s"])
+    c_done = (ev_b != 0) & (out["depth"] == 1) & (depth_before == 2) \
+        & (rec["val_s"] >= 0) & root_obj
+
+    pair_done = t_done | c_done
+    # terminal values: strip quotes from strings to match the raw-map
+    # contract (MapUtilsTest: value of "STANDARD" is STANDARD)
+    is_str = ev_a == EV_STR
+    t_s = jnp.where(is_str, span_s + 1, span_s)
+    t_len = jnp.where(is_str, span_len - 2, span_len)
+    pv_s = jnp.where(t_done, t_s, rec["val_s"])
+    pv_e = jnp.where(t_done, t_s + t_len, j + 1)
+
+    ys_rec = {
+        "pair": pair_done,
+        "pk_s": jnp.where(pair_done, rec["key_s"], 0),
+        "pk_e": jnp.where(pair_done, rec["key_e"], 0),
+        "pv_s": jnp.where(pair_done, pv_s, 0),
+        "pv_e": jnp.where(pair_done, pv_e, 0),
+    }
+    out.update(
+        key_s=key_s,
+        key_e=key_e,
+        val_s=jnp.where(pair_done, jnp.int32(-1), val_s),
+        root_obj=root_obj,
+    )
+    return out, ys_rec
+
+
+@partial(jax.jit, static_argnames=("max_pairs_per_row",))
+def _extract(chars, lengths, validity, max_pairs_per_row):
+    n, L = chars.shape
+    i32 = jnp.int32
+    ptypes, pindexes, pnames, pnamelens, P = _pack_path([])
+
+    from .get_json_object import EVM_NORM, MAX_PATH
+
+    D = MAX_PATH + 1
+    zeros = jnp.zeros((n,), i32)
+    carry = {
+        "mode": jnp.full((n,), M_VALUE, i32),
+        "depth": zeros,
+        "cstack_lo": jnp.zeros((n,), jnp.uint32),
+        "cstack_hi": jnp.zeros((n,), jnp.uint32),
+        "allow_close": jnp.zeros((n,), jnp.bool_),
+        "quote": jnp.zeros((n,), jnp.uint8),
+        "sfield": jnp.zeros((n,), jnp.bool_),
+        "tok_start": zeros,
+        "ndig": zeros,
+        "numf": jnp.zeros((n,), jnp.bool_),
+        "ucnt": zeros,
+        "lit_id": zeros,
+        "lit_pos": zeros,
+        "length": lengths.astype(i32),
+        "fm_ok": jnp.zeros((n,), jnp.bool_),
+        "fm_pos": zeros,
+        "term_emit": jnp.zeros((n,), jnp.bool_),
+        "term_esc": jnp.zeros((n,), jnp.bool_),
+        "nfloat": zeros,
+        "neg0": jnp.zeros((n,), jnp.bool_),
+        "evm": jnp.full((n,), EVM_NORM, i32),
+        "base_depth": zeros,
+        "sp": zeros,
+        "root_wait": jnp.ones((n,), jnp.bool_),
+        "root_dirty": zeros,
+        "ev_done": jnp.zeros((n,), jnp.bool_),
+        "ev_fail": jnp.zeros((n,), jnp.bool_),
+        "g_adep": zeros,
+        "g_empty": jnp.ones((n,), jnp.bool_),
+        "k_kind": jnp.zeros((n, D), i32),
+        "k_wait": jnp.zeros((n, D), i32),
+        "k_cpi": jnp.zeros((n, D), i32),
+        "k_cnt": jnp.zeros((n, D), i32),
+        "k_depth": jnp.zeros((n, D), i32),
+        "k_dirty": jnp.zeros((n, D), i32),
+        "k_chstyle": jnp.zeros((n, D), i32),
+        "k_sadep": jnp.zeros((n, D), i32),
+        "k_sempty": jnp.zeros((n, D), jnp.bool_),
+        "k_gap": jnp.zeros((n, D), i32),
+        # recorder fields
+        "key_s": zeros,
+        "key_e": zeros,
+        "val_s": jnp.full((n,), -1, i32),
+        "root_obj": jnp.zeros((n,), jnp.bool_),
+    }
+    cpad = jnp.pad(chars, ((0, 0), (0, 1)))
+    xs = (jnp.arange(L + 1, dtype=i32), cpad.T)
+    step = partial(_recorder_step, P, ptypes, pindexes, pnames, pnamelens)
+    final, ys = jax.lax.scan(step, carry, xs)
+    ys = {k: jnp.moveaxis(v, 0, 1) for k, v in ys.items()}  # [n, L+1]
+
+    row_ok = validity & final["root_obj"] & (final["mode"] == M_DONE) \
+        & ~final["ev_fail"]
+    pair = ys["pair"] & row_ok[:, None]
+    counts = pair.sum(axis=1).astype(i32)
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), i32), jnp.cumsum(counts).astype(i32)])
+
+    # flatten pair events row-major and front-compact with a flag sort
+    L1 = L + 1
+    flat_pair = pair.reshape(n * L1)
+    flat_idx = jnp.arange(n * L1, dtype=i32)
+    order = jax.lax.sort(
+        ((~flat_pair).astype(jnp.uint32), flat_idx), num_keys=1,
+        is_stable=True)[1]
+    C = n * max_pairs_per_row
+    picks = order[:C]
+    total = counts.sum()
+    live = jnp.arange(C, dtype=i32) < total
+
+    def span(arr_s, arr_e, W):
+        s = arr_s.reshape(n * L1)[picks]
+        e = arr_e.reshape(n * L1)[picks]
+        row = picks // L1
+        ln = jnp.clip(e - s, 0, W)
+        idx = jnp.clip(s[:, None], 0, L) + jnp.arange(W, dtype=i32)[None, :]
+        rows = jnp.take(jnp.pad(chars, ((0, 0), (0, W))), row, axis=0)
+        win = jnp.take_along_axis(rows, jnp.clip(idx, 0, L + W - 1), axis=1)
+        win = jnp.where(jnp.arange(W, dtype=i32)[None, :] < ln[:, None],
+                        win, jnp.uint8(0))
+        return win, jnp.where(live, ln, 0)
+
+    kc, kl = span(ys["pk_s"], ys["pk_e"], min(L, 256))
+    vc, vl = span(ys["pv_s"], ys["pv_e"], L)
+    return (offsets, row_ok, kc, kl, vc, vl, live, total)
+
+
+def from_json_to_raw_map(col: StringColumn,
+                         max_pairs_per_row: int = 0) -> ListColumn:
+    """LIST<STRUCT<key STRING, value STRING>> of top-level object fields."""
+    n, L = col.chars.shape
+    if max_pairs_per_row <= 0:
+        # a pair needs >= 6 chars ('"k":v,'); +1 slack for tiny inputs
+        max_pairs_per_row = max(1, L // 6 + 1)
+    offsets, row_ok, kc, kl, vc, vl, live, total = _extract(
+        col.chars, col.lengths, col.validity, max_pairs_per_row)
+    keys = StringColumn(kc, kl, live)
+    values = StringColumn(vc, vl, live)
+    structs = StructColumn({"key": keys, "value": values}, live)
+    return ListColumn(offsets, structs, row_ok)

@@ -404,6 +404,8 @@ def _step(P, ptypes, pindexes, pnames, pnamelens, carry, xs):
                   jnp.where(st["lit_id"] == 1, i32(EV_FALSE), i32(EV_NULL))),
         ev_a,
     )
+    ev_span_start = jnp.where(lit_done, st["tok_start"], ev_span_start)
+    ev_span_len = jnp.where(lit_done, j + 1 - st["tok_start"], ev_span_len)
     err = err | (ml & ~lit_ok)
 
     # -- number digit / float tracking ---------------------------------
@@ -810,6 +812,11 @@ def _step(P, ptypes, pindexes, pnames, pnamelens, carry, xs):
         "patch_tgt": patch_tgt.astype(i32),
         "patch_k0": patch_k0,
         "patch_k1": patch_k1,
+        # raw token events (consumed by from_json's recorder)
+        "ev_a": ev_a,
+        "ev_b": ev_b,
+        "span_s": ev_span_start.astype(i32),
+        "span_len": ev_span_len.astype(i32),
     }
 
     out = {
@@ -1043,6 +1050,9 @@ def _materialize(chars, ys, fail, float_bytes, float_lens, max_out):
           jnp.where(in_flt, b_flt,
           jnp.where(in_self, c_s, jnp.uint8(ord("]"))))))).astype(jnp.uint8)
     out = jnp.where(pos < total[:, None], out, jnp.uint8(0))
+    # a row overflowing the buffer cannot be represented: null it rather
+    # than return a silently truncated string
+    total = jnp.where(total > max_out, -1, total)
     return out, total
 
 
@@ -1201,7 +1211,7 @@ def _run(col_chars, col_lengths, col_validity, path_tuple, max_out):
 
     out_chars, out_lens = _materialize(
         col_chars, ys, fail, float_bytes, float_lens, max_out)
-    valid = col_validity & ok
+    valid = col_validity & ok & (out_lens >= 0)
     return out_chars, jnp.where(valid, out_lens, 0), valid
 
 
@@ -1212,15 +1222,19 @@ def get_json_object(
 ) -> StringColumn:
     """Evaluate a JSONPath against every row; invalid/no-match rows -> null.
 
-    ``max_out`` pins the output char-matrix width (default: 3*L+16, enough
-    for escape expansion and float re-formatting of practical data).
+    ``max_out`` pins the output char-matrix width (default 6*L+20 covers
+    the worst-case escape expansion; lower it to trade memory when inputs
+    are known tame — overlong results then clamp to null).
     """
     instructions = parse_path(path) if isinstance(path, str) else list(path)
     if len(instructions) > MAX_PATH:
         raise ValueError(f"path deeper than {MAX_PATH}")
     L = col.max_len
     if max_out <= 0:
-        max_out = 3 * L + 16
+        # provable worst case: every source byte expands to at most 6
+        # output bytes (control char -> \u00XX in escaped style); floats
+        # emit <= srclen+9; case-6 brackets add <=3 per '[' char
+        max_out = 6 * L + 20
     out_chars, out_lens, valid = _run(
         col.chars, col.lengths, col.validity, tuple(instructions), max_out)
     return StringColumn(out_chars, out_lens, valid)

@@ -451,6 +451,8 @@ def _list_fold(col, h, element_fn):
     from ..relational.gather import gather_column
 
     leaf, start, end = _drill_list(col)
+    if leaf.num_rows == 0:  # all rows null/empty: every fold is a no-op
+        return h
     max_len = jnp.maximum((end - start).max(), 0)
 
     def cond(st):

@@ -361,6 +361,9 @@ def _parse(chars, lengths, validity, part, key):
     empty_rest = length - start <= 0
     valid = valid & (~empty_rest | ~has_scheme)
     only_path = empty_rest & ~has_scheme
+    # the reference OVERWRITES valid here (:608-614): an empty remainder
+    # keeps only the empty path — the fragment bit is lost too
+    has[FRAGMENT] = has[FRAGMENT] & ~empty_rest
 
     # ---- hierarchical vs opaque ----------------------------------------
     first_c = jnp.take_along_axis(cpad, jnp.clip(start, 0, L)[:, None],

@@ -86,6 +86,23 @@ def _seg_scan_minmax(vals, boundary, op):
     return out
 
 
+def _seg_scan_sum(vals, boundary):
+    """Segmented running sum (resets at boundaries).
+
+    Used for FLOAT sums: a global prefix-sum difference cancels
+    catastrophically when a small group sorts after a large one (1e18
+    prefixes have ~128 ulp); the segmented scan keeps each group's sum a
+    tree-reduction of only its own elements.
+    """
+    def comb(a, b):
+        av, ab = a
+        bv, bb = b
+        return jnp.where(bb, bv, av + bv), ab | bb
+
+    out, _ = jax.lax.associative_scan(comb, (vals, boundary))
+    return out
+
+
 def group_by(
     batch: ColumnBatch,
     key_names: Sequence[str],
@@ -197,7 +214,10 @@ def group_by(
             acc = data.astype(out_t.jnp_dtype if spec.op == "sum"
                               else jnp.float64)
             acc = jnp.where(valid, acc, jnp.zeros((), acc.dtype))
-            s = at_ends_diff(jnp.cumsum(acc))
+            if jnp.issubdtype(acc.dtype, jnp.floating):
+                s = jnp.take(_seg_scan_sum(acc, boundary), ends)
+            else:
+                s = at_ends_diff(jnp.cumsum(acc))  # exact mod-2^64
             if spec.op == "mean":
                 s = s / jnp.maximum(nn, 1).astype(jnp.float64)
             out[spec.out_name] = Column(s, out_valid & has_any, out_t)

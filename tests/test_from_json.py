@@ -1,0 +1,65 @@
+"""from_json raw-map extraction (reference MapUtilsTest.java vectors)."""
+
+from spark_rapids_jni_tpu.columnar.column import StringColumn
+from spark_rapids_jni_tpu.ops.from_json import from_json_to_raw_map
+
+
+def run(rows):
+    col = StringColumn.from_pylist(rows, pad_to_multiple=8)
+    out = from_json_to_raw_map(col)
+    result = []
+    for row in out.to_pylist():
+        if row is None:
+            result.append(None)
+        else:
+            result.append([(d["key"], d["value"]) for d in row])
+    return result
+
+
+def test_simple_input():
+    j1 = ('{"Zipcode" : 704 , "ZipCodeType" : "STANDARD" , "City" : "PARC'
+          ' PARQUE" , "State" : "PR"}')
+    j2 = "{}"
+    j3 = ('{"category": "reference", "index": [4,{},null,{"a":[{ }, {}] } '
+          '], "author": "Nigel Rees", "title": "{}[], '
+          '<=semantic-symbols-string", "price": 8.95}')
+    got = run([j1, j2, None, j3])
+    assert got[0] == [("Zipcode", "704"), ("ZipCodeType", "STANDARD"),
+                      ("City", "PARC PARQUE"), ("State", "PR")]
+    assert got[1] == []
+    assert got[2] is None
+    assert got[3] == [
+        ("category", "reference"),
+        ("index", '[4,{},null,{"a":[{ }, {}] } ]'),
+        ("author", "Nigel Rees"),
+        ("title", "{}[], <=semantic-symbols-string"),
+        ("price", "8.95"),
+    ]
+
+
+def test_utf8_keys_values():
+    j1 = ('{"Zipcóde" : 704 , "ZípCodeTypé" : "STANDARD" ,'
+          ' "City" : "PARC PARQUE" , "Stâte" : "PR"}')
+    j3 = ('{"Zipcóde" : 704 , "ZípCodeTypé" : '
+          '"\U00029E3D" , "City" : "\U0001F3F3" , "Stâte" : '
+          '"\U0001F3F3"}')
+    got = run([j1, "{}", None, j3])
+    assert got[0] == [("Zipcóde", "704"),
+                      ("ZípCodeTypé", "STANDARD"),
+                      ("City", "PARC PARQUE"), ("Stâte", "PR")]
+    assert got[3] == [("Zipcóde", "704"),
+                      ("ZípCodeTypé", "\U00029E3D"),
+                      ("City", "\U0001F3F3"), ("Stâte", "\U0001F3F3")]
+
+
+def test_invalid_and_non_object():
+    got = run(['{"a":1', "[1,2]", "42", '{"k": true, "j": null}'])
+    assert got[0] is None
+    assert got[1] is None
+    assert got[2] is None
+    assert got[3] == [("k", "true"), ("j", "null")]
+
+
+def test_nested_values_raw():
+    got = run(['{"a": {"x": [1, 2]}, "b": [ {"y": "z"} ]}'])
+    assert got[0] == [("a", '{"x": [1, 2]}'), ("b", '[ {"y": "z"} ]')]

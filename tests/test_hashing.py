@@ -660,3 +660,12 @@ class TestNestedMurmur3:
         expected = murmur_hash3_32([e1, e2], seed=1868).to_pylist()
         got = murmur_hash3_32([lc], seed=1868).to_pylist()
         assert got == expected
+
+
+def test_list_hash_all_null_or_empty_rows():
+    from spark_rapids_jni_tpu.columnar.column import ListColumn
+
+    lc = ListColumn.from_pylist([None, []], T.INT32)
+    got = murmur_hash3_32([lc], seed=1868).to_pylist()
+    # null row and empty row both leave the seed untouched
+    assert got[0] == got[1]

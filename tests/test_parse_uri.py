@@ -178,3 +178,9 @@ def test_device_known_subset():
         expected = [e for _, e in cases]
         got = _device(rows, part)
         assert got == expected, (part, list(zip(rows, got, expected)))
+
+
+def test_fragment_cleared_on_empty_remainder():
+    """'#bob' keeps only the empty path (reference :608-614 overwrite)."""
+    got = _device(["#bob"], "FRAGMENT")
+    assert got == [None]

@@ -505,3 +505,16 @@ class TestWindow:
             window(b, ["p"], ["o1", "o2"],
                    [WindowSpec("row_number", None, "rn")],
                    descending=[True])
+
+
+class TestReviewRegressions2:
+    def test_float_sum_no_catastrophic_cancellation(self):
+        """A tiny group sorting after a huge one must still sum exactly
+        (segmented scan, not global prefix-sum difference)."""
+        n = 4096
+        ks = [0] * (n - 2) + [1, 1]
+        vs = [1e12] * (n - 2) + [0.5, 0.5]
+        b = ColumnBatch({"k": ints(ks), "v": Column.from_pylist(vs, T.FLOAT64)})
+        out, ng = group_by(b, ["k"], [AggSpec("sum", "v", "s")])
+        got = trimmed(out, ng)["s"]
+        assert got[1] == 1.0
