@@ -1,0 +1,3 @@
+"""I/O & metadata components (reference SURVEY.md §2.3)."""
+
+from .parquet_footer import ParquetFooter, read_footer_bytes  # noqa: F401

@@ -188,7 +188,7 @@ def _extract(chars, lengths, validity, max_pairs_per_row):
                         win, jnp.uint8(0))
         return win, jnp.where(live, ln, 0)
 
-    kc, kl = span(ys["pk_s"], ys["pk_e"], min(L, 256))
+    kc, kl = span(ys["pk_s"], ys["pk_e"], L)
     vc, vl = span(ys["pv_s"], ys["pv_e"], L)
     return (offsets, row_ok, kc, kl, vc, vl, live, total)
 
@@ -198,8 +198,9 @@ def from_json_to_raw_map(col: StringColumn,
     """LIST<STRUCT<key STRING, value STRING>> of top-level object fields."""
     n, L = col.chars.shape
     if max_pairs_per_row <= 0:
-        # a pair needs >= 6 chars ('"k":v,'); +1 slack for tiny inputs
-        max_pairs_per_row = max(1, L // 6 + 1)
+        # the smallest possible pair is 5 chars ('"":0,'); +1 slack covers
+        # the missing trailing comma of the last pair
+        max_pairs_per_row = max(1, L // 5 + 1)
     offsets, row_ok, kc, kl, vc, vl, live, total = _extract(
         col.chars, col.lengths, col.validity, max_pairs_per_row)
     keys = StringColumn(kc, kl, live)

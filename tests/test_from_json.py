@@ -63,3 +63,13 @@ def test_invalid_and_non_object():
 def test_nested_values_raw():
     got = run(['{"a": {"x": [1, 2]}, "b": [ {"y": "z"} ]}'])
     assert got[0] == [("a", '{"x": [1, 2]}'), ("b", '[ {"y": "z"} ]')]
+
+
+def test_many_minimal_pairs():
+    """Review regression: 13 five-char pairs must not overflow the default
+    pair capacity (smallest pair is '"":0,')."""
+    doc = "{" + ",".join(['"":%d' % (i % 10) for i in range(13)]) + "}"
+    got = run([doc])
+    assert got[0] is not None
+    assert len(got[0]) == 13
+    assert got[0][0] == ("", "0")
