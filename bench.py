@@ -216,6 +216,49 @@ def micro_main():
         m,
     )
 
+    # pallas variants of the hash kernels (native on TPU)
+    from spark_rapids_jni_tpu.ops import pallas_kernels
+
+    run("murmur3_int64_pallas",
+        jax.jit(lambda c: pallas_kernels.murmur3_int64(c)), vals, n)
+    run("xxhash64_int64_pallas",
+        jax.jit(lambda c: pallas_kernels.xxhash64_int64(c)), vals, n)
+
+    # get_json_object (mirrors GET_JSON_OBJECT_BENCH)
+    from spark_rapids_jni_tpu.ops.get_json_object import get_json_object
+
+    m_json = 1 << 14
+    jdocs = [
+        ('{"store":{"fruit":[{"weight":%d,"type":"apple"},'
+         '{"weight":%d,"type":"pear"}],"basket":[1,2,3]},"email":"x@y.com",'
+         '"owner":"amy%d"}') % (rng.integers(1, 99), rng.integers(1, 99), i)
+        for i in range(m_json)
+    ]
+    jcols = [(StringColumn.from_pylist(
+        [jdocs[(i + k) % m_json] for i in range(m_json)], pad_to_multiple=32),)
+        for k in range(V)]
+    run(
+        "get_json_object_owner",
+        jax.jit(lambda c: get_json_object(c, "$.owner")),
+        jcols,
+        m_json,
+        reps=4,
+    )
+
+    # parse_uri (mirrors PARSE_URI_BENCH)
+    from spark_rapids_jni_tpu.ops.parse_uri import parse_uri
+
+    m_uri = 1 << 16
+    uris = [
+        f"https://user{i}@www.example{i % 97}.com:8443/a/b/c{i}?k={i}&q=7#f"
+        for i in range(m_uri)
+    ]
+    ucols = [(StringColumn.from_pylist(
+        [uris[(i + k) % m_uri] for i in range(m_uri)], pad_to_multiple=32),)
+        for k in range(V)]
+    run("parse_uri_host", jax.jit(lambda c: parse_uri(c, "HOST")), ucols,
+        m_uri, reps=4)
+
     # group-by (100 keys, sum+count) — mirrors the q6 aggregate stage
     from spark_rapids_jni_tpu.relational import AggSpec, group_by
 

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# CPU smoke of the benchmark harness (the driver runs the real thing on TPU).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+BENCH_FORCE_CPU=1 BENCH_N_ROWS=65536 BENCH_REPS=2 python bench.py

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Premerge gate (role of the reference's ci/premerge-build.sh): build the
+# native pieces, run the full CPU suite on an 8-virtual-device mesh, then
+# the multi-chip dryrun. No accelerator needed — kernels run in XLA-CPU /
+# Pallas interpret mode (an improvement over the reference, whose suite
+# needs a physical GPU).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+make -C spark_rapids_jni_tpu/mem/native
+make -C spark_rapids_jni_tpu/io/native
+
+python -m pytest tests/ -x -q
+
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+  python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

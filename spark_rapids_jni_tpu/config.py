@@ -1,0 +1,92 @@
+"""Unified config/flag registry.
+
+The reference spreads its knobs over four layers (SURVEY.md §5: maven/
+cmake build properties, Java system properties, env vars for injected
+libs, and per-call arguments).  Here one registry holds every documented
+runtime knob with an env-var override (``SPARK_RAPIDS_TPU_<KEY>``),
+while per-call arguments keep winning at call sites — the same precedence
+story, minus the scatter.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+_ENV_PREFIX = "SPARK_RAPIDS_TPU_"
+
+
+@dataclass(frozen=True)
+class _Entry:
+    default: Any
+    parse: Callable[[str], Any]
+    doc: str
+
+
+_REGISTRY: Dict[str, _Entry] = {}
+_overrides: Dict[str, Any] = {}
+_lock = threading.Lock()
+
+
+def _register(key: str, default, parse, doc: str):
+    _REGISTRY[key] = _Entry(default, parse, doc)
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+# ---- documented knobs ------------------------------------------------------
+_register("watchdog_poll_ms", 100.0, float,
+          "Deadlock watchdog period for the resource adaptor "
+          "(reference: ai.rapids.cudf.spark.rmmWatchdogPollingPeriod).")
+_register("mem_pool_bytes", 0, int,
+          "Default logical HBM arena size for RmmSpark.set_event_handler "
+          "(0 = caller must pass one explicitly).")
+_register("json_max_out", 0, int,
+          "get_json_object output width cap (0 = provable 6*L+20 bound).")
+_register("shuffle_capacity_bucket", 256, int,
+          "Rounding bucket for auto-planned exchange capacities (bigger = "
+          "fewer recompiles, more slot padding).")
+_register("bench_rows", 1 << 21, int,
+          "Row count for the flagship q6 benchmark.")
+_register("use_pallas_hashes", False, _parse_bool,
+          "Route murmur3/xxhash64 int64 fast paths through the Pallas "
+          "kernels instead of the jnp formulations.")
+
+
+def get(key: str):
+    """Resolve ``key``: programmatic override > env var > default."""
+    entry = _REGISTRY.get(key)
+    if entry is None:
+        raise KeyError(f"unknown config key {key!r}; known: "
+                       f"{sorted(_REGISTRY)}")
+    with _lock:
+        if key in _overrides:
+            return _overrides[key]
+    env = os.environ.get(_ENV_PREFIX + key.upper())
+    if env is not None:
+        return entry.parse(env)
+    return entry.default
+
+
+def set(key: str, value) -> None:  # noqa: A001 - mirrors a settings API
+    if key not in _REGISTRY:
+        raise KeyError(f"unknown config key {key!r}")
+    with _lock:
+        _overrides[key] = value
+
+
+def reset(key: Optional[str] = None) -> None:
+    with _lock:
+        if key is None:
+            _overrides.clear()
+        else:
+            _overrides.pop(key, None)
+
+
+def describe() -> Dict[str, str]:
+    """key -> one-line doc (for --help style listings)."""
+    return {k: e.doc for k, e in sorted(_REGISTRY.items())}

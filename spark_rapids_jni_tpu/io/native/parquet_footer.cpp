@@ -109,15 +109,20 @@ class Reader {
   }
 
  private:
-  TValue read_value(uint8_t type) {
+  TValue read_value(uint8_t type, bool in_container = false) {
     TValue v;
     v.type = type;
     switch (type) {
       case T_TRUE:
-        v.b = true;
-        break;
       case T_FALSE:
-        v.b = false;
+        if (in_container) {
+          // container bools are one byte (1=true, 2=false); field bools
+          // live in the field-header type nibble and consume nothing
+          v.b = u8() == 1;
+          v.type = v.b ? T_TRUE : T_FALSE;
+        } else {
+          v.b = (type == T_TRUE);
+        }
         break;
       case T_BYTE:
         v.i = int8_t(u8());
@@ -146,20 +151,26 @@ class Reader {
         uint64_t size = head >> 4;
         v.elem_type = head & 0x0F;
         if (size == 15) size = varint();
+        // every element consumes >= 1 byte except nothing does 0, so a
+        // size beyond the remaining bytes is a corrupt/hostile footer
+        if (size > remaining())
+          throw std::runtime_error("container size exceeds footer");
         v.elems.reserve(size);
         for (uint64_t k = 0; k < size; k++)
-          v.elems.push_back(read_value(list_elem_type(v.elem_type)));
+          v.elems.push_back(read_value(v.elem_type, /*in_container=*/true));
         break;
       }
       case T_MAP: {
         uint64_t size = varint();
+        if (size > remaining())
+          throw std::runtime_error("map size exceeds footer");
         if (size > 0) {
           uint8_t kv = u8();
           v.key_type = kv >> 4;
           v.val_type = kv & 0x0F;
           for (uint64_t k = 0; k < size; k++) {
-            TValue key = read_value(list_elem_type(v.key_type));
-            TValue val = read_value(list_elem_type(v.val_type));
+            TValue key = read_value(v.key_type, /*in_container=*/true);
+            TValue val = read_value(v.val_type, /*in_container=*/true);
             v.kvs.push_back({std::move(key), std::move(val)});
           }
         }
@@ -174,8 +185,7 @@ class Reader {
     return v;
   }
 
-  // container element types use BOOL=1 rather than the TRUE/FALSE field forms
-  static uint8_t list_elem_type(uint8_t t) { return t; }
+  uint64_t remaining() const { return n_ - pos_; }
 
   void need(uint64_t n) {
     if (pos_ + n > n_) throw std::runtime_error("footer truncated");
@@ -232,7 +242,7 @@ class Writer {
     switch (v.type) {
       case T_TRUE:
       case T_FALSE:
-        if (!in_field) u8(v.b ? 1 : 0);
+        if (!in_field) u8(v.b ? 1 : 2);  // container bools: 1=true, 2=false
         break;  // field bools are encoded in the type nibble
       case T_BYTE:
         u8(uint8_t(v.i));
@@ -583,7 +593,7 @@ void* pqf_read_and_filter(const uint8_t* buf, long len, long part_offset,
                           long part_length, const char** names,
                           const int* num_children, const int* tags,
                           int n_entries, int parent_num_children,
-                          int ignore_case) {
+                          int ignore_case, int do_prune) {
   auto* out = new Footer();
   try {
     Reader r(buf, size_t(len));
@@ -603,7 +613,7 @@ void* pqf_read_and_filter(const uint8_t* buf, long len, long part_offset,
     }
 
     // --- column pruning against the requested schema tree ------------
-    if (n_entries > 0) {
+    if (do_prune) {
       PruneNode root;
       std::vector<std::string> nm(names, names + n_entries);
       std::vector<int> nc(num_children, num_children + n_entries);

@@ -53,7 +53,7 @@ def _load_lib() -> ctypes.CDLL:
             ctypes.c_char_p, ctypes.c_long, ctypes.c_long, ctypes.c_long,
             ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int),
             ctypes.POINTER(ctypes.c_int), ctypes.c_int, ctypes.c_int,
-            ctypes.c_int]
+            ctypes.c_int, ctypes.c_int]
         lib.pqf_error.restype = ctypes.c_char_p
         lib.pqf_error.argtypes = [ctypes.c_void_p]
         lib.pqf_free.argtypes = [ctypes.c_void_p]
@@ -164,7 +164,7 @@ class ParquetFooter:
         c_tags = (ctypes.c_int * max(n, 1))(*(tags or [0]))
         h = lib.pqf_read_and_filter(
             footer, len(footer), part_offset, part_length, c_names, c_counts,
-            c_tags, n, n_top, int(ignore_case))
+            c_tags, n, n_top, int(ignore_case), int(schema is not None))
         err = lib.pqf_error(h)
         if err:
             msg = err.decode()

@@ -1,1 +1,10 @@
-"""Spark-semantics-exact kernels over column batches."""
+"""Spark-semantics-exact kernels over column batches.
+
+Import kernels from their modules (``ops.cast_string``, ``ops.hashing``,
+``ops.get_json_object``, ``ops.parse_uri``, ``ops.from_json``, ...); the
+high-traffic entry points are also re-exported here.
+"""
+
+from .from_json import from_json_to_raw_map  # noqa: F401
+from .get_json_object import get_json_object, parse_path  # noqa: F401
+from .parse_uri import parse_uri  # noqa: F401

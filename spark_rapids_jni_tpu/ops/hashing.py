@@ -489,6 +489,15 @@ def murmur_hash3_32(columns: Columns, seed: int = 42) -> Column:
     n = _validate(cols)
     from ..columnar.column import ListColumn
 
+    if (len(cols) == 1 and isinstance(cols[0], Column)
+            and cols[0].dtype.kind in (T.Kind.INT64, T.Kind.TIMESTAMP)):
+        from .. import config
+
+        if config.get("use_pallas_hashes"):
+            from .pallas_kernels import murmur3_int64
+
+            return murmur3_int64(cols[0], seed=seed)
+
     h = jnp.full((n,), jnp.uint32(seed & 0xFFFFFFFF))
     for c in cols:
         if isinstance(c, ListColumn):
@@ -505,6 +514,14 @@ def xxhash64(columns: Columns, seed: int = DEFAULT_XXHASH64_SEED) -> Column:
 
     cols = _as_columns(columns)
     n = _validate(cols)
+    if (len(cols) == 1 and isinstance(cols[0], Column)
+            and cols[0].dtype.kind in (T.Kind.INT64, T.Kind.TIMESTAMP)):
+        from .. import config
+
+        if config.get("use_pallas_hashes"):
+            from .pallas_kernels import xxhash64_int64
+
+            return xxhash64_int64(cols[0], seed=seed)
     h = jnp.full((n,), jnp.uint64(seed & 0xFFFFFFFFFFFFFFFF))
     for c in cols:
         if isinstance(c, ListColumn):

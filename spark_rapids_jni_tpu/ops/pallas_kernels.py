@@ -1,0 +1,262 @@
+"""Pallas TPU kernels for the hot hash path.
+
+The jnp formulations in :mod:`hashing` leave fusion to XLA; these kernels
+pin the whole per-row pipeline (seed -> mix per 4-byte block -> finalize ->
+validity select) into one VMEM pass per tile, the shape SURVEY.md §2
+prescribes for kernel work ("Pallas/XLA kernels, not Python stand-ins").
+Tiles are ``(BLOCK_ROWS, 128)`` uint32 lanes — native VPU width; 64-bit
+inputs arrive pre-split into lo/hi words so no 64-bit lanes are needed
+(TPU has none).
+
+Every entry point takes ``interpret=None`` and auto-falls back to the
+Pallas interpreter off-TPU, so the same kernels run in CPU CI (an
+improvement over the reference, whose kernels need a physical GPU —
+SURVEY.md §4).
+
+Parity: tests assert bit-identity against :mod:`hashing`'s golden-tested
+murmur3/xxhash64 (reference ``murmur_hash.cu:187``, ``xxhash64.cu:330``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..columnar import types as T
+from ..columnar.column import Column
+
+LANES = 128
+BLOCK_ROWS = 256  # 256x128 uint32 tile = 128KB/operand in VMEM
+
+
+def _auto_interpret(interpret: Optional[bool]) -> bool:
+    if interpret is not None:
+        return interpret
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+def _rotl(x, r: int):
+    return (x << r) | (x >> (32 - r))
+
+
+# plain ints here: module-level jnp scalars would be captured constants,
+# which pallas_call rejects; literals created inside the traced kernel fold
+_C1 = 0xCC9E2D51
+_C2 = 0x1B873593
+_C3 = 0xE6546B64
+
+
+def _mix(h, k1):
+    k1 = k1 * jnp.uint32(_C1)
+    k1 = _rotl(k1, 15)
+    k1 = k1 * jnp.uint32(_C2)
+    h = h ^ k1
+    h = _rotl(h, 13)
+    return h * jnp.uint32(5) + jnp.uint32(_C3)
+
+
+def _fmix(h):
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def _murmur3_i64_kernel(lo_ref, hi_ref, valid_ref, seed_ref, out_ref):
+    seed = seed_ref[0]
+    h = jnp.full(lo_ref.shape, seed, jnp.uint32)
+    h = _mix(h, lo_ref[:])
+    h = _mix(h, hi_ref[:])
+    h = h ^ jnp.uint32(8)
+    h = _fmix(h)
+    out_ref[:] = jnp.where(valid_ref[:] != 0, h,
+                           jnp.full(lo_ref.shape, seed, jnp.uint32))
+
+
+def _pad_tiles(a, n):
+    rows = -(-n // LANES)
+    rows = -(-rows // BLOCK_ROWS) * BLOCK_ROWS
+    flat = jnp.zeros((rows * LANES,), a.dtype).at[:n].set(a)
+    return flat.reshape(rows, LANES), rows
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def _murmur3_i64_call(lo, hi, valid, seed, interpret):
+    n = lo.shape[0]
+    lo2, rows = _pad_tiles(lo, n)
+    hi2, _ = _pad_tiles(hi, n)
+    va2, _ = _pad_tiles(valid.astype(jnp.uint32), n)
+    grid = rows // BLOCK_ROWS
+    out = pl.pallas_call(
+        _murmur3_i64_kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, LANES), jnp.uint32),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0)),
+            pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0)),
+            pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0)),
+        interpret=interpret,
+    )(lo2, hi2, va2, seed)
+    return out.reshape(-1)[:n]
+
+
+def murmur3_int64(col: Column, seed: int = 42,
+                  interpret: Optional[bool] = None) -> Column:
+    """Spark murmur3_32 of one int64 column (Pallas tile kernel)."""
+    u = col.data.astype(jnp.int64)
+    pair = jax.lax.bitcast_convert_type(u, jnp.uint32)
+    lo, hi = pair[..., 0], pair[..., 1]
+    h = _murmur3_i64_call(lo, hi, col.validity,
+                          jnp.asarray([seed & 0xFFFFFFFF], jnp.uint32),
+                          _auto_interpret(interpret))
+    out = jax.lax.bitcast_convert_type(h, jnp.int32)
+    return Column(out, jnp.ones_like(col.validity), T.INT32)
+
+
+# ---------------------------------------------------------------------------
+# xxhash64 (uint64 emulated as lo/hi uint32 pairs inside the kernel)
+# ---------------------------------------------------------------------------
+
+_P1 = 0x9E3779B185EBCA87
+_P2 = 0xC2B2AE3D27D4EB4F
+_P3 = 0x165667B19E3779F9
+_P5 = 0x27D4EB2F165667C5
+
+
+def _c64(v):
+    return (jnp.uint32(v & 0xFFFFFFFF), jnp.uint32((v >> 32) & 0xFFFFFFFF))
+
+
+def _add64(a, b):
+    lo = a[0] + b[0]
+    carry = (lo < a[0]).astype(jnp.uint32)
+    return lo, a[1] + b[1] + carry
+
+
+def _xor64(a, b):
+    return a[0] ^ b[0], a[1] ^ b[1]
+
+
+def _mul64(a, b):
+    """Full 64-bit product of two (lo, hi) uint32 pairs (mod 2^64)."""
+    a0, a1 = a
+    b0, b1 = b
+    # 16-bit limb products to stay exact in uint32 arithmetic
+    a0l, a0h = a0 & jnp.uint32(0xFFFF), a0 >> 16
+    b0l, b0h = b0 & jnp.uint32(0xFFFF), b0 >> 16
+    ll = a0l * b0l
+    lh = a0l * b0h
+    hl = a0h * b0l
+    hh = a0h * b0h
+    mid = (ll >> 16) + (lh & jnp.uint32(0xFFFF)) + (hl & jnp.uint32(0xFFFF))
+    lo = (ll & jnp.uint32(0xFFFF)) | (mid << 16)
+    carry = (mid >> 16) + (lh >> 16) + (hl >> 16) + hh
+    hi = carry + a0 * b1 + a1 * b0
+    return lo, hi
+
+
+def _rotl64p(a, r: int):
+    lo, hi = a
+    if r == 32:
+        return hi, lo
+    if r < 32:
+        return ((lo << r) | (hi >> (32 - r)), (hi << r) | (lo >> (32 - r)))
+    r -= 32
+    lo, hi = hi, lo
+    return ((lo << r) | (hi >> (32 - r)), (hi << r) | (lo >> (32 - r)))
+
+
+def _shr64(a, r: int):
+    lo, hi = a
+    if r >= 32:
+        return hi >> (r - 32), jnp.zeros_like(hi)
+    return (lo >> r) | (hi << (32 - r)), hi >> r
+
+
+def _xxh_kernel(lo_ref, hi_ref, valid_ref, seed_ref, out_lo_ref, out_hi_ref):
+    shape = lo_ref.shape
+    seed = (jnp.full(shape, seed_ref[0], jnp.uint32),
+            jnp.full(shape, seed_ref[1], jnp.uint32))
+    p1 = _c64(_P1)
+    p2 = _c64(_P2)
+    p3 = _c64(_P3)
+    p5 = _c64(_P5)
+
+    def bc(c):
+        return (jnp.broadcast_to(c[0], shape), jnp.broadcast_to(c[1], shape))
+
+    h = _add64(_add64(seed, bc(p5)), bc(_c64(8)))
+    k = (lo_ref[:], hi_ref[:])
+    k = _mul64(k, bc(p2))
+    k = _rotl64p(k, 31)
+    k = _mul64(k, bc(p1))
+    h = _xor64(h, k)
+    h = _rotl64p(h, 27)
+    h = _mul64(h, bc(p1))
+    h = _add64(h, bc(_c64(0x85EBCA77C2B2AE63)))
+    # finalize
+    h = _xor64(h, _shr64(h, 33))
+    h = _mul64(h, bc(p2))
+    h = _xor64(h, _shr64(h, 29))
+    h = _mul64(h, bc(p3))
+    h = _xor64(h, _shr64(h, 32))
+    live = valid_ref[:] != 0
+    out_lo_ref[:] = jnp.where(live, h[0], seed[0])
+    out_hi_ref[:] = jnp.where(live, h[1], seed[1])
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def _xxh_i64_call(lo, hi, valid, seed_pair, interpret):
+    n = lo.shape[0]
+    lo2, rows = _pad_tiles(lo, n)
+    hi2, _ = _pad_tiles(hi, n)
+    va2, _ = _pad_tiles(valid.astype(jnp.uint32), n)
+    grid = rows // BLOCK_ROWS
+    out_lo, out_hi = pl.pallas_call(
+        _xxh_kernel,
+        out_shape=(jax.ShapeDtypeStruct((rows, LANES), jnp.uint32),
+                   jax.ShapeDtypeStruct((rows, LANES), jnp.uint32)),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0)),
+            pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0)),
+            pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=(pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0)),
+                   pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0))),
+        interpret=interpret,
+    )(lo2, hi2, va2, seed_pair)
+    return out_lo.reshape(-1)[:n], out_hi.reshape(-1)[:n]
+
+
+def xxhash64_int64(col: Column, seed: int = 42,
+                   interpret: Optional[bool] = None) -> Column:
+    """Spark xxhash64 of one int64 column (Pallas tile kernel).
+
+    The whole 64-bit pipeline (multiplies included) runs on 32-bit lanes —
+    ``_mul64`` builds the product from 16-bit limb partials, the same
+    discipline the decimal128 kernels use.
+    """
+    u = col.data.astype(jnp.int64)
+    pair = jax.lax.bitcast_convert_type(u, jnp.uint32)
+    lo, hi = pair[..., 0], pair[..., 1]
+    seed64 = seed & 0xFFFFFFFFFFFFFFFF
+    seed_pair = jnp.asarray([seed64 & 0xFFFFFFFF, seed64 >> 32], jnp.uint32)
+    out_lo, out_hi = _xxh_i64_call(lo, hi, col.validity, seed_pair,
+                                   _auto_interpret(interpret))
+    from .hashing import _u64_to_i64
+
+    u64 = out_lo.astype(jnp.uint64) | (out_hi.astype(jnp.uint64)
+                                       << jnp.uint64(32))
+    return Column(_u64_to_i64(u64), jnp.ones_like(col.validity), T.INT64)

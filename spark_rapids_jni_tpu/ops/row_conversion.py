@@ -276,3 +276,116 @@ def convert_from_rows(
             u = _read_le(data, coff, w)
             out[name] = Column(_u64_to_kind(u, d.dtype, w), valid, d.dtype)
     return ColumnBatch(out)
+
+
+# ---------------------------------------------------------------------------
+# batching + the fixed-width-optimized entry (reference RowConversion.java)
+# ---------------------------------------------------------------------------
+
+MAX_BATCH_BYTES = (1 << 31) - 8  # one output batch stays under 2GB
+FIXED_OPT_MAX_COLS = 100         # RowConversion.java:32-33
+FIXED_OPT_MAX_ROW_BYTES = 1024   # RowConversion.java:115-116
+
+
+def _slice_col(col, lo: int, hi: int):
+    import dataclasses
+
+    if isinstance(col, StringColumn):
+        return StringColumn(col.chars[lo:hi], col.lengths[lo:hi],
+                            col.validity[lo:hi], col.dtype)
+    if isinstance(col, Decimal128Column):
+        return Decimal128Column(col.limbs[lo:hi], col.validity[lo:hi],
+                                col.dtype)
+    return dataclasses.replace(col, data=col.data[lo:hi],
+                               validity=col.validity[lo:hi])
+
+
+def convert_to_rows_fixed_width_optimized(batch: ColumnBatch,
+                                          row_valid=None) -> StringColumn:
+    """The <100-column, <=1KB-row fast-path entry.
+
+    Mirrors the reference's separate optimized kernel contract
+    (``convert_to_rows_fixed_width_optimized``, ``row_conversion.cu:2053``;
+    limits from ``RowConversion.java:32-33,115-116``).  Under XLA the
+    string-free layout already compiles to pure aligned byte slices, so
+    this entry enforces the contract and dispatches to the same program.
+    """
+    cols = batch.columns
+    if len(cols) >= FIXED_OPT_MAX_COLS:
+        raise ValueError(
+            f"fixed-width-optimized path requires <{FIXED_OPT_MAX_COLS} "
+            f"columns, got {len(cols)}")
+    for name, col in zip(batch.names, cols):
+        if isinstance(col, StringColumn):
+            raise ValueError(
+                f"fixed-width-optimized path cannot handle string column "
+                f"{name!r}")
+    _, _, fixed_end, _ = row_layout(cols)
+    row_bytes = _align(fixed_end, 8)
+    if row_bytes > FIXED_OPT_MAX_ROW_BYTES:
+        raise ValueError(
+            f"fixed-width-optimized path caps rows at "
+            f"{FIXED_OPT_MAX_ROW_BYTES}B, layout needs {row_bytes}B")
+    return convert_to_rows(batch, row_valid=row_valid)
+
+
+def convert_to_rows_batched(batch: ColumnBatch,
+                            max_batch_bytes: int = MAX_BATCH_BYTES) -> list:
+    """Split the input so each output row image stays under the byte cap.
+
+    The TPU equivalent of the reference's ``build_batches``
+    (``row_conversion.cu:1458``): one cudf LIST<INT8> column is capped at
+    2GB of child data, so conversions of big tables must emit multiple
+    batches.  Splitting happens on the input row axis with a worst-case
+    per-row byte bound (fixed layout + each string column's max_len).
+    """
+    n = batch.num_rows
+    cols = batch.columns
+    _, _, fixed_end, _ = row_layout(cols)
+    # the actual row image width: fixed area + worst-case string bytes,
+    # padded to 8 as convert_to_rows does
+    worst_row = _align(
+        fixed_end + sum(c.max_len for c in cols
+                        if isinstance(c, StringColumn)), 8)
+    worst_row = max(worst_row, 1)
+    rows_per_batch = max(1, int(max_batch_bytes // worst_row))
+    out = []
+    for lo in range(0, max(n, 1), rows_per_batch):
+        hi = min(lo + rows_per_batch, n)
+        piece = ColumnBatch({
+            name: _slice_col(col, lo, hi)
+            for name, col in zip(batch.names, cols)
+        })
+        out.append(convert_to_rows(piece))
+    return out
+
+
+def convert_from_rows_batched(row_batches: list, schema) -> ColumnBatch:
+    """Inverse of :func:`convert_to_rows_batched`: concatenate batches."""
+    import dataclasses
+
+    parts = [convert_from_rows(rb, schema) for rb in row_batches]
+    if len(parts) == 1:
+        return parts[0]
+    out = {}
+    for name in parts[0].names:
+        cols = [p[name] for p in parts]
+        c0 = cols[0]
+        if isinstance(c0, StringColumn):
+            width = max(c.max_len for c in cols)
+            chars = jnp.concatenate([
+                jnp.pad(c.chars, ((0, 0), (0, width - c.max_len)))
+                for c in cols
+            ])
+            out[name] = StringColumn(
+                chars, jnp.concatenate([c.lengths for c in cols]),
+                jnp.concatenate([c.validity for c in cols]), c0.dtype)
+        elif isinstance(c0, Decimal128Column):
+            out[name] = Decimal128Column(
+                jnp.concatenate([c.limbs for c in cols]),
+                jnp.concatenate([c.validity for c in cols]), c0.dtype)
+        else:
+            out[name] = dataclasses.replace(
+                c0, data=jnp.concatenate([c.data for c in cols]),
+                validity=jnp.concatenate([c.validity for c in cols]))
+    return ColumnBatch(out)

@@ -1,0 +1,45 @@
+"""Config registry precedence: override > env > default."""
+
+import pytest
+
+from spark_rapids_jni_tpu import config
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    config.reset()
+
+
+def test_default():
+    assert config.get("watchdog_poll_ms") == 100.0
+
+
+def test_env_override(monkeypatch):
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_WATCHDOG_POLL_MS", "25")
+    assert config.get("watchdog_poll_ms") == 25.0
+
+
+def test_programmatic_override_beats_env(monkeypatch):
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_WATCHDOG_POLL_MS", "25")
+    config.set("watchdog_poll_ms", 7.0)
+    assert config.get("watchdog_poll_ms") == 7.0
+    config.reset("watchdog_poll_ms")
+    assert config.get("watchdog_poll_ms") == 25.0
+
+
+def test_unknown_key_raises():
+    with pytest.raises(KeyError):
+        config.get("nope")
+    with pytest.raises(KeyError):
+        config.set("nope", 1)
+
+
+def test_bool_parse(monkeypatch):
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_USE_PALLAS_HASHES", "true")
+    assert config.get("use_pallas_hashes") is True
+
+
+def test_describe_lists_all():
+    d = config.describe()
+    assert "watchdog_poll_ms" in d and all(v for v in d.values())

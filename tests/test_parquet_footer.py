@@ -147,3 +147,9 @@ def test_read_footer_bytes_rejects_garbage(tmp_path):
 def test_bad_thrift_raises():
     with pytest.raises(ValueError):
         ParquetFooter.read_and_filter(b"\xff\xff\xff\xff\xff")
+
+
+def test_empty_schema_prunes_everything(flat_file):
+    """schema={} means keep zero columns, unlike schema=None (keep all)."""
+    with ParquetFooter.read_and_filter(flat_file, schema={}) as f:
+        assert f.num_columns == 0

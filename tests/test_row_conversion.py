@@ -11,6 +11,7 @@ from spark_rapids_jni_tpu.columnar.column import (
     Decimal128Column,
     StringColumn,
 )
+import spark_rapids_jni_tpu.ops.row_conversion as rc
 from spark_rapids_jni_tpu.ops.row_conversion import (
     convert_from_rows,
     convert_to_rows,
@@ -136,3 +137,50 @@ class TestRoundTrip:
         )
         assert back["d9"].to_pylist() == vals
         assert back["d18"].to_pylist() == vals
+
+
+class TestBatchingAndFixedOpt:
+    def test_fixed_width_optimized_roundtrip(self):
+        b = ColumnBatch(
+            {
+                "a": Column.from_pylist([1, None, 3], T.INT32),
+                "b": Column.from_pylist([1.5, 2.5, None], T.FLOAT64),
+            }
+        )
+        rows = rc.convert_to_rows_fixed_width_optimized(b)
+        back = rc.convert_from_rows(rows, {"a": T.INT32, "b": T.FLOAT64})
+        assert back.to_pydict() == b.to_pydict()
+
+    def test_fixed_width_optimized_rejects_strings(self):
+        b = ColumnBatch({"s": StringColumn.from_pylist(["x"])})
+        with pytest.raises(ValueError):
+            rc.convert_to_rows_fixed_width_optimized(b)
+
+    def test_fixed_width_optimized_rejects_wide_rows(self):
+        # 90 decimal128 columns = 1440B/row, over the 1KB fast-path cap
+        cols = {
+            f"c{i}": Decimal128Column.from_unscaled([1], 38, 0)
+            for i in range(90)
+        }
+        with pytest.raises(ValueError):
+            rc.convert_to_rows_fixed_width_optimized(ColumnBatch(cols))
+
+    def test_fixed_width_optimized_rejects_too_many_cols(self):
+        cols = {f"c{i}": Column.from_pylist([1], T.INT32) for i in range(100)}
+        with pytest.raises(ValueError):
+            rc.convert_to_rows_fixed_width_optimized(ColumnBatch(cols))
+
+    def test_batched_roundtrip_multiple_batches(self):
+        n = 100
+        b = ColumnBatch(
+            {
+                "a": Column.from_pylist(list(range(n)), T.INT64),
+                "s": StringColumn.from_pylist([f"v{i}" for i in range(n)]),
+            }
+        )
+        # force tiny batches: each row image is ~24B, cap at 100B
+        batches = rc.convert_to_rows_batched(b, max_batch_bytes=100)
+        assert len(batches) > 1
+        back = rc.convert_from_rows_batched(
+            batches, {"a": T.INT64, "s": (T.STRING, 4)})
+        assert back.to_pydict() == b.to_pydict()
