@@ -26,7 +26,16 @@ import subprocess
 import sys
 import time
 
-N_ROWS = int(os.environ.get("BENCH_N_ROWS", 1 << 21))  # 2M
+def _default_rows():
+    try:
+        from spark_rapids_jni_tpu import config
+
+        return config.get("bench_rows")
+    except Exception:
+        return 1 << 21
+
+
+N_ROWS = int(os.environ.get("BENCH_N_ROWS", 0)) or _default_rows()
 REPS = int(os.environ.get("BENCH_REPS", 8))
 TPU_TIMEOUT_S = int(os.environ.get("BENCH_TPU_TIMEOUT_S", "1500"))
 CPU_TIMEOUT_S = int(os.environ.get("BENCH_CPU_TIMEOUT_S", "900"))
@@ -281,6 +290,15 @@ def micro_main():
         gbs,
         m,
     )
+
+    # the other BASELINE.md query shapes: q3 (join) and q67 (window)
+    import __graft_entry__ as ge
+
+    nq = 1 << 18
+    q3in = [ge._q3_batches(nq, seed=11 + k) for k in range(V)]
+    run("q3_join_agg", jax.jit(ge._q3_step), q3in, nq, reps=6)
+    q67in = [(ge._q67_batch(nq, seed=13 + k),) for k in range(V)]
+    run("q67_window_topk", jax.jit(ge._q67_step), q67in, nq, reps=6)
 
     for r in results:
         print(json.dumps(r), flush=True)

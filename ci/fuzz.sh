@@ -1,23 +1,21 @@
 #!/usr/bin/env bash
 # Long-running memory-scheduler fuzz (role of the reference's
 # ci/fuzz-test.sh: RmmSparkMonteCarlo at 2:3 oversubscription with skew).
-# SEEDS / TASKS / OPS scale the hunt; every seed must complete without
-# deadlock, livelock, or lost allocations.
+# Each round feeds DISTINCT seeds into the Monte-Carlo scenario via
+# MEM_FUZZ_SEEDS; every seed must complete without deadlock or livelock.
 set -euo pipefail
 cd "$(dirname "$0")/.."
-SEEDS=${SEEDS:-20}
-
-python - <<PY
-import random, subprocess, sys
-fails = 0
-for seed in range(int("${SEEDS}")):
-    r = subprocess.run(
-        [sys.executable, "-m", "pytest", "tests/test_mem_adaptor.py::TestMonteCarlo",
-         "-q", "--no-header", "-p", "no:cacheprovider"],
-        env={"PYTHONHASHSEED": str(seed), "PATH": "/usr/bin:/bin:/usr/local/bin"},
-        capture_output=True, text=True)
-    ok = r.returncode == 0
-    print(f"seed {seed}: {'ok' if ok else 'FAIL'}")
-    fails += (not ok)
-sys.exit(1 if fails else 0)
-PY
+ROUNDS=${ROUNDS:-10}
+fails=0
+for round in $(seq 1 "${ROUNDS}"); do
+  seeds="$((round * 101)),$((round * 101 + 7)),$((round * 101 + 13))"
+  if MEM_FUZZ_SEEDS="$seeds" python -m pytest \
+       tests/test_mem_adaptor.py::TestMonteCarlo -q --no-header \
+       -p no:cacheprovider > /dev/null 2>&1; then
+    echo "round ${round} (seeds ${seeds}): ok"
+  else
+    echo "round ${round} (seeds ${seeds}): FAIL"
+    fails=$((fails + 1))
+  fi
+done
+exit $((fails > 0))

@@ -1,0 +1,155 @@
+"""Fault injection at the execute boundary.
+
+Reference: the CUPTI injector (``faultinj/faultinj.cu:84-137`` + its
+README): a library the driver loads into any process, configured by a JSON
+file named in an env var, matching CUDA calls by function name or ``*``
+with a probability and count, injecting one of three fault flavors, with
+hot-reloadable config.  The TPU equivalent intercepts at OUR execute
+boundary — instrumented jitted callables — since there is no CUPTI:
+
+* config: JSON at ``SPARK_RAPIDS_TPU_FAULT_CONFIG`` (or passed directly)::
+
+      {"seed": 42, "dynamic": true,
+       "faults": [{"match": "q6*",  "probability": 0.01,
+                   "fault": "exception"},
+                  {"match": "*",    "count": 2, "fault": "oom"}]}
+
+  ``match`` is an fnmatch pattern on the instrumented name; ``count``
+  limits firings (omit for unlimited); ``probability`` defaults to 1.
+* faults: ``"exception"`` raises :class:`InjectedFault` (the retryable
+  CudfException analogue), ``"oom"`` raises
+  :class:`~spark_rapids_jni_tpu.mem.RetryOOM` (driving the rollback
+  ladder), ``"fatal"`` raises :class:`FatalInjectedFault` (the
+  device-trap analogue — callers must treat the executor as poisoned).
+* ``dynamic: true`` re-reads the file when its mtime changes, matching
+  the injector's ``dynamicReconfig`` thread without needing one.
+
+Usage::
+
+    from spark_rapids_jni_tpu import faultinj
+    faultinj.configure(path_or_dict)          # or env var + configure()
+    step = faultinj.instrument(jax.jit(fn), "q6_step")
+    step(batch)   # may raise per config
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import functools
+import json
+import os
+import random
+import threading
+from typing import Optional, Union
+
+ENV_CONFIG = "SPARK_RAPIDS_TPU_FAULT_CONFIG"
+
+
+class InjectedFault(RuntimeError):
+    """Retryable injected failure (the injected-CudfException analogue)."""
+
+
+class FatalInjectedFault(RuntimeError):
+    """Fatal injected failure (the device trap/assert analogue)."""
+
+
+class _Rule:
+    def __init__(self, spec: dict):
+        self.match = spec.get("match", "*")
+        self.probability = float(spec.get("probability", 1.0))
+        self.count = spec.get("count")  # None = unlimited
+        self.fault = spec.get("fault", "exception")
+        if self.fault not in ("exception", "oom", "fatal"):
+            raise ValueError(f"unknown fault kind {self.fault!r}")
+        self.remaining = None if self.count is None else int(self.count)
+
+    def applies(self, name: str) -> bool:
+        return fnmatch.fnmatchcase(name, self.match)
+
+
+class _Injector:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rules: list = []
+        self._rng = random.Random(0)
+        self._path: Optional[str] = None
+        self._mtime: float = 0.0
+        self._dynamic = False
+
+    def configure(self, config: Union[None, str, dict] = None):
+        """Load config from a dict, a path, or the env var."""
+        if config is None:
+            config = os.environ.get(ENV_CONFIG)
+            if config is None:
+                with self._lock:
+                    self._rules = []
+                    self._path = None
+                return
+        if isinstance(config, str):
+            path = config
+            with open(path) as f:
+                doc = json.load(f)
+            with self._lock:
+                self._path = path
+                self._mtime = os.path.getmtime(path)
+        else:
+            doc = config
+            with self._lock:
+                self._path = None
+        rules = [_Rule(r) for r in doc.get("faults", [])]
+        with self._lock:
+            self._rules = rules
+            self._rng = random.Random(doc.get("seed", 0))
+            self._dynamic = bool(doc.get("dynamic", False))
+
+    def _maybe_reload(self):
+        if not self._dynamic or self._path is None:
+            return
+        try:
+            mtime = os.path.getmtime(self._path)
+        except OSError:
+            return
+        if mtime != self._mtime:
+            self.configure(self._path)
+
+    def check(self, name: str):
+        """Called at each instrumented execution; raises if a rule fires."""
+        self._maybe_reload()
+        with self._lock:
+            for rule in self._rules:
+                if not rule.applies(name):
+                    continue
+                if rule.remaining is not None and rule.remaining <= 0:
+                    continue
+                if self._rng.random() >= rule.probability:
+                    continue
+                if rule.remaining is not None:
+                    rule.remaining -= 1
+                kind = rule.fault
+                break
+            else:
+                return
+        if kind == "oom":
+            from .mem import RetryOOM
+
+            raise RetryOOM(f"injected OOM at {name}")
+        if kind == "fatal":
+            raise FatalInjectedFault(f"injected fatal fault at {name}")
+        raise InjectedFault(f"injected exception at {name}")
+
+
+_injector = _Injector()
+configure = _injector.configure
+
+
+def instrument(fn, name: Optional[str] = None):
+    """Wrap an executable so the injector screens every invocation."""
+    label = name or getattr(fn, "__name__", "anonymous")
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        _injector.check(label)
+        return fn(*args, **kwargs)
+
+    wrapped.__faultinj_name__ = label
+    return wrapped

@@ -40,8 +40,11 @@ def _load_lib() -> ctypes.CDLL:
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH):
-            proc = subprocess.run(["make", "-C", _NATIVE_DIR],
+        src_path = os.path.join(_NATIVE_DIR, "parquet_footer.cpp")
+        stale = (not os.path.exists(_LIB_PATH)
+                 or os.path.getmtime(_LIB_PATH) < os.path.getmtime(src_path))
+        if stale:
+            proc = subprocess.run(["make", "-C", _NATIVE_DIR, "-B"],
                                   capture_output=True, text=True)
             if proc.returncode != 0:
                 raise RuntimeError(

@@ -14,6 +14,7 @@ surface (``RmmSpark.java``, ``SparkResourceAdaptor.java``,
   equivalents the query engine catches to roll back, spill, and retry.
 """
 
+from .executor import TaskContext, batch_nbytes, run_with_retry  # noqa: F401
 from .rmm_spark import (  # noqa: F401
     CpuRetryOOM,
     CpuSplitAndRetryOOM,

@@ -1,0 +1,130 @@
+"""Task-level execution against the memory arenas.
+
+The reference's plugin drives this contract around every GPU operator
+(``RmmSpark.java:402-416``): catch ``GpuRetryOOM`` → make inputs
+spillable → ``blockThreadUntilReady`` → retry; catch
+``GpuSplitAndRetryOOM`` → halve the input → retry.  This module makes the
+same contract a first-class, testable piece of the framework:
+
+* :class:`TaskContext` — registers the current thread for a task on the
+  installed arena(s), charges the arena for the batches a step
+  materializes, and releases on exit (the per-task HBM accounting of
+  SURVEY.md §2.6).
+* :func:`run_with_retry` — the rollback/split ladder as a function.
+* :func:`batch_nbytes` — HBM footprint of a ColumnBatch/pytree.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+import jax
+
+from .rmm_spark import (
+    CpuRetryOOM,
+    CpuSplitAndRetryOOM,
+    RetryOOM,
+    RmmSpark,
+    SplitAndRetryOOM,
+)
+
+
+def batch_nbytes(tree) -> int:
+    """Total device bytes of every array in a pytree (ColumnBatch etc.)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        size = getattr(leaf, "size", None)
+        dtype = getattr(leaf, "dtype", None)
+        if size is None or dtype is None:
+            continue
+        total += int(size) * jax.numpy.dtype(dtype).itemsize
+    return total
+
+
+class TaskContext:
+    """``with TaskContext(task_id): ...`` — register + charge + release.
+
+    ``charge(tree)`` draws the tree's byte footprint from the device
+    arena (raising the OOM ladder under pressure) and remembers it;
+    everything charged is released when the context exits, and the task's
+    thread association is dropped (``task_done`` is the caller's call —
+    a task spans many contexts across operators).
+    """
+
+    def __init__(self, task_id: int):
+        self.task_id = task_id
+        self._charged = 0
+        self._lock = threading.Lock()
+
+    def __enter__(self):
+        RmmSpark.current_thread_is_dedicated_to_task(self.task_id)
+        return self
+
+    def charge(self, tree_or_bytes) -> int:
+        n = (tree_or_bytes if isinstance(tree_or_bytes, int)
+             else batch_nbytes(tree_or_bytes))
+        RmmSpark.allocate(n)
+        with self._lock:
+            self._charged += n
+        return n
+
+    def release(self, nbytes: int):
+        RmmSpark.deallocate(nbytes)
+        with self._lock:
+            self._charged -= nbytes
+
+    def __exit__(self, *exc):
+        with self._lock:
+            leftover, self._charged = self._charged, 0
+        if leftover > 0:
+            RmmSpark.deallocate(leftover)
+        RmmSpark.remove_current_thread_association()
+        return False
+
+
+def run_with_retry(
+    step: Callable,
+    make_spillable: Optional[Callable[[], None]] = None,
+    split: Optional[Callable[[], None]] = None,
+    max_retries: int = 8,
+):
+    """Execute ``step()`` under the reference's rollback ladder.
+
+    * :class:`RetryOOM`: call ``make_spillable()`` (free/spill whatever the
+      caller can), park in ``block_thread_until_ready`` until the scheduler
+      releases this thread, then retry.
+    * :class:`SplitAndRetryOOM`: call ``split()`` (the caller halves its
+      input) and retry immediately — the scheduler guarantees this thread
+      is the only one running.
+
+    Raises the last error when the ladder is exhausted.
+    """
+    last = None
+    for _ in range(max_retries):
+        try:
+            return step()
+        except SplitAndRetryOOM as e:
+            last = e
+            if split is None:
+                raise
+            split()
+        except RetryOOM as e:
+            last = e
+            if make_spillable is not None:
+                make_spillable()
+            # park on the arena that raised: Cpu* flavors block on the
+            # host adaptor, device flavors on the device adaptor
+            block = (RmmSpark.cpu_block_thread_until_ready
+                     if isinstance(e, (CpuRetryOOM, CpuSplitAndRetryOOM))
+                     else RmmSpark.block_thread_until_ready)
+            try:
+                block()
+            except SplitAndRetryOOM as e2:
+                last = e2
+                if split is None:
+                    raise
+                split()
+            except RetryOOM as e2:
+                last = e2
+    raise last

@@ -113,9 +113,16 @@ def _load_lib() -> ctypes.CDLL:
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH):
-            subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                           capture_output=True)
+        src_path = os.path.join(_NATIVE_DIR, "resource_adaptor.cpp")
+        stale = (not os.path.exists(_LIB_PATH)
+                 or os.path.getmtime(_LIB_PATH) < os.path.getmtime(src_path))
+        if stale:
+            proc = subprocess.run(["make", "-C", _NATIVE_DIR, "-B"],
+                                  capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    "building libtpu_resource_adaptor.so failed:\n"
+                    + proc.stderr[-2000:])
         lib = ctypes.CDLL(_LIB_PATH)
         lib.tra_create.restype = ctypes.c_void_p
         lib.tra_create.argtypes = [ctypes.c_long, ctypes.c_char_p]
@@ -209,7 +216,11 @@ class SparkResourceAdaptor:
     ``poll_ms`` (reference SparkResourceAdaptor.java:35-79)."""
 
     def __init__(self, pool_bytes: int, log_path: Optional[str] = None,
-                 poll_ms: float = 100.0):
+                 poll_ms: Optional[float] = None):
+        if poll_ms is None:
+            from .. import config
+
+            poll_ms = config.get("watchdog_poll_ms")
         self._lib = _load_lib()
         self._h = self._lib.tra_create(
             ctypes.c_long(pool_bytes),
@@ -329,15 +340,27 @@ class SparkResourceAdaptor:
 # ---------------------------------------------------------------------------
 
 class RmmSpark:
-    """Static facade, one installed adaptor per process."""
+    """Static facade, one installed device adaptor (plus an optional host
+    arena — the reference's CPU-alloc hook mirror,
+    ``RmmSpark.java:601-664``) per process."""
 
     _adaptor: Optional[SparkResourceAdaptor] = None
+    _cpu_adaptor: Optional[SparkResourceAdaptor] = None
     _lock = threading.Lock()
 
     @classmethod
-    def set_event_handler(cls, pool_bytes: int, log_path=None,
-                          poll_ms: float = 100.0) -> SparkResourceAdaptor:
+    def set_event_handler(cls, pool_bytes: Optional[int] = None,
+                          log_path=None,
+                          poll_ms: Optional[float] = None
+                          ) -> SparkResourceAdaptor:
         """Install the adaptor (reference RmmSpark.setEventHandler)."""
+        if pool_bytes is None:
+            from .. import config
+
+            pool_bytes = config.get("mem_pool_bytes")
+            if pool_bytes <= 0:
+                raise ValueError(
+                    "pool_bytes not given and mem_pool_bytes config unset")
         with cls._lock:
             if cls._adaptor is not None:
                 raise RuntimeError("adaptor already installed")
@@ -345,11 +368,25 @@ class RmmSpark:
             return cls._adaptor
 
     @classmethod
+    def set_cpu_event_handler(cls, pool_bytes: int, log_path=None,
+                              poll_ms: float = 100.0) -> SparkResourceAdaptor:
+        """Install the HOST-memory arena (off-heap limit equivalent)."""
+        with cls._lock:
+            if cls._cpu_adaptor is not None:
+                raise RuntimeError("cpu adaptor already installed")
+            cls._cpu_adaptor = SparkResourceAdaptor(pool_bytes, log_path,
+                                                    poll_ms)
+            return cls._cpu_adaptor
+
+    @classmethod
     def clear_event_handler(cls):
         with cls._lock:
             if cls._adaptor is not None:
                 cls._adaptor.close()
                 cls._adaptor = None
+            if cls._cpu_adaptor is not None:
+                cls._cpu_adaptor.close()
+                cls._cpu_adaptor = None
 
     @classmethod
     def _a(cls) -> SparkResourceAdaptor:
@@ -358,30 +395,48 @@ class RmmSpark:
             raise RuntimeError("no adaptor installed; call set_event_handler")
         return a
 
-    # thread-role registration -----------------------------------------
+    @classmethod
+    def _c(cls) -> SparkResourceAdaptor:
+        a = cls._cpu_adaptor
+        if a is None:
+            raise RuntimeError(
+                "no cpu adaptor installed; call set_cpu_event_handler")
+        return a
+
+    @classmethod
+    def _each(cls):
+        return [a for a in (cls._adaptor, cls._cpu_adaptor) if a is not None]
+
+    # thread-role registration (applies to both arenas) -----------------
     @classmethod
     def current_thread_is_dedicated_to_task(cls, task_id: int):
-        cls._a().start_dedicated_task_thread(task_id)
+        for a in cls._each():
+            a.start_dedicated_task_thread(task_id)
 
     @classmethod
     def shuffle_thread_working_on_tasks(cls, task_ids: Sequence[int]):
-        cls._a().pool_thread_working_on_tasks(True, task_ids)
+        for a in cls._each():
+            a.pool_thread_working_on_tasks(True, task_ids)
 
     @classmethod
     def pool_thread_working_on_tasks(cls, task_ids: Sequence[int]):
-        cls._a().pool_thread_working_on_tasks(False, task_ids)
+        for a in cls._each():
+            a.pool_thread_working_on_tasks(False, task_ids)
 
     @classmethod
     def pool_thread_finished_for_tasks(cls, task_ids: Sequence[int]):
-        cls._a().pool_thread_finished_for_tasks(task_ids)
+        for a in cls._each():
+            a.pool_thread_finished_for_tasks(task_ids)
 
     @classmethod
     def remove_current_thread_association(cls):
-        cls._a().remove_thread_association()
+        for a in cls._each():
+            a.remove_thread_association()
 
     @classmethod
     def task_done(cls, task_id: int):
-        cls._a().task_done(task_id)
+        for a in cls._each():
+            a.task_done(task_id)
 
     # allocation --------------------------------------------------------
     @classmethod
@@ -391,6 +446,29 @@ class RmmSpark:
     @classmethod
     def deallocate(cls, nbytes: int):
         cls._a().deallocate(nbytes)
+
+    @classmethod
+    def cpu_allocate(cls, nbytes: int):
+        """Host-arena draw; raises the Cpu* OOM flavors."""
+        try:
+            cls._c().allocate(nbytes)
+        except SplitAndRetryOOM as e:
+            raise CpuSplitAndRetryOOM(*e.args) from None
+        except RetryOOM as e:
+            raise CpuRetryOOM(*e.args) from None
+
+    @classmethod
+    def cpu_deallocate(cls, nbytes: int):
+        cls._c().deallocate(nbytes)
+
+    @classmethod
+    def cpu_block_thread_until_ready(cls):
+        try:
+            cls._c().block_thread_until_ready()
+        except SplitAndRetryOOM as e:
+            raise CpuSplitAndRetryOOM(*e.args) from None
+        except RetryOOM as e:
+            raise CpuRetryOOM(*e.args) from None
 
     @classmethod
     def block_thread_until_ready(cls):

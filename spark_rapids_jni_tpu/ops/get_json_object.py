@@ -1231,6 +1231,10 @@ def get_json_object(
         raise ValueError(f"path deeper than {MAX_PATH}")
     L = col.max_len
     if max_out <= 0:
+        from .. import config
+
+        max_out = config.get("json_max_out")
+    if max_out <= 0:
         # provable worst case: every source byte expands to at most 6
         # output bytes (control char -> \u00XX in escaped style); floats
         # emit <= srclen+9; case-6 brackets add <=3 per '[' char

@@ -66,9 +66,14 @@ def distributed_group_by(
 
 
 def plan_exchange_capacity(batch, key_names, mesh, axis_name="data",
-                           row_valid=None, bucket: int = 256):
+                           row_valid=None, bucket: Optional[int] = None):
     """Host-side planning: the exact global max bucket size, rounded up to
-    ``bucket`` so repeated batches reuse one compiled exchange."""
+    ``bucket`` (default: the shuffle_capacity_bucket config knob) so
+    repeated batches reuse one compiled exchange."""
+    if bucket is None:
+        from .. import config
+
+        bucket = config.get("shuffle_capacity_bucket")
     plan = _plan_step(mesh, axis_name, tuple(key_names), row_valid is None)
     cmax = int(np.asarray(jax.device_get(
         plan(batch) if row_valid is None else plan(batch, row_valid)))[0])

@@ -1,0 +1,82 @@
+"""Execute-boundary fault injection (reference faultinj/ semantics)."""
+
+import json
+
+import pytest
+
+from spark_rapids_jni_tpu import faultinj
+from spark_rapids_jni_tpu.mem import RetryOOM
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    faultinj.configure({})
+
+
+def test_count_limited_exception():
+    faultinj.configure({"faults": [{"match": "*", "count": 2,
+                                    "fault": "exception"}]})
+    calls = []
+    f = faultinj.instrument(lambda x: calls.append(x) or x + 1, "k")
+    for _ in range(2):
+        with pytest.raises(faultinj.InjectedFault):
+            f(1)
+    assert f(1) == 2  # injection exhausted
+    assert calls == [1]
+
+
+def test_name_matching():
+    faultinj.configure({"faults": [{"match": "q6*", "fault": "fatal"}]})
+    ok = faultinj.instrument(lambda: "fine", "q95_step")
+    bad = faultinj.instrument(lambda: "boom", "q6_step")
+    assert ok() == "fine"
+    with pytest.raises(faultinj.FatalInjectedFault):
+        bad()
+
+
+def test_oom_flavor_raises_retryoom():
+    faultinj.configure({"faults": [{"match": "*", "count": 1,
+                                    "fault": "oom"}]})
+    f = faultinj.instrument(lambda: 1, "alloc_heavy")
+    with pytest.raises(RetryOOM):
+        f()
+    assert f() == 1
+
+
+def test_probability_seeded():
+    faultinj.configure({"seed": 7,
+                        "faults": [{"match": "*", "probability": 0.5,
+                                    "fault": "exception"}]})
+    f = faultinj.instrument(lambda: 1, "p")
+    outcomes = []
+    for _ in range(50):
+        try:
+            f()
+            outcomes.append(0)
+        except faultinj.InjectedFault:
+            outcomes.append(1)
+    assert 5 < sum(outcomes) < 45  # fires sometimes, not always
+
+
+def test_dynamic_reload(tmp_path):
+    cfg = tmp_path / "f.json"
+    cfg.write_text(json.dumps({"dynamic": True, "faults": []}))
+    faultinj.configure(str(cfg))
+    f = faultinj.instrument(lambda: 1, "r")
+    assert f() == 1
+    import os
+    import time
+
+    cfg.write_text(json.dumps(
+        {"dynamic": True,
+         "faults": [{"match": "*", "fault": "exception"}]}))
+    os.utime(cfg, (time.time() + 5, time.time() + 5))
+    with pytest.raises(faultinj.InjectedFault):
+        f()
+
+
+def test_no_config_is_noop():
+    faultinj.configure({})
+    f = faultinj.instrument(lambda: "ok")
+    assert f() == "ok"

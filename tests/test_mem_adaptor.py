@@ -249,7 +249,10 @@ class TestMonteCarlo:
     """Seeded oversubscription fuzz (RmmSparkMonteCarlo.java semantics:
     taskMax ~2048MiB vs pool 3072MiB, scaled down)."""
 
-    @pytest.mark.parametrize("seed", [11, 42])
+    @pytest.mark.parametrize(
+        "seed",
+        [int(s) for s in
+         __import__("os").environ.get("MEM_FUZZ_SEEDS", "11,42").split(",")])
     def test_oversubscribed_tasks_all_complete(self, seed):
         pool = 3 * MB
         task_max = 2 * MB
@@ -309,3 +312,84 @@ class TestMonteCarlo:
             f"retries={retries[0]}")
         assert not failures, failures
         assert adaptor._h is None
+
+
+class TestCpuArena:
+    def test_cpu_flavored_oom(self):
+        RmmSpark.set_event_handler(8 * MB)
+        RmmSpark.set_cpu_event_handler(1 * MB)
+        try:
+            RmmSpark.current_thread_is_dedicated_to_task(1)
+            RmmSpark.cpu_allocate(512 << 10)
+            RmmSpark.cpu_deallocate(512 << 10)
+            from spark_rapids_jni_tpu.mem import CpuRetryOOM
+
+            RmmSpark._c().force_retry_oom(None)
+            with pytest.raises(CpuRetryOOM):
+                RmmSpark.cpu_allocate(1)
+        finally:
+            RmmSpark.clear_event_handler()
+
+
+class TestTransitionLog:
+    def test_csv_state_log_written(self, tmp_path):
+        """The spdlog-CSV analogue (reference :897-933): the race-hunting
+        transition log records alloc state changes."""
+        log = str(tmp_path / "transitions.csv")
+        a = SparkResourceAdaptor(MB, log_path=log, poll_ms=50.0)
+        try:
+            t = TaskThread(a, 1)
+            t.do(lambda: a.allocate(1024, tid=t.tid))
+            assert t.expect()[0] == "ok"
+            t.do(lambda: a.deallocate(1024, tid=t.tid))
+            assert t.expect()[0] == "ok"
+            t.finish()
+        finally:
+            a.close()
+        with open(log) as f:
+            lines = f.read().splitlines()
+        assert lines[0].startswith("time_ns,op,")
+        assert any("alloc_ok" in ln for ln in lines)
+
+
+class TestExecutor:
+    def test_task_context_charges_and_releases(self):
+        import jax.numpy as jnp
+
+        from spark_rapids_jni_tpu.mem.executor import TaskContext, batch_nbytes
+
+        RmmSpark.set_event_handler(64 * MB)
+        try:
+            tree = {"a": jnp.zeros((1024,), jnp.int32)}
+            n = batch_nbytes(tree)
+            assert n == 4096
+            with TaskContext(1) as ctx:
+                ctx.charge(tree)
+                assert RmmSpark._a().total_allocated() == n
+            assert RmmSpark._a().total_allocated() == 0
+        finally:
+            RmmSpark.clear_event_handler()
+
+    def test_run_with_retry_ladder(self):
+        from spark_rapids_jni_tpu.mem.executor import TaskContext, run_with_retry
+
+        RmmSpark.set_event_handler(64 * MB)
+        try:
+            with TaskContext(1):
+                a = RmmSpark._a()
+                a.force_retry_oom(None, num_ooms=1)
+                a.force_split_and_retry_oom(None, num_ooms=1, skip_count=1)
+                spilled = []
+                halved = []
+
+                def step():
+                    RmmSpark.allocate(1024)
+                    RmmSpark.deallocate(1024)
+                    return "done"
+
+                out = run_with_retry(step, make_spillable=lambda: spilled.append(1),
+                                     split=lambda: halved.append(1))
+                assert out == "done"
+                assert spilled and halved
+        finally:
+            RmmSpark.clear_event_handler()

@@ -518,3 +518,27 @@ class TestReviewRegressions2:
         out, ng = group_by(b, ["k"], [AggSpec("sum", "v", "s")])
         got = trimmed(out, ng)["s"]
         assert got[1] == 1.0
+
+
+class TestQueryShapes:
+    """The BASELINE.md pipeline shapes compile and produce sane results."""
+
+    def test_q3_shape(self):
+        import __graft_entry__ as ge
+        import jax
+
+        fact, dim = ge._q3_batches(512)
+        res, ng = jax.jit(ge._q3_step)(fact, dim)
+        assert 1 <= int(ng) <= 5
+        got = trimmed(res, ng)
+        assert sum(got["cnt"]) == 512  # every fact row joins exactly once
+
+    def test_q67_shape(self):
+        import __graft_entry__ as ge
+        import jax
+
+        b = ge._q67_batch(512)
+        out = jax.jit(ge._q67_step)(b)
+        d = out.to_pydict()
+        live = [r for r, v in zip(d["rk"], d["cat"]) if v is not None]
+        assert live and max(live) <= 100
