@@ -102,6 +102,30 @@ def array_to_column(arr):
     if isinstance(arr, pa.ChunkedArray):
         arr = arr.combine_chunks()
     t = arr.type
+    if pa.types.is_list(t) or pa.types.is_large_list(t):
+        from .column import ListColumn
+
+        n = len(arr)
+        valid = unpack_bitmask(arr.buffers()[0], arr.offset, n)
+        offsets = np.asarray(arr.offsets)[: n + 1].astype(np.int32)
+        # normalize to a zero base so the child slice starts at 0
+        base = offsets[0]
+        child = arr.values.slice(base, offsets[-1] - base)
+        return ListColumn(
+            jnp.asarray(offsets - base),
+            array_to_column(child),
+            jnp.asarray(valid),
+        )
+    if pa.types.is_struct(t):
+        from .column import StructColumn
+
+        n = len(arr)
+        valid = unpack_bitmask(arr.buffers()[0], arr.offset, n)
+        fields = {
+            t.field(i).name: array_to_column(arr.field(i))
+            for i in range(t.num_fields)
+        }
+        return StructColumn(fields, jnp.asarray(valid))
     if pa.types.is_string(t) or pa.types.is_large_string(t):
         return _string_array_to_column(arr)
     if pa.types.is_decimal128(t) or pa.types.is_decimal(t):
@@ -138,6 +162,23 @@ def from_arrow(table: pa.Table) -> ColumnBatch:
 
 
 def _column_to_array(col) -> pa.Array:
+    from .column import ListColumn, StructColumn
+
+    if isinstance(col, ListColumn):
+        child = _column_to_array(col.child)
+        offsets = np.asarray(jax.device_get(col.offsets))
+        valid = np.asarray(jax.device_get(col.validity))
+        pa_offsets = pa.array(
+            [None if not valid[i] else int(offsets[i])
+             for i in range(len(valid))] + [int(offsets[-1])],
+            type=pa.int32())
+        return pa.ListArray.from_arrays(pa_offsets, child)
+    if isinstance(col, StructColumn):
+        children = [_column_to_array(c) for c in col.children]
+        valid = np.asarray(jax.device_get(col.validity))
+        return pa.StructArray.from_arrays(
+            children, names=list(col.field_names),
+            mask=pa.array(~valid))
     if isinstance(col, StringColumn):
         chars = np.asarray(jax.device_get(col.chars))
         lengths = np.asarray(jax.device_get(col.lengths))

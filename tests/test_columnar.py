@@ -181,3 +181,50 @@ class TestArrowInterop:
         packed = pack_bitmask(valid)
         buf = pa.py_buffer(packed)
         np.testing.assert_array_equal(unpack_bitmask(buf, 0, 10), valid)
+
+
+class TestNestedArrow:
+    def test_list_roundtrip(self):
+        import pyarrow as pa
+
+        from spark_rapids_jni_tpu.columnar.arrow import array_to_column, _column_to_array
+
+        arr = pa.array([[1, 2], None, [], [3]], pa.list_(pa.int32()))
+        col = array_to_column(arr)
+        assert col.to_pylist() == [[1, 2], None, [], [3]]
+        back = _column_to_array(col)
+        assert back.to_pylist() == [[1, 2], None, [], [3]]
+
+    def test_struct_roundtrip(self):
+        import pyarrow as pa
+
+        from spark_rapids_jni_tpu.columnar.arrow import array_to_column, _column_to_array
+
+        arr = pa.array([{"a": 1, "s": "x"}, None, {"a": 3, "s": None}],
+                       pa.struct([("a", pa.int32()), ("s", pa.string())]))
+        col = array_to_column(arr)
+        assert col.to_pylist() == [{"a": 1, "s": "x"}, None,
+                                   {"a": 3, "s": None}]
+        back = _column_to_array(col)
+        assert back.to_pylist() == [{"a": 1, "s": "x"},
+                                    None, {"a": 3, "s": None}]
+
+    def test_list_of_struct(self):
+        import pyarrow as pa
+
+        from spark_rapids_jni_tpu.columnar.arrow import array_to_column
+
+        arr = pa.array([[{"k": "a", "v": 1}], [], None],
+                       pa.list_(pa.struct([("k", pa.string()),
+                                           ("v", pa.int64())])))
+        col = array_to_column(arr)
+        assert col.to_pylist() == [[{"k": "a", "v": 1}], [], None]
+
+    def test_sliced_list_array(self):
+        import pyarrow as pa
+
+        from spark_rapids_jni_tpu.columnar.arrow import array_to_column
+
+        arr = pa.array([[9], [1, 2], [3]], pa.list_(pa.int32())).slice(1, 2)
+        col = array_to_column(arr)
+        assert col.to_pylist() == [[1, 2], [3]]
