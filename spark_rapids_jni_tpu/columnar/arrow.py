@@ -107,12 +107,27 @@ def array_to_column(arr):
 
         n = len(arr)
         valid = unpack_bitmask(arr.buffers()[0], arr.offset, n)
-        offsets = np.asarray(arr.offsets)[: n + 1].astype(np.int32)
-        # normalize to a zero base so the child slice starts at 0
-        base = offsets[0]
-        child = arr.values.slice(base, offsets[-1] - base)
+        # rebase in int64 first: sliced large_lists can carry absolute
+        # offsets past 2^31 even when the extents themselves fit int32
+        offsets64 = np.asarray(arr.offsets)[: n + 1].astype(np.int64)
+        base = offsets64[0]
+        child = arr.values.slice(base, offsets64[-1] - base)
+        offsets = (offsets64 - base).astype(np.int32)
+        # Arrow allows null rows to span non-empty extents (post-IPC /
+        # concatenation); ListColumn's invariant is offsets[i]==offsets[i+1]
+        # for null rows (hash folds rely on it) — repack when violated
+        lens = np.diff(offsets)
+        if np.any(~valid & (lens > 0)):
+            keep_lens = np.where(valid, lens, 0)
+            take = np.concatenate(
+                [np.arange(offsets[i], offsets[i] + keep_lens[i])
+                 for i in range(n)] or [np.array([], np.int64)]
+            ).astype(np.int64)
+            child = child.take(pa.array(take))
+            offsets = np.concatenate(
+                [[0], np.cumsum(keep_lens)]).astype(np.int32)
         return ListColumn(
-            jnp.asarray(offsets - base),
+            jnp.asarray(offsets),
             array_to_column(child),
             jnp.asarray(valid),
         )
@@ -168,11 +183,11 @@ def _column_to_array(col) -> pa.Array:
         child = _column_to_array(col.child)
         offsets = np.asarray(jax.device_get(col.offsets))
         valid = np.asarray(jax.device_get(col.validity))
-        pa_offsets = pa.array(
-            [None if not valid[i] else int(offsets[i])
-             for i in range(len(valid))] + [int(offsets[-1])],
-            type=pa.int32())
-        return pa.ListArray.from_arrays(pa_offsets, child)
+        # a null-offsets encoding would make pyarrow extend the PRECEDING
+        # row through the null slot's extent; the mask keeps extents exact
+        return pa.ListArray.from_arrays(
+            pa.array(offsets.astype(np.int32), type=pa.int32()), child,
+            mask=pa.array(~valid))
     if isinstance(col, StructColumn):
         children = [_column_to_array(c) for c in col.children]
         valid = np.asarray(jax.device_get(col.validity))

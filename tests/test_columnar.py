@@ -228,3 +228,30 @@ class TestNestedArrow:
         arr = pa.array([[9], [1, 2], [3]], pa.list_(pa.int32())).slice(1, 2)
         col = array_to_column(arr)
         assert col.to_pylist() == [[1, 2], [3]]
+
+    def test_null_row_with_nonempty_extent(self):
+        """Spec-legal Arrow: a null list slot spanning child elements must
+        neither leak into neighbors on export nor violate the ListColumn
+        empty-null invariant on ingest (review regression)."""
+        import numpy as np
+        import pyarrow as pa
+
+        from spark_rapids_jni_tpu.columnar.arrow import (
+            _column_to_array,
+            array_to_column,
+        )
+
+        values = pa.array([1, 2, 3, 4, 5], pa.int32())
+        offsets = pa.array([0, 2, 4, 5], pa.int32())
+        arr = pa.ListArray.from_arrays(offsets, values)
+        # null out row 1 while keeping its non-empty extent
+        buffers = arr.buffers()
+        validity = pa.py_buffer(bytes([0b101]))
+        arr = pa.ListArray.from_buffers(
+            arr.type, 3, [validity, buffers[1]], children=[values])
+        col = array_to_column(arr)
+        assert col.to_pylist() == [[1, 2], None, [5]]
+        offs = np.asarray(col.offsets)
+        assert offs[1] == offs[2]  # null row canonicalized to empty
+        back = _column_to_array(col)
+        assert back.to_pylist() == [[1, 2], None, [5]]
