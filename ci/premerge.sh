@@ -9,6 +9,7 @@ cd "$(dirname "$0")/.."
 
 make -C spark_rapids_jni_tpu/mem/native
 make -C spark_rapids_jni_tpu/io/native
+make -C jni
 
 python -m pytest tests/ -x -q
 

@@ -1,0 +1,83 @@
+/*
+ * TPU-native rebuild of the spark-rapids-jni surface.
+ * Licensed under the Apache License, Version 2.0.
+ */
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Spark BloomFilterImpl-compatible bloom filter (reference
+ * BloomFilter.java:42-95; byte-parity build/merge/probe/serialize in
+ * ops/bloom_filter.py, big-endian word layout bloom_filter.cu:46-60).
+ *
+ * The reference passes filters as cudf Scalars; here a filter is its own
+ * handle type with the same operation set.
+ */
+public class BloomFilter implements AutoCloseable {
+  private long handle;
+
+  BloomFilter(long handle) {
+    this.handle = handle;
+  }
+
+  static {
+    NativeDepsLoader.loadNativeDeps();
+  }
+
+  private long view() {
+    if (handle == 0) {
+      throw new IllegalStateException("bloom filter is closed");
+    }
+    return handle;
+  }
+
+  public static BloomFilter create(int numHashes, long bloomFilterBits) {
+    return new BloomFilter(Bridge.invokeOne("BloomFilter.create",
+        "{\"num_hashes\":" + numHashes + ",\"bits\":" + bloomFilterBits + "}"));
+  }
+
+  /** Adds the non-null rows of cv (xxhash64 double-hashing, reference
+   * bloom_filter.cu:63-87). */
+  public void put(TpuColumnVector cv) {
+    long next = Bridge.invokeOne("BloomFilter.put", "{}", view(),
+        cv.getNativeView());
+    Bridge.release(handle);
+    handle = next;
+  }
+
+  public static BloomFilter merge(BloomFilter... filters) {
+    long[] handles = new long[filters.length];
+    for (int i = 0; i < filters.length; i++) {
+      handles[i] = filters[i].view();
+    }
+    return new BloomFilter(Bridge.invokeOne("BloomFilter.merge", "{}", handles));
+  }
+
+  public TpuColumnVector probe(TpuColumnVector cv) {
+    return new TpuColumnVector(Bridge.invokeOne("BloomFilter.probe", "{}",
+        view(), cv.getNativeView()));
+  }
+
+  /** Spark-serialized form, interchangeable with BloomFilterImpl. */
+  public byte[] serialize() {
+    Bridge.invoke("BloomFilter.serialize", "{}", new long[]{view()});
+    String json = Bridge.lastInvokeJson();
+    int i = json.indexOf("\"data\"");
+    int a = json.indexOf('"', i + 6 + 1) + 1;
+    int b = json.indexOf('"', a);
+    return java.util.Base64.getDecoder().decode(json.substring(a, b));
+  }
+
+  public static BloomFilter deserialize(byte[] data) {
+    return new BloomFilter(Bridge.invokeOne("BloomFilter.deserialize",
+        "{\"data\":" + Bridge.quote(
+            java.util.Base64.getEncoder().encodeToString(data)) + "}"));
+  }
+
+  @Override
+  public void close() {
+    if (handle != 0) {
+      Bridge.release(handle);
+      handle = 0;
+    }
+  }
+}

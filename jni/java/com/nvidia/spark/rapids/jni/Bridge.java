@@ -1,0 +1,76 @@
+/*
+ * TPU-native rebuild of the spark-rapids-jni surface.
+ * Licensed under the Apache License, Version 2.0.
+ */
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Package-private native entry points into the TPU bridge
+ * (jni/src/jni_glue.cpp over jni/src/bridge.h).  Every mirror class's
+ * static methods funnel through {@link #invoke}; per-op marshaling lives
+ * in the Python dispatcher (spark_rapids_jni_tpu/jni_bridge.py).  This
+ * replaces the reference's 15 per-class *Jni.cpp marshaling files.
+ */
+final class Bridge {
+  static {
+    NativeDepsLoader.loadNativeDeps();
+  }
+
+  private Bridge() {}
+
+  /** Host column image crossing the boundary (Arrow-style buffers). */
+  static final class HostColumn {
+    String kind;
+    long rows;
+    byte[] data;
+    byte[] validity; // one byte per row
+    int[] offsets;   // strings only, else null
+    int precision;
+    int scale;
+  }
+
+  static native long columnFromHost(String kind, long rows, byte[] data,
+      byte[] validity, int precision, int scale);
+
+  static native long stringColumnFromHost(byte[] chars, int[] offsets,
+      byte[] validity, long rows);
+
+  static native HostColumn columnToHost(long handle);
+
+  static native long numRows(long handle);
+
+  static native void release(long handle);
+
+  /**
+   * Generic op dispatch; returns result handles.  Errors surface as the
+   * mapped Java exception family (CastException, GpuRetryOOM, ...).
+   */
+  static native long[] invoke(String op, String argsJson, long[] handles);
+
+  /** Metadata JSON produced by the most recent invoke on this thread. */
+  static native String lastInvokeJson();
+
+  static long invokeOne(String op, String argsJson, long... handles) {
+    long[] out = invoke(op, argsJson, handles);
+    if (out.length != 1) {
+      throw new IllegalStateException(op + " returned " + out.length
+          + " results, expected 1");
+    }
+    return out[0];
+  }
+
+  static String quote(String s) {
+    StringBuilder sb = new StringBuilder("\"");
+    for (int i = 0; i < s.length(); i++) {
+      char c = s.charAt(i);
+      if (c == '"' || c == '\\') {
+        sb.append('\\').append(c);
+      } else if (c < 0x20) {
+        sb.append(String.format("\\u%04x", (int) c));
+      } else {
+        sb.append(c);
+      }
+    }
+    return sb.append('"').toString();
+  }
+}

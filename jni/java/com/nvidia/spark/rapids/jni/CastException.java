@@ -1,0 +1,28 @@
+/*
+ * TPU-native rebuild of the spark-rapids-jni surface.
+ * Licensed under the Apache License, Version 2.0.
+ */
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * ANSI-mode cast failure carrying the first offending row
+ * (reference CastException.java / cast_string.hpp:28-58).
+ */
+public class CastException extends RuntimeException {
+  private final String stringWithError;
+  private final int rowWithError;
+
+  public CastException(String stringWithError, int rowWithError) {
+    super("Error casting data on row " + rowWithError + ": " + stringWithError);
+    this.stringWithError = stringWithError;
+    this.rowWithError = rowWithError;
+  }
+
+  public String getStringWithError() {
+    return stringWithError;
+  }
+
+  public int getRowWithError() {
+    return rowWithError;
+  }
+}

@@ -1,0 +1,76 @@
+/*
+ * TPU-native rebuild of the spark-rapids-jni surface.
+ * Licensed under the Apache License, Version 2.0.
+ */
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Spark-exact string casts (reference CastStrings.java:36-165; kernels
+ * ops/cast_string.py, ops/float_to_string.py, ops/decimal_to_string.py).
+ */
+public class CastStrings {
+  static {
+    NativeDepsLoader.loadNativeDeps();
+  }
+
+  public static TpuColumnVector toInteger(TpuColumnVector cv, boolean ansiMode, DType type) {
+    return toInteger(cv, ansiMode, true, type);
+  }
+
+  public static TpuColumnVector toInteger(TpuColumnVector cv, boolean ansiMode, boolean strip,
+      DType type) {
+    return new TpuColumnVector(Bridge.invokeOne("CastStrings.toInteger",
+        "{\"ansi\":" + ansiMode + ",\"strip\":" + strip + ",\"kind\":\""
+            + type.bridgeKind() + "\"}",
+        cv.getNativeView()));
+  }
+
+  public static TpuColumnVector toDecimal(TpuColumnVector cv, boolean ansiMode, int precision,
+      int scale) {
+    return toDecimal(cv, ansiMode, true, precision, scale);
+  }
+
+  public static TpuColumnVector toDecimal(TpuColumnVector cv, boolean ansiMode, boolean strip,
+      int precision, int scale) {
+    return new TpuColumnVector(Bridge.invokeOne("CastStrings.toDecimal",
+        "{\"ansi\":" + ansiMode + ",\"strip\":" + strip + ",\"precision\":" + precision
+            + ",\"scale\":" + scale + "}",
+        cv.getNativeView()));
+  }
+
+  public static TpuColumnVector toFloat(TpuColumnVector cv, boolean ansiMode, DType type) {
+    return new TpuColumnVector(Bridge.invokeOne("CastStrings.toFloat",
+        "{\"ansi\":" + ansiMode + ",\"kind\":\"" + type.bridgeKind() + "\"}",
+        cv.getNativeView()));
+  }
+
+  public static TpuColumnVector fromFloat(TpuColumnVector cv) {
+    return new TpuColumnVector(
+        Bridge.invokeOne("CastStrings.fromFloat", "{}", cv.getNativeView()));
+  }
+
+  public static TpuColumnVector fromFloatWithFormat(TpuColumnVector cv, int digits) {
+    return new TpuColumnVector(Bridge.invokeOne("CastStrings.fromFloatWithFormat",
+        "{\"digits\":" + digits + "}", cv.getNativeView()));
+  }
+
+  public static TpuColumnVector fromDecimal(TpuColumnVector cv) {
+    return new TpuColumnVector(
+        Bridge.invokeOne("CastStrings.fromDecimal", "{}", cv.getNativeView()));
+  }
+
+  /** Spark conv(): parse with base 10 or 16 (reference CastStrings.java:127). */
+  public static TpuColumnVector toIntegersWithBase(TpuColumnVector cv, int base,
+      boolean ansiEnabled, DType type) {
+    return new TpuColumnVector(Bridge.invokeOne("CastStrings.toIntegersWithBase",
+        "{\"base\":" + base + ",\"ansi\":" + ansiEnabled + ",\"kind\":\""
+            + type.bridgeKind() + "\"}",
+        cv.getNativeView()));
+  }
+
+  /** Spark conv(): format in base 10 or 16 (reference CastStrings.java:151). */
+  public static TpuColumnVector fromIntegersWithBase(TpuColumnVector cv, int base) {
+    return new TpuColumnVector(Bridge.invokeOne("CastStrings.fromIntegersWithBase",
+        "{\"base\":" + base + "}", cv.getNativeView()));
+  }
+}

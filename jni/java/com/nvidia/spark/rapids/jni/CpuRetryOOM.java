@@ -1,0 +1,16 @@
+/*
+ * TPU-native rebuild of the spark-rapids-jni surface.
+ * Licensed under the Apache License, Version 2.0.
+ */
+package com.nvidia.spark.rapids.jni;
+
+/** Host-memory retry OOM (reference CpuRetryOOM.java). */
+public class CpuRetryOOM extends OffHeapOOM {
+  public CpuRetryOOM() {
+    super();
+  }
+
+  public CpuRetryOOM(String message) {
+    super(message);
+  }
+}

@@ -1,0 +1,16 @@
+/*
+ * TPU-native rebuild of the spark-rapids-jni surface.
+ * Licensed under the Apache License, Version 2.0.
+ */
+package com.nvidia.spark.rapids.jni;
+
+/** Host-memory split-and-retry OOM (reference CpuSplitAndRetryOOM.java). */
+public class CpuSplitAndRetryOOM extends OffHeapOOM {
+  public CpuSplitAndRetryOOM() {
+    super();
+  }
+
+  public CpuSplitAndRetryOOM(String message) {
+    super(message);
+  }
+}

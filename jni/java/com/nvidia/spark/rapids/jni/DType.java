@@ -1,0 +1,37 @@
+/*
+ * TPU-native rebuild of the spark-rapids-jni surface.
+ * Licensed under the Apache License, Version 2.0.
+ */
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Logical column types of the TPU surface (role of ai.rapids.cudf.DType
+ * in the reference signatures, e.g. CastStrings.java:36).  The bridge
+ * kind strings match spark_rapids_jni_tpu.columnar.types.Kind; UINT64 is
+ * the conv()-cast bit-pattern type (stored as 64 signed bits,
+ * ops/cast_string.py string_to_integer_with_base).
+ */
+public enum DType {
+  BOOL8("boolean"),
+  INT8("int8"),
+  INT16("int16"),
+  INT32("int32"),
+  INT64("int64"),
+  UINT64("uint64"),
+  FLOAT32("float32"),
+  FLOAT64("float64"),
+  STRING("string"),
+  TIMESTAMP_DAYS("date"),
+  TIMESTAMP_MICROSECONDS("timestamp"),
+  DECIMAL128("decimal");
+
+  private final String bridgeKind;
+
+  DType(String bridgeKind) {
+    this.bridgeKind = bridgeKind;
+  }
+
+  String bridgeKind() {
+    return bridgeKind;
+  }
+}

@@ -1,0 +1,26 @@
+/*
+ * TPU-native rebuild of the spark-rapids-jni surface.
+ * Licensed under the Apache License, Version 2.0.
+ */
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Proleptic-Gregorian <-> hybrid-Julian calendar rebase (reference
+ * DateTimeRebase.java:38-51; kernel ops/datetime_rebase.py mirroring
+ * datetime_rebase.cu:58-373).
+ */
+public class DateTimeRebase {
+  static {
+    NativeDepsLoader.loadNativeDeps();
+  }
+
+  public static TpuColumnVector rebaseGregorianToJulian(TpuColumnVector input) {
+    return new TpuColumnVector(Bridge.invokeOne(
+        "DateTimeRebase.rebaseGregorianToJulian", "{}", input.getNativeView()));
+  }
+
+  public static TpuColumnVector rebaseJulianToGregorian(TpuColumnVector input) {
+    return new TpuColumnVector(Bridge.invokeOne(
+        "DateTimeRebase.rebaseJulianToGregorian", "{}", input.getNativeView()));
+  }
+}

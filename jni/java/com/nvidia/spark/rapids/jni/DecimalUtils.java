@@ -1,0 +1,50 @@
+/*
+ * TPU-native rebuild of the spark-rapids-jni surface.
+ * Licensed under the Apache License, Version 2.0.
+ */
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * DECIMAL128 arithmetic with 256-bit intermediates (reference
+ * DecimalUtils.java:46-172; kernels ops/decimal.py, which preserve the
+ * known Spark multiply rounding bug — DecimalUtils.java:33-37).
+ * Each op returns a two-column table: (overflow BOOL8, result DECIMAL128),
+ * matching the reference's Table contract.
+ */
+public class DecimalUtils {
+  static {
+    NativeDepsLoader.loadNativeDeps();
+  }
+
+  private static TpuTable binop(String op, TpuColumnVector a, TpuColumnVector b,
+      int scale) {
+    long[] out = Bridge.invoke("DecimalUtils." + op,
+        "{\"scale\":" + scale + "}",
+        new long[]{a.getNativeView(), b.getNativeView()});
+    return new TpuTable(new TpuColumnVector(out[0]), new TpuColumnVector(out[1]));
+  }
+
+  public static TpuTable multiply128(TpuColumnVector a, TpuColumnVector b, int productScale) {
+    return binop("multiply128", a, b, productScale);
+  }
+
+  public static TpuTable divide128(TpuColumnVector a, TpuColumnVector b, int quotientScale) {
+    return binop("divide128", a, b, quotientScale);
+  }
+
+  public static TpuTable integerDivide128(TpuColumnVector a, TpuColumnVector b) {
+    return binop("integerDivide128", a, b, 0);
+  }
+
+  public static TpuTable remainder128(TpuColumnVector a, TpuColumnVector b, int remainderScale) {
+    return binop("remainder128", a, b, remainderScale);
+  }
+
+  public static TpuTable add128(TpuColumnVector a, TpuColumnVector b, int targetScale) {
+    return binop("add128", a, b, targetScale);
+  }
+
+  public static TpuTable subtract128(TpuColumnVector a, TpuColumnVector b, int targetScale) {
+    return binop("subtract128", a, b, targetScale);
+  }
+}

@@ -1,0 +1,16 @@
+/*
+ * TPU-native rebuild of the spark-rapids-jni surface.
+ * Licensed under the Apache License, Version 2.0.
+ */
+package com.nvidia.spark.rapids.jni;
+
+/** Hard device OOM - the retry ladder is exhausted (reference GpuOOM.java). */
+public class GpuOOM extends RuntimeException {
+  public GpuOOM() {
+    super();
+  }
+
+  public GpuOOM(String message) {
+    super(message);
+  }
+}

@@ -1,0 +1,16 @@
+/*
+ * TPU-native rebuild of the spark-rapids-jni surface.
+ * Licensed under the Apache License, Version 2.0.
+ */
+package com.nvidia.spark.rapids.jni;
+
+/** Roll back, make inputs spillable, block until ready, retry (reference GpuRetryOOM.java). */
+public class GpuRetryOOM extends GpuOOM {
+  public GpuRetryOOM() {
+    super();
+  }
+
+  public GpuRetryOOM(String message) {
+    super(message);
+  }
+}

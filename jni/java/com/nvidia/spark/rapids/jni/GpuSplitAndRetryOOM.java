@@ -1,0 +1,16 @@
+/*
+ * TPU-native rebuild of the spark-rapids-jni surface.
+ * Licensed under the Apache License, Version 2.0.
+ */
+package com.nvidia.spark.rapids.jni;
+
+/** Like GpuRetryOOM but the input must also be split (reference GpuSplitAndRetryOOM.java). */
+public class GpuSplitAndRetryOOM extends GpuOOM {
+  public GpuSplitAndRetryOOM() {
+    super();
+  }
+
+  public GpuSplitAndRetryOOM(String message) {
+    super(message);
+  }
+}

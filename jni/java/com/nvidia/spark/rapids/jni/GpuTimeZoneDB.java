@@ -1,0 +1,57 @@
+/*
+ * TPU-native rebuild of the spark-rapids-jni surface.
+ * Licensed under the Apache License, Version 2.0.
+ */
+package com.nvidia.spark.rapids.jni;
+
+import java.time.ZoneId;
+
+/**
+ * Timezone conversion database (reference GpuTimeZoneDB.java:52-251).
+ * The reference lazily loads the JVM tz database into a device
+ * LIST&lt;STRUCT&gt; transitions table; here the runtime loads IANA TZif
+ * files directly (ops/timezones.py TimeZoneDB) so cache calls are cheap
+ * idempotent no-ops kept for API parity.  Same non-DST zone support
+ * scope as the reference (:237-247).
+ */
+public class GpuTimeZoneDB {
+  static {
+    NativeDepsLoader.loadNativeDeps();
+  }
+
+  public static void cacheDatabaseAsync() {}
+
+  public static void cacheDatabase() {}
+
+  public static void shutdown() {}
+
+  public static TpuColumnVector fromTimestampToUtcTimestamp(TpuColumnVector input,
+      ZoneId currentTimeZone) {
+    return new TpuColumnVector(Bridge.invokeOne(
+        "GpuTimeZoneDB.fromTimestampToUtcTimestamp",
+        "{\"zone\":" + Bridge.quote(currentTimeZone.getId()) + "}",
+        input.getNativeView()));
+  }
+
+  public static TpuColumnVector fromUtcTimestampToTimestamp(TpuColumnVector input,
+      ZoneId desiredTimeZone) {
+    return new TpuColumnVector(Bridge.invokeOne(
+        "GpuTimeZoneDB.fromUtcTimestampToTimestamp",
+        "{\"zone\":" + Bridge.quote(desiredTimeZone.getId()) + "}",
+        input.getNativeView()));
+  }
+
+  public static boolean isSupportedTimeZone(ZoneId desiredTimeZone) {
+    return isSupportedTimeZone(desiredTimeZone.getId());
+  }
+
+  public static boolean isSupportedTimeZone(String zoneId) {
+    Bridge.invoke("GpuTimeZoneDB.isSupportedTimeZone",
+        "{\"zone\":" + Bridge.quote(zoneId) + "}", new long[0]);
+    return Bridge.lastInvokeJson().contains("true");
+  }
+
+  public static ZoneId getZoneId(String timeZoneId) {
+    return ZoneId.of(timeZoneId.trim());
+  }
+}

@@ -1,0 +1,45 @@
+/*
+ * TPU-native rebuild of the spark-rapids-jni surface.
+ * Licensed under the Apache License, Version 2.0.
+ */
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Spark-exact row hashes (reference Hash.java:26-84; kernels
+ * ops/hashing.py incl. nested list/struct folds).
+ */
+public class Hash {
+  /** Spark's default seed (reference Hash.java:26). */
+  public static final int DEFAULT_HASH_SEED = 42;
+  public static final long DEFAULT_XXHASH64_SEED = 42;
+
+  static {
+    NativeDepsLoader.loadNativeDeps();
+  }
+
+  public static TpuColumnVector murmurHash32(int seed, TpuColumnVector[] columns) {
+    long[] handles = new long[columns.length];
+    for (int i = 0; i < columns.length; i++) {
+      handles[i] = columns[i].getNativeView();
+    }
+    return new TpuColumnVector(
+        Bridge.invokeOne("Hash.murmurHash32", "{\"seed\":" + seed + "}", handles));
+  }
+
+  public static TpuColumnVector murmurHash32(TpuColumnVector[] columns) {
+    return murmurHash32(DEFAULT_HASH_SEED, columns);
+  }
+
+  public static TpuColumnVector xxhash64(long seed, TpuColumnVector[] columns) {
+    long[] handles = new long[columns.length];
+    for (int i = 0; i < columns.length; i++) {
+      handles[i] = columns[i].getNativeView();
+    }
+    return new TpuColumnVector(
+        Bridge.invokeOne("Hash.xxhash64", "{\"seed\":" + seed + "}", handles));
+  }
+
+  public static TpuColumnVector xxhash64(TpuColumnVector[] columns) {
+    return xxhash64(DEFAULT_XXHASH64_SEED, columns);
+  }
+}

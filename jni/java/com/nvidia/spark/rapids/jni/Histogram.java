@@ -1,0 +1,40 @@
+/*
+ * TPU-native rebuild of the spark-rapids-jni surface.
+ * Licensed under the Apache License, Version 2.0.
+ */
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * percentile_approx support: exact percentiles from (value, frequency)
+ * histograms (reference Histogram.java:47-64; kernel ops/histogram.py
+ * mirroring histogram.cu:283,429).
+ */
+public class Histogram {
+  static {
+    NativeDepsLoader.loadNativeDeps();
+  }
+
+  /** Validate + pack; returns (values, frequencies) with invalid entries
+   * nulled (reference histogram.cu:283). */
+  public static TpuTable createHistogramIfValid(TpuColumnVector values,
+      TpuColumnVector frequencies) {
+    long[] out = Bridge.invoke("Histogram.createHistogramIfValid", "{}",
+        new long[]{values.getNativeView(), frequencies.getNativeView()});
+    return new TpuTable(new TpuColumnVector(out[0]), new TpuColumnVector(out[1]));
+  }
+
+  public static TpuColumnVector percentileFromHistogram(TpuColumnVector values,
+      TpuColumnVector frequencies, double[] percentages) {
+    StringBuilder sb = new StringBuilder("{\"percentages\":[");
+    for (int i = 0; i < percentages.length; i++) {
+      if (i > 0) {
+        sb.append(',');
+      }
+      sb.append(percentages[i]);
+    }
+    sb.append("]}");
+    return new TpuColumnVector(Bridge.invokeOne(
+        "Histogram.percentileFromHistogram", sb.toString(),
+        values.getNativeView(), frequencies.getNativeView()));
+  }
+}

@@ -1,0 +1,64 @@
+/*
+ * TPU-native rebuild of the spark-rapids-jni surface.
+ * Licensed under the Apache License, Version 2.0.
+ */
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Spark get_json_object (reference JSONUtils.java:27-60; kernel
+ * ops/get_json_object.py — char-level scan fusing tokenizer + JSONPath,
+ * path depth <= MAX_PATH_DEPTH like get_json_object.cu:360-420).
+ */
+public class JSONUtils {
+  public static final int MAX_PATH_DEPTH = 16;
+
+  static {
+    NativeDepsLoader.loadNativeDeps();
+  }
+
+  public enum PathInstructionType {
+    WILDCARD,
+    INDEX,
+    NAMED
+  }
+
+  public static class PathInstructionJni {
+    final PathInstructionType type;
+    final String name;
+    final long index;
+
+    public PathInstructionJni(PathInstructionType type, String name, long index) {
+      this.type = type;
+      this.name = name;
+      this.index = index;
+    }
+  }
+
+  public static TpuColumnVector getJsonObject(TpuColumnVector input,
+      PathInstructionJni[] pathInstructions) {
+    if (pathInstructions.length > MAX_PATH_DEPTH) {
+      throw new IllegalArgumentException("path depth > " + MAX_PATH_DEPTH);
+    }
+    StringBuilder sb = new StringBuilder("{\"path\":[");
+    for (int i = 0; i < pathInstructions.length; i++) {
+      PathInstructionJni p = pathInstructions[i];
+      if (i > 0) {
+        sb.append(',');
+      }
+      switch (p.type) {
+        case WILDCARD:
+          sb.append("[\"wildcard\",\"\",-1]");
+          break;
+        case INDEX:
+          sb.append("[\"index\",\"\",").append(p.index).append(']');
+          break;
+        default:
+          sb.append("[\"named\",").append(Bridge.quote(p.name)).append(",-1]");
+          break;
+      }
+    }
+    sb.append("]}");
+    return new TpuColumnVector(Bridge.invokeOne("JSONUtils.getJsonObject",
+        sb.toString(), input.getNativeView()));
+  }
+}

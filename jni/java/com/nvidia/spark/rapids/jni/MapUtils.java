@@ -1,0 +1,37 @@
+/*
+ * TPU-native rebuild of the spark-rapids-jni surface.
+ * Licensed under the Apache License, Version 2.0.
+ */
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * from_json raw-map extraction (reference MapUtils.java:47; kernel
+ * ops/from_json.py producing LIST&lt;STRUCT&lt;STRING,STRING&gt;&gt; like
+ * map_utils.cu:62-360).  The bridge returns the flattened key and value
+ * string children; the list offsets ride in the invoke metadata.
+ */
+public class MapUtils {
+  static {
+    NativeDepsLoader.loadNativeDeps();
+  }
+
+  /** Returns a (keys, values) table of the flattened map entries. */
+  public static TpuTable extractRawMapFromJsonString(TpuColumnVector jsonColumn) {
+    long[] out = Bridge.invoke("MapUtils.extractRawMapFromJsonString", "{}",
+        new long[]{jsonColumn.getNativeView()});
+    return new TpuTable(new TpuColumnVector(out[0]), new TpuColumnVector(out[1]));
+  }
+
+  /** Row offsets into the flattened entries from the last extract call. */
+  public static int[] lastExtractOffsets() {
+    String json = Bridge.lastInvokeJson();
+    int i = json.indexOf('[');
+    int j = json.indexOf(']', i);
+    String[] parts = json.substring(i + 1, j).split(",");
+    int[] offs = new int[parts.length];
+    for (int k = 0; k < parts.length; k++) {
+      offs[k] = Integer.parseInt(parts[k].trim());
+    }
+    return offs;
+  }
+}

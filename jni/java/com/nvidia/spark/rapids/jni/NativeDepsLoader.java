@@ -1,0 +1,46 @@
+/*
+ * TPU-native rebuild of the spark-rapids-jni surface.
+ * Licensed under the Apache License, Version 2.0.
+ */
+package com.nvidia.spark.rapids.jni;
+
+import java.io.File;
+
+/**
+ * Loads libspark_rapids_jni_tpu.so and initializes the embedded Python
+ * runtime (role of ai.rapids.cudf.NativeDepsLoader in the reference,
+ * e.g. CastStrings.java:24-26).
+ *
+ * System properties:
+ *   ai.rapids.tpu.libPath     explicit path to the .so (else java.library.path)
+ *   ai.rapids.tpu.pythonPath  prepended to sys.path so the
+ *                             spark_rapids_jni_tpu package resolves
+ */
+final class NativeDepsLoader {
+  private static boolean loaded = false;
+
+  private NativeDepsLoader() {}
+
+  static synchronized void loadNativeDeps() {
+    if (loaded) {
+      return;
+    }
+    String explicit = System.getProperty("ai.rapids.tpu.libPath");
+    if (explicit != null) {
+      System.load(new File(explicit).getAbsolutePath());
+    } else {
+      System.loadLibrary("spark_rapids_jni_tpu");
+    }
+    String pythonPath = System.getProperty("ai.rapids.tpu.pythonPath", "");
+    int rc = initBridge(pythonPath);
+    if (rc != 0) {
+      throw new ExceptionInInitializerError(
+          "TPU bridge init failed: " + lastError());
+    }
+    loaded = true;
+  }
+
+  private static native int initBridge(String pythonPath);
+
+  private static native String lastError();
+}

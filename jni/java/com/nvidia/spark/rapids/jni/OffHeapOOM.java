@@ -1,0 +1,16 @@
+/*
+ * TPU-native rebuild of the spark-rapids-jni surface.
+ * Licensed under the Apache License, Version 2.0.
+ */
+package com.nvidia.spark.rapids.jni;
+
+/** Host off-heap OOM (reference OffHeapOOM.java). */
+public class OffHeapOOM extends RuntimeException {
+  public OffHeapOOM() {
+    super();
+  }
+
+  public OffHeapOOM(String message) {
+    super(message);
+  }
+}

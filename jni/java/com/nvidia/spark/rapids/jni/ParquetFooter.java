@@ -1,0 +1,159 @@
+/*
+ * TPU-native rebuild of the spark-rapids-jni surface.
+ * Licensed under the Apache License, Version 2.0.
+ */
+package com.nvidia.spark.rapids.jni;
+
+import java.util.ArrayList;
+import java.util.Base64;
+import java.util.List;
+
+/**
+ * Parquet footer parse/filter/rewrite (reference ParquetFooter.java:27-221
+ * over NativeParquetJni.cpp:109-670).  The native engine is the C++
+ * thrift-compact footer library (spark_rapids_jni_tpu/io/native/
+ * parquet_footer.cpp) reached through the bridge; row groups are pruned
+ * by split midpoint and columns by a case-(in)sensitive schema tree.
+ */
+public class ParquetFooter implements AutoCloseable {
+
+  public static abstract class SchemaElement {
+    abstract String toJson();
+  }
+
+  public static class ValueElement extends SchemaElement {
+    public ValueElement() {}
+
+    String toJson() {
+      return "null";
+    }
+  }
+
+  public static class StructElement extends SchemaElement {
+    private final List<String> names = new ArrayList<>();
+    private final List<SchemaElement> children = new ArrayList<>();
+
+    public static StructBuilder builder() {
+      return new StructBuilder();
+    }
+
+    void add(String name, SchemaElement child) {
+      names.add(name);
+      children.add(child);
+    }
+
+    String toJson() {
+      StringBuilder sb = new StringBuilder("{");
+      for (int i = 0; i < names.size(); i++) {
+        if (i > 0) {
+          sb.append(',');
+        }
+        sb.append(Bridge.quote(names.get(i))).append(':')
+            .append(children.get(i).toJson());
+      }
+      return sb.append('}').toString();
+    }
+  }
+
+  public static class StructBuilder {
+    private final StructElement element = new StructElement();
+
+    public StructBuilder addChild(String name, SchemaElement child) {
+      element.add(name, child);
+      return this;
+    }
+
+    public StructElement build() {
+      return element;
+    }
+  }
+
+  public static class ListElement extends SchemaElement {
+    private final SchemaElement item;
+
+    public ListElement(SchemaElement item) {
+      this.item = item;
+    }
+
+    String toJson() {
+      return "{\"__list__\":" + item.toJson() + "}";
+    }
+  }
+
+  public static class MapElement extends SchemaElement {
+    private final SchemaElement key;
+    private final SchemaElement value;
+
+    public MapElement(SchemaElement key, SchemaElement value) {
+      this.key = key;
+      this.value = value;
+    }
+
+    String toJson() {
+      return "{\"__map__\":[" + key.toJson() + "," + value.toJson() + "]}";
+    }
+  }
+
+  private long handle;
+
+  private ParquetFooter(long handle) {
+    this.handle = handle;
+  }
+
+  private long view() {
+    if (handle == 0) {
+      throw new IllegalStateException("footer is closed");
+    }
+    return handle;
+  }
+
+  public static ParquetFooter readAndFilter(byte[] thriftFooter, long partOffset,
+      long partLength, SchemaElement schema, boolean ignoreCase) {
+    StringBuilder sb = new StringBuilder("{\"data\":")
+        .append(Bridge.quote(Base64.getEncoder().encodeToString(thriftFooter)))
+        .append(",\"part_offset\":").append(partOffset)
+        .append(",\"part_length\":").append(partLength)
+        .append(",\"ignore_case\":").append(ignoreCase);
+    if (schema != null) {
+      sb.append(",\"schema\":").append(schema.toJson());
+    }
+    sb.append('}');
+    return new ParquetFooter(
+        Bridge.invokeOne("ParquetFooter.readAndFilter", sb.toString()));
+  }
+
+  public long getNumRows() {
+    Bridge.invoke("ParquetFooter.getNumRows", "{}", new long[]{view()});
+    return metaLong();
+  }
+
+  public int getNumColumns() {
+    Bridge.invoke("ParquetFooter.getNumColumns", "{}", new long[]{view()});
+    return (int) metaLong();
+  }
+
+  /** PAR1-framed footer file bytes (reference :106-110). */
+  public byte[] serializeThriftFile() {
+    Bridge.invoke("ParquetFooter.serializeThriftFile", "{}", new long[]{view()});
+    String json = Bridge.lastInvokeJson();
+    int i = json.indexOf("\"data\"");
+    int a = json.indexOf('"', i + 7) + 1;
+    int b = json.indexOf('"', a);
+    return Base64.getDecoder().decode(json.substring(a, b));
+  }
+
+  private static long metaLong() {
+    String json = Bridge.lastInvokeJson();
+    int i = json.indexOf(':');
+    int j = json.indexOf('}', i);
+    return Long.parseLong(json.substring(i + 1, j).trim());
+  }
+
+  @Override
+  public void close() {
+    if (handle != 0) {
+      Bridge.release(handle);
+      handle = 0;
+    }
+  }
+}

@@ -1,0 +1,45 @@
+/*
+ * TPU-native rebuild of the spark-rapids-jni surface.
+ * Licensed under the Apache License, Version 2.0.
+ */
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Spark parse_url (reference ParseURI.java:36-94; kernel
+ * ops/parse_uri.py mirroring parse_uri.cu:773-1005).
+ */
+public class ParseURI {
+  static {
+    NativeDepsLoader.loadNativeDeps();
+  }
+
+  private static TpuColumnVector part(TpuColumnVector uriColumn, String part,
+      String key) {
+    String args = key == null
+        ? "{\"part\":\"" + part + "\"}"
+        : "{\"part\":\"" + part + "\",\"key\":" + Bridge.quote(key) + "}";
+    return new TpuColumnVector(Bridge.invokeOne("ParseURI.parseURI", args,
+        uriColumn.getNativeView()));
+  }
+
+  public static TpuColumnVector parseURIProtocol(TpuColumnVector uriColumn) {
+    return part(uriColumn, "PROTOCOL", null);
+  }
+
+  public static TpuColumnVector parseURIHost(TpuColumnVector uriColumn) {
+    return part(uriColumn, "HOST", null);
+  }
+
+  public static TpuColumnVector parseURIQuery(TpuColumnVector uriColumn) {
+    return part(uriColumn, "QUERY", null);
+  }
+
+  public static TpuColumnVector parseURIQueryWithLiteral(TpuColumnVector uriColumn,
+      String query) {
+    return part(uriColumn, "QUERY", query);
+  }
+
+  public static TpuColumnVector parseURIPath(TpuColumnVector uriColumn) {
+    return part(uriColumn, "PATH", null);
+  }
+}

@@ -1,0 +1,75 @@
+/*
+ * TPU-native rebuild of the spark-rapids-jni surface.
+ * Licensed under the Apache License, Version 2.0.
+ */
+package com.nvidia.spark.rapids.jni;
+
+import java.io.File;
+import java.io.IOException;
+import java.nio.ByteBuffer;
+import java.nio.file.Files;
+
+/**
+ * Always-attachable runtime profiler (reference Profiler.java:37-124 over
+ * the CUPTI->flatbuffers pipeline).  Here the native side is the XLA
+ * profiler bridge (spark_rapids_jni_tpu/profiler.py): same
+ * init/start/stop/shutdown lifecycle and the same DataWriter sink
+ * contract — records are captured to a spool file and pushed to the
+ * writer at shutdown.
+ */
+public class Profiler {
+  private static DataWriter writer = null;
+  private static File spool = null;
+
+  static {
+    NativeDepsLoader.loadNativeDeps();
+  }
+
+  /** Sink for serialized profile data (reference Profiler.java:117-124). */
+  public static abstract class DataWriter implements AutoCloseable {
+    public abstract void write(ByteBuffer data);
+  }
+
+  public static void init(DataWriter w) {
+    init(w, 8 * 1024 * 1024, 1000);
+  }
+
+  public static void init(DataWriter w, long writeBufferSize, int flushPeriodMillis) {
+    if (writer != null) {
+      throw new IllegalStateException("profiler already initialized");
+    }
+    try {
+      spool = File.createTempFile("tpu-profile", ".bin");
+    } catch (IOException e) {
+      throw new RuntimeException(e);
+    }
+    Bridge.invoke("Profiler.init",
+        "{\"path\":" + Bridge.quote(spool.getAbsolutePath()) + "}", new long[0]);
+    writer = w;
+  }
+
+  public static void start() {
+    Bridge.invoke("Profiler.start", "{}", new long[0]);
+  }
+
+  public static void stop() {
+    Bridge.invoke("Profiler.stop", "{}", new long[0]);
+  }
+
+  public static void shutdown() {
+    if (writer == null) {
+      return;
+    }
+    Bridge.invoke("Profiler.shutdown", "{}", new long[0]);
+    try {
+      writer.write(ByteBuffer.wrap(Files.readAllBytes(spool.toPath())));
+      writer.close();
+    } catch (Exception e) {
+      throw new RuntimeException(e);
+    } finally {
+      writer = null;
+      spool.delete();
+      spool = null;
+    }
+  }
+}

@@ -1,0 +1,25 @@
+/*
+ * TPU-native rebuild of the spark-rapids-jni surface.
+ * Licensed under the Apache License, Version 2.0.
+ */
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Fast-path contains check for literal[start-end]{len,} regexes
+ * (reference RegexRewriteUtils.java:38; kernel ops/regex_rewrite.py
+ * mirroring regex_rewrite_utils.cu:65-121).
+ */
+public class RegexRewriteUtils {
+  static {
+    NativeDepsLoader.loadNativeDeps();
+  }
+
+  public static TpuColumnVector literalRangePattern(TpuColumnVector input,
+      String literal, int len, int start, int end) {
+    return new TpuColumnVector(Bridge.invokeOne(
+        "RegexRewriteUtils.literalRangePattern",
+        "{\"literal\":" + Bridge.quote(literal) + ",\"len\":" + len
+            + ",\"start\":" + start + ",\"end\":" + end + "}",
+        input.getNativeView()));
+  }
+}

@@ -1,0 +1,140 @@
+/*
+ * TPU-native rebuild of the spark-rapids-jni surface.
+ * Licensed under the Apache License, Version 2.0.
+ */
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Static facade over the resource adaptor (reference RmmSpark.java:59-664):
+ * thread-role registration, retry-block demarcation, OOM injection, and
+ * per-task metrics.  Thread ids are JVM thread ids (the reference uses
+ * native thread ids; the adaptor only needs uniqueness + stability).
+ */
+public class RmmSpark {
+
+  public enum OomInjectionType {
+    CPU_OR_GPU,
+    CPU,
+    GPU;
+  }
+
+  private static volatile SparkResourceAdaptor sra = null;
+
+  public static synchronized void setEventHandler(long poolBytes, String logLoc) {
+    if (sra != null) {
+      throw new IllegalStateException("event handler already set");
+    }
+    sra = new SparkResourceAdaptor(poolBytes, logLoc);
+  }
+
+  public static synchronized void clearEventHandler() {
+    if (sra != null) {
+      sra.close();
+      sra = null;
+    }
+  }
+
+  private static SparkResourceAdaptor get() {
+    SparkResourceAdaptor s = sra;
+    if (s == null) {
+      throw new IllegalStateException("no event handler set");
+    }
+    return s;
+  }
+
+  public static long getCurrentThreadId() {
+    return Thread.currentThread().getId();
+  }
+
+  public static void currentThreadIsDedicatedToTask(long taskId) {
+    get().startDedicatedTaskThread(getCurrentThreadId(), taskId);
+  }
+
+  public static void shuffleThreadWorkingOnTasks(long[] taskIds) {
+    get().poolThreadWorkingOnTasks(true, getCurrentThreadId(), taskIds);
+  }
+
+  public static void poolThreadWorkingOnTasks(long[] taskIds) {
+    get().poolThreadWorkingOnTasks(false, getCurrentThreadId(), taskIds);
+  }
+
+  public static void poolThreadFinishedForTasks(long[] taskIds) {
+    get().poolThreadFinishedForTasks(getCurrentThreadId(), taskIds);
+  }
+
+  public static void removeCurrentDedicatedThreadAssociation(long taskId) {
+    get().removeCurrentThreadAssociation(getCurrentThreadId(), taskId);
+  }
+
+  public static void taskDone(long taskId) {
+    get().taskDone(taskId);
+  }
+
+  /** Simulated-pressure allocation through the scheduler (the TPU arena
+   * is logical: XLA owns physical buffers, see mem/rmm_spark.py). */
+  public static void allocate(long bytes) {
+    get().allocate(getCurrentThreadId(), bytes);
+  }
+
+  public static void deallocate(long bytes) {
+    get().deallocate(getCurrentThreadId(), bytes);
+  }
+
+  /** Block after a RetryOOM until the scheduler wakes this thread
+   * (reference RmmSpark.java:417). */
+  public static void blockThreadUntilReady() {
+    get().blockThreadUntilReady(getCurrentThreadId());
+  }
+
+  public static RmmSparkThreadState getStateOf(long threadId) {
+    return get().getStateOf(threadId);
+  }
+
+  public static void forceRetryOOM(long threadId) {
+    forceRetryOOM(threadId, 1, 0);
+  }
+
+  public static void forceRetryOOM(long threadId, int numOOMs, int skipCount) {
+    get().forceRetryOOM(threadId, numOOMs, skipCount);
+  }
+
+  public static void forceSplitAndRetryOOM(long threadId) {
+    forceSplitAndRetryOOM(threadId, 1, 0);
+  }
+
+  public static void forceSplitAndRetryOOM(long threadId, int numOOMs, int skipCount) {
+    get().forceSplitAndRetryOOM(threadId, numOOMs, skipCount);
+  }
+
+  public static void forceCudfException(long threadId) {
+    forceCudfException(threadId, 1, 0);
+  }
+
+  public static void forceCudfException(long threadId, int numTimes, int skipCount) {
+    get().forceCudfException(threadId, numTimes, skipCount);
+  }
+
+  public static long getAndResetNumRetryThrow(long taskId) {
+    return get().getAndResetNumRetryThrow(taskId);
+  }
+
+  public static long getAndResetNumSplitRetryThrow(long taskId) {
+    return get().getAndResetNumSplitRetryThrow(taskId);
+  }
+
+  public static long getAndResetBlockTimeNs(long taskId) {
+    return get().getAndResetBlockTime(taskId);
+  }
+
+  public static long getAndResetComputeTimeLostToRetryNs(long taskId) {
+    return get().getAndResetComputeTimeLostToRetry(taskId);
+  }
+
+  public static long getTotalAllocated() {
+    return get().getTotalAllocated();
+  }
+
+  public static long getMaxAllocated() {
+    return get().getMaxAllocated();
+  }
+}

@@ -1,0 +1,38 @@
+/*
+ * TPU-native rebuild of the spark-rapids-jni surface.
+ * Licensed under the Apache License, Version 2.0.
+ */
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Mirror of the native thread-scheduler states (reference
+ * RmmSparkThreadState.java:23-35, SparkResourceAdaptorJni.cpp:82-95;
+ * native enum in mem/native/resource_adaptor.cpp).
+ */
+public enum RmmSparkThreadState {
+  UNKNOWN(0),
+  THREAD_RUNNING(1),
+  THREAD_ALLOC(2),
+  THREAD_ALLOC_FREE(3),
+  THREAD_BLOCKED(4),
+  THREAD_BUFN_THROW(5),
+  THREAD_BUFN_WAIT(6),
+  THREAD_BUFN(7),
+  THREAD_SPLIT_THROW(8),
+  THREAD_REMOVE_THROW(9);
+
+  private final int nativeId;
+
+  RmmSparkThreadState(int nativeId) {
+    this.nativeId = nativeId;
+  }
+
+  static RmmSparkThreadState fromNativeId(int id) {
+    for (RmmSparkThreadState state : values()) {
+      if (state.nativeId == id) {
+        return state;
+      }
+    }
+    throw new IllegalArgumentException("unknown native state " + id);
+  }
+}

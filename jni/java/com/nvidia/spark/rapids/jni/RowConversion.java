@@ -1,0 +1,97 @@
+/*
+ * TPU-native rebuild of the spark-rapids-jni surface.
+ * Licensed under the Apache License, Version 2.0.
+ */
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Row <-> columnar transpose in the JCUDF row format (reference
+ * RowConversion.java:35-158, layout doc :57-116; kernel
+ * ops/row_conversion.py incl. the 2GB batch splitter and the
+ * fixed-width-optimized entry).
+ */
+public class RowConversion {
+  static {
+    NativeDepsLoader.loadNativeDeps();
+  }
+
+  private static long[] views(TpuTable table) {
+    long[] handles = new long[table.getNumberOfColumns()];
+    for (int i = 0; i < handles.length; i++) {
+      handles[i] = table.getColumn(i).getNativeView();
+    }
+    return handles;
+  }
+
+  /** One binary row column per &lt;=2GB batch (reference :35-42). */
+  public static TpuColumnVector[] convertToRows(TpuTable table) {
+    long[] out = Bridge.invoke("RowConversion.convertToRows", "{}", views(table));
+    TpuColumnVector[] res = new TpuColumnVector[out.length];
+    for (int i = 0; i < out.length; i++) {
+      res[i] = new TpuColumnVector(out[i]);
+    }
+    return res;
+  }
+
+  /** Fast path, &lt;100 columns, fixed-width only (reference :118). */
+  public static TpuColumnVector[] convertToRowsFixedWidthOptimized(TpuTable table) {
+    long[] out = Bridge.invoke("RowConversion.convertToRowsFixedWidthOptimized",
+        "{}", views(table));
+    TpuColumnVector[] res = new TpuColumnVector[out.length];
+    for (int i = 0; i < out.length; i++) {
+      res[i] = new TpuColumnVector(out[i]);
+    }
+    return res;
+  }
+
+  /** Fixed-width schemas only; DECIMAL128/STRING need the full overload
+   * (precision/scale and padded width cannot be defaulted safely). */
+  public static TpuTable convertFromRows(TpuColumnVector vec, DType... schema) {
+    return convertFromRows(vec, schema, null, null, null);
+  }
+
+  /**
+   * Full schema: precisions/scales apply to DECIMAL128 entries, maxLens
+   * bounds each STRING column's padded width (pass null arrays when no
+   * such columns exist).
+   */
+  public static TpuTable convertFromRows(TpuColumnVector vec, DType[] schema,
+      int[] precisions, int[] scales, int[] maxLens) {
+    StringBuilder sb = new StringBuilder("{\"schema\":[");
+    for (int i = 0; i < schema.length; i++) {
+      if (i > 0) {
+        sb.append(',');
+      }
+      sb.append("{\"kind\":\"").append(schema[i].bridgeKind()).append('"');
+      if (schema[i] == DType.DECIMAL128) {
+        if (precisions == null || scales == null) {
+          throw new IllegalArgumentException(
+              "DECIMAL128 schema entries need precisions/scales arrays");
+        }
+        sb.append(",\"precision\":").append(precisions[i])
+            .append(",\"scale\":").append(scales[i]);
+      }
+      if (schema[i] == DType.STRING) {
+        if (maxLens == null) {
+          throw new IllegalArgumentException(
+              "STRING schema entries need a maxLens array");
+        }
+        sb.append(",\"max_len\":").append(maxLens[i]);
+      }
+      sb.append('}');
+    }
+    sb.append("]}");
+    long[] out = Bridge.invoke("RowConversion.convertFromRows", sb.toString(),
+        new long[]{vec.getNativeView()});
+    TpuColumnVector[] res = new TpuColumnVector[out.length];
+    for (int i = 0; i < out.length; i++) {
+      res[i] = new TpuColumnVector(out[i]);
+    }
+    return new TpuTable(res);
+  }
+
+  public static TpuTable convertFromRowsFixedWidthOptimized(TpuColumnVector vec,
+      DType... schema) {
+    return convertFromRows(vec, schema);
+  }
+}

@@ -1,0 +1,214 @@
+/*
+ * TPU-native rebuild of the spark-rapids-jni surface.
+ * Licensed under the Apache License, Version 2.0.
+ */
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Handle owner for the native per-task retry/block/split scheduler
+ * (reference SparkResourceAdaptor.java:35-330 over
+ * SparkResourceAdaptorJni.cpp).  The native state machine is
+ * mem/native/resource_adaptor.cpp (libtpu_resource_adaptor.so) — the
+ * same single in-process instance the Python facade
+ * (spark_rapids_jni_tpu/mem/rmm_spark.py) drives.  A daemon watchdog
+ * thread polls checkAndBreakDeadlocks every polling period (reference
+ * :59-79).
+ */
+public class SparkResourceAdaptor implements AutoCloseable {
+  private static final long DEFAULT_POLLING_PERIOD = 100;
+  private static final String POLLING_PROP =
+      "ai.rapids.cudf.spark.rmmWatchdogPollingPeriod";
+
+  static {
+    NativeDepsLoader.loadNativeDeps();
+  }
+
+  private long handle;
+  private Thread watchdog;
+  private volatile boolean closed = false;
+
+  public SparkResourceAdaptor(long poolBytes, String logLoc) {
+    handle = create(poolBytes, logLoc);
+    long period = Long.getLong(POLLING_PROP, DEFAULT_POLLING_PERIOD);
+    watchdog = new Thread(() -> {
+      while (!closed) {
+        try {
+          Thread.sleep(period);
+        } catch (InterruptedException e) {
+          return;
+        }
+        if (!closed) {
+          checkAndBreakDeadlocks(handle);
+        }
+      }
+    }, "rmm-spark-watchdog");
+    watchdog.setDaemon(true);
+    watchdog.start();
+  }
+
+  long getHandle() {
+    return handle;
+  }
+
+  @Override
+  public void close() {
+    if (!closed) {
+      closed = true;
+      watchdog.interrupt();
+      try {
+        // the watchdog must be out of checkAndBreakDeadlocks before the
+        // native object is freed
+        watchdog.join();
+      } catch (InterruptedException e) {
+        Thread.currentThread().interrupt();
+      }
+      destroy(handle);
+      handle = 0;
+    }
+  }
+
+  public void startDedicatedTaskThread(long threadId, long taskId) {
+    ThreadStateRegistry.addThread(threadId, Thread.currentThread());
+    startDedicatedTaskThread(handle, threadId, taskId);
+  }
+
+  public void poolThreadWorkingOnTasks(boolean isShuffle, long threadId, long[] taskIds) {
+    ThreadStateRegistry.addThread(threadId, Thread.currentThread());
+    poolThreadWorkingOnTasks(handle, isShuffle, threadId, taskIds);
+  }
+
+  public void poolThreadFinishedForTasks(long threadId, long[] taskIds) {
+    poolThreadFinishedForTasks(handle, threadId, taskIds);
+  }
+
+  public void removeCurrentThreadAssociation(long threadId, long taskId) {
+    ThreadStateRegistry.removeThread(threadId);
+    removeThreadAssociation(handle, threadId, taskId);
+  }
+
+  public void taskDone(long taskId) {
+    taskDone(handle, taskId);
+  }
+
+  /** Drive one (simulated-pressure) allocation through the state machine;
+   * throws the OOM family on BUFN_THROW/SPLIT_THROW. */
+  public void allocate(long threadId, long bytes) {
+    throwFor(allocate(handle, threadId, bytes));
+  }
+
+  public void deallocate(long threadId, long bytes) {
+    deallocate(handle, threadId, bytes);
+  }
+
+  public void blockThreadUntilReady(long threadId) {
+    throwFor(blockThreadUntilReady(handle, threadId));
+  }
+
+  public RmmSparkThreadState getStateOf(long threadId) {
+    return RmmSparkThreadState.fromNativeId(getStateOf(handle, threadId));
+  }
+
+  public boolean checkAndBreakDeadlocks() {
+    return checkAndBreakDeadlocks(handle) != 0;
+  }
+
+  public void forceRetryOOM(long threadId, int numOOMs, int skipCount) {
+    forceRetryOOM(handle, threadId, numOOMs, skipCount);
+  }
+
+  public void forceSplitAndRetryOOM(long threadId, int numOOMs, int skipCount) {
+    forceSplitAndRetryOOM(handle, threadId, numOOMs, skipCount);
+  }
+
+  public void forceCudfException(long threadId, int numTimes, int skipCount) {
+    forceCudfException(handle, threadId, numTimes, skipCount);
+  }
+
+  public long getAndResetNumRetryThrow(long taskId) {
+    return getAndResetMetric(handle, taskId, 0);
+  }
+
+  public long getAndResetNumSplitRetryThrow(long taskId) {
+    return getAndResetMetric(handle, taskId, 1);
+  }
+
+  public long getAndResetBlockTime(long taskId) {
+    return getAndResetMetric(handle, taskId, 2);
+  }
+
+  public long getAndResetComputeTimeLostToRetry(long taskId) {
+    return getAndResetMetric(handle, taskId, 3);
+  }
+
+  public long getMaxGpuTaskMemory(long taskId) {
+    return getAndResetMetric(handle, taskId, 4);
+  }
+
+  public long getTotalAllocated() {
+    return totalAllocated(handle);
+  }
+
+  public long getMaxAllocated() {
+    return maxAllocated(handle);
+  }
+
+  /** Error-code -> exception ladder (codes shared with the native lib
+   * and the Python facade's _raise_for). */
+  private static void throwFor(int code) {
+    switch (code) {
+      case 0:
+        return;
+      case 1:
+        throw new GpuRetryOOM("injected RetryOOM");
+      case 2:
+        throw new GpuSplitAndRetryOOM("injected SplitAndRetryOOM");
+      case 3:
+        throw new GpuOOM("GPU OOM");
+      case 4:
+        throw new RuntimeException("injected exception");
+      default:
+        throw new RuntimeException("native error " + code);
+    }
+  }
+
+  private static native long create(long poolBytes, String logLoc);
+
+  private static native void destroy(long handle);
+
+  private static native void startDedicatedTaskThread(long handle, long threadId, long taskId);
+
+  private static native void poolThreadWorkingOnTasks(long handle, boolean isShuffle,
+      long threadId, long[] taskIds);
+
+  private static native void poolThreadFinishedForTasks(long handle, long threadId,
+      long[] taskIds);
+
+  private static native void removeThreadAssociation(long handle, long threadId, long taskId);
+
+  private static native void taskDone(long handle, long taskId);
+
+  private static native int allocate(long handle, long threadId, long bytes);
+
+  private static native void deallocate(long handle, long threadId, long bytes);
+
+  private static native int blockThreadUntilReady(long handle, long threadId);
+
+  private static native int getStateOf(long handle, long threadId);
+
+  private static native int checkAndBreakDeadlocks(long handle);
+
+  private static native void forceRetryOOM(long handle, long threadId, int numOOMs,
+      int skipCount);
+
+  private static native void forceSplitAndRetryOOM(long handle, long threadId, int numOOMs,
+      int skipCount);
+
+  private static native void forceCudfException(long handle, long threadId, int numTimes,
+      int skipCount);
+
+  private static native long getAndResetMetric(long handle, long taskId, int which);
+
+  private static native long totalAllocated(long handle);
+
+  private static native long maxAllocated(long handle);
+}

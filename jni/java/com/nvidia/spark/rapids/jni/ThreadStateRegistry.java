@@ -1,0 +1,43 @@
+/*
+ * TPU-native rebuild of the spark-rapids-jni surface.
+ * Licensed under the Apache License, Version 2.0.
+ */
+package com.nvidia.spark.rapids.jni;
+
+import java.util.HashMap;
+
+/**
+ * Maps native thread ids to Java threads so the native deadlock scan can
+ * classify threads blocked outside the allocator (reference
+ * ThreadStateRegistry.java:44-66; called from the adaptor's
+ * is_in_deadlock via the registered blocked-thread callback).
+ */
+public class ThreadStateRegistry {
+  private static final HashMap<Long, Thread> knownThreads = new HashMap<>();
+
+  public static synchronized void addThread(long nativeId, Thread t) {
+    knownThreads.put(nativeId, t);
+  }
+
+  public static synchronized void removeThread(long nativeId) {
+    knownThreads.remove(nativeId);
+  }
+
+  /** Called from native code during the deadlock scan. */
+  public static synchronized boolean isThreadBlocked(long nativeId) {
+    Thread t = knownThreads.get(nativeId);
+    if (t == null || !t.isAlive()) {
+      return true;
+    }
+    Thread.State state = t.getState();
+    switch (state) {
+      case BLOCKED:
+      case WAITING:
+      case TIMED_WAITING:
+      case TERMINATED:
+        return true;
+      default:
+        return false;
+    }
+  }
+}

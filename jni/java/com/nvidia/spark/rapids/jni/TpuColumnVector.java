@@ -1,0 +1,135 @@
+/*
+ * TPU-native rebuild of the spark-rapids-jni surface.
+ * Licensed under the Apache License, Version 2.0.
+ */
+package com.nvidia.spark.rapids.jni;
+
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+import java.nio.charset.StandardCharsets;
+
+/**
+ * A device column handle (role of ai.rapids.cudf ColumnVector/ColumnView
+ * in the reference API).  The handle owns a live column object inside the
+ * TPU runtime; buffers stay in HBM and cross the host boundary only via
+ * the explicit from/to-host constructors here.
+ */
+public class TpuColumnVector implements AutoCloseable {
+  private long handle;
+
+  TpuColumnVector(long handle) {
+    if (handle == 0) {
+      throw new IllegalArgumentException("null native column handle");
+    }
+    this.handle = handle;
+  }
+
+  /** The native view handle (role of ColumnView.getNativeView()). */
+  public long getNativeView() {
+    if (handle == 0) {
+      throw new IllegalStateException("column is closed");
+    }
+    return handle;
+  }
+
+  public long getRowCount() {
+    return Bridge.numRows(getNativeView());
+  }
+
+  @Override
+  public void close() {
+    if (handle != 0) {
+      Bridge.release(handle);
+      handle = 0;
+    }
+  }
+
+  // ---- host-side constructors --------------------------------------
+
+  public static TpuColumnVector fromLongs(long... values) {
+    ByteBuffer bb = ByteBuffer.allocate(values.length * 8)
+        .order(ByteOrder.LITTLE_ENDIAN);
+    for (long v : values) {
+      bb.putLong(v);
+    }
+    return new TpuColumnVector(Bridge.columnFromHost(
+        DType.INT64.bridgeKind(), values.length, bb.array(), null, 0, 0));
+  }
+
+  public static TpuColumnVector fromInts(int... values) {
+    ByteBuffer bb = ByteBuffer.allocate(values.length * 4)
+        .order(ByteOrder.LITTLE_ENDIAN);
+    for (int v : values) {
+      bb.putInt(v);
+    }
+    return new TpuColumnVector(Bridge.columnFromHost(
+        DType.INT32.bridgeKind(), values.length, bb.array(), null, 0, 0));
+  }
+
+  public static TpuColumnVector fromDoubles(double... values) {
+    ByteBuffer bb = ByteBuffer.allocate(values.length * 8)
+        .order(ByteOrder.LITTLE_ENDIAN);
+    for (double v : values) {
+      bb.putDouble(v);
+    }
+    return new TpuColumnVector(Bridge.columnFromHost(
+        DType.FLOAT64.bridgeKind(), values.length, bb.array(), null, 0, 0));
+  }
+
+  /** Null entries become null rows. */
+  public static TpuColumnVector fromStrings(String... values) {
+    byte[][] encoded = new byte[values.length][];
+    int total = 0;
+    byte[] validity = new byte[values.length];
+    for (int i = 0; i < values.length; i++) {
+      encoded[i] = values[i] == null ? new byte[0]
+          : values[i].getBytes(StandardCharsets.UTF_8);
+      validity[i] = (byte) (values[i] == null ? 0 : 1);
+      total += encoded[i].length;
+    }
+    byte[] chars = new byte[total];
+    int[] offsets = new int[values.length + 1];
+    int pos = 0;
+    for (int i = 0; i < values.length; i++) {
+      System.arraycopy(encoded[i], 0, chars, pos, encoded[i].length);
+      pos += encoded[i].length;
+      offsets[i + 1] = pos;
+    }
+    return new TpuColumnVector(Bridge.stringColumnFromHost(
+        chars, offsets, validity, values.length));
+  }
+
+  /**
+   * Generic fixed-width constructor: data is little-endian packed
+   * (decimal: 16 bytes per row, two's complement); validity is one byte
+   * per row or null for all-valid.
+   */
+  public static TpuColumnVector fromHostBuffer(DType type, long rows,
+      byte[] data, byte[] validity, int precision, int scale) {
+    return new TpuColumnVector(Bridge.columnFromHost(
+        type.bridgeKind(), rows, data, validity, precision, scale));
+  }
+
+  // ---- host-side export --------------------------------------------
+
+  /** Copy the column back to host buffers. */
+  public Bridge.HostColumn copyToHost() {
+    return Bridge.columnToHost(getNativeView());
+  }
+
+  /** Convenience: decode a string column to a String array. */
+  public String[] copyToHostStrings() {
+    Bridge.HostColumn hc = copyToHost();
+    if (hc.offsets == null) {
+      throw new IllegalStateException("not a string column: " + hc.kind);
+    }
+    String[] out = new String[(int) hc.rows];
+    for (int i = 0; i < out.length; i++) {
+      if (hc.validity[i] != 0) {
+        out[i] = new String(hc.data, hc.offsets[i],
+            hc.offsets[i + 1] - hc.offsets[i], StandardCharsets.UTF_8);
+      }
+    }
+    return out;
+  }
+}

@@ -1,0 +1,37 @@
+/*
+ * TPU-native rebuild of the spark-rapids-jni surface.
+ * Licensed under the Apache License, Version 2.0.
+ */
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * An ordered set of columns (role of ai.rapids.cudf.Table in the
+ * reference signatures, e.g. DecimalUtils.java:46 returns a Table of
+ * (overflow, result)).
+ */
+public class TpuTable implements AutoCloseable {
+  private final TpuColumnVector[] columns;
+
+  public TpuTable(TpuColumnVector... columns) {
+    this.columns = columns;
+  }
+
+  public TpuColumnVector getColumn(int i) {
+    return columns[i];
+  }
+
+  public int getNumberOfColumns() {
+    return columns.length;
+  }
+
+  public long getRowCount() {
+    return columns.length == 0 ? 0 : columns[0].getRowCount();
+  }
+
+  @Override
+  public void close() {
+    for (TpuColumnVector c : columns) {
+      c.close();
+    }
+  }
+}

@@ -1,0 +1,35 @@
+/*
+ * TPU-native rebuild of the spark-rapids-jni surface.
+ * Licensed under the Apache License, Version 2.0.
+ */
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * DeltaLake clustering indexes (reference ZOrder.java:41-70; kernel
+ * ops/zorder.py mirroring zorder.cu:37-224).
+ */
+public class ZOrder {
+  static {
+    NativeDepsLoader.loadNativeDeps();
+  }
+
+  private static long[] views(TpuColumnVector... cols) {
+    long[] handles = new long[cols.length];
+    for (int i = 0; i < cols.length; i++) {
+      handles[i] = cols[i].getNativeView();
+    }
+    return handles;
+  }
+
+  public static TpuColumnVector interleaveBits(int numRows,
+      TpuColumnVector... inputColumns) {
+    return new TpuColumnVector(Bridge.invokeOne("ZOrder.interleaveBits", "{}",
+        views(inputColumns)));
+  }
+
+  public static TpuColumnVector hilbertIndex(int numBits, int numRows,
+      TpuColumnVector... inputColumns) {
+    return new TpuColumnVector(Bridge.invokeOne("ZOrder.hilbertIndex",
+        "{\"num_bits\":" + numBits + "}", views(inputColumns)));
+  }
+}

@@ -1,0 +1,360 @@
+/* Embedded-CPython bridge behind the Java/JNI surface (see bridge.h).
+ *
+ * Reference counterpart: the 15 hand-written JNI marshaling files
+ * (src/main/cpp/src/XxxJni.cpp) plus cudf::jni helpers.  Design difference:
+ * one generic dispatch entry; per-op marshaling lives in Python
+ * (spark_rapids_jni_tpu/jni_bridge.py) where the kernels are.
+ */
+#include "bridge.h"
+
+#include <Python.h>
+
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+namespace {
+
+thread_local std::string g_error;
+thread_local int g_error_code = SRJ_OK;
+thread_local std::string g_invoke_json;
+
+bool g_owns_interpreter = false;
+PyObject* g_module = nullptr; /* spark_rapids_jni_tpu.jni_bridge */
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() : st(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(st); }
+};
+
+void set_error(const std::string& msg, int code = SRJ_ERR) {
+  g_error = msg;
+  g_error_code = code;
+}
+
+/* Capture the pending Python exception: message + family code (via
+ * jni_bridge.classify_exception, mirroring CATCH_CAST_EXCEPTION /
+ * CATCH_STD in the reference glue). */
+void capture_py_error() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = "python error";
+  int code = SRJ_ERR;
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) msg = c;
+      Py_DECREF(s);
+    }
+    if (type != nullptr) {
+      PyObject* tname = PyObject_GetAttrString(type, "__name__");
+      if (tname != nullptr) {
+        const char* tn = PyUnicode_AsUTF8(tname);
+        if (tn != nullptr) msg = std::string(tn) + ": " + msg;
+        Py_DECREF(tname);
+      }
+    }
+    if (g_module != nullptr) {
+      PyObject* res =
+          PyObject_CallMethod(g_module, "classify_exception", "O", value);
+      if (res != nullptr) {
+        code = static_cast<int>(PyLong_AsLong(res));
+        Py_DECREF(res);
+      } else {
+        PyErr_Clear();
+      }
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_error(msg, code);
+}
+
+PyObject* handle_obj(int64_t h) {
+  return reinterpret_cast<PyObject*>(static_cast<intptr_t>(h));
+}
+
+int64_t obj_handle(PyObject* o) { /* takes ownership of a new ref */
+  return static_cast<int64_t>(reinterpret_cast<intptr_t>(o));
+}
+
+bool module_ready() {
+  if (g_module != nullptr) return true;
+  set_error("bridge not initialized (call srj_init)", SRJ_ERR);
+  return false;
+}
+
+/* Call g_module.<fn>(*args). Returns new ref or nullptr (error captured). */
+PyObject* call_bridge(const char* fn, PyObject* args /* tuple, stolen */) {
+  PyObject* f = PyObject_GetAttrString(g_module, fn);
+  if (f == nullptr) {
+    Py_DECREF(args);
+    capture_py_error();
+    return nullptr;
+  }
+  PyObject* res = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_DECREF(args);
+  if (res == nullptr) capture_py_error();
+  return res;
+}
+
+/* Extract bytes from a Python bytes object into a malloc'd buffer. */
+uint8_t* copy_bytes(PyObject* b, int64_t* len_out) {
+  char* buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(b, &buf, &len) != 0) return nullptr;
+  auto* out = static_cast<uint8_t*>(std::malloc(len > 0 ? len : 1));
+  if (out != nullptr && len > 0) std::memcpy(out, buf, len);
+  *len_out = static_cast<int64_t>(len);
+  return out;
+}
+
+} /* namespace */
+
+extern "C" {
+
+int srj_init(const char* python_path) {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_owns_interpreter = true;
+    /* release the GIL the init call acquired so per-call PyGILState
+     * acquisition works from any thread, including this one */
+    PyEval_SaveThread();
+  }
+  Gil gil;
+  if (python_path != nullptr && python_path[0] != '\0') {
+    PyObject* sys_path = PySys_GetObject("path"); /* borrowed */
+    if (sys_path != nullptr) {
+      PyObject* p = PyUnicode_FromString(python_path);
+      if (p != nullptr) {
+        PyList_Insert(sys_path, 0, p);
+        Py_DECREF(p);
+      }
+    }
+  }
+  if (g_module == nullptr) {
+    g_module = PyImport_ImportModule("spark_rapids_jni_tpu.jni_bridge");
+    if (g_module == nullptr) {
+      capture_py_error();
+      return SRJ_ERR;
+    }
+  }
+  return SRJ_OK;
+}
+
+void srj_shutdown(void) {
+  /* Dropping the module reference is enough; tearing down an embedded
+   * interpreter that may still own XLA runtime threads is not safe, so we
+   * deliberately never Py_Finalize (the reference similarly leaves the
+   * driver loaded for the process lifetime). */
+  if (g_module != nullptr) {
+    Gil gil;
+    Py_CLEAR(g_module);
+  }
+}
+
+int64_t srj_column_from_host(const char* kind, int64_t n, const void* data,
+                             int64_t data_len, const uint8_t* validity,
+                             int precision, int scale) {
+  if (!module_ready()) return 0;
+  Gil gil;
+  PyObject* pdata = PyBytes_FromStringAndSize(
+      static_cast<const char*>(data), static_cast<Py_ssize_t>(data_len));
+  PyObject* pvalid =
+      validity != nullptr
+          ? PyBytes_FromStringAndSize(
+                reinterpret_cast<const char*>(validity),
+                static_cast<Py_ssize_t>(n))
+          : PyBytes_FromStringAndSize("", 0);
+  if (pdata == nullptr || pvalid == nullptr) {
+    Py_XDECREF(pdata);
+    Py_XDECREF(pvalid);
+    capture_py_error();
+    return 0;
+  }
+  PyObject* args = Py_BuildValue("(sLNNii)", kind, (long long)n, pdata,
+                                 pvalid, precision, scale);
+  if (args == nullptr) {
+    capture_py_error();
+    return 0;
+  }
+  PyObject* col = call_bridge("column_from_host", args);
+  return col != nullptr ? obj_handle(col) : 0;
+}
+
+int64_t srj_string_column_from_host(const uint8_t* chars, int64_t chars_len,
+                                    const int32_t* offsets,
+                                    const uint8_t* validity, int64_t n) {
+  if (!module_ready()) return 0;
+  Gil gil;
+  PyObject* pchars = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(chars),
+      static_cast<Py_ssize_t>(chars_len));
+  PyObject* poffs = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(offsets),
+      static_cast<Py_ssize_t>((n + 1) * sizeof(int32_t)));
+  PyObject* pvalid =
+      validity != nullptr
+          ? PyBytes_FromStringAndSize(
+                reinterpret_cast<const char*>(validity),
+                static_cast<Py_ssize_t>(n))
+          : PyBytes_FromStringAndSize("", 0);
+  if (pchars == nullptr || poffs == nullptr || pvalid == nullptr) {
+    Py_XDECREF(pchars);
+    Py_XDECREF(poffs);
+    Py_XDECREF(pvalid);
+    capture_py_error();
+    return 0;
+  }
+  PyObject* args =
+      Py_BuildValue("(NNNL)", pchars, poffs, pvalid, (long long)n);
+  if (args == nullptr) {
+    capture_py_error();
+    return 0;
+  }
+  PyObject* col = call_bridge("string_column_from_host", args);
+  return col != nullptr ? obj_handle(col) : 0;
+}
+
+int srj_column_to_host(int64_t handle, SrjHostColumn* out) {
+  if (!module_ready()) return SRJ_ERR;
+  std::memset(out, 0, sizeof(*out));
+  if (handle == 0) {
+    set_error("null column handle", SRJ_ERR);
+    return SRJ_ERR;
+  }
+  Gil gil;
+  PyObject* args = Py_BuildValue("(O)", handle_obj(handle));
+  if (args == nullptr) {
+    capture_py_error();
+    return SRJ_ERR;
+  }
+  PyObject* res = call_bridge("column_to_host", args);
+  if (res == nullptr) return g_error_code;
+  /* (kind, n, data, validity, offsets|None, precision, scale) */
+  const char* kind = nullptr;
+  long long n = 0;
+  PyObject *pdata = nullptr, *pvalid = nullptr, *poffs = nullptr;
+  int precision = 0, scale = 0;
+  if (!PyArg_ParseTuple(res, "sLOOOii", &kind, &n, &pdata, &pvalid, &poffs,
+                        &precision, &scale)) {
+    Py_DECREF(res);
+    capture_py_error();
+    return SRJ_ERR;
+  }
+  std::strncpy(out->kind, kind, sizeof(out->kind) - 1);
+  out->n = n;
+  out->precision = precision;
+  out->scale = scale;
+  out->data = copy_bytes(pdata, &out->data_len);
+  int64_t vlen = 0;
+  out->validity = copy_bytes(pvalid, &vlen);
+  if (poffs != Py_None) {
+    int64_t olen = 0;
+    out->offsets = reinterpret_cast<int32_t*>(copy_bytes(poffs, &olen));
+  }
+  Py_DECREF(res);
+  if (out->data == nullptr || out->validity == nullptr) {
+    srj_free_host_column(out);
+    set_error("host export alloc failed", SRJ_ERR_OOM);
+    return SRJ_ERR_OOM;
+  }
+  return SRJ_OK;
+}
+
+void srj_free_host_column(SrjHostColumn* out) {
+  std::free(out->data);
+  std::free(out->validity);
+  std::free(out->offsets);
+  out->data = nullptr;
+  out->validity = nullptr;
+  out->offsets = nullptr;
+}
+
+int64_t srj_num_rows(int64_t handle) {
+  if (!module_ready()) return -1;
+  if (handle == 0) {
+    set_error("null column handle", SRJ_ERR);
+    return -1;
+  }
+  Gil gil;
+  PyObject* n = PyObject_GetAttrString(handle_obj(handle), "num_rows");
+  if (n == nullptr) {
+    capture_py_error();
+    return -1;
+  }
+  int64_t v = static_cast<int64_t>(PyLong_AsLongLong(n));
+  Py_DECREF(n);
+  return v;
+}
+
+int srj_invoke(const char* op, const char* args_json,
+               const int64_t* in_handles, int n_in, int64_t* out_handles,
+               int max_out) {
+  if (!module_ready()) return -1;
+  for (int i = 0; i < n_in; ++i) {
+    if (in_handles[i] == 0) {
+      set_error("null/closed handle passed to invoke", SRJ_ERR);
+      return -1;
+    }
+  }
+  Gil gil;
+  PyObject* objs = PyList_New(n_in);
+  if (objs == nullptr) {
+    capture_py_error();
+    return -1;
+  }
+  for (int i = 0; i < n_in; ++i) {
+    PyObject* o = handle_obj(in_handles[i]);
+    Py_INCREF(o);
+    PyList_SET_ITEM(objs, i, o);
+  }
+  PyObject* args = Py_BuildValue(
+      "(ssN)", op, args_json != nullptr ? args_json : "", objs);
+  if (args == nullptr) {
+    capture_py_error();
+    return -1;
+  }
+  PyObject* res = call_bridge("invoke", args);
+  if (res == nullptr) return -1;
+  PyObject *out_list = nullptr, *meta = nullptr;
+  if (!PyArg_ParseTuple(res, "OO", &out_list, &meta)) {
+    Py_DECREF(res);
+    capture_py_error();
+    return -1;
+  }
+  const char* meta_c = PyUnicode_AsUTF8(meta);
+  g_invoke_json = meta_c != nullptr ? meta_c : "{}";
+  Py_ssize_t n_out = PyList_Size(out_list);
+  if (n_out > max_out) {
+    Py_DECREF(res);
+    set_error("too many results for out_handles buffer", SRJ_ERR);
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < n_out; ++i) {
+    PyObject* o = PyList_GET_ITEM(out_list, i); /* borrowed */
+    Py_INCREF(o);
+    out_handles[i] = obj_handle(o);
+  }
+  Py_DECREF(res);
+  return static_cast<int>(n_out);
+}
+
+const char* srj_invoke_json(void) { return g_invoke_json.c_str(); }
+
+const char* srj_last_error(void) { return g_error.c_str(); }
+
+int srj_last_error_code(void) { return g_error_code; }
+
+void srj_release(int64_t handle) {
+  if (handle == 0 || g_module == nullptr) return;
+  Gil gil;
+  Py_DECREF(handle_obj(handle));
+}
+
+} /* extern "C" */

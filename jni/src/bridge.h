@@ -1,0 +1,94 @@
+/* C ABI of the TPU JNI bridge.
+ *
+ * Role of the reference's per-class JNI glue (reference
+ * src/main/cpp/src/XxxJni.cpp, 15 files): marshal host buffers across the
+ * native boundary, translate the exception family, and dispatch ops.  Here
+ * the op surface is one generic entry (srj_invoke) into an embedded CPython
+ * running spark_rapids_jni_tpu.jni_bridge; columns cross as Arrow-style
+ * host buffers exactly once at construction/export.
+ *
+ * Thread model: any thread may call any function; the bridge takes the GIL
+ * per call (PyGILState).  Handles are CPython object references owned by
+ * the bridge; release with srj_release.
+ */
+#ifndef SRJ_BRIDGE_H
+#define SRJ_BRIDGE_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* error codes shared with jni_bridge.classify_exception and the Java
+ * exception family (GpuRetryOOM.java etc.) */
+enum SrjErrorCode {
+  SRJ_OK = 0,
+  SRJ_ERR = 1,               /* generic -> RuntimeException */
+  SRJ_ERR_CAST = 2,          /* CastException */
+  SRJ_ERR_RETRY_OOM = 3,     /* GpuRetryOOM */
+  SRJ_ERR_SPLIT_OOM = 4,     /* GpuSplitAndRetryOOM */
+  SRJ_ERR_OOM = 5,           /* GpuOOM */
+  SRJ_ERR_CPU_RETRY_OOM = 6, /* CpuRetryOOM */
+  SRJ_ERR_CPU_SPLIT_OOM = 7  /* CpuSplitAndRetryOOM */
+};
+
+/* Initialize the embedded interpreter (no-op when hosted inside Python,
+ * e.g. under the ctypes test harness).  python_path, when non-NULL, is
+ * prepended to sys.path so the spark_rapids_jni_tpu package resolves.
+ * Returns SRJ_OK or SRJ_ERR. */
+int srj_init(const char* python_path);
+void srj_shutdown(void);
+
+/* ---- columns ---------------------------------------------------------- */
+
+/* kind: "int8"|"int16"|"int32"|"int64"|"float32"|"float64"|"boolean"|
+ *       "date"|"timestamp"|"decimal".  data is little-endian packed
+ * (decimal: 16 B/row two's complement).  validity: one byte per row,
+ * NULL = all valid.  Returns a handle (0 on error). */
+int64_t srj_column_from_host(const char* kind, int64_t n, const void* data,
+                             int64_t data_len, const uint8_t* validity,
+                             int precision, int scale);
+
+/* chars: concatenated UTF-8; offsets: int32[n+1]. */
+int64_t srj_string_column_from_host(const uint8_t* chars, int64_t chars_len,
+                                    const int32_t* offsets,
+                                    const uint8_t* validity, int64_t n);
+
+typedef struct {
+  char kind[16];
+  int64_t n;
+  uint8_t* data; /* malloc'd; free via srj_free_host_column */
+  int64_t data_len;
+  uint8_t* validity; /* byte per row */
+  int32_t* offsets;  /* strings only, else NULL; int32[n+1] */
+  int precision;
+  int scale;
+} SrjHostColumn;
+
+int srj_column_to_host(int64_t handle, SrjHostColumn* out);
+void srj_free_host_column(SrjHostColumn* out);
+int64_t srj_num_rows(int64_t handle);
+
+/* ---- generic op dispatch ---------------------------------------------- */
+
+/* Runs jni_bridge.invoke(op, args_json, [handles...]).  Writes up to
+ * max_out result handles; returns the result count, or -1 on error (see
+ * srj_last_error / srj_last_error_code).  Result metadata JSON from the
+ * op (scalars, serialized bytes as base64) is readable via
+ * srj_invoke_json until the next call on the same thread. */
+int srj_invoke(const char* op, const char* args_json,
+               const int64_t* in_handles, int n_in, int64_t* out_handles,
+               int max_out);
+const char* srj_invoke_json(void);
+
+const char* srj_last_error(void);
+int srj_last_error_code(void);
+
+void srj_release(int64_t handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* SRJ_BRIDGE_H */

@@ -1,0 +1,463 @@
+/* JNI entry points for the com.nvidia.spark.rapids.jni mirror classes.
+ *
+ * Role of the reference's fifteen src/main/cpp/src/XxxJni.cpp files, in
+ * one file: the kernel surface funnels through the generic bridge
+ * (bridge.h srj_invoke -> embedded CPython dispatcher), while the
+ * resource-adaptor surface forwards straight to the tra_* C ABI of
+ * libtpu_resource_adaptor.so (mem/native/resource_adaptor.cpp) — the
+ * SAME in-process instance the Python facade drives, since the dynamic
+ * loader maps the library once per process.
+ *
+ * Error contract mirrors CATCH_STD/CATCH_CAST_EXCEPTION: bridge error
+ * codes map onto the Java exception family (CastException, GpuRetryOOM,
+ * GpuSplitAndRetryOOM, GpuOOM, RuntimeException).
+ */
+#ifdef SRJ_JNI_STUB
+#include "jni_stub.h"
+#else
+#include <jni.h>
+#endif
+
+#include <dlfcn.h>
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "bridge.h"
+
+#define JNI_CLASS(name) Java_com_nvidia_spark_rapids_jni_##name
+
+namespace {
+
+const char* const kPkg = "com/nvidia/spark/rapids/jni/";
+
+void throw_java(JNIEnv* env, const char* cls_name, const char* msg) {
+  if (env->ExceptionCheck()) return;
+  std::string full = std::string(kPkg) + cls_name;
+  jclass cls = env->FindClass(full.c_str());
+  if (cls == nullptr) {
+    env->ExceptionClear();
+    cls = env->FindClass("java/lang/RuntimeException");
+  }
+  if (cls != nullptr) env->ThrowNew(cls, msg);
+}
+
+/* Map srj_last_error_code onto the Java exception family. */
+void throw_bridge_error(JNIEnv* env) {
+  const char* msg = srj_last_error();
+  switch (srj_last_error_code()) {
+    case SRJ_ERR_CAST: throw_java(env, "CastException", msg); break;
+    case SRJ_ERR_RETRY_OOM: throw_java(env, "GpuRetryOOM", msg); break;
+    case SRJ_ERR_SPLIT_OOM: throw_java(env, "GpuSplitAndRetryOOM", msg); break;
+    case SRJ_ERR_OOM: throw_java(env, "GpuOOM", msg); break;
+    case SRJ_ERR_CPU_RETRY_OOM: throw_java(env, "CpuRetryOOM", msg); break;
+    case SRJ_ERR_CPU_SPLIT_OOM:
+      throw_java(env, "CpuSplitAndRetryOOM", msg);
+      break;
+    default:
+      throw_java(env, nullptr, msg);  /* RuntimeException */
+      break;
+  }
+}
+
+/* JNI strings are *modified* UTF-8: supplementary chars arrive as CESU-8
+ * surrogate pairs and NUL as 0xC0 0x80.  The bridge (and CPython) require
+ * strict UTF-8, so re-encode before crossing. */
+std::string from_modified_utf8(const char* m) {
+  std::string out;
+  const unsigned char* p = reinterpret_cast<const unsigned char*>(m);
+  while (*p != 0) {
+    if (p[0] == 0xC0 && p[1] == 0x80) { /* embedded NUL */
+      out.push_back('\0');
+      p += 2;
+    } else if (p[0] == 0xED && (p[1] & 0xF0) == 0xA0 && p[2] != 0 &&
+               p[3] == 0xED && (p[4] & 0xF0) == 0xB0) {
+      /* CESU-8 surrogate pair -> one 4-byte UTF-8 sequence */
+      uint32_t hi = ((p[1] & 0x0F) << 6) | (p[2] & 0x3F);
+      uint32_t lo = ((p[4] & 0x0F) << 6) | (p[5] & 0x3F);
+      uint32_t cp = 0x10000 + ((hi & 0x3FF) << 10) + (lo & 0x3FF);
+      out.push_back(static_cast<char>(0xF0 | (cp >> 18)));
+      out.push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3F)));
+      out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+      out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+      p += 6;
+    } else {
+      out.push_back(static_cast<char>(*p));
+      p += 1;
+    }
+  }
+  return out;
+}
+
+struct Utf {
+  JNIEnv* env;
+  jstring s;
+  const char* c;
+  std::string owned;
+  Utf(JNIEnv* e, jstring str) : env(e), s(str), c(nullptr) {
+    if (s != nullptr) {
+      const char* raw = env->GetStringUTFChars(s, nullptr);
+      if (raw != nullptr) {
+        owned = from_modified_utf8(raw);
+        env->ReleaseStringUTFChars(s, raw);
+        c = owned.c_str();
+      }
+    }
+  }
+};
+
+std::vector<uint8_t> byte_vec(JNIEnv* env, jbyteArray a) {
+  std::vector<uint8_t> out;
+  if (a == nullptr) return out;
+  jsize n = env->GetArrayLength(a);
+  out.resize(static_cast<size_t>(n));
+  if (n > 0)
+    env->GetByteArrayRegion(a, 0, n, reinterpret_cast<jbyte*>(out.data()));
+  return out;
+}
+
+std::vector<int64_t> long_vec(JNIEnv* env, jlongArray a) {
+  std::vector<int64_t> out;
+  if (a == nullptr) return out;
+  jsize n = env->GetArrayLength(a);
+  out.resize(static_cast<size_t>(n));
+  if (n > 0)
+    env->GetLongArrayRegion(a, 0, n, reinterpret_cast<jlong*>(out.data()));
+  return out;
+}
+
+/* ---- resource adaptor dynamic binding -------------------------------- */
+
+struct TraApi {
+  void* (*create)(long, const char*) = nullptr;
+  void (*destroy)(void*) = nullptr;
+  void (*set_blocked_callback)(void*, int (*)(long)) = nullptr;
+  void (*start_dedicated)(void*, long, long) = nullptr;
+  void (*pool_working)(void*, int, long, const long*, int) = nullptr;
+  void (*pool_finished)(void*, long, const long*, int) = nullptr;
+  void (*remove_assoc)(void*, long, long) = nullptr;
+  void (*task_done)(void*, long) = nullptr;
+  int (*allocate)(void*, long, long) = nullptr;
+  void (*deallocate)(void*, long, long) = nullptr;
+  int (*block_until_ready)(void*, long) = nullptr;
+  int (*get_state)(void*, long) = nullptr;
+  int (*check_deadlocks)(void*) = nullptr;
+  void (*force_retry)(void*, long, int, int) = nullptr;
+  void (*force_split)(void*, long, int, int) = nullptr;
+  void (*force_exc)(void*, long, int, int) = nullptr;
+  long (*get_metric)(void*, long, int) = nullptr;
+  long (*total_alloc)(void*) = nullptr;
+  long (*max_alloc)(void*) = nullptr;
+  bool ok = false;
+};
+
+TraApi g_tra;
+JavaVM* g_vm = nullptr;
+
+bool load_tra(JNIEnv* env) {
+  if (g_tra.ok) return true;
+  const char* path = std::getenv("SRJ_ADAPTOR_LIB");
+  void* h = dlopen(path != nullptr ? path : "libtpu_resource_adaptor.so",
+                   RTLD_NOW | RTLD_GLOBAL);
+  if (h == nullptr) {
+    throw_java(env, nullptr, "cannot load libtpu_resource_adaptor.so (set "
+                             "SRJ_ADAPTOR_LIB)");
+    return false;
+  }
+#define TRA_SYM(field, sym) \
+  *reinterpret_cast<void**>(&g_tra.field) = dlsym(h, sym)
+  TRA_SYM(create, "tra_create");
+  TRA_SYM(destroy, "tra_destroy");
+  TRA_SYM(set_blocked_callback, "tra_set_blocked_callback");
+  TRA_SYM(start_dedicated, "tra_start_dedicated_task_thread");
+  TRA_SYM(pool_working, "tra_pool_thread_working_on_tasks");
+  TRA_SYM(pool_finished, "tra_pool_thread_finished_for_tasks");
+  TRA_SYM(remove_assoc, "tra_remove_thread_association");
+  TRA_SYM(task_done, "tra_task_done");
+  TRA_SYM(allocate, "tra_allocate");
+  TRA_SYM(deallocate, "tra_deallocate");
+  TRA_SYM(block_until_ready, "tra_block_thread_until_ready");
+  TRA_SYM(get_state, "tra_get_state_of");
+  TRA_SYM(check_deadlocks, "tra_check_and_break_deadlocks");
+  TRA_SYM(force_retry, "tra_force_retry_oom");
+  TRA_SYM(force_split, "tra_force_split_retry_oom");
+  TRA_SYM(force_exc, "tra_force_cudf_exception");
+  TRA_SYM(get_metric, "tra_get_and_reset_metric");
+  TRA_SYM(total_alloc, "tra_total_allocated");
+  TRA_SYM(max_alloc, "tra_max_allocated");
+#undef TRA_SYM
+  if (g_tra.create == nullptr || g_tra.allocate == nullptr) {
+    throw_java(env, nullptr, "libtpu_resource_adaptor.so missing tra_ symbols");
+    return false;
+  }
+  g_tra.ok = true;
+  return true;
+}
+
+/* Blocked-thread classifier: native deadlock scan -> JVM
+ * ThreadStateRegistry.isThreadBlocked (reference
+ * SparkResourceAdaptorJni.cpp:1506 calling ThreadStateRegistry.java:44). */
+int is_thread_blocked_cb(long thread_id) {
+  if (g_vm == nullptr) return 0;
+  JNIEnv* env = nullptr;
+  bool attached = false;
+  if (g_vm->GetEnv(reinterpret_cast<void**>(&env), JNI_VERSION_1_6) != JNI_OK) {
+    if (g_vm->AttachCurrentThreadAsDaemon(reinterpret_cast<void**>(&env),
+                                          nullptr) != JNI_OK)
+      return 0;
+    attached = true;
+  }
+  int blocked = 0;
+  jclass cls = env->FindClass(
+      "com/nvidia/spark/rapids/jni/ThreadStateRegistry");
+  if (cls != nullptr) {
+    jmethodID mid = env->GetStaticMethodID(cls, "isThreadBlocked", "(J)Z");
+    if (mid != nullptr) {
+      blocked = env->CallStaticBooleanMethod(
+                    cls, mid, static_cast<jlong>(thread_id)) != JNI_FALSE
+                    ? 1
+                    : 0;
+    }
+  }
+  if (env->ExceptionCheck()) env->ExceptionClear();
+  if (attached) g_vm->DetachCurrentThread();
+  return blocked;
+}
+
+} /* namespace */
+
+extern "C" {
+
+/* ===== NativeDepsLoader ================================================ */
+
+JNIEXPORT jint JNICALL JNI_CLASS(NativeDepsLoader_initBridge)(
+    JNIEnv* env, jclass, jstring python_path) {
+  env->GetJavaVM(&g_vm);
+  Utf p(env, python_path);
+  return srj_init(p.c != nullptr ? p.c : "");
+}
+
+JNIEXPORT jstring JNICALL JNI_CLASS(NativeDepsLoader_lastError)(
+    JNIEnv* env, jclass) {
+  return env->NewStringUTF(srj_last_error());
+}
+
+/* ===== Bridge ========================================================== */
+
+JNIEXPORT jlong JNICALL JNI_CLASS(Bridge_columnFromHost)(
+    JNIEnv* env, jclass, jstring kind, jlong rows, jbyteArray data,
+    jbyteArray validity, jint precision, jint scale) {
+  Utf k(env, kind);
+  auto d = byte_vec(env, data);
+  auto v = byte_vec(env, validity);
+  int64_t h = srj_column_from_host(
+      k.c, rows, d.data(), static_cast<int64_t>(d.size()),
+      validity != nullptr ? v.data() : nullptr, precision, scale);
+  if (h == 0) throw_bridge_error(env);
+  return static_cast<jlong>(h);
+}
+
+JNIEXPORT jlong JNICALL JNI_CLASS(Bridge_stringColumnFromHost)(
+    JNIEnv* env, jclass, jbyteArray chars, jintArray offsets,
+    jbyteArray validity, jlong rows) {
+  auto c = byte_vec(env, chars);
+  auto v = byte_vec(env, validity);
+  jsize n_off = env->GetArrayLength(offsets);
+  std::vector<int32_t> offs(static_cast<size_t>(n_off));
+  env->GetIntArrayRegion(offsets, 0, n_off,
+                         reinterpret_cast<jint*>(offs.data()));
+  int64_t h = srj_string_column_from_host(
+      c.data(), static_cast<int64_t>(c.size()), offs.data(),
+      validity != nullptr ? v.data() : nullptr, rows);
+  if (h == 0) throw_bridge_error(env);
+  return static_cast<jlong>(h);
+}
+
+JNIEXPORT jobject JNICALL JNI_CLASS(Bridge_columnToHost)(
+    JNIEnv* env, jclass, jlong handle) {
+  SrjHostColumn hc;
+  if (srj_column_to_host(handle, &hc) != SRJ_OK) {
+    throw_bridge_error(env);
+    return nullptr;
+  }
+  jclass cls = env->FindClass("com/nvidia/spark/rapids/jni/Bridge$HostColumn");
+  if (cls == nullptr) return nullptr;
+  jmethodID ctor = env->GetMethodID(cls, "<init>", "()V");
+  jobject obj = env->NewObject(cls, ctor);
+  env->SetObjectField(obj,
+                      env->GetFieldID(cls, "kind", "Ljava/lang/String;"),
+                      env->NewStringUTF(hc.kind));
+  env->SetLongField(obj, env->GetFieldID(cls, "rows", "J"), hc.n);
+  env->SetIntField(obj, env->GetFieldID(cls, "precision", "I"), hc.precision);
+  env->SetIntField(obj, env->GetFieldID(cls, "scale", "I"), hc.scale);
+  jbyteArray data = env->NewByteArray(static_cast<jsize>(hc.data_len));
+  env->SetByteArrayRegion(data, 0, static_cast<jsize>(hc.data_len),
+                          reinterpret_cast<const jbyte*>(hc.data));
+  env->SetObjectField(obj, env->GetFieldID(cls, "data", "[B"), data);
+  jbyteArray valid = env->NewByteArray(static_cast<jsize>(hc.n));
+  env->SetByteArrayRegion(valid, 0, static_cast<jsize>(hc.n),
+                          reinterpret_cast<const jbyte*>(hc.validity));
+  env->SetObjectField(obj, env->GetFieldID(cls, "validity", "[B"), valid);
+  if (hc.offsets != nullptr) {
+    jintArray offs = env->NewIntArray(static_cast<jsize>(hc.n + 1));
+    env->SetIntArrayRegion(offs, 0, static_cast<jsize>(hc.n + 1),
+                           reinterpret_cast<const jint*>(hc.offsets));
+    env->SetObjectField(obj, env->GetFieldID(cls, "offsets", "[I"), offs);
+  }
+  srj_free_host_column(&hc);
+  return obj;
+}
+
+JNIEXPORT jlong JNICALL JNI_CLASS(Bridge_numRows)(JNIEnv* env, jclass,
+                                                  jlong handle) {
+  int64_t n = srj_num_rows(handle);
+  if (n < 0) throw_bridge_error(env);
+  return static_cast<jlong>(n);
+}
+
+JNIEXPORT void JNICALL JNI_CLASS(Bridge_release)(JNIEnv*, jclass,
+                                                 jlong handle) {
+  srj_release(handle);
+}
+
+JNIEXPORT jlongArray JNICALL JNI_CLASS(Bridge_invoke)(
+    JNIEnv* env, jclass, jstring op, jstring args_json, jlongArray handles) {
+  Utf o(env, op);
+  Utf a(env, args_json);
+  auto in = long_vec(env, handles);
+  /* wide enough for any op: convertFromRows emits one handle per schema
+   * column, and the reference supports up to ~250M columns via batching —
+   * here the bound is the 2GB row-image batch, far under 4096 handles */
+  std::vector<int64_t> out(4096);
+  int n = srj_invoke(o.c, a.c, in.data(), static_cast<int>(in.size()),
+                     out.data(), static_cast<int>(out.size()));
+  if (n < 0) {
+    throw_bridge_error(env);
+    return nullptr;
+  }
+  jlongArray res = env->NewLongArray(n);
+  if (n > 0)
+    env->SetLongArrayRegion(res, 0, n,
+                            reinterpret_cast<const jlong*>(out.data()));
+  return res;
+}
+
+JNIEXPORT jstring JNICALL JNI_CLASS(Bridge_lastInvokeJson)(JNIEnv* env,
+                                                           jclass) {
+  return env->NewStringUTF(srj_invoke_json());
+}
+
+/* ===== SparkResourceAdaptor ============================================ */
+
+#define TRA_HANDLE(h) reinterpret_cast<void*>(static_cast<intptr_t>(h))
+
+JNIEXPORT jlong JNICALL JNI_CLASS(SparkResourceAdaptor_create)(
+    JNIEnv* env, jclass, jlong pool_bytes, jstring log_loc) {
+  env->GetJavaVM(&g_vm);
+  if (!load_tra(env)) return 0;
+  Utf log(env, log_loc);
+  void* h = g_tra.create(static_cast<long>(pool_bytes), log.c);
+  g_tra.set_blocked_callback(h, is_thread_blocked_cb);
+  return static_cast<jlong>(reinterpret_cast<intptr_t>(h));
+}
+
+JNIEXPORT void JNICALL JNI_CLASS(SparkResourceAdaptor_destroy)(
+    JNIEnv*, jclass, jlong handle) {
+  if (g_tra.ok) g_tra.destroy(TRA_HANDLE(handle));
+}
+
+JNIEXPORT void JNICALL JNI_CLASS(SparkResourceAdaptor_startDedicatedTaskThread)(
+    JNIEnv*, jclass, jlong handle, jlong tid, jlong task) {
+  g_tra.start_dedicated(TRA_HANDLE(handle), static_cast<long>(tid),
+                        static_cast<long>(task));
+}
+
+JNIEXPORT void JNICALL JNI_CLASS(SparkResourceAdaptor_poolThreadWorkingOnTasks)(
+    JNIEnv* env, jclass, jlong handle, jboolean shuffle, jlong tid,
+    jlongArray tasks) {
+  auto t = long_vec(env, tasks);
+  std::vector<long> tl(t.begin(), t.end());
+  g_tra.pool_working(TRA_HANDLE(handle), shuffle != JNI_FALSE ? 1 : 0,
+                     static_cast<long>(tid), tl.data(),
+                     static_cast<int>(tl.size()));
+}
+
+JNIEXPORT void JNICALL JNI_CLASS(SparkResourceAdaptor_poolThreadFinishedForTasks)(
+    JNIEnv* env, jclass, jlong handle, jlong tid, jlongArray tasks) {
+  auto t = long_vec(env, tasks);
+  std::vector<long> tl(t.begin(), t.end());
+  g_tra.pool_finished(TRA_HANDLE(handle), static_cast<long>(tid), tl.data(),
+                      static_cast<int>(tl.size()));
+}
+
+JNIEXPORT void JNICALL JNI_CLASS(SparkResourceAdaptor_removeThreadAssociation)(
+    JNIEnv*, jclass, jlong handle, jlong tid, jlong task) {
+  g_tra.remove_assoc(TRA_HANDLE(handle), static_cast<long>(tid),
+                     static_cast<long>(task));
+}
+
+JNIEXPORT void JNICALL JNI_CLASS(SparkResourceAdaptor_taskDone)(
+    JNIEnv*, jclass, jlong handle, jlong task) {
+  g_tra.task_done(TRA_HANDLE(handle), static_cast<long>(task));
+}
+
+JNIEXPORT jint JNICALL JNI_CLASS(SparkResourceAdaptor_allocate)(
+    JNIEnv*, jclass, jlong handle, jlong tid, jlong bytes) {
+  return g_tra.allocate(TRA_HANDLE(handle), static_cast<long>(tid),
+                        static_cast<long>(bytes));
+}
+
+JNIEXPORT void JNICALL JNI_CLASS(SparkResourceAdaptor_deallocate)(
+    JNIEnv*, jclass, jlong handle, jlong tid, jlong bytes) {
+  g_tra.deallocate(TRA_HANDLE(handle), static_cast<long>(tid),
+                   static_cast<long>(bytes));
+}
+
+JNIEXPORT jint JNICALL JNI_CLASS(SparkResourceAdaptor_blockThreadUntilReady)(
+    JNIEnv*, jclass, jlong handle, jlong tid) {
+  return g_tra.block_until_ready(TRA_HANDLE(handle), static_cast<long>(tid));
+}
+
+JNIEXPORT jint JNICALL JNI_CLASS(SparkResourceAdaptor_getStateOf)(
+    JNIEnv*, jclass, jlong handle, jlong tid) {
+  return g_tra.get_state(TRA_HANDLE(handle), static_cast<long>(tid));
+}
+
+JNIEXPORT jint JNICALL JNI_CLASS(SparkResourceAdaptor_checkAndBreakDeadlocks)(
+    JNIEnv*, jclass, jlong handle) {
+  return g_tra.check_deadlocks(TRA_HANDLE(handle));
+}
+
+JNIEXPORT void JNICALL JNI_CLASS(SparkResourceAdaptor_forceRetryOOM)(
+    JNIEnv*, jclass, jlong handle, jlong tid, jint num, jint skip) {
+  g_tra.force_retry(TRA_HANDLE(handle), static_cast<long>(tid), num, skip);
+}
+
+JNIEXPORT void JNICALL JNI_CLASS(SparkResourceAdaptor_forceSplitAndRetryOOM)(
+    JNIEnv*, jclass, jlong handle, jlong tid, jint num, jint skip) {
+  g_tra.force_split(TRA_HANDLE(handle), static_cast<long>(tid), num, skip);
+}
+
+JNIEXPORT void JNICALL JNI_CLASS(SparkResourceAdaptor_forceCudfException)(
+    JNIEnv*, jclass, jlong handle, jlong tid, jint num, jint skip) {
+  g_tra.force_exc(TRA_HANDLE(handle), static_cast<long>(tid), num, skip);
+}
+
+JNIEXPORT jlong JNICALL JNI_CLASS(SparkResourceAdaptor_getAndResetMetric)(
+    JNIEnv*, jclass, jlong handle, jlong task, jint which) {
+  return static_cast<jlong>(
+      g_tra.get_metric(TRA_HANDLE(handle), static_cast<long>(task), which));
+}
+
+JNIEXPORT jlong JNICALL JNI_CLASS(SparkResourceAdaptor_totalAllocated)(
+    JNIEnv*, jclass, jlong handle) {
+  return static_cast<jlong>(g_tra.total_alloc(TRA_HANDLE(handle)));
+}
+
+JNIEXPORT jlong JNICALL JNI_CLASS(SparkResourceAdaptor_maxAllocated)(
+    JNIEnv*, jclass, jlong handle) {
+  return static_cast<jlong>(g_tra.max_alloc(TRA_HANDLE(handle)));
+}
+
+} /* extern "C" */

@@ -1,0 +1,85 @@
+/* Minimal JNI type/API declarations for compile-checking jni_glue.cpp in
+ * images without a JDK (enabled by -DSRJ_JNI_STUB; a real build includes
+ * <jni.h>).  Only the subset the glue uses is declared; nothing here is
+ * ever linked or executed — the check exists to catch signature drift in
+ * CI the way the reference's premerge compile does.
+ */
+#ifndef SRJ_JNI_STUB_H
+#define SRJ_JNI_STUB_H
+
+#include <stdint.h>
+
+typedef int32_t jint;
+typedef int64_t jlong;
+typedef int8_t jbyte;
+typedef uint8_t jboolean;
+typedef uint16_t jchar;
+typedef int16_t jshort;
+typedef float jfloat;
+typedef double jdouble;
+typedef jint jsize;
+
+class _jobject {};
+typedef _jobject* jobject;
+typedef jobject jclass;
+typedef jobject jstring;
+typedef jobject jarray;
+typedef jobject jbyteArray;
+typedef jobject jintArray;
+typedef jobject jlongArray;
+typedef jobject jobjectArray;
+typedef jobject jthrowable;
+
+struct jfieldID_;
+typedef jfieldID_* jfieldID;
+struct jmethodID_;
+typedef jmethodID_* jmethodID;
+
+#define JNI_FALSE 0
+#define JNI_TRUE 1
+#define JNI_OK 0
+#define JNI_VERSION_1_6 0x00010006
+
+#define JNIEXPORT __attribute__((visibility("default")))
+#define JNIIMPORT
+#define JNICALL
+
+struct JNIEnv {
+  jclass FindClass(const char* name);
+  jint ThrowNew(jclass clazz, const char* msg);
+  jboolean ExceptionCheck();
+  void ExceptionClear();
+  const char* GetStringUTFChars(jstring s, jboolean* isCopy);
+  void ReleaseStringUTFChars(jstring s, const char* chars);
+  jstring NewStringUTF(const char* bytes);
+  jsize GetArrayLength(jarray a);
+  jbyteArray NewByteArray(jsize len);
+  void GetByteArrayRegion(jbyteArray a, jsize start, jsize len, jbyte* buf);
+  void SetByteArrayRegion(jbyteArray a, jsize start, jsize len, const jbyte* buf);
+  jintArray NewIntArray(jsize len);
+  void SetIntArrayRegion(jintArray a, jsize start, jsize len, const jint* buf);
+  void GetIntArrayRegion(jintArray a, jsize start, jsize len, jint* buf);
+  jlongArray NewLongArray(jsize len);
+  void SetLongArrayRegion(jlongArray a, jsize start, jsize len, const jlong* buf);
+  void GetLongArrayRegion(jlongArray a, jsize start, jsize len, jlong* buf);
+  jfieldID GetFieldID(jclass clazz, const char* name, const char* sig);
+  jmethodID GetMethodID(jclass clazz, const char* name, const char* sig);
+  jmethodID GetStaticMethodID(jclass clazz, const char* name, const char* sig);
+  jobject NewObject(jclass clazz, jmethodID ctor, ...);
+  void SetObjectField(jobject obj, jfieldID f, jobject v);
+  void SetLongField(jobject obj, jfieldID f, jlong v);
+  void SetIntField(jobject obj, jfieldID f, jint v);
+  jboolean CallStaticBooleanMethod(jclass clazz, jmethodID m, ...);
+  jint GetJavaVM(struct JavaVM** vm);
+  jclass GetObjectClass(jobject obj);
+  jobject NewGlobalRef(jobject obj);
+  void DeleteGlobalRef(jobject obj);
+};
+
+struct JavaVM {
+  jint GetEnv(void** env, jint version);
+  jint AttachCurrentThreadAsDaemon(void** env, void* args);
+  jint DetachCurrentThread();
+};
+
+#endif /* SRJ_JNI_STUB_H */

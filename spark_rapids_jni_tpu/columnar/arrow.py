@@ -119,10 +119,11 @@ def array_to_column(arr):
         lens = np.diff(offsets)
         if np.any(~valid & (lens > 0)):
             keep_lens = np.where(valid, lens, 0)
-            take = np.concatenate(
-                [np.arange(offsets[i], offsets[i] + keep_lens[i])
-                 for i in range(n)] or [np.array([], np.int64)]
-            ).astype(np.int64)
+            total = int(keep_lens.sum())
+            within = np.arange(total) - np.repeat(
+                np.cumsum(keep_lens) - keep_lens, keep_lens)
+            take = (np.repeat(offsets[:-1].astype(np.int64), keep_lens)
+                    + within)
             child = child.take(pa.array(take))
             offsets = np.concatenate(
                 [[0], np.cumsum(keep_lens)]).astype(np.int32)

@@ -1,0 +1,557 @@
+"""Host-boundary dispatcher behind the Java/JNI API surface.
+
+The reference exposes 26 Java classes (``com.nvidia.spark.rapids.jni.*``,
+reference ``src/main/java/.../jni/*.java``) whose static native methods land
+in per-class JNI glue (``src/main/cpp/src/*Jni.cpp``).  Here the native side
+is one C-ABI bridge library (``jni/src/bridge.cpp``) that embeds CPython and
+funnels every op through :func:`invoke` — argument marshaling happens once,
+in Python, where the kernels live, instead of 15 hand-written marshaling
+files.  The Java classes (``jni/java/...``) keep the reference's public
+signatures (e.g. ``CastStrings.toInteger`` ``CastStrings.java:49``,
+``Hash.murmurHash32`` ``Hash.java:40``) and call the bridge through thin
+JNI glue (``jni/src/jni_glue.cpp``).
+
+Handles are live Python objects (columns, bloom filters, footers) whose
+references are owned by the C++ side; there is no serialization on the hot
+path — host buffers cross the boundary exactly once at column construction.
+
+Columns cross as Arrow-style host buffers:
+
+* fixed width:  ``data`` little-endian packed values, ``validity`` one byte
+  per row (empty = all valid)
+* strings:      ``data`` concatenated UTF-8 chars + ``offsets`` int32[n+1]
+* decimal128:   ``data`` 16 bytes per row, little-endian two's complement
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+
+import numpy as np
+
+
+def _types():
+    from .columnar import types as T
+
+    return T
+
+
+def _valid_arr(validity: bytes, n: int):
+    if not validity:
+        return np.ones(n, dtype=np.bool_)
+    return np.frombuffer(validity, dtype=np.uint8, count=n).astype(np.bool_)
+
+
+def column_from_host(kind_name: str, n: int, data: bytes, validity: bytes,
+                     precision: int = 0, scale: int = 0):
+    """Build a device column from host buffers (one copy, then HBM)."""
+    import jax.numpy as jnp
+
+    T = _types()
+    kind = T.Kind(kind_name)
+    valid = _valid_arr(validity, n)
+    if kind is T.Kind.DECIMAL:
+        from .columnar.column import Decimal128Column
+
+        raw = np.frombuffer(data, dtype=np.uint64, count=2 * n).reshape(n, 2)
+        return Decimal128Column(
+            jnp.asarray(raw), jnp.asarray(valid),
+            T.SparkType.decimal(precision or 38, scale))
+    from .columnar.column import Column
+
+    st = T.SparkType(kind)
+    np_dtype = np.dtype(st.jnp_dtype)
+    arr = np.frombuffer(data, dtype=np_dtype, count=n)
+    return Column(jnp.asarray(arr), jnp.asarray(valid), st)
+
+
+def string_column_from_host(chars: bytes, offsets: bytes, validity: bytes,
+                            n: int):
+    """Ragged (chars, offsets) -> padded matrix, one vectorized scatter
+    (same shape as columnar/arrow.py _string_array_to_column)."""
+    import jax.numpy as jnp
+
+    from .columnar.column import StringColumn
+
+    offs = np.frombuffer(offsets, dtype=np.int32, count=n + 1)
+    valid = _valid_arr(validity, n)
+    # null rows must have zero extent (ListColumn/hash-fold invariant)
+    lengths = np.where(valid, offs[1:] - offs[:-1], 0).astype(np.int32)
+    max_len = max(int(lengths.max()) if n else 0, 1)
+    mat = np.zeros((n, max_len), dtype=np.uint8)
+    buf = np.frombuffer(chars, dtype=np.uint8)
+    if buf.size and lengths.sum():
+        row_idx = np.repeat(np.arange(n), lengths)
+        within = np.arange(lengths.sum()) - np.repeat(
+            np.cumsum(lengths) - lengths, lengths)
+        src = np.repeat(offs[:-1], lengths) + within
+        mat[row_idx, within] = buf[src]
+    return StringColumn(jnp.asarray(mat), jnp.asarray(lengths),
+                        jnp.asarray(valid))
+
+
+def column_to_host(col):
+    """-> (kind_name, n, data, validity, offsets|None, precision, scale)."""
+    import jax
+
+    from .columnar.column import Column, Decimal128Column, StringColumn
+
+    T = _types()
+    if isinstance(col, StringColumn):
+        chars = np.asarray(jax.device_get(col.chars))
+        lengths = np.asarray(jax.device_get(col.lengths))
+        valid = np.asarray(jax.device_get(col.validity))
+        n = len(lengths)
+        lens = np.where(valid, lengths, 0).astype(np.int64)
+        offs = np.zeros(n + 1, dtype=np.int32)
+        np.cumsum(lens, out=offs[1:])
+        # padded matrix -> ragged bytes with one boolean-mask gather
+        keep = np.arange(chars.shape[1])[None, :] < lens[:, None]
+        out = chars[keep]
+        return ("string", col.num_rows, out.tobytes(),
+                valid.astype(np.uint8).tobytes(), offs.tobytes(), 0, 0)
+    if isinstance(col, Decimal128Column):
+        limbs = np.asarray(jax.device_get(col.limbs)).astype(np.uint64)
+        valid = np.asarray(jax.device_get(col.validity))
+        return ("decimal", col.num_rows, limbs.tobytes(),
+                valid.astype(np.uint8).tobytes(), None,
+                col.dtype.precision, col.dtype.scale)
+    if isinstance(col, Column):
+        data = np.asarray(jax.device_get(col.data))
+        valid = np.asarray(jax.device_get(col.validity))
+        return (col.dtype.kind.value, col.num_rows, data.tobytes(),
+                valid.astype(np.uint8).tobytes(), None, 0, 0)
+    raise TypeError(f"not a host-exportable column: {type(col).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# op dispatch — names mirror the reference's native methods
+# ---------------------------------------------------------------------------
+
+def _kind_of(args):
+    T = _types()
+    kind = args["kind"]
+    # the JNI surface's UINT64 (conv() casts) stores the same 64 bits in
+    # our signed INT64 columns (types.py has no unsigned kinds)
+    if kind in ("uint64", "uint32", "uint16", "uint8"):
+        kind = "int" + kind[4:]
+    return T.SparkType(T.Kind(kind))
+
+
+def _op_cast_to_integer(args, objs):
+    from .ops import cast_string
+
+    return [cast_string.string_to_integer(
+        objs[0], _kind_of(args), ansi_mode=args["ansi"],
+        strip=args.get("strip", True))], {}
+
+
+def _op_cast_to_float(args, objs):
+    from .ops import cast_string
+
+    return [cast_string.string_to_float(
+        objs[0], _kind_of(args), ansi_mode=args["ansi"])], {}
+
+
+def _op_cast_to_decimal(args, objs):
+    from .ops import cast_string
+
+    return [cast_string.string_to_decimal(
+        objs[0], args["precision"], args["scale"], ansi_mode=args["ansi"],
+        strip=args.get("strip", True))], {}
+
+
+def _op_cast_from_float(args, objs):
+    from .ops.float_to_string import float_to_string
+
+    return [float_to_string(objs[0])], {}
+
+
+def _op_cast_from_float_fmt(args, objs):
+    from .ops.format_float import format_float
+
+    return [format_float(objs[0], args["digits"])], {}
+
+
+def _op_cast_from_decimal(args, objs):
+    from .ops.decimal_to_string import decimal_to_string
+
+    return [decimal_to_string(objs[0])], {}
+
+
+def _op_cast_to_int_base(args, objs):
+    from .ops import cast_string
+
+    return [cast_string.string_to_integer_with_base(
+        objs[0], _kind_of(args), base=args["base"],
+        ansi_mode=args["ansi"])], {}
+
+
+def _op_cast_from_int_base(args, objs):
+    from .ops import cast_string
+
+    return [cast_string.integer_to_string_with_base(
+        objs[0], base=args["base"])], {}
+
+
+def _op_murmur(args, objs):
+    from .ops.hashing import murmur_hash3_32
+
+    return [murmur_hash3_32(objs, seed=args.get("seed", 42))], {}
+
+
+def _op_xxhash(args, objs):
+    from .ops import hashing
+
+    return [hashing.xxhash64(
+        objs, seed=args.get("seed", hashing.DEFAULT_XXHASH64_SEED))], {}
+
+
+def _op_bloom_create(args, objs):
+    from .ops import bloom_filter as bf
+
+    nlongs = (args["bits"] + 63) // 64
+    return [bf.bloom_filter_create(args["num_hashes"], nlongs)], {}
+
+
+def _op_bloom_put(args, objs):
+    from .ops import bloom_filter as bf
+
+    return [bf.bloom_filter_put(objs[0], objs[1])], {}
+
+
+def _op_bloom_merge(args, objs):
+    from .ops import bloom_filter as bf
+
+    return [bf.bloom_filter_merge(objs)], {}
+
+
+def _op_bloom_probe(args, objs):
+    from .ops import bloom_filter as bf
+
+    return [bf.bloom_filter_probe(objs[0], objs[1])], {}
+
+
+def _op_bloom_serialize(args, objs):
+    from .ops import bloom_filter as bf
+
+    raw = bf.bloom_filter_serialize(objs[0])
+    return [], {"data": base64.b64encode(raw).decode("ascii")}
+
+
+def _op_bloom_deserialize(args, objs):
+    from .ops import bloom_filter as bf
+
+    return [bf.bloom_filter_deserialize(base64.b64decode(args["data"]))], {}
+
+
+def _op_rebase_g2j(args, objs):
+    from .ops.datetime_rebase import rebase_gregorian_to_julian
+
+    return [rebase_gregorian_to_julian(objs[0])], {}
+
+
+def _op_rebase_j2g(args, objs):
+    from .ops.datetime_rebase import rebase_julian_to_gregorian
+
+    return [rebase_julian_to_gregorian(objs[0])], {}
+
+
+def _op_dec128(fn_name, n_out=2):
+    def run(args, objs):
+        from .ops import decimal as D
+
+        fn = getattr(D, fn_name)
+        if fn_name in ("integer_divide_decimal128",):
+            overflow, res = fn(objs[0], objs[1])
+        else:
+            overflow, res = fn(objs[0], objs[1], args["scale"])
+        return [overflow, res], {}
+
+    return run
+
+
+def _op_histogram_create(args, objs):
+    from .ops.histogram import create_histogram_if_valid
+
+    vals, freqs = create_histogram_if_valid(objs[0], objs[1])
+    return [vals, freqs], {}
+
+
+def _op_histogram_percentile(args, objs):
+    import jax.numpy as jnp
+
+    from .columnar import types as T
+    from .columnar.column import Column
+    from .ops.histogram import percentile_from_histogram
+
+    values, freqs = objs[0], objs[1]
+    n = values.num_rows
+    offsets = jnp.asarray([0, n], jnp.int32)
+    out, valid = percentile_from_histogram(
+        values, freqs, offsets, list(args["percentages"]))
+    return [Column(out.reshape(-1), valid.reshape(-1), T.FLOAT64)], {}
+
+
+def _op_get_json(args, objs):
+    from .ops.get_json_object import get_json_object
+
+    path = [tuple(p) for p in args["path"]]
+    return [get_json_object(objs[0], path)], {}
+
+
+def _op_from_json(args, objs):
+    from .ops.from_json import from_json_to_raw_map
+
+    lst = from_json_to_raw_map(objs[0])
+    kv = lst.child
+    return [kv.field("key"), kv.field("value")], {
+        "offsets": np.asarray(lst.offsets).tolist()}
+
+
+def _op_parse_uri(args, objs):
+    from .ops.parse_uri import parse_uri
+
+    key = args.get("key")
+    if args.get("key_from_column") and len(objs) > 1:
+        raise NotImplementedError(
+            "per-row query keys: pass key as literal (reference "
+            "parse_uri.cu:876-1005 column variant)")
+    return [parse_uri(objs[0], args["part"], key=key)], {}
+
+
+def _op_regex_literal_range(args, objs):
+    from .ops.regex_rewrite import literal_range_pattern
+
+    return [literal_range_pattern(
+        objs[0], args["literal"], args["len"], args["start"],
+        args["end"])], {}
+
+
+def _batch(objs):
+    from .columnar.column import ColumnBatch
+
+    return ColumnBatch({f"c{i}": c for i, c in enumerate(objs)})
+
+
+def _op_rows_to(args, objs):
+    from .ops.row_conversion import convert_to_rows_batched
+
+    return list(convert_to_rows_batched(_batch(objs))), {}
+
+
+def _op_rows_to_fixed(args, objs):
+    from .ops.row_conversion import convert_to_rows_fixed_width_optimized
+
+    return [convert_to_rows_fixed_width_optimized(_batch(objs))], {}
+
+
+def _schema_types(args):
+    T = _types()
+    out = {}
+    for i, s in enumerate(args["schema"]):
+        kind = T.Kind(s["kind"])
+        if kind is T.Kind.DECIMAL:
+            if "precision" not in s or "scale" not in s:
+                raise ValueError(
+                    "decimal schema entries need explicit precision/scale")
+            st = T.SparkType.decimal(s["precision"], s["scale"])
+        else:
+            st = T.SparkType(kind)
+        if kind is T.Kind.STRING:
+            if "max_len" not in s:
+                raise ValueError(
+                    "string schema entries need an explicit max_len")
+            st = (st, s["max_len"])
+        out[f"c{i}"] = st
+    return out
+
+
+def _op_rows_from(args, objs):
+    from .ops.row_conversion import convert_from_rows
+
+    batch = convert_from_rows(objs[0], _schema_types(args))
+    return list(batch.columns), {}
+
+
+def _op_zorder_interleave(args, objs):
+    from .ops.zorder import interleave_bits
+
+    return [interleave_bits(objs)], {}
+
+
+def _op_zorder_hilbert(args, objs):
+    from .ops.zorder import hilbert_index
+
+    return [hilbert_index(args["num_bits"], objs)], {}
+
+
+def _op_tz_to_utc(args, objs):
+    from .ops.timezones import convert_timestamp_to_utc
+
+    return [convert_timestamp_to_utc(objs[0], args["zone"])], {}
+
+
+def _op_tz_from_utc(args, objs):
+    from .ops.timezones import convert_utc_to_timezone
+
+    return [convert_utc_to_timezone(objs[0], args["zone"])], {}
+
+
+def _op_tz_supported(args, objs):
+    from .ops.timezones import default_db
+
+    return [], {"supported": default_db().is_supported(args["zone"])}
+
+
+def _wire_schema(node):
+    """JSON-safe schema wire format -> the io.parquet_footer spec.
+
+    leaf = null; struct = object; list = {"__list__": elem};
+    map = {"__map__": [key, value]} (JSON cannot carry the internal
+    tuple/None shapes directly — ParquetFooter.java SchemaElement.toJson
+    emits this encoding).
+    """
+    if node is None:
+        return None
+    if isinstance(node, dict):
+        if "__list__" in node and len(node) == 1:
+            return [_wire_schema(node["__list__"])]
+        if "__map__" in node and len(node) == 1:
+            k, v = node["__map__"]
+            return (_wire_schema(k), _wire_schema(v))
+        return {k: _wire_schema(v) for k, v in node.items()}
+    raise TypeError(f"bad wire schema node {node!r}")
+
+
+def _op_parquet_read_filter(args, objs):
+    from .io.parquet_footer import ParquetFooter
+
+    schema = args.get("schema")
+    footer = ParquetFooter.read_and_filter(
+        base64.b64decode(args["data"]),
+        part_offset=args.get("part_offset", 0),
+        part_length=args.get("part_length", 1 << 62),
+        schema=_wire_schema(schema) if schema is not None else None,
+        ignore_case=args.get("ignore_case", False),
+    )
+    return [footer], {}
+
+
+def _op_parquet_num_rows(args, objs):
+    return [], {"value": objs[0].num_rows}
+
+
+def _op_parquet_num_columns(args, objs):
+    return [], {"value": objs[0].num_columns}
+
+
+def _op_parquet_serialize(args, objs):
+    raw = objs[0].serialize()
+    return [], {"data": base64.b64encode(raw).decode("ascii")}
+
+
+def _op_profiler(method):
+    def run(args, objs):
+        from .profiler import FileWriter, Profiler
+
+        if method == "init":
+            Profiler.init(FileWriter(args["path"]))
+        else:
+            getattr(Profiler, method)()
+        return [], {}
+
+    return run
+
+
+_OPS = {
+    "CastStrings.toInteger": _op_cast_to_integer,
+    "CastStrings.toFloat": _op_cast_to_float,
+    "CastStrings.toDecimal": _op_cast_to_decimal,
+    "CastStrings.fromFloat": _op_cast_from_float,
+    "CastStrings.fromFloatWithFormat": _op_cast_from_float_fmt,
+    "CastStrings.fromDecimal": _op_cast_from_decimal,
+    "CastStrings.toIntegersWithBase": _op_cast_to_int_base,
+    "CastStrings.fromIntegersWithBase": _op_cast_from_int_base,
+    "Hash.murmurHash32": _op_murmur,
+    "Hash.xxhash64": _op_xxhash,
+    "BloomFilter.create": _op_bloom_create,
+    "BloomFilter.put": _op_bloom_put,
+    "BloomFilter.merge": _op_bloom_merge,
+    "BloomFilter.probe": _op_bloom_probe,
+    "BloomFilter.serialize": _op_bloom_serialize,
+    "BloomFilter.deserialize": _op_bloom_deserialize,
+    "DateTimeRebase.rebaseGregorianToJulian": _op_rebase_g2j,
+    "DateTimeRebase.rebaseJulianToGregorian": _op_rebase_j2g,
+    "DecimalUtils.add128": _op_dec128("add_decimal128"),
+    "DecimalUtils.subtract128": _op_dec128("sub_decimal128"),
+    "DecimalUtils.multiply128": _op_dec128("multiply_decimal128"),
+    "DecimalUtils.divide128": _op_dec128("divide_decimal128"),
+    "DecimalUtils.integerDivide128": _op_dec128("integer_divide_decimal128"),
+    "DecimalUtils.remainder128": _op_dec128("remainder_decimal128"),
+    "Histogram.createHistogramIfValid": _op_histogram_create,
+    "Histogram.percentileFromHistogram": _op_histogram_percentile,
+    "JSONUtils.getJsonObject": _op_get_json,
+    "MapUtils.extractRawMapFromJsonString": _op_from_json,
+    "ParseURI.parseURI": _op_parse_uri,
+    "RegexRewriteUtils.literalRangePattern": _op_regex_literal_range,
+    "RowConversion.convertToRows": _op_rows_to,
+    "RowConversion.convertToRowsFixedWidthOptimized": _op_rows_to_fixed,
+    "RowConversion.convertFromRows": _op_rows_from,
+    "RowConversion.convertFromRowsFixedWidthOptimized": _op_rows_from,
+    "ZOrder.interleaveBits": _op_zorder_interleave,
+    "ZOrder.hilbertIndex": _op_zorder_hilbert,
+    "GpuTimeZoneDB.fromTimestampToUtcTimestamp": _op_tz_to_utc,
+    "GpuTimeZoneDB.fromUtcTimestampToTimestamp": _op_tz_from_utc,
+    "GpuTimeZoneDB.isSupportedTimeZone": _op_tz_supported,
+    "ParquetFooter.readAndFilter": _op_parquet_read_filter,
+    "ParquetFooter.getNumRows": _op_parquet_num_rows,
+    "ParquetFooter.getNumColumns": _op_parquet_num_columns,
+    "ParquetFooter.serializeThriftFile": _op_parquet_serialize,
+    "Profiler.init": _op_profiler("init"),
+    "Profiler.start": _op_profiler("start"),
+    "Profiler.stop": _op_profiler("stop"),
+    "Profiler.shutdown": _op_profiler("shutdown"),
+}
+
+
+# error codes shared with jni/src/bridge.h (SrjErrorCode)
+(OK, ERR_GENERIC, ERR_CAST, ERR_RETRY_OOM, ERR_SPLIT_OOM, ERR_OOM,
+ ERR_CPU_RETRY_OOM, ERR_CPU_SPLIT_OOM) = range(8)
+
+
+def classify_exception(exc) -> int:
+    """Map a Python exception to the bridge/Java exception family.
+
+    The Cpu subclasses must win over their Gpu parents so the Java side
+    can throw CpuRetryOOM/CpuSplitAndRetryOOM (host-memory recovery takes
+    a different plugin path than device OOM).
+    """
+    from .mem import rmm_spark as M
+    from .ops.cast_string import CastException
+
+    if isinstance(exc, CastException):
+        return ERR_CAST
+    if isinstance(exc, M.CpuSplitAndRetryOOM):
+        return ERR_CPU_SPLIT_OOM
+    if isinstance(exc, M.CpuRetryOOM):
+        return ERR_CPU_RETRY_OOM
+    if isinstance(exc, M.SplitAndRetryOOM):
+        return ERR_SPLIT_OOM
+    if isinstance(exc, M.RetryOOM):
+        return ERR_RETRY_OOM
+    if isinstance(exc, M.OOMError):
+        return ERR_OOM
+    return ERR_GENERIC
+
+
+def invoke(name: str, args_json: str, objs: list):
+    """Run one op. Returns (result_objects, result_json_string)."""
+    try:
+        fn = _OPS[name]
+    except KeyError:
+        raise NotImplementedError(f"unknown bridge op {name!r}") from None
+    args = json.loads(args_json) if args_json else {}
+    out_objs, meta = fn(args, list(objs))
+    return out_objs, json.dumps(meta)

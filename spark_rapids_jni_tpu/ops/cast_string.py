@@ -661,3 +661,149 @@ def string_to_decimal(
     if ansi_mode:
         _raise_on_invalid(col, valid)
     return out
+
+
+# ---------------------------------------------------------------------------
+# string <-> integer with base (Spark ``conv()``; reference
+# CastStringJni.cpp:159-259 toIntegersWithBase / fromIntegersWithBase)
+# ---------------------------------------------------------------------------
+
+# the reference validity regexes use \s — cudf's [ \t\n\r\f\v]
+_CONV_WS = (0x20, 0x09, 0x0A, 0x0D, 0x0C, 0x0B)
+
+
+def string_to_integer_with_base(
+    col: StringColumn,
+    dtype: T.SparkType,
+    base: int = 10,
+    ansi_mode: bool = False,
+) -> Column:
+    """Parse ``^\\s*(-?[digits]+).*`` per row; Spark ``conv()`` semantics.
+
+    Mirrors reference ``CastStringJni.cpp:159-228``: rows are matched
+    against the prefix regex; non-matching rows yield **0** (not null);
+    all-whitespace/empty rows and input nulls yield null; a leading ``-``
+    negates with wraparound in the unsigned bit pattern (``-510`` as
+    UINT64 -> 18446744073709551106).  Junk after the digit run is ignored.
+    The result column stores the unsigned bit pattern (our type system is
+    signed; the JNI surface's UINT64 is the same 64 bits).  ``ansi_mode``
+    is accepted for signature parity — the reference native code never
+    reads it.
+    """
+    del ansi_mode
+    if base not in (10, 16):
+        raise ValueError(f"Bases supported 10, 16; Actual: {base}")
+    chars, lengths = col.chars, col.lengths
+    n, L = chars.shape
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    in_str = pos < lengths[:, None]
+
+    ws = jnp.zeros_like(chars, dtype=jnp.bool_)
+    for w in _CONV_WS:
+        ws = ws | (chars == w)
+    ws = ws & in_str
+    # run of leading whitespace
+    nws = jnp.cumprod(ws.astype(jnp.int32), axis=1).sum(axis=1)
+
+    start = jnp.minimum(nws, jnp.maximum(lengths, 1) - 1)
+    first = jnp.take_along_axis(chars, start[:, None], axis=1)[:, 0]
+    has_minus = (first == ord("-")) & (nws < lengths)
+    dstart = nws + has_minus.astype(jnp.int32)
+
+    lower = chars | 0x20
+    is_dig = (chars >= ord("0")) & (chars <= ord("9"))
+    dval = (chars - ord("0")).astype(jnp.uint64)
+    if base == 16:
+        is_hex = (lower >= ord("a")) & (lower <= ord("f"))
+        dval = jnp.where(is_hex, (lower - ord("a") + 10).astype(jnp.uint64), dval)
+        is_dig = is_dig | is_hex
+
+    after = pos >= dstart[:, None]
+    run = jnp.cumprod(
+        jnp.where(after, is_dig & in_str, True).astype(jnp.int32), axis=1
+    ).astype(jnp.bool_)
+    digit_mask = run & after & in_str
+    matched = digit_mask.any(axis=1)
+
+    b = jnp.uint64(base)
+
+    def body(j, v):
+        return jnp.where(digit_mask[:, j], v * b + dval[:, j], v)
+
+    val = jax.lax.fori_loop(0, L, body, jnp.zeros((n,), jnp.uint64))
+    val = jnp.where(has_minus & matched, jnp.uint64(0) - val, val)
+    val = jnp.where(matched, val, jnp.uint64(0))
+
+    all_ws = nws >= lengths  # includes empty strings
+    valid = col.validity & ~all_ws
+    bits = jax.lax.bitcast_convert_type(val, jnp.int64).astype(
+        dtype.jnp_dtype
+    )
+    return Column(bits, valid, dtype)
+
+
+_HEX_DIGITS = jnp.asarray(
+    [ord(c) for c in "0123456789ABCDEF"], dtype=jnp.uint8
+)
+_POW10_CONV = jnp.asarray(
+    [np.uint64(10) ** k for k in range(20)], dtype=jnp.uint64
+)
+
+
+def integer_to_string_with_base(col: Column, base: int = 10) -> StringColumn:
+    """Format the unsigned bit pattern in base 10 or 16 (reference
+    ``CastStringJni.cpp:229-259``).
+
+    Base 16 emits minimal uppercase hex digits (cudf ``integers_to_hex``
+    followed by the reference's leading-zero strip); base 10 emits the
+    unsigned decimal of the stored bits (``strings::from_integers`` over
+    the UINT64 column the paired cast produces).  Nulls propagate.
+    """
+    if base not in (10, 16):
+        raise ValueError(f"Bases supported 10, 16; Actual: {base}")
+    width_bytes = np.dtype(col.dtype.jnp_dtype).itemsize
+    u = jax.lax.bitcast_convert_type(
+        col.data.astype(jnp.int64), jnp.uint64
+    )
+    if width_bytes < 8:
+        u = u & jnp.uint64((1 << (8 * width_bytes)) - 1)
+    n = col.num_rows
+
+    if base == 16:
+        max_out = 2 * width_bytes
+        nibble = jnp.arange(max_out, dtype=jnp.uint64)
+        shifted = (u[:, None] >> (jnp.uint64(4) * nibble[None, :])) & jnp.uint64(0xF)
+        ndig = jnp.maximum(
+            (shifted != 0).astype(jnp.int32)
+            * (jnp.arange(max_out, dtype=jnp.int32)[None, :] + 1),
+            0,
+        ).max(axis=1)
+        ndig = jnp.maximum(ndig, 1)
+        outpos = jnp.arange(max_out, dtype=jnp.int32)[None, :]
+        src = ndig[:, None] - 1 - outpos  # nibble index, msd first
+        digit = jnp.take_along_axis(
+            shifted, jnp.clip(src, 0, max_out - 1).astype(jnp.int32), axis=1
+        )
+        out = jnp.where(
+            outpos < ndig[:, None],
+            _HEX_DIGITS[digit.astype(jnp.int32)],
+            jnp.uint8(0),
+        )
+        return StringColumn(out, ndig, col.validity)
+
+    max_out = 20  # 2^64-1 has 20 decimal digits
+    j = jnp.arange(max_out, dtype=jnp.int32)
+    digs = (u[:, None] // _POW10_CONV[None, :]) % jnp.uint64(10)
+    ndig = jnp.maximum((digs != 0).astype(jnp.int32) * (j[None, :] + 1), 0).max(axis=1)
+    ndig = jnp.maximum(ndig, 1)
+    outpos = j[None, :]
+    src = ndig[:, None] - 1 - outpos
+    digit = jnp.take_along_axis(
+        digs, jnp.clip(src, 0, max_out - 1).astype(jnp.int32), axis=1
+    )
+    out = jnp.where(
+        outpos < ndig[:, None],
+        (digit + jnp.uint64(ord("0"))).astype(jnp.uint8),
+        jnp.uint8(0),
+    )
+    return StringColumn(out, ndig, col.validity)

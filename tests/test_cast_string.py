@@ -355,3 +355,53 @@ class TestStringToDecimalSemantics:
     def test_ansi_throws(self):
         with pytest.raises(CastException):
             self.cast_dec(["1.5", "abc"], 5, 0, ansi=True)
+
+
+class TestConvWithBase:
+    """Spark conv() casts — golden vectors from the reference
+    CastStringsTest.java convTestInternal/baseDec2HexTestMixed/baseHex2DecTest."""
+
+    @staticmethod
+    def conv(vals, from_base):
+        from spark_rapids_jni_tpu.ops.cast_string import (
+            integer_to_string_with_base,
+            string_to_integer_with_base,
+        )
+
+        col = StringColumn.from_pylist(vals)
+        ints = string_to_integer_with_base(col, T.INT64, base=from_base)
+        dec = integer_to_string_with_base(ints, base=10).to_pylist()
+        hexs = integer_to_string_with_base(ints, base=16).to_pylist()
+        return dec, hexs
+
+    def test_dec2hex_mixed(self):
+        dec, hexs = self.conv(
+            [None, " ", "junk-510junk510", "--510", "   -510junk510",
+             "  510junk510", "510", "00510", "00-510"], 10)
+        assert dec == [None, None, "0", "0", "18446744073709551106",
+                       "510", "510", "510", "0"]
+        assert hexs == [None, None, "0", "0", "FFFFFFFFFFFFFE02",
+                        "1FE", "1FE", "1FE", "0"]
+
+    def test_hex2dec(self):
+        dec, hexs = self.conv(
+            [None, "junk", "0", "f", "junk-5Ajunk5A", "--5A",
+             "   -5Ajunk5A", "  5Ajunk5A", "5a", "05a", "005a", "00-5a",
+             "NzGGImWNRh"], 16)
+        assert dec == [None, "0", "0", "15", "0", "0",
+                       "18446744073709551526", "90", "90", "90", "90",
+                       "0", "0"]
+        assert hexs == [None, "0", "0", "F", "0", "0",
+                        "FFFFFFFFFFFFFFA6", "5A", "5A", "5A", "5A", "0",
+                        "0"]
+
+    def test_bad_base(self):
+        import pytest as _pytest
+
+        from spark_rapids_jni_tpu.ops.cast_string import (
+            string_to_integer_with_base,
+        )
+
+        with _pytest.raises(ValueError):
+            string_to_integer_with_base(
+                StringColumn.from_pylist(["1"]), T.INT64, base=2)

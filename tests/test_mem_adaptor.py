@@ -280,16 +280,25 @@ class TestMonteCarlo:
                         if sum(held) > task_max - want:
                             while held:
                                 adaptor.deallocate(held.pop())
-                    except RetryOOM:
-                        retries[0] += 1
-                        while held:
-                            adaptor.deallocate(held.pop())
-                        adaptor.block_thread_until_ready()
                     except SplitAndRetryOOM:
                         retries[0] += 1
                         while held:
                             adaptor.deallocate(held.pop())
                         budget = max(budget // 2, 4)
+                    except RetryOOM:
+                        retries[0] += 1
+                        while held:
+                            adaptor.deallocate(held.pop())
+                        try:
+                            adaptor.block_thread_until_ready()
+                        except SplitAndRetryOOM:
+                            # the scheduler may escalate the blocked thread
+                            # to SPLIT_THROW (reference
+                            # SparkResourceAdaptorJni.cpp:1084-1088) — the
+                            # plugin contract is to halve and retry
+                            budget = max(budget // 2, 4)
+                        except RetryOOM:
+                            pass
                 while held:
                     adaptor.deallocate(held.pop())
             except BaseException as e:  # noqa: BLE001

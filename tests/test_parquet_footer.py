@@ -153,3 +153,38 @@ def test_empty_schema_prunes_everything(flat_file):
     """schema={} means keep zero columns, unlike schema=None (keep all)."""
     with ParquetFooter.read_and_filter(flat_file, schema={}) as f:
         assert f.num_columns == 0
+
+
+class TestJniWireSchema:
+    """The Java surface (ParquetFooter.java SchemaElement.toJson) sends a
+    JSON-safe schema encoding; jni_bridge._wire_schema decodes it back to
+    the internal leaf=None / list / (k,v)-tuple spec."""
+
+    def test_wire_decoding(self):
+        from spark_rapids_jni_tpu.jni_bridge import _wire_schema
+
+        wire = {"a": None,
+                "s": {"x": None, "lst": {"__list__": None}},
+                "m": {"__map__": [None, {"y": None}]}}
+        spec = _wire_schema(wire)
+        assert spec["a"] is None
+        assert spec["s"]["lst"] == [None]
+        assert spec["m"] == (None, {"y": None})
+
+    def test_read_and_filter_via_invoke(self, flat_file):
+        import base64
+
+        from spark_rapids_jni_tpu.jni_bridge import invoke
+
+        raw = read_footer_bytes(flat_file)
+        args = {"data": base64.b64encode(raw).decode(),
+                "schema": {"a": None, "b": None}, "ignore_case": False}
+        outs, meta = invoke("ParquetFooter.readAndFilter",
+                            __import__("json").dumps(args), [])
+        footer = outs[0]
+        assert footer.num_columns == 2
+        outs2, meta2 = invoke("ParquetFooter.serializeThriftFile", "{}",
+                              [footer])
+        data = base64.b64decode(__import__("json").loads(meta2)["data"])
+        assert data[:4] == b"PAR1" and data[-4:] == b"PAR1"
+        footer.close()
