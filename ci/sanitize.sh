@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Sanitizer pass (role of the reference's test-with-sanitizer maven
+# profile, pom.xml:218-264, which reruns the suite under compute-sanitizer
+# memcheck).  XLA's JIT cannot run under an ASan preload, so the
+# instrumented targets are the native test drivers, which exercise the
+# same concurrency scenarios + fuzz the Python suites do
+# (mem/native/test_adaptor.cpp, io/native/test_footer.cpp).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+SAN="-fsanitize=address,undefined -fno-sanitize-recover=all -fno-omit-frame-pointer -g -O1"
+FLAGS="-std=c++17 -fPIC -Wall -Wextra $SAN"
+
+# footer fixture: a real pyarrow footer (bare thrift bytes)
+python3 - <<'EOF'
+import pyarrow as pa, pyarrow.parquet as pq, struct
+path = "/tmp/san_footer.parquet"
+t = pa.table({"a": pa.array(range(1000), pa.int64()),
+              "b": pa.array([f"s{i}" for i in range(1000)])})
+pq.write_table(t, path, row_group_size=100)
+raw = open(path, "rb").read()
+flen = struct.unpack("<I", raw[-8:-4])[0]
+open("/tmp/san_footer.thrift", "wb").write(raw[-8-flen:-8])
+EOF
+
+make -C spark_rapids_jni_tpu/mem/native clean
+make -C spark_rapids_jni_tpu/mem/native CXXFLAGS="$FLAGS" test_adaptor
+./spark_rapids_jni_tpu/mem/native/test_adaptor 42
+./spark_rapids_jni_tpu/mem/native/test_adaptor 11
+
+make -C spark_rapids_jni_tpu/io/native clean
+make -C spark_rapids_jni_tpu/io/native CXXFLAGS="$FLAGS" test_footer
+./spark_rapids_jni_tpu/io/native/test_footer /tmp/san_footer.thrift
+
+# restore the normal (uninstrumented) builds
+make -C spark_rapids_jni_tpu/mem/native clean >/dev/null
+make -C spark_rapids_jni_tpu/mem/native >/dev/null
+make -C spark_rapids_jni_tpu/io/native clean >/dev/null
+make -C spark_rapids_jni_tpu/io/native >/dev/null
+echo "sanitizer pass OK"

@@ -18,6 +18,27 @@ public class CastException extends RuntimeException {
     this.rowWithError = rowWithError;
   }
 
+  /**
+   * Single-string constructor required by the JNI glue's ThrowNew path
+   * (jni_glue.cpp throw_bridge_error); recovers the structured fields
+   * from the canonical message the kernel side produces
+   * (ops/cast_string.py CastException).
+   */
+  public CastException(String message) {
+    super(message);
+    int row = -1;
+    String bad = message;
+    java.util.regex.Matcher m = java.util.regex.Pattern
+        .compile("row (\\d+): (.*)$", java.util.regex.Pattern.DOTALL)
+        .matcher(message);
+    if (m.find()) {
+      row = Integer.parseInt(m.group(1));
+      bad = m.group(2);
+    }
+    this.rowWithError = row;
+    this.stringWithError = bad;
+  }
+
   public String getStringWithError() {
     return stringWithError;
   }

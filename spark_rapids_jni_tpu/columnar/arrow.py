@@ -44,6 +44,20 @@ def unpack_bitmask(buf: Optional[pa.Buffer], offset: int, n: int) -> np.ndarray:
     return expanded[offset : offset + n].astype(np.bool_)
 
 
+def segment_positions(lens: np.ndarray):
+    """Flat (row_idx, within) indices for ragged segments of given lengths.
+
+    The one place the arange-minus-repeat(cumsum) index math lives; used
+    by string ingest here, the list null-extent repack, and the JNI host
+    marshaling (jni_bridge.py).
+    """
+    lens = np.asarray(lens)
+    total = int(lens.sum())
+    row_idx = np.repeat(np.arange(len(lens)), lens)
+    within = np.arange(total) - np.repeat(np.cumsum(lens) - lens, lens)
+    return row_idx, within
+
+
 def pack_bitmask(valid: np.ndarray) -> bytes:
     """bool[n] -> Arrow LSB-first packed bitmask bytes."""
     return np.packbits(valid.astype(np.uint8), bitorder="little").tobytes()
@@ -71,10 +85,7 @@ def _string_array_to_column(arr: pa.Array, pad_to_multiple: int = 8) -> StringCo
     # row r contributes bytes [offsets[r], offsets[r]+lengths[r]).
     chars = np.zeros((n, max_len), dtype=np.uint8)
     if chars_flat.size:
-        row_idx = np.repeat(np.arange(n), lengths)
-        within = np.arange(lengths.sum()) - np.repeat(
-            np.cumsum(lengths) - lengths, lengths
-        )
+        row_idx, within = segment_positions(lengths)
         src = np.repeat(offsets[:-1], lengths) + within
         chars[row_idx, within] = chars_flat[src]
     return StringColumn(
@@ -119,9 +130,7 @@ def array_to_column(arr):
         lens = np.diff(offsets)
         if np.any(~valid & (lens > 0)):
             keep_lens = np.where(valid, lens, 0)
-            total = int(keep_lens.sum())
-            within = np.arange(total) - np.repeat(
-                np.cumsum(keep_lens) - keep_lens, keep_lens)
+            _, within = segment_positions(keep_lens)
             take = (np.repeat(offsets[:-1].astype(np.int64), keep_lens)
                     + within)
             child = child.take(pa.array(take))

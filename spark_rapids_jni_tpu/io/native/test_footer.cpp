@@ -1,0 +1,93 @@
+/* Native-level round-trip test of the parquet footer engine (role of the
+ * reference's footer coverage in its Java suite; sanitizer target for
+ * ci/sanitize.sh).  Takes a real footer file produced by pyarrow
+ * (ci/sanitize.sh generates it), reads+filters+re-serializes, and checks
+ * the frame invariants.
+ */
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+void* pqf_read_and_filter(const uint8_t* buf, long len, long part_offset,
+                          long part_length, const char** names,
+                          const int* num_children, const int* tags,
+                          int n_entries, int parent_num_children,
+                          int ignore_case, int do_prune);
+const char* pqf_error(void* h);
+void pqf_free(void* h);
+long pqf_num_rows(void* h);
+long pqf_num_columns(void* h);
+long pqf_num_row_groups(void* h);
+long pqf_serialize(void* h, uint8_t* outbuf, long cap);
+}
+
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      std::fprintf(stderr, "CHECK failed at %s:%d: %s\n", __FILE__,   \
+                   __LINE__, #cond);                                  \
+      std::abort();                                                   \
+    }                                                                 \
+  } while (0)
+
+static std::vector<uint8_t> read_file(const char* path) {
+  FILE* f = std::fopen(path, "rb");
+  CHECK(f != nullptr);
+  std::fseek(f, 0, SEEK_END);
+  long n = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  std::vector<uint8_t> buf(static_cast<size_t>(n));
+  CHECK(std::fread(buf.data(), 1, buf.size(), f) == buf.size());
+  std::fclose(f);
+  return buf;
+}
+
+int main(int argc, char** argv) {
+  CHECK(argc > 1);
+  auto raw = read_file(argv[1]); /* bare thrift footer bytes */
+
+  /* identity pass */
+  void* h = pqf_read_and_filter(raw.data(), (long)raw.size(), 0, 1L << 62,
+                                nullptr, nullptr, nullptr, 0, 0, 0, 0);
+  CHECK(pqf_error(h) == nullptr || pqf_error(h)[0] == '\0');
+  long rows = pqf_num_rows(h);
+  long cols = pqf_num_columns(h);
+  CHECK(rows > 0 && cols >= 2);
+  long need = pqf_serialize(h, nullptr, 0);
+  CHECK(need > 8);
+  std::vector<uint8_t> out(static_cast<size_t>(need));
+  CHECK(pqf_serialize(h, out.data(), need) == need);
+  CHECK(std::memcmp(out.data(), "PAR1", 4) == 0);
+  CHECK(std::memcmp(out.data() + out.size() - 4, "PAR1", 4) == 0);
+  pqf_free(h);
+
+  /* column pruning: keep just column "a" (tag 0 = value leaf) */
+  const char* names[] = {"a"};
+  int counts[] = {0};
+  int tags[] = {0};
+  void* h2 = pqf_read_and_filter(raw.data(), (long)raw.size(), 0, 1L << 62,
+                                 names, counts, tags, 1, 1, 0, 1);
+  CHECK(pqf_error(h2) == nullptr || pqf_error(h2)[0] == '\0');
+  CHECK(pqf_num_columns(h2) == 1);
+  CHECK(pqf_num_rows(h2) == rows);
+  pqf_free(h2);
+
+  /* split pruning: zero-length split keeps no row groups */
+  void* h3 = pqf_read_and_filter(raw.data(), (long)raw.size(), 0, 0, nullptr,
+                                 nullptr, nullptr, 0, 0, 0, 0);
+  CHECK(pqf_num_row_groups(h3) == 0);
+  pqf_free(h3);
+
+  /* garbage must error, not crash (sanitizer checks the parse paths) */
+  std::vector<uint8_t> junk(raw.begin(), raw.begin() + raw.size() / 3);
+  void* h4 = pqf_read_and_filter(junk.data(), (long)junk.size(), 0, 1L << 62,
+                                 nullptr, nullptr, nullptr, 0, 0, 0, 0);
+  /* either a clean error or a parsed prefix — must not crash */
+  pqf_free(h4);
+
+  std::puts("footer native tests OK");
+  return 0;
+}

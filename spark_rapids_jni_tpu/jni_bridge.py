@@ -74,6 +74,8 @@ def string_column_from_host(chars: bytes, offsets: bytes, validity: bytes,
 
     from .columnar.column import StringColumn
 
+    from .columnar.arrow import segment_positions
+
     offs = np.frombuffer(offsets, dtype=np.int32, count=n + 1)
     valid = _valid_arr(validity, n)
     # null rows must have zero extent (ListColumn/hash-fold invariant)
@@ -82,9 +84,7 @@ def string_column_from_host(chars: bytes, offsets: bytes, validity: bytes,
     mat = np.zeros((n, max_len), dtype=np.uint8)
     buf = np.frombuffer(chars, dtype=np.uint8)
     if buf.size and lengths.sum():
-        row_idx = np.repeat(np.arange(n), lengths)
-        within = np.arange(lengths.sum()) - np.repeat(
-            np.cumsum(lengths) - lengths, lengths)
+        row_idx, within = segment_positions(lengths)
         src = np.repeat(offs[:-1], lengths) + within
         mat[row_idx, within] = buf[src]
     return StringColumn(jnp.asarray(mat), jnp.asarray(lengths),
@@ -295,9 +295,20 @@ def _op_histogram_percentile(args, objs):
 
 
 def _op_get_json(args, objs):
+    """Wire triples [type, name, index] (JSONUtils.java PathInstructionJni)
+    -> the internal instruction tuples parse_path produces."""
     from .ops.get_json_object import get_json_object
 
-    path = [tuple(p) for p in args["path"]]
+    path = []
+    for typ, name, idx in args["path"]:
+        if typ == "wildcard":
+            path.append(("wildcard",))
+        elif typ == "index":
+            path.append(("index", int(idx)))
+        elif typ == "named":
+            path.append(("named", name.encode("utf-8")))
+        else:
+            raise ValueError(f"unknown path instruction type {typ!r}")
     return [get_json_object(objs[0], path)], {}
 
 

@@ -1,0 +1,202 @@
+/* Native-level tests of the resource-adaptor state machine (role of the
+ * reference's C++ gtest suite, src/main/cpp/tests/, and the concurrency
+ * half of RmmSparkTest.java).  No framework: each CHECK aborts with a
+ * message, so the binary doubles as the AddressSanitizer/UBSan target for
+ * ci/sanitize.sh (the reference's compute-sanitizer pass).
+ */
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <random>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* tra_create(long pool_bytes, const char* log_path);
+void tra_destroy(void* h);
+void tra_start_dedicated_task_thread(void* h, long tid, long task);
+void tra_remove_thread_association(void* h, long tid, long task);
+void tra_task_done(void* h, long task);
+int tra_allocate(void* h, long tid, long bytes);
+void tra_deallocate(void* h, long tid, long bytes);
+int tra_block_thread_until_ready(void* h, long tid);
+int tra_get_state_of(void* h, long tid);
+int tra_check_and_break_deadlocks(void* h);
+void tra_force_retry_oom(void* h, long tid, int count, int skip);
+void tra_force_split_retry_oom(void* h, long tid, int count, int skip);
+long tra_get_and_reset_metric(void* h, long task, int which);
+long tra_total_allocated(void* h);
+long tra_max_allocated(void* h);
+}
+
+enum { OK = 0, RETRY_OOM = 1, SPLIT_OOM = 2, HARD_OOM = 3, INJECTED = 4 };
+
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      std::fprintf(stderr, "CHECK failed at %s:%d: %s\n", __FILE__,   \
+                   __LINE__, #cond);                                  \
+      std::abort();                                                   \
+    }                                                                 \
+  } while (0)
+
+static void test_basic_alloc_free() {
+  void* h = tra_create(1000, nullptr);
+  tra_start_dedicated_task_thread(h, 1, 101);
+  CHECK(tra_allocate(h, 1, 600) == OK);
+  CHECK(tra_total_allocated(h) == 600);
+  tra_deallocate(h, 1, 600);
+  CHECK(tra_total_allocated(h) == 0);
+  CHECK(tra_max_allocated(h) == 600);
+  CHECK(tra_get_and_reset_metric(h, 101, 4) == 600); /* max task memory */
+  tra_task_done(h, 101);
+  tra_destroy(h);
+}
+
+static void test_injection() {
+  void* h = tra_create(1000, nullptr);
+  tra_start_dedicated_task_thread(h, 1, 101);
+  tra_force_retry_oom(h, 1, 1, 1); /* skip one alloc, then one RetryOOM */
+  CHECK(tra_allocate(h, 1, 10) == OK);
+  CHECK(tra_allocate(h, 1, 10) == RETRY_OOM);
+  tra_deallocate(h, 1, 20);
+  CHECK(tra_block_thread_until_ready(h, 1) == OK);
+  CHECK(tra_allocate(h, 1, 10) == OK);
+  CHECK(tra_get_and_reset_metric(h, 101, 0) >= 1); /* retry count */
+  tra_deallocate(h, 1, 10);
+  tra_task_done(h, 101);
+  tra_destroy(h);
+}
+
+/* Two tasks over an undersized pool: both must complete, with the loser
+ * going through the retry ladder (the RmmSparkTest blocking scenarios). */
+static void test_contention_completes() {
+  void* h = tra_create(1000, nullptr);
+  std::atomic<int> done{0};
+  auto worker = [&](long tid, long task) {
+    tra_start_dedicated_task_thread(h, tid, task);
+    long held = 0;
+    for (int i = 0; i < 50; ++i) {
+      long want = 300;
+      for (;;) {
+        int rc = tra_allocate(h, tid, want);
+        if (rc == OK) {
+          held += want;
+          break;
+        }
+        tra_deallocate(h, tid, held);
+        held = 0;
+        if (rc == RETRY_OOM) {
+          int brc = tra_block_thread_until_ready(h, tid);
+          if (brc == SPLIT_OOM) want = std::max(4L, want / 2);
+        } else if (rc == SPLIT_OOM) {
+          want = std::max(4L, want / 2);
+        } else {
+          CHECK(false && "unexpected hard OOM under 2x contention");
+        }
+      }
+      if (held >= 600) {
+        tra_deallocate(h, tid, held);
+        held = 0;
+      }
+    }
+    tra_deallocate(h, tid, held);
+    tra_task_done(h, task);
+    done.fetch_add(1);
+  };
+  std::thread t1(worker, 1, 101), t2(worker, 2, 102), t3(worker, 3, 103);
+  /* watchdog, as SparkResourceAdaptor.java:59-79 */
+  std::atomic<bool> stop{false};
+  std::thread wd([&] {
+    while (!stop.load()) {
+      tra_check_and_break_deadlocks(h);
+      std::this_thread::sleep_for(std::chrono::milliseconds(10));
+    }
+  });
+  t1.join();
+  t2.join();
+  t3.join();
+  stop.store(true);
+  wd.join();
+  CHECK(done.load() == 3);
+  CHECK(tra_total_allocated(h) == 0);
+  tra_destroy(h);
+}
+
+/* Seeded fuzz matching tests/test_mem_adaptor.py TestMonteCarlo — random
+ * alloc/free with the full escalation ladder, N tasks oversubscribed. */
+static void test_fuzz(unsigned seed) {
+  const long MB = 1 << 20;
+  void* h = tra_create(3 * MB, nullptr);
+  std::atomic<int> done{0};
+  auto task_fn = [&](long tid, long task) {
+    std::mt19937 rng(seed * 1000 + static_cast<unsigned>(task));
+    tra_start_dedicated_task_thread(h, tid, task);
+    std::vector<long> held;
+    long budget = 2 * MB;
+    int ops = 0;
+    while (ops < 40) {
+      long want = 1 + static_cast<long>(rng() % std::max(2L, budget / 4));
+      int rc = tra_allocate(h, tid, want);
+      if (rc == OK) {
+        held.push_back(want);
+        ++ops;
+        if (rng() % 10 < 4 && !held.empty()) {
+          size_t i = rng() % held.size();
+          tra_deallocate(h, tid, held[i]);
+          held.erase(held.begin() + static_cast<long>(i));
+        }
+        long sum = 0;
+        for (long x : held) sum += x;
+        if (sum > 2 * MB - want) {
+          for (long x : held) tra_deallocate(h, tid, x);
+          held.clear();
+        }
+      } else if (rc == RETRY_OOM) {
+        for (long x : held) tra_deallocate(h, tid, x);
+        held.clear();
+        int brc = tra_block_thread_until_ready(h, tid);
+        if (brc == SPLIT_OOM) budget = std::max(4L, budget / 2);
+      } else if (rc == SPLIT_OOM) {
+        for (long x : held) tra_deallocate(h, tid, x);
+        held.clear();
+        budget = std::max(4L, budget / 2);
+      } else {
+        CHECK(false && "hard OOM in fuzz");
+      }
+    }
+    for (long x : held) tra_deallocate(h, tid, x);
+    tra_task_done(h, task);
+    done.fetch_add(1);
+  };
+  std::vector<std::thread> ts;
+  for (int i = 0; i < 6; ++i)
+    ts.emplace_back(task_fn, i + 1, 100 + i + 1);
+  std::atomic<bool> stop{false};
+  std::thread wd([&] {
+    while (!stop.load()) {
+      tra_check_and_break_deadlocks(h);
+      std::this_thread::sleep_for(std::chrono::milliseconds(10));
+    }
+  });
+  for (auto& t : ts) t.join();
+  stop.store(true);
+  wd.join();
+  CHECK(done.load() == 6);
+  CHECK(tra_total_allocated(h) == 0);
+  tra_destroy(h);
+}
+
+int main(int argc, char** argv) {
+  unsigned seed = argc > 1 ? static_cast<unsigned>(std::atoi(argv[1])) : 42;
+  test_basic_alloc_free();
+  std::puts("basic_alloc_free OK");
+  test_injection();
+  std::puts("injection OK");
+  test_contention_completes();
+  std::puts("contention OK");
+  test_fuzz(seed);
+  std::puts("fuzz OK");
+  return 0;
+}

@@ -199,3 +199,19 @@ def test_unknown_op_is_error(lib):
     n, _ = invoke(lib, "No.suchOp", {}, [])
     assert n == -1
     assert "unknown bridge op" in lib.srj_last_error().decode()
+
+
+def test_get_json_object_wire_path(lib):
+    docs = ['{"a": {"b": [10, 20]}}', '{"a": {"b": [7]}}', '{"x": 1}']
+    h = make_string_col(lib, docs)
+    # wire triples as JSONUtils.java PathInstructionJni emits them
+    n, outs = invoke(lib, "JSONUtils.getJsonObject",
+                     {"path": [["named", "a", -1], ["named", "b", -1],
+                               ["index", "", 1]]}, [h])
+    assert n == 1, lib.srj_last_error().decode()
+    kind, cnt, data, valid, offs = export(lib, outs[0])
+    vals = [data[offs[i]:offs[i + 1]].decode() if valid[i] else None
+            for i in range(cnt)]
+    assert vals == ["20", None, None]
+    lib.srj_release(h)
+    lib.srj_release(outs[0])
