@@ -39,6 +39,14 @@ public class ParseURI {
     return part(uriColumn, "QUERY", query);
   }
 
+  /** Per-row keys (reference ParseURI.java:82). */
+  public static TpuColumnVector parseURIQueryWithColumn(TpuColumnVector uriColumn,
+      TpuColumnVector queryColumn) {
+    return new TpuColumnVector(Bridge.invokeOne("ParseURI.parseURI",
+        "{\"part\":\"QUERY\"}", uriColumn.getNativeView(),
+        queryColumn.getNativeView()));
+  }
+
   public static TpuColumnVector parseURIPath(TpuColumnVector uriColumn) {
     return part(uriColumn, "PATH", null);
   }

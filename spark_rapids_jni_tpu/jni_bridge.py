@@ -322,14 +322,11 @@ def _op_from_json(args, objs):
 
 
 def _op_parse_uri(args, objs):
-    from .ops.parse_uri import parse_uri
+    from .ops.parse_uri import parse_uri, parse_uri_query_with_column
 
-    key = args.get("key")
-    if args.get("key_from_column") and len(objs) > 1:
-        raise NotImplementedError(
-            "per-row query keys: pass key as literal (reference "
-            "parse_uri.cu:876-1005 column variant)")
-    return [parse_uri(objs[0], args["part"], key=key)], {}
+    if len(objs) > 1:  # per-row keys (ParseURI.parseURIQueryWithColumn)
+        return [parse_uri_query_with_column(objs[0], objs[1])], {}
+    return [parse_uri(objs[0], args["part"], key=args.get("key"))], {}
 
 
 def _op_regex_literal_range(args, objs):

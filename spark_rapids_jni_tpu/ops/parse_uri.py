@@ -516,3 +516,54 @@ def parse_uri(col: StringColumn, part: str,
         raise ValueError("key filter is only valid with QUERY")
     out, lens, has = _parse(col.chars, col.lengths, col.validity, part, key)
     return StringColumn(out, lens, has)
+
+
+def parse_uri_query_with_column(col: StringColumn,
+                                keys: StringColumn) -> StringColumn:
+    """Per-row query-parameter extraction (reference ParseURI.java:82
+    parseURIQueryWithColumn over parse_uri.cu's column-key kernel).
+
+    Two stages: the shared validator/extractor pulls each row's QUERY
+    span, then a vectorized matcher finds ``key=`` at parameter starts
+    (query start or after ``&``) with the key length varying per row.
+    Null keys or invalid URIs produce null rows.
+    """
+    if keys.num_rows != col.num_rows:
+        raise ValueError("key column must match the URI column's row count")
+    q = parse_uri(col, "QUERY")
+    qc, ql, qv = q.chars, q.lengths, q.validity
+    kc, kl, kv = keys.chars, keys.lengths, keys.validity
+    n, L = qc.shape
+    KL = kc.shape[1]
+    i32 = jnp.int32
+    pos = jnp.arange(L, dtype=i32)[None, :]
+    in_q = pos < ql[:, None]
+
+    prev = jnp.pad(qc, ((0, 0), (1, 0)))[:, :L]
+    at_start = in_q & ((pos == 0) | (prev == ord("&")))
+
+    qp = jnp.pad(qc, ((0, 0), (0, KL + 1)))
+    match = jnp.ones((n, L), jnp.bool_)
+    for j in range(KL):
+        active = (jnp.int32(j) < kl)[:, None]
+        match = match & (~active | (qp[:, j: L + j] == kc[:, j][:, None]))
+    # '=' must follow the (per-row-length) key
+    eq_idx = jnp.clip(pos + kl[:, None], 0, L + KL)
+    eq_char = jnp.take_along_axis(qp, eq_idx, axis=1)
+    match = match & (eq_char == ord("="))
+    match = match & at_start & ((pos + kl[:, None]) < ql[:, None])
+
+    mpos = _first_pos(match, jnp.broadcast_to(pos, (n, L)), L)
+    found = qv & kv & (mpos < L)
+    v_s = mpos + kl + 1
+    amp = _first_pos(
+        (qc == ord("&")) & (pos >= v_s[:, None]) & in_q,
+        jnp.broadcast_to(pos, (n, L)), L)
+    v_e = jnp.minimum(amp, ql)
+
+    out_len = jnp.clip(v_e - v_s, 0, L)
+    oidx = jnp.clip(v_s[:, None], 0, L) + jnp.arange(L, dtype=i32)[None, :]
+    out = jnp.take_along_axis(jnp.pad(qc, ((0, 0), (0, L))),
+                              jnp.clip(oidx, 0, 2 * L - 1), axis=1)
+    out = jnp.where(pos < out_len[:, None], out, jnp.uint8(0))
+    return StringColumn(out, jnp.where(found, out_len, 0), found)

@@ -184,3 +184,54 @@ def test_fragment_cleared_on_empty_remainder():
     """'#bob' keeps only the empty path (reference :608-614 overwrite)."""
     got = _device(["#bob"], "FRAGMENT")
     assert got == [None]
+
+
+from spark_rapids_jni_tpu.columnar.column import StringColumn
+
+
+class TestQueryWithColumn:
+    """Per-row key extraction (reference ParseURI.java:82,
+    parseURIQueryWithColumn) must agree with the literal-key kernel."""
+
+    def test_matches_literal_per_row(self):
+        from spark_rapids_jni_tpu.ops.parse_uri import (
+            parse_uri,
+            parse_uri_query_with_column,
+        )
+
+        uris = [
+            "https://a.com/p?x=1&yy=2&z=3",
+            "https://b.com/?yy=22",
+            "http://c.com/no/query",
+            "https://d.com/?x=&yy=7#frag",
+            None,
+            "https://e.com/?zz=9",
+        ]
+        keys = ["x", "yy", "x", "yy", "x", None]
+        ucol = StringColumn.from_pylist(uris)
+        kcol = StringColumn.from_pylist(keys)
+        got = parse_uri_query_with_column(ucol, kcol).to_pylist()
+        expected = []
+        for u, k in zip(uris, keys):
+            if u is None or k is None:
+                expected.append(None)
+                continue
+            one = parse_uri(StringColumn.from_pylist([u]), "QUERY",
+                            key=k).to_pylist()[0]
+            expected.append(one)
+        assert got == expected
+        # spot-check concrete values
+        assert got[0] == "1" and got[1] == "22" and got[2] is None
+        assert got[3] == "7" and got[4] is None and got[5] is None
+
+    def test_row_count_mismatch(self):
+        import pytest as _pytest
+
+        from spark_rapids_jni_tpu.ops.parse_uri import (
+            parse_uri_query_with_column,
+        )
+
+        with _pytest.raises(ValueError):
+            parse_uri_query_with_column(
+                StringColumn.from_pylist(["http://a.com/?x=1"]),
+                StringColumn.from_pylist(["x", "y"]))
