@@ -157,11 +157,15 @@ def micro_main():
     V = 3  # input variants per kernel (the backend dedupes identical calls)
 
     def run(name, jfn, variants, n, unit="Mrows/s", reps=10):
+        print(f"# measuring {name}", file=sys.stderr, flush=True)
         try:
             mrows = _bench_one(jfn, variants[0], n, reps, variants=variants)
             results.append({"metric": name, "value": round(mrows, 2), "unit": unit})
         except Exception as e:  # pragma: no cover - diagnostic path
             results.append({"metric": name, "error": f"{type(e).__name__}: {e}"})
+        # emit incrementally: a slow-compiling kernel must not hold every
+        # earlier measurement hostage (the parent keeps partial results)
+        print(json.dumps(results[-1]), flush=True)
 
     n = 1 << 20
     ones = jnp.ones((n,), jnp.bool_)
@@ -291,7 +295,8 @@ def micro_main():
         m,
     )
 
-    # the other BASELINE.md query shapes: q3 (join) and q67 (window)
+    # the other BASELINE.md query shapes: q3 (join), q67 (window),
+    # and the string/regex-heavy config (#4)
     import __graft_entry__ as ge
 
     nq = 1 << 18
@@ -299,10 +304,12 @@ def micro_main():
     run("q3_join_agg", jax.jit(ge._q3_step), q3in, nq, reps=6)
     q67in = [(ge._q67_batch(nq, seed=13 + k),) for k in range(V)]
     run("q67_window_topk", jax.jit(ge._q67_step), q67in, nq, reps=6)
+    ns = 1 << 14
+    qsin = [(ge._qstr_batch(ns, seed=17 + k),) for k in range(V)]
+    run("qstr_string_heavy", jax.jit(ge._qstr_step), qsin, ns, reps=4)
 
-    for r in results:
-        print(json.dumps(r), flush=True)
-    # retry on CPU only if NOTHING measured; partial results are kept
+    # lines were emitted as they were measured; only signal retry-on-CPU
+    # if NOTHING was measured
     return 18 if all("error" in r for r in results) else 0
 
 
@@ -327,6 +334,15 @@ def _run_child(extra_env, timeout_s, mode):
                 "utf-8", "replace"
             )
             sys.stderr.write(err_txt[-4000:])
+        # salvage whatever the child measured before the timeout
+        if e.stdout:
+            out_txt = e.stdout if isinstance(e.stdout, str) else e.stdout.decode(
+                "utf-8", "replace"
+            )
+            lines = [ln for ln in out_txt.splitlines()
+                     if ln.startswith("{") and '"metric"' in ln]
+            if lines:
+                return lines, None
         return None, "timeout"
     sys.stderr.write(proc.stderr[-4000:])
     lines = [

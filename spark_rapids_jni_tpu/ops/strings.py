@@ -1,0 +1,65 @@
+"""String expression kernels for the string-heavy benchmark config.
+
+The reference repo delegates plain string functions to libcudf (out of
+tree); the driver's string/regex-heavy config (BASELINE.md #4) names
+``substring`` alongside the in-tree ``regexp`` fast path and
+``get_json_object``, so the Spark-exact substring lives here.
+
+Semantics follow Spark's ``UTF8String.substringSQL`` (character-based,
+1-based positions, negative position counts from the end, window clamped
+to the string):
+
+    substring('abc',  -5, 3) -> 'a'    (window [-2, 1) clamps to [0, 1))
+    substring('abcd', -2, 3) -> 'cd'
+    substring('abc',   0, 2) -> 'ab'   (pos 0 behaves like 1)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..columnar.column import StringColumn
+from .regex_rewrite import _decode_utf8
+
+
+def substring(col: StringColumn, pos: int, length: int = -1) -> StringColumn:
+    """Character-based Spark substring; ``length < 0`` means "to the end".
+
+    Works on the padded byte matrix: UTF-8 start bytes give each byte a
+    character index (continuation bytes inherit their start byte's index),
+    the [start, end) character window selects bytes, and a stable argsort
+    left-compacts the survivors — no scatter (slow on the TPU backend,
+    BASELINE.md primitive costs).
+    """
+    chars, lengths, validity = col.chars, col.lengths, col.validity
+    n, L = chars.shape
+    posax = jnp.arange(L, dtype=jnp.int32)[None, :]
+    in_str = posax < lengths[:, None]
+
+    _, _, is_start = _decode_utf8(chars)
+    is_start = is_start & in_str
+    # 0-based character index per byte (continuation bytes inherit)
+    char_idx = jnp.cumsum(is_start.astype(jnp.int32), axis=1) - 1
+    nchars = jnp.sum(is_start, axis=1).astype(jnp.int32)
+
+    if pos > 0:
+        s0 = jnp.full((n,), pos - 1, jnp.int32)
+    elif pos < 0:
+        s0 = nchars + pos
+    else:
+        s0 = jnp.zeros((n,), jnp.int32)
+    if length < 0:
+        e0 = jnp.full((n,), 2**31 - 1, jnp.int32)
+    else:
+        # window end BEFORE clamping the start (Spark: the negative-start
+        # window loses the part hanging off the front of the string)
+        e0 = s0 + length
+    lo = jnp.maximum(s0, 0)
+
+    keep = in_str & (char_idx >= lo[:, None]) & (char_idx < e0[:, None])
+    # stable left-compaction of kept bytes
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    out = jnp.take_along_axis(chars, order, axis=1)
+    out_len = jnp.sum(keep, axis=1).astype(jnp.int32)
+    out = jnp.where(posax < out_len[:, None], out, jnp.uint8(0))
+    return StringColumn(out, jnp.where(validity, out_len, 0), validity)

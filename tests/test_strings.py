@@ -1,0 +1,51 @@
+"""Spark substring semantics (UTF8String.substringSQL oracle)."""
+
+import pytest
+
+from spark_rapids_jni_tpu.columnar.column import StringColumn
+from spark_rapids_jni_tpu.ops.strings import substring
+
+
+def oracle(s, pos, length):
+    """Python port of Spark UTF8String.substringSQL (character-based)."""
+    if s is None:
+        return None
+    chars = list(s)  # python str indexing is already character-based
+    n = len(chars)
+    if pos > 0:
+        s0 = pos - 1
+    elif pos < 0:
+        s0 = n + pos
+    else:
+        s0 = 0
+    e0 = (s0 + length) if length >= 0 else n
+    lo = max(s0, 0)
+    return "".join(chars[lo:max(e0, lo)]) if lo < n else ""
+
+
+CASES = [
+    ("abc", -5, 3), ("abcd", -2, 3), ("abc", 0, 2), ("abc", 1, 2),
+    ("abc", 2, 99), ("abc", 4, 2), ("abc", -3, 1), ("abc", -1, 5),
+    ("", 1, 2), ("hello world", 7, 5), ("abc", 2, 0),
+]
+
+
+@pytest.mark.parametrize("s,pos,length", CASES)
+def test_substring_matches_oracle(s, pos, length):
+    col = StringColumn.from_pylist([s])
+    got = substring(col, pos, length).to_pylist()[0]
+    assert got == oracle(s, pos, length), (s, pos, length)
+
+
+def test_substring_multibyte_and_nulls():
+    vals = ["héllo", "日本語abc", None, "xy"]
+    col = StringColumn.from_pylist(vals)
+    got = substring(col, 2, 3).to_pylist()
+    assert got == [oracle(v, 2, 3) for v in vals]
+    got = substring(col, -2).to_pylist()
+    assert got == [None if v is None else v[-2:] for v in vals]
+
+
+def test_substring_to_end():
+    col = StringColumn.from_pylist(["abcdef"])
+    assert substring(col, 3).to_pylist() == ["cdef"]
