@@ -305,3 +305,75 @@ def _sort_step(mesh, axis_name, key_names, splitter_shape, capacity):
         return out, occ_sorted, dropped[None]
 
     return jax.jit(step)
+
+
+# ---------------------------------------------------------------------------
+# hierarchical (multi-host) mesh: DCN x ICI
+# ---------------------------------------------------------------------------
+
+def hierarchical_mesh(n_hosts: int, chips_per_host: int,
+                      dcn_axis: str = "dcn", ici_axis: str = "ici") -> Mesh:
+    """(hosts, chips) mesh: the outer axis maps across hosts (DCN), the
+    inner across each host's chips (ICI).  On real multi-host TPU the
+    device order from ``jax.devices()`` is already host-major, so the
+    reshape lines the axes up with the physical links."""
+    devs = jax.devices()[: n_hosts * chips_per_host]
+    if len(devs) < n_hosts * chips_per_host:
+        raise RuntimeError(
+            f"need {n_hosts * chips_per_host} devices, have {len(devs)}")
+    return Mesh(np.array(devs).reshape(n_hosts, chips_per_host),
+                (dcn_axis, ici_axis))
+
+
+def distributed_group_by_2d(
+    batch: ColumnBatch,
+    key_names: Sequence[str],
+    aggs: Sequence[AggSpec],
+    mesh: Mesh,
+    dcn_axis: str = "dcn",
+    ici_axis: str = "ici",
+    capacity_dcn: Optional[int] = None,
+    capacity_ici: Optional[int] = None,
+):
+    """Group-by over a multi-host mesh via the two-hop hierarchical shuffle
+    (rows cross DCN once, ICI once; see shuffle.exchange_hierarchical).
+
+    Capacities default to the always-lossless bounds: every sender holds R
+    rows so a host bucket holds <= R; after hop one a device holds up to
+    ``n_hosts * C_dcn`` live rows, all of which may share one chip.  Pass
+    planned capacities to shrink the grids when the key distribution is
+    known (plan_capacity per hop).
+    """
+    H, D = mesh.shape[dcn_axis], mesh.shape[ici_axis]
+    R = batch.num_rows // (H * D)
+    if capacity_dcn is None:
+        capacity_dcn = R
+    if capacity_ici is None:
+        capacity_ici = H * capacity_dcn
+    step = _group_by_2d_step(mesh, dcn_axis, ici_axis, tuple(key_names),
+                             tuple(aggs), capacity_dcn, capacity_ici)
+    return step(batch)
+
+
+@lru_cache(maxsize=None)
+def _group_by_2d_step(mesh, dcn_axis, ici_axis, key_names, aggs,
+                      capacity_dcn, capacity_ici):
+    from .shuffle import exchange_hierarchical
+
+    H, D = mesh.shape[dcn_axis], mesh.shape[ici_axis]
+    P = H * D
+    spec = PartitionSpec((dcn_axis, ici_axis))
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(spec,), out_specs=(spec, spec, spec), check_vma=False,
+    )
+    def step(b: ColumnBatch):
+        rv = jnp.ones((b.num_rows,), jnp.bool_)
+        pid = spark_partition_id([b[k] for k in key_names], P, rv)
+        shuffled, occ, dropped = exchange_hierarchical(
+            b, pid, dcn_axis, ici_axis, H, D, capacity_dcn, capacity_ici)
+        res, ng = group_by(shuffled, key_names, aggs, row_valid=occ)
+        return res, ng[None], dropped[None]
+
+    return jax.jit(step)

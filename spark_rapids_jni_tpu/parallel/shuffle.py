@@ -88,3 +88,47 @@ def plan_capacity(pid, axis_name: str, num_partitions: int):
     )[:P]
     local_max = counts.max()
     return jax.lax.pmax(local_max, axis_name)
+
+
+def exchange_hierarchical(
+    batch: ColumnBatch,
+    pid,
+    dcn_axis: str,
+    ici_axis: str,
+    n_hosts: int,
+    n_chips: int,
+    capacity_dcn: int | None = None,
+    capacity_ici: int | None = None,
+):
+    """Two-hop all-to-all over a (dcn, ici) mesh: rows cross the slow DCN
+    link exactly once (to the destination host, same chip index), then the
+    fast ICI once (to the destination chip).  This is the multi-host form
+    of the reference's single-exchange shuffle — the global partition id
+    ``p = host * n_chips + chip`` is still the Spark-exact murmur3 pmod id,
+    so results are bit-identical to the flat exchange.
+
+    Must run inside ``shard_map`` over both axes.  Returns
+    ``(out_batch, occupancy, dropped)`` like :func:`exchange`; ``dropped``
+    sums both hops.
+    """
+    from ..columnar import types as T
+    from ..columnar.column import Column
+
+    if "__pid__" in batch.names:
+        raise ValueError("'__pid__' is reserved by exchange_hierarchical")
+    P = n_hosts * n_chips
+    pid = jnp.clip(pid.astype(jnp.int32), 0, P)
+    carried = batch.with_column("__pid__", Column(pid, pid < P, T.INT32))
+
+    host_dst = jnp.where(pid < P, pid // n_chips, n_hosts)
+    out_a, occ_a, drop_a = exchange(
+        carried, host_dst, dcn_axis, n_hosts, capacity_dcn)
+
+    # the routing column has done its job after hop one — don't pay ICI
+    # bandwidth shuffling it again
+    pid_a = out_a["__pid__"].data
+    chip_dst = jnp.where(occ_a, pid_a % n_chips, n_chips)
+    out_b, occ_b, drop_b = exchange(
+        out_a.select(list(batch.names)), chip_dst, ici_axis, n_chips,
+        capacity_ici)
+    return out_b, occ_b, drop_a + drop_b
