@@ -236,6 +236,18 @@ def micro_main():
         jax.jit(lambda c: pallas_kernels.murmur3_int64(c)), vals, n)
     run("xxhash64_int64_pallas",
         jax.jit(lambda c: pallas_kernels.xxhash64_int64(c)), vals, n)
+    strs = [
+        (StringColumn.from_pylist(
+            [f"key-{rng.integers(0, 1 << 30)}" for _ in range(1 << 18)],
+            pad_to_multiple=16),)
+        for _ in range(V)
+    ]
+    run("murmur3_string", jax.jit(
+        lambda c: __import__("spark_rapids_jni_tpu.ops.hashing",
+                             fromlist=["x"]).murmur_hash3_32([c])),
+        strs, 1 << 18)
+    run("murmur3_string_pallas",
+        jax.jit(lambda c: pallas_kernels.murmur3_string(c)), strs, 1 << 18)
 
     # get_json_object (mirrors GET_JSON_OBJECT_BENCH)
     from spark_rapids_jni_tpu.ops.get_json_object import get_json_object

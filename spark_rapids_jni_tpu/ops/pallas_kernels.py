@@ -260,3 +260,95 @@ def xxhash64_int64(col: Column, seed: int = 42,
     u64 = out_lo.astype(jnp.uint64) | (out_hi.astype(jnp.uint64)
                                        << jnp.uint64(32))
     return Column(_u64_to_i64(u64), jnp.ones_like(col.validity), T.INT64)
+
+
+# ---------------------------------------------------------------------------
+# murmur3 over byte strings (shuffle partition ids on string keys)
+# ---------------------------------------------------------------------------
+
+def _murmur3_str_kernel(words_ref, len_ref, valid_ref, seed_ref, out_ref):
+    """One pass over the word axis handles blocks AND the tail uniformly.
+
+    Layout is word-major: ``words_ref[j, :]`` is the j-th 4-byte word of
+    128 rows (one sublane read per step — no cross-lane gathers).  The
+    Spark tail (<=3 sign-extended bytes) always lives in word
+    ``nblocks``, so each step applies the block mix where ``j < nblocks``
+    and the ordered tail mixes where ``j == nblocks``.
+    """
+    W = words_ref.shape[0]
+    lengths = len_ref[0, :].astype(jnp.int32)
+    nblocks = lengths // 4
+    seed = seed_ref[0]
+    h0 = jnp.full(lengths.shape, seed, jnp.uint32)
+
+    def body(j, h):
+        w = words_ref[j, :]
+        h = jnp.where(j < nblocks, _mix_mm3(h, w), h)
+        is_tail = j == nblocks
+        rem = lengths - 4 * j
+        for t in range(3):
+            b = (w >> jnp.uint32(8 * t)) & jnp.uint32(0xFF)
+            # Java byte -> int sign-extends
+            k1 = jnp.where(b >= jnp.uint32(0x80),
+                           b | jnp.uint32(0xFFFFFF00), b)
+            h = jnp.where(is_tail & (t < rem), _mix_mm3(h, k1), h)
+        return h
+
+    h = jax.lax.fori_loop(0, W, body, h0)
+    h = h ^ lengths.astype(jnp.uint32)
+    h = _fmix(h)
+    out_ref[0, :] = jnp.where(valid_ref[0, :] != 0, h, h0)
+
+
+# murmur3 block mix shared with the int64 kernel (different name to avoid
+# shadowing hashing._mm3_mix's (h, k1) jnp-scalar signature)
+def _mix_mm3(h, k1):
+    return _mix(h, k1)
+
+
+def murmur3_string(col, seed: int = 42,
+                   interpret: Optional[bool] = None) -> Column:
+    """Spark murmur3_32 of one string column (Pallas word-major kernel).
+
+    Bit-identical to :func:`hashing.murmur3_bytes` (reference
+    ``murmur_hash.cuh`` tail handling); null rows return the seed, like a
+    null column contributing nothing to the row hash.
+    """
+    chars, lengths, valid = col.chars, col.lengths, col.validity
+    n, L = chars.shape
+    Lp = -(-max(L, 4) // 4) * 4
+    if Lp != L:
+        chars = jnp.pad(chars, ((0, 0), (0, Lp - L)))
+    W = Lp // 4
+    words = jax.lax.bitcast_convert_type(
+        chars.reshape(n, W, 4), jnp.uint32)        # little-endian combine
+    words_t = words.T                              # [W, n]
+
+    npad = -(-max(n, 1) // LANES) * LANES
+    if npad != n:
+        words_t = jnp.pad(words_t, ((0, 0), (0, npad - n)))
+        lengths = jnp.pad(lengths, (0, npad - n))
+        valid = jnp.pad(valid, (0, npad - n))
+    grid = npad // LANES
+
+    out = pl.pallas_call(
+        _murmur3_str_kernel,
+        out_shape=jax.ShapeDtypeStruct((1, npad), jnp.uint32),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((W, LANES), lambda i: (0, i)),
+            pl.BlockSpec((1, LANES), lambda i: (0, i)),
+            pl.BlockSpec((1, LANES), lambda i: (0, i)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((1, LANES), lambda i: (0, i)),
+        interpret=_auto_interpret(interpret),
+    )(
+        words_t,
+        lengths.astype(jnp.int32)[None, :],
+        valid.astype(jnp.uint32)[None, :],
+        jnp.asarray([seed & 0xFFFFFFFF], jnp.uint32),
+    )
+    h = out[0, :n]
+    return Column(jax.lax.bitcast_convert_type(h, jnp.int32),
+                  jnp.ones((n,), jnp.bool_), T.INT32)

@@ -54,3 +54,52 @@ def test_config_routes_murmur3_through_pallas(rng):
     finally:
         config.reset("use_pallas_hashes")
     assert got == want
+
+
+class TestMurmur3String:
+    def test_parity_with_jnp(self):
+        import numpy as np
+
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_jni_tpu.columnar.column import StringColumn
+        from spark_rapids_jni_tpu.ops import hashing
+        from spark_rapids_jni_tpu.ops.pallas_kernels import murmur3_string
+
+        rng = np.random.default_rng(9)
+        vals = []
+        for i in range(300):
+            ln = int(rng.integers(0, 21))
+            vals.append(bytes(rng.integers(0, 256, ln).astype(np.uint8))
+                        .decode("latin-1"))
+        vals[5] = None
+        vals[17] = ""
+        col = StringColumn.from_pylist(vals)
+        got = murmur3_string(col, seed=42, interpret=True)
+        # latin-1 re-encode to utf-8 changes bytes; rebuild raw column
+        # to compare apples to apples: hash the padded byte matrix direct
+        ref = hashing.murmur3_bytes(
+            col.chars, col.lengths,
+            jnp.full((col.num_rows,), jnp.uint32(42)))
+        ref = jnp.where(col.validity,
+                        jax.lax.bitcast_convert_type(ref, jnp.int32),
+                        jnp.int32(42))
+        assert (np.asarray(got.data) == np.asarray(ref)).all()
+
+    def test_spark_golden_vectors(self):
+        """Golden string vectors from the jnp path (itself pinned to
+        reference HashTest.java goldens in test_hashing)."""
+        import numpy as np
+
+        from spark_rapids_jni_tpu.columnar import types as T
+        from spark_rapids_jni_tpu.columnar.column import StringColumn
+        from spark_rapids_jni_tpu.ops.hashing import murmur_hash3_32
+        from spark_rapids_jni_tpu.ops.pallas_kernels import murmur3_string
+
+        vals = ["", "a", "ab", "abc", "abcd", "abcde", "hello world",
+                "0123456789abcdef0123456789", None]
+        col = StringColumn.from_pylist(vals)
+        want = murmur_hash3_32([col])
+        got = murmur3_string(col, interpret=True)
+        assert (np.asarray(got.data) == np.asarray(want.data)).all()
