@@ -255,3 +255,161 @@ def group_by(
             out[spec.out_name] = Column(r, out_valid & has_any, col_dtype)
 
     return ColumnBatch(out), num_groups
+
+
+# ---------------------------------------------------------------------------
+# MXU path: one-hot int8 matmul aggregation for small static key domains
+# ---------------------------------------------------------------------------
+
+def group_by_onehot(
+    batch: ColumnBatch,
+    key_name: str,
+    aggs: Sequence[AggSpec],
+    domain: int,
+    row_valid=None,
+    float_mode: str = "f64",
+):
+    """Hash-aggregate as matmuls: the TPU-first alternative to the
+    sort-scan path when one integer key column has a small static domain
+    ``[0, domain)`` (dimension ids, date ordinals, bucketed keys — the q6
+    shape).  The per-key FLOPs land on the MXU instead of the VPU sort
+    network:
+
+    * one-hot ``[n, K+1]`` int8 (bucket K holds null keys), fused by XLA
+      into the dot operand;
+    * count(*) / count(col): ``onehot^T @ 1`` with int32 accumulation;
+    * sum(int*): exact via byte limbs — each int64 value becomes eight
+      int8 lanes ``b_l - 128``; ``onehot^T @ limbs`` accumulates in int32
+      (|x|<=128, n<=2^23 keeps partials under 2^31), then the true limb
+      sums are rebuilt with ``+128*count`` and recombined in uint64 with
+      Spark's non-ANSI wraparound;
+    * sum(float*): f32 limb split (hi/mid/lo, exact 3-way Dekker split of
+      the f64 mantissa) so the dot runs on MXU-native f32; accumulation
+      rounding is within Spark's order-nondeterministic tolerance;
+    * mean: sum / count in f64.
+
+    min/max and multi-column keys stay on the sort-scan path.  Returns
+    ``(result, num_groups, overflow)`` — ``overflow`` is a device bool
+    that is True if any non-null key fell outside ``[0, domain)`` (result
+    is then invalid; callers assert or fall back).
+    """
+    K = int(domain)
+    col = batch[key_name]
+    if col.dtype.kind not in (T.Kind.INT8, T.Kind.INT16, T.Kind.INT32,
+                              T.Kind.INT64):
+        raise TypeError("group_by_onehot needs an integer key column")
+    n = col.num_rows
+    row_live = jnp.ones((n,), jnp.bool_) if row_valid is None else row_valid
+    live = col.validity & row_live
+
+    k = col.data.astype(jnp.int32)
+    overflow = jnp.any(live & ((k < 0) | (k >= K)))
+    # null keys form their own group (bucket K), like the sort-scan path;
+    # dead padding rows are dropped from the onehot entirely
+    bucket = jnp.where(live, jnp.clip(k, 0, K - 1), K)
+    oh = ((bucket[:, None] == jnp.arange(K + 1, dtype=jnp.int32)[None, :])
+          & row_live[:, None]).astype(jnp.int8)
+
+    counts_star = jax.lax.dot_general(
+        oh.T, jnp.ones((n, 1), jnp.int8),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32,
+    )[:, 0]
+
+    out_cols = {}
+    key_valid = jnp.arange(K + 1) < K
+    out_cols[key_name] = Column(
+        jnp.arange(K + 1, dtype=col.dtype.jnp_dtype),
+        key_valid & (counts_star > 0), col.dtype)
+
+    for spec in aggs:
+        if spec.op == "count" and spec.column is None:
+            out_cols[spec.out_name] = Column(
+                counts_star.astype(jnp.int64), counts_star >= 0, T.INT64)
+            continue
+        vcol = batch[spec.column]
+        vvalid = vcol.validity & row_live
+        if spec.op == "count":
+            cnt = jax.lax.dot_general(
+                oh.T, vvalid.astype(jnp.int8)[:, None],
+                (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32,
+            )[:, 0]
+            out_cols[spec.out_name] = Column(
+                cnt.astype(jnp.int64), cnt >= 0, T.INT64)
+            continue
+        if spec.op not in ("sum", "mean"):
+            raise NotImplementedError(
+                f"group_by_onehot: {spec.op} stays on the sort-scan path")
+
+        cnt_v = jax.lax.dot_general(
+            oh.T, vvalid.astype(jnp.int8)[:, None],
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32,
+        )[:, 0]
+
+        if vcol.dtype.kind in (T.Kind.FLOAT32, T.Kind.FLOAT64):
+            v = jnp.where(vvalid, vcol.data.astype(jnp.float64), 0.0)
+            if float_mode == "f32x3":
+                # MXU-native: exact 3-way Dekker split, f32 accumulation.
+                # Rounding ~1e-6 relative at millions of rows — inside
+                # Spark's shuffle-order nondeterminism for many queries,
+                # but NOT bit-stable; opt-in.
+                hi = v.astype(jnp.float32)
+                r1 = v - hi.astype(jnp.float64)
+                mid = r1.astype(jnp.float32)
+                lo = (r1 - mid.astype(jnp.float64)).astype(jnp.float32)
+                limbs = jnp.stack([hi, mid, lo], axis=1)  # [n, 3] f32
+                part = jax.lax.dot_general(
+                    oh.astype(jnp.float32).T, limbs,
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ).astype(jnp.float64)
+                fsum = part[:, 0] + part[:, 1] + part[:, 2]
+            else:
+                # exact mode: f64 contraction (XLA emulates f64 off the
+                # MXU; accumulation error matches the sort-scan path's)
+                fsum = jax.lax.dot_general(
+                    oh.astype(jnp.float64).T, v[:, None],
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float64,
+                )[:, 0]
+            if spec.op == "mean":
+                res = fsum / jnp.maximum(cnt_v, 1).astype(jnp.float64)
+            else:
+                res = fsum
+            out_cols[spec.out_name] = Column(res, cnt_v > 0, T.FLOAT64)
+            continue
+
+        # exact integer sums via byte limbs
+        u = jax.lax.bitcast_convert_type(
+            jnp.where(vvalid, vcol.data.astype(jnp.int64), jnp.int64(0)),
+            jnp.uint64)
+        bytes8 = jax.lax.bitcast_convert_type(u, jnp.uint8)  # [n, 8]
+        x = jnp.where(vvalid[:, None],
+                      bytes8.astype(jnp.int16) - jnp.int16(128),
+                      jnp.int16(0)).astype(jnp.int8)
+        part = jax.lax.dot_general(
+            oh.T, x, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )  # [K+1, 8]
+        true_limb = part.astype(jnp.int64) + jnp.int64(128) * cnt_v[:, None]
+        shifts = (jnp.uint64(8) * jnp.arange(8, dtype=jnp.uint64))[None, :]
+        total_u = jnp.sum(
+            jax.lax.bitcast_convert_type(true_limb, jnp.uint64)
+            << shifts, axis=1)
+        isum = jax.lax.bitcast_convert_type(total_u, jnp.int64)
+        if spec.op == "mean":
+            out_cols[spec.out_name] = Column(
+                isum.astype(jnp.float64)
+                / jnp.maximum(cnt_v, 1).astype(jnp.float64),
+                cnt_v > 0, T.FLOAT64)
+        else:
+            out_cols[spec.out_name] = Column(isum, cnt_v > 0, T.INT64)
+
+    # compact live groups to the front (stable) like the sort-scan path
+    live_group = counts_star > 0
+    order = jnp.argsort(~live_group, stable=True).astype(jnp.int32)
+    from .gather import gather_column
+
+    compacted = ColumnBatch({
+        name: gather_column(c, order) for name, c in out_cols.items()})
+    ng = jnp.sum(live_group.astype(jnp.int32))
+    return compacted, ng, overflow

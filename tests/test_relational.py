@@ -542,3 +542,146 @@ class TestQueryShapes:
         d = out.to_pydict()
         live = [r for r, v in zip(d["rk"], d["cat"]) if v is not None]
         assert live and max(live) <= 100
+
+
+class TestGroupByOnehot:
+    """MXU one-hot path must agree with the sort-scan group_by exactly
+    (int sums bit-exact incl. wraparound; float sums within order
+    tolerance)."""
+
+    @staticmethod
+    def run_both(k, v, price, kvalid=None, vvalid=None, row_valid=None,
+                 domain=64):
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from spark_rapids_jni_tpu.columnar import types as T
+        from spark_rapids_jni_tpu.columnar.column import Column, ColumnBatch
+        from spark_rapids_jni_tpu.relational import AggSpec, group_by
+        from spark_rapids_jni_tpu.relational.aggregate import group_by_onehot
+
+        n = len(k)
+        kv = jnp.asarray(kvalid if kvalid is not None else [True] * n)
+        vv = jnp.asarray(vvalid if vvalid is not None else [True] * n)
+        batch = ColumnBatch(
+            {
+                "k": Column(jnp.asarray(np.asarray(k, np.int32)), kv,
+                            T.INT32),
+                "v": Column(jnp.asarray(np.asarray(v, np.int64)), vv,
+                            T.INT64),
+                "p": Column(jnp.asarray(np.asarray(price, np.float64)),
+                            jnp.ones((n,), jnp.bool_), T.FLOAT64),
+            }
+        )
+        aggs = [AggSpec("sum", "v", "s"), AggSpec("count", None, "c"),
+                AggSpec("mean", "p", "m")]
+        rv = None if row_valid is None else jnp.asarray(row_valid)
+        res_a, ng_a = group_by(batch, ["k"], aggs, row_valid=rv)
+        res_b, ng_b, ovf = group_by_onehot(batch, "k", aggs, domain,
+                                           row_valid=rv)
+        assert not bool(ovf)
+
+        def groups(res, ng):
+            out = {}
+            ks = res["k"].to_pylist()[: int(ng)]
+            ss = res["s"].to_pylist()[: int(ng)]
+            cs = res["c"].to_pylist()[: int(ng)]
+            ms = res["m"].to_pylist()[: int(ng)]
+            for i in range(int(ng)):
+                out[ks[i]] = (ss[i], cs[i], ms[i])
+            return out
+
+        ga, gb = groups(res_a, ng_a), groups(res_b, ng_b)
+        assert set(ga) == set(gb)
+        for key in ga:
+            sa, ca, ma = ga[key]
+            sb, cb, mb = gb[key]
+            assert sa == sb, (key, sa, sb)
+            assert ca == cb
+            if ma is None:
+                assert mb is None
+            else:
+                import math
+
+                assert math.isclose(ma, mb, rel_tol=1e-12), (key, ma, mb)
+
+    def test_basic(self):
+        import numpy as np
+
+        rng = np.random.default_rng(3)
+        n = 4096
+        self.run_both(rng.integers(0, 60, n), rng.integers(-(10**9), 10**9, n),
+                      rng.random(n) * 100)
+
+    def test_null_keys_and_values(self):
+        import numpy as np
+
+        rng = np.random.default_rng(4)
+        n = 1000
+        self.run_both(
+            rng.integers(0, 30, n),
+            rng.integers(-(10**12), 10**12, n),
+            rng.random(n),
+            kvalid=list(rng.random(n) > 0.1),
+            vvalid=list(rng.random(n) > 0.2),
+        )
+
+    def test_row_valid_and_wraparound(self):
+        import numpy as np
+
+        rng = np.random.default_rng(5)
+        n = 512
+        big = [2**62, 2**62, 2**62, 2**62] * (n // 4)  # sums wrap int64
+        self.run_both(
+            [i % 3 for i in range(n)], big, rng.random(n),
+            row_valid=list(rng.random(n) > 0.3), domain=8)
+
+    def test_overflow_flag(self):
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from spark_rapids_jni_tpu.columnar import types as T
+        from spark_rapids_jni_tpu.columnar.column import Column, ColumnBatch
+        from spark_rapids_jni_tpu.relational import AggSpec
+        from spark_rapids_jni_tpu.relational.aggregate import group_by_onehot
+
+        batch = ColumnBatch({"k": Column(
+            jnp.asarray(np.asarray([1, 99], np.int32)),
+            jnp.ones((2,), jnp.bool_), T.INT32)})
+        _, _, ovf = group_by_onehot(
+            batch, "k", [AggSpec("count", None, "c")], 8)
+        assert bool(ovf)
+
+
+    def test_f32x3_mode_close(self):
+        import math
+
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from spark_rapids_jni_tpu.columnar import types as T
+        from spark_rapids_jni_tpu.columnar.column import Column, ColumnBatch
+        from spark_rapids_jni_tpu.relational import AggSpec
+        from spark_rapids_jni_tpu.relational.aggregate import group_by_onehot
+
+        rng = np.random.default_rng(6)
+        n = 4096
+        batch = ColumnBatch(
+            {
+                "k": Column(jnp.asarray(rng.integers(0, 10, n)
+                                        .astype(np.int32)),
+                            jnp.ones((n,), jnp.bool_), T.INT32),
+                "p": Column(jnp.asarray(rng.random(n) * 100),
+                            jnp.ones((n,), jnp.bool_), T.FLOAT64),
+            }
+        )
+        exact, ng, _ = group_by_onehot(
+            batch, "k", [AggSpec("sum", "p", "s")], 16)
+        approx, _, _ = group_by_onehot(
+            batch, "k", [AggSpec("sum", "p", "s")], 16, float_mode="f32x3")
+        for a, b in zip(exact["s"].to_pylist()[: int(ng)],
+                        approx["s"].to_pylist()[: int(ng)]):
+            assert math.isclose(a, b, rel_tol=1e-5)
