@@ -1,3 +1,4 @@
 """I/O & metadata components (reference SURVEY.md §2.3)."""
 
+from .parquet import read_parquet, select_row_groups  # noqa: F401
 from .parquet_footer import ParquetFooter, read_footer_bytes  # noqa: F401

@@ -1,0 +1,80 @@
+"""Parquet scan: split-pruned read into a device ColumnBatch.
+
+SURVEY.md §7 Phase 1's "Parquet host decode -> ColumnBatch upload".  The
+reference keeps decode in libcudf and only prunes footers natively
+(``NativeParquetJni.cpp``); here decode is pyarrow (host) and the pruning
+rules are the reference's:
+
+* a row group survives a split when its **midpoint** falls inside
+  ``[part_offset, part_offset + part_length)`` — the same rule as
+  ``NativeParquetJni.cpp:556-637`` (every row group belongs to exactly
+  one split, splits need no coordination);
+* column pruning by (case-(in)sensitively matched) top-level names.
+
+Tests cross-check the selection against the native footer engine
+(``parquet_footer.ParquetFooter.read_and_filter``) so the Python rule and
+the C++ rule cannot drift apart.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import pyarrow.parquet as pq
+
+from ..columnar.arrow import from_arrow
+from ..columnar.column import ColumnBatch
+
+
+def _row_group_span(rg) -> tuple:
+    """(start, end) byte range of a row group's column chunk data."""
+    start = None
+    end = 0
+    for ci in range(rg.num_columns):
+        col = rg.column(ci)
+        off = col.data_page_offset
+        if col.dictionary_page_offset is not None:
+            off = min(off, col.dictionary_page_offset)
+        start = off if start is None else min(start, off)
+        end = max(end, off + col.total_compressed_size)
+    return (start or 0, end)
+
+
+def select_row_groups(meta, part_offset: int, part_length: int) -> list:
+    """Indices of row groups whose midpoint is inside the split."""
+    lo, hi = part_offset, part_offset + part_length
+    keep = []
+    for i in range(meta.num_row_groups):
+        start, end = _row_group_span(meta.row_group(i))
+        mid = start + (end - start) // 2
+        if lo <= mid < hi:
+            keep.append(i)
+    return keep
+
+
+def _match_columns(schema_names, columns, ignore_case: bool) -> list:
+    if columns is None:
+        return list(schema_names)
+    if not ignore_case:
+        wanted = set(columns)
+        return [n for n in schema_names if n in wanted]
+    wanted_l = {c.lower() for c in columns}
+    return [n for n in schema_names if n.lower() in wanted_l]
+
+
+def read_parquet(
+    path: str,
+    columns: Optional[Sequence[str]] = None,
+    part_offset: int = 0,
+    part_length: int = 1 << 62,
+    ignore_case: bool = False,
+) -> ColumnBatch:
+    """Read (a split of) a parquet file into a device ColumnBatch."""
+    f = pq.ParquetFile(path)
+    keep = select_row_groups(f.metadata, part_offset, part_length)
+    names = _match_columns(f.schema_arrow.names, columns, ignore_case)
+    if not keep:
+        table = f.schema_arrow.empty_table().select(names)
+    else:
+        table = f.read_row_groups(keep, columns=names)
+    return from_arrow(table)
