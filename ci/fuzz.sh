@@ -7,6 +7,14 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 ROUNDS=${ROUNDS:-10}
 fails=0
+# native-level fuzz of the same scenario (mem/native/test_adaptor.cpp)
+make -C spark_rapids_jni_tpu/mem/native test_adaptor >/dev/null 2>&1
+for round in $(seq 1 "${ROUNDS}"); do
+  if ! ./spark_rapids_jni_tpu/mem/native/test_adaptor $((round * 101))        > /dev/null 2>&1; then
+    echo "native fuzz round ${round}: FAIL"
+    fails=$((fails + 1))
+  fi
+done
 for round in $(seq 1 "${ROUNDS}"); do
   seeds="$((round * 101)),$((round * 101 + 7)),$((round * 101 + 13))"
   if MEM_FUZZ_SEEDS="$seeds" python -m pytest \

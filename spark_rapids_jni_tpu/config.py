@@ -55,6 +55,10 @@ _register("bench_rows", 1 << 21, int,
 _register("use_pallas_hashes", False, _parse_bool,
           "Route murmur3/xxhash64 int64 fast paths through the Pallas "
           "kernels instead of the jnp formulations.")
+_register("q6_group_path", "sort", str,
+          "Aggregation path for the q6 flagship bench: 'sort' (sort-scan "
+          "group_by) or 'onehot' (MXU one-hot matmul, group_by_onehot "
+          "with the bench's static key domain).")
 
 
 def get(key: str):
