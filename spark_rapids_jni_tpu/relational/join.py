@@ -10,6 +10,13 @@ Spark semantics: SQL equality join keys — ``null`` matches nothing (inner
 drops null-keyed rows, left outer emits them with a null right side, left
 anti *keeps* them); float keys normalize -0.0/NaN (equality domain of
 :mod:`keys`).
+
+Join types: inner / left / right / full / semi / anti.  ``right`` is the
+swapped left join (output keeps the right side's columns first, probe-side
+key columns dropped — document order, not semantics).  ``full`` keeps ALL
+right columns (keys included) so unmatched right rows retain their key
+values, and appends them after the left-join region; its output capacity
+is ``capacity + right.num_rows``.
 """
 
 from __future__ import annotations
@@ -24,7 +31,7 @@ from . import keys as K
 from .filter import compact
 from .gather import gather_batch
 
-_HOWS = ("inner", "left", "semi", "anti")
+_HOWS = ("inner", "left", "right", "full", "semi", "anti")
 
 
 def _one_null_row_like(batch: ColumnBatch) -> ColumnBatch:
@@ -85,6 +92,13 @@ def hash_join(
         raise ValueError(f"unknown join type {how!r}")
     if len(left_on) != len(right_on):
         raise ValueError("left_on/right_on length mismatch")
+    if how == "right":
+        # swapped left join (reference cudf right joins are the same
+        # reversal); right side's columns come first in the output
+        return hash_join(right, left, right_on, left_on, "left",
+                         capacity=capacity, suffixes=(suffixes[1],
+                                                      suffixes[0]),
+                         left_valid=right_valid, right_valid=left_valid)
 
     nl, nr = left.num_rows, right.num_rows
     if nr == 0:
@@ -128,7 +142,7 @@ def hash_join(
     if how == "anti":
         return compact(left, (counts == 0) & l_live)
 
-    outer = how == "left"
+    outer = how in ("left", "full")
     counts_out = jnp.where(l_live, jnp.maximum(counts, 1), 0) if outer \
         else counts
     cum = jnp.cumsum(counts_out)  # inclusive
@@ -149,12 +163,58 @@ def hash_join(
     matched = (counts[li] > 0) & out_valid if nl else jnp.zeros_like(out_valid)
 
     lpart = gather_batch(left, li, out_valid)
-    right_names = [n for n in right.names if n not in right_on]
+    # full joins keep the right key columns so unmatched right rows
+    # retain their key values in the appended region
+    right_names = (list(right.names) if how == "full"
+                   else [n for n in right.names if n not in right_on])
     rpart = gather_batch(
         right.select(right_names) if right_names else ColumnBatch({}),
         ri,
         matched if outer else out_valid,
     )
+
+    if how == "full":
+        # unmatched right rows: probe the LEFT keys with the right keys.
+        # Dead (shuffle-padding) left rows must not count as matches:
+        # re-key them as nulls, which sort last and match nothing.
+        if left_valid is not None:
+            import dataclasses as _dc
+
+            lcols_live = [_dc.replace(c, validity=c.validity & l_live)
+                          for c in lcols]
+            lkeys = K.batch_radix_keys(lcols_live, equality=True,
+                                       nulls_first=False)
+        lkeys_sorted_ops = jax.lax.sort(
+            tuple(lkeys) + (jnp.arange(nl, dtype=jnp.int32),),
+            num_keys=len(lkeys), is_stable=True)
+        sorted_lkeys = lkeys_sorted_ops[:-1]
+        rlo, rhi = K.equal_range(sorted_lkeys, rkeys)
+        r_null = jnp.zeros((nr,), jnp.bool_)
+        for c in rcols:
+            r_null = r_null | ~c.validity
+        r_live = (jnp.ones((nr,), jnp.bool_) if right_valid is None
+                  else right_valid.astype(jnp.bool_))
+        rcounts = jnp.where(r_null | ~r_live, 0, rhi - rlo)
+        unmatched = (rcounts == 0) & r_live
+        n_un = jnp.sum(unmatched.astype(jnp.int32))
+        order = jnp.argsort(~unmatched, stable=True).astype(jnp.int32)
+        app_valid = jnp.arange(nr, dtype=jnp.int32) < n_un
+        rpart_app = gather_batch(right.select(right_names), order, app_valid)
+        lpart_app = gather_batch(left, jnp.zeros((nr,), jnp.int32),
+                                 jnp.zeros((nr,), jnp.bool_))
+        lpart = _concat_batches(lpart, lpart_app)
+        rpart = _concat_batches(rpart, rpart_app)
+        # the append region sits at offset `capacity`; pull it up so live
+        # rows are contiguous [0, total_main + n_un)
+        total_main = total
+        total = total_main + n_un
+        idx = jnp.arange(capacity + nr, dtype=jnp.int32)
+        srcrow = jnp.where(idx < total_main, idx,
+                           capacity + idx - total_main)
+        srcrow = jnp.clip(srcrow, 0, capacity + nr - 1)
+        live = idx < total
+        lpart = gather_batch(lpart, srcrow, live)
+        rpart = gather_batch(rpart, srcrow, live)
 
     collisions = set(lpart.names) & set(rpart.names)
     merged = {}
@@ -167,3 +227,26 @@ def hash_join(
                 )
             merged[out] = col
     return ColumnBatch(merged), total
+
+
+def _concat_col(a, b):
+    if isinstance(a, StringColumn):
+        W = max(a.max_len, b.max_len)
+
+        def pad(c):
+            return jnp.pad(c.chars, ((0, 0), (0, W - c.max_len)))
+
+        return StringColumn(
+            jnp.concatenate([pad(a), pad(b)]),
+            jnp.concatenate([a.lengths, b.lengths]),
+            jnp.concatenate([a.validity, b.validity]), a.dtype)
+    if isinstance(a, Decimal128Column):
+        return Decimal128Column(
+            jnp.concatenate([a.limbs, b.limbs]),
+            jnp.concatenate([a.validity, b.validity]), a.dtype)
+    return Column(jnp.concatenate([a.data, b.data]),
+                  jnp.concatenate([a.validity, b.validity]), a.dtype)
+
+
+def _concat_batches(a: ColumnBatch, b: ColumnBatch) -> ColumnBatch:
+    return ColumnBatch({n: _concat_col(a[n], b[n]) for n in a.names})

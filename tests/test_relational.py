@@ -685,3 +685,82 @@ class TestGroupByOnehot:
         for a, b in zip(exact["s"].to_pylist()[: int(ng)],
                         approx["s"].to_pylist()[: int(ng)]):
             assert math.isclose(a, b, rel_tol=1e-5)
+
+
+class TestOuterJoins:
+    """right/full outer joins vs a pandas-style python oracle."""
+
+    @staticmethod
+    def oracle(lk, lv, rk, rv, how):
+        out = []
+        for i, k in enumerate(lk):
+            matches = [j for j, k2 in enumerate(rk)
+                       if k is not None and k2 == k]
+            if matches:
+                for j in matches:
+                    out.append((k, lv[i], rk[j], rv[j]))
+            elif how in ("left", "full"):
+                out.append((k, lv[i], None, None))
+        if how == "full":
+            for j, k2 in enumerate(rk):
+                if k2 is None or k2 not in [k for k in lk if k is not None]:
+                    out.append((None, None, rk[j], rv[j]))
+        return sorted(out, key=lambda t: (t[0] is None, t[0] or 0,
+                                          t[1] is None, t[1] or 0,
+                                          t[3] is None, t[3] or 0))
+
+    @staticmethod
+    def batches(lk, lv, rk, rv):
+        import jax.numpy as jnp
+
+        from spark_rapids_jni_tpu.columnar import types as T
+        from spark_rapids_jni_tpu.columnar.column import Column, ColumnBatch
+
+        return (
+            ColumnBatch({"k": Column.from_pylist(lk, T.INT32),
+                         "lv": Column.from_pylist(lv, T.INT64)}),
+            ColumnBatch({"k": Column.from_pylist(rk, T.INT32),
+                         "rv": Column.from_pylist(rv, T.INT64)}),
+        )
+
+    def test_full_outer(self):
+        from spark_rapids_jni_tpu.relational import hash_join
+
+        lk = [1, 2, None, 4, 5]
+        lv = [10, 20, 30, 40, 50]
+        rk = [2, 2, 6, None]
+        rv = [200, 201, 600, 700]
+        left, right = self.batches(lk, lv, rk, rv)
+        res, total = hash_join(left, right, ["k"], ["k"], "full",
+                               capacity=16)
+        t = int(total)
+        ks = res["k"].to_pylist()[:t]
+        lvs = res["lv"].to_pylist()[:t]
+        rks = res["k_r"].to_pylist()[:t] if "k_r" in res.names else \
+            res["k" + "_right"].to_pylist()[:t]
+        rvs = res["rv"].to_pylist()[:t]
+        got = sorted(zip(ks, lvs, rks, rvs),
+                     key=lambda x: (x[0] is None, x[0] or 0,
+                                    x[1] is None, x[1] or 0,
+                                    x[3] is None, x[3] or 0))
+        want = self.oracle(lk, lv, rk, rv, "full")
+        assert got == want
+
+    def test_right_outer(self):
+        from spark_rapids_jni_tpu.relational import hash_join
+
+        lk = [1, 2, 2]
+        lv = [10, 20, 21]
+        rk = [2, 3]
+        rv = [200, 300]
+        left, right = self.batches(lk, lv, rk, rv)
+        res, total = hash_join(left, right, ["k"], ["k"], "right",
+                               capacity=8)
+        t = int(total)
+        # right join == swapped left join: right columns first, keys kept
+        ks = res["k"].to_pylist()[:t]
+        rvs = res["rv"].to_pylist()[:t]
+        lvs = res["lv"].to_pylist()[:t]
+        got = sorted(zip(ks, rvs, lvs),
+                     key=lambda x: (x[0], x[2] is None, x[2] or 0))
+        assert got == [(2, 200, 20), (2, 200, 21), (3, 300, None)]
