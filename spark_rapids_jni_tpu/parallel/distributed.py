@@ -202,6 +202,40 @@ def _join_step(mesh, axis_name, left_on, right_on, how, capacity,
     return jax.jit(step)
 
 
+
+
+def _sample_splitters(batch: ColumnBatch, key_names, P: int):
+    """Host-side sample-sort splitter plan shared by the 1-D and 2-D
+    sorts: strided sample of the radix key words, P-1 picks."""
+    from ..relational import keys as K
+
+    kcols = [batch[k] for k in key_names]
+    karr = K.batch_radix_keys(kcols, equality=False, nulls_first=True)
+    n = karr[0].shape[0]
+    sample_n = min(n, max(P * 64, 1024))
+    stride = max(n // sample_n, 1)
+    words = np.stack(
+        [np.asarray(jax.device_get(a[::stride])) for a in karr], axis=1)
+    order = np.lexsort(words[:, ::-1].T)
+    m = words.shape[0]
+    picks = order[np.linspace(0, m - 1, P + 1).astype(np.int64)[1:-1]]
+    return jnp.asarray(words[picks])  # [P-1, nw]
+
+
+def _local_sort_with_occ(shuffled: ColumnBatch, occ, key_names):
+    """Local sort with dead shuffle slots last (shared epilogue)."""
+    from ..columnar import types as T
+    from ..columnar.column import Column
+    from ..relational.sort import SortKey, sort_by
+
+    aug = shuffled.with_column(
+        "__occ", Column(occ.astype(jnp.int32), jnp.ones_like(occ), T.INT32))
+    out = sort_by(aug, [SortKey("__occ", ascending=False)]
+                  + [SortKey(k) for k in key_names])
+    occ_sorted = out["__occ"].data == 1
+    return out.select([n for n in out.names if n != "__occ"]), occ_sorted
+
+
 def distributed_sort(
     batch: ColumnBatch,
     key_names: Sequence[str],
@@ -216,24 +250,8 @@ def distributed_sort(
     Splitters are sampled on the host from the first key column's radix
     words, the classic sample-sort plan pass.
     """
-    from ..relational import keys as K
-    from ..relational.sort import SortKey, sort_by
-
     P = mesh.shape[axis_name]
-    # host-side splitter sampling: a strided SAMPLE of the radix key words
-    # (not the full column — sample-sort needs a few hundred rows per
-    # device, not an O(n log n) host sort of everything)
-    kcols = [batch[k] for k in key_names]
-    karr = K.batch_radix_keys(kcols, equality=False, nulls_first=True)
-    n = karr[0].shape[0]
-    sample_n = min(n, max(P * 64, 1024))
-    stride = max(n // sample_n, 1)
-    words = np.stack(
-        [np.asarray(jax.device_get(a[::stride])) for a in karr], axis=1)
-    order = np.lexsort(words[:, ::-1].T)
-    m = words.shape[0]
-    picks = order[np.linspace(0, m - 1, P + 1).astype(np.int64)[1:-1]]
-    splitters = jnp.asarray(words[picks])  # [P-1, nw]
+    splitters = _sample_splitters(batch, key_names, P)
 
     if capacity is None:
         # plan: count destinations per device
@@ -280,8 +298,6 @@ def _sort_plan_step(mesh, axis_name, key_names, splitter_shape):
 
 @lru_cache(maxsize=None)
 def _sort_step(mesh, axis_name, key_names, splitter_shape, capacity):
-    from ..relational.sort import SortKey, sort_by
-
     P = mesh.shape[axis_name]
     spec = PartitionSpec(axis_name)
 
@@ -290,18 +306,7 @@ def _sort_step(mesh, axis_name, key_names, splitter_shape, capacity):
     def step(b, splitters):
         pid = _range_pid(b, key_names, splitters, P)
         shuffled, occ, dropped = exchange(b, pid, axis_name, P, capacity)
-        # local sort with dead slots last: seed an occupancy pre-key by
-        # sorting on (~occ, keys...) — reuse sort_by with an extra column
-        from ..columnar import types as T
-        from ..columnar.column import Column
-
-        aug = shuffled.with_column(
-            "__occ", Column(occ.astype(jnp.int32), jnp.ones_like(occ), T.INT32)
-        )
-        out = sort_by(aug, [SortKey("__occ", ascending=False)]
-                      + [SortKey(k) for k in key_names])
-        occ_sorted = out["__occ"].data == 1
-        out = out.select([n for n in out.names if n != "__occ"])
+        out, occ_sorted = _local_sort_with_occ(shuffled, occ, key_names)
         return out, occ_sorted, dropped[None]
 
     return jax.jit(step)
@@ -375,5 +380,107 @@ def _group_by_2d_step(mesh, dcn_axis, ici_axis, key_names, aggs,
             b, pid, dcn_axis, ici_axis, H, D, capacity_dcn, capacity_ici)
         res, ng = group_by(shuffled, key_names, aggs, row_valid=occ)
         return res, ng[None], dropped[None]
+
+    return jax.jit(step)
+
+
+def distributed_hash_join_2d(
+    left: ColumnBatch,
+    right: ColumnBatch,
+    left_on: Sequence[str],
+    right_on: Sequence[str],
+    how: str,
+    mesh: Mesh,
+    dcn_axis: str = "dcn",
+    ici_axis: str = "ici",
+    capacity_dcn: Optional[int] = None,
+    out_capacity: Optional[int] = None,
+):
+    """Hash join over a multi-host mesh via the two-hop shuffle (both
+    sides routed by the same Spark-exact partition ids, so matching keys
+    still meet on one chip).  Lossless default capacities as in
+    :func:`distributed_group_by_2d`."""
+    H, D = mesh.shape[dcn_axis], mesh.shape[ici_axis]
+    if capacity_dcn is None:
+        capacity_dcn = max(left.num_rows, right.num_rows) // (H * D)
+    step = _join_2d_step(mesh, dcn_axis, ici_axis, tuple(left_on),
+                         tuple(right_on), how, capacity_dcn, out_capacity)
+    return step(left, right)
+
+
+@lru_cache(maxsize=None)
+def _join_2d_step(mesh, dcn_axis, ici_axis, left_on, right_on, how,
+                  capacity_dcn, out_capacity):
+    from ..relational.join import hash_join
+    from .shuffle import exchange_hierarchical
+
+    H, D = mesh.shape[dcn_axis], mesh.shape[ici_axis]
+    P = H * D
+    spec = PartitionSpec((dcn_axis, ici_axis))
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(spec, spec), out_specs=(spec, spec, spec), check_vma=False,
+    )
+    def step(lb: ColumnBatch, rb: ColumnBatch):
+        lv = jnp.ones((lb.num_rows,), jnp.bool_)
+        rv = jnp.ones((rb.num_rows,), jnp.bool_)
+        lpid = spark_partition_id([lb[k] for k in left_on], P, lv)
+        rpid = spark_partition_id([rb[k] for k in right_on], P, rv)
+        ls, locc, ldrop = exchange_hierarchical(
+            lb, lpid, dcn_axis, ici_axis, H, D, capacity_dcn,
+            H * capacity_dcn)
+        rs, rocc, rdrop = exchange_hierarchical(
+            rb, rpid, dcn_axis, ici_axis, H, D, capacity_dcn,
+            H * capacity_dcn)
+        out, count = hash_join(ls, rs, list(left_on), list(right_on), how,
+                               capacity=out_capacity,
+                               left_valid=locc, right_valid=rocc)
+        return out, count[None], jnp.stack([ldrop, rdrop])[None]
+
+    return jax.jit(step)
+
+
+def distributed_sort_2d(
+    batch: ColumnBatch,
+    key_names: Sequence[str],
+    mesh: Mesh,
+    dcn_axis: str = "dcn",
+    ici_axis: str = "ici",
+    capacity_dcn: Optional[int] = None,
+):
+    """Global sample-sort over a multi-host mesh: same splitter plan as
+    :func:`distributed_sort` with P = hosts * chips range partitions,
+    routed through the two-hop exchange.  Device (h, d) holds global
+    range ``h * chips + d`` in sorted order."""
+    H, D = mesh.shape[dcn_axis], mesh.shape[ici_axis]
+    P = H * D
+    splitters = _sample_splitters(batch, key_names, P)
+
+    if capacity_dcn is None:
+        capacity_dcn = batch.num_rows // P
+    step = _sort_2d_step(mesh, dcn_axis, ici_axis, tuple(key_names),
+                         splitters.shape, capacity_dcn)
+    return step(batch, splitters)
+
+
+@lru_cache(maxsize=None)
+def _sort_2d_step(mesh, dcn_axis, ici_axis, key_names, splitter_shape,
+                  capacity_dcn):
+    from .shuffle import exchange_hierarchical
+
+    H, D = mesh.shape[dcn_axis], mesh.shape[ici_axis]
+    P = H * D
+    spec = PartitionSpec((dcn_axis, ici_axis))
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(spec, PartitionSpec()),
+             out_specs=(spec, spec, spec), check_vma=False)
+    def step(b, splitters):
+        pid = _range_pid(b, key_names, splitters, P)
+        shuffled, occ, dropped = exchange_hierarchical(
+            b, pid, dcn_axis, ici_axis, H, D, capacity_dcn,
+            H * capacity_dcn)
+        out, occ_sorted = _local_sort_with_occ(shuffled, occ, key_names)
+        return out, occ_sorted, dropped[None]
 
     return jax.jit(step)

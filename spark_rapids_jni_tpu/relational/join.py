@@ -101,6 +101,7 @@ def hash_join(
                          left_valid=right_valid, right_valid=left_valid)
 
     nl, nr = left.num_rows, right.num_rows
+    padded_right = nr == 0
     if nr == 0:
         # pad the build side with one unmatchable null row: downstream
         # gathers stay in-bounds and every probe misses (count semantics of
@@ -196,6 +197,10 @@ def hash_join(
                   else right_valid.astype(jnp.bool_))
         rcounts = jnp.where(r_null | ~r_live, 0, rhi - rlo)
         unmatched = (rcounts == 0) & r_live
+        if padded_right:
+            # the synthetic 1-row pad (empty build side) is not a real
+            # right row; it must not be appended
+            unmatched = jnp.zeros_like(unmatched)
         n_un = jnp.sum(unmatched.astype(jnp.int32))
         order = jnp.argsort(~unmatched, stable=True).astype(jnp.int32)
         app_valid = jnp.arange(nr, dtype=jnp.int32) < n_un
@@ -205,14 +210,21 @@ def hash_join(
         lpart = _concat_batches(lpart, lpart_app)
         rpart = _concat_batches(rpart, rpart_app)
         # the append region sits at offset `capacity`; pull it up so live
-        # rows are contiguous [0, total_main + n_un)
+        # rows are contiguous.  If the left-join region overflowed its
+        # budget (emitted_main < true total_main), surface an
+        # unambiguous overflow count — capacity+nr+1 always exceeds any
+        # representable output, so the caller's count>capacity check
+        # fires instead of garbage rows being presented as live.
         total_main = total
-        total = total_main + n_un
+        emitted_main = jnp.minimum(total_main, capacity)
+        total = jnp.where(total_main > capacity,
+                          jnp.int32(capacity + nr + 1),
+                          total_main + n_un)
         idx = jnp.arange(capacity + nr, dtype=jnp.int32)
-        srcrow = jnp.where(idx < total_main, idx,
-                           capacity + idx - total_main)
+        srcrow = jnp.where(idx < emitted_main, idx,
+                           capacity + idx - emitted_main)
         srcrow = jnp.clip(srcrow, 0, capacity + nr - 1)
-        live = idx < total
+        live = idx < emitted_main + n_un
         lpart = gather_batch(lpart, srcrow, live)
         rpart = gather_batch(rpart, srcrow, live)
 

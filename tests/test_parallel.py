@@ -219,3 +219,81 @@ class TestHierarchicalMesh:
 
         assert got_map == want_map
         assert sum(c for _, c in got_map.values()) == n
+
+
+class TestHierarchicalJoinSort:
+    def test_join_2d_matches_flat(self):
+        import numpy as np
+
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_jni_tpu.columnar import types as T
+        from spark_rapids_jni_tpu.columnar.column import Column, ColumnBatch
+        from spark_rapids_jni_tpu.parallel import data_mesh, shard_batch
+        from spark_rapids_jni_tpu.parallel.distributed import (
+            distributed_hash_join,
+            distributed_hash_join_2d,
+            hierarchical_mesh,
+        )
+
+        n = 8 * 32
+        rng = np.random.default_rng(6)
+        left = ColumnBatch(
+            {"k": Column.from_pylist(
+                list(rng.integers(0, 40, n).astype(int)), T.INT32),
+             "lv": Column.from_pylist(list(rng.integers(0, 100, n)
+                                           .astype(int)), T.INT64)})
+        right = ColumnBatch(
+            {"k": Column.from_pylist(list(range(40)) * (n // 40)
+                                     + [0] * (n % 40), T.INT32),
+             "rv": Column.from_pylist(
+                 [x * 7 for x in list(range(40)) * (n // 40)
+                  + [0] * (n % 40)], T.INT64)})
+
+        mesh2d = hierarchical_mesh(2, 4)
+        spec2d = jax.sharding.NamedSharding(
+            mesh2d, jax.sharding.PartitionSpec(("dcn", "ici")))
+        put2 = lambda b: jax.tree_util.tree_map(  # noqa: E731
+            lambda x: jax.device_put(x, spec2d), b)
+        res2, cnt2, drop2 = distributed_hash_join_2d(
+            put2(left), put2(right), ["k"], ["k"], "inner", mesh2d)
+        assert int(np.asarray(drop2).sum()) == 0
+
+        mesh1d = data_mesh(8)
+        res1, cnt1, drop1 = distributed_hash_join(
+            shard_batch(left, mesh1d), shard_batch(right, mesh1d),
+            ["k"], ["k"], "inner", mesh1d)
+        assert int(np.asarray(drop1).sum()) == 0
+        assert int(np.asarray(cnt2).sum()) == int(np.asarray(cnt1).sum())
+
+    def test_sort_2d_global_order(self):
+        import numpy as np
+
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_jni_tpu.columnar import types as T
+        from spark_rapids_jni_tpu.columnar.column import Column, ColumnBatch
+        from spark_rapids_jni_tpu.parallel.distributed import (
+            distributed_sort_2d,
+            hierarchical_mesh,
+        )
+
+        n = 8 * 64
+        rng = np.random.default_rng(7)
+        vals = rng.integers(-(10**6), 10**6, n)
+        batch = ColumnBatch(
+            {"k": Column.from_pylist(list(vals), T.INT64)})
+        mesh2d = hierarchical_mesh(2, 4)
+        spec2d = jax.sharding.NamedSharding(
+            mesh2d, jax.sharding.PartitionSpec(("dcn", "ici")))
+        sharded = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, spec2d), batch)
+        res, occ, drop = distributed_sort_2d(sharded, ["k"], mesh2d)
+        assert int(np.asarray(drop).sum()) == 0
+        occ_np = np.asarray(jax.device_get(occ))
+        k_np = np.asarray(jax.device_get(res["k"].data))[occ_np]
+        assert occ_np.sum() == n
+        assert (np.diff(k_np) >= 0).all()
+        assert sorted(k_np.tolist()) == sorted(vals.tolist())

@@ -764,3 +764,25 @@ class TestOuterJoins:
         got = sorted(zip(ks, rvs, lvs),
                      key=lambda x: (x[0], x[2] is None, x[2] or 0))
         assert got == [(2, 200, 20), (2, 200, 21), (3, 300, None)]
+
+
+    def test_full_join_overflow_and_empty_right(self):
+        from spark_rapids_jni_tpu.relational import hash_join
+
+        # overflow: 3 left rows each matching 2 right rows, capacity 4
+        left, right = self.batches([1, 1, 1], [10, 11, 12],
+                                   [1, 1, 9], [100, 101, 900])
+        res, count = hash_join(left, right, ["k"], ["k"], "full",
+                               capacity=4)
+        assert int(count) > 4 + 3  # unambiguous overflow signal
+        # retry with a big-enough budget succeeds
+        res, count = hash_join(left, right, ["k"], ["k"], "full",
+                               capacity=16)
+        assert int(count) == 7  # 6 matches + unmatched k=9
+
+        # empty right side: no spurious all-null appended row
+        left, right = self.batches([1, 2], [10, 20], [], [])
+        res, count = hash_join(left, right, ["k"], ["k"], "full",
+                               capacity=4)
+        assert int(count) == 2
+        assert res["lv"].to_pylist()[:2] == [10, 20]
