@@ -352,3 +352,187 @@ def murmur3_string(col, seed: int = 42,
     h = out[0, :n]
     return Column(jax.lax.bitcast_convert_type(h, jnp.int32),
                   jnp.ones((n,), jnp.bool_), T.INT32)
+
+
+# ---------------------------------------------------------------------------
+# xxhash64 over byte strings (word-major layout like murmur3_string)
+# ---------------------------------------------------------------------------
+
+_P4 = 0x85EBCA77C2B2AE63
+
+
+def _where64(m, a, b):
+    return jnp.where(m, a[0], b[0]), jnp.where(m, a[1], b[1])
+
+
+def _xxh_str_kernel(words_ref, len_ref, valid_ref, seed_ref,
+                    out_lo_ref, out_hi_ref):
+    """Full xxhash64 byte-stream pipeline in three uniform passes over the
+    word axis: 32-byte stripes, then 8-byte chunks, then the 4-byte word +
+    trailing bytes.  All per-row offsets (stripe count, chunk count, tail
+    word) are data, never indices — every sublane read is uniform across
+    lanes, so no cross-lane gathers (same discipline as
+    _murmur3_str_kernel; reference xxhash64.cu processes a row per thread
+    and has no such constraint).
+    """
+    W = words_ref.shape[0]
+    lengths = len_ref[0, :].astype(jnp.int32)
+    shape = lengths.shape
+    seed = (jnp.full(shape, seed_ref[0], jnp.uint32),
+            jnp.full(shape, seed_ref[1], jnp.uint32))
+
+    def bc(c):
+        return (jnp.broadcast_to(c[0], shape), jnp.broadcast_to(c[1], shape))
+
+    p1, p2, p3 = bc(_c64(_P1)), bc(_c64(_P2)), bc(_c64(_P3))
+    p4, p5 = bc(_c64(_P4)), bc(_c64(_P5))
+
+    nstripes = lengths // 32
+    n8 = (lengths % 32) // 8
+    has4 = (lengths % 8) >= 4
+
+    def u64_at(w_lo, w_hi):
+        return (w_lo, w_hi)
+
+    # --- pass 1: 32-byte stripes ------------------------------------
+    def acc(v, k, m):
+        nv = _mul64(_rotl64p(_add64(v, _mul64(k, p2)), 31), p1)
+        return _where64(m, nv, v)
+
+    def stripe_body(s, vs):
+        v1, v2, v3, v4 = vs
+        m = s < nstripes
+        v1 = acc(v1, u64_at(words_ref[8 * s + 0, :],
+                            words_ref[8 * s + 1, :]), m)
+        v2 = acc(v2, u64_at(words_ref[8 * s + 2, :],
+                            words_ref[8 * s + 3, :]), m)
+        v3 = acc(v3, u64_at(words_ref[8 * s + 4, :],
+                            words_ref[8 * s + 5, :]), m)
+        v4 = acc(v4, u64_at(words_ref[8 * s + 6, :],
+                            words_ref[8 * s + 7, :]), m)
+        return v1, v2, v3, v4
+
+    v1 = _add64(seed, bc(_c64((_P1 + _P2) & 0xFFFFFFFFFFFFFFFF)))
+    v2 = _add64(seed, p2)
+    v3 = seed
+    v4 = _add64(seed, bc(_c64((-_P1) & 0xFFFFFFFFFFFFFFFF)))
+    if W >= 8:
+        v1, v2, v3, v4 = jax.lax.fori_loop(
+            0, W // 8, stripe_body, (v1, v2, v3, v4))
+
+    h_long = _add64(
+        _add64(_rotl64p(v1, 1), _rotl64p(v2, 7)),
+        _add64(_rotl64p(v3, 12), _rotl64p(v4, 18)))
+
+    def merge(h, v):
+        vv = _mul64(_rotl64p(_mul64(v, p2), 31), p1)
+        return _add64(_mul64(_xor64(h, vv), p1), p4)
+
+    for v in (v1, v2, v3, v4):
+        h_long = merge(h_long, v)
+    h = _where64(lengths >= 32, h_long, _add64(seed, p5))
+    len64 = (jax.lax.bitcast_convert_type(lengths, jnp.uint32),
+             jnp.zeros(shape, jnp.uint32))
+    h = _add64(h, len64)
+
+    # --- pass 2: 8-byte chunks after the stripes ---------------------
+    def mix8(h, k):
+        kk = _mul64(_rotl64p(_mul64(k, p2), 31), p1)
+        return _add64(_mul64(_rotl64p(_xor64(h, kk), 27), p1), p4)
+
+    npairs = W // 2
+
+    def chunk8_body(p, h):
+        c = p - 4 * nstripes
+        m = (c >= 0) & (c < n8)
+        k = u64_at(words_ref[2 * p, :], words_ref[2 * p + 1, :])
+        return _where64(m, mix8(h, k), h)
+
+    if npairs > 0:
+        h = jax.lax.fori_loop(0, npairs, chunk8_body, h)
+
+    # --- pass 3: the optional 4-byte word + trailing bytes -----------
+    w4 = 8 * nstripes + 2 * n8
+    wb = w4 + has4.astype(jnp.int32)
+
+    def mix4(h, w):
+        k = _mul64((w, jnp.zeros(shape, jnp.uint32)), p1)
+        return _add64(_mul64(_rotl64p(_xor64(h, k), 23), p2), p3)
+
+    def mix1(h, byte_u32):
+        k = _mul64((byte_u32, jnp.zeros(shape, jnp.uint32)), p5)
+        return _mul64(_rotl64p(_xor64(h, k), 11), p1)
+
+    def tail_body(w, h):
+        word = words_ref[w, :]
+        h = _where64((w == w4) & has4, mix4(h, word), h)
+        at_tail = w == wb
+        nbytes = lengths - 4 * wb
+        for t in range(3):
+            b = (word >> jnp.uint32(8 * t)) & jnp.uint32(0xFF)
+            h = _where64(at_tail & (t < nbytes), mix1(h, b), h)
+        return h
+
+    h = jax.lax.fori_loop(0, W, tail_body, h)
+
+    # finalize
+    h = _xor64(h, _shr64(h, 33))
+    h = _mul64(h, p2)
+    h = _xor64(h, _shr64(h, 29))
+    h = _mul64(h, p3)
+    h = _xor64(h, _shr64(h, 32))
+    live = valid_ref[0, :] != 0
+    out_lo_ref[0, :] = jnp.where(live, h[0], seed[0])
+    out_hi_ref[0, :] = jnp.where(live, h[1], seed[1])
+
+
+def xxhash64_string(col, seed: int = 42,
+                    interpret: Optional[bool] = None) -> Column:
+    """Spark xxhash64 of one string column (Pallas word-major kernel);
+    bit-identical to :func:`hashing.xxhash64_bytes`.  Null rows return
+    the seed, like a null column contributing nothing to the row hash."""
+    chars, lengths, valid = col.chars, col.lengths, col.validity
+    n, L = chars.shape
+    # pad the word axis to a multiple of 8 (one full stripe) so every
+    # sublane index 8s+k .. 2p+1 .. stays in range
+    Lp = -(-max(L, 32) // 32) * 32
+    if Lp != L:
+        chars = jnp.pad(chars, ((0, 0), (0, Lp - L)))
+    W = Lp // 4
+    words = jax.lax.bitcast_convert_type(
+        chars.reshape(n, W, 4), jnp.uint32)
+    words_t = words.T
+
+    npad = -(-max(n, 1) // LANES) * LANES
+    if npad != n:
+        words_t = jnp.pad(words_t, ((0, 0), (0, npad - n)))
+        lengths = jnp.pad(lengths, (0, npad - n))
+        valid = jnp.pad(valid, (0, npad - n))
+    grid = npad // LANES
+
+    seed64 = seed & 0xFFFFFFFFFFFFFFFF
+    out_lo, out_hi = pl.pallas_call(
+        _xxh_str_kernel,
+        out_shape=(jax.ShapeDtypeStruct((1, npad), jnp.uint32),
+                   jax.ShapeDtypeStruct((1, npad), jnp.uint32)),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((W, LANES), lambda i: (0, i)),
+            pl.BlockSpec((1, LANES), lambda i: (0, i)),
+            pl.BlockSpec((1, LANES), lambda i: (0, i)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=(pl.BlockSpec((1, LANES), lambda i: (0, i)),
+                   pl.BlockSpec((1, LANES), lambda i: (0, i))),
+        interpret=_auto_interpret(interpret),
+    )(
+        words_t,
+        lengths.astype(jnp.int32)[None, :],
+        valid.astype(jnp.uint32)[None, :],
+        jnp.asarray([seed64 & 0xFFFFFFFF, seed64 >> 32], jnp.uint32),
+    )
+    from .hashing import _u64_to_i64
+
+    u64 = (out_lo[0, :n].astype(jnp.uint64)
+           | (out_hi[0, :n].astype(jnp.uint64) << jnp.uint64(32)))
+    return Column(_u64_to_i64(u64), jnp.ones((n,), jnp.bool_), T.INT64)

@@ -103,3 +103,29 @@ class TestMurmur3String:
         want = murmur_hash3_32([col])
         got = murmur3_string(col, interpret=True)
         assert (np.asarray(got.data) == np.asarray(want.data)).all()
+
+
+class TestXxhash64String:
+    def test_parity_with_jnp(self):
+        import numpy as np
+
+        from spark_rapids_jni_tpu.columnar.column import StringColumn
+        from spark_rapids_jni_tpu.ops.hashing import xxhash64
+        from spark_rapids_jni_tpu.ops.pallas_kernels import xxhash64_string
+
+        rng = np.random.default_rng(11)
+        vals = []
+        for i in range(400):
+            # hit every structural case: stripes (>=32), 8-byte chunks,
+            # the 4-byte word, and 0-3 trailing bytes
+            ln = int(rng.integers(0, 80))
+            vals.append(bytes(rng.integers(32, 127, ln).astype(np.uint8))
+                        .decode("ascii"))
+        vals[3] = None
+        vals[7] = ""
+        vals[11] = "x" * 32
+        vals[13] = "y" * 64
+        col = StringColumn.from_pylist(vals)
+        want = xxhash64([col])
+        got = xxhash64_string(col, interpret=True)
+        assert (np.asarray(got.data) == np.asarray(want.data)).all()
