@@ -248,6 +248,12 @@ def micro_main():
         strs, 1 << 18)
     run("murmur3_string_pallas",
         jax.jit(lambda c: pallas_kernels.murmur3_string(c)), strs, 1 << 18)
+    run("xxhash64_string", jax.jit(
+        lambda c: __import__("spark_rapids_jni_tpu.ops.hashing",
+                             fromlist=["x"]).xxhash64([c])),
+        strs, 1 << 18)
+    run("xxhash64_string_pallas",
+        jax.jit(lambda c: pallas_kernels.xxhash64_string(c)), strs, 1 << 18)
 
     # get_json_object (mirrors GET_JSON_OBJECT_BENCH)
     from spark_rapids_jni_tpu.ops.get_json_object import get_json_object

@@ -24,13 +24,30 @@ slice and DCN across.
 """
 
 from .partition import spark_partition_id
-from .shuffle import exchange
-from .distributed import data_mesh, distributed_group_by, shard_batch
+from .shuffle import exchange, exchange_hierarchical
+from .distributed import (
+    data_mesh,
+    distributed_group_by,
+    distributed_group_by_2d,
+    distributed_hash_join,
+    distributed_hash_join_2d,
+    distributed_sort,
+    distributed_sort_2d,
+    hierarchical_mesh,
+    shard_batch,
+)
 
 __all__ = [
     "spark_partition_id",
     "exchange",
+    "exchange_hierarchical",
     "data_mesh",
+    "hierarchical_mesh",
     "distributed_group_by",
+    "distributed_group_by_2d",
+    "distributed_hash_join",
+    "distributed_hash_join_2d",
+    "distributed_sort",
+    "distributed_sort_2d",
     "shard_batch",
 ]
