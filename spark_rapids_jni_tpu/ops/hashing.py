@@ -489,14 +489,20 @@ def murmur_hash3_32(columns: Columns, seed: int = 42) -> Column:
     n = _validate(cols)
     from ..columnar.column import ListColumn
 
-    if (len(cols) == 1 and isinstance(cols[0], Column)
-            and cols[0].dtype.kind in (T.Kind.INT64, T.Kind.TIMESTAMP)):
+    if len(cols) == 1:
         from .. import config
 
         if config.get("use_pallas_hashes"):
-            from .pallas_kernels import murmur3_int64
+            if (isinstance(cols[0], Column)
+                    and cols[0].dtype.kind in (T.Kind.INT64,
+                                               T.Kind.TIMESTAMP)):
+                from .pallas_kernels import murmur3_int64
 
-            return murmur3_int64(cols[0], seed=seed)
+                return murmur3_int64(cols[0], seed=seed)
+            if isinstance(cols[0], StringColumn):
+                from .pallas_kernels import murmur3_string
+
+                return murmur3_string(cols[0], seed=seed)
 
     h = jnp.full((n,), jnp.uint32(seed & 0xFFFFFFFF))
     for c in cols:
@@ -514,14 +520,20 @@ def xxhash64(columns: Columns, seed: int = DEFAULT_XXHASH64_SEED) -> Column:
 
     cols = _as_columns(columns)
     n = _validate(cols)
-    if (len(cols) == 1 and isinstance(cols[0], Column)
-            and cols[0].dtype.kind in (T.Kind.INT64, T.Kind.TIMESTAMP)):
+    if len(cols) == 1:
         from .. import config
 
         if config.get("use_pallas_hashes"):
-            from .pallas_kernels import xxhash64_int64
+            if (isinstance(cols[0], Column)
+                    and cols[0].dtype.kind in (T.Kind.INT64,
+                                               T.Kind.TIMESTAMP)):
+                from .pallas_kernels import xxhash64_int64
 
-            return xxhash64_int64(cols[0], seed=seed)
+                return xxhash64_int64(cols[0], seed=seed)
+            if isinstance(cols[0], StringColumn):
+                from .pallas_kernels import xxhash64_string
+
+                return xxhash64_string(cols[0], seed=seed)
     h = jnp.full((n,), jnp.uint64(seed & 0xFFFFFFFFFFFFFFFF))
     for c in cols:
         if isinstance(c, ListColumn):
