@@ -371,15 +371,59 @@ def _run_child(extra_env, timeout_s, mode):
     return None, f"rc={proc.returncode}"
 
 
+def _probe_main():
+    """Tiny child: is the accelerator backend alive at all?  A wedged
+    axon tunnel hangs jax.devices() forever (BASELINE.md), so the parent
+    gives this a short leash before paying the full TPU attempt."""
+    import jax
+
+    devs = jax.devices()
+    import jax.numpy as jnp
+
+    jax.block_until_ready(jnp.arange(8) + 1)
+    print(f"# probe ok: {devs}", flush=True)
+    return 0
+
+
 def main():
     mode = sys.argv[1] if len(sys.argv) > 1 else ""
     if mode == "--child":
         sys.exit(child_main())
     if mode == "--child-micro":
         sys.exit(micro_main())
+    if mode == "--probe":
+        sys.exit(_probe_main())
 
     run_micro = mode == "--micro"
     child_mode = "--child-micro" if run_micro else "--child"
+
+    # Pre-flight: a wedged accelerator tunnel hangs forever on first
+    # device use; detect that cheaply instead of burning the full TPU
+    # timeout before the CPU fallback.
+    probe_s = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "240"))
+    env = dict(os.environ)
+    try:
+        probe = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--probe"],
+            env=env, capture_output=True, text=True, timeout=probe_s)
+        accel_ok = probe.returncode == 0
+    except subprocess.TimeoutExpired:
+        accel_ok = False
+    if not accel_ok:
+        print("# accelerator probe failed/hung; running on CPU",
+              file=sys.stderr, flush=True)
+        lines, err = _run_child(
+            {"BENCH_FORCE_CPU": "1", "JAX_TRACEBACK_FILTERING": "off"},
+            CPU_TIMEOUT_S, child_mode)
+        if lines is None:
+            metric = "micro_suite" if run_micro else "q6_pipeline_throughput"
+            print(json.dumps({"metric": metric, "value": 0.0,
+                              "unit": "Mrows/s", "vs_baseline": 0.0,
+                              "error": err}))
+            sys.exit(0)
+        for ln in lines:
+            print(ln)
+        sys.exit(0)
 
     # 1st attempt: whatever backend the environment provides (TPU via axon).
     lines, err = _run_child({}, TPU_TIMEOUT_S, child_mode)
