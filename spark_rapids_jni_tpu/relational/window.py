@@ -28,7 +28,7 @@ from . import keys as K
 from .gather import gather_batch
 
 _WINDOW_OPS = ("row_number", "rank", "dense_rank", "sum", "min", "max",
-               "count", "avg")
+               "count", "avg", "lag", "lead")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -36,12 +36,16 @@ class WindowSpec:
     op: str                    # row_number | rank | dense_rank | sum | ...
     column: Optional[str]      # None for row_number/rank/dense_rank/count(*)
     out_name: str
+    offset: int = 1            # lag/lead only
 
     def __post_init__(self):
         if self.op not in _WINDOW_OPS:
             raise ValueError(f"unknown window op {self.op!r}")
-        if self.column is None and self.op in ("sum", "min", "max", "avg"):
+        if self.column is None and self.op in ("sum", "min", "max", "avg",
+                                               "lag", "lead"):
             raise ValueError(f"{self.op} needs a value column")
+        if self.op in ("lag", "lead") and self.offset < 0:
+            raise ValueError("lag/lead offset must be >= 0")
 
 
 def _seg_scan(vals, boundary, combine):
@@ -137,6 +141,29 @@ def window(
 
         col = sorted_batch[spec.column]
         data, valid = col.data, col.validity
+
+        if spec.op in ("lag", "lead"):
+            # partition extents: first index (running min of iota) and
+            # last index (running max over the reversed segments)
+            ps = _seg_scan(iota, part_boundary, jnp.minimum)
+            last_of_part = jnp.concatenate(
+                [part_boundary[1:], jnp.ones((1,), jnp.bool_)])
+            pe = jnp.flip(_seg_scan(jnp.flip(iota), jnp.flip(last_of_part),
+                                    jnp.maximum))
+            k = spec.offset
+            if spec.op == "lag":
+                src_i = iota - k
+                ok = src_i >= ps
+            else:
+                src_i = iota + k
+                ok = src_i <= pe
+            src_i = jnp.clip(src_i, 0, n - 1)
+            from .gather import gather_column
+
+            shifted = gather_column(col, src_i, valid=ok)
+            out[spec.out_name] = shifted
+            continue
+
         if spec.op == "count":
             cnt = _seg_scan(valid.astype(jnp.int64), part_boundary,
                             lambda a, b: a + b)

@@ -786,3 +786,51 @@ class TestOuterJoins:
                                capacity=4)
         assert int(count) == 2
         assert res["lv"].to_pylist()[:2] == [10, 20]
+
+
+class TestLagLead:
+    def test_lag_lead_within_partitions(self):
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from spark_rapids_jni_tpu.columnar import types as T
+        from spark_rapids_jni_tpu.columnar.column import Column, ColumnBatch
+        from spark_rapids_jni_tpu.relational import WindowSpec, window
+
+        part = [1, 1, 1, 2, 2, 3]
+        order = [10, 20, 30, 5, 6, 9]
+        vals = [100, 200, 300, 400, 500, 600]
+        batch = ColumnBatch(
+            {"p": Column.from_pylist(part, T.INT32),
+             "o": Column.from_pylist(order, T.INT64),
+             "v": Column.from_pylist(vals, T.INT64)})
+        res = window(batch, ["p"], ["o"],
+                     [WindowSpec("lag", "v", "lag1"),
+                      WindowSpec("lead", "v", "lead1"),
+                      WindowSpec("lag", "v", "lag2", offset=2)])
+        rows = sorted(zip(res["p"].to_pylist(), res["o"].to_pylist(),
+                          res["lag1"].to_pylist(), res["lead1"].to_pylist(),
+                          res["lag2"].to_pylist()))
+        assert rows == [
+            (1, 10, None, 200, None),
+            (1, 20, 100, 300, None),
+            (1, 30, 200, None, 100),
+            (2, 5, None, 500, None),
+            (2, 6, 400, None, None),
+            (3, 9, None, None, None),
+        ]
+
+    def test_lag_propagates_source_nulls(self):
+        from spark_rapids_jni_tpu.columnar import types as T
+        from spark_rapids_jni_tpu.columnar.column import Column, ColumnBatch
+        from spark_rapids_jni_tpu.relational import WindowSpec, window
+
+        batch = ColumnBatch(
+            {"p": Column.from_pylist([1, 1, 1], T.INT32),
+             "o": Column.from_pylist([1, 2, 3], T.INT64),
+             "v": Column.from_pylist([7, None, 9], T.INT64)})
+        res = window(batch, ["p"], ["o"], [WindowSpec("lag", "v", "lg")])
+        got = [x for _, x in sorted(zip(res["o"].to_pylist(),
+                                        res["lg"].to_pylist()))]
+        assert got == [None, 7, None]
