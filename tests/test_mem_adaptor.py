@@ -402,3 +402,69 @@ class TestExecutor:
                 assert spilled and halved
         finally:
             RmmSpark.clear_event_handler()
+
+
+class TestPipelineUnderInjectedOOM:
+    """End-to-end SURVEY §3.1 contract: the q6 pipeline driven through
+    TaskContext + run_with_retry completes with correct results under
+    injected RetryOOM and SplitAndRetryOOM (the reference proves this with
+    RmmSparkTest's injection scenarios around real kernels)."""
+
+    def test_q6_completes_under_injection(self):
+        import jax
+
+        import __graft_entry__ as ge
+        from spark_rapids_jni_tpu.mem import RmmSpark, TaskContext, run_with_retry
+        from spark_rapids_jni_tpu.mem.executor import batch_nbytes
+
+        RmmSpark.set_event_handler(64 << 20)
+        try:
+            batch = ge._example_batch(2048)
+            want_res, want_ng = jax.jit(ge._q6_step)(batch)
+            want = dict(zip(
+                want_res["k"].to_pylist()[: int(want_ng)],
+                want_res["sum_v"].to_pylist()[: int(want_ng)]))
+
+            state = {"rows": 2048, "splits": 0, "spills": 0}
+
+            with TaskContext(7) as ctx:
+                # inject: one RetryOOM then (after one success) a split
+                RmmSpark.force_retry_oom(None, 1, 0)
+
+                def step():
+                    b = ge._example_batch(state["rows"])
+                    n = ctx.charge(batch_nbytes(b))
+                    try:
+                        res, ng = jax.jit(ge._q6_step)(b)
+                        jax.block_until_ready((res, ng))
+                        return res, ng
+                    finally:
+                        ctx.release(n)
+
+                def make_spillable():
+                    state["spills"] += 1
+
+                def split():
+                    state["splits"] += 1
+                    state["rows"] //= 2
+
+                res, ng = run_with_retry(step, make_spillable, split)
+                assert state["spills"] == 1  # the injected retry fired
+
+                RmmSpark.force_split_and_retry_oom(None, 1, 0)
+                res, ng = run_with_retry(step, make_spillable, split)
+                assert state["splits"] == 1 and state["rows"] == 1024
+
+            RmmSpark.task_done(7)
+            got = dict(zip(res["k"].to_pylist()[: int(ng)],
+                           res["sum_v"].to_pylist()[: int(ng)]))
+            # split halved the input; recompute the oracle on 1024 rows
+            b2 = ge._example_batch(1024)
+            oracle_res, oracle_ng = jax.jit(ge._q6_step)(b2)
+            oracle = dict(zip(
+                oracle_res["k"].to_pylist()[: int(oracle_ng)],
+                oracle_res["sum_v"].to_pylist()[: int(oracle_ng)]))
+            assert got == oracle
+            assert RmmSpark._a().get_and_reset_num_retry(7) >= 1
+        finally:
+            RmmSpark.clear_event_handler()
