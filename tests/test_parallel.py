@@ -297,3 +297,41 @@ class TestHierarchicalJoinSort:
         assert occ_np.sum() == n
         assert (np.diff(k_np) >= 0).all()
         assert sorted(k_np.tolist()) == sorted(vals.tolist())
+
+
+def test_partition_ids_stable_under_pallas_knob():
+    """Shuffle partition assignment must be bit-identical whichever hash
+    backend the knob selects (partition parity is a wire contract)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_jni_tpu import config
+    from spark_rapids_jni_tpu.columnar.column import StringColumn
+    from spark_rapids_jni_tpu.parallel import spark_partition_id
+
+    col = StringColumn.from_pylist(
+        [f"key-{i * 37 % 101}" for i in range(257)] + [None])
+    rv = jnp.ones((col.num_rows,), jnp.bool_)
+    a = spark_partition_id([col], 16, rv)
+    config.set("use_pallas_hashes", True)
+    try:
+        b = spark_partition_id([col], 16, rv)
+    finally:
+        config.reset("use_pallas_hashes")
+    assert (np.asarray(a) == np.asarray(b)).all()
+
+
+def test_exchange_hierarchical_reserved_name():
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from spark_rapids_jni_tpu.columnar import types as T
+    from spark_rapids_jni_tpu.columnar.column import Column, ColumnBatch
+    from spark_rapids_jni_tpu.parallel import exchange_hierarchical
+
+    batch = ColumnBatch({"__pid__": Column.from_pylist([1], T.INT32)})
+    with _pytest.raises(ValueError):
+        exchange_hierarchical(batch, jnp.zeros((1,), jnp.int32),
+                              "dcn", "ici", 2, 2)
