@@ -484,3 +484,50 @@ def _sort_2d_step(mesh, dcn_axis, ici_axis, key_names, splitter_shape,
         return out, occ_sorted, dropped[None]
 
     return jax.jit(step)
+
+
+def distributed_group_by_onehot(
+    batch: ColumnBatch,
+    key_name: str,
+    aggs: Sequence[AggSpec],
+    domain: int,
+    mesh: Mesh,
+    axis_name: str = "data",
+    capacity: Optional[int] = None,
+):
+    """Distributed MXU-path aggregation: shuffle by key hash, then the
+    one-hot matmul aggregate locally (relational.aggregate.group_by_onehot).
+
+    Returns ``(result, num_groups int32[P], dropped int32[P],
+    overflow bool[P])`` — overflow means some non-null key fell outside
+    ``[0, domain)`` on that device and the caller must fall back to the
+    sort-scan path.
+    """
+    if capacity is None:
+        capacity = plan_exchange_capacity(batch, [key_name], mesh, axis_name)
+    step = _group_by_onehot_step(mesh, axis_name, key_name, tuple(aggs),
+                                 int(domain), capacity)
+    return step(batch)
+
+
+@lru_cache(maxsize=None)
+def _group_by_onehot_step(mesh, axis_name, key_name, aggs, domain, capacity):
+    from ..relational.aggregate import group_by_onehot
+
+    P = mesh.shape[axis_name]
+    spec = PartitionSpec(axis_name)
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(spec,), out_specs=(spec, spec, spec, spec),
+        check_vma=False,
+    )
+    def step(b: ColumnBatch):
+        rv = jnp.ones((b.num_rows,), jnp.bool_)
+        pid = spark_partition_id([b[key_name]], P, rv)
+        shuffled, occ, dropped = exchange(b, pid, axis_name, P, capacity)
+        res, ng, overflow = group_by_onehot(
+            shuffled, key_name, list(aggs), domain, row_valid=occ)
+        return res, ng[None], dropped[None], overflow[None]
+
+    return jax.jit(step)

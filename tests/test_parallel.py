@@ -335,3 +335,46 @@ def test_exchange_hierarchical_reserved_name():
     with _pytest.raises(ValueError):
         exchange_hierarchical(batch, jnp.zeros((1,), jnp.int32),
                               "dcn", "ici", 2, 2)
+
+
+def test_distributed_onehot_matches_sort_path():
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_jni_tpu.columnar import types as T
+    from spark_rapids_jni_tpu.columnar.column import Column, ColumnBatch
+    from spark_rapids_jni_tpu.parallel import (
+        data_mesh,
+        distributed_group_by,
+        shard_batch,
+    )
+    from spark_rapids_jni_tpu.parallel.distributed import (
+        distributed_group_by_onehot,
+    )
+    from spark_rapids_jni_tpu.relational import AggSpec
+
+    n = 8 * 32
+    rng = np.random.default_rng(12)
+    batch = ColumnBatch(
+        {"k": Column.from_pylist(
+            list(rng.integers(0, 50, n).astype(int)), T.INT32),
+         "v": Column.from_pylist(list(rng.integers(-999, 999, n)
+                                      .astype(int)), T.INT64)})
+    aggs = [AggSpec("sum", "v", "s"), AggSpec("count", None, "c")]
+    mesh = data_mesh(8)
+    sharded = shard_batch(batch, mesh)
+
+    res_a, ng_a, drop_a = distributed_group_by(sharded, ["k"], aggs, mesh)
+    res_b, ng_b, drop_b, ovf = distributed_group_by_onehot(
+        sharded, "k", aggs, 64, mesh)
+    assert not bool(np.asarray(ovf).any())
+    assert int(np.asarray(drop_b).sum()) == 0
+
+    from spark_rapids_jni_tpu.parallel.distributed import collect_groups
+
+    ga = collect_groups(res_a, ng_a)
+    gb = collect_groups(res_b, ng_b)
+    assert dict(zip(ga["k"], zip(ga["s"], ga["c"]))) == \
+        dict(zip(gb["k"], zip(gb["s"], gb["c"])))
