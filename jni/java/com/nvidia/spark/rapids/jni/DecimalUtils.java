@@ -28,6 +28,16 @@ public class DecimalUtils {
     return binop("multiply128", a, b, productScale);
   }
 
+  /** interimCast=true replicates the Spark &lt; 3.4.2 double-rounding bug
+   * (reference DecimalUtils.java:55-70). */
+  public static TpuTable multiply128(TpuColumnVector a, TpuColumnVector b, int productScale,
+      boolean interimCast) {
+    long[] out = Bridge.invoke("DecimalUtils.multiply128",
+        "{\"scale\":" + productScale + ",\"interim_cast\":" + interimCast + "}",
+        new long[]{a.getNativeView(), b.getNativeView()});
+    return new TpuTable(new TpuColumnVector(out[0]), new TpuColumnVector(out[1]));
+  }
+
   public static TpuTable divide128(TpuColumnVector a, TpuColumnVector b, int quotientScale) {
     return binop("divide128", a, b, quotientScale);
   }

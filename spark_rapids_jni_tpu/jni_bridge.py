@@ -265,6 +265,10 @@ def _op_dec128(fn_name, n_out=2):
         fn = getattr(D, fn_name)
         if fn_name in ("integer_divide_decimal128",):
             overflow, res = fn(objs[0], objs[1])
+        elif fn_name == "multiply_decimal128":
+            overflow, res = fn(
+                objs[0], objs[1], args["scale"],
+                cast_interim_result=args.get("interim_cast", True))
         else:
             overflow, res = fn(objs[0], objs[1], args["scale"])
         return [overflow, res], {}

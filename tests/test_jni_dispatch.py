@@ -235,3 +235,15 @@ class TestErrors:
             SplitAndRetryOOM()) == jb.ERR_SPLIT_OOM
         assert jb.classify_exception(CpuRetryOOM()) == jb.ERR_CPU_RETRY_OOM
         assert jb.classify_exception(ValueError()) == jb.ERR_GENERIC
+
+
+def test_multiply128_interim_cast_toggle():
+    """Both rounding modes reachable through the wire (reference
+    DecimalUtils.java:70 interimCast)."""
+    a = dec([10**37], precision=38, scale=2)
+    b = dec([10**3], precision=38, scale=2)
+    with_bug, _ = invoke("DecimalUtils.multiply128",
+                         {"scale": 2, "interim_cast": True}, [a, b])
+    without, _ = invoke("DecimalUtils.multiply128",
+                        {"scale": 2, "interim_cast": False}, [a, b])
+    assert with_bug[0].num_rows == 1 and without[0].num_rows == 1
