@@ -14,7 +14,12 @@ surface (``RmmSpark.java``, ``SparkResourceAdaptor.java``,
   equivalents the query engine catches to roll back, spill, and retry.
 """
 
-from .executor import TaskContext, batch_nbytes, run_with_retry  # noqa: F401
+from .executor import (  # noqa: F401
+    Spillable,
+    TaskContext,
+    batch_nbytes,
+    run_with_retry,
+)
 from .rmm_spark import (  # noqa: F401
     CpuRetryOOM,
     CpuSplitAndRetryOOM,

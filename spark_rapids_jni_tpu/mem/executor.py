@@ -128,3 +128,63 @@ def run_with_retry(
             except RetryOOM as e2:
                 last = e2
     raise last
+
+
+class Spillable:
+    """Device batch that can round-trip to host memory under pressure.
+
+    The reference plugin's retry contract is "make inputs spillable ->
+    blockThreadUntilReady -> retry" (RmmSpark.java:402-416); the spill
+    framework itself lives plugin-side.  This is the TPU-side primitive:
+    ``spill()`` copies every device buffer to host numpy and releases the
+    arena charge; ``get()`` re-uploads (re-charging) on next use.
+
+    Typical wiring: ``run_with_retry(step, make_spillable=s.spill)``.
+    """
+
+    def __init__(self, tree, ctx: Optional[TaskContext] = None):
+        self._tree = tree
+        self._host = None
+        self._treedef = None
+        self._ctx = ctx
+        self._charged = 0
+        if ctx is not None:
+            self._charged = ctx.charge(batch_nbytes(tree))
+
+    @property
+    def is_spilled(self) -> bool:
+        return self._host is not None
+
+    def spill(self):
+        """Device -> host; releases the arena charge.  Idempotent."""
+        if self._host is not None or self._tree is None:
+            return
+        import numpy as np
+
+        leaves, treedef = jax.tree_util.tree_flatten(self._tree)
+        self._host = [np.asarray(jax.device_get(leaf)) for leaf in leaves]
+        self._treedef = treedef
+        self._tree = None
+        if self._ctx is not None and self._charged:
+            self._ctx.release(self._charged)
+            self._charged = 0
+
+    def get(self):
+        """The device tree, re-uploading (and re-charging) if spilled."""
+        if self._tree is None:
+            import jax.numpy as jnp
+
+            leaves = [jnp.asarray(a) for a in self._host]
+            self._tree = jax.tree_util.tree_unflatten(self._treedef, leaves)
+            self._host = None
+            self._treedef = None
+            if self._ctx is not None:
+                self._charged = self._ctx.charge(batch_nbytes(self._tree))
+        return self._tree
+
+    def close(self):
+        if self._ctx is not None and self._charged:
+            self._ctx.release(self._charged)
+            self._charged = 0
+        self._tree = None
+        self._host = None

@@ -479,3 +479,72 @@ class TestPipelineUnderInjectedOOM:
             assert RmmSpark._a().get_and_reset_num_retry(7) >= 1
         finally:
             RmmSpark.clear_event_handler()
+
+
+class TestSpillable:
+    def test_spill_releases_and_reupload_recharges(self):
+        import numpy as np
+
+        import jax
+
+        import __graft_entry__ as ge
+        from spark_rapids_jni_tpu.mem import RmmSpark, Spillable, TaskContext
+        from spark_rapids_jni_tpu.mem.executor import batch_nbytes
+
+        RmmSpark.set_event_handler(64 << 20)
+        try:
+            with TaskContext(3) as ctx:
+                batch = ge._example_batch(512)
+                nbytes = batch_nbytes(batch)
+                s = Spillable(batch, ctx)
+                assert RmmSpark._a().total_allocated() == nbytes
+                before = np.asarray(jax.device_get(batch["v"].data)).copy()
+
+                s.spill()
+                assert s.is_spilled
+                assert RmmSpark._a().total_allocated() == 0
+
+                got = s.get()  # re-upload + re-charge
+                assert not s.is_spilled
+                assert RmmSpark._a().total_allocated() == nbytes
+                after = np.asarray(jax.device_get(got["v"].data))
+                assert (before == after).all()
+                s.close()
+                assert RmmSpark._a().total_allocated() == 0
+            RmmSpark.task_done(3)
+        finally:
+            RmmSpark.clear_event_handler()
+
+    def test_retry_ladder_with_real_spill(self):
+        import jax
+
+        import __graft_entry__ as ge
+        from spark_rapids_jni_tpu.mem import (
+            RmmSpark,
+            Spillable,
+            TaskContext,
+            run_with_retry,
+        )
+
+        RmmSpark.set_event_handler(64 << 20)
+        try:
+            with TaskContext(4) as ctx:
+                s = Spillable(ge._example_batch(512), ctx)
+                RmmSpark.force_retry_oom(None, 1, 0)
+
+                def step():
+                    RmmSpark.allocate(1 << 10)  # trips the injection once
+                    try:
+                        res, ng = jax.jit(ge._q6_step)(s.get())
+                        jax.block_until_ready((res, ng))
+                        return res, ng
+                    finally:
+                        RmmSpark.deallocate(1 << 10)
+
+                res, ng = run_with_retry(step, make_spillable=s.spill)
+                assert int(ng) > 0
+                assert not s.is_spilled  # get() re-uploaded for the retry
+                s.close()
+            RmmSpark.task_done(4)
+        finally:
+            RmmSpark.clear_event_handler()
