@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# One-shot TPU measurement session (run when the axon tunnel is alive):
+# flagship q6 under both aggregation engines, then the incremental micro
+# suite.  Never run two TPU clients at once (BASELINE.md).
+# Config env overrides use the SPARK_RAPIDS_TPU_<KEY> registry prefix.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== q6 sort-scan engine"
+python bench.py
+
+echo "== q6 MXU one-hot engine"
+SPARK_RAPIDS_TPU_Q6_GROUP_PATH=onehot python bench.py
+
+echo "== pallas hash routing on"
+SPARK_RAPIDS_TPU_USE_PALLAS_HASHES=1 python bench.py
+
+echo "== micro suite"
+python bench.py --micro
