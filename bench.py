@@ -385,6 +385,29 @@ def _probe_main():
     return 0
 
 
+def _run_probe(env, timeout_s) -> bool:
+    """Run the accelerator probe with a GRACEFUL timeout.  subprocess.run's
+    timeout SIGKILLs the child, and a client SIGKILLed mid-handshake wedges
+    the single axon tunnel slot (BASELINE.md) — the probe must never cause
+    the condition it exists to detect.  SIGTERM first, wait, then escalate.
+    """
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--probe"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    deadline = time.monotonic() + timeout_s
+    while proc.poll() is None and time.monotonic() < deadline:
+        time.sleep(0.25)
+    if proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+        return False
+    return proc.returncode == 0
+
+
 def main():
     mode = sys.argv[1] if len(sys.argv) > 1 else ""
     if mode == "--child":
@@ -402,13 +425,7 @@ def main():
     # timeout before the CPU fallback.
     probe_s = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "240"))
     env = dict(os.environ)
-    try:
-        probe = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--probe"],
-            env=env, capture_output=True, text=True, timeout=probe_s)
-        accel_ok = probe.returncode == 0
-    except subprocess.TimeoutExpired:
-        accel_ok = False
+    accel_ok = _run_probe(env, probe_s)
     if not accel_ok:
         print("# accelerator probe failed/hung; running on CPU",
               file=sys.stderr, flush=True)

@@ -170,16 +170,30 @@ class Spillable:
             self._charged = 0
 
     def get(self):
-        """The device tree, re-uploading (and re-charging) if spilled."""
+        """The device tree, re-uploading (and re-charging) if spilled.
+
+        The arena is charged BEFORE the upload (the byte count is known
+        from the host leaves): if ``charge`` raises RetryOOM the batch
+        stays spilled and fully accounted, instead of sitting in HBM
+        uncharged forever.
+        """
         if self._tree is None:
             import jax.numpy as jnp
 
-            leaves = [jnp.asarray(a) for a in self._host]
-            self._tree = jax.tree_util.tree_unflatten(self._treedef, leaves)
+            if self._ctx is not None:
+                nbytes = sum(int(a.nbytes) for a in self._host)
+                self._charged = self._ctx.charge(nbytes)  # may raise RetryOOM
+            try:
+                leaves = [jnp.asarray(a) for a in self._host]
+                self._tree = jax.tree_util.tree_unflatten(
+                    self._treedef, leaves)
+            except BaseException:
+                if self._ctx is not None and self._charged:
+                    self._ctx.release(self._charged)
+                    self._charged = 0
+                raise
             self._host = None
             self._treedef = None
-            if self._ctx is not None:
-                self._charged = self._ctx.charge(batch_nbytes(self._tree))
         return self._tree
 
     def close(self):

@@ -536,3 +536,122 @@ def xxhash64_string(col, seed: int = 42,
     u64 = (out_lo[0, :n].astype(jnp.uint64)
            | (out_hi[0, :n].astype(jnp.uint64) << jnp.uint64(32)))
     return Column(_u64_to_i64(u64), jnp.ones((n,), jnp.bool_), T.INT64)
+
+
+# ---------------------------------------------------------------------------
+# fused one-hot group-by contraction (the q6 aggregation hot loop)
+# ---------------------------------------------------------------------------
+
+GB_ROWS = 1024  # rows per grid step; [GB_ROWS, 128] int8 onehot = 128KB VMEM
+
+
+def _onehot_tile(bucket_ref, kblock):
+    """The tile's one-hot, built on the fly from [rows, 1] bucket ids —
+    it lives only in VMEM/registers.  (The XLA formulation in
+    :func:`relational.aggregate.group_by_onehot` materializes ``[n, K]``
+    one-hots in HBM at every contraction dtype — multi-GB at bench row
+    counts; here HBM traffic is just the payload columns.)"""
+    b = bucket_ref[:]  # [rows, 1] int32; -1 = dead row (matches no lane)
+    lanes = (jax.lax.broadcasted_iota(jnp.int32, (b.shape[0], LANES), 1)
+             + kblock * LANES)
+    return b == lanes
+
+
+# Grid order: the K block is the OUTER dim and rows the INNER dim, so each
+# output block is visited on consecutive grid steps — Pallas TPU keeps an
+# output window resident in VMEM only across consecutive steps, and a
+# revisited block would otherwise read back undefined HBM contents.
+# Accumulation: int32 / f32; partials bound by |payload| <= 128 per row
+# ⇒ callers chunk at 2^23 rows.
+
+def _onehot_gb_kernel(bucket_ref, pi_ref, pf_ref, oi_ref, of_ref):
+    i = pl.program_id(1)  # row tile (inner)
+
+    @pl.when(i == 0)
+    def _():
+        oi_ref[:] = jnp.zeros_like(oi_ref)
+        of_ref[:] = jnp.zeros_like(of_ref)
+
+    oh = _onehot_tile(bucket_ref, pl.program_id(0))
+    oi_ref[:] += jax.lax.dot_general(
+        oh.astype(jnp.int8), pi_ref[:],
+        (((0,), (0,)), ((), ())), preferred_element_type=jnp.int32)
+    of_ref[:] += jax.lax.dot_general(
+        oh.astype(jnp.float32), pf_ref[:],
+        (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+
+def _onehot_gb_kernel_int(bucket_ref, pi_ref, oi_ref):
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _():
+        oi_ref[:] = jnp.zeros_like(oi_ref)
+
+    oh = _onehot_tile(bucket_ref, pl.program_id(0))
+    oi_ref[:] += jax.lax.dot_general(
+        oh.astype(jnp.int8), pi_ref[:],
+        (((0,), (0,)), ((), ())), preferred_element_type=jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("domain", "interpret"))
+def _onehot_gb_call(bucket, pi, pf, domain, interpret):
+    n = bucket.shape[0]
+    npad = -(-max(n, 1) // GB_ROWS) * GB_ROWS
+    if npad != n:
+        bucket = jnp.pad(bucket, (0, npad - n), constant_values=-1)
+        pi = jnp.pad(pi, ((0, npad - n), (0, 0)))
+        pf = jnp.pad(pf, ((0, npad - n), (0, 0)))
+    KP = -(-domain // LANES) * LANES
+    mi, mf = pi.shape[1], pf.shape[1]
+    grid = (KP // LANES, npad // GB_ROWS)
+    row_spec = lambda mcols: pl.BlockSpec(  # noqa: E731
+        (GB_ROWS, mcols), lambda j, i: (i, 0))
+    out_spec = lambda mcols: pl.BlockSpec(  # noqa: E731
+        (LANES, mcols), lambda j, i: (j, 0))
+    if mf == 0:  # int-only aggregations skip the float pass entirely
+        oi = pl.pallas_call(
+            _onehot_gb_kernel_int,
+            out_shape=jax.ShapeDtypeStruct((KP, mi), jnp.int32),
+            grid=grid,
+            in_specs=[row_spec(1), row_spec(mi)],
+            out_specs=out_spec(mi),
+            interpret=interpret,
+        )(bucket[:, None], pi)
+        return oi[:domain], jnp.zeros((domain, 0), jnp.float32)
+    oi, of = pl.pallas_call(
+        _onehot_gb_kernel,
+        out_shape=(jax.ShapeDtypeStruct((KP, mi), jnp.int32),
+                   jax.ShapeDtypeStruct((KP, mf), jnp.float32)),
+        grid=grid,
+        in_specs=[row_spec(1), row_spec(mi), row_spec(mf)],
+        out_specs=(out_spec(mi), out_spec(mf)),
+        interpret=interpret,
+    )(bucket[:, None], pi, pf)
+    return oi[:domain], of[:domain]
+
+
+def onehot_groupby_parts(bucket, int_payload, float_payload, domain,
+                         interpret=None):
+    """Fused group-by contraction: per-bucket column sums without an HBM
+    one-hot.
+
+    ``bucket``: int32[n], values in [0, domain) (use -1 for dead rows).
+    ``int_payload``: int8[n, mi], |x| <= 128 per element (byte limbs,
+    validity flags, count ones).  ``float_payload``: f32[n, mf] (Dekker
+    limbs of f64 values).  Returns (int64[domain, mi], float64[domain,
+    mf]) — int sums exact; float sums accumulate in f32 per 2^23-row
+    chunk, then f64 across chunks.
+    """
+    interp = _auto_interpret(interpret)
+    n = bucket.shape[0]
+    CH = 1 << 23  # int32 partials hold n * 128 < 2^31 per chunk
+    oi64 = jnp.zeros((domain, int_payload.shape[1]), jnp.int64)
+    of64 = jnp.zeros((domain, float_payload.shape[1]), jnp.float64)
+    for lo in range(0, max(n, 1), CH):
+        oi, of = _onehot_gb_call(
+            bucket[lo:lo + CH], int_payload[lo:lo + CH],
+            float_payload[lo:lo + CH], domain, interp)
+        oi64 = oi64 + oi.astype(jnp.int64)
+        of64 = of64 + of.astype(jnp.float64)
+    return oi64, of64

@@ -268,6 +268,7 @@ def group_by_onehot(
     domain: int,
     row_valid=None,
     float_mode: str = "f64",
+    engine: str = "xla",
 ):
     """Hash-aggregate as matmuls: the TPU-first alternative to the
     sort-scan path when one integer key column has a small static domain
@@ -275,23 +276,29 @@ def group_by_onehot(
     shape).  The per-key FLOPs land on the MXU instead of the VPU sort
     network:
 
-    * one-hot ``[n, K+1]`` int8 (bucket K holds null keys), fused by XLA
-      into the dot operand;
-    * count(*) / count(col): ``onehot^T @ 1`` with int32 accumulation;
-    * sum(int*): exact via byte limbs — each int64 value becomes eight
-      int8 lanes ``b_l - 128``; ``onehot^T @ limbs`` accumulates in int32
-      (|x|<=128, n<=2^23 keeps partials under 2^31), then the true limb
-      sums are rebuilt with ``+128*count`` and recombined in uint64 with
-      Spark's non-ANSI wraparound;
-    * sum(float*): f32 limb split (hi/mid/lo, exact 3-way Dekker split of
-      the f64 mantissa) so the dot runs on MXU-native f32; accumulation
-      rounding is within Spark's order-nondeterministic tolerance;
+    * one-hot ``[n, K+1]`` int8 (bucket K holds null keys);
+    * ALL integer payloads ride ONE chunked int8 x int8 -> int32
+      contraction: column 0 is the count(*) ones, then per referenced
+      column a validity flag, then for each integer sum the eight byte
+      limbs ``b_l - 128`` (exact: true limb sums are rebuilt with
+      ``+128*count`` and recombined in uint64 with Spark's non-ANSI
+      wraparound).  One HBM pass over the one-hot instead of one per agg;
+    * float sums ride ONE f32 contraction in ``f32x3`` mode (exact 3-way
+      Dekker split of the f64 mantissa — MXU-native, accumulation
+      rounding inside Spark's order-nondeterminism) or one emulated-f64
+      contraction in ``f64`` mode (slow on TPU but rounding-compatible
+      with the sort-scan path);
     * mean: sum / count in f64.
 
     min/max and multi-column keys stay on the sort-scan path.  Returns
     ``(result, num_groups, overflow)`` — ``overflow`` is a device bool
     that is True if any non-null key fell outside ``[0, domain)`` (result
     is then invalid; callers assert or fall back).
+
+    ``engine="pallas"`` routes the contraction through the fused
+    :func:`ops.pallas_kernels.onehot_groupby_parts` kernel, which never
+    materializes the one-hot in HBM (the XLA engine does, twice at the
+    widest dtype); the pallas engine always uses the f32x3 float split.
     """
     K = int(domain)
     col = batch[key_name]
@@ -302,18 +309,135 @@ def group_by_onehot(
     row_live = jnp.ones((n,), jnp.bool_) if row_valid is None else row_valid
     live = col.validity & row_live
 
-    k = col.data.astype(jnp.int32)
-    overflow = jnp.any(live & ((k < 0) | (k >= K)))
+    # overflow must be judged on the original key width: an INT64 key like
+    # 2**32 wraps to 0 under an int32 cast and would silently pass the
+    # bounds check (callers rely on this flag to fall back to sort-scan)
+    k_orig = col.data
+    overflow = jnp.any(live & ((k_orig < 0) | (k_orig >= K)))
+    k = k_orig.astype(jnp.int32)
     # null keys form their own group (bucket K), like the sort-scan path;
     # dead padding rows are dropped from the onehot entirely
     bucket = jnp.where(live, jnp.clip(k, 0, K - 1), K)
-    oh = ((bucket[:, None] == jnp.arange(K + 1, dtype=jnp.int32)[None, :])
-          & row_live[:, None]).astype(jnp.int8)
 
-    counts_star = jax.lax.dot_general(
-        oh.T, jnp.ones((n, 1), jnp.int8),
-        (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32,
-    )[:, 0]
+    # ---- plan the stacked payload ------------------------------------
+    # int8 slots: [0]=ones(count*), then per referenced column one valid
+    # flag, then 8 byte limbs per integer sum column
+    is_float = {}
+    int_cols, float_cols = [], []
+    valid_slot = {}
+    for spec in aggs:
+        if spec.op not in ("sum", "mean", "count"):
+            raise NotImplementedError(
+                f"group_by_onehot: {spec.op} stays on the sort-scan path")
+        if spec.column is None:
+            continue
+        c = spec.column
+        valid_slot.setdefault(c, 0)  # slot index assigned below
+        if spec.op in ("sum", "mean"):
+            fl = batch[c].dtype.kind in (T.Kind.FLOAT32, T.Kind.FLOAT64)
+            is_float[c] = fl
+            target = float_cols if fl else int_cols
+            if c not in target:
+                target.append(c)
+
+    cols8 = [jnp.ones((n,), jnp.int8)]  # slot 0: count(*)
+    for c in valid_slot:
+        valid_slot[c] = len(cols8)
+        cols8.append((batch[c].validity & row_live).astype(jnp.int8))
+    limb_slot = {}
+    for c in int_cols:
+        vcol = batch[c]
+        vvalid = vcol.validity & row_live
+        u = jax.lax.bitcast_convert_type(
+            jnp.where(vvalid, vcol.data.astype(jnp.int64), jnp.int64(0)),
+            jnp.uint64)
+        bytes8 = jax.lax.bitcast_convert_type(u, jnp.uint8)  # [n, 8]
+        x = jnp.where(vvalid[:, None],
+                      bytes8.astype(jnp.int16) - jnp.int16(128),
+                      jnp.int16(0)).astype(jnp.int8)
+        limb_slot[c] = len(cols8)
+        cols8.extend(x[:, j] for j in range(8))
+    X8 = jnp.stack(cols8, axis=1)  # [n, m8]
+
+    def dekker_limbs(c):
+        """Exact 3-way split of a masked f64 column into f32 (hi, mid, lo)."""
+        vcol = batch[c]
+        vvalid = vcol.validity & row_live
+        v = jnp.where(vvalid, vcol.data.astype(jnp.float64), 0.0)
+        hi = v.astype(jnp.float32)
+        r1 = v - hi.astype(jnp.float64)
+        mid = r1.astype(jnp.float32)
+        lo_ = (r1 - mid.astype(jnp.float64)).astype(jnp.float32)
+        return [hi, mid, lo_]
+
+    if engine not in ("xla", "pallas"):
+        raise ValueError(f"unknown engine {engine!r} (use 'xla' or 'pallas')")
+    if engine == "pallas" and float_cols and float_mode != "f32x3":
+        raise ValueError(
+            "engine='pallas' computes float sums with the f32x3 Dekker "
+            "split only (no f64 contraction in the kernel); pass "
+            "float_mode='f32x3' to acknowledge the non-bit-stable rounding")
+    use_f32x3 = float_mode == "f32x3" or engine == "pallas"
+
+    F = None
+    if float_cols:
+        if use_f32x3:
+            F = jnp.stack(
+                sum((dekker_limbs(c) for c in float_cols), []), axis=1)
+        else:
+            F = jnp.stack(
+                [jnp.where(batch[c].validity & row_live,
+                           batch[c].data.astype(jnp.float64), 0.0)
+                 for c in float_cols], axis=1)
+
+    if engine == "pallas":
+        from ..ops.pallas_kernels import onehot_groupby_parts
+
+        bucket_pl = jnp.where(row_live, bucket, jnp.int32(-1))
+        Fp = F if F is not None else jnp.zeros((n, 0), jnp.float32)
+        part, fpart = onehot_groupby_parts(bucket_pl, X8, Fp, K + 1)
+    else:
+        oh = ((bucket[:, None]
+               == jnp.arange(K + 1, dtype=jnp.int32)[None, :])
+              & row_live[:, None]).astype(jnp.int8)
+        # ONE chunked int8 contraction.  int32 partials hold |x| <= 128
+        # summed over a block, so blocks stay under 2^31/128 = 2^24 rows;
+        # static n means static slices, combined in int64.
+        B = 1 << 23
+        part = jnp.zeros((K + 1, X8.shape[1]), jnp.int64)
+        for lo in range(0, n, B):
+            part = part + jax.lax.dot_general(
+                oh[lo:lo + B].T, X8[lo:lo + B], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            ).astype(jnp.int64)
+        if float_cols:
+            fdt = jnp.float32 if use_f32x3 else jnp.float64
+            fpart = jax.lax.dot_general(
+                oh.astype(fdt).T, F, (((1,), (0,)), ((), ())),
+                preferred_element_type=fdt,
+            ).astype(jnp.float64)
+
+    fsum_of = {}
+    for i, c in enumerate(float_cols):
+        if use_f32x3:
+            fsum_of[c] = (fpart[:, 3 * i] + fpart[:, 3 * i + 1]
+                          + fpart[:, 3 * i + 2])
+        else:
+            fsum_of[c] = fpart[:, i]
+
+    counts_star = part[:, 0]
+    cnt_of = {c: part[:, s] for c, s in valid_slot.items()}
+
+    # ---- exact integer sums: rebuild from offset byte limbs ----------
+    isum_of = {}
+    shifts = (jnp.uint64(8) * jnp.arange(8, dtype=jnp.uint64))[None, :]
+    for c in int_cols:
+        s = limb_slot[c]
+        true_limb = part[:, s:s + 8] + jnp.int64(128) * cnt_of[c][:, None]
+        total_u = jnp.sum(
+            jax.lax.bitcast_convert_type(true_limb, jnp.uint64)
+            << shifts, axis=1)
+        isum_of[c] = jax.lax.bitcast_convert_type(total_u, jnp.int64)
 
     out_cols = {}
     key_valid = jnp.arange(K + 1) < K
@@ -326,83 +450,26 @@ def group_by_onehot(
             out_cols[spec.out_name] = Column(
                 counts_star.astype(jnp.int64), counts_star >= 0, T.INT64)
             continue
-        vcol = batch[spec.column]
-        vvalid = vcol.validity & row_live
+        cnt_v = cnt_of[spec.column]
         if spec.op == "count":
-            cnt = jax.lax.dot_general(
-                oh.T, vvalid.astype(jnp.int8)[:, None],
-                (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32,
-            )[:, 0]
             out_cols[spec.out_name] = Column(
-                cnt.astype(jnp.int64), cnt >= 0, T.INT64)
+                cnt_v.astype(jnp.int64), cnt_v >= 0, T.INT64)
             continue
-        if spec.op not in ("sum", "mean"):
-            raise NotImplementedError(
-                f"group_by_onehot: {spec.op} stays on the sort-scan path")
-
-        cnt_v = jax.lax.dot_general(
-            oh.T, vvalid.astype(jnp.int8)[:, None],
-            (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32,
-        )[:, 0]
-
-        if vcol.dtype.kind in (T.Kind.FLOAT32, T.Kind.FLOAT64):
-            v = jnp.where(vvalid, vcol.data.astype(jnp.float64), 0.0)
-            if float_mode == "f32x3":
-                # MXU-native: exact 3-way Dekker split, f32 accumulation.
-                # Rounding ~1e-6 relative at millions of rows — inside
-                # Spark's shuffle-order nondeterminism for many queries,
-                # but NOT bit-stable; opt-in.
-                hi = v.astype(jnp.float32)
-                r1 = v - hi.astype(jnp.float64)
-                mid = r1.astype(jnp.float32)
-                lo = (r1 - mid.astype(jnp.float64)).astype(jnp.float32)
-                limbs = jnp.stack([hi, mid, lo], axis=1)  # [n, 3] f32
-                part = jax.lax.dot_general(
-                    oh.astype(jnp.float32).T, limbs,
-                    (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                ).astype(jnp.float64)
-                fsum = part[:, 0] + part[:, 1] + part[:, 2]
-            else:
-                # exact mode: f64 contraction (XLA emulates f64 off the
-                # MXU; accumulation error matches the sort-scan path's)
-                fsum = jax.lax.dot_general(
-                    oh.astype(jnp.float64).T, v[:, None],
-                    (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float64,
-                )[:, 0]
+        if is_float[spec.column]:
+            fsum = fsum_of[spec.column]
             if spec.op == "mean":
                 res = fsum / jnp.maximum(cnt_v, 1).astype(jnp.float64)
             else:
                 res = fsum
             out_cols[spec.out_name] = Column(res, cnt_v > 0, T.FLOAT64)
-            continue
-
-        # exact integer sums via byte limbs
-        u = jax.lax.bitcast_convert_type(
-            jnp.where(vvalid, vcol.data.astype(jnp.int64), jnp.int64(0)),
-            jnp.uint64)
-        bytes8 = jax.lax.bitcast_convert_type(u, jnp.uint8)  # [n, 8]
-        x = jnp.where(vvalid[:, None],
-                      bytes8.astype(jnp.int16) - jnp.int16(128),
-                      jnp.int16(0)).astype(jnp.int8)
-        part = jax.lax.dot_general(
-            oh.T, x, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32,
-        )  # [K+1, 8]
-        true_limb = part.astype(jnp.int64) + jnp.int64(128) * cnt_v[:, None]
-        shifts = (jnp.uint64(8) * jnp.arange(8, dtype=jnp.uint64))[None, :]
-        total_u = jnp.sum(
-            jax.lax.bitcast_convert_type(true_limb, jnp.uint64)
-            << shifts, axis=1)
-        isum = jax.lax.bitcast_convert_type(total_u, jnp.int64)
-        if spec.op == "mean":
+        elif spec.op == "mean":
             out_cols[spec.out_name] = Column(
-                isum.astype(jnp.float64)
+                isum_of[spec.column].astype(jnp.float64)
                 / jnp.maximum(cnt_v, 1).astype(jnp.float64),
                 cnt_v > 0, T.FLOAT64)
         else:
-            out_cols[spec.out_name] = Column(isum, cnt_v > 0, T.INT64)
+            out_cols[spec.out_name] = Column(
+                isum_of[spec.column], cnt_v > 0, T.INT64)
 
     # compact live groups to the front (stable) like the sort-scan path
     live_group = counts_star > 0

@@ -654,6 +654,110 @@ class TestGroupByOnehot:
             batch, "k", [AggSpec("count", None, "c")], 8)
         assert bool(ovf)
 
+    def test_overflow_flag_int64_wraparound(self):
+        """An INT64 key like 2**32 wraps to 0 under int32 — the overflow
+        flag must be computed on the original width (round-2 advisor)."""
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from spark_rapids_jni_tpu.columnar import types as T
+        from spark_rapids_jni_tpu.columnar.column import Column, ColumnBatch
+        from spark_rapids_jni_tpu.relational import AggSpec
+        from spark_rapids_jni_tpu.relational.aggregate import group_by_onehot
+
+        batch = ColumnBatch({"k": Column(
+            jnp.asarray(np.asarray([1, 2**32], np.int64)),
+            jnp.ones((2,), jnp.bool_), T.INT64)})
+        _, _, ovf = group_by_onehot(
+            batch, "k", [AggSpec("count", None, "c")], 8)
+        assert bool(ovf)
+
+    def test_pallas_engine_matches_xla(self):
+        """The fused Pallas contraction must agree with the XLA engine:
+        exact int sums/counts, float sums to f32x3 tolerance; nulls,
+        dead rows, and a key domain wider than one 128-lane block."""
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from spark_rapids_jni_tpu.columnar import types as T
+        from spark_rapids_jni_tpu.columnar.column import Column, ColumnBatch
+        from spark_rapids_jni_tpu.relational import AggSpec
+        from spark_rapids_jni_tpu.relational.aggregate import group_by_onehot
+
+        rng = np.random.default_rng(5)
+        n, K = 3000, 200  # two lane blocks
+        k = rng.integers(0, K, n).astype(np.int32)
+        kval = rng.random(n) > 0.1
+        v = rng.integers(-(2**40), 2**40, n)
+        vval = rng.random(n) > 0.2
+        price = rng.random(n) * 1e6
+        live = rng.random(n) > 0.15
+        batch = ColumnBatch({
+            "k": Column(jnp.asarray(k), jnp.asarray(kval), T.INT32),
+            "v": Column(jnp.asarray(v), jnp.asarray(vval), T.INT64),
+            "p": Column(jnp.asarray(price), jnp.ones((n,), jnp.bool_),
+                        T.FLOAT64),
+        })
+        aggs = [AggSpec("sum", "v", "sv"), AggSpec("count", None, "c"),
+                AggSpec("count", "v", "cv"), AggSpec("mean", "p", "mp")]
+        ra, nga, _ = group_by_onehot(batch, "k", aggs, K,
+                                     row_valid=jnp.asarray(live),
+                                     float_mode="f32x3")
+        rb, ngb, _ = group_by_onehot(batch, "k", aggs, K,
+                                     row_valid=jnp.asarray(live),
+                                     float_mode="f32x3", engine="pallas")
+        assert int(nga) == int(ngb)
+        g = int(nga)
+        for name in ("k", "sv", "c", "cv"):
+            np.testing.assert_array_equal(
+                np.asarray(ra[name].data)[:g], np.asarray(rb[name].data)[:g],
+                err_msg=name)
+            np.testing.assert_array_equal(
+                np.asarray(ra[name].validity)[:g],
+                np.asarray(rb[name].validity)[:g], err_msg=name)
+        np.testing.assert_allclose(
+            np.asarray(ra["mp"].data)[:g], np.asarray(rb["mp"].data)[:g],
+            rtol=1e-5)
+
+    def test_pallas_engine_int_only_and_f64_rejected(self):
+        """Int-only aggs take the no-float kernel (mf=0); float aggs with
+        the default f64 mode must be rejected loudly, not silently
+        downgraded to f32x3 rounding."""
+        import numpy as np
+
+        import jax.numpy as jnp
+        import pytest
+
+        from spark_rapids_jni_tpu.columnar import types as T
+        from spark_rapids_jni_tpu.columnar.column import Column, ColumnBatch
+        from spark_rapids_jni_tpu.relational import AggSpec
+        from spark_rapids_jni_tpu.relational.aggregate import group_by_onehot
+
+        rng = np.random.default_rng(9)
+        n = 1500
+        batch = ColumnBatch({
+            "k": Column(jnp.asarray(rng.integers(0, 10, n).astype(np.int32)),
+                        jnp.ones((n,), jnp.bool_), T.INT32),
+            "v": Column(jnp.asarray(rng.integers(-100, 100, n)),
+                        jnp.ones((n,), jnp.bool_), T.INT64),
+            "p": Column(jnp.asarray(rng.random(n)), jnp.ones((n,), jnp.bool_),
+                        T.FLOAT64),
+        })
+        aggs = [AggSpec("sum", "v", "sv"), AggSpec("count", None, "c")]
+        ra, nga, _ = group_by_onehot(batch, "k", aggs, 10)
+        rb, ngb, _ = group_by_onehot(batch, "k", aggs, 10, engine="pallas")
+        g = int(nga)
+        assert g == int(ngb)
+        np.testing.assert_array_equal(np.asarray(ra["sv"].data)[:g],
+                                      np.asarray(rb["sv"].data)[:g])
+        with pytest.raises(ValueError, match="f32x3"):
+            group_by_onehot(batch, "k", [AggSpec("sum", "p", "sp")], 10,
+                            engine="pallas")
+        with pytest.raises(ValueError, match="engine"):
+            group_by_onehot(batch, "k", aggs, 10, engine="Pallas")
+
 
     def test_f32x3_mode_close(self):
         import math
