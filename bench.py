@@ -26,19 +26,14 @@ import subprocess
 import sys
 import time
 
-def _default_rows():
-    try:
-        from spark_rapids_jni_tpu import config
-
-        return config.get("bench_rows")
-    except Exception:
-        return 1 << 21
-
-
-N_ROWS = int(os.environ.get("BENCH_N_ROWS", 0)) or _default_rows()
-REPS = int(os.environ.get("BENCH_REPS", 8))
-TPU_TIMEOUT_S = int(os.environ.get("BENCH_TPU_TIMEOUT_S", "1500"))
-CPU_TIMEOUT_S = int(os.environ.get("BENCH_CPU_TIMEOUT_S", "900"))
+REPS = int(os.environ.get("BENCH_REPS", 4))
+# Hard total wall-clock budget for the WHOLE bench (probe + children +
+# fallback).  Two rounds of driver captures died on unbounded paths
+# (BENCH_r01 rc=1, BENCH_r02 rc=124); the parent now guarantees exit —
+# with a valid JSON line — inside this budget no matter what the tunnel
+# or the compile cache does.
+TOTAL_BUDGET_S = int(os.environ.get("BENCH_TOTAL_BUDGET_S", "240"))
+N_SMALL = 1 << 18  # headline-first size: compile + measure in seconds
 
 
 # --------------------------------------------------------------------------
@@ -78,6 +73,9 @@ def _bench_one(jfn, args, n_rows, reps, variants=None):
 
 
 def child_main():
+    t_start = time.monotonic()
+    deadline_s = float(os.environ.get("BENCH_CHILD_DEADLINE_S", "1e9"))
+
     import numpy as np
 
     import jax
@@ -99,34 +97,59 @@ def child_main():
         return 17
 
     import __graft_entry__ as ge
+    from spark_rapids_jni_tpu import config
 
-    fn = ge._q6_step
-    batch = ge._example_batch(N_ROWS)
-    variants = [(ge._example_batch(N_ROWS, seed=7 + i),) for i in range(2)]
-    jfn = jax.jit(fn)
-    tpu_mrows = _bench_one(jfn, (batch,), N_ROWS, REPS, variants=variants)
+    is_accel = platform != "cpu"
+    n_full = int(os.environ.get("BENCH_N_ROWS", 0)) or config.get(
+        "bench_rows_tpu" if is_accel else "bench_rows_cpu")
+    jfn = jax.jit(ge._q6_step)
 
-    k = np.asarray(jax.device_get(batch["k"].data))
-    v = np.asarray(jax.device_get(batch["v"].data))
-    price = np.asarray(jax.device_get(batch["price"].data))
-    t0 = time.perf_counter()
-    for _ in range(3):
-        _numpy_pipeline(k, v, price)
-    cpu_dt = (time.perf_counter() - t0) / 3
-    cpu_mrows = N_ROWS / cpu_dt / 1e6
+    def measure(n_rows):
+        variants = [(ge._example_batch(n_rows, seed=7 + i),)
+                    for i in range(2)]
+        return _bench_one(jfn, variants[0], n_rows, REPS, variants=variants)
 
-    print(
-        json.dumps(
-            {
-                "metric": "q6_pipeline_throughput",
-                "value": round(tpu_mrows, 2),
-                "unit": "Mrows/s",
-                "vs_baseline": round(tpu_mrows / cpu_mrows, 2),
-                "platform": platform,
-            }
-        ),
-        flush=True,
-    )
+    def numpy_mrows(n_rows):
+        rng_batch = ge._example_batch(n_rows)
+        k = np.asarray(jax.device_get(rng_batch["k"].data))
+        v = np.asarray(jax.device_get(rng_batch["v"].data))
+        price = np.asarray(jax.device_get(rng_batch["price"].data))
+        t0 = time.perf_counter()
+        for _ in range(3):
+            _numpy_pipeline(k, v, price)
+        return n_rows / ((time.perf_counter() - t0) / 3) / 1e6
+
+    def emit(mrows, n_rows, cpu_mrows):
+        print(json.dumps({
+            "metric": "q6_pipeline_throughput",
+            "value": round(mrows, 2),
+            "unit": "Mrows/s",
+            "vs_baseline": round(mrows / cpu_mrows, 2),
+            "platform": platform,
+            "rows": n_rows,
+        }), flush=True)
+
+    # headline FIRST at a small size: a valid line exists within seconds
+    # of backend init, no matter what happens to the full-size attempt
+    n_small = min(N_SMALL, n_full)
+    cpu_mrows = numpy_mrows(n_small)
+    mrows = measure(n_small)
+    emit(mrows, n_small, cpu_mrows)
+
+    if n_full > n_small:
+        # refine only if the scaled steady-state cost + a fresh-shape
+        # compile (~40s) plausibly fits the remaining budget; the
+        # steady-state per-iter cost extrapolates from the small run
+        est = (n_full / (mrows * 1e6)) * (REPS + 3) + 60.0
+        left = deadline_s - (time.monotonic() - t_start)
+        if est < left:
+            # re-baseline numpy at the full size: its Mrows/s drops once
+            # the working set leaves cache, and the ratio must compare
+            # equal row counts
+            emit(measure(n_full), n_full, numpy_mrows(n_full))
+        else:
+            print(f"# skipping full-size refine: est {est:.0f}s > "
+                  f"remaining {left:.0f}s", file=sys.stderr, flush=True)
     return 0
 
 
@@ -335,40 +358,40 @@ def micro_main():
 # parent: fail-soft orchestration
 # --------------------------------------------------------------------------
 
+def _communicate_graceful(proc, timeout_s):
+    """Wait for a child; on timeout SIGTERM → wait → SIGKILL.  A client
+    SIGKILLed mid-handshake wedges the single axon tunnel slot
+    (BASELINE.md), so every bench child gets this ladder.  Returns
+    (out, err, timed_out)."""
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+        return out, err, False
+    except subprocess.TimeoutExpired:
+        proc.terminate()
+        try:
+            out, err = proc.communicate(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, err = proc.communicate()
+        return out, err, True
+
+
 def _run_child(extra_env, timeout_s, mode):
+    """Run a measurement child with a graceful timeout and salvage every
+    metric line it managed to flush."""
     env = dict(os.environ)
     env.update(extra_env)
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), mode],
-            env=env,
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-        )
-    except subprocess.TimeoutExpired as e:
-        if e.stderr:
-            err_txt = e.stderr if isinstance(e.stderr, str) else e.stderr.decode(
-                "utf-8", "replace"
-            )
-            sys.stderr.write(err_txt[-4000:])
-        # salvage whatever the child measured before the timeout
-        if e.stdout:
-            out_txt = e.stdout if isinstance(e.stdout, str) else e.stdout.decode(
-                "utf-8", "replace"
-            )
-            lines = [ln for ln in out_txt.splitlines()
-                     if ln.startswith("{") and '"metric"' in ln]
-            if lines:
-                return lines, None
-        return None, "timeout"
-    sys.stderr.write(proc.stderr[-4000:])
-    lines = [
-        ln for ln in proc.stdout.splitlines() if ln.startswith("{") and '"metric"' in ln
-    ]
-    if proc.returncode == 0 and lines:
+    env.setdefault("BENCH_CHILD_DEADLINE_S", str(max(timeout_s - 10, 10)))
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), mode],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    out, err, timed_out = _communicate_graceful(proc, timeout_s)
+    sys.stderr.write((err or "")[-4000:])
+    lines = [ln for ln in (out or "").splitlines()
+             if ln.startswith("{") and '"metric"' in ln]
+    if lines:
         return lines, None
-    return None, f"rc={proc.returncode}"
+    return None, "timeout" if timed_out else f"rc={proc.returncode}"
 
 
 def _probe_main():
@@ -386,26 +409,29 @@ def _probe_main():
 
 
 def _run_probe(env, timeout_s) -> bool:
-    """Run the accelerator probe with a GRACEFUL timeout.  subprocess.run's
-    timeout SIGKILLs the child, and a client SIGKILLed mid-handshake wedges
-    the single axon tunnel slot (BASELINE.md) — the probe must never cause
-    the condition it exists to detect.  SIGTERM first, wait, then escalate.
-    """
+    """Run the accelerator probe under the graceful-kill ladder — the
+    probe must never cause the wedge it exists to detect."""
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "--probe"],
         env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
-    deadline = time.monotonic() + timeout_s
-    while proc.poll() is None and time.monotonic() < deadline:
-        time.sleep(0.25)
-    if proc.poll() is None:
-        proc.terminate()
+    _, _, timed_out = _communicate_graceful(proc, timeout_s)
+    return (not timed_out) and proc.returncode == 0
+
+
+def _emit_final(lines):
+    """Print one line per metric, keeping the LAST (most refined) value."""
+    best = {}
+    order = []
+    for ln in lines:
         try:
-            proc.wait(timeout=15)
-        except subprocess.TimeoutExpired:
-            proc.kill()
-            proc.wait()
-        return False
-    return proc.returncode == 0
+            metric = json.loads(ln).get("metric")
+        except Exception:
+            continue
+        if metric not in best:
+            order.append(metric)
+        best[metric] = ln
+    for metric in order:
+        print(best[metric], flush=True)
 
 
 def main():
@@ -419,39 +445,36 @@ def main():
 
     run_micro = mode == "--micro"
     child_mode = "--child-micro" if run_micro else "--child"
+    t0 = time.monotonic()
+
+    def left():
+        return TOTAL_BUDGET_S - (time.monotonic() - t0)
 
     # Pre-flight: a wedged accelerator tunnel hangs forever on first
-    # device use; detect that cheaply instead of burning the full TPU
-    # timeout before the CPU fallback.
-    probe_s = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "240"))
-    env = dict(os.environ)
-    accel_ok = _run_probe(env, probe_s)
-    if not accel_ok:
+    # device use; detect that cheaply instead of burning the whole budget
+    # before the CPU fallback.  A healthy tunnel answers in ~10-20s.
+    probe_s = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "60"))
+    if os.environ.get("BENCH_FORCE_CPU"):
+        accel_ok = False  # explicit CPU run (ci/bench_smoke.sh): skip probe
+    else:
+        accel_ok = _run_probe(dict(os.environ),
+                              min(probe_s, max(left() - 90, 15)))
+
+    lines = None
+    err = "probe failed"
+    if accel_ok:
+        # accelerator attempt gets the budget minus a CPU-fallback reserve
+        lines, err = _run_child({}, max(left() - 75, 30), child_mode)
+        if lines is None:
+            print(f"# accelerator attempt failed ({err}); falling back "
+                  "to CPU", file=sys.stderr, flush=True)
+    else:
         print("# accelerator probe failed/hung; running on CPU",
               file=sys.stderr, flush=True)
-        lines, err = _run_child(
-            {"BENCH_FORCE_CPU": "1", "JAX_TRACEBACK_FILTERING": "off"},
-            CPU_TIMEOUT_S, child_mode)
-        if lines is None:
-            metric = "micro_suite" if run_micro else "q6_pipeline_throughput"
-            print(json.dumps({"metric": metric, "value": 0.0,
-                              "unit": "Mrows/s", "vs_baseline": 0.0,
-                              "error": err}))
-            sys.exit(0)
-        for ln in lines:
-            print(ln)
-        sys.exit(0)
-
-    # 1st attempt: whatever backend the environment provides (TPU via axon).
-    lines, err = _run_child({}, TPU_TIMEOUT_S, child_mode)
     if lines is None:
-        print(f"# accelerator attempt failed ({err}); falling back to CPU",
-              file=sys.stderr, flush=True)
         lines, err = _run_child(
             {"BENCH_FORCE_CPU": "1", "JAX_TRACEBACK_FILTERING": "off"},
-            CPU_TIMEOUT_S,
-            child_mode,
-        )
+            max(left() - 10, 20), child_mode)
     if lines is None:
         # Last resort: still emit a valid line so the harness records
         # *something*, labeled for the mode that actually failed.
@@ -464,8 +487,7 @@ def main():
             "error": err,
         }))
         sys.exit(0)
-    for ln in lines:
-        print(ln)
+    _emit_final(lines)
     sys.exit(0)
 
 

@@ -51,14 +51,28 @@ _register("shuffle_capacity_bucket", 256, int,
           "Rounding bucket for auto-planned exchange capacities (bigger = "
           "fewer recompiles, more slot padding).")
 _register("bench_rows", 1 << 21, int,
-          "Row count for the flagship q6 benchmark.")
+          "Row count for the flagship q6 benchmark (legacy knob; the "
+          "bench now sizes per platform via bench_rows_tpu/cpu).")
+_register("bench_rows_tpu", 1 << 24, int,
+          "Full-size row count for the q6 bench on an accelerator; "
+          "amortizes the ~63ms per-execution tunnel round-trip.")
+_register("bench_rows_cpu", 1 << 18, int,
+          "Full-size row count for the q6 bench on the CPU fallback "
+          "(round 2's 2M-row CPU fallback blew the driver window).")
 _register("use_pallas_hashes", False, _parse_bool,
           "Route murmur3/xxhash64 int64 fast paths through the Pallas "
           "kernels instead of the jnp formulations.")
-_register("q6_group_path", "sort", str,
-          "Aggregation path for the q6 flagship bench: 'sort' (sort-scan "
-          "group_by) or 'onehot' (MXU one-hot matmul, group_by_onehot "
-          "with the bench's static key domain).")
+_register("q6_group_path", "onehot", str,
+          "Aggregation path for the q6 flagship bench: 'onehot' (MXU "
+          "one-hot matmul, group_by_onehot with the bench's static key "
+          "domain) or 'sort' (sort-scan group_by, the general engine).")
+_register("q6_onehot_engine", "xla", str,
+          "Contraction engine for the q6 onehot path: 'xla' (materialized "
+          "one-hot) or 'pallas' (fused VMEM one-hot kernel).")
+_register("q6_float_mode", "f32x3", str,
+          "Float-sum mode for the q6 onehot path: 'f32x3' (exact Dekker "
+          "split, MXU-native, order-nondeterministic rounding) or 'f64' "
+          "(emulated f64 contraction, sort-path-compatible rounding).")
 
 
 def get(key: str):

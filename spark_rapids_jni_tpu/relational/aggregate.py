@@ -309,10 +309,12 @@ def group_by_onehot(
     row_live = jnp.ones((n,), jnp.bool_) if row_valid is None else row_valid
     live = col.validity & row_live
 
-    # overflow must be judged on the original key width: an INT64 key like
-    # 2**32 wraps to 0 under an int32 cast and would silently pass the
-    # bounds check (callers rely on this flag to fall back to sort-scan)
-    k_orig = col.data
+    # overflow must be judged at full width: an INT64 key like 2**32 wraps
+    # to 0 under an int32 cast and would silently pass the bounds check
+    # (callers rely on this flag to fall back to sort-scan); widen to
+    # int64 first so a domain beyond a narrow key dtype's range (INT8 key,
+    # domain=200) compares instead of raising at trace time
+    k_orig = col.data.astype(jnp.int64)
     overflow = jnp.any(live & ((k_orig < 0) | (k_orig >= K)))
     k = k_orig.astype(jnp.int32)
     # null keys form their own group (bucket K), like the sort-scan path;
