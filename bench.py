@@ -27,13 +27,19 @@ import sys
 import time
 
 REPS = int(os.environ.get("BENCH_REPS", 4))
-# Hard total wall-clock budget for the WHOLE bench (probe + children +
+# Total wall-clock budget for the WHOLE bench (probe + children +
 # fallback).  Two rounds of driver captures died on unbounded paths
-# (BENCH_r01 rc=1, BENCH_r02 rc=124); the parent now guarantees exit —
-# with a valid JSON line — inside this budget no matter what the tunnel
-# or the compile cache does.
+# (BENCH_r01 rc=1, BENCH_r02 rc=124); the parent now bounds every stage
+# against this budget and exits with a valid JSON line in every path.
+# Per-stage minimum windows (probe 15s, children 20-30s, graceful-kill
+# grace 15s) mean budgets under ~90s get stretched to ~90s — the floor a
+# measurement child needs to produce anything at all.
 TOTAL_BUDGET_S = int(os.environ.get("BENCH_TOTAL_BUDGET_S", "240"))
 N_SMALL = 1 << 18  # headline-first size: compile + measure in seconds
+for _legacy in ("BENCH_TPU_TIMEOUT_S", "BENCH_CPU_TIMEOUT_S"):
+    if os.environ.get(_legacy):
+        sys.stderr.write(f"# note: {_legacy} is no longer used; set "
+                         "BENCH_TOTAL_BUDGET_S (default 240)\n")
 
 
 # --------------------------------------------------------------------------
@@ -110,10 +116,13 @@ def child_main():
         return _bench_one(jfn, variants[0], n_rows, REPS, variants=variants)
 
     def numpy_mrows(n_rows):
-        rng_batch = ge._example_batch(n_rows)
-        k = np.asarray(jax.device_get(rng_batch["k"].data))
-        v = np.asarray(jax.device_get(rng_batch["v"].data))
-        price = np.asarray(jax.device_get(rng_batch["price"].data))
+        # generate host-side with _example_batch's exact recipe — pulling
+        # the device copies back through the tunnel would cost hundreds of
+        # MB of transfer just to time a CPU baseline
+        rng = np.random.default_rng(7)
+        k = rng.integers(0, 100, n_rows).astype(np.int32)
+        v = rng.integers(-1000, 1000, n_rows).astype(np.int64)
+        price = rng.random(n_rows) * 100.0
         t0 = time.perf_counter()
         for _ in range(3):
             _numpy_pipeline(k, v, price)
@@ -140,7 +149,11 @@ def child_main():
         # refine only if the scaled steady-state cost + a fresh-shape
         # compile (~40s) plausibly fits the remaining budget; the
         # steady-state per-iter cost extrapolates from the small run
-        est = (n_full / (mrows * 1e6)) * (REPS + 3) + 60.0
+        # accelerator steady-state + fresh-shape compile (~40s) + the
+        # numpy re-baseline (host generation + 3 pipeline passes at a
+        # conservative 5 Mrows/s)
+        est = ((n_full / (mrows * 1e6)) * (REPS + 3) + 60.0
+               + 3 * n_full / 5e6)
         left = deadline_s - (time.monotonic() - t_start)
         if est < left:
             # re-baseline numpy at the full size: its Mrows/s drops once
@@ -387,11 +400,27 @@ def _run_child(extra_env, timeout_s, mode):
         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
     out, err, timed_out = _communicate_graceful(proc, timeout_s)
     sys.stderr.write((err or "")[-4000:])
-    lines = [ln for ln in (out or "").splitlines()
-             if ln.startswith("{") and '"metric"' in ln]
+    lines = _valid_metric_lines(out or "")
     if lines:
         return lines, None
     return None, "timeout" if timed_out else f"rc={proc.returncode}"
+
+
+def _valid_metric_lines(out):
+    """Only lines that parse as JSON objects with a metric key — a child
+    killed mid-write can leave a truncated line that would otherwise be
+    'salvaged' here and then dropped by _emit_final, leaving no output."""
+    lines = []
+    for ln in out.splitlines():
+        ln = ln.strip()
+        if not ln.startswith("{"):
+            continue
+        try:
+            if "metric" in json.loads(ln):
+                lines.append(ln)
+        except Exception:
+            continue
+    return lines
 
 
 def _probe_main():

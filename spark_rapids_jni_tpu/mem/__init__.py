@@ -18,7 +18,9 @@ from .executor import (  # noqa: F401
     Spillable,
     TaskContext,
     batch_nbytes,
+    is_device_oom,
     run_with_retry,
+    translate_device_oom,
 )
 from .rmm_spark import (  # noqa: F401
     CpuRetryOOM,

@@ -24,6 +24,7 @@ import jax
 from .rmm_spark import (
     CpuRetryOOM,
     CpuSplitAndRetryOOM,
+    InjectedException,
     RetryOOM,
     RmmSpark,
     SplitAndRetryOOM,
@@ -83,6 +84,51 @@ class TaskContext:
         return False
 
 
+def is_device_oom(exc: BaseException) -> bool:
+    """Is ``exc`` a REAL accelerator allocation failure (XLA
+    RESOURCE_EXHAUSTED), as opposed to the logical arena's OOM family?"""
+    if type(exc).__name__ not in ("XlaRuntimeError", "JaxRuntimeError"):
+        return False
+    s = str(exc)
+    return ("RESOURCE_EXHAUSTED" in s or "Out of memory" in s
+            or "out of memory" in s)
+
+
+def translate_device_oom(step: Callable) -> Callable:
+    """Execute-boundary adapter: a real XLA allocation failure inside
+    ``step`` is routed through the native alloc-failure protocol (park,
+    BUFN-escalate, split decision) and re-raised as the OOM family, so the
+    :func:`run_with_retry` ladder treats genuine HBM exhaustion exactly
+    like logical arena pressure.  The reference gets this for free by
+    interposing the allocator (SparkResourceAdaptorJni.cpp:1731-1798);
+    XLA owns physical buffers, so the translation happens where the error
+    surfaces: at execute/block_until_ready time.
+
+    With no adaptor installed the raw error propagates unchanged.
+    """
+    import functools
+
+    @functools.wraps(step)
+    def wrapped(*args, **kwargs):
+        try:
+            return step(*args, **kwargs)
+        except Exception as e:
+            if not is_device_oom(e) or RmmSpark._adaptor is None:
+                raise
+            try:
+                RmmSpark.device_oom_observed()  # raises the OOM family
+            except (MemoryError, InjectedException):
+                raise  # RetryOOM/SplitAndRetryOOM/OOMError or injection
+            except Exception:
+                # protocol unavailable (e.g. thread never registered with
+                # the adaptor): surface the REAL device error, not the
+                # bookkeeping failure
+                raise e
+            raise  # pragma: no cover - device_oom_observed always raises
+
+    return wrapped
+
+
 def run_with_retry(
     step: Callable,
     make_spillable: Optional[Callable[[], None]] = None,
@@ -98,8 +144,12 @@ def run_with_retry(
       input) and retry immediately — the scheduler guarantees this thread
       is the only one running.
 
+    Real device OOMs (XLA RESOURCE_EXHAUSTED) are translated into the
+    same ladder via :func:`translate_device_oom`.
+
     Raises the last error when the ladder is exhausted.
     """
+    step = translate_device_oom(step)
     last = None
     for _ in range(max_retries):
         try:

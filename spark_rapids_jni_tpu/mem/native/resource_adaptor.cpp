@@ -237,6 +237,53 @@ class ResourceAdaptor {
     }
   }
 
+  // A REAL device allocation failed (XLA RESOURCE_EXHAUSTED) outside the
+  // logical arena.  Drive the same failure protocol as a logical alloc
+  // failure — park while the scheduler holds us back, BUFN-escalate,
+  // honor SPLIT_THROW — then tell the caller to retry the step.  The
+  // reference interposes the real allocator so its failure path IS this
+  // path (SparkResourceAdaptorJni.cpp:1731-1798); here XLA owns physical
+  // buffers, so the failure arrives after the fact and the protocol runs
+  // at the execute boundary instead.
+  int device_alloc_failed(long tid) {
+    int code = pre_alloc(tid);  // surfaces pending escalations/injections
+    if (code != OK) return code;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      auto it = threads_.find(tid);
+      if (it == threads_.end()) return UNKNOWN_THREAD;
+      bool retry = post_alloc_failed_locked(it->second, 0);
+      if (!retry) return OOM;  // retry cap: the 500-retry livelock bound
+    }
+    // parks while BLOCKED/BUFN; converts BUFN_THROW/SPLIT_THROW to codes
+    code = pre_alloc(tid);
+    if (code != OK) return code;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      auto it = threads_.find(tid);
+      if (it == threads_.end()) return UNKNOWN_THREAD;
+      ThreadInfo& t = it->second;
+      // block time was already accounted by whichever wake path released
+      // us (wake_next_highest_priority_blocked / BUFN paths); adding it
+      // again here would double-count the same blocked_since interval
+      bump_metric(t, &TaskMetrics::num_retry);
+      set_state(t, State::RUNNING, "device_oom_retry");
+    }
+    return RETRY_OOM;  // peers freed memory: re-run the step now
+  }
+
+  // Re-size the logical pool to track what the device reports
+  // (jax memory_stats); growing frees budget, shrinking can drive
+  // free_bytes_ negative, which simply blocks new allocations until
+  // enough is released.
+  void resize_pool(long new_pool_bytes) {
+    std::lock_guard<std::mutex> g(mu_);
+    long delta = new_pool_bytes - pool_bytes_;
+    pool_bytes_ = new_pool_bytes;
+    free_bytes_ += delta;
+    if (delta > 0) wake_next_highest_priority_blocked(/*from_free=*/true);
+  }
+
   void deallocate(long tid, long bytes) {
     std::lock_guard<std::mutex> g(mu_);
     free_bytes_ = std::min(free_bytes_ + bytes, pool_bytes_);
@@ -554,6 +601,12 @@ void tra_task_done(void* h, long task) {
 }
 int tra_allocate(void* h, long tid, long bytes) {
   return static_cast<ResourceAdaptor*>(h)->allocate(tid, bytes, nullptr);
+}
+int tra_device_alloc_failed(void* h, long tid) {
+  return static_cast<ResourceAdaptor*>(h)->device_alloc_failed(tid);
+}
+void tra_resize_pool(void* h, long new_pool_bytes) {
+  static_cast<ResourceAdaptor*>(h)->resize_pool(new_pool_bytes);
 }
 void tra_deallocate(void* h, long tid, long bytes) {
   static_cast<ResourceAdaptor*>(h)->deallocate(tid, bytes);

@@ -142,6 +142,10 @@ def _load_lib() -> ctypes.CDLL:
         lib.tra_allocate.restype = ctypes.c_int
         lib.tra_allocate.argtypes = [ctypes.c_void_p, ctypes.c_long,
                                      ctypes.c_long]
+        lib.tra_device_alloc_failed.restype = ctypes.c_int
+        lib.tra_device_alloc_failed.argtypes = [ctypes.c_void_p,
+                                                ctypes.c_long]
+        lib.tra_resize_pool.argtypes = [ctypes.c_void_p, ctypes.c_long]
         lib.tra_deallocate.argtypes = [ctypes.c_void_p, ctypes.c_long,
                                        ctypes.c_long]
         lib.tra_block_thread_until_ready.restype = ctypes.c_int
@@ -287,6 +291,16 @@ class SparkResourceAdaptor:
 
     def deallocate(self, nbytes: int, tid: Optional[int] = None):
         self._lib.tra_deallocate(self._h, self._tid(tid), nbytes)
+
+    def device_alloc_failed(self, tid: Optional[int] = None):
+        """A REAL device allocation failed: run the alloc-failure protocol
+        (block / BUFN-escalate / split) and raise the resulting OOM."""
+        _raise_for(self._lib.tra_device_alloc_failed(self._h,
+                                                     self._tid(tid)))
+
+    def resize_pool(self, new_pool_bytes: int):
+        """Track the device's reported capacity (jax memory_stats)."""
+        self._lib.tra_resize_pool(self._h, new_pool_bytes)
 
     def block_thread_until_ready(self, tid: Optional[int] = None):
         _raise_for(self._lib.tra_block_thread_until_ready(
@@ -446,6 +460,36 @@ class RmmSpark:
     @classmethod
     def deallocate(cls, nbytes: int):
         cls._a().deallocate(nbytes)
+
+    @classmethod
+    def device_oom_observed(cls):
+        """Translate a caught real device OOM (XLA RESOURCE_EXHAUSTED)
+        into the retry ladder; always raises one of the OOM family."""
+        cls._a().device_alloc_failed()
+        raise RetryOOM()  # unreachable unless native returned OK
+
+    @classmethod
+    def sync_pool_with_device(cls, device=None, fraction: float = 1.0):
+        """Resize the logical arena to what the device can actually still
+        admit: ``(limit - bytes_in_use) * fraction`` of real headroom plus
+        the bytes the arena itself already accounts (its charges are part
+        of bytes_in_use).  Returns the new pool size, or None when the
+        backend has no memory_stats (CPU)."""
+        import jax
+
+        d = device or jax.local_devices()[0]
+        stats = getattr(d, "memory_stats", lambda: None)()
+        if not stats:
+            return None
+        limit = stats.get("bytes_limit") or stats.get(
+            "bytes_reservable_limit")
+        if not limit:
+            return None
+        in_use = stats.get("bytes_in_use", 0)
+        arena = cls._a().total_allocated()
+        new_pool = max(int((limit - in_use) * fraction) + arena, arena)
+        cls._a().resize_pool(new_pool)
+        return new_pool
 
     @classmethod
     def cpu_allocate(cls, nbytes: int):

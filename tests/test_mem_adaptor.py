@@ -548,3 +548,110 @@ class TestSpillable:
             RmmSpark.task_done(4)
         finally:
             RmmSpark.clear_event_handler()
+
+
+class TestRealDeviceOomTranslation:
+    """VERDICT r2 item 3: a REAL XLA RESOURCE_EXHAUSTED at the execute
+    boundary must drive the same spill -> block -> retry ladder as
+    logical arena pressure (reference interposes the allocator,
+    SparkResourceAdaptorJni.cpp:1731-1798; we translate where the error
+    surfaces)."""
+
+    @staticmethod
+    def _fake_xla_oom():
+        # matched by TYPE NAME + marker, exactly like the real
+        # jaxlib.xla_extension.XlaRuntimeError we cannot construct here
+        class XlaRuntimeError(RuntimeError):
+            pass
+
+        return XlaRuntimeError(
+            "RESOURCE_EXHAUSTED: Out of memory allocating 16777216 bytes")
+
+    def test_is_device_oom_matcher(self):
+        from spark_rapids_jni_tpu.mem import is_device_oom
+
+        assert is_device_oom(self._fake_xla_oom())
+        assert not is_device_oom(RuntimeError("RESOURCE_EXHAUSTED"))
+        assert not is_device_oom(MemoryError("Out of memory"))
+
+        class XlaRuntimeError(RuntimeError):
+            pass
+
+        assert not is_device_oom(XlaRuntimeError("INVALID_ARGUMENT: shape"))
+
+    def test_without_adaptor_raw_error_propagates(self):
+        import pytest
+
+        from spark_rapids_jni_tpu.mem import run_with_retry
+
+        err = self._fake_xla_oom()
+
+        def step():
+            raise err
+
+        with pytest.raises(type(err)):
+            run_with_retry(step)
+
+    def test_real_oom_drives_spill_block_retry(self):
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_jni_tpu.mem import (
+            RmmSpark,
+            Spillable,
+            TaskContext,
+            run_with_retry,
+        )
+
+        RmmSpark.set_event_handler(1 << 20)
+        try:
+            with TaskContext(21) as ctx:
+                s = Spillable({"x": jnp.arange(1024, dtype=jnp.int32)}, ctx)
+                calls = {"step": 0, "splits": 0}
+
+                def step():
+                    calls["step"] += 1
+                    batch = s.get()
+                    if calls["step"] == 1:
+                        raise self._fake_xla_oom()  # "HBM" refuses
+                    return int(jax.device_get(batch["x"][-1]))
+
+                res = run_with_retry(step, make_spillable=s.spill,
+                                     split=lambda: calls.__setitem__(
+                                         "splits", calls["splits"] + 1))
+                assert res == 1023
+                assert calls["step"] >= 2  # the step really re-ran
+                s.close()
+            RmmSpark.task_done(21)
+            # the ladder went through the native protocol, not a bare
+            # python re-raise: the retry metric moved
+            assert RmmSpark._a().get_and_reset_num_retry(21) >= 1
+        finally:
+            RmmSpark.clear_event_handler()
+
+    def test_sync_pool_with_device_cpu_is_none(self):
+        from spark_rapids_jni_tpu.mem import RmmSpark
+
+        RmmSpark.set_event_handler(1 << 20)
+        try:
+            # CPU backends expose no memory_stats: sync is a no-op
+            assert RmmSpark.sync_pool_with_device() is None
+        finally:
+            RmmSpark.clear_event_handler()
+
+    def test_resize_pool_frees_budget(self):
+        import pytest
+
+        from spark_rapids_jni_tpu.mem import RetryOOM, RmmSpark, TaskContext
+
+        RmmSpark.set_event_handler(1 << 10)
+        try:
+            with TaskContext(22) as ctx:
+                ctx.charge(1 << 10)  # arena full
+                RmmSpark._a().resize_pool(1 << 12)  # device says: more room
+                ctx.charge(1 << 11)  # now fits
+                with pytest.raises((RetryOOM, MemoryError)):
+                    ctx.charge(1 << 12)  # beyond even the resized pool
+            RmmSpark.task_done(22)
+        finally:
+            RmmSpark.clear_event_handler()

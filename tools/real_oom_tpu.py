@@ -1,0 +1,74 @@
+"""Real-HBM OOM drill (run on actual TPU hardware; not part of CPU CI).
+
+Provokes a GENUINE XLA RESOURCE_EXHAUSTED by allocating past device HBM,
+and proves the execute-boundary translation drives the retry ladder:
+spill -> block -> split -> succeed at a smaller size.
+
+Usage (needs the axon tunnel up; single client only):
+    python tools/real_oom_tpu.py
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu.mem import (
+    RmmSpark,
+    Spillable,
+    TaskContext,
+    run_with_retry,
+)
+
+
+def main():
+    dev = jax.devices()[0]
+    print("device:", dev, flush=True)
+    stats = getattr(dev, "memory_stats", lambda: None)() or {}
+    limit = stats.get("bytes_limit", 16 << 30)
+    print("bytes_limit:", limit, flush=True)
+
+    RmmSpark.set_event_handler(pool_bytes=limit)
+    synced = RmmSpark.sync_pool_with_device(dev)
+    print("pool synced to:", synced, flush=True)
+
+    state = {"rows": int(limit * 1.5) // 4, "attempts": 0, "spills": 0,
+             "splits": 0}
+
+    with TaskContext(1) as ctx:
+        keep = Spillable({"pin": jnp.ones((1 << 26,), jnp.float32)}, ctx)
+
+        def step():
+            state["attempts"] += 1
+            # ~1.5x HBM on the first attempt -> guaranteed real OOM
+            x = jnp.ones((state["rows"],), jnp.float32)
+            y = jax.jit(lambda a: a * 2 + 1)(x)
+            jax.block_until_ready(y)
+            return float(y[0])
+
+        def spill():
+            state["spills"] += 1
+            keep.spill()
+
+        def split():
+            state["splits"] += 1
+            state["rows"] //= 4
+
+        val = run_with_retry(step, make_spillable=spill, split=split,
+                             max_retries=12)
+        print(f"PASS: step succeeded with value {val} after "
+              f"{state['attempts']} attempts, {state['spills']} spills, "
+              f"{state['splits']} splits "
+              f"(final rows {state['rows']})", flush=True)
+        keep.close()
+    RmmSpark.task_done(1)
+    retries = RmmSpark._a().get_and_reset_num_retry(1)
+    splits = RmmSpark._a().get_and_reset_num_split_retry(1)
+    print(f"metrics: num_retry={retries} num_split_retry={splits}",
+          flush=True)
+    RmmSpark.clear_event_handler()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
