@@ -116,13 +116,10 @@ def child_main():
         return _bench_one(jfn, variants[0], n_rows, REPS, variants=variants)
 
     def numpy_mrows(n_rows):
-        # generate host-side with _example_batch's exact recipe — pulling
-        # the device copies back through the tunnel would cost hundreds of
-        # MB of transfer just to time a CPU baseline
-        rng = np.random.default_rng(7)
-        k = rng.integers(0, 100, n_rows).astype(np.int32)
-        v = rng.integers(-1000, 1000, n_rows).astype(np.int64)
-        price = rng.random(n_rows) * 100.0
+        # the shared host-side recipe — pulling the device copies back
+        # through the tunnel would cost hundreds of MB of transfer just
+        # to time a CPU baseline
+        k, v, price = ge._example_arrays(n_rows, seed=7)
         t0 = time.perf_counter()
         for _ in range(3):
             _numpy_pipeline(k, v, price)
@@ -312,6 +309,26 @@ def micro_main():
         reps=4,
     )
 
+    # mixed lengths with a 1% long tail: flat pads EVERY row to the
+    # outlier width; bucketed scans each width bucket separately
+    from spark_rapids_jni_tpu.columnar import BucketedStringColumn
+
+    long_doc = ('{"store":{"basket":[1,2]},"owner":"big","pad":"%s"}'
+                % ("x" * 1400))
+    mdocs = [long_doc if i % 100 == 0 else jdocs[i] for i in range(m_json)]
+    mflat = [(StringColumn.from_pylist(
+        [mdocs[(i + k) % m_json] for i in range(m_json)],
+        pad_to_multiple=32),) for k in range(V)]
+    run("get_json_mixed_flat",
+        jax.jit(lambda c: get_json_object(c, "$.owner")), mflat, m_json,
+        reps=2)
+    mbuck = [(BucketedStringColumn.from_pylist(
+        [mdocs[(i + k) % m_json] for i in range(m_json)]),)
+        for k in range(V)]
+    run("get_json_mixed_bucketed",
+        jax.jit(lambda c: get_json_object(c, "$.owner")), mbuck, m_json,
+        reps=2)
+
     # parse_uri (mirrors PARSE_URI_BENCH)
     from spark_rapids_jni_tpu.ops.parse_uri import parse_uri
 
@@ -358,6 +375,28 @@ def micro_main():
     run("q3_join_agg", jax.jit(ge._q3_step), q3in, nq, reps=6)
     q67in = [(ge._q67_batch(nq, seed=13 + k),) for k in range(V)]
     run("q67_window_topk", jax.jit(ge._q67_step), q67in, nq, reps=6)
+    q95in = [ge._q95_batches(nq, seed=19 + k) for k in range(V)]
+    run("q95_shape_2exch_2join_agg", jax.jit(ge._q95_step), q95in, nq,
+        reps=4)
+
+    # decimal128 multiply (the DecimalUtils hot op; 128-bit limb math)
+    from spark_rapids_jni_tpu.columnar.column import Decimal128Column
+    from spark_rapids_jni_tpu.ops import decimal as dec
+
+    nd = 1 << 20
+    dones = jnp.ones((nd,), jnp.bool_)
+    dt = T.SparkType.decimal(38, 2)
+
+    def dec_col(seed):
+        r = np.random.default_rng(seed)
+        limbs = np.zeros((nd, 2), np.uint64)
+        limbs[:, 0] = r.integers(0, 1 << 40, nd, dtype=np.uint64)
+        return Decimal128Column(jnp.asarray(limbs), dones, dt)
+
+    decs = [(dec_col(60 + k), dec_col(80 + k)) for k in range(V)]
+    run("decimal128_multiply",
+        jax.jit(lambda a, b: dec.multiply_decimal128(a, b, 4)[1].limbs),
+        decs, nd)
     ns = 1 << 14
     qsin = [(ge._qstr_batch(ns, seed=17 + k),) for k in range(V)]
     run("qstr_string_heavy", jax.jit(ge._qstr_step), qsin, ns, reps=4)

@@ -10,6 +10,13 @@ cd "$(dirname "$0")/.."
 make -C spark_rapids_jni_tpu/mem/native
 make -C spark_rapids_jni_tpu/io/native
 make -C jni
+make -C jni test_glue
+
+# EXECUTE the JNIEXPORT layer over the fake JNIEnv (no JVM needed):
+# column create -> op -> fetch -> close, error mapping, RmmSpark path
+SRJ_PY_ROOT="$(pwd)" \
+  SRJ_ADAPTOR_LIB="$(pwd)/spark_rapids_jni_tpu/mem/native/libtpu_resource_adaptor.so" \
+  ./jni/test_glue
 
 python -m pytest tests/ -x -q
 

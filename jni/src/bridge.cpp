@@ -11,7 +11,9 @@
 
 #include <cstdlib>
 #include <cstring>
+#include <mutex>
 #include <string>
+#include <unordered_map>
 
 namespace {
 
@@ -21,6 +23,17 @@ thread_local std::string g_invoke_json;
 
 bool g_owns_interpreter = false;
 PyObject* g_module = nullptr; /* spark_rapids_jni_tpu.jni_bridge */
+
+/* Handle registry: handles are opaque ids, NOT raw PyObject pointers, so
+ * a double release or use-after-release is a clean SRJ_ERR instead of
+ * undefined behavior.  (The reference hands raw cudf pointers across JNI
+ * and relies on the Java wrappers' close() guards; a registry makes the
+ * native layer itself safe — the glue-driver lifecycle tests exercise
+ * this.)  The mutex only guards the map; refcount changes happen under
+ * the GIL as before. */
+std::mutex g_handles_mu;
+std::unordered_map<int64_t, PyObject*> g_handles;
+int64_t g_next_handle = 1;
 
 struct Gil {
   PyGILState_STATE st;
@@ -74,12 +87,22 @@ void capture_py_error() {
   set_error(msg, code);
 }
 
+/* Borrowed lookup; nullptr (+error set) for unknown/released handles. */
 PyObject* handle_obj(int64_t h) {
-  return reinterpret_cast<PyObject*>(static_cast<intptr_t>(h));
+  std::lock_guard<std::mutex> g(g_handles_mu);
+  auto it = g_handles.find(h);
+  if (it == g_handles.end()) {
+    set_error("invalid or already-released column handle", SRJ_ERR);
+    return nullptr;
+  }
+  return it->second;
 }
 
 int64_t obj_handle(PyObject* o) { /* takes ownership of a new ref */
-  return static_cast<int64_t>(reinterpret_cast<intptr_t>(o));
+  std::lock_guard<std::mutex> g(g_handles_mu);
+  int64_t h = g_next_handle++;
+  g_handles.emplace(h, o);
+  return h;
 }
 
 bool module_ready() {
@@ -229,7 +252,9 @@ int srj_column_to_host(int64_t handle, SrjHostColumn* out) {
     return SRJ_ERR;
   }
   Gil gil;
-  PyObject* args = Py_BuildValue("(O)", handle_obj(handle));
+  PyObject* obj = handle_obj(handle);
+  if (obj == nullptr) return SRJ_ERR;
+  PyObject* args = Py_BuildValue("(O)", obj);
   if (args == nullptr) {
     capture_py_error();
     return SRJ_ERR;
@@ -283,7 +308,9 @@ int64_t srj_num_rows(int64_t handle) {
     return -1;
   }
   Gil gil;
-  PyObject* n = PyObject_GetAttrString(handle_obj(handle), "num_rows");
+  PyObject* obj = handle_obj(handle);
+  if (obj == nullptr) return -1;
+  PyObject* n = PyObject_GetAttrString(obj, "num_rows");
   if (n == nullptr) {
     capture_py_error();
     return -1;
@@ -311,6 +338,10 @@ int srj_invoke(const char* op, const char* args_json,
   }
   for (int i = 0; i < n_in; ++i) {
     PyObject* o = handle_obj(in_handles[i]);
+    if (o == nullptr) {
+      Py_DECREF(objs);
+      return -1;  /* invalid/released handle: error already set */
+    }
     Py_INCREF(o);
     PyList_SET_ITEM(objs, i, o);
   }
@@ -353,8 +384,16 @@ int srj_last_error_code(void) { return g_error_code; }
 
 void srj_release(int64_t handle) {
   if (handle == 0 || g_module == nullptr) return;
+  PyObject* obj = nullptr;
+  {
+    std::lock_guard<std::mutex> g(g_handles_mu);
+    auto it = g_handles.find(handle);
+    if (it == g_handles.end()) return; /* double release: clean no-op */
+    obj = it->second;
+    g_handles.erase(it);
+  }
   Gil gil;
-  Py_DECREF(handle_obj(handle));
+  Py_DECREF(obj);
 }
 
 } /* extern "C" */

@@ -1,0 +1,37 @@
+/* Test-side controls for the fake JNIEnv (see fake_jni.cpp). */
+#ifndef SRJ_FAKE_JNI_H
+#define SRJ_FAKE_JNI_H
+
+#include <string>
+#include <vector>
+
+#include "jni_stub.h"
+
+namespace fakejni {
+
+typedef bool (*BlockedHook)(long thread_id);
+
+JNIEnv* env();
+JavaVM* vm();
+void reset();                       // clear pending exception record
+bool exception_pending();
+const std::string& thrown_class();  // last ThrowNew class name
+const std::string& thrown_msg();
+void set_blocked_hook(BlockedHook h);  // ThreadStateRegistry.isThreadBlocked
+long blocked_calls();
+
+jstring make_string(const char* s);
+jbyteArray make_bytes(const void* data, size_t n);
+jintArray make_ints(const jint* data, size_t n);
+jlongArray make_longs(const jlong* data, size_t n);
+std::string get_string(jobject s);
+std::vector<jbyte> get_bytes(jobject a);
+std::vector<jlong> get_longs(jobject a);
+std::vector<jint> get_ints(jobject a);
+jobject get_obj_field(jobject o, const char* name);
+jlong get_long_field(jobject o, const char* name);
+jint get_int_field(jobject o, const char* name);
+
+}  // namespace fakejni
+
+#endif /* SRJ_FAKE_JNI_H */

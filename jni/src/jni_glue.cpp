@@ -34,12 +34,13 @@ const char* const kPkg = "com/nvidia/spark/rapids/jni/";
 
 void throw_java(JNIEnv* env, const char* cls_name, const char* msg) {
   if (env->ExceptionCheck()) return;
-  std::string full = std::string(kPkg) + cls_name;
-  jclass cls = env->FindClass(full.c_str());
-  if (cls == nullptr) {
-    env->ExceptionClear();
-    cls = env->FindClass("java/lang/RuntimeException");
+  jclass cls = nullptr;
+  if (cls_name != nullptr) { /* nullptr -> plain RuntimeException */
+    std::string full = std::string(kPkg) + cls_name;
+    cls = env->FindClass(full.c_str());
+    if (cls == nullptr) env->ExceptionClear();
   }
+  if (cls == nullptr) cls = env->FindClass("java/lang/RuntimeException");
   if (cls != nullptr) env->ThrowNew(cls, msg);
 }
 

@@ -26,7 +26,18 @@ wrapping uint64 arithmetic.  On TPU, XLA emulates 64-bit integer ops with
 against 32-bit lanes wherever possible.
 """
 
+import os as _os
+
 import jax
+
+if _os.environ.get("SRJ_FORCE_CPU"):
+    # Embedded-interpreter hosts (the C++ glue test driver, a JVM without
+    # an accelerator) must pin the platform BEFORE any submodule import:
+    # ops tables built at import time would otherwise initialize the
+    # default backend, and a wedged axon tunnel hangs that first use
+    # forever (BASELINE.md).  Env vars alone are too late for the axon
+    # sitecustomize, hence the config call.
+    jax.config.update("jax_platforms", "cpu")
 
 jax.config.update("jax_enable_x64", True)
 

@@ -1,4 +1,5 @@
 from . import types
+from .bucketed import BucketedStringColumn
 from .column import AnyColumn, Column, ColumnBatch, Decimal128Column, StringColumn
 from .arrow import from_arrow, to_arrow, array_to_column
 
@@ -9,6 +10,7 @@ __all__ = [
     "ColumnBatch",
     "Decimal128Column",
     "StringColumn",
+    "BucketedStringColumn",
     "from_arrow",
     "to_arrow",
     "array_to_column",

@@ -1,0 +1,157 @@
+"""Length-bucketed string storage (SURVEY.md §5 "bucketed padding +
+logical length").
+
+The flat :class:`~spark_rapids_jni_tpu.columnar.column.StringColumn` pads
+every row to the column max: one 10KB document in a 2M-row batch
+materializes a ~20GB char matrix, and char-scan kernels then run max_len
+serial steps over ALL rows.  A :class:`BucketedStringColumn` splits rows
+by length into a few width buckets (geometric widths), so
+
+* memory is bounded by ~2x total chars, not ``n * max_len``;
+* a scan kernel runs ``width_b`` steps over only bucket ``b``'s rows —
+  total serial-step x row work tracks the actual char mass.
+
+Bucketing happens at the host boundary (ingest), where row lengths are
+known and bucket sizes become static shapes; on device each bucket is an
+ordinary StringColumn plus an int32 row-id map back to original order.
+Results of per-bucket kernels merge back with one scatter per bucket
+(reference has no analogue: cudf strings are offset+chars, a layout the
+TPU's tiled memory model does not reward — SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .column import StringColumn
+
+DEFAULT_WIDTH_LADDER = (32, 128, 512, 2048, 8192, 32768)
+
+
+def plan_widths(lengths, ladder: Sequence[int] = DEFAULT_WIDTH_LADDER
+                ) -> List[int]:
+    """The subset of the width ladder actually needed for ``lengths``
+    (always at least one bucket; the last width covers the true max)."""
+    need = int(max(lengths, default=0))
+    widths = [w for w in ladder if w < need]
+    cap = next((w for w in ladder if w >= need), None)
+    widths.append(cap if cap is not None else max(need, 1))
+    return widths
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class BucketedStringColumn:
+    """Strings split into width buckets; ``row_ids[b][i]`` is the original
+    row of bucket ``b``'s row ``i``.  ``num_rows`` is static."""
+
+    buckets: List[StringColumn]
+    row_ids: List[jax.Array]  # int32 per bucket
+    num_rows: int
+
+    def tree_flatten(self):
+        return (tuple(self.buckets), tuple(self.row_ids)), self.num_rows
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        buckets, row_ids = children
+        return cls(list(buckets), list(row_ids), aux)
+
+    @property
+    def widths(self) -> List[int]:
+        return [b.max_len for b in self.buckets]
+
+    @property
+    def total_char_capacity(self) -> int:
+        return sum(b.chars.shape[0] * b.max_len for b in self.buckets)
+
+    # ---- host constructors -------------------------------------------
+    @staticmethod
+    def from_pylist(values: Sequence[Optional[str]],
+                    ladder: Sequence[int] = DEFAULT_WIDTH_LADDER
+                    ) -> "BucketedStringColumn":
+        encoded = [v.encode("utf-8") if v is not None else b""
+                   for v in values]
+        lens = np.asarray([len(b) for b in encoded], np.int64)
+        widths = plan_widths(lens.tolist(), ladder)
+        # one pass: widths are the (sorted, disjoint) bucket upper bounds
+        which = np.searchsorted(np.asarray(widths), lens, side="left")
+        buckets, row_ids = [], []
+        for b, w in enumerate(widths):
+            sel = np.nonzero(which == b)[0]
+            if sel.size == 0:
+                continue
+            buckets.append(StringColumn.from_pylist(
+                [values[i] for i in sel], max_len=w))
+            row_ids.append(jnp.asarray(sel.astype(np.int32)))
+        if not buckets:  # empty column: one empty bucket keeps shapes sane
+            buckets = [StringColumn.from_pylist([], max_len=widths[0])]
+            row_ids = [jnp.zeros((0,), jnp.int32)]
+        return BucketedStringColumn(buckets, row_ids, len(values))
+
+    @staticmethod
+    def from_string_column(col: StringColumn,
+                           ladder: Sequence[int] = DEFAULT_WIDTH_LADDER
+                           ) -> "BucketedStringColumn":
+        """Re-bucket a flat column (host sync on lengths: ingest-time op)."""
+        lens = np.asarray(jax.device_get(col.lengths))
+        chars = np.asarray(jax.device_get(col.chars))
+        valid = np.asarray(jax.device_get(col.validity))
+        widths = plan_widths(lens.tolist(), ladder)
+        buckets, row_ids = [], []
+        lo = -1
+        for w in widths:
+            sel = np.nonzero((lens > lo) & (lens <= w))[0]
+            lo = w
+            if sel.size == 0:
+                continue
+            sub = np.zeros((sel.size, w), np.uint8)
+            take = min(w, chars.shape[1])
+            sub[:, :take] = chars[sel, :take]
+            buckets.append(StringColumn(
+                jnp.asarray(sub), jnp.asarray(lens[sel].astype(np.int32)),
+                jnp.asarray(valid[sel])))
+            row_ids.append(jnp.asarray(sel.astype(np.int32)))
+        if not buckets:
+            buckets = [StringColumn.from_pylist([], max_len=widths[0])]
+            row_ids = [jnp.zeros((0,), jnp.int32)]
+        return BucketedStringColumn(buckets, row_ids, col.num_rows)
+
+    # ---- per-bucket execution ----------------------------------------
+    def apply(self, fn: Callable[[StringColumn], StringColumn]
+              ) -> "BucketedStringColumn":
+        """Run a StringColumn->StringColumn kernel per bucket (each bucket
+        compiles at ITS width) and keep the result bucketed."""
+        return BucketedStringColumn(
+            [fn(b) for b in self.buckets], list(self.row_ids), self.num_rows)
+
+    def merge(self) -> StringColumn:
+        """Scatter the buckets back into one row-ordered StringColumn
+        (width = widest bucket result)."""
+        width = max((b.max_len for b in self.buckets), default=1)
+        n = self.num_rows
+        chars = jnp.zeros((n, width), jnp.uint8)
+        lengths = jnp.zeros((n,), jnp.int32)
+        valid = jnp.zeros((n,), jnp.bool_)
+        for b, ids in zip(self.buckets, self.row_ids):
+            if b.chars.shape[0] == 0:
+                continue
+            pad = width - b.max_len
+            bc = jnp.pad(b.chars, ((0, 0), (0, pad))) if pad else b.chars
+            chars = chars.at[ids].set(bc)
+            lengths = lengths.at[ids].set(b.lengths)
+            valid = valid.at[ids].set(b.validity)
+        return StringColumn(chars, lengths, valid)
+
+    def to_pylist(self) -> list:
+        out = [None] * self.num_rows
+        for b, ids in zip(self.buckets, self.row_ids):
+            vals = b.to_pylist()
+            for i, row in enumerate(np.asarray(jax.device_get(ids))):
+                out[int(row)] = vals[i]
+        return out

@@ -47,6 +47,10 @@ _register("mem_pool_bytes", 0, int,
           "(0 = caller must pass one explicitly).")
 _register("json_max_out", 0, int,
           "get_json_object output width cap (0 = provable 6*L+20 bound).")
+_register("json_scan_unroll", 8, int,
+          "Chars processed per while-loop iteration in the JSON scan "
+          "(lax.scan unroll): the scan carry round-trips HBM once per "
+          "iteration, so higher = fewer latency-bound steps, more code.")
 _register("shuffle_capacity_bucket", 256, int,
           "Rounding bucket for auto-planned exchange capacities (bigger = "
           "fewer recompiles, more slot padding).")

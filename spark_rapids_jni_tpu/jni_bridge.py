@@ -30,6 +30,9 @@ import json
 
 import numpy as np
 
+# SRJ_FORCE_CPU (embedded hosts) is honored by the package __init__,
+# which runs before any op-table submodule can initialize a backend.
+
 
 def _types():
     from .columnar import types as T

@@ -153,7 +153,13 @@ def run_with_retry(
     last = None
     for _ in range(max_retries):
         try:
-            return step()
+            result = step()
+            if last is not None and RmmSpark._adaptor is not None:
+                # the failure streak resolved: reset the adaptor's
+                # consecutive-failure count (the 500-retry livelock
+                # bound restarts per streak, not per thread lifetime)
+                RmmSpark._adaptor.alloc_recovered()
+            return result
         except SplitAndRetryOOM as e:
             last = e
             if split is None:

@@ -79,6 +79,7 @@ struct ThreadInfo {
   Injection inject_split;
   Injection inject_exception;
   int retry_count = 0;           // consecutive failed allocs (watchdog)
+  int blocked_pool = 0;          // which pool the thread last blocked on
   std::condition_variable cv;
   Clock::time_point blocked_since{};
 
@@ -101,12 +102,31 @@ struct TaskMetrics {
   long cur_memory_allocated = 0;
 };
 
+// Pool indices: one adaptor schedules BOTH memory arenas through ONE
+// thread state machine, so the deadlock scan sees a thread blocked on
+// host memory while holding device budget (the reference handles mixed
+// GPU+CPU blocking in one state machine too —
+// SparkResourceAdaptorJni.cpp:808-842, RmmSparkTest mixed matrix).
+constexpr int POOL_DEVICE = 0;
+constexpr int POOL_HOST = 1;
+constexpr int NUM_POOLS = 2;
+
 class ResourceAdaptor {
  public:
-  ResourceAdaptor(long pool_bytes, const char* log_path)
-      : pool_bytes_(pool_bytes), free_bytes_(pool_bytes) {
+  ResourceAdaptor(long pool_bytes, const char* log_path) {
+    pool_bytes_[POOL_DEVICE] = pool_bytes;
+    free_bytes_[POOL_DEVICE] = pool_bytes;
+    pool_bytes_[POOL_HOST] = 0;   // disabled until set_host_pool
+    free_bytes_[POOL_HOST] = 0;
     if (log_path && log_path[0]) log_ = std::fopen(log_path, "w");
     if (log_) std::fprintf(log_, "time_ns,op,thread,task,from,to,notes\n");
+  }
+
+  void set_host_pool(long bytes) {
+    std::lock_guard<std::mutex> g(mu_);
+    long delta = bytes - pool_bytes_[POOL_HOST];
+    pool_bytes_[POOL_HOST] = bytes;
+    free_bytes_[POOL_HOST] += delta;
   }
 
   ~ResourceAdaptor() {
@@ -209,7 +229,8 @@ class ResourceAdaptor {
   }
 
   // ---- the allocation protocol ---------------------------------------
-  int allocate(long tid, long bytes, long* out_allocated) {
+  int allocate(long tid, long bytes, long* out_allocated,
+               int pool = POOL_DEVICE) {
     for (;;) {
       int code = pre_alloc(tid);
       if (code != OK) return code;
@@ -217,21 +238,24 @@ class ResourceAdaptor {
         std::unique_lock<std::mutex> lk(mu_);
         auto it = threads_.find(tid);
         if (it == threads_.end()) return UNKNOWN_THREAD;
-        if (bytes <= free_bytes_) {
-          free_bytes_ -= bytes;
-          allocated_ += bytes;
-          max_allocated_ = std::max(max_allocated_, allocated_);
-          for (long task : it->second.tasks) {
-            auto& m = metrics_[task];
-            m.cur_memory_allocated += bytes;
-            m.max_memory_allocated =
-                std::max(m.max_memory_allocated, m.cur_memory_allocated);
+        if (bytes <= free_bytes_[pool]) {
+          free_bytes_[pool] -= bytes;
+          allocated_[pool] += bytes;
+          max_allocated_[pool] =
+              std::max(max_allocated_[pool], allocated_[pool]);
+          if (pool == POOL_DEVICE) {  // task metrics track device HBM
+            for (long task : it->second.tasks) {
+              auto& m = metrics_[task];
+              m.cur_memory_allocated += bytes;
+              m.max_memory_allocated =
+                  std::max(m.max_memory_allocated, m.cur_memory_allocated);
+            }
           }
           post_alloc_success_locked(it->second);
-          if (out_allocated) *out_allocated = allocated_;
+          if (out_allocated) *out_allocated = allocated_[pool];
           return OK;
         }
-        bool retry = post_alloc_failed_locked(it->second, bytes);
+        bool retry = post_alloc_failed_locked(it->second, bytes, pool);
         if (!retry) return OOM;
       }
     }
@@ -252,7 +276,7 @@ class ResourceAdaptor {
       std::unique_lock<std::mutex> lk(mu_);
       auto it = threads_.find(tid);
       if (it == threads_.end()) return UNKNOWN_THREAD;
-      bool retry = post_alloc_failed_locked(it->second, 0);
+      bool retry = post_alloc_failed_locked(it->second, 0, POOL_DEVICE);
       if (!retry) return OOM;  // retry cap: the 500-retry livelock bound
     }
     // parks while BLOCKED/BUFN; converts BUFN_THROW/SPLIT_THROW to codes
@@ -272,24 +296,38 @@ class ResourceAdaptor {
     return RETRY_OOM;  // peers freed memory: re-run the step now
   }
 
+  // The caller's step completed after a retry ladder: the failure streak
+  // is over, so the 500-retry livelock bound starts fresh.  (A logical
+  // allocate() success resets the counter in post_alloc_success_locked;
+  // real-device-OOM recoveries never pass through allocate, so they
+  // signal here instead — otherwise retry_count would be monotonic over
+  // the thread's lifetime and eventually hard-OOM a healthy thread.)
+  void alloc_recovered(long tid) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = threads_.find(tid);
+    if (it != threads_.end()) it->second.retry_count = 0;
+  }
+
   // Re-size the logical pool to track what the device reports
   // (jax memory_stats); growing frees budget, shrinking can drive
   // free_bytes_ negative, which simply blocks new allocations until
   // enough is released.
-  void resize_pool(long new_pool_bytes) {
+  void resize_pool(long new_pool_bytes, int pool = POOL_DEVICE) {
     std::lock_guard<std::mutex> g(mu_);
-    long delta = new_pool_bytes - pool_bytes_;
-    pool_bytes_ = new_pool_bytes;
-    free_bytes_ += delta;
-    if (delta > 0) wake_next_highest_priority_blocked(/*from_free=*/true);
+    long delta = new_pool_bytes - pool_bytes_[pool];
+    pool_bytes_[pool] = new_pool_bytes;
+    free_bytes_[pool] += delta;
+    if (delta > 0)
+      wake_next_highest_priority_blocked(/*from_free=*/true, pool);
   }
 
-  void deallocate(long tid, long bytes) {
+  void deallocate(long tid, long bytes, int pool = POOL_DEVICE) {
     std::lock_guard<std::mutex> g(mu_);
-    free_bytes_ = std::min(free_bytes_ + bytes, pool_bytes_);
-    allocated_ = std::max<long>(0, allocated_ - bytes);
+    free_bytes_[pool] = std::min(free_bytes_[pool] + bytes,
+                                 pool_bytes_[pool]);
+    allocated_[pool] = std::max<long>(0, allocated_[pool] - bytes);
     auto it = threads_.find(tid);
-    if (it != threads_.end()) {
+    if (pool == POOL_DEVICE && it != threads_.end()) {
       for (long task : it->second.tasks) {
         auto& m = metrics_[task];
         m.cur_memory_allocated = std::max<long>(0, m.cur_memory_allocated - bytes);
@@ -300,7 +338,7 @@ class ResourceAdaptor {
     for (auto& [id, t] : threads_) {
       if (t.state == State::ALLOC) set_state(t, State::ALLOC_FREE, "peer_free");
     }
-    wake_next_highest_priority_blocked(/*from_free=*/true);
+    wake_next_highest_priority_blocked(/*from_free=*/true, pool);
   }
 
   // after catching a retry/split OOM the caller parks here until the
@@ -357,13 +395,13 @@ class ResourceAdaptor {
     return v;
   }
 
-  long total_allocated() {
+  long total_allocated(int pool = POOL_DEVICE) {
     std::lock_guard<std::mutex> g(mu_);
-    return allocated_;
+    return allocated_[pool];
   }
-  long max_allocated() {
+  long max_allocated(int pool = POOL_DEVICE) {
     std::lock_guard<std::mutex> g(mu_);
-    return max_allocated_;
+    return max_allocated_[pool];
   }
 
  private:
@@ -458,7 +496,8 @@ class ResourceAdaptor {
   }
 
   // returns true when the allocation should be retried (after blocking)
-  bool post_alloc_failed_locked(ThreadInfo& t, long bytes) {
+  bool post_alloc_failed_locked(ThreadInfo& t, long /*bytes*/,
+                                int pool = POOL_DEVICE) {
     if (++t.retry_count >= MAX_RETRIES) {
       set_state(t, State::RUNNING, "retry_cap");
       return false;  // hard OOM
@@ -469,7 +508,9 @@ class ResourceAdaptor {
       set_state(t, State::RUNNING, "");
       return true;
     }
-    set_state(t, State::BLOCKED, "alloc_failed");
+    t.blocked_pool = pool;
+    set_state(t, State::BLOCKED,
+              pool == POOL_HOST ? "host_alloc_failed" : "alloc_failed");
     t.blocked_since = Clock::now();
     check_and_update_for_bufn_locked();
     return true;
@@ -530,11 +571,18 @@ class ResourceAdaptor {
     return false;
   }
 
-  void wake_next_highest_priority_blocked(bool from_free) {
+  // pool >= 0: prefer threads blocked on THAT pool (a host free cannot
+  // unblock a device-starved thread); fall back to any blocked thread —
+  // waking the wrong one is safe (its retry fails and re-blocks).
+  void wake_next_highest_priority_blocked(bool from_free, int pool = -1) {
     ThreadInfo* best = nullptr;
-    for (auto& [id, t] : threads_) {
-      if (t.state != State::BLOCKED) continue;
-      if (!best || t.priority() > best->priority()) best = &t;
+    for (int pass = 0; pass < 2 && !best; pass++) {
+      for (auto& [id, t] : threads_) {
+        if (t.state != State::BLOCKED) continue;
+        if (pass == 0 && pool >= 0 && t.blocked_pool != pool) continue;
+        if (!best || t.priority() > best->priority()) best = &t;
+      }
+      if (pool < 0) break;  // no preference: one pass is the full scan
     }
     if (best) {
       add_block_time(*best);
@@ -560,10 +608,10 @@ class ResourceAdaptor {
   std::map<long, ThreadInfo> threads_;
   std::map<long, std::set<long>> task_threads_;
   std::map<long, TaskMetrics> metrics_;
-  long pool_bytes_;
-  long free_bytes_;
-  long allocated_ = 0;
-  long max_allocated_ = 0;
+  long pool_bytes_[NUM_POOLS] = {0, 0};
+  long free_bytes_[NUM_POOLS] = {0, 0};
+  long allocated_[NUM_POOLS] = {0, 0};
+  long max_allocated_[NUM_POOLS] = {0, 0};
   BlockedCb blocked_cb_ = nullptr;
   std::FILE* log_ = nullptr;
 };
@@ -605,8 +653,25 @@ int tra_allocate(void* h, long tid, long bytes) {
 int tra_device_alloc_failed(void* h, long tid) {
   return static_cast<ResourceAdaptor*>(h)->device_alloc_failed(tid);
 }
+void tra_alloc_recovered(void* h, long tid) {
+  static_cast<ResourceAdaptor*>(h)->alloc_recovered(tid);
+}
 void tra_resize_pool(void* h, long new_pool_bytes) {
   static_cast<ResourceAdaptor*>(h)->resize_pool(new_pool_bytes);
+}
+/* ---- unified second (host) pool: same thread state machine ---------- */
+void tra_set_host_pool(void* h, long bytes) {
+  static_cast<ResourceAdaptor*>(h)->set_host_pool(bytes);
+}
+int tra_allocate_on(void* h, long tid, long bytes, int pool) {
+  return static_cast<ResourceAdaptor*>(h)->allocate(tid, bytes, nullptr,
+                                                    pool);
+}
+void tra_deallocate_on(void* h, long tid, long bytes, int pool) {
+  static_cast<ResourceAdaptor*>(h)->deallocate(tid, bytes, pool);
+}
+long tra_total_allocated_on(void* h, int pool) {
+  return static_cast<ResourceAdaptor*>(h)->total_allocated(pool);
 }
 void tra_deallocate(void* h, long tid, long bytes) {
   static_cast<ResourceAdaptor*>(h)->deallocate(tid, bytes);

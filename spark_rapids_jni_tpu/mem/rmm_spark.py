@@ -145,7 +145,17 @@ def _load_lib() -> ctypes.CDLL:
         lib.tra_device_alloc_failed.restype = ctypes.c_int
         lib.tra_device_alloc_failed.argtypes = [ctypes.c_void_p,
                                                 ctypes.c_long]
+        lib.tra_alloc_recovered.argtypes = [ctypes.c_void_p, ctypes.c_long]
         lib.tra_resize_pool.argtypes = [ctypes.c_void_p, ctypes.c_long]
+        lib.tra_set_host_pool.argtypes = [ctypes.c_void_p, ctypes.c_long]
+        lib.tra_allocate_on.restype = ctypes.c_int
+        lib.tra_allocate_on.argtypes = [ctypes.c_void_p, ctypes.c_long,
+                                        ctypes.c_long, ctypes.c_int]
+        lib.tra_deallocate_on.argtypes = [ctypes.c_void_p, ctypes.c_long,
+                                          ctypes.c_long, ctypes.c_int]
+        lib.tra_total_allocated_on.restype = ctypes.c_long
+        lib.tra_total_allocated_on.argtypes = [ctypes.c_void_p,
+                                               ctypes.c_int]
         lib.tra_deallocate.argtypes = [ctypes.c_void_p, ctypes.c_long,
                                        ctypes.c_long]
         lib.tra_block_thread_until_ready.restype = ctypes.c_int
@@ -220,7 +230,8 @@ class SparkResourceAdaptor:
     ``poll_ms`` (reference SparkResourceAdaptor.java:35-79)."""
 
     def __init__(self, pool_bytes: int, log_path: Optional[str] = None,
-                 poll_ms: Optional[float] = None):
+                 poll_ms: Optional[float] = None,
+                 host_pool_bytes: int = 0):
         if poll_ms is None:
             from .. import config
 
@@ -229,6 +240,13 @@ class SparkResourceAdaptor:
         self._h = self._lib.tra_create(
             ctypes.c_long(pool_bytes),
             (log_path or "").encode())
+        self.host_pool_bytes = host_pool_bytes
+        if host_pool_bytes > 0:
+            # second pool in the SAME state machine: the deadlock scan
+            # sees mixed device+host blocking (reference handles mixed
+            # GPU+CPU blocking in one machine)
+            self._lib.tra_set_host_pool(self._h,
+                                        ctypes.c_long(host_pool_bytes))
         self._lib.tra_set_blocked_callback(self._h, _is_blocked_cb)
         self._closed = threading.Event()
         self._watchdog = threading.Thread(
@@ -298,6 +316,22 @@ class SparkResourceAdaptor:
         _raise_for(self._lib.tra_device_alloc_failed(self._h,
                                                      self._tid(tid)))
 
+    def alloc_recovered(self, tid: Optional[int] = None):
+        """A retry ladder resolved: reset the consecutive-failure count
+        (real-device-OOM recoveries never pass through allocate())."""
+        self._lib.tra_alloc_recovered(self._h, self._tid(tid))
+
+    def host_allocate(self, nbytes: int, tid: Optional[int] = None):
+        """Draw from the unified HOST pool; raises the Cpu* OOM flavors."""
+        _raise_for(self._lib.tra_allocate_on(self._h, self._tid(tid),
+                                             nbytes, 1), cpu=True)
+
+    def host_deallocate(self, nbytes: int, tid: Optional[int] = None):
+        self._lib.tra_deallocate_on(self._h, self._tid(tid), nbytes, 1)
+
+    def host_total_allocated(self) -> int:
+        return self._lib.tra_total_allocated_on(self._h, 1)
+
     def resize_pool(self, new_pool_bytes: int):
         """Track the device's reported capacity (jax memory_stats)."""
         self._lib.tra_resize_pool(self._h, new_pool_bytes)
@@ -365,9 +399,16 @@ class RmmSpark:
     @classmethod
     def set_event_handler(cls, pool_bytes: Optional[int] = None,
                           log_path=None,
-                          poll_ms: Optional[float] = None
+                          poll_ms: Optional[float] = None,
+                          host_pool_bytes: int = 0
                           ) -> SparkResourceAdaptor:
-        """Install the adaptor (reference RmmSpark.setEventHandler)."""
+        """Install the adaptor (reference RmmSpark.setEventHandler).
+
+        ``host_pool_bytes > 0`` enables the UNIFIED host arena: both pools
+        share one thread state machine, so the deadlock scan sees a thread
+        blocked on host memory while holding device budget (the
+        reference's mixed CPU+GPU blocking matrix,
+        SparkResourceAdaptorJni.cpp:808-842)."""
         if pool_bytes is None:
             from .. import config
 
@@ -378,13 +419,17 @@ class RmmSpark:
         with cls._lock:
             if cls._adaptor is not None:
                 raise RuntimeError("adaptor already installed")
-            cls._adaptor = SparkResourceAdaptor(pool_bytes, log_path, poll_ms)
+            cls._adaptor = SparkResourceAdaptor(
+                pool_bytes, log_path, poll_ms,
+                host_pool_bytes=host_pool_bytes)
             return cls._adaptor
 
     @classmethod
     def set_cpu_event_handler(cls, pool_bytes: int, log_path=None,
                               poll_ms: float = 100.0) -> SparkResourceAdaptor:
-        """Install the HOST-memory arena (off-heap limit equivalent)."""
+        """LEGACY: a host arena as a second independent adaptor (its
+        deadlock scan cannot see device-arena blocking).  Prefer
+        ``set_event_handler(..., host_pool_bytes=...)``."""
         with cls._lock:
             if cls._cpu_adaptor is not None:
                 raise RuntimeError("cpu adaptor already installed")
@@ -492,8 +537,16 @@ class RmmSpark:
         return new_pool
 
     @classmethod
+    def _unified_host(cls) -> bool:
+        a = cls._adaptor
+        return a is not None and a.host_pool_bytes > 0
+
+    @classmethod
     def cpu_allocate(cls, nbytes: int):
         """Host-arena draw; raises the Cpu* OOM flavors."""
+        if cls._unified_host():
+            cls._a().host_allocate(nbytes)
+            return
         try:
             cls._c().allocate(nbytes)
         except SplitAndRetryOOM as e:
@@ -503,12 +556,16 @@ class RmmSpark:
 
     @classmethod
     def cpu_deallocate(cls, nbytes: int):
+        if cls._unified_host():
+            cls._a().host_deallocate(nbytes)
+            return
         cls._c().deallocate(nbytes)
 
     @classmethod
     def cpu_block_thread_until_ready(cls):
+        adaptor = cls._a() if cls._unified_host() else cls._c()
         try:
-            cls._c().block_thread_until_ready()
+            adaptor.block_thread_until_ready()
         except SplitAndRetryOOM as e:
             raise CpuSplitAndRetryOOM(*e.args) from None
         except RetryOOM as e:

@@ -1137,8 +1137,9 @@ def _format_floats(chars, fstarts, flens, F):
     return fb.reshape(n, F, -1), fl.reshape(n, F).astype(jnp.int32)
 
 
-@partial(jax.jit, static_argnames=("path_tuple", "max_out"))
-def _run(col_chars, col_lengths, col_validity, path_tuple, max_out):
+@partial(jax.jit, static_argnames=("path_tuple", "max_out", "unroll"))
+def _run(col_chars, col_lengths, col_validity, path_tuple, max_out,
+         unroll=1):
     instructions = list(path_tuple)
     ptypes, pindexes, pnames, pnamelens, P = _pack_path(instructions)
     n, L = col_chars.shape
@@ -1190,7 +1191,13 @@ def _run(col_chars, col_lengths, col_validity, path_tuple, max_out):
     cpad = jnp.pad(col_chars, ((0, 0), (0, 1)))
     xs = (jnp.arange(L + 1, dtype=i32), cpad.T)
     step = partial(_step, P, ptypes, pindexes, pnames, pnamelens)
-    final, ys = jax.lax.scan(step, carry, xs)
+    # unroll: several chars per while-loop iteration — the big carry
+    # round-trips HBM once per ITERATION, so unrolling divides the
+    # scan's memory-latency cost by the unroll factor (VERDICT r2 §4:
+    # "process chunks per step"); the carry threads through the unrolled
+    # body in registers/VMEM.  Static jit arg: it must key the cache.
+    final, ys = jax.lax.scan(step, carry, xs,
+                             unroll=min(max(1, unroll), L + 1))
     ys = {k: jnp.moveaxis(v, 0, 1) for k, v in ys.items()}  # [n, L+1]
 
     ok = final["ev_done"] & ~final["ev_fail"] & (final["root_dirty"] > 0)
@@ -1216,16 +1223,25 @@ def _run(col_chars, col_lengths, col_validity, path_tuple, max_out):
 
 
 def get_json_object(
-    col: StringColumn,
+    col,
     path: Union[str, Sequence],
     max_out: int = 0,
-) -> StringColumn:
+):
     """Evaluate a JSONPath against every row; invalid/no-match rows -> null.
 
     ``max_out`` pins the output char-matrix width (default 6*L+20 covers
     the worst-case escape expansion; lower it to trade memory when inputs
     are known tame — overlong results then clamp to null).
+
+    A :class:`~spark_rapids_jni_tpu.columnar.bucketed.BucketedStringColumn`
+    input evaluates per bucket — each bucket's scan runs only that
+    bucket's width — and returns a bucketed result (``.merge()`` for a
+    flat column).
     """
+    from ..columnar.bucketed import BucketedStringColumn
+
+    if isinstance(col, BucketedStringColumn):
+        return col.apply(lambda b: get_json_object(b, path, max_out))
     instructions = parse_path(path) if isinstance(path, str) else list(path)
     if len(instructions) > MAX_PATH:
         raise ValueError(f"path deeper than {MAX_PATH}")
@@ -1239,6 +1255,9 @@ def get_json_object(
         # output bytes (control char -> \u00XX in escaped style); floats
         # emit <= srclen+9; case-6 brackets add <=3 per '[' char
         max_out = 6 * L + 20
+    from .. import config
+
     out_chars, out_lens, valid = _run(
-        col.chars, col.lengths, col.validity, tuple(instructions), max_out)
+        col.chars, col.lengths, col.validity, tuple(instructions), max_out,
+        unroll=max(1, int(config.get("json_scan_unroll"))))
     return StringColumn(out_chars, out_lens, valid)

@@ -159,12 +159,137 @@ def _iter_frames(data: bytes):
         yield name, payload
 
 
+# ---------------------------------------------------------------------------
+# xplane.pb decoding (minimal protobuf wire reader; no tensorflow needed)
+# ---------------------------------------------------------------------------
+# Field numbers from tsl/profiler/protobuf/xplane.proto:
+#   XSpace   { repeated XPlane planes = 1; }
+#   XPlane   { int64 id=1; string name=2; repeated XLine lines=3;
+#              map<int64, XEventMetadata> event_metadata=4; }
+#   XLine    { int64 id=1; string name=2; int64 timestamp_ns=3;
+#              repeated XEvent events=4; string display_name=11; }
+#   XEvent   { int64 metadata_id=1; int64 offset_ps=2;
+#              int64 duration_ps=3; }
+#   XEventMetadata { int64 id=1; string name=2; }
+# The device planes ("/device:TPU:0 ...") carry per-kernel events — the
+# role of the reference's CUPTI activity records
+# (profiler_serializer.cpp:222-280).
+
+
+def _pb_fields(buf: bytes):
+    """Yield (field_number, wire_type, value) over one message's bytes."""
+    off = 0
+    n = len(buf)
+    while off < n:
+        key = 0
+        shift = 0
+        while True:
+            b = buf[off]
+            off += 1
+            key |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        field, wt = key >> 3, key & 7
+        if wt == 0:  # varint
+            v = 0
+            shift = 0
+            while True:
+                b = buf[off]
+                off += 1
+                v |= (b & 0x7F) << shift
+                if not b & 0x80:
+                    break
+                shift += 7
+            yield field, wt, v
+        elif wt == 2:  # length-delimited
+            ln = 0
+            shift = 0
+            while True:
+                b = buf[off]
+                off += 1
+                ln |= (b & 0x7F) << shift
+                if not b & 0x80:
+                    break
+                shift += 7
+            yield field, wt, buf[off: off + ln]
+            off += ln
+        elif wt == 5:  # fixed32
+            yield field, wt, buf[off: off + 4]
+            off += 4
+        elif wt == 1:  # fixed64
+            yield field, wt, buf[off: off + 8]
+            off += 8
+        else:
+            raise ProfilerError(f"unsupported protobuf wire type {wt}")
+
+
+def _decode_xspace(payload: bytes) -> List[dict]:
+    """XSpace bytes -> flat event records (plane/line/kernel name/us)."""
+    events: List[dict] = []
+    for f, wt, plane_buf in _pb_fields(payload):
+        if f != 1 or wt != 2:
+            continue
+        plane_name = ""
+        meta_names = {}
+        lines = []
+        for pf, pwt, pv in _pb_fields(plane_buf):
+            if pf == 2 and pwt == 2:
+                plane_name = pv.decode("utf-8", "replace")
+            elif pf == 3 and pwt == 2:
+                lines.append(pv)
+            elif pf == 4 and pwt == 2:
+                # map entry { int64 key=1; XEventMetadata value=2; }
+                mid, mname = 0, ""
+                for mf, mwt, mv in _pb_fields(pv):
+                    if mf == 1 and mwt == 0:
+                        mid = mv
+                    elif mf == 2 and mwt == 2:
+                        for ef, ewt, ev in _pb_fields(mv):
+                            if ef == 2 and ewt == 2:
+                                mname = ev.decode("utf-8", "replace")
+                meta_names[mid] = mname
+        for line_buf in lines:
+            line_name = ""
+            ts_ns = 0
+            evs = []
+            for lf, lwt, lv in _pb_fields(line_buf):
+                if lf == 2 and lwt == 2:
+                    line_name = lv.decode("utf-8", "replace")
+                elif lf == 3 and lwt == 0:
+                    ts_ns = lv
+                elif lf == 4 and lwt == 2:
+                    evs.append(lv)
+            for ev_buf in evs:
+                mid = off_ps = dur_ps = 0
+                for ef, ewt, ev in _pb_fields(ev_buf):
+                    if ef == 1 and ewt == 0:
+                        mid = ev
+                    elif ef == 2 and ewt == 0:
+                        off_ps = ev
+                    elif ef == 3 and ewt == 0:
+                        dur_ps = ev
+                events.append({
+                    "name": meta_names.get(mid, f"event:{mid}"),
+                    "ts_us": ts_ns / 1e3 + off_ps / 1e6,
+                    "dur_us": dur_ps / 1e6,
+                    "plane": plane_name,
+                    "line": line_name,
+                })
+    return events
+
+
 def convert_profile(capture_path: str) -> List[dict]:
     """Offline converter: capture stream -> flat event records.
 
     Equivalent role to ``spark_rapids_profile_converter`` (flatbuffer ->
-    JSON); decodes the Chrome-trace JSON (``*.trace.json.gz``) inside the
-    capture into ``{"name", "ts_us", "dur_us", "tid", "pid"}`` records.
+    JSON).  Decodes BOTH artifact formats the XLA profiler produces:
+
+    * ``*.trace.json.gz`` Chrome-trace -> {"name", "ts_us", "dur_us",
+      "tid", "pid"} records;
+    * ``*.xplane.pb`` XSpace protos -> {"name", "ts_us", "dur_us",
+      "plane", "line"} records, where device planes carry the per-kernel
+      activity (the reference's CUPTI record role).
     """
     with open(capture_path, "rb") as f:
         data = f.read()
@@ -185,6 +310,8 @@ def convert_profile(capture_path: str) -> List[dict]:
                             "tid": ev.get("tid"),
                         }
                     )
+        elif name.endswith(".xplane.pb"):
+            events.extend(_decode_xspace(payload))
     return events
 
 

@@ -22,6 +22,14 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# The JSON scan's production unroll factor multiplies XLA-CPU compile time
+# by ~the factor across the suite's many (shape, path) variants; CI pins
+# it to 1 (unroll is a lax.scan parameter — semantics are identical; one
+# dedicated test covers an unrolled run).
+from spark_rapids_jni_tpu import config as _srj_config  # noqa: E402
+
+_srj_config.set("json_scan_unroll", 1)
+
 
 @pytest.fixture(scope="session")
 def eight_devices():

@@ -1,0 +1,79 @@
+"""Length-bucketed string storage (SURVEY.md §5 bucketed padding).
+
+The batching weakness VERDICT r2 named: one long outlier row used to
+inflate the whole ``[n, max_len]`` matrix and every scan kernel's step
+count.  These tests pin (a) round-trip fidelity, (b) kernel parity with
+the flat layout, (c) the memory bound actually holding.
+"""
+
+import numpy as np
+
+from spark_rapids_jni_tpu.columnar import BucketedStringColumn, StringColumn
+from spark_rapids_jni_tpu.columnar.bucketed import plan_widths
+
+
+class TestBucketing:
+    def test_round_trip_with_nulls_and_empties(self):
+        vals = ["a", None, "", "x" * 100, "hello", None, "y" * 700, "z"]
+        b = BucketedStringColumn.from_pylist(vals)
+        assert b.to_pylist() == vals
+        assert b.num_rows == len(vals)
+
+    def test_plan_widths_covers_max(self):
+        assert plan_widths([5, 10]) == [32]
+        assert plan_widths([5, 100]) == [32, 128]
+        assert plan_widths([100000]) == [32, 128, 512, 2048, 8192, 32768,
+                                         100000]
+        assert plan_widths([]) == [32]
+
+    def test_capacity_bound_vs_flat(self):
+        # 1000 short rows + one 8KB outlier: flat layout needs n*8192;
+        # bucketed stays within ~2x the actual char mass
+        vals = ["row-%d" % i for i in range(1000)] + ["X" * 8000]
+        b = BucketedStringColumn.from_pylist(vals)
+        flat_capacity = len(vals) * 8192
+        assert b.total_char_capacity < flat_capacity / 50
+        assert b.total_char_capacity >= sum(len(v) for v in vals)
+
+    def test_from_string_column_round_trip(self):
+        vals = ["alpha", None, "beta" * 40, ""]
+        flat = StringColumn.from_pylist(vals)
+        b = BucketedStringColumn.from_string_column(flat)
+        assert b.to_pylist() == vals
+        merged = b.merge()
+        assert merged.to_pylist() == vals
+
+    def test_merge_restores_row_order(self):
+        vals = ["bb" * 60, "a", "ccc" * 300, "d"]
+        b = BucketedStringColumn.from_pylist(vals)
+        assert len(b.buckets) >= 2  # actually split across widths
+        assert b.merge().to_pylist() == vals
+
+
+class TestBucketedJson:
+    def test_get_json_object_parity_with_flat(self):
+        from spark_rapids_jni_tpu.ops.get_json_object import get_json_object
+
+        docs = (
+            ['{"owner":"amy%d","id":%d}' % (i, i) for i in range(40)]
+            + ['{"pad":"%s","owner":"big"}' % ("p" * 600)]  # outlier
+            + [None, "not json", '{"owner": null}']
+        )
+        flat = StringColumn.from_pylist(docs, pad_to_multiple=32)
+        want = get_json_object(flat, "$.owner").to_pylist()
+
+        b = BucketedStringColumn.from_pylist(docs)
+        got = get_json_object(b, "$.owner")
+        assert isinstance(got, BucketedStringColumn)
+        assert got.to_pylist() == want
+        assert got.merge().to_pylist() == want
+
+    def test_bucketed_scan_width_tracks_bucket(self):
+        from spark_rapids_jni_tpu.ops.get_json_object import get_json_object
+
+        docs = ['{"k":%d}' % i for i in range(20)] + ['{"k":"%s"}' % ("v" * 900)]
+        b = BucketedStringColumn.from_pylist(docs)
+        out = get_json_object(b, "$.k")
+        # the short bucket's OUTPUT width must be sized by the short
+        # bucket's input width, not the outlier's
+        assert out.buckets[0].max_len <= 6 * 32 + 20

@@ -248,3 +248,24 @@ def _to_ins(path):
     from spark_rapids_jni_tpu.ops.get_json_object import parse_path
 
     return parse_path(path)
+
+
+class TestScanUnroll:
+    def test_unrolled_scan_matches_unroll1(self):
+        """json_scan_unroll is a lax.scan unroll factor; one unrolled run
+        pins that the carry threads correctly through the unrolled body
+        (CI otherwise runs unroll=1 for compile time)."""
+        from spark_rapids_jni_tpu import config
+        from spark_rapids_jni_tpu.columnar.column import StringColumn
+        from spark_rapids_jni_tpu.ops.get_json_object import get_json_object
+
+        docs = ['{"a": {"b": [1, 2, {"c": "x%d"}]}}' % i for i in range(8)]
+        docs += [None, "broken", '{"a": 1}']
+        col = StringColumn.from_pylist(docs, pad_to_multiple=16)
+        want = get_json_object(col, "$.a.b[2].c").to_pylist()
+        config.set("json_scan_unroll", 4)
+        try:
+            got = get_json_object(col, "$.a.b[2].c").to_pylist()
+        finally:
+            config.set("json_scan_unroll", 1)
+        assert got == want

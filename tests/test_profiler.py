@@ -65,3 +65,40 @@ def test_stop_idempotent(tmp_path):
     Profiler.stop()  # never started: no-op
     Profiler.shutdown()
     w.close()
+
+
+class TestXplaneDecode:
+    def test_xplane_pb_events_decode(self, tmp_path):
+        """The converter must decode the XLA profiler's xplane.pb (the
+        format that carries per-kernel device activity on TPU), not just
+        the Chrome-trace JSON (VERDICT r2 item 8)."""
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_jni_tpu.profiler import (
+            FileWriter,
+            Profiler,
+            convert_profile,
+            list_capture_files,
+        )
+
+        cap = str(tmp_path / "cap.bin")
+        w = FileWriter(cap)
+        Profiler.init(w)
+        Profiler.start()
+        jax.block_until_ready(
+            jax.jit(lambda x: (x * 2 + 1).sum())(jnp.arange(4096)))
+        Profiler.stop()
+        Profiler.shutdown()
+        w.close()
+
+        names = list_capture_files(cap)
+        assert any(n.endswith(".xplane.pb") for n in names), names
+        events = convert_profile(cap)
+        xev = [e for e in events if "plane" in e]
+        assert xev, "no xplane events decoded"
+        # empirical schema check: plane/line names decoded as text and at
+        # least one event has a real name and a positive duration
+        assert any(e["plane"] for e in xev)
+        assert any(e["dur_us"] > 0 and not e["name"].startswith("event:")
+                   for e in xev), xev[:5]
