@@ -235,6 +235,52 @@ static void segv_handler(int sig) {
   _exit(139);
 }
 
+#include <atomic>
+#include <chrono>
+#include <thread>
+
+static void test_rmm_blocking(JNIEnv* env) {
+  /* RmmSparkTest.testBasicBlocking port: a second task's allocate parks
+   * in BLOCKED until the first frees; codes and states observed through
+   * the glue exactly as the Java side would. */
+  jlong h = GLUE(SparkResourceAdaptor_create)(env, nullptr, 1 << 20,
+                                              nullptr);
+  CHECK(h != 0, "adaptor create (blocking)");
+  jlong t1 = 8101, t2 = 8102;
+  GLUE(SparkResourceAdaptor_startDedicatedTaskThread)(env, nullptr, h, t1,
+                                                      1);
+  GLUE(SparkResourceAdaptor_startDedicatedTaskThread)(env, nullptr, h, t2,
+                                                      2);
+  CHECK(GLUE(SparkResourceAdaptor_allocate)(env, nullptr, h, t1,
+                                            900 << 10) == 0,
+        "t1 allocate ok");
+
+  std::atomic<int> t2_code{-99};
+  std::thread blocked([&] {
+    t2_code = GLUE(SparkResourceAdaptor_allocate)(env, nullptr, h, t2,
+                                                  900 << 10);
+  });
+  /* poll for BLOCKED(4) like RmmSparkTest.pollForState */
+  int state = 0;
+  for (int i = 0; i < 200; i++) {
+    state = GLUE(SparkResourceAdaptor_getStateOf)(env, nullptr, h, t2);
+    if (state == 4) break;
+    std::this_thread::sleep_for(std::chrono::milliseconds(5));
+  }
+  CHECK(state == 4, "t2 reaches BLOCKED");
+  GLUE(SparkResourceAdaptor_deallocate)(env, nullptr, h, t1, 900 << 10);
+  blocked.join();
+  CHECK(t2_code == 0, "t2 allocate completed after the free");
+  CHECK(GLUE(SparkResourceAdaptor_getAndResetMetric)(env, nullptr, h, 2,
+                                                     2) > 0,
+        "t2 block time metric");
+  GLUE(SparkResourceAdaptor_deallocate)(env, nullptr, h, t2, 900 << 10);
+  GLUE(SparkResourceAdaptor_taskDone)(env, nullptr, h, 1);
+  GLUE(SparkResourceAdaptor_taskDone)(env, nullptr, h, 2);
+  GLUE(SparkResourceAdaptor_destroy)(env, nullptr, h);
+  std::printf("rmm-blocking scenario OK\n");
+}
+
 int main() {
   std::signal(SIGSEGV, segv_handler);
   std::signal(SIGABRT, segv_handler);
@@ -272,6 +318,8 @@ int main() {
   test_hash_roundtrip(env);
   std::printf("stage: rmm\n");
   test_rmm_spark(env);
+  std::printf("stage: rmm-blocking\n");
+  test_rmm_blocking(env);
 
   if (g_failures != 0) {
     std::fprintf(stderr, "%d glue checks FAILED\n", g_failures);

@@ -130,6 +130,26 @@ class BucketedStringColumn:
         return BucketedStringColumn(
             [fn(b) for b in self.buckets], list(self.row_ids), self.num_rows)
 
+    def apply_column(self, fn) -> "Column":
+        """Run a StringColumn -> Column kernel per bucket (hashes, casts,
+        predicates) and merge the per-bucket results back into one
+        row-ordered Column with one scatter per bucket."""
+        import jax
+
+        from .column import Column
+
+        outs = [(fn(b), ids) for b, ids in zip(self.buckets, self.row_ids)]
+        first = outs[0][0]
+        data = jnp.zeros((self.num_rows,) + first.data.shape[1:],
+                         first.data.dtype)
+        valid = jnp.zeros((self.num_rows,), jnp.bool_)
+        for col, ids in outs:
+            if col.data.shape[0] == 0:
+                continue
+            data = data.at[ids].set(col.data)
+            valid = valid.at[ids].set(col.validity)
+        return Column(data, valid, first.dtype)
+
     def merge(self) -> StringColumn:
         """Scatter the buckets back into one row-ordered StringColumn
         (width = widest bucket result)."""

@@ -127,6 +127,8 @@ class ResourceAdaptor {
     long delta = bytes - pool_bytes_[POOL_HOST];
     pool_bytes_[POOL_HOST] = bytes;
     free_bytes_[POOL_HOST] += delta;
+    if (delta > 0)  // growth can unblock a host-starved thread
+      wake_next_highest_priority_blocked(/*from_free=*/true, POOL_HOST);
   }
 
   ~ResourceAdaptor() {

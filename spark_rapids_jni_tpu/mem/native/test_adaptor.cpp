@@ -20,6 +20,10 @@ void tra_remove_thread_association(void* h, long tid, long task);
 void tra_task_done(void* h, long task);
 int tra_allocate(void* h, long tid, long bytes);
 void tra_deallocate(void* h, long tid, long bytes);
+void tra_set_host_pool(void* h, long bytes);
+int tra_allocate_on(void* h, long tid, long bytes, int pool);
+void tra_deallocate_on(void* h, long tid, long bytes, int pool);
+long tra_total_allocated_on(void* h, int pool);
 int tra_block_thread_until_ready(void* h, long tid);
 int tra_get_state_of(void* h, long tid);
 int tra_check_and_break_deadlocks(void* h);
@@ -124,6 +128,53 @@ static void test_contention_completes() {
   tra_destroy(h);
 }
 
+/* Cross-arena deadlock: t1 holds HOST + blocks on DEVICE, t2 holds
+ * DEVICE + blocks on HOST.  One state machine sees both, escalates the
+ * lower-priority victim, both complete (unified-pool half of the
+ * reference's mixed CPU+GPU blocking matrix). */
+static void test_cross_pool_deadlock() {
+  void* h = tra_create(1000, nullptr);
+  tra_set_host_pool(h, 1000);
+  std::atomic<int> done{0};
+  auto run = [&](long tid, long task, int first_pool, int second_pool) {
+    tra_start_dedicated_task_thread(h, tid, task);
+    CHECK(tra_allocate_on(h, tid, 900, first_pool) == OK);
+    long held_first = 900;
+    for (;;) {
+      int rc = tra_allocate_on(h, tid, 900, second_pool);
+      if (rc == OK) {
+        tra_deallocate_on(h, tid, 900, second_pool);
+        break;
+      }
+      /* escalated: roll back the FIRST holding, park, retry */
+      tra_deallocate_on(h, tid, held_first, first_pool);
+      held_first = 0;
+      int brc = tra_block_thread_until_ready(h, tid);
+      (void)brc; /* RETRY/SPLIT both mean: retry now */
+    }
+    if (held_first) tra_deallocate_on(h, tid, held_first, first_pool);
+    tra_task_done(h, task);
+    done.fetch_add(1);
+  };
+  std::thread t1(run, 21, 201, 1, 0);  /* host first, device second */
+  std::thread t2(run, 22, 202, 0, 1);  /* device first, host second */
+  std::atomic<bool> stop{false};
+  std::thread wd([&] {
+    while (!stop.load()) {
+      tra_check_and_break_deadlocks(h);
+      std::this_thread::sleep_for(std::chrono::milliseconds(10));
+    }
+  });
+  t1.join();
+  t2.join();
+  stop.store(true);
+  wd.join();
+  CHECK(done.load() == 2);
+  CHECK(tra_total_allocated(h) == 0);
+  CHECK(tra_total_allocated_on(h, 1) == 0);
+  tra_destroy(h);
+}
+
 /* Seeded fuzz matching tests/test_mem_adaptor.py TestMonteCarlo — random
  * alloc/free with the full escalation ladder, N tasks oversubscribed. */
 static void test_fuzz(unsigned seed) {
@@ -195,6 +246,8 @@ int main(int argc, char** argv) {
   test_injection();
   std::puts("injection OK");
   test_contention_completes();
+  std::puts("cross_pool_deadlock");
+  test_cross_pool_deadlock();
   std::puts("contention OK");
   test_fuzz(seed);
   std::puts("fuzz OK");

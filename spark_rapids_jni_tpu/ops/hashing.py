@@ -485,6 +485,13 @@ def _validate(cols):
 
 def murmur_hash3_32(columns: Columns, seed: int = 42) -> Column:
     """Spark Murmur3_32 row hash across columns (reference murmur_hash.cu:187)."""
+    from ..columnar.bucketed import BucketedStringColumn
+
+    cols = columns if isinstance(columns, (list, tuple)) else [columns]
+    if len(cols) == 1 and isinstance(cols[0], BucketedStringColumn):
+        # per-bucket hashing at each bucket's width, scatter-merged
+        return cols[0].apply_column(
+            lambda b: murmur_hash3_32([b], seed=seed))
     cols = _as_columns(columns)
     n = _validate(cols)
     from ..columnar.column import ListColumn
@@ -518,6 +525,11 @@ def xxhash64(columns: Columns, seed: int = DEFAULT_XXHASH64_SEED) -> Column:
     """Spark XXHash64 row hash across columns (reference xxhash64.cu:330)."""
     from ..columnar.column import ListColumn
 
+    from ..columnar.bucketed import BucketedStringColumn
+
+    pre = columns if isinstance(columns, (list, tuple)) else [columns]
+    if len(pre) == 1 and isinstance(pre[0], BucketedStringColumn):
+        return pre[0].apply_column(lambda b: xxhash64([b], seed=seed))
     cols = _as_columns(columns)
     n = _validate(cols)
     if len(cols) == 1:

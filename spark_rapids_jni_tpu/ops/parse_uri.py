@@ -514,6 +514,11 @@ def parse_uri(col: StringColumn, part: str,
         raise ValueError(f"unknown URI part {part!r}")
     if key is not None and part != "QUERY":
         raise ValueError("key filter is only valid with QUERY")
+    from ..columnar.bucketed import BucketedStringColumn
+
+    if isinstance(col, BucketedStringColumn):
+        # per-bucket: each bucket's validator scan runs at ITS width
+        return col.apply(lambda b: parse_uri(b, part, key))
     out, lens, has = _parse(col.chars, col.lengths, col.validity, part, key)
     return StringColumn(out, lens, has)
 

@@ -31,6 +31,10 @@ def substring(col: StringColumn, pos: int, length: int = -1) -> StringColumn:
     left-compacts the survivors — no scatter (slow on the TPU backend,
     BASELINE.md primitive costs).
     """
+    from ..columnar.bucketed import BucketedStringColumn
+
+    if isinstance(col, BucketedStringColumn):
+        return col.apply(lambda b: substring(b, pos, length))
     chars, lengths, validity = col.chars, col.lengths, col.validity
     n, L = chars.shape
     posax = jnp.arange(L, dtype=jnp.int32)[None, :]

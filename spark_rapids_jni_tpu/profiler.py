@@ -129,6 +129,16 @@ class Profiler:
             cls._writer(data)
 
 
+def trace_range(name: str):
+    """Named range in the captured trace — the NVTX-range analogue
+    (reference compiles nvtx3 ranges into kernels for nsys, SURVEY §5);
+    here ``with trace_range("stage"):`` annotates the XLA trace so the
+    converter's events carry pipeline-stage names."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
 class FileWriter:
     """A DataWriter that appends frames to one capture file."""
 

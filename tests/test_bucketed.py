@@ -68,6 +68,34 @@ class TestBucketedJson:
         assert got.to_pylist() == want
         assert got.merge().to_pylist() == want
 
+    def test_parse_uri_and_substring_parity(self):
+        from spark_rapids_jni_tpu.ops.parse_uri import parse_uri
+        from spark_rapids_jni_tpu.ops.strings import substring
+
+        uris = ([f"https://h{i}.example.com:80/p{i}?q={i}#f"
+                 for i in range(30)]
+                + ["https://long.example.com/" + "seg/" * 200, None,
+                   "not a uri"])
+        flat = StringColumn.from_pylist(uris, pad_to_multiple=16)
+        b = BucketedStringColumn.from_pylist(uris)
+        for part in ("HOST", "PATH", "QUERY"):
+            want = parse_uri(flat, part).to_pylist()
+            assert parse_uri(b, part).to_pylist() == want, part
+        want = substring(flat, 9, 12).to_pylist()
+        assert substring(b, 9, 12).to_pylist() == want
+
+    def test_hashes_parity(self):
+        from spark_rapids_jni_tpu.ops import hashing
+
+        vals = (["key-%d" % i for i in range(40)]
+                + ["K" * 500, None, ""])
+        flat = StringColumn.from_pylist(vals, pad_to_multiple=16)
+        b = BucketedStringColumn.from_pylist(vals)
+        for fn in (hashing.murmur_hash3_32, hashing.xxhash64):
+            want = fn([flat]).to_pylist()
+            got = fn([b]).to_pylist()
+            assert got == want, fn.__name__
+
     def test_bucketed_scan_width_tracks_bucket(self):
         from spark_rapids_jni_tpu.ops.get_json_object import get_json_object
 

@@ -102,3 +102,28 @@ class TestXplaneDecode:
         assert any(e["plane"] for e in xev)
         assert any(e["dur_us"] > 0 and not e["name"].startswith("event:")
                    for e in xev), xev[:5]
+
+    def test_trace_range_names_appear(self, tmp_path):
+        """with trace_range(name): ... must annotate the capture (the
+        NVTX-range analogue, SURVEY §5 tracing)."""
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_jni_tpu.profiler import (
+            FileWriter,
+            Profiler,
+            convert_profile,
+            trace_range,
+        )
+
+        cap = str(tmp_path / "cap2.bin")
+        w = FileWriter(cap)
+        Profiler.init(w)
+        Profiler.start()
+        with trace_range("srj_stage_filter"):
+            jax.block_until_ready(jax.jit(lambda x: x + 1)(jnp.arange(64)))
+        Profiler.stop()
+        Profiler.shutdown()
+        w.close()
+        events = convert_profile(cap)
+        assert any("srj_stage_filter" in e["name"] for e in events)

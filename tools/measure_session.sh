@@ -1,19 +1,51 @@
 #!/usr/bin/env bash
 # One-shot TPU measurement session (run when the axon tunnel is alive):
-# flagship q6 under both aggregation engines, then the incremental micro
-# suite.  Never run two TPU clients at once (BASELINE.md).
+# the full A/B matrix for the round-3 perf design, then the micro suite,
+# a profiler capture, and the real-HBM OOM drill.  Never run two TPU
+# clients at once (BASELINE.md); every stage uses bench.py's bounded
+# budget or its own timeout.
 # Config env overrides use the SPARK_RAPIDS_TPU_<KEY> registry prefix.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== q6 sort-scan engine"
+stamp() { date +%H:%M:%S; }
+
+echo "== [$(stamp)] q6 default: onehot-xla f32x3 @16M"
 python bench.py
 
-echo "== q6 MXU one-hot engine"
-SPARK_RAPIDS_TPU_Q6_GROUP_PATH=onehot python bench.py
+echo "== [$(stamp)] q6 onehot-pallas (fused VMEM one-hot)"
+SPARK_RAPIDS_TPU_Q6_ONEHOT_ENGINE=pallas python bench.py
 
-echo "== pallas hash routing on"
-SPARK_RAPIDS_TPU_USE_PALLAS_HASHES=1 python bench.py
+echo "== [$(stamp)] q6 onehot-xla f64 floats (rounding-compatible mode)"
+SPARK_RAPIDS_TPU_Q6_FLOAT_MODE=f64 python bench.py
 
-echo "== micro suite"
-python bench.py --micro
+echo "== [$(stamp)] q6 sort-scan engine (the general path)"
+SPARK_RAPIDS_TPU_Q6_GROUP_PATH=sort python bench.py
+
+echo "== [$(stamp)] q6 rows sweep: dispatch-latency amortization curve"
+for rows in 2097152 8388608 33554432; do
+  echo "-- rows=$rows"
+  BENCH_N_ROWS=$rows python bench.py
+done
+
+echo "== [$(stamp)] json unroll A/B (flagship micro only runs once; use"
+echo "   SPARK_RAPIDS_TPU_JSON_SCAN_UNROLL to compare 1 vs 8)"
+SPARK_RAPIDS_TPU_JSON_SCAN_UNROLL=1 BENCH_TOTAL_BUDGET_S=300 \
+  python bench.py --micro 2>/dev/null | grep -E "get_json|qstr" || true
+SPARK_RAPIDS_TPU_JSON_SCAN_UNROLL=8 BENCH_TOTAL_BUDGET_S=300 \
+  python bench.py --micro 2>/dev/null | grep -E "get_json|qstr" || true
+
+echo "== [$(stamp)] pallas hash routing on"
+SPARK_RAPIDS_TPU_USE_PALLAS_HASHES=1 python bench.py --micro \
+  2>/dev/null | grep -E "murmur|xxhash" || true
+
+echo "== [$(stamp)] full micro suite"
+BENCH_TOTAL_BUDGET_S=600 python bench.py --micro
+
+echo "== [$(stamp)] q6 profiler capture (xplane, kernel-level)"
+timeout --signal=TERM 300 python tools/prof_q6.py || true
+
+echo "== [$(stamp)] real-HBM OOM drill (retry ladder on genuine OOM)"
+timeout --signal=TERM 300 python tools/real_oom_tpu.py || true
+
+echo "== [$(stamp)] done"

@@ -1,4 +1,15 @@
-"""Stage-by-stage timing of the q6 pipeline on whatever backend resolves."""
+"""Stage-by-stage cost breakdown of the q6 pipeline + a profiler capture.
+
+Answers VERDICT r2 weakness 2 ("the measured primitive costs don't
+explain the pipeline cost — nobody profiled the gap"): times each stage
+of the one-hot engine, both engines end-to-end, and then points the
+in-tree Profiler at the full step and prints the top device events from
+the decoded capture (xplane on TPU).
+
+Run on whatever backend resolves (TPU when the tunnel is alive).
+"""
+import os
+import tempfile
 import time
 
 import jax
@@ -6,77 +17,103 @@ import jax.numpy as jnp
 import numpy as np
 
 import __graft_entry__ as ge
-from spark_rapids_jni_tpu.relational import AggSpec, compact, group_by
-from spark_rapids_jni_tpu.relational import keys as K
-from spark_rapids_jni_tpu.relational.aggregate import _elect_representatives, _hash_words
+from spark_rapids_jni_tpu.relational import AggSpec, group_by
+from spark_rapids_jni_tpu.relational.aggregate import group_by_onehot
 
-N = 1 << 21
+N = int(os.environ.get("PROF_Q6_ROWS", 1 << 21))
 batch = ge._example_batch(N)
+variants = [ge._example_batch(N, seed=7 + i) for i in range(2)]
 
 
-def bench(name, f, *args, reps=10):
+def bench(name, f, reps=8):
     jf = jax.jit(f)
-    out = jf(*args)
-    jax.block_until_ready(out)
+    for v in variants:  # the tunnel dedupes identical executions
+        jax.block_until_ready(jf(v))
     t0 = time.perf_counter()
-    for _ in range(reps):
-        out = jf(*args)
+    for r in range(reps):
+        out = jf(variants[r % 2])
     jax.block_until_ready(out)
     dt = (time.perf_counter() - t0) / reps
-    print(f"{name:28s} {dt*1e3:8.2f} ms   {N/dt/1e6:8.1f} Mrows/s", flush=True)
+    print(f"{name:32s} {dt*1e3:8.2f} ms   {N/dt/1e6:8.1f} Mrows/s",
+          flush=True)
 
 
-print("devices:", jax.devices(), flush=True)
+print("devices:", jax.devices(), "rows:", N, flush=True)
 
-bench("mask_only", lambda b: b["price"].data < 50.0, batch)
-bench("compact", lambda b: compact(b, b["price"].data < 50.0), batch)
-
-
-def elect(b):
-    karr = K.batch_radix_keys([b["k"]], equality=True, nulls_first=True)
-    return _elect_representatives(karr, jnp.ones((N,), jnp.bool_), N)
+# ---- one-hot engine stages ------------------------------------------------
+bench("mask_only", lambda b: b["price"].data < 50.0)
 
 
-bench("radix+elect", elect, batch)
+def bucket_only(b):
+    k = b["k"].data.astype(jnp.int32)
+    live = b["k"].validity & (b["price"].data < 50.0)
+    return jnp.where(live, jnp.clip(k, 0, 99), 100)
 
 
-def elect_one_round(b):
-    karr = K.batch_radix_keys([b["k"]], equality=True, nulls_first=True)
-    S = 1 << (2 * N - 1).bit_length()
-    S = min(S, 1 << 22)
-    iota = jnp.arange(N, dtype=jnp.int32)
-    h = _hash_words(karr, jnp.uint32(0))
-    b_ = (h & jnp.uint32(S - 1)).astype(jnp.int32)
-    table = jnp.full((S + 1,), jnp.int32(2**31 - 1), jnp.int32).at[b_].min(iota)
-    cand = jnp.clip(jnp.take(table, b_), 0, N - 1)
-    eq = jnp.ones((N,), jnp.bool_)
-    for k in karr:
-        eq = eq & (k == jnp.take(k, cand))
-    return eq
+bench("bucket_build", bucket_only)
 
 
-bench("one_election_round", elect_one_round, batch)
+def onehot_int_dot(b):
+    bucket = bucket_only(b)
+    oh = (bucket[:, None] == jnp.arange(101, dtype=jnp.int32)[None, :]
+          ).astype(jnp.int8)
+    ones = jnp.ones((N, 1), jnp.int8)
+    return jax.lax.dot_general(oh.T, ones, (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.int32)
 
 
-def segsum(b):
-    gid = (b["k"].data % 100).astype(jnp.int32)
-    return jax.ops.segment_sum(b["v"].data.astype(jnp.int64), gid, num_segments=N + 1)[:N]
+bench("onehot_count_dot", onehot_int_dot)
 
+AGGS = [AggSpec("sum", "v", "sum_v"), AggSpec("count", None, "cnt"),
+        AggSpec("mean", "price", "avg_price")]
 
-bench("segment_sum_bigseg", segsum, batch)
+bench("onehot_xla_f32x3", lambda b: group_by_onehot(
+    b, "k", AGGS, 100, row_valid=b["price"].data < 50.0,
+    float_mode="f32x3"))
+bench("onehot_xla_f64", lambda b: group_by_onehot(
+    b, "k", AGGS, 100, row_valid=b["price"].data < 50.0,
+    float_mode="f64"))
+bench("onehot_pallas", lambda b: group_by_onehot(
+    b, "k", AGGS, 100, row_valid=b["price"].data < 50.0,
+    float_mode="f32x3", engine="pallas"))
+bench("sort_scan_group_by", lambda b: group_by(
+    b, ["k"], AGGS, row_valid=b["price"].data < 50.0))
+bench("full_q6_default", ge._q6_step)
 
+# ---- capture a real trace of the full step --------------------------------
+from spark_rapids_jni_tpu.profiler import (  # noqa: E402
+    FileWriter,
+    Profiler,
+    convert_profile,
+)
 
-def segsum_small(b):
-    gid = (b["k"].data % 100).astype(jnp.int32)
-    return jax.ops.segment_sum(b["v"].data.astype(jnp.int64), gid, num_segments=128)
+cap = os.path.join(tempfile.gettempdir(), "q6_capture.bin")
+if os.path.exists(cap):
+    os.remove(cap)
+w = FileWriter(cap)
+Profiler.init(w)
+jf = jax.jit(ge._q6_step)
+jax.block_until_ready(jf(variants[0]))
+Profiler.start()
+for r in range(4):
+    out = jf(variants[r % 2])
+jax.block_until_ready(out)
+Profiler.stop()
+Profiler.shutdown()
+w.close()
 
-
-bench("segment_sum_128seg", segsum_small, batch)
-
-bench("cumsum_i32", lambda b: jnp.cumsum((b["price"].data < 50.0).astype(jnp.int32)), batch)
-
-bench("group_by_only", lambda b: group_by(b, ["k"], [
-    AggSpec("sum", "v", "s"), AggSpec("count", None, "c"),
-    AggSpec("mean", "price", "m")]), batch)
-
-bench("full_q6", ge._q6_step, batch)
+events = convert_profile(cap)
+dev = [e for e in events
+       if e.get("plane", "").lower().find("device") >= 0
+       or e.get("plane", "").lower().find("tpu") >= 0]
+pool = dev if dev else [e for e in events if "plane" in e]
+agg = {}
+for e in pool:
+    agg.setdefault(e["name"], [0.0, 0])
+    agg[e["name"]][0] += e["dur_us"]
+    agg[e["name"]][1] += 1
+print(f"\ncapture: {cap} ({len(events)} events, {len(dev)} device-plane)",
+      flush=True)
+print("top events by total us:")
+for name, (us, cnt) in sorted(agg.items(), key=lambda kv: -kv[1][0])[:20]:
+    print(f"  {us:10.1f} us  x{cnt:<5d} {name[:80]}")
