@@ -73,6 +73,14 @@ _register("q6_group_path", "onehot", str,
 _register("q6_onehot_engine", "xla", str,
           "Contraction engine for the q6 onehot path: 'xla' (materialized "
           "one-hot) or 'pallas' (fused VMEM one-hot kernel).")
+_register("group_sort_payload", "gather", str,
+          "How sort-scan group_by moves agg values into sorted order: "
+          "'gather' (sort only [keys..., row-id], then one take() per agg "
+          "column — fewest sort operands) or 'ride' (agg words ride the "
+          "sort as payload operands — no post-sort gathers).  The "
+          "emulated-64-bit multi-operand sort measured ~1s/iter at 256K "
+          "rows on v5e (round 3), so 'gather' is the default; 'ride' is "
+          "kept for A/B.")
 _register("q6_float_mode", "f32x3", str,
           "Float-sum mode for the q6 onehot path: 'f32x3' (exact Dekker "
           "split, MXU-native, order-nondeterministic rounding) or 'f64' "

@@ -392,13 +392,21 @@ def _as_columns(columns: Columns):
     """Expand top-level structs into their children (the reference's JNI
     layer decomposes structs before the kernel — HashTest struct tests
     assert struct hash == hashing the leaves in order).  A null struct row
-    nulls its children, so the fold skips them (seed passes through)."""
+    nulls its children, so the fold skips them (seed passes through).
+    Bucketed string members of a MULTI-column row hash are merged back to
+    one flat column first: the fold threads a per-row running hash
+    through every column, which per-bucket evaluation can't reproduce
+    (the single-column fast paths stay bucketed — they dispatch before
+    this)."""
+    from ..columnar.bucketed import BucketedStringColumn
     from ..columnar.column import StructColumn
 
     cols = columns.columns if isinstance(columns, ColumnBatch) else list(columns)
     out = []
 
     def expand(c, parent_valid=None):
+        if isinstance(c, BucketedStringColumn):
+            c = c.merge()
         if isinstance(c, StructColumn):
             v = c.validity if parent_valid is None else (c.validity & parent_valid)
             for child in c.children:

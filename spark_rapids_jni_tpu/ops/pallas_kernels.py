@@ -99,12 +99,12 @@ def _murmur3_i64_call(lo, hi, valid, seed, interpret):
         out_shape=jax.ShapeDtypeStruct((rows, LANES), jnp.uint32),
         grid=(grid,),
         in_specs=[
-            pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0)),
-            pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0)),
-            pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0)),
+            pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, jnp.int32(0))),
+            pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, jnp.int32(0))),
+            pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, jnp.int32(0))),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
-        out_specs=pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0)),
+        out_specs=pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, jnp.int32(0))),
         interpret=interpret,
     )(lo2, hi2, va2, seed)
     return out.reshape(-1)[:n]
@@ -228,13 +228,13 @@ def _xxh_i64_call(lo, hi, valid, seed_pair, interpret):
                    jax.ShapeDtypeStruct((rows, LANES), jnp.uint32)),
         grid=(grid,),
         in_specs=[
-            pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0)),
-            pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0)),
-            pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0)),
+            pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, jnp.int32(0))),
+            pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, jnp.int32(0))),
+            pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, jnp.int32(0))),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
-        out_specs=(pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0)),
-                   pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0))),
+        out_specs=(pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, jnp.int32(0))),
+                   pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, jnp.int32(0)))),
         interpret=interpret,
     )(lo2, hi2, va2, seed_pair)
     return out_lo.reshape(-1)[:n], out_hi.reshape(-1)[:n]
@@ -336,12 +336,12 @@ def murmur3_string(col, seed: int = 42,
         out_shape=jax.ShapeDtypeStruct((1, npad), jnp.uint32),
         grid=(grid,),
         in_specs=[
-            pl.BlockSpec((W, LANES), lambda i: (0, i)),
-            pl.BlockSpec((1, LANES), lambda i: (0, i)),
-            pl.BlockSpec((1, LANES), lambda i: (0, i)),
+            pl.BlockSpec((W, LANES), lambda i: (jnp.int32(0), i)),
+            pl.BlockSpec((1, LANES), lambda i: (jnp.int32(0), i)),
+            pl.BlockSpec((1, LANES), lambda i: (jnp.int32(0), i)),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
-        out_specs=pl.BlockSpec((1, LANES), lambda i: (0, i)),
+        out_specs=pl.BlockSpec((1, LANES), lambda i: (jnp.int32(0), i)),
         interpret=_auto_interpret(interpret),
     )(
         words_t,
@@ -517,13 +517,13 @@ def xxhash64_string(col, seed: int = 42,
                    jax.ShapeDtypeStruct((1, npad), jnp.uint32)),
         grid=(grid,),
         in_specs=[
-            pl.BlockSpec((W, LANES), lambda i: (0, i)),
-            pl.BlockSpec((1, LANES), lambda i: (0, i)),
-            pl.BlockSpec((1, LANES), lambda i: (0, i)),
+            pl.BlockSpec((W, LANES), lambda i: (jnp.int32(0), i)),
+            pl.BlockSpec((1, LANES), lambda i: (jnp.int32(0), i)),
+            pl.BlockSpec((1, LANES), lambda i: (jnp.int32(0), i)),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
-        out_specs=(pl.BlockSpec((1, LANES), lambda i: (0, i)),
-                   pl.BlockSpec((1, LANES), lambda i: (0, i))),
+        out_specs=(pl.BlockSpec((1, LANES), lambda i: (jnp.int32(0), i)),
+                   pl.BlockSpec((1, LANES), lambda i: (jnp.int32(0), i))),
         interpret=_auto_interpret(interpret),
     )(
         words_t,
@@ -606,9 +606,9 @@ def _onehot_gb_call(bucket, pi, pf, domain, interpret):
     mi, mf = pi.shape[1], pf.shape[1]
     grid = (KP // LANES, npad // GB_ROWS)
     row_spec = lambda mcols: pl.BlockSpec(  # noqa: E731
-        (GB_ROWS, mcols), lambda j, i: (i, 0))
+        (GB_ROWS, mcols), lambda j, i: (i, jnp.int32(0)))
     out_spec = lambda mcols: pl.BlockSpec(  # noqa: E731
-        (LANES, mcols), lambda j, i: (j, 0))
+        (LANES, mcols), lambda j, i: (j, jnp.int32(0)))
     if mf == 0:  # int-only aggregations skip the float pass entirely
         oi = pl.pallas_call(
             _onehot_gb_kernel_int,

@@ -12,7 +12,11 @@ Three designs were measured on the real chip this round:
   operands so no full-width random gather is needed afterwards either.
 
 Pipeline: lower keys to uint32 radix words (:mod:`keys`, equality domain)
--> one ``lax.sort`` carrying [keys..., row-id, agg-value words...] ->
+-> one ``lax.sort`` carrying [keys..., row-id] (agg values are gathered
+along the permutation afterwards by default; config
+``group_sort_payload='ride'`` makes them ride the sort as extra payload
+operands instead — round 3 measured the wide emulated-64-bit sort at
+~1s/iter @256K rows on v5e, so narrow-sort+gather is the default) ->
 adjacent-compare boundaries on the sorted key words -> per-agg prefix
 ``cumsum`` (or segmented min/max ``associative_scan``) -> group result =
 scan value at each group's last row minus the previous group's, fetched
@@ -131,7 +135,6 @@ def group_by(
         ]
     iota = jnp.arange(n, dtype=jnp.int32)
 
-    # agg columns ride the sort as payload words (no post-sort gathers)
     agg_cols = []
     for spec in aggs:
         if spec.column is not None and spec.column not in agg_cols:
@@ -141,14 +144,26 @@ def group_by(
                     f"{spec.op} over {col.dtype!r} groups not implemented yet"
                 )
             agg_cols.append(spec.column)
-    # agg data rides the sort in its native dtype (the TPU X64-rewrite
-    # pass legalizes 64-bit sort payloads but not u32-pair bitcasts)
+    # Two ways to move agg values into sorted order (config
+    # ``group_sort_payload``).  'ride': values ride the sort as payload
+    # operands — no post-sort gathers, but every 64-bit operand is an
+    # emulated u32 pair inside the TPU sort network, and the multi-operand
+    # sort measured ~1s/iter at 256K rows on v5e (round 3).  'gather':
+    # sort carries only [keys..., row-id]; each agg column is fetched
+    # afterwards with one take() along the permutation (linear passes,
+    # ~24ms per 2M-row gather measured round 2).
+    from .. import config as _config
+
+    ride = _config.get("group_sort_payload") == "ride"
     payload = [iota]
     spans = {}
-    for name in agg_cols:
-        col = batch[name]
-        spans[name] = len(payload)
-        payload.extend([col.data, col.validity])
+    if ride:
+        # agg data rides the sort in its native dtype (the TPU X64-rewrite
+        # pass legalizes 64-bit sort payloads but not u32-pair bitcasts)
+        for name in agg_cols:
+            col = batch[name]
+            spans[name] = len(payload)
+            payload.extend([col.data, col.validity])
 
     nk = len(karr)
     res = jax.lax.sort(tuple(karr) + tuple(payload), num_keys=nk,
@@ -188,10 +203,14 @@ def group_by(
         out[name] = gather_column(batch[name], rows0, out_valid)
 
     def sorted_col(name):
-        off = spans[name]
-        data = spay[off - 1]  # payload[0] is iota (== sperm)
-        valid = spay[off] & sorted_occ
-        return data, valid
+        if ride:
+            off = spans[name]
+            data = spay[off - 1]  # payload[0] is iota (== sperm)
+            valid = spay[off] & sorted_occ
+            return data, valid
+        col = batch[name]
+        return (jnp.take(col.data, sperm),
+                jnp.take(col.validity, sperm) & sorted_occ)
 
     for spec in aggs:
         if spec.op == "count":
@@ -399,25 +418,33 @@ def group_by_onehot(
         Fp = F if F is not None else jnp.zeros((n, 0), jnp.float32)
         part, fpart = onehot_groupby_parts(bucket_pl, X8, Fp, K + 1)
     else:
-        oh = ((bucket[:, None]
-               == jnp.arange(K + 1, dtype=jnp.int32)[None, :])
-              & row_live[:, None]).astype(jnp.int8)
-        # ONE chunked int8 contraction.  int32 partials hold |x| <= 128
-        # summed over a block, so blocks stay under 2^31/128 = 2^24 rows;
-        # static n means static slices, combined in int64.
+        # Chunked contractions with the one-hot built PER CHUNK: int32
+        # partials hold |x| <= 128 summed over a block, so blocks stay
+        # under 2^31/128 = 2^24 rows — and only one [B, K+1] one-hot is
+        # ever live (a full-width [n, K+1] float one-hot is multi-GB at
+        # bench row counts; the f64-emulated contraction of one OOM'd
+        # real v5e HBM at 16M rows in round 3).  Static n means static
+        # slices, combined in int64/float64 across chunks.
         B = 1 << 23
+        kids = jnp.arange(K + 1, dtype=jnp.int32)[None, :]
+        fdt = jnp.float32 if use_f32x3 else jnp.float64
         part = jnp.zeros((K + 1, X8.shape[1]), jnp.int64)
+        fpart = (jnp.zeros((K + 1, F.shape[1]), jnp.float64)
+                 if float_cols else None)
         for lo in range(0, n, B):
+            ohc = ((bucket[lo:lo + B, None] == kids)
+                   & row_live[lo:lo + B, None])
             part = part + jax.lax.dot_general(
-                oh[lo:lo + B].T, X8[lo:lo + B], (((1,), (0,)), ((), ())),
+                ohc.astype(jnp.int8).T, X8[lo:lo + B],
+                (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.int32,
             ).astype(jnp.int64)
-        if float_cols:
-            fdt = jnp.float32 if use_f32x3 else jnp.float64
-            fpart = jax.lax.dot_general(
-                oh.astype(fdt).T, F, (((1,), (0,)), ((), ())),
-                preferred_element_type=fdt,
-            ).astype(jnp.float64)
+            if float_cols:
+                fpart = fpart + jax.lax.dot_general(
+                    ohc.astype(fdt).T, F[lo:lo + B],
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=fdt,
+                ).astype(jnp.float64)
 
     fsum_of = {}
     for i, c in enumerate(float_cols):

@@ -96,6 +96,22 @@ class TestBucketedJson:
             got = fn([b]).to_pylist()
             assert got == want, fn.__name__
 
+    def test_multi_column_row_hash_with_bucketed_member(self):
+        """A bucketed string inside a MULTI-column row hash merges to flat
+        first (the fold threads a per-row running hash, which per-bucket
+        evaluation cannot reproduce) — must equal the all-flat result."""
+        from spark_rapids_jni_tpu.columnar import types as T
+        from spark_rapids_jni_tpu.columnar.column import Column
+        from spark_rapids_jni_tpu.ops import hashing
+
+        vals = ["a", None, "hello-world", "x" * 200, "bc"]
+        flat = StringColumn.from_pylist(vals, max_len=256)
+        b = BucketedStringColumn.from_pylist(vals)
+        ic = Column.from_pylist([1, 2, 3, None, 5], T.INT64)
+        for fn in (hashing.murmur_hash3_32, hashing.xxhash64):
+            assert fn([b, ic]).to_pylist() == fn([flat, ic]).to_pylist(), \
+                fn.__name__
+
     def test_bucketed_scan_width_tracks_bucket(self):
         from spark_rapids_jni_tpu.ops.get_json_object import get_json_object
 

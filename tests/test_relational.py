@@ -938,3 +938,41 @@ class TestLagLead:
         got = [x for _, x in sorted(zip(res["o"].to_pylist(),
                                         res["lg"].to_pylist()))]
         assert got == [None, 7, None]
+
+
+class TestGroupSortPayloadModes:
+    """The two agg-movement strategies (config ``group_sort_payload``)
+    must be bit-identical; 'gather' is the v5e-measured default, 'ride'
+    is kept for A/B (see aggregate.py docstring / round-3 notes)."""
+
+    def test_ride_equals_gather(self):
+        from spark_rapids_jni_tpu import config
+
+        rng = np.random.default_rng(5)
+        n = 4096
+        b = ColumnBatch({
+            "k": Column.from_pylist(
+                [None if x == 0 else int(x) for x in
+                 rng.integers(0, 37, n)], T.INT32),
+            "v": Column.from_pylist(
+                [None if x % 11 == 0 else int(x) for x in
+                 rng.integers(-(10**12), 10**12, n)], T.INT64),
+            "f": Column.from_pylist(
+                [None if x % 7 == 0 else float(x) for x in
+                 rng.integers(-1000, 1000, n)], T.FLOAT64),
+        })
+        aggs = [AggSpec("sum", "v", "s"), AggSpec("count", "v", "c"),
+                AggSpec("min", "f", "lo"), AggSpec("max", "f", "hi"),
+                AggSpec("mean", "f", "m")]
+        rv = jnp.asarray(rng.random(n) < 0.9)
+        results = {}
+        for mode in ("gather", "ride"):
+            config.set("group_sort_payload", mode)
+            try:
+                out, ng = group_by(b, ["k"], aggs, row_valid=rv)
+            finally:
+                config.reset("group_sort_payload")
+            results[mode] = (int(ng), {
+                name: out[name].to_pylist()[: int(ng)]
+                for name in ("k", "s", "c", "lo", "hi", "m")})
+        assert results["ride"] == results["gather"]

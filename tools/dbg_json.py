@@ -1,4 +1,5 @@
 """Debug driver: device get_json_object vs oracle on non-wildcard goldens."""
+import _bootstrap  # noqa: F401  (repo root on sys.path)
 import jax
 
 jax.config.update("jax_platforms", "cpu")

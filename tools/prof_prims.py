@@ -5,6 +5,7 @@ same buffers returns in ~30us), so every rep must vary its input — each
 benchmarked fn takes a `salt` scalar folded into the data — and consume
 the result via a small reduction.
 """
+import _bootstrap  # noqa: F401  (repo root on sys.path)
 import time
 
 import jax

@@ -5,6 +5,7 @@ executions, and block_until_ready alone under-reports.  So: dispatch K
 executions with K DISTINCT inputs, then device_get ALL results once; the
 slope (T(K2)-T(K1))/(K2-K1) is the true per-execution device time.
 """
+import _bootstrap  # noqa: F401  (repo root on sys.path)
 import time
 
 import jax

@@ -7,6 +7,7 @@ spill -> block -> split -> succeed at a smaller size.
 Usage (needs the axon tunnel up; single client only):
     python tools/real_oom_tpu.py
 """
+import _bootstrap  # noqa: F401  (repo root on sys.path)
 
 import sys
 
