@@ -194,10 +194,12 @@ def child_main():
         # extrapolate from the GROSS rate when devgen subtracted a
         # generation baseline (the net rate can be much higher than what
         # the wall clock pays per execution); devgen compiles TWO fresh
-        # shapes (gen + step) at ~40s each, non-devgen one
+        # shapes (gen + step, ~40s each) and runs 2x(REPS+1) executions,
+        # non-devgen one shape and REPS+1
         base_mrows = devgen_note.get(n_small, {}).get("gross_mrows", mrows)
+        execs = (2 if use_devgen else 1) * (REPS + 1)
         compile_s = 100.0 if use_devgen else 60.0
-        est = ((n_full / (base_mrows * 1e6)) * 2 * (REPS + 1) + compile_s
+        est = ((n_full / (base_mrows * 1e6)) * execs + compile_s
                + 3 * n_full / 5e6)
         left = deadline_s - (time.monotonic() - t_start)
         if est < left:
@@ -244,13 +246,27 @@ def micro_main():
 
     skipped = []
 
-    def run(name, jfn, variants, n, unit="Mrows/s", reps=10):
+    def over():
         # Self-enforced deadline: the child must EXIT before the parent's
         # graceful-kill window closes — a SIGKILLed accelerator client
         # mid-RPC wedges the single axon tunnel slot (this exact path
         # caused the 01:20 wedge on 2026-07-31).  Reserve ~45s for one
-        # fresh-shape TPU compile + measurement.
-        if time.monotonic() - t_start > deadline_s - 45:
+        # fresh-shape TPU compile + measurement.  Checked both in run()
+        # AND between the construction blocks below: building variants is
+        # itself host generation + tunnel transfer work.
+        return time.monotonic() - t_start > deadline_s - 45
+
+    def finish():
+        if skipped:
+            print(f"# deadline: skipped {len(skipped)} entries: "
+                  f"{', '.join(skipped)}", file=sys.stderr, flush=True)
+        # lines were emitted as they were measured; only signal
+        # retry-on-CPU if NOTHING was measured
+        return 18 if not results or all("error" in r for r in results) \
+            else 0
+
+    def run(name, jfn, variants, n, unit="Mrows/s", reps=10):
+        if over():
             skipped.append(name)
             return
         print(f"# measuring {name}", file=sys.stderr, flush=True)
@@ -273,6 +289,10 @@ def micro_main():
     run("murmur3_int64", jax.jit(lambda c: hashing.murmur_hash3_32([c])), vals, n)
     run("xxhash64_int64", jax.jit(lambda c: hashing.xxhash64([c])), vals, n)
 
+    if over():
+        skipped.append("<remaining suite>")
+        return finish()
+
     # string→float over padded numeric strings
     scs = [
         (StringColumn.from_pylist(
@@ -285,6 +305,10 @@ def micro_main():
         scs,
         1 << 18,
     )
+
+    if over():
+        skipped.append("<remaining suite>")
+        return finish()
 
     # bloom build + probe (1M-bit filter)
     items = [
@@ -305,6 +329,10 @@ def micro_main():
         n,
     )
 
+    if over():
+        skipped.append("<remaining suite>")
+        return finish()
+
     # row conversion (8 int64 cols → JCUDF rows)
     m = 1 << 16
     mones = jnp.ones((m,), jnp.bool_)
@@ -324,6 +352,10 @@ def micro_main():
         cbs,
         m,
     )
+
+    if over():
+        skipped.append("<remaining suite>")
+        return finish()
 
     # pallas variants of the hash kernels (native on TPU)
     from spark_rapids_jni_tpu.ops import pallas_kernels
@@ -351,6 +383,10 @@ def micro_main():
     run("xxhash64_string_pallas",
         jax.jit(lambda c: pallas_kernels.xxhash64_string(c)), strs, 1 << 18)
 
+    if over():
+        skipped.append("<remaining suite>")
+        return finish()
+
     # get_json_object (mirrors GET_JSON_OBJECT_BENCH)
     from spark_rapids_jni_tpu.ops.get_json_object import get_json_object
 
@@ -372,6 +408,10 @@ def micro_main():
         reps=4,
     )
 
+    if over():
+        skipped.append("<remaining suite>")
+        return finish()
+
     # mixed lengths with a 1% long tail: flat pads EVERY row to the
     # outlier width; bucketed scans each width bucket separately
     from spark_rapids_jni_tpu.columnar import BucketedStringColumn
@@ -392,6 +432,10 @@ def micro_main():
         jax.jit(lambda c: get_json_object(c, "$.owner")), mbuck, m_json,
         reps=2)
 
+    if over():
+        skipped.append("<remaining suite>")
+        return finish()
+
     # parse_uri (mirrors PARSE_URI_BENCH)
     from spark_rapids_jni_tpu.ops.parse_uri import parse_uri
 
@@ -405,6 +449,10 @@ def micro_main():
         for k in range(V)]
     run("parse_uri_host", jax.jit(lambda c: parse_uri(c, "HOST")), ucols,
         m_uri, reps=4)
+
+    if over():
+        skipped.append("<remaining suite>")
+        return finish()
 
     # group-by (100 keys, sum+count) — mirrors the q6 aggregate stage
     from spark_rapids_jni_tpu.relational import AggSpec, group_by
@@ -429,6 +477,37 @@ def micro_main():
         m,
     )
 
+    if over():
+        skipped.append("<remaining suite>")
+        return finish()
+
+    # decimal128 group sum (exact 256-bit segmented sums — the TPC
+    # revenue-aggregate shape; see relational/aggregate.py)
+    from spark_rapids_jni_tpu.columnar.column import Decimal128Column as _D
+
+    def _dec_gb(seed):
+        r = np.random.default_rng(seed)
+        limbs = np.zeros((m, 2), np.uint64)
+        limbs[:, 0] = r.integers(0, 1 << 50, m, dtype=np.uint64)
+        return ColumnBatch({
+            "k": Column(jnp.asarray(r.integers(0, 100, m).astype(np.int32)),
+                        mones, T.INT32),
+            "d": _D(jnp.asarray(limbs), mones,
+                    T.SparkType.decimal(38, 2)),
+        })
+
+    run(
+        "group_by_decimal_sum",
+        jax.jit(lambda b: group_by(b, ["k"],
+                                   [AggSpec("sum", "d", "s")])[0]["s"].limbs),
+        [(_dec_gb(70 + k),) for k in range(V)],
+        m,
+    )
+
+    if over():
+        skipped.append("<remaining suite>")
+        return finish()
+
     # the other BASELINE.md query shapes: q3 (join), q67 (window),
     # and the string/regex-heavy config (#4)
     import __graft_entry__ as ge
@@ -441,6 +520,10 @@ def micro_main():
     q95in = [ge._q95_batches(nq, seed=19 + k) for k in range(V)]
     run("q95_shape_2exch_2join_agg", jax.jit(ge._q95_step), q95in, nq,
         reps=4)
+
+    if over():
+        skipped.append("<remaining suite>")
+        return finish()
 
     # decimal128 multiply (the DecimalUtils hot op; 128-bit limb math)
     from spark_rapids_jni_tpu.columnar.column import Decimal128Column
@@ -464,12 +547,7 @@ def micro_main():
     qsin = [(ge._qstr_batch(ns, seed=17 + k),) for k in range(V)]
     run("qstr_string_heavy", jax.jit(ge._qstr_step), qsin, ns, reps=4)
 
-    if skipped:
-        print(f"# deadline: skipped {len(skipped)} kernels: "
-              f"{', '.join(skipped)}", file=sys.stderr, flush=True)
-    # lines were emitted as they were measured; only signal retry-on-CPU
-    # if NOTHING was measured
-    return 18 if not results or all("error" in r for r in results) else 0
+    return finish()
 
 
 # --------------------------------------------------------------------------

@@ -542,7 +542,11 @@ def xxhash64_string(col, seed: int = 42,
 # fused one-hot group-by contraction (the q6 aggregation hot loop)
 # ---------------------------------------------------------------------------
 
-GB_ROWS = 1024  # rows per grid step; [GB_ROWS, 128] int8 onehot = 128KB VMEM
+# rows per grid step: the one-hot tile is [GB_ROWS, 128] int8 (1MB VMEM at
+# 8192) and each step DMAs [GB_ROWS, mi+mf] of payload — at 1024 rows that
+# was an ~11KB int-payload read per step (16K steps at 16M rows, grid
+# overhead dominant); 8192 keeps well under VMEM while cutting steps 8x
+GB_ROWS = 8192
 
 
 def _onehot_tile(bucket_ref, kblock):

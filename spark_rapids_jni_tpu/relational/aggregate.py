@@ -107,6 +107,20 @@ def _seg_scan_sum(vals, boundary):
     return out
 
 
+def _seg_scan_sum256(vals, boundary):
+    """Segmented running 256-bit sum over uint32[n, 8] limb rows
+    (decimal128 group sums; limb add from :mod:`ops.decimal`)."""
+    from ..ops import decimal as D
+
+    def comb(a, b):
+        av, ab = a
+        bv, bb = b
+        return jnp.where(bb[:, None], bv, D._add(av, bv)), ab | bb
+
+    out, _ = jax.lax.associative_scan(comb, (vals, boundary))
+    return out
+
+
 def group_by(
     batch: ColumnBatch,
     key_names: Sequence[str],
@@ -137,13 +151,19 @@ def group_by(
 
     agg_cols = []
     for spec in aggs:
-        if spec.column is not None and spec.column not in agg_cols:
+        if spec.column is not None:
             col = batch[spec.column]
-            if isinstance(col, (StringColumn, Decimal128Column)):
+            if isinstance(col, StringColumn):
                 raise NotImplementedError(
                     f"{spec.op} over {col.dtype!r} groups not implemented yet"
                 )
-            agg_cols.append(spec.column)
+            if isinstance(col, Decimal128Column) and spec.op not in (
+                    "sum", "count"):
+                raise NotImplementedError(
+                    f"{spec.op} over decimal groups not implemented yet "
+                    "(sum/count are)")
+            if spec.column not in agg_cols:
+                agg_cols.append(spec.column)
     # Two ways to move agg values into sorted order (config
     # ``group_sort_payload``).  'ride': values ride the sort as payload
     # operands — no post-sort gathers, but every 64-bit operand is an
@@ -159,9 +179,13 @@ def group_by(
     spans = {}
     if ride:
         # agg data rides the sort in its native dtype (the TPU X64-rewrite
-        # pass legalizes 64-bit sort payloads but not u32-pair bitcasts)
+        # pass legalizes 64-bit sort payloads but not u32-pair bitcasts).
+        # Decimal128 limbs are [n, 2] and cannot be sort operands — those
+        # columns always gather along the permutation instead.
         for name in agg_cols:
             col = batch[name]
+            if isinstance(col, Decimal128Column):
+                continue
             spans[name] = len(payload)
             payload.extend([col.data, col.validity])
 
@@ -202,25 +226,53 @@ def group_by(
     for name in key_names:
         out[name] = gather_column(batch[name], rows0, out_valid)
 
+    def sorted_valid(name):
+        return jnp.take(batch[name].validity, sperm) & sorted_occ
+
     def sorted_col(name):
-        if ride:
+        if ride and name in spans:
             off = spans[name]
             data = spay[off - 1]  # payload[0] is iota (== sperm)
             valid = spay[off] & sorted_occ
             return data, valid
         col = batch[name]
-        return (jnp.take(col.data, sperm),
-                jnp.take(col.validity, sperm) & sorted_occ)
+        return jnp.take(col.data, sperm), sorted_valid(name)
 
     for spec in aggs:
         if spec.op == "count":
             if spec.column is None:
                 ones = sorted_occ.astype(jnp.int64)
             else:
-                _, valid = sorted_col(spec.column)
-                ones = valid.astype(jnp.int64)
+                ones = sorted_valid(spec.column).astype(jnp.int64)
             out[spec.out_name] = Column(at_ends_diff(jnp.cumsum(ones)),
                                         out_valid, T.INT64)
+            continue
+
+        if isinstance(batch[spec.column], Decimal128Column):
+            # sum(decimal128): exact 256-bit segmented sum over sorted
+            # runs (values sign-extend to uint32[n,8]; a 2^31-row group of
+            # |v|<2^127 stays < 2^158, so the scan never wraps), then
+            # Spark's sum type decimal(min(38, p+10), s) with overflow ->
+            # null (non-ANSI nullOnOverflow; reference DecimalUtils adds
+            # are per-element — group sums live above cudf in the plugin,
+            # so semantics follow Spark's Sum expression)
+            from ..ops import decimal as D
+
+            dcol = batch[spec.column]
+            svalid = sorted_valid(spec.column)
+            u = D._from_i128(jnp.take(dcol.limbs, sperm, axis=0))
+            u = jnp.where(svalid[:, None], u, jnp.zeros((), jnp.uint32))
+            run = _seg_scan_sum256(u, boundary)
+            s256 = jnp.take(run, ends, axis=0)
+            out_p = min(38, dcol.dtype.precision + 10)
+            mag, _ = D._abs(s256)
+            overflow = ~D._lt_u(mag, jnp.broadcast_to(D._pow10(out_p),
+                                                      mag.shape))
+            nn_d = at_ends_diff(jnp.cumsum(svalid.astype(jnp.int32)))
+            out[spec.out_name] = Decimal128Column(
+                D._to_i128(s256),
+                out_valid & (nn_d > 0) & ~overflow,
+                T.SparkType.decimal(out_p, dcol.dtype.scale))
             continue
 
         data, valid = sorted_col(spec.column)
@@ -344,7 +396,7 @@ def group_by_onehot(
     # int8 slots: [0]=ones(count*), then per referenced column one valid
     # flag, then 8 byte limbs per integer sum column
     is_float = {}
-    int_cols, float_cols = [], []
+    int_cols, float_cols, dec_cols = [], [], []
     valid_slot = {}
     for spec in aggs:
         if spec.op not in ("sum", "mean", "count"):
@@ -353,6 +405,16 @@ def group_by_onehot(
         if spec.column is None:
             continue
         c = spec.column
+        if isinstance(batch[c], Decimal128Column):
+            if spec.op not in ("sum", "count"):
+                raise NotImplementedError(
+                    f"group_by_onehot: {spec.op} over decimal groups not "
+                    "implemented (sum/count are)")
+            valid_slot.setdefault(c, 0)
+            is_float[c] = False
+            if spec.op == "sum" and c not in dec_cols:
+                dec_cols.append(c)
+            continue
         valid_slot.setdefault(c, 0)  # slot index assigned below
         if spec.op in ("sum", "mean"):
             fl = batch[c].dtype.kind in (T.Kind.FLOAT32, T.Kind.FLOAT64)
@@ -378,6 +440,27 @@ def group_by_onehot(
                       jnp.int16(0)).astype(jnp.int8)
         limb_slot[c] = len(cols8)
         cols8.extend(x[:, j] for j in range(8))
+    # decimal128 sum columns: 16 byte limbs of the two's-complement
+    # unscaled value + one negative-flag slot (the signed sum is the
+    # unsigned-representation sum minus 2^128 x #negatives — unlike the
+    # int64 path that correction does NOT wrap away, since decimal
+    # overflow is judged exactly against 10^precision)
+    dec_slot = {}
+    for c in dec_cols:
+        vcol = batch[c]
+        vvalid = vcol.validity & row_live
+        limbs = jnp.where(vvalid[:, None], vcol.limbs,
+                          jnp.zeros((), jnp.uint64))
+        bytes16 = jax.lax.bitcast_convert_type(
+            limbs, jnp.uint8).reshape(n, 16)
+        x = jnp.where(vvalid[:, None],
+                      bytes16.astype(jnp.int16) - jnp.int16(128),
+                      jnp.int16(0)).astype(jnp.int8)
+        neg = (vvalid
+               & ((limbs[:, 1] >> jnp.uint64(63)) != 0)).astype(jnp.int8)
+        dec_slot[c] = len(cols8)
+        cols8.extend(x[:, j] for j in range(16))
+        cols8.append(neg)
     X8 = jnp.stack(cols8, axis=1)  # [n, m8]
 
     def dekker_limbs(c):
@@ -468,6 +551,52 @@ def group_by_onehot(
             << shifts, axis=1)
         isum_of[c] = jax.lax.bitcast_convert_type(total_u, jnp.int64)
 
+    # ---- exact decimal128 sums: 256-bit rebuild with sign correction --
+    # sum = (Σ_j true_limb_j · 256^j) − 2^128 · #negatives, carried out in
+    # uint32[K+1, 8] limbs (≤ 2^158 for 2^31 rows — never wraps); overflow
+    # vs 10^min(38, p+10) nulls the group (Spark non-ANSI Sum)
+    dsum_of, dover_of = {}, {}
+    if dec_cols:
+        from ..ops import decimal as D
+
+        m32 = jnp.uint64(0xFFFFFFFF)
+        KP1 = K + 1
+        for c in dec_cols:
+            s = dec_slot[c]
+            true_limb = jax.lax.bitcast_convert_type(
+                part[:, s:s + 16]
+                + jnp.int64(128) * cnt_of[c][:, None], jnp.uint64)
+            # lane accumulators stay uint64 (each < 2^41 + carries);
+            # every byte sum j lands at bit 8j = 32·(j//4) + 8·(j%4)
+            lanes = [jnp.zeros((KP1,), jnp.uint64) for _ in range(9)]
+            for j in range(16):
+                q, r = divmod(8 * j, 32)
+                slo = true_limb[:, j] & m32  # < 2^33; slo<<r fits u64
+                shi = true_limb[:, j] >> jnp.uint64(32)
+                a = slo << jnp.uint64(r)
+                b = shi << jnp.uint64(r)
+                lanes[q] = lanes[q] + (a & m32)
+                lanes[q + 1] = lanes[q + 1] + (a >> jnp.uint64(32)) \
+                    + (b & m32)
+                lanes[q + 2] = lanes[q + 2] + (b >> jnp.uint64(32))
+            carry = jnp.zeros((KP1,), jnp.uint64)
+            out32 = []
+            for i in range(8):
+                t = lanes[i] + carry
+                out32.append((t & m32).astype(jnp.uint32))
+                carry = t >> jnp.uint64(32)
+            usum = jnp.stack(out32, axis=1)
+            negcnt = part[:, s + 16]  # >= 0, < 2^31: one u32 limb at 2^128
+            sub = jnp.zeros((KP1, 8), jnp.uint32).at[:, 4].set(
+                negcnt.astype(jnp.uint32))
+            s256 = D._add(usum, D._neg(sub))
+            out_p = min(38, batch[c].dtype.precision + 10)
+            mag, _ = D._abs(s256)
+            dover_of[c] = ~D._lt_u(mag, jnp.broadcast_to(D._pow10(out_p),
+                                                         mag.shape))
+            dsum_of[c] = (D._to_i128(s256),
+                          T.SparkType.decimal(out_p, batch[c].dtype.scale))
+
     out_cols = {}
     key_valid = jnp.arange(K + 1) < K
     out_cols[key_name] = Column(
@@ -483,6 +612,11 @@ def group_by_onehot(
         if spec.op == "count":
             out_cols[spec.out_name] = Column(
                 cnt_v.astype(jnp.int64), cnt_v >= 0, T.INT64)
+            continue
+        if spec.column in dsum_of:
+            limbs128, out_t = dsum_of[spec.column]
+            out_cols[spec.out_name] = Decimal128Column(
+                limbs128, (cnt_v > 0) & ~dover_of[spec.column], out_t)
             continue
         if is_float[spec.column]:
             fsum = fsum_of[spec.column]

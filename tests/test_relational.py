@@ -976,3 +976,120 @@ class TestGroupSortPayloadModes:
                 name: out[name].to_pylist()[: int(ng)]
                 for name in ("k", "s", "c", "lo", "hi", "m")})
         assert results["ride"] == results["gather"]
+
+
+class TestGroupByDecimalSum:
+    """sum(decimal128) group aggregation: exact 256-bit segmented sums,
+    Spark result type decimal(min(38, p+10), s), overflow -> null
+    (non-ANSI Sum semantics; per-element add parity lives in
+    tests/test_decimal.py against reference DecimalUtils)."""
+
+    def _run(self, keys, vals, precision, scale, aggs=None, **kw):
+        from spark_rapids_jni_tpu.columnar.column import Decimal128Column
+
+        b = ColumnBatch({
+            "k": Column.from_pylist(keys, T.INT32),
+            "d": Decimal128Column.from_unscaled(vals, precision, scale),
+        })
+        out, ng = group_by(b, ["k"], aggs or [
+            AggSpec("sum", "d", "s"), AggSpec("count", "d", "c")], **kw)
+        n = int(ng)
+        return (out["k"].to_pylist()[:n], out["s"].to_pylist()[:n],
+                out["c"].to_pylist()[:n] if "c" in out.names else None,
+                out["s"].dtype)
+
+    def test_golden_sums_nulls_negatives(self):
+        keys = [1, 2, 1, None, 2, 1, 3]
+        vals = [10**20, -5, None, 7, 10**20 + 5, -(10**20), 0]
+        ks, sums, cnts, dt = self._run(keys, vals, 21, 2)
+        got = dict(zip(ks, sums))
+        assert got == {None: 7, 1: 0, 2: 10**20, 3: 0}
+        assert dict(zip(ks, cnts)) == {None: 1, 1: 2, 2: 2, 3: 1}
+        assert (dt.precision, dt.scale) == (31, 2)
+
+    def test_all_null_group_is_null(self):
+        ks, sums, _, _ = self._run([1, 1, 2], [None, None, 3], 10, 0)
+        assert dict(zip(ks, sums)) == {1: None, 2: 3}
+
+    def test_overflow_to_null_at_38(self):
+        # p=38 -> result precision stays 38; two values summing past
+        # 10^38 must null out, a group within bounds must not
+        big = 6 * 10**37
+        ks, sums, _, dt = self._run([1, 1, 2, 2], [big, big, big, -big],
+                                    38, 0)
+        assert dict(zip(ks, sums)) == {1: None, 2: 0}
+        assert dt.precision == 38
+
+    def test_row_valid_and_payload_modes(self):
+        from spark_rapids_jni_tpu import config
+
+        keys = [5, 5, 6, 6, 5]
+        vals = [100, 200, None, 400, 800]
+        rv = jnp.asarray([True, False, True, True, True])
+        res = {}
+        for mode in ("gather", "ride"):
+            config.set("group_sort_payload", mode)
+            try:
+                ks, sums, cnts, _ = self._run(keys, vals, 12, 3,
+                                              row_valid=rv)
+            finally:
+                config.reset("group_sort_payload")
+            res[mode] = (ks, sums, cnts)
+        assert res["gather"] == res["ride"]
+        ks, sums, cnts = res["gather"]
+        assert dict(zip(ks, sums)) == {5: 900, 6: 400}
+        assert dict(zip(ks, cnts)) == {5: 2, 6: 1}
+
+    def test_mean_decimal_rejected(self):
+        import pytest as _pytest
+
+        with _pytest.raises(NotImplementedError):
+            self._run([1], [1], 10, 0, aggs=[AggSpec("mean", "d", "m")])
+
+    def test_onehot_decimal_sum_matches_sort_path(self):
+        from spark_rapids_jni_tpu.columnar.column import Decimal128Column
+        from spark_rapids_jni_tpu.relational.aggregate import group_by_onehot
+
+        rng = np.random.default_rng(11)
+        n = 1000
+        keys = [int(x) for x in rng.integers(0, 7, n)]
+        vals = [None if x % 13 == 0 else int(x) * 10**18 - 5 * 10**17
+                for x in rng.integers(-50, 50, n)]
+        b = ColumnBatch({
+            "k": Column.from_pylist(keys, T.INT32),
+            "d": Decimal128Column.from_unscaled(vals, 25, 4),
+        })
+        aggs = [AggSpec("sum", "d", "s"), AggSpec("count", "d", "c")]
+        want, ngw = group_by(b, ["k"], aggs)
+        nw = int(ngw)
+        want_map = dict(zip(want["k"].to_pylist()[:nw],
+                            want["s"].to_pylist()[:nw]))
+        for engine in ("xla", "pallas"):
+            got, ng, overflow = group_by_onehot(b, "k", aggs, 7,
+                                                engine=engine)
+            assert not bool(overflow)
+            m = int(ng)
+            got_map = dict(zip(got["k"].to_pylist()[:m],
+                               got["s"].to_pylist()[:m]))
+            assert got_map == want_map, engine
+            assert got["s"].dtype.precision == 35
+            assert dict(zip(got["k"].to_pylist()[:m],
+                            got["c"].to_pylist()[:m])) == dict(
+                zip(want["k"].to_pylist()[:nw],
+                    want["c"].to_pylist()[:nw]))
+
+    def test_onehot_decimal_overflow_group_nulls(self):
+        from spark_rapids_jni_tpu.columnar.column import Decimal128Column
+        from spark_rapids_jni_tpu.relational.aggregate import group_by_onehot
+
+        big = 6 * 10**37
+        b = ColumnBatch({
+            "k": Column.from_pylist([0, 0, 1, 1], T.INT32),
+            "d": Decimal128Column.from_unscaled([big, big, big, -big],
+                                                38, 0),
+        })
+        got, ng, overflow = group_by_onehot(
+            b, "k", [AggSpec("sum", "d", "s")], 2)
+        assert not bool(overflow) and int(ng) == 2
+        m = dict(zip(got["k"].to_pylist()[:2], got["s"].to_pylist()[:2]))
+        assert m == {0: None, 1: 0}
