@@ -177,6 +177,22 @@ def _divmod_u(num, den) -> Tuple[jax.Array, jax.Array]:
     return q, r
 
 
+def _divmod_u_small(u, den) -> Tuple[jax.Array, jax.Array]:
+    """Unsigned 256-bit / u32 long division -> (quotient, remainder).
+
+    ``den``: uint64[n], 0 < den < 2^32.  Schoolbook base-2^32 from the top
+    limb — 8 u64 divmods instead of :func:`_divmod_u`'s 256 shift-subtract
+    steps (group-average divides by a row count, always a small divisor).
+    """
+    rem = jnp.zeros(u.shape[:1], jnp.uint64)
+    qs = []
+    for i in range(7, -1, -1):
+        cur = (rem << jnp.uint64(32)) | u[:, i].astype(jnp.uint64)
+        qs.append((cur // den).astype(jnp.uint32))
+        rem = cur % den
+    return jnp.stack(qs[::-1], axis=1), rem
+
+
 def _precision10(u_abs) -> jax.Array:
     """Smallest i with 10^i >= |value| (reference precision10)."""
     table = jnp.asarray(_POW10_NP)  # [77, 8]

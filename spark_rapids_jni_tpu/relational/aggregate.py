@@ -121,6 +121,61 @@ def _seg_scan_sum256(vals, boundary):
     return out
 
 
+def _dec128_lt(alo, ahi, blo, bhi):
+    """Signed 128-bit a < b over (lo u64, hi u64) pairs."""
+    ah = jax.lax.bitcast_convert_type(ahi, jnp.int64)
+    bh = jax.lax.bitcast_convert_type(bhi, jnp.int64)
+    return (ah < bh) | ((ah == bh) & (alo < blo))
+
+
+def _seg_scan_minmax128(lo, hi, boundary, op):
+    """Segmented running signed-128 min/max over (lo, hi) u64 limb pairs."""
+    def comb(a, b):
+        alo, ahi, ab = a
+        blo, bhi, bb = b
+        if op == "min":
+            pick_b = _dec128_lt(blo, bhi, alo, ahi)
+        else:
+            pick_b = _dec128_lt(alo, ahi, blo, bhi)
+        pick_b = pick_b | bb
+        return (jnp.where(pick_b, blo, alo), jnp.where(pick_b, bhi, ahi),
+                ab | bb)
+
+    olo, ohi, _ = jax.lax.associative_scan(comb, (lo, hi, boundary))
+    return olo, ohi
+
+
+def _average_decimal_type(p: int, s: int):
+    """Spark ``Average`` over DecimalType(p, s): ``DecimalType.bounded(
+    p+4, s+4)`` — a plain clamp of precision AND scale to 38 (bounded
+    does NOT apply adjustPrecisionScale's integral-digit trade; avg of
+    decimal(38, 10) is decimal(38, 14) in Spark)."""
+    return min(p + 4, 38), min(s + 4, 38)
+
+
+def _decimal_avg(s256, cnt, in_dtype):
+    """Group average from exact 256-bit sums: rescale to the result scale,
+    divide by the count with HALF_UP, overflow -> invalid.
+
+    Returns (limbs128, ok_mask, result_dtype); rows with cnt == 0 divide
+    by a masked 1 — callers AND ``ok`` with their has-any mask.
+    """
+    from ..ops import decimal as D
+
+    p_res, s_res = _average_decimal_type(in_dtype.precision, in_dtype.scale)
+    d = s_res - in_dtype.scale  # >= 0 by the bounded rules
+    scaled = D._mul(s256, jnp.broadcast_to(D._pow10(d), s256.shape)) \
+        if d else s256
+    mag, neg = D._abs(scaled)
+    den = jnp.maximum(cnt, 1).astype(jnp.uint64)
+    q, rem = D._divmod_u_small(mag, den)
+    q = D._add_small(q, ((rem * 2) >= den).astype(jnp.int32))  # HALF_UP
+    ok = D._lt_u(q, jnp.broadcast_to(D._pow10(p_res), q.shape))
+    signed = jnp.where(neg[:, None], D._neg(q), q)
+    return (D._to_i128(signed), ok,
+            T.SparkType.decimal(p_res, s_res))
+
+
 def group_by(
     batch: ColumnBatch,
     key_names: Sequence[str],
@@ -157,11 +212,6 @@ def group_by(
                 raise NotImplementedError(
                     f"{spec.op} over {col.dtype!r} groups not implemented yet"
                 )
-            if isinstance(col, Decimal128Column) and spec.op not in (
-                    "sum", "count"):
-                raise NotImplementedError(
-                    f"{spec.op} over decimal groups not implemented yet "
-                    "(sum/count are)")
             if spec.column not in agg_cols:
                 agg_cols.append(spec.column)
     # Two ways to move agg values into sorted order (config
@@ -249,29 +299,53 @@ def group_by(
             continue
 
         if isinstance(batch[spec.column], Decimal128Column):
-            # sum(decimal128): exact 256-bit segmented sum over sorted
-            # runs (values sign-extend to uint32[n,8]; a 2^31-row group of
-            # |v|<2^127 stays < 2^158, so the scan never wraps), then
-            # Spark's sum type decimal(min(38, p+10), s) with overflow ->
-            # null (non-ANSI nullOnOverflow; reference DecimalUtils adds
-            # are per-element — group sums live above cudf in the plugin,
-            # so semantics follow Spark's Sum expression)
+            # Decimal128 aggregation over sorted runs.  sum/mean: exact
+            # 256-bit segmented sums (values sign-extend to uint32[n,8]; a
+            # 2^31-row group of |v|<2^127 stays < 2^158, never wraps) —
+            # sum gets Spark's decimal(min(38, p+10), s) with overflow ->
+            # null, mean divides by the count per Average's bounded(p+4,
+            # s+4) HALF_UP.  min/max: signed-128 segmented scans on the
+            # raw limb pairs.  (Non-ANSI nullOnOverflow; reference
+            # DecimalUtils ops are per-element — group aggregation lives
+            # above cudf in the plugin, so semantics follow Spark's
+            # aggregate expressions.)
             from ..ops import decimal as D
 
             dcol = batch[spec.column]
             svalid = sorted_valid(spec.column)
-            u = D._from_i128(jnp.take(dcol.limbs, sperm, axis=0))
+            slimbs = jnp.take(dcol.limbs, sperm, axis=0)
+            nn_d = at_ends_diff(jnp.cumsum(svalid.astype(jnp.int32)))
+            has_any_d = out_valid & (nn_d > 0)
+            if spec.op in ("min", "max"):
+                if spec.op == "min":  # fill nulls with +max signed 128
+                    flo = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+                    fhi = jnp.uint64(0x7FFFFFFFFFFFFFFF)
+                else:                 # fill with -min signed 128
+                    flo = jnp.uint64(0)
+                    fhi = jnp.uint64(0x8000000000000000)
+                lo = jnp.where(svalid, slimbs[:, 0], flo)
+                hi = jnp.where(svalid, slimbs[:, 1], fhi)
+                rlo, rhi = _seg_scan_minmax128(lo, hi, boundary, spec.op)
+                out[spec.out_name] = Decimal128Column(
+                    jnp.stack([jnp.take(rlo, ends),
+                               jnp.take(rhi, ends)], axis=1),
+                    has_any_d, dcol.dtype)
+                continue
+            u = D._from_i128(slimbs)
             u = jnp.where(svalid[:, None], u, jnp.zeros((), jnp.uint32))
             run = _seg_scan_sum256(u, boundary)
             s256 = jnp.take(run, ends, axis=0)
+            if spec.op == "mean":
+                limbs128, ok, out_t = _decimal_avg(s256, nn_d, dcol.dtype)
+                out[spec.out_name] = Decimal128Column(
+                    limbs128, has_any_d & ok, out_t)
+                continue
             out_p = min(38, dcol.dtype.precision + 10)
             mag, _ = D._abs(s256)
             overflow = ~D._lt_u(mag, jnp.broadcast_to(D._pow10(out_p),
                                                       mag.shape))
-            nn_d = at_ends_diff(jnp.cumsum(svalid.astype(jnp.int32)))
             out[spec.out_name] = Decimal128Column(
-                D._to_i128(s256),
-                out_valid & (nn_d > 0) & ~overflow,
+                D._to_i128(s256), has_any_d & ~overflow,
                 T.SparkType.decimal(out_p, dcol.dtype.scale))
             continue
 
@@ -406,13 +480,13 @@ def group_by_onehot(
             continue
         c = spec.column
         if isinstance(batch[c], Decimal128Column):
-            if spec.op not in ("sum", "count"):
+            if spec.op not in ("sum", "count", "mean"):
                 raise NotImplementedError(
-                    f"group_by_onehot: {spec.op} over decimal groups not "
-                    "implemented (sum/count are)")
+                    f"group_by_onehot: {spec.op} over decimal groups "
+                    "stays on the sort-scan path")
             valid_slot.setdefault(c, 0)
             is_float[c] = False
-            if spec.op == "sum" and c not in dec_cols:
+            if spec.op in ("sum", "mean") and c not in dec_cols:
                 dec_cols.append(c)
             continue
         valid_slot.setdefault(c, 0)  # slot index assigned below
@@ -555,7 +629,7 @@ def group_by_onehot(
     # sum = (Σ_j true_limb_j · 256^j) − 2^128 · #negatives, carried out in
     # uint32[K+1, 8] limbs (≤ 2^158 for 2^31 rows — never wraps); overflow
     # vs 10^min(38, p+10) nulls the group (Spark non-ANSI Sum)
-    dsum_of, dover_of = {}, {}
+    dsum_of, dover_of, draw_of = {}, {}, {}
     if dec_cols:
         from ..ops import decimal as D
 
@@ -596,6 +670,7 @@ def group_by_onehot(
                                                          mag.shape))
             dsum_of[c] = (D._to_i128(s256),
                           T.SparkType.decimal(out_p, batch[c].dtype.scale))
+            draw_of[c] = s256
 
     out_cols = {}
     key_valid = jnp.arange(K + 1) < K
@@ -614,9 +689,15 @@ def group_by_onehot(
                 cnt_v.astype(jnp.int64), cnt_v >= 0, T.INT64)
             continue
         if spec.column in dsum_of:
-            limbs128, out_t = dsum_of[spec.column]
-            out_cols[spec.out_name] = Decimal128Column(
-                limbs128, (cnt_v > 0) & ~dover_of[spec.column], out_t)
+            if spec.op == "mean":
+                limbs128, ok, out_t = _decimal_avg(
+                    draw_of[spec.column], cnt_v, batch[spec.column].dtype)
+                out_cols[spec.out_name] = Decimal128Column(
+                    limbs128, (cnt_v > 0) & ok, out_t)
+            else:
+                limbs128, out_t = dsum_of[spec.column]
+                out_cols[spec.out_name] = Decimal128Column(
+                    limbs128, (cnt_v > 0) & ~dover_of[spec.column], out_t)
             continue
         if is_float[spec.column]:
             fsum = fsum_of[spec.column]

@@ -1040,12 +1040,6 @@ class TestGroupByDecimalSum:
         assert dict(zip(ks, sums)) == {5: 900, 6: 400}
         assert dict(zip(ks, cnts)) == {5: 2, 6: 1}
 
-    def test_mean_decimal_rejected(self):
-        import pytest as _pytest
-
-        with _pytest.raises(NotImplementedError):
-            self._run([1], [1], 10, 0, aggs=[AggSpec("mean", "d", "m")])
-
     def test_onehot_decimal_sum_matches_sort_path(self):
         from spark_rapids_jni_tpu.columnar.column import Decimal128Column
         from spark_rapids_jni_tpu.relational.aggregate import group_by_onehot
@@ -1093,3 +1087,71 @@ class TestGroupByDecimalSum:
         assert not bool(overflow) and int(ng) == 2
         m = dict(zip(got["k"].to_pylist()[:2], got["s"].to_pylist()[:2]))
         assert m == {0: None, 1: 0}
+
+    def test_mean_min_max_decimal(self):
+        """avg: Spark Average bounded(p+4, s+4) with HALF_UP; min/max:
+        signed-128 comparisons.  Goldens from python Decimal."""
+        keys = [1, 1, 1, 2, 2, 3, 3]
+        # scale 0, precision 5
+        vals = [0, 1, 1, -7, None, 10**4, -(10**4)]
+        ks, outs, _, dt = self._run(
+            keys, vals, 5, 0,
+            aggs=[AggSpec("mean", "d", "s")])
+        got = dict(zip(ks, outs))
+        # avg type decimal(9, 4): unscaled at scale 4
+        assert (dt.precision, dt.scale) == (9, 4)
+        assert got == {1: 6667,          # 2/3 = 0.6667 HALF_UP
+                       2: -70000,        # -7.0000
+                       3: 0}
+        ks, mins, _, mdt = self._run(keys, vals, 5, 0,
+                                     aggs=[AggSpec("min", "d", "s")])
+        assert dict(zip(ks, mins)) == {1: 0, 2: -7, 3: -(10**4)}
+        assert (mdt.precision, mdt.scale) == (5, 0)
+        ks, maxs, _, _ = self._run(keys, vals, 5, 0,
+                                   aggs=[AggSpec("max", "d", "s")])
+        assert dict(zip(ks, maxs)) == {1: 1, 2: -7, 3: 10**4}
+
+    def test_mean_decimal_p38_bounded_clamp(self):
+        # p=38 -> Average type is DecimalType.bounded(p+4, s+4): a plain
+        # clamp of BOTH fields to 38 (no adjustPrecisionScale trade);
+        # s=2 gives decimal(38, 6), s=10 gives decimal(38, 14)
+        ks, outs, _, dt = self._run(
+            [9, 9], [123456, 100], 38, 2,
+            aggs=[AggSpec("mean", "d", "s")])
+        assert (dt.precision, dt.scale) == (38, 6)
+        # (1234.56 + 1.00)/2 = 617.78 -> unscaled at scale 6
+        assert outs == [617780000]
+        ks, outs, _, dt = self._run(
+            [9, 9, 9], [2, 0, 0], 38, 10,
+            aggs=[AggSpec("mean", "d", "s")])
+        assert (dt.precision, dt.scale) == (38, 14)
+        # (2e-10 + 0 + 0)/3 at scale 14 = 0.666... e-10 -> 6667 HALF_UP
+        assert outs == [6667]
+
+    def test_onehot_decimal_mean_matches_sort_path(self):
+        from spark_rapids_jni_tpu.columnar.column import Decimal128Column
+        from spark_rapids_jni_tpu.relational.aggregate import group_by_onehot
+
+        rng = np.random.default_rng(23)
+        n = 500
+        keys = [int(x) for x in rng.integers(0, 5, n)]
+        vals = [None if x % 17 == 0 else int(x)
+                for x in rng.integers(-(10**10), 10**10, n)]
+        b = ColumnBatch({
+            "k": Column.from_pylist(keys, T.INT32),
+            "d": Decimal128Column.from_unscaled(vals, 20, 3),
+        })
+        aggs = [AggSpec("mean", "d", "m")]
+        want, ngw = group_by(b, ["k"], aggs)
+        nw = int(ngw)
+        want_map = dict(zip(want["k"].to_pylist()[:nw],
+                            want["m"].to_pylist()[:nw]))
+        for engine in ("xla", "pallas"):
+            got, ng, overflow = group_by_onehot(b, "k", aggs, 5,
+                                                engine=engine)
+            assert not bool(overflow)
+            m = int(ng)
+            assert dict(zip(got["k"].to_pylist()[:m],
+                            got["m"].to_pylist()[:m])) == want_map, engine
+            assert got["m"].dtype.precision == 24
+            assert got["m"].dtype.scale == 7
