@@ -542,11 +542,14 @@ def xxhash64_string(col, seed: int = 42,
 # fused one-hot group-by contraction (the q6 aggregation hot loop)
 # ---------------------------------------------------------------------------
 
-# rows per grid step: the one-hot tile is [GB_ROWS, 128] int8 (1MB VMEM at
-# 8192) and each step DMAs [GB_ROWS, mi+mf] of payload — at 1024 rows that
-# was an ~11KB int-payload read per step (16K steps at 16M rows, grid
-# overhead dominant); 8192 keeps well under VMEM while cutting steps 8x
-GB_ROWS = 8192
+# rows per grid step.  At 1024 rows the ~11KB int-payload DMA per step was
+# grid-overhead dominated (16K steps at 16M rows); at 8192 the step's
+# scoped VMEM — one-hot tile as int8 (1MB) AND f32 (4MB), the lanes iota
+# (4MB), payload windows, all double-buffered — hit 21.24M against the
+# 16M scoped-vmem limit on real v5e (Mosaic stack OOM, session r3b).
+# 4096 halves the scaling terms (~10.6M) while keeping steps 4x fewer
+# than the 1024 tiling.
+GB_ROWS = 4096
 
 
 def _onehot_tile(bucket_ref, kblock):
