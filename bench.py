@@ -120,6 +120,14 @@ def child_main():
     is_accel = platform != "cpu"
     n_full = int(os.environ.get("BENCH_N_ROWS", 0)) or config.get(
         "bench_rows_tpu" if is_accel else "bench_rows_cpu")
+    if not is_accel and (config.get("q6_group_path") != "onehot"
+                        or config.get("q6_onehot_engine")
+                        not in ("auto", "scatter")):
+        # bench_rows_cpu=1M is sized for the scatter engine (~35ms/iter);
+        # the sort/onehot/pallas engines are seconds per iteration on
+        # XLA-CPU — an A/B override falling back to CPU must not blow the
+        # driver window (the BENCH_r02 failure mode)
+        n_full = min(n_full, 1 << 18)
     jfn = jax.jit(ge._q6_step)
 
     # Device-side generation (default on accelerators): host-built
@@ -471,6 +479,23 @@ def micro_main():
         jax.jit(
             lambda b: group_by(
                 b, ["k"], [AggSpec("sum", "v", "s"), AggSpec("count", None, "c")]
+            )
+        ),
+        gbs,
+        m,
+    )
+
+    # same shape on the domain-key engine (auto: scatter on CPU, MXU
+    # one-hot on accelerators) — the q6 fast path vs the general engine
+    from spark_rapids_jni_tpu.relational.aggregate import group_by_onehot
+
+    run(
+        "group_by_100keys_domain",
+        jax.jit(
+            lambda b: group_by_onehot(
+                b, "k", [AggSpec("sum", "v", "s"),
+                         AggSpec("count", None, "c")], 100,
+                engine="auto",
             )
         ),
         gbs,

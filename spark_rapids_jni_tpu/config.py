@@ -60,9 +60,11 @@ _register("bench_rows", 1 << 21, int,
 _register("bench_rows_tpu", 1 << 24, int,
           "Full-size row count for the q6 bench on an accelerator; "
           "amortizes the ~63ms per-execution tunnel round-trip.")
-_register("bench_rows_cpu", 1 << 18, int,
+_register("bench_rows_cpu", 1 << 20, int,
           "Full-size row count for the q6 bench on the CPU fallback "
-          "(round 2's 2M-row CPU fallback blew the driver window).")
+          "(round 2's 2M-row CPU fallback blew the driver window; the "
+          "round-4 scatter engine runs 1M rows in ~35ms, so the refine "
+          "step fits the budget comfortably).")
 _register("use_pallas_hashes", False, _parse_bool,
           "Route murmur3/xxhash64 int64 fast paths through the Pallas "
           "kernels instead of the jnp formulations.")
@@ -70,9 +72,12 @@ _register("q6_group_path", "onehot", str,
           "Aggregation path for the q6 flagship bench: 'onehot' (MXU "
           "one-hot matmul, group_by_onehot with the bench's static key "
           "domain) or 'sort' (sort-scan group_by, the general engine).")
-_register("q6_onehot_engine", "xla", str,
-          "Contraction engine for the q6 onehot path: 'xla' (materialized "
-          "one-hot) or 'pallas' (fused VMEM one-hot kernel).")
+_register("q6_onehot_engine", "auto", str,
+          "Engine for the q6 domain-key aggregation: 'auto' (scatter on "
+          "CPU, xla on accelerators — measured both ways round 4), 'xla' "
+          "(materialized one-hot contraction), 'pallas' (fused VMEM "
+          "one-hot kernel), or 'scatter' (linear segment sums; fast on "
+          "CPU, 2 orders slow on TPU v5e).")
 _register("group_sort_payload", "gather", str,
           "How sort-scan group_by moves agg values into sorted order: "
           "'gather' (sort only [keys..., row-id], then one take() per agg "

@@ -578,9 +578,6 @@ class TestGroupByOnehot:
                 AggSpec("mean", "p", "m")]
         rv = None if row_valid is None else jnp.asarray(row_valid)
         res_a, ng_a = group_by(batch, ["k"], aggs, row_valid=rv)
-        res_b, ng_b, ovf = group_by_onehot(batch, "k", aggs, domain,
-                                           row_valid=rv)
-        assert not bool(ovf)
 
         def groups(res, ng):
             out = {}
@@ -592,19 +589,25 @@ class TestGroupByOnehot:
                 out[ks[i]] = (ss[i], cs[i], ms[i])
             return out
 
-        ga, gb = groups(res_a, ng_a), groups(res_b, ng_b)
-        assert set(ga) == set(gb)
-        for key in ga:
-            sa, ca, ma = ga[key]
-            sb, cb, mb = gb[key]
-            assert sa == sb, (key, sa, sb)
-            assert ca == cb
-            if ma is None:
-                assert mb is None
-            else:
-                import math
+        ga = groups(res_a, ng_a)
+        for engine in ("xla", "scatter"):
+            res_b, ng_b, ovf = group_by_onehot(batch, "k", aggs, domain,
+                                               row_valid=rv, engine=engine)
+            assert not bool(ovf)
+            gb = groups(res_b, ng_b)
+            assert set(ga) == set(gb), engine
+            for key in ga:
+                sa, ca, ma = ga[key]
+                sb, cb, mb = gb[key]
+                assert sa == sb, (engine, key, sa, sb)
+                assert ca == cb
+                if ma is None:
+                    assert mb is None
+                else:
+                    import math
 
-                assert math.isclose(ma, mb, rel_tol=1e-12), (key, ma, mb)
+                    assert math.isclose(ma, mb, rel_tol=1e-12), \
+                        (engine, key, ma, mb)
 
     def test_basic(self):
         import numpy as np
@@ -1058,7 +1061,7 @@ class TestGroupByDecimalSum:
         nw = int(ngw)
         want_map = dict(zip(want["k"].to_pylist()[:nw],
                             want["s"].to_pylist()[:nw]))
-        for engine in ("xla", "pallas"):
+        for engine in ("xla", "pallas", "scatter"):
             got, ng, overflow = group_by_onehot(b, "k", aggs, 7,
                                                 engine=engine)
             assert not bool(overflow)
@@ -1146,7 +1149,7 @@ class TestGroupByDecimalSum:
         nw = int(ngw)
         want_map = dict(zip(want["k"].to_pylist()[:nw],
                             want["m"].to_pylist()[:nw]))
-        for engine in ("xla", "pallas"):
+        for engine in ("xla", "pallas", "scatter"):
             got, ng, overflow = group_by_onehot(b, "k", aggs, 5,
                                                 engine=engine)
             assert not bool(overflow)

@@ -1,51 +1,49 @@
 #!/usr/bin/env bash
-# One-shot TPU measurement session (run when the axon tunnel is alive):
-# the full A/B matrix for the round-3 perf design, then the micro suite,
-# a profiler capture, and the real-HBM OOM drill.  Never run two TPU
-# clients at once (BASELINE.md); every stage uses bench.py's bounded
-# budget or its own timeout.
+# One-shot TPU measurement session (run when the axon tunnel is alive).
+# ORDER IS PRIORITY ORDER: round 3's session wedged mid-way (an
+# over-budget child), so the irreplaceable evidence comes FIRST —
+# 1) honest q6 headline with the fixed no-dedupe protocol,
+# 2) kernel-level profiler capture (VERDICT round-2 item 8),
+# 3) real-HBM OOM drill (item 3's hardware leg),
+# then the A/B matrix and micro suite, which are merely informative.
+# Never run two TPU clients at once (BASELINE.md); every stage uses
+# bench.py's bounded budget or its own SIGTERM timeout.
 # Config env overrides use the SPARK_RAPIDS_TPU_<KEY> registry prefix.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
 stamp() { date +%H:%M:%S; }
 
-echo "== [$(stamp)] q6 default: onehot-xla f32x3 @16M"
+echo "== [$(stamp)] 1. q6 headline (default engines, fixed protocol)"
 python bench.py
 
-echo "== [$(stamp)] q6 onehot-pallas (fused VMEM one-hot)"
+echo "== [$(stamp)] 2. q6 profiler capture (xplane, kernel-level)"
+timeout --signal=TERM 300 python tools/prof_q6.py || true
+
+echo "== [$(stamp)] 3. real-HBM OOM drill (retry ladder on genuine OOM)"
+timeout --signal=TERM 300 python tools/real_oom_tpu.py || true
+
+echo "== [$(stamp)] 4. q6 onehot-pallas (fused VMEM one-hot)"
 SPARK_RAPIDS_TPU_Q6_ONEHOT_ENGINE=pallas python bench.py
 
-echo "== [$(stamp)] q6 onehot-xla f64 floats (rounding-compatible mode)"
+echo "== [$(stamp)] 5. q6 engine A/B: f64 floats / sort-scan / scatter"
 SPARK_RAPIDS_TPU_Q6_FLOAT_MODE=f64 python bench.py
-
-echo "== [$(stamp)] q6 sort-scan engine (the general path)"
 SPARK_RAPIDS_TPU_Q6_GROUP_PATH=sort python bench.py
+SPARK_RAPIDS_TPU_Q6_ONEHOT_ENGINE=scatter python bench.py
 
-echo "== [$(stamp)] q6 rows sweep: dispatch-latency amortization curve"
+echo "== [$(stamp)] 6. q6 rows sweep: dispatch-latency amortization curve"
 for rows in 2097152 8388608 33554432; do
   echo "-- rows=$rows"
   BENCH_N_ROWS=$rows python bench.py
 done
 
-echo "== [$(stamp)] json unroll A/B (flagship micro only runs once; use"
-echo "   SPARK_RAPIDS_TPU_JSON_SCAN_UNROLL to compare 1 vs 8)"
+echo "== [$(stamp)] 7. full micro suite"
+BENCH_TOTAL_BUDGET_S=600 python bench.py --micro
+
+echo "== [$(stamp)] 8. json unroll A/B"
 SPARK_RAPIDS_TPU_JSON_SCAN_UNROLL=1 BENCH_TOTAL_BUDGET_S=300 \
   python bench.py --micro 2>/dev/null | grep -E "get_json|qstr" || true
 SPARK_RAPIDS_TPU_JSON_SCAN_UNROLL=8 BENCH_TOTAL_BUDGET_S=300 \
   python bench.py --micro 2>/dev/null | grep -E "get_json|qstr" || true
-
-echo "== [$(stamp)] pallas hash routing on"
-SPARK_RAPIDS_TPU_USE_PALLAS_HASHES=1 python bench.py --micro \
-  2>/dev/null | grep -E "murmur|xxhash" || true
-
-echo "== [$(stamp)] full micro suite"
-BENCH_TOTAL_BUDGET_S=600 python bench.py --micro
-
-echo "== [$(stamp)] q6 profiler capture (xplane, kernel-level)"
-timeout --signal=TERM 300 python tools/prof_q6.py || true
-
-echo "== [$(stamp)] real-HBM OOM drill (retry ladder on genuine OOM)"
-timeout --signal=TERM 300 python tools/real_oom_tpu.py || true
 
 echo "== [$(stamp)] done"

@@ -1,0 +1,60 @@
+"""Poll the axon TPU tunnel until it answers, then exit 0.
+
+Runs bench.py's --probe child under the same graceful-kill ladder the
+bench parent uses (SIGTERM -> grace -> SIGKILL; a hung probe on a wedged
+tunnel never held a slot, so killing it is safe — the wedge mechanism is
+killing a client mid-RPC on a LIVE tunnel, BASELINE.md).
+
+Exit 0 = tunnel alive (a measurement session may start).
+Exit 3 = gave up after --max-hours.
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def probe_once(timeout_s: int) -> bool:
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--probe"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        proc.wait(timeout=timeout_s)
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+        return False
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=300.0)
+    ap.add_argument("--probe-timeout", type=int, default=90)
+    ap.add_argument("--max-hours", type=float, default=12.0)
+    args = ap.parse_args()
+
+    deadline = time.monotonic() + args.max_hours * 3600
+    attempt = 0
+    while time.monotonic() < deadline:
+        attempt += 1
+        t0 = time.strftime("%H:%M:%S")
+        ok = probe_once(args.probe_timeout)
+        print(f"[{t0}] probe #{attempt}: {'ALIVE' if ok else 'wedged'}",
+              flush=True)
+        if ok:
+            return 0
+        time.sleep(args.interval)
+    return 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())
