@@ -40,10 +40,12 @@ done
 echo "== [$(stamp)] 7. full micro suite"
 BENCH_TOTAL_BUDGET_S=600 python bench.py --micro
 
-echo "== [$(stamp)] 8. json unroll A/B"
-SPARK_RAPIDS_TPU_JSON_SCAN_UNROLL=1 BENCH_TOTAL_BUDGET_S=300 \
+echo "== [$(stamp)] 8. json engine A/B: serial scan (fast path off;"
+echo "   the default fast-path numbers are stage 7's get_json entries)"
+SPARK_RAPIDS_TPU_JSON_FAST_PATH=0 BENCH_TOTAL_BUDGET_S=300 \
   python bench.py --micro 2>/dev/null | grep -E "get_json|qstr" || true
-SPARK_RAPIDS_TPU_JSON_SCAN_UNROLL=8 BENCH_TOTAL_BUDGET_S=300 \
+SPARK_RAPIDS_TPU_JSON_FAST_PATH=0 SPARK_RAPIDS_TPU_JSON_SCAN_UNROLL=1 \
+  BENCH_TOTAL_BUDGET_S=300 \
   python bench.py --micro 2>/dev/null | grep -E "get_json|qstr" || true
 
 echo "== [$(stamp)] done"
