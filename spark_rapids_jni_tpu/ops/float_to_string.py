@@ -392,6 +392,12 @@ def _digit_count(v):
     return count
 
 
+# fixed output width of double_to_json_string: _format's 26-char layout
+# ("-2.2250738585072014E-308") + 2 pad columns for the quoted specials.
+# json_fast's lax.cond skip branch must match this shape exactly.
+DOUBLE_JSON_W = 28
+
+
 def _format(digits, exp10, negative, is_nan, is_inf, is_zero):
     """Assemble Java toString chars: digits u64[n], exp10 = power of the
     LAST digit; value = digits * 10^exp10."""

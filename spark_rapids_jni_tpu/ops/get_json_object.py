@@ -1222,6 +1222,33 @@ def _run(col_chars, col_lengths, col_validity, path_tuple, max_out,
     return out_chars, jnp.where(valid, out_lens, 0), valid
 
 
+@partial(jax.jit, static_argnames=("path_tuple", "max_out", "unroll"))
+def _run_hybrid(col_chars, col_lengths, col_validity, path_tuple, max_out,
+                unroll=1):
+    """Bit-parallel fast path with scan-machine fallback.
+
+    :func:`json_fast.fast_path` evaluates wildcard-free paths over clean
+    documents in O(path + log L) data-parallel passes and flags every row
+    it cannot prove it handles; if ANY row flags, the whole batch runs
+    the general char-scan machine (one ``lax.cond`` — the scan engine
+    stays the single source of semantics).  Clean batches (the common
+    analytics case) never pay the ``max_len``-sequential-steps scan.
+    """
+    from . import json_fast
+
+    fast_c, fast_l, fast_ok, fb = json_fast.fast_path(
+        col_chars, col_lengths, col_validity, path_tuple, max_out)
+
+    def serial(_):
+        return _run(col_chars, col_lengths, col_validity, path_tuple,
+                    max_out, unroll=unroll)
+
+    def fast(_):
+        return fast_c, fast_l.astype(jnp.int32), fast_ok
+
+    return jax.lax.cond(jnp.any(fb), serial, fast, None)
+
+
 def get_json_object(
     col,
     path: Union[str, Sequence],
@@ -1257,7 +1284,10 @@ def get_json_object(
         max_out = 6 * L + 20
     from .. import config
 
-    out_chars, out_lens, valid = _run(
+    use_fast = bool(config.get("json_fast_path")) and not any(
+        i[0] == "wildcard" for i in instructions)
+    runner = _run_hybrid if use_fast else _run
+    out_chars, out_lens, valid = runner(
         col.chars, col.lengths, col.validity, tuple(instructions), max_out,
         unroll=max(1, int(config.get("json_scan_unroll"))))
     return StringColumn(out_chars, out_lens, valid)

@@ -1,0 +1,555 @@
+"""Bit-parallel fast path for ``get_json_object`` (clean-document subset).
+
+The general engine (:mod:`get_json_object`) is a char-level ``lax.scan``:
+``max_len`` *sequential* steps, each a vector over the batch.  That shape
+is latency-bound on TPU — the carry round-trips HBM every step.  This
+module re-expresses the common case as ~60 *data-parallel* passes over
+the ``[n, L]`` char matrix (the simdjson stage-1 idea, mapped to XLA):
+quote-parity prefix sums for the in-string mask, masked cumulative sums
+for nesting depth, forward-fills for grammar anchors, and a static
+unrolled walk over the (static) JSONPath — no sequential dependence on
+``L`` anywhere.
+
+Reference semantics: ``/root/reference/src/main/cpp/src/json_parser.cuh``
+(tokenizer) and ``get_json_object.cu:360-788`` (path evaluator), as
+modeled by ``tests/json_oracle.py``.
+
+**Accept-list contract.**  The fast path only keeps rows it can prove it
+handles exactly; everything else raises the per-row ``fallback`` flag and
+the caller routes the batch through the general scan machine
+(``lax.cond`` — the serial engine still defines the semantics).  A row
+falls back when any of these hold:
+
+* a backslash anywhere in the document (escapes, and the reference's
+  ``\\uXXXX`` field-name-never-matches quirk, stay on the scan machine);
+* a single-quote character anywhere (the two-quote-type automaton is not
+  a parity sum);
+* nesting depth > 16 (the owner-bracket forward-fill is per-depth);
+* any local grammar check fails (the row may be malformed: the scan
+  machine decides NULL properly — the fast path never declares NULL for
+  a doc it cannot fully validate, except provably-structural cases);
+* the matched value needs non-trivial rewriting: a float-containing or
+  ``-0``-containing container copy, control chars inside a container
+  copy, or a float token wider than the static parse window.
+
+Rows the fast path *keeps* are fully validated: every accepted document
+parses under the reference grammar (numbers, literals, separator
+placement by container kind), so emitting bytes for them is sound.
+
+Wildcard paths never enter the fast path (static routing in
+``get_json_object``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import types as T
+from ..columnar.column import StringColumn
+from . import cast_string, float_to_string
+
+MAX_FF_DEPTH = 16   # owner forward-fill depth budget; deeper rows fall back
+FLOAT_TOK_W = 48    # static float-token parse window; wider tokens fall back
+
+_U8 = jnp.uint8
+_I32 = jnp.int32
+
+
+def _c(ch: str):
+    return _U8(ord(ch))
+
+
+def _ffill_max(x, axis=1):
+    """Running maximum (forward fill of the latest index)."""
+    return jax.lax.cummax(x, axis=axis)
+
+
+def _first_true(mask, L):
+    """Index of first True per row, L if none.  mask: bool [n, L]."""
+    pos = jnp.arange(L, dtype=_I32)
+    return jnp.min(jnp.where(mask, pos[None, :], _I32(L)), axis=1)
+
+
+def _gather_cols(mat, idx):
+    """mat [n, L], idx [n] -> mat[i, idx[i]] with idx clipped."""
+    n, L = mat.shape
+    safe = jnp.clip(idx, 0, L - 1)
+    return jnp.take_along_axis(mat, safe[:, None], axis=1)[:, 0]
+
+
+# anchor kinds (token-level grammar elements)
+A_NONE = 0
+A_OBRACE = 1    # {
+A_CBRACE = 2    # }
+A_OBRK = 3      # [
+A_CBRK = 4      # ]
+A_COMMA = 5
+A_COLON = 6
+A_OPENQ = 7     # opening quote of a string
+A_CLOSEQ = 8    # closing quote of a value string
+A_FCLOSEQ = 9   # closing quote of a field-name string
+A_VEND = 10     # last char of a number/literal run
+A_START = 11    # virtual "before document" anchor
+
+
+@partial(jax.jit, static_argnames=("path_tuple", "max_out"))
+def fast_path(chars, lengths, validity, path_tuple, max_out):
+    """Evaluate a wildcard-free JSONPath over clean documents.
+
+    Returns ``(out_chars u8[n, max_out], out_lens i32[n], ok bool[n],
+    fallback bool[n])``.  ``ok`` is meaningful only where ``fallback`` is
+    False; callers must route fallback rows through the scan machine.
+    """
+    n, L = chars.shape
+    pos = jnp.arange(L, dtype=_I32)[None, :]
+    lens = lengths.astype(_I32)
+    inb = pos < lens[:, None]
+    ch = jnp.where(inb, chars, _U8(0))
+
+    fb = jnp.zeros((n,), jnp.bool_)      # fallback
+    bad = jnp.zeros((n,), jnp.bool_)     # provably NULL (structural miss)
+
+    # ---- trigger 1: characters the fast path does not model ----------
+    fb |= jnp.any(inb & ((ch == _c("\\")) | (ch == _c("'"))), axis=1)
+
+    # ---- in-string mask (double quotes only, no escapes) -------------
+    isq = ch == _c('"')
+    qpre = jnp.cumsum(isq.astype(_I32), axis=1)          # inclusive
+    open_q = isq & (qpre % 2 == 1)
+    close_q = isq & (qpre % 2 == 0)
+    content = (~isq) & ((qpre % 2) == 1) & inb           # strictly inside
+    outside = inb & ~content & ~isq
+
+    isws = (ch == _c(" ")) | (ch == _c("\t")) | (ch == _c("\n")) | (
+        ch == _c("\r"))
+    ws = outside & isws
+    punct_chars = ((ch == _c("{")) | (ch == _c("}")) | (ch == _c("[")) |
+                   (ch == _c("]")) | (ch == _c(",")) | (ch == _c(":")))
+    punct = outside & punct_chars
+    valch = outside & ~ws & ~punct_chars                 # number/literal
+
+    opens = outside & ((ch == _c("{")) | (ch == _c("[")))
+    closes = outside & ((ch == _c("}")) | (ch == _c("]")))
+    delta = opens.astype(_I32) - closes.astype(_I32)
+    depth_after = jnp.cumsum(delta, axis=1)
+    depth_before = depth_after - delta
+
+    # ---- root span ---------------------------------------------------
+    nonws = inb & ~isws
+    root_start = _first_true(nonws, L)
+    empty_doc = root_start >= lens                        # NULL, not fb
+    c0 = _gather_cols(ch, root_start)
+    root_is_container = (c0 == _c("{")) | (c0 == _c("["))
+    # matching close of the root container: first close AFTER root_start
+    # whose depth_after is 0
+    close0 = closes & (depth_after == 0) & (pos > root_start[:, None])
+    root_close = _first_true(close0, L)
+    # scalar roots end at their token end (string close / run end)
+    run_end = valch & ~jnp.concatenate(
+        [valch[:, 1:], jnp.zeros((n, 1), jnp.bool_)], axis=1)
+    str_close_after = lambda s: _first_true(  # noqa: E731
+        close_q & (pos > s[:, None]), L)
+    vend_at = lambda s: _first_true(  # noqa: E731
+        run_end & (pos >= s[:, None]), L)
+    root_end = jnp.where(
+        root_is_container, root_close,
+        jnp.where(c0 == _c('"'), str_close_after(root_start),
+                  vend_at(root_start)))
+    # a container root with no matching close, or a scalar root with no
+    # token end, may still be junk the scan machine NULLs — fall back
+    fb |= (~empty_doc) & (root_end >= L)
+    span = (pos >= root_start[:, None]) & (pos <= root_end[:, None]) & inb
+
+    # parity must close inside the root span (an unclosed string whose
+    # quote count balances later in trailing junk would corrupt masks)
+    qpre_end = _gather_cols(qpre, root_end)
+    fb |= (~empty_doc) & (qpre_end % 2 != 0)
+    # trailing junk is ignored by the reference; nothing after root_end
+    # participates in any mask below
+    depth_ok = depth_before >= 0
+    fb |= jnp.any(span & ~depth_ok, axis=1)
+    maxd = jnp.max(jnp.where(span, depth_after, 0), axis=1)
+    fb |= maxd > MAX_FF_DEPTH
+
+    # ---- owner container type per position ---------------------------
+    # owner_char_at_depth[d][j] = char of the latest open bracket with
+    # depth_after == d at or before j (the bracket owning level d)
+    neg1 = jnp.full((n, L), -1, _I32)
+    own_idx = []
+    for d in range(1, MAX_FF_DEPTH + 1):
+        cand = jnp.where(opens & span & (depth_after == d), pos, neg1)
+        own_idx.append(_ffill_max(cand))
+    # container type for a position with depth_before == d: the owner
+    # bracket char at level d (0 -> ROOT sentinel)
+    def owner_char(db, at):
+        """db: [n, L] depth_before; at: [n, L] positions; -> u8 char,
+        0 for ROOT."""
+        out = jnp.zeros((n, L), _U8)
+        for d in range(1, MAX_FF_DEPTH + 1):
+            oc = jnp.where(own_idx[d - 1] >= 0,
+                           jnp.take_along_axis(
+                               ch, jnp.clip(own_idx[d - 1], 0, L - 1),
+                               axis=1),
+                           _U8(0))
+            out = jnp.where(db == d, oc, out)
+        return out
+
+    cont = owner_char(depth_before, pos)   # container char per position
+
+    # ---- anchors and prev-anchor grammar -----------------------------
+    run_start = valch & ~jnp.concatenate(
+        [jnp.zeros((n, 1), jnp.bool_), valch[:, :-1]], axis=1)
+    kind = jnp.zeros((n, L), _I32)
+    kind = jnp.where(punct & (ch == _c("{")), A_OBRACE, kind)
+    kind = jnp.where(punct & (ch == _c("}")), A_CBRACE, kind)
+    kind = jnp.where(punct & (ch == _c("[")), A_OBRK, kind)
+    kind = jnp.where(punct & (ch == _c("]")), A_CBRK, kind)
+    kind = jnp.where(punct & (ch == _c(",")), A_COMMA, kind)
+    kind = jnp.where(punct & (ch == _c(":")), A_COLON, kind)
+    kind = jnp.where(open_q, A_OPENQ, kind)
+    kind = jnp.where(close_q, A_CLOSEQ, kind)  # field/value split below
+    kind = jnp.where(run_end, A_VEND, kind)
+    anchor = (kind != 0) & span
+
+    # prev anchor kind/char before each position (START if none)
+    aidx = jnp.where(anchor, pos, neg1)
+    prev_idx_incl = _ffill_max(aidx)                  # latest anchor <= j
+    prev_idx = jnp.concatenate(
+        [jnp.full((n, 1), -1, _I32), prev_idx_incl[:, :-1]], axis=1)
+    prev_kind = jnp.where(
+        prev_idx >= 0,
+        jnp.take_along_axis(kind, jnp.clip(prev_idx, 0, L - 1), axis=1),
+        _I32(A_START))
+
+    # field-name strings: an opening quote in an object context whose
+    # previous anchor is '{' or ',' (value strings follow ':')
+    is_fq_open = open_q & span & (cont == _c("{")) & (
+        (prev_kind == A_OBRACE) | (prev_kind == A_COMMA))
+    # propagate the field flag from each open quote to its close quote:
+    # encode (position, flag) as pos*2+flag so the running max carries the
+    # LATEST open quote's flag (a bare 0/1 cummax would let an earlier
+    # field's 1 shadow a later value string's 0)
+    fq_ff = _ffill_max(jnp.where(
+        open_q, pos * 2 + is_fq_open.astype(_I32), -1))
+    close_is_field = close_q & (fq_ff >= 0) & (fq_ff % 2 == 1)
+    kind = jnp.where(close_is_field, A_FCLOSEQ, kind)
+    prev_kind = jnp.where(
+        prev_idx >= 0,
+        jnp.take_along_axis(kind, jnp.clip(prev_idx, 0, L - 1), axis=1),
+        _I32(A_START))
+
+    is_obj = cont == _c("{")
+    is_arr = cont == _c("[")
+    is_root_ctx = cont == _U8(0)
+
+    pk = prev_kind
+    value_end_kinds = ((pk == A_CLOSEQ) | (pk == A_CBRACE) | (pk == A_CBRK)
+                       | (pk == A_VEND))
+    value_start_ok = (
+        (is_obj & (pk == A_COLON))
+        | (is_arr & ((pk == A_OBRK) | (pk == A_COMMA)))
+        | (is_root_ctx & (pk == A_START)))
+
+    rule_ok = jnp.ones((n, L), jnp.bool_)
+
+    def apply(mask, ok):
+        """AND a rule into rule_ok at masked positions (a position may be
+        subject to several rules — e.g. a digit is checked by the
+        value-start rule, the leading-zero rule, and the digit budget)."""
+        nonlocal rule_ok
+        rule_ok = jnp.where(mask & span, rule_ok & ok, rule_ok)
+
+    apply(kind == A_OBRACE, value_start_ok)
+    apply(kind == A_OBRK, value_start_ok)
+    apply(run_start, value_start_ok)
+    apply(open_q & ~is_fq_open,
+          value_start_ok | (is_obj & (pk == A_COLON)))
+    apply(kind == A_CBRACE,
+          is_obj & ((pk == A_OBRACE) | value_end_kinds))
+    apply(kind == A_CBRK,
+          is_arr & ((pk == A_OBRK) | value_end_kinds))
+    apply(kind == A_COMMA, (is_obj | is_arr) & value_end_kinds)
+    apply(kind == A_COLON, is_obj & (pk == A_FCLOSEQ))
+    # a field close-quote must be followed by ':' — equivalently no other
+    # anchor may have a field-close as its previous anchor
+    apply((kind != 0) & (kind != A_COLON) & (pk == A_FCLOSEQ),
+          jnp.zeros((n, L), jnp.bool_))
+
+    # ---- number / literal token validation ---------------------------
+    isdig = (ch >= _c("0")) & (ch <= _c("9"))
+    num_allowed = (isdig | (ch == _c("-")) | (ch == _c("+"))
+                   | (ch == _c(".")) | (ch == _c("e")) | (ch == _c("E")))
+    lit_allowed = ((ch == _c("t")) | (ch == _c("r")) | (ch == _c("u"))
+                   | (ch == _c("e")) | (ch == _c("f")) | (ch == _c("a"))
+                   | (ch == _c("l")) | (ch == _c("s")) | (ch == _c("n")))
+
+    # first char of each run, forward-filled across the run
+    rs_idx = _ffill_max(jnp.where(run_start, pos, neg1))
+    rs_char = jnp.where(rs_idx >= 0,
+                        jnp.take_along_axis(ch, jnp.clip(rs_idx, 0, L - 1),
+                                            axis=1), _U8(0))
+    is_lit_run = ((rs_char == _c("t")) | (rs_char == _c("f"))
+                  | (rs_char == _c("n")))
+    is_num_run = valch & ~is_lit_run
+    lit_run = valch & is_lit_run
+
+    apply(is_num_run, num_allowed)
+    apply(lit_run, lit_allowed)
+
+    # literal runs must be exactly true/false/null
+    def win_eq(s_idx, lit):
+        m = jnp.ones((n,), jnp.bool_)
+        for i, b in enumerate(lit):
+            m &= _gather_cols(ch, s_idx + i) == _U8(b)
+        return m
+
+    lit_start = run_start & is_lit_run & span
+    # validate every literal run via its start (vector over positions)
+    lit_len_map = {b"true": 4, b"false": 5, b"null": 4}
+    # run length at run START: find this run's end = first run_end >= start
+    # (per-position: the run end forward-filled from the right); compute
+    # via reversed ffill
+    rev = lambda x: x[:, ::-1]  # noqa: E731
+    next_end_rev = _ffill_max(rev(jnp.where(run_end, (L - 1) - pos, neg1)))
+    next_end = (L - 1) - rev(next_end_rev)  # first run_end >= j (L-1-(-1) if none)
+    run_len = jnp.where(valch, next_end - rs_idx + 1, 0)
+    for lit, ll in lit_len_map.items():
+        first = _U8(lit[0])
+        sel = lit_start & (ch == first)
+        okm = jnp.zeros((n, L), jnp.bool_)
+        for i, b in enumerate(lit):
+            at = jnp.clip(pos + i, 0, L - 1)
+            okm_i = jnp.take_along_axis(ch, at, axis=1) == _U8(b)
+            okm = okm_i if i == 0 else (okm & okm_i)
+        apply(sel, okm & (run_len == ll))
+    # literal starts with t/f/n but matching none of the three first chars
+    # is impossible (is_lit_run keyed on first char), but 't' runs not
+    # spelling "true" are caught by the window check above
+
+    # number grammar: local char rules + per-run aggregates
+    prev_ch = jnp.concatenate([jnp.zeros((n, 1), _U8), ch[:, :-1]], axis=1)
+    next_ch = jnp.concatenate([ch[:, 1:], jnp.zeros((n, 1), _U8)], axis=1)
+    prev_dig = (prev_ch >= _c("0")) & (prev_ch <= _c("9"))
+    next_dig = (next_ch >= _c("0")) & (next_ch <= _c("9"))
+    is_e = is_num_run & ((ch == _c("e")) | (ch == _c("E")))
+    nn_ch = jnp.concatenate([ch[:, 2:], jnp.zeros((n, 2), _U8)], axis=1)
+    nn_dig = (nn_ch >= _c("0")) & (nn_ch <= _c("9"))
+    apply(is_num_run & (ch == _c("-")), run_start | (
+        (prev_ch == _c("e")) | (prev_ch == _c("E"))))
+    apply(is_num_run & (ch == _c("+")),
+          (prev_ch == _c("e")) | (prev_ch == _c("E")))
+    apply(is_num_run & (ch == _c(".")), prev_dig & next_dig)
+    apply(is_e, prev_dig & (next_dig | (
+        ((next_ch == _c("+")) | (next_ch == _c("-"))) & nn_dig)))
+    # leading zero: '0' at int-part start directly followed by a digit
+    int_start = run_start | (prev_ch == _c("-")) & (rs_idx == pos - 1)
+    apply(is_num_run & (ch == _c("0")) & int_start, ~next_dig)
+    # at most one e / one dot, dot before e — per-run aggregates via
+    # cumsum differences anchored at the run start
+    cum_e = jnp.cumsum(is_e.astype(_I32), axis=1)
+    cum_d = jnp.cumsum((is_num_run & (ch == _c("."))).astype(_I32), axis=1)
+    base_e = jnp.where(rs_idx >= 0,
+                       jnp.take_along_axis(cum_e, jnp.clip(rs_idx, 0, L - 1),
+                                           axis=1), 0)
+    base_d = jnp.where(rs_idx >= 0,
+                       jnp.take_along_axis(cum_d, jnp.clip(rs_idx, 0, L - 1),
+                                           axis=1), 0)
+    e_at_start = jnp.where(
+        rs_idx >= 0, jnp.take_along_axis(
+            is_e.astype(_I32), jnp.clip(rs_idx, 0, L - 1), axis=1), 0)
+    run_e = cum_e - base_e + e_at_start
+    run_d = cum_d - base_d  # '.' can never be at run start (rule above)
+    apply(is_e, run_e <= 1)
+    apply(is_num_run & (ch == _c(".")), (run_d <= 1) & (run_e == 0))
+    # digit budget (reference: <=1000 digits).  run_len <= 1000 implies
+    # digits <= 1000 (sound accept); valid numbers of 1001-1007 chars with
+    # exactly <=1000 digits false-reject into the harmless fallback
+    apply(run_start & is_num_run, run_len <= 1000)
+
+    # any rule failure -> fall back (the scan machine decides NULL)
+    fb |= jnp.any(span & ~rule_ok, axis=1)
+
+    # ---- path navigation (static unrolled) ---------------------------
+    cs = root_start
+    alive = ~empty_doc
+    for (ptype, parg) in path_tuple:
+        ccur = _gather_cols(ch, cs)
+        cd = _gather_cols(depth_after, cs)    # depth of contents
+        # matching close of this container
+        close_m = closes & (pos > cs[:, None]) & (
+            depth_after == (cd - 1)[:, None]) & span
+        cend = _first_true(close_m, L)
+        if ptype == "named":
+            name = parg
+            k = len(name)
+            bad |= alive & (ccur != _c("{"))
+            alive &= ccur == _c("{")
+            # candidate field quotes at this level inside (cs, cend)
+            cand = (kind == A_OPENQ) & is_fq_open & (
+                depth_before == cd[:, None]) & (pos > cs[:, None]) & (
+                pos < cend[:, None])
+            m = cand
+            for i, b in enumerate(name):
+                at = jnp.clip(pos + 1 + i, 0, L - 1)
+                m &= jnp.take_along_axis(ch, at, axis=1) == _U8(b)
+            at = jnp.clip(pos + 1 + k, 0, L - 1)
+            m &= jnp.take_along_axis(ch, at, axis=1) == _c('"')
+            q0 = _first_true(m, L)
+            found = q0 < L
+            bad |= alive & ~found
+            alive &= found
+            # value start: first non-ws after the colon after q0+k+1
+            colon = _first_true(
+                (~isws) & inb & (pos > (q0 + k + 1)[:, None]), L)
+            vstart = _first_true((~isws) & inb & (pos > colon[:, None]), L)
+            # matched null at a named step -> NULL overall
+            vc = _gather_cols(ch, vstart)
+            is_null = (vc == _c("n")) & win_eq(vstart, b"null")
+            bad |= alive & is_null
+            alive &= ~is_null
+            cs = jnp.where(alive, vstart, cs)
+        else:  # ("index", i)
+            idx = int(parg)
+            bad |= alive & (ccur != _c("["))
+            alive &= ccur == _c("[")
+            first_elem = _first_true(
+                (~isws) & inb & (pos > cs[:, None]), L)
+            empty_arr = _gather_cols(ch, first_elem) == _c("]")
+            if idx == 0:
+                bad |= alive & empty_arr
+                alive &= ~empty_arr
+                cs = jnp.where(alive, first_elem, cs)
+            else:
+                commas = (kind == A_COMMA) & (
+                    depth_before == cd[:, None]) & (pos > cs[:, None]) & (
+                    pos < cend[:, None])
+                ccount = jnp.cumsum(commas.astype(_I32), axis=1)
+                target_comma = _first_true(commas & (ccount == idx), L)
+                have = target_comma < L
+                bad |= alive & ~have
+                alive &= have
+                estart = _first_true(
+                    (~isws) & inb & (pos > target_comma[:, None]), L)
+                cs = jnp.where(alive, estart, cs)
+
+    # ---- target classification & span --------------------------------
+    tc = _gather_cols(ch, cs)
+    t_is_str = tc == _c('"')
+    t_is_cont = (tc == _c("{")) | (tc == _c("["))
+    t_is_lit = (tc == _c("t")) | (tc == _c("f")) | (tc == _c("n"))
+    t_is_num = alive & ~t_is_str & ~t_is_cont & ~t_is_lit
+
+    td = _gather_cols(depth_after, cs)
+    t_close = _first_true(closes & (pos > cs[:, None]) & (
+        depth_after == (td - 1)[:, None]) & span, L)
+    t_strclose = str_close_after(cs)
+    t_vend = vend_at(cs)
+    t_end = jnp.where(t_is_cont, t_close,
+                      jnp.where(t_is_str, t_strclose, t_vend))
+
+    in_tspan = (pos >= cs[:, None]) & (pos <= t_end[:, None])
+
+    # container-copy fallback triggers: float numbers, "-0" ints,
+    # control chars inside strings (all need rewriting)
+    t_has_float = jnp.any(
+        in_tspan & is_num_run & ((ch == _c(".")) | is_e), axis=1)
+    neg0 = run_start & (ch == _c("-")) & (next_ch == _c("0")) & (run_len == 2)
+    t_has_neg0 = jnp.any(in_tspan & neg0, axis=1)
+    t_has_ctrl = jnp.any(in_tspan & content & (ch < _U8(0x20)), axis=1)
+    fb |= alive & t_is_cont & (t_has_float | t_has_neg0 | t_has_ctrl)
+
+    # scalar float target: parse-window bound
+    t_num_end = t_vend
+    t_tok_len = t_num_end - cs + 1
+    t_is_float = t_is_num & jnp.any(
+        in_tspan & is_num_run & ((ch == _c(".")) | is_e), axis=1)
+    fb |= alive & t_is_float & (t_tok_len > FLOAT_TOK_W)
+
+    # ---- materialization ---------------------------------------------
+    W = int(max_out)
+    outp = jnp.arange(W, dtype=_I32)[None, :]
+
+    # verbatim channel (string content / int / literal / container-compact)
+    # string: span (cs+1, t_strclose); int/literal: [cs, t_vend]
+    v_start = jnp.where(t_is_str, cs + 1, cs)
+    v_len = jnp.where(t_is_str, t_strclose - cs - 1,
+                      jnp.where(t_is_cont, jnp.zeros_like(cs),
+                                t_vend - cs + 1))
+    # "-0" -> "0"
+    is_neg0_t = t_is_num & (_gather_cols(ch, cs) == _c("-")) & (
+        _gather_cols(ch, cs + 1) == _c("0")) & (t_tok_len == 2)
+    v_start = jnp.where(is_neg0_t, cs + 1, v_start)
+    v_len = jnp.where(is_neg0_t, 1, v_len)
+    src = jnp.clip(v_start[:, None] + outp, 0, L - 1)
+    verb = jnp.where(outp < v_len[:, None],
+                     jnp.take_along_axis(ch, src, axis=1), _U8(0))
+
+    # container-compact channel: keep = non-ws within span (strings keep
+    # everything incl. quotes); compact via a 2-operand flag sort.  The
+    # sort only runs when some live row actually has a container target
+    # (lax.cond) — the common scalar extraction skips it entirely.
+    any_cont = jnp.any(alive & t_is_cont)
+
+    def compact_containers(_):
+        keep = in_tspan & (content | isq | (outside & ~ws))
+        flag = (~keep).astype(jnp.uint32)
+        perm = jax.lax.sort(
+            (flag, jnp.broadcast_to(pos, (n, L)).astype(_I32)),
+            num_keys=1, is_stable=True)[1]
+        packed = jnp.take_along_axis(ch, perm, axis=1)
+        return packed, jnp.sum(keep, axis=1, dtype=_I32)
+
+    packed, c_len = jax.lax.cond(
+        any_cont, compact_containers,
+        lambda _: (jnp.zeros((n, L), _U8), jnp.zeros((n,), _I32)), None)
+    if W >= L:
+        cont_out = jnp.pad(packed, ((0, 0), (0, W - L)))
+    else:
+        cont_out = packed[:, :W]
+    cont_out = jnp.where(outp < c_len[:, None], cont_out, _U8(0))
+
+    # float channel: gather the token into a static window, parse+format
+    # (Ryu) — also gated on any live float target existing
+    any_float = jnp.any(alive & t_is_float)
+
+    def format_floats(_):
+        fsrc = jnp.clip(
+            cs[:, None] + jnp.arange(FLOAT_TOK_W, dtype=_I32)[None, :],
+            0, L - 1)
+        ftok = jnp.where(
+            jnp.arange(FLOAT_TOK_W, dtype=_I32)[None, :] < t_tok_len[:, None],
+            jnp.take_along_axis(ch, fsrc, axis=1), _U8(0))
+        fcol = StringColumn(ftok, jnp.where(t_is_float, t_tok_len, 1),
+                            jnp.ones((n,), jnp.bool_))
+        fvals = cast_string.string_to_float(fcol, T.FLOAT64)
+        fbytes, flens = float_to_string.double_to_json_string(fvals.data)
+        return fbytes, flens.astype(_I32)
+
+    fbytes, flens = jax.lax.cond(
+        any_float, format_floats,
+        lambda _: (jnp.zeros((n, float_to_string.DOUBLE_JSON_W), _U8),
+                   jnp.zeros((n,), _I32)),
+        None)
+    FW = fbytes.shape[1]
+    if W >= FW:
+        float_out = jnp.pad(fbytes, ((0, 0), (0, W - FW)))
+    else:
+        float_out = fbytes[:, :W]
+    float_out = jnp.where(outp < flens[:, None], float_out, _U8(0))
+
+    use_float = t_is_float
+    use_cont = t_is_cont
+    out_chars = jnp.where(use_float[:, None], float_out,
+                          jnp.where(use_cont[:, None], cont_out, verb))
+    out_lens = jnp.where(use_float, flens,
+                         jnp.where(use_cont, c_len, v_len))
+
+    ok = alive & ~bad & validity
+    ok &= out_lens <= W   # overlong -> null (matches the scan machine)
+    out_lens = jnp.where(ok, out_lens, 0)
+    out_chars = jnp.where(ok[:, None], out_chars, _U8(0))
+    fb &= validity       # null rows never need the scan machine
+    return out_chars, out_lens, ok, fb

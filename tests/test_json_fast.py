@@ -1,0 +1,233 @@
+"""Bit-parallel get_json_object fast path (ops/json_fast.py).
+
+Contract under test (module docstring): rows the fast engine keeps must
+match the scan machine byte-for-byte; everything it cannot prove it
+handles must raise the per-row fallback flag (never a wrong answer).
+Float formatting is compared fast-vs-serial, not vs the host oracle: both
+engines share string_to_float, whose digit-limited parse can be one ulp
+off the ideal (a pre-existing, engine-independent property).
+
+Compile budget: every distinct (path, shape) pair compiles the fast
+engine (and, in the hybrid, the scan machine), so the corpus is shared
+across cases and the path list is kept short.
+"""
+
+import numpy as np
+import pytest
+
+from json_oracle import get_json_object as oracle
+from spark_rapids_jni_tpu import config
+from spark_rapids_jni_tpu.columnar.column import StringColumn
+from spark_rapids_jni_tpu.ops.get_json_object import get_json_object, parse_path
+from spark_rapids_jni_tpu.ops.json_fast import fast_path
+
+CLEAN_DOCS = [
+    '{"owner":"amy","store":{"fruit":[{"weight":8,"type":"apple"},'
+    '{"weight":9,"type":"pear"}],"basket":[1,2,3]}}',
+    '{"a": 1}',
+    '{"a": -0}',
+    '{"a": true, "b": false, "c": null}',
+    '{"a": [10, 20, 30]}',
+    '{"a": {"b": {"c": "deep"}}}',
+    '[1, 2, 3]',
+    '"just a string"',
+    '42',
+    '  {"a" : "spaced"}  trailing junk',
+    '{"a": "x", "a": "y"}',
+    '{"miss": 1}',
+    '{"a": []}',
+    '{"a": [1]}',
+    '{"": 5, "a": ""}',
+    '{"b":[[1,2],[3,4]]}',
+    'null',
+    '',
+    '   ',
+]
+
+MALFORMED_DOCS = [
+    '{"a": 01}',
+    '{"a": 1,}',
+    '{"a" 1}',
+    '{"a": [1:2]}',
+    '{"a": "x" "b": "y"}',
+    '{"a": tru}',
+    '{"a": nullx}',
+    '{"a": 1.}',
+    '{"a": .5}',
+    '{"a": 1e}',
+    '{"a": --1}',
+    '{"a": 1.2.3}',
+    '{"a": 1e2e3}',
+    '{]',
+]
+
+DIRTY_DOCS = [  # valid but outside the fast-path accept list
+    '{"a": "esc\\nape"}',
+    "{'single': 1}",
+    '{"a\\u0062c": 1}',
+]
+
+PATHS = ["$.a", "$.owner", "$.a[1]", "$[0]", "$", "$.a.b", "$.b[1][0]"]
+
+
+def _pt(path):
+    return tuple(parse_path(path))
+
+
+def _run_fast(docs, path, pad=8):
+    col = StringColumn.from_pylist(docs, pad_to_multiple=pad)
+    out_c, out_l, ok, fb = map(
+        np.asarray,
+        fast_path(col.chars, col.lengths, col.validity, _pt(path),
+                  col.max_len + 8))
+    res = []
+    for i in range(len(docs)):
+        if fb[i]:
+            res.append("<FB>")
+        elif not ok[i]:
+            res.append(None)
+        else:
+            res.append(bytes(out_c[i, :out_l[i]]).decode("utf-8", "replace"))
+    return res
+
+
+class TestFastEngineOracleParity:
+    """Rows the fast engine keeps must equal the oracle; rows it rejects
+    must raise fallback (checked per class of input)."""
+
+    @pytest.mark.parametrize("path", PATHS)
+    def test_clean_and_malformed(self, path):
+        docs = CLEAN_DOCS + MALFORMED_DOCS
+        got = _run_fast(docs, path)
+        n_handled = 0
+        for d, g in zip(docs, got):
+            if g == "<FB>":
+                continue
+            n_handled += 1
+            assert g == oracle(d, path), (path, d)
+        # the clean corpus must be predominantly fast-handled — the
+        # engine exists to keep clean analytics batches off the scan
+        assert n_handled >= len(CLEAN_DOCS) // 2, (path, n_handled)
+
+    def test_dirty_docs_always_fall_back(self):
+        got = _run_fast(DIRTY_DOCS, "$.a")
+        assert got == ["<FB>"] * len(DIRTY_DOCS)
+
+    def test_null_semantics_asymmetry(self):
+        # a null VALUE matched by a named step is NULL; a null ELEMENT
+        # matched by an index step prints "null" (reference case 4 vs 9)
+        docs = ['{"a": null}', '[null, 2]']
+        assert _run_fast(docs, "$.a")[0] is None
+        assert _run_fast(docs, "$[0]")[1] == "null"
+
+    def test_deep_nesting_falls_back(self):
+        doc = "[" * 20 + "1" + "]" * 20
+        assert _run_fast([doc], "$[0]")[0] == "<FB>"
+
+    def test_float_container_falls_back_int_container_kept(self):
+        docs = ['{"a": {"x": 1.5}}', '{"a": {"x": 15}}', '{"a": [-0]}']
+        got = _run_fast(docs, "$.a")
+        assert got[0] == "<FB>"          # float inside a container copy
+        assert got[1] == '{"x":15}'      # int container compacts fast
+        assert got[2] == "<FB>"          # "-0" inside a container copy
+
+    def test_float_scalar_matches_serial(self):
+        docs = ['{"a": 1.5}', '{"a": 1.5e2}', '{"a": 0.25}', '{"a": 1e309}',
+                '{"a": 2}', '{"a": -0.0}']
+        fast = _run_fast(docs, "$.a")
+        assert "<FB>" not in fast
+        col = StringColumn.from_pylist(docs, pad_to_multiple=8)
+        config.set("json_fast_path", False)
+        try:
+            serial = get_json_object(col, "$.a").to_pylist()
+        finally:
+            config.reset("json_fast_path")
+        assert fast == serial
+
+
+class TestHybridRouting:
+    def test_mixed_batch_falls_back_whole_batch_correctly(self):
+        # one dirty row forces the scan machine; results must equal the
+        # scan machine everywhere (cond's serial branch)
+        docs = CLEAN_DOCS + DIRTY_DOCS
+        col = StringColumn.from_pylist(docs, pad_to_multiple=8)
+        config.set("json_fast_path", True)
+        try:
+            hybrid = get_json_object(col, "$.a").to_pylist()
+        finally:
+            config.reset("json_fast_path")
+        config.set("json_fast_path", False)
+        try:
+            serial = get_json_object(col, "$.a").to_pylist()
+        finally:
+            config.reset("json_fast_path")
+        assert hybrid == serial
+
+    def test_clean_batch_stays_fast_and_matches_serial(self):
+        col = StringColumn.from_pylist(CLEAN_DOCS, pad_to_multiple=8)
+        config.set("json_fast_path", True)
+        try:
+            hybrid = get_json_object(col, "$.a").to_pylist()
+        finally:
+            config.reset("json_fast_path")
+        config.set("json_fast_path", False)
+        try:
+            serial = get_json_object(col, "$.a").to_pylist()
+        finally:
+            config.reset("json_fast_path")
+        assert hybrid == serial
+
+    def test_null_rows_do_not_force_fallback(self):
+        docs = ['{"a": 1}', None, '{"a": 2}']
+        col = StringColumn.from_pylist(docs, pad_to_multiple=8)
+        out_c, out_l, ok, fb = map(
+            np.asarray,
+            fast_path(col.chars, col.lengths, col.validity, _pt("$.a"),
+                      col.max_len + 8))
+        assert not fb.any()
+        assert list(ok) == [True, False, True]
+
+
+class TestFastEngineFuzz:
+    def test_random_corpus_parity(self):
+        """Random nested docs (ints/strings/literals only — float parity
+        is engine-vs-engine, covered above) against the oracle."""
+        import json
+        import random
+
+        rng = random.Random(7)
+        names = ["a", "b", "cc", "owner", "x"]
+
+        def rand_value(depth):
+            r = rng.random()
+            if depth >= 3 or r < 0.4:
+                return rng.choice([
+                    lambda: rng.randint(-10**6, 10**12),
+                    lambda: rng.choice([True, False, None]),
+                    lambda: "".join(rng.choice("abc XY-@#.")
+                                    for _ in range(rng.randint(0, 10))),
+                ])()
+            if r < 0.75:
+                return {rng.choice(names): rand_value(depth + 1)
+                        for _ in range(rng.randint(0, 3))}
+            return [rand_value(depth + 1) for _ in range(rng.randint(0, 3))]
+
+        docs = []
+        for _ in range(200):
+            s = json.dumps(rand_value(0))
+            if rng.random() < 0.5:
+                s = s.replace(",", " , ").replace(":", " : ")
+            docs.append(s)
+        # mutate some into likely-malformed variants
+        for i in range(0, 200, 9):
+            d = docs[i]
+            if len(d) > 3:
+                j = rng.randrange(len(d))
+                docs[i] = d[:j] + rng.choice("{},:0\"x") + d[j + 1:]
+
+        for path in ("$.a", "$.owner[0]", "$.b.x"):
+            got = _run_fast(docs, path)
+            for d, g in zip(docs, got):
+                if g == "<FB>":
+                    continue
+                assert g == oracle(d, path), (path, d)
