@@ -29,6 +29,7 @@ from .distributed import (
     data_mesh,
     distributed_group_by,
     distributed_group_by_2d,
+    distributed_group_by_domain,
     distributed_hash_join,
     distributed_hash_join_2d,
     distributed_sort,
@@ -45,6 +46,7 @@ __all__ = [
     "hierarchical_mesh",
     "distributed_group_by",
     "distributed_group_by_2d",
+    "distributed_group_by_domain",
     "distributed_hash_join",
     "distributed_hash_join_2d",
     "distributed_sort",

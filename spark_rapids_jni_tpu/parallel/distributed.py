@@ -510,6 +510,72 @@ def distributed_group_by_onehot(
     return step(batch)
 
 
+def distributed_group_by_domain(
+    batch: ColumnBatch,
+    key_name: str,
+    aggs: Sequence[AggSpec],
+    domain: int,
+    mesh: Mesh,
+    axis_name: str = "data",
+    row_valid=None,
+    engine: str = "auto",
+    float_mode: str = "f64",
+):
+    """Map-side combine: NO row shuffle at all for small-domain keys.
+
+    Each device reduces its local rows into additive ``[K+1]``-bucket
+    partials (:func:`relational.aggregate._domain_partials` — the MXU
+    one-hot contraction on TPU, segment sums on CPU), then ONE ``psum``
+    over the mesh merges them and every device finalizes the identical
+    replicated result.  The collective payload is O(domain x aggs)
+    scalars instead of the row set — for the q6 shape (2M rows/device,
+    domain 100) that is ~5 KB over ICI versus ~40 MB of all-to-all row
+    exchange, and there is no capacity planning, no skew sensitivity,
+    and no dropped-row accounting.  This is Spark's map-side combine
+    (partial aggregation before the exchange) taken to its limit: the
+    exchange degenerates into an all-reduce.
+
+    Supports sum/count/mean over int/float/decimal128 (the additive
+    ops); min/max stay on :func:`distributed_group_by`.  Returns
+    ``(result, num_groups, overflow)`` — all REPLICATED across the mesh
+    (every device holds the full group table; ``overflow`` True means
+    some key fell outside ``[0, domain)`` somewhere and the caller must
+    fall back to the shuffling path).
+    """
+    step = _group_by_domain_step(
+        mesh, axis_name, key_name, tuple(aggs), int(domain),
+        row_valid is None, engine, float_mode)
+    return step(batch) if row_valid is None else step(batch, row_valid)
+
+
+@lru_cache(maxsize=None)
+def _group_by_domain_step(mesh, axis_name, key_name, aggs, domain,
+                          all_valid, engine, float_mode):
+    from ..relational.aggregate import _domain_partials, _finalize_domain
+
+    spec = PartitionSpec(axis_name)
+    rep = PartitionSpec()
+    n_in = 1 if all_valid else 2
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(spec,) * n_in, out_specs=(rep, rep, rep),
+        check_vma=False,
+    )
+    def step(b: ColumnBatch, *rv):
+        rv = jnp.ones((b.num_rows,), jnp.bool_) if all_valid else rv[0]
+        parts, ovf = _domain_partials(
+            b, key_name, list(aggs), domain, row_valid=rv, engine=engine,
+            float_mode=float_mode)
+        parts = jax.tree_util.tree_map(
+            lambda x: jax.lax.psum(x, axis_name), parts)
+        ovf = jax.lax.psum(ovf.astype(jnp.int32), axis_name) > 0
+        res, ng = _finalize_domain(b, key_name, domain, list(aggs), parts)
+        return res, ng, ovf
+
+    return jax.jit(step)
+
+
 @lru_cache(maxsize=None)
 def _group_by_onehot_step(mesh, axis_name, key_name, aggs, domain, capacity):
     from ..relational.aggregate import group_by_onehot

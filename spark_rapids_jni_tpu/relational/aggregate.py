@@ -449,12 +449,51 @@ def group_by_onehot(
     platform: scatter on CPU, xla one-hot on accelerators (measured both
     ways round 4: segment_sum 80x faster on XLA-CPU, scatters 2 orders
     slow on v5e).
+
+    Internally this is :func:`_domain_partials` (additive per-bucket
+    partials — the map-side-combine unit that
+    :func:`parallel.distributed.distributed_group_by_domain` psum-merges
+    across a mesh) followed by :func:`_finalize_domain`.
+    """
+    parts, overflow = _domain_partials(batch, key_name, aggs, domain,
+                                       row_valid, engine, float_mode)
+    res, ng = _finalize_domain(batch, key_name, int(domain), aggs, parts)
+    return res, ng, overflow
+
+
+def _domain_partials(batch, key_name, aggs, domain, row_valid=None,
+                     engine="auto", float_mode="f64"):
+    """Additive per-bucket partial aggregates over a static key domain.
+
+    Returns ``(parts, overflow)`` where ``parts`` is a pytree of
+    psum-mergeable arrays over buckets ``[0, K]`` (bucket K = null keys):
+
+    * ``star``  int64[K+1] — count(*) rows
+    * ``cnt``   {col: int64[K+1]} — non-null counts
+    * ``isum``  {col: int64[K+1]} — integer sums (wrap mod 2^64 under
+      merging, exactly Spark's non-ANSI overflow)
+    * ``fsum``  {col: float64[K+1]} — float sums (merge-order rounding
+      sits inside Spark's shuffle nondeterminism)
+    * ``d64``   {col: uint64[K+1, 8]} — decimal128 sums as 256-bit
+      two's-complement u32 limbs widened to u64, so a psum over P
+      devices cannot carry out of a lane (P·2^32 < 2^64); the merged
+      lanes re-fold in :func:`_finalize_domain`
+
+    Every leaf is additive: element-wise sum of two devices' parts is
+    the parts of their concatenated rows.  min/max are not expressible
+    this way under psum and stay on the sort-scan path.
     """
     if engine == "auto":
         engine = "scatter" if jax.default_backend() == "cpu" else "xla"
     if engine == "scatter":
-        return group_by_scatter(batch, key_name, aggs, domain,
-                                row_valid=row_valid)
+        return _domain_partials_scatter(batch, key_name, aggs, domain,
+                                        row_valid)
+    return _domain_partials_onehot(batch, key_name, aggs, domain,
+                                   row_valid, float_mode, engine)
+
+
+def _domain_partials_onehot(batch, key_name, aggs, domain, row_valid,
+                            float_mode, engine):
     K = int(domain)
     col = batch[key_name]
     if col.dtype.kind not in (T.Kind.INT8, T.Kind.INT16, T.Kind.INT32,
@@ -633,7 +672,7 @@ def group_by_onehot(
     # sum = (Σ_j true_limb_j · 256^j) − 2^128 · #negatives, carried out in
     # uint32[K+1, 8] limbs (≤ 2^158 for 2^31 rows — never wraps); overflow
     # vs 10^min(38, p+10) nulls the group (Spark non-ANSI Sum)
-    dsum_of, dover_of, draw_of = {}, {}, {}
+    d64_of = {}
     if dec_cols:
         from ..ops import decimal as D
 
@@ -661,19 +700,11 @@ def group_by_onehot(
             negcnt = part[:, s + 16]  # >= 0, < 2^31: one u32 limb at 2^128
             sub = jnp.zeros((KP1, 8), jnp.uint32).at[:, 4].set(
                 negcnt.astype(jnp.uint32))
-            s256 = D._add(usum, D._neg(sub))
-            out_p = min(38, batch[c].dtype.precision + 10)
-            mag, _ = D._abs(s256)
-            dover_of[c] = ~D._lt_u(mag, jnp.broadcast_to(D._pow10(out_p),
-                                                         mag.shape))
-            dsum_of[c] = (D._to_i128(s256),
-                          T.SparkType.decimal(out_p, batch[c].dtype.scale))
-            draw_of[c] = s256
+            d64_of[c] = D._add(usum, D._neg(sub)).astype(jnp.uint64)
 
-    result, ng = _assemble_domain_result(
-        batch, key_name, K, aggs, counts_star, cnt_of, isum_of, fsum_of,
-        dsum_of, dover_of, draw_of)
-    return result, ng, overflow
+    parts = {"star": counts_star, "cnt": cnt_of, "isum": isum_of,
+             "fsum": fsum_of, "d64": d64_of}
+    return parts, overflow
 
 
 def _domain_bucket_overflow(col, live, K):
@@ -703,6 +734,30 @@ def _carry_fold_u64_lanes(lanes):
         out32.append((t & m32).astype(jnp.uint32))
         carry = t >> jnp.uint64(32)
     return jnp.stack(out32, axis=1)
+
+
+def _finalize_domain(batch, key_name, K, aggs, parts):
+    """Turn (possibly psum-merged) :func:`_domain_partials` into the
+    group-by result.  Decimal lanes re-fold their carries here — after
+    merging — and the overflow-vs-10^p check runs on the GLOBAL sum, so
+    a per-device overflow that cancels across devices does not null the
+    group (matching what a single-chip aggregation of the union would
+    produce)."""
+    from ..ops import decimal as D
+
+    dsum_of, dover_of, draw_of = {}, {}, {}
+    for c, d64 in parts["d64"].items():
+        s256 = _carry_fold_u64_lanes(d64)
+        out_p = min(38, batch[c].dtype.precision + 10)
+        mag, _ = D._abs(s256)
+        dover_of[c] = ~D._lt_u(mag, jnp.broadcast_to(D._pow10(out_p),
+                                                     mag.shape))
+        dsum_of[c] = (D._to_i128(s256),
+                      T.SparkType.decimal(out_p, batch[c].dtype.scale))
+        draw_of[c] = s256
+    return _assemble_domain_result(
+        batch, key_name, K, aggs, parts["star"], parts["cnt"],
+        parts["isum"], parts["fsum"], dsum_of, dover_of, draw_of)
 
 
 def _assemble_domain_result(batch, key_name, K, aggs, counts_star, cnt_of,
@@ -790,6 +845,14 @@ def group_by_scatter(
     int64 sums keep Spark's non-ANSI mod-2^64 wraparound; decimal128
     sums are exact 256-bit with overflow -> null.
     """
+    parts, overflow = _domain_partials_scatter(batch, key_name, aggs,
+                                               domain, row_valid)
+    res, ng = _finalize_domain(batch, key_name, int(domain), aggs, parts)
+    return res, ng, overflow
+
+
+def _domain_partials_scatter(batch, key_name, aggs, domain, row_valid=None):
+    """Scatter/segment-sum engine for :func:`_domain_partials`."""
     from jax.ops import segment_sum
 
     K = int(domain)
@@ -809,8 +872,7 @@ def group_by_scatter(
     counts_star = segment_sum(
         row_live.astype(jnp.int64), bucket, num_segments=K + 1)
 
-    cnt_of, isum_of, fsum_of = {}, {}, {}
-    dsum_of, dover_of, draw_of = {}, {}, {}
+    cnt_of, isum_of, fsum_of, d64_of = {}, {}, {}, {}
     for spec in aggs:
         if spec.column is None:
             continue
@@ -826,7 +888,7 @@ def group_by_scatter(
         if spec.op not in ("sum", "mean"):
             continue
         if isinstance(vcol, Decimal128Column):
-            if c in dsum_of:
+            if c in d64_of:
                 continue
             from ..ops import decimal as D
 
@@ -840,14 +902,7 @@ def group_by_scatter(
             # stays under 2^63; carry-propagate once at the end
             lanes = segment_sum(u.astype(jnp.uint64), bucket,
                                 num_segments=K + 1)  # [K+1, 8]
-            s256 = _carry_fold_u64_lanes(lanes)
-            out_p = min(38, vcol.dtype.precision + 10)
-            mag, _ = D._abs(s256)
-            dover_of[c] = ~D._lt_u(mag, jnp.broadcast_to(D._pow10(out_p),
-                                                         mag.shape))
-            dsum_of[c] = (D._to_i128(s256),
-                          T.SparkType.decimal(out_p, vcol.dtype.scale))
-            draw_of[c] = s256
+            d64_of[c] = _carry_fold_u64_lanes(lanes).astype(jnp.uint64)
         elif vcol.dtype.kind in (T.Kind.FLOAT32, T.Kind.FLOAT64):
             if c not in fsum_of:
                 fsum_of[c] = segment_sum(
@@ -860,7 +915,5 @@ def group_by_scatter(
                               jnp.int64(0)),
                     bucket, num_segments=K + 1)
 
-    result, ng = _assemble_domain_result(
-        batch, key_name, K, aggs, counts_star, cnt_of, isum_of, fsum_of,
-        dsum_of, dover_of, draw_of)
-    return result, ng, overflow
+    return {"star": counts_star, "cnt": cnt_of, "isum": isum_of,
+            "fsum": fsum_of, "d64": d64_of}, overflow

@@ -422,3 +422,101 @@ def test_distributed_decimal_group_sum_matches_single_chip():
         for i in range(int(ng_host[d])):
             got[kk[d * per_dev + i]] = ss[d * per_dev + i]
     assert got == want_map
+
+
+def test_distributed_domain_combine_matches_single_chip():
+    """Map-side combine (distributed_group_by_domain): per-device
+    additive [K+1] partials + one psum, no row exchange.  Must equal the
+    single-chip sort-scan on the union — int/float/decimal sums, counts,
+    means, nulls, dead rows; the result is replicated."""
+    import math
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_jni_tpu.columnar import types as T
+    from spark_rapids_jni_tpu.columnar.column import (
+        Column,
+        ColumnBatch,
+        Decimal128Column,
+    )
+    from spark_rapids_jni_tpu.parallel import (
+        data_mesh,
+        distributed_group_by_domain,
+        shard_batch,
+    )
+    from spark_rapids_jni_tpu.relational import AggSpec, group_by
+
+    rng = np.random.default_rng(5)
+    n = 8 * 64
+    k = rng.integers(0, 20, n).astype(np.int32)
+    kval = rng.random(n) > 0.1
+    v = rng.integers(-(10**10), 10**10, n)
+    vval = rng.random(n) > 0.2
+    p = rng.random(n) * 100
+    dvals = [None if x % 7 == 0 else int(x) * 10**15
+             for x in rng.integers(-40, 40, n)]
+    live = rng.random(n) > 0.15
+    ones = jnp.ones((n,), jnp.bool_)
+    batch = ColumnBatch({
+        "k": Column(jnp.asarray(k), jnp.asarray(kval), T.INT32),
+        "v": Column(jnp.asarray(v), jnp.asarray(vval), T.INT64),
+        "p": Column(jnp.asarray(p), ones, T.FLOAT64),
+        "d": Decimal128Column.from_unscaled(dvals, 30, 2),
+    })
+    aggs = [AggSpec("sum", "v", "sv"), AggSpec("count", None, "c"),
+            AggSpec("mean", "p", "mp"), AggSpec("sum", "d", "sd")]
+    want, ngw = group_by(batch, ["k"], aggs, row_valid=jnp.asarray(live))
+
+    mesh = data_mesh(8)
+    sharded = shard_batch(batch, mesh)
+    rv = jax.device_put(
+        jnp.asarray(live),
+        jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("data")))
+    res, ng, ovf = distributed_group_by_domain(
+        sharded, "k", aggs, 32, mesh, row_valid=rv)
+    assert not bool(ovf)
+    g, gw = int(ng), int(ngw)
+    assert g == gw
+
+    def gmap(r, m, cols):
+        return {r["k"].to_pylist()[i]: tuple(r[c].to_pylist()[i]
+                                             for c in cols)
+                for i in range(m)}
+
+    got = gmap(res, g, ("sv", "c", "sd"))
+    wnt = gmap(want, gw, ("sv", "c", "sd"))
+    assert got == wnt
+    gm = gmap(res, g, ("mp",))
+    wm = gmap(want, gw, ("mp",))
+    for key in wm:
+        a, b = wm[key][0], gm[key][0]
+        assert (a is None) == (b is None)
+        if a is not None:
+            assert math.isclose(a, b, rel_tol=1e-12)
+
+
+def test_distributed_domain_combine_overflow_flag():
+    """A key outside [0, domain) on ANY device must raise the replicated
+    overflow flag (callers fall back to the shuffling path)."""
+    import numpy as np
+
+    from spark_rapids_jni_tpu.columnar import types as T
+    from spark_rapids_jni_tpu.columnar.column import Column, ColumnBatch
+    from spark_rapids_jni_tpu.parallel import (
+        data_mesh,
+        distributed_group_by_domain,
+        shard_batch,
+    )
+    from spark_rapids_jni_tpu.relational import AggSpec
+
+    n = 8 * 8
+    keys = [3] * n
+    keys[-1] = 99  # only on the last device
+    b = ColumnBatch({"k": Column.from_pylist(keys, T.INT32)})
+    mesh = data_mesh(8)
+    _, _, ovf = distributed_group_by_domain(
+        shard_batch(b, mesh), "k", [AggSpec("count", None, "c")], 16, mesh)
+    assert bool(ovf)
