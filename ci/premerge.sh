@@ -18,7 +18,10 @@ SRJ_PY_ROOT="$(pwd)" \
   SRJ_ADAPTOR_LIB="$(pwd)/spark_rapids_jni_tpu/mem/native/libtpu_resource_adaptor.so" \
   ./jni/test_glue
 
-python -m pytest tests/ -x -q
+# full suite, one pytest process per file: a single long-lived process
+# over the whole suite degraded pathologically on a 1-core box (round 4:
+# >4h and never finished vs 38 min chunked, same tests)
+bash ci/run_tests_chunked.sh
 
 XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -20,7 +20,7 @@ compilation model:
 from .filter import apply_mask, compact
 from .gather import gather_batch, gather_column
 from .sort import SortKey, sort_by
-from .aggregate import AggSpec, group_by
+from .aggregate import AggSpec, group_by, group_by_domain_or_sort
 from .join import hash_join
 from .window import WindowSpec, window
 
@@ -33,6 +33,7 @@ __all__ = [
     "sort_by",
     "AggSpec",
     "group_by",
+    "group_by_domain_or_sort",
     "hash_join",
     "WindowSpec",
     "window",

@@ -917,3 +917,69 @@ def _domain_partials_scatter(batch, key_name, aggs, domain, row_valid=None):
 
     return {"star": counts_star, "cnt": cnt_of, "isum": isum_of,
             "fsum": fsum_of, "d64": d64_of}, overflow
+
+
+def _pad_rows(col, pad_to: int):
+    """Pad a result column with null rows up to ``pad_to`` rows."""
+    n = col.num_rows
+    if n == pad_to:
+        return col
+    extra = pad_to - n
+    pv = jnp.concatenate([col.validity, jnp.zeros((extra,), jnp.bool_)])
+    if isinstance(col, Decimal128Column):
+        pl = jnp.concatenate(
+            [col.limbs, jnp.zeros((extra, 2), jnp.uint64)], axis=0)
+        return Decimal128Column(pl, pv, col.dtype)
+    pd = jnp.concatenate(
+        [col.data, jnp.zeros((extra,), col.data.dtype)])
+    return Column(pd, pv, col.dtype)
+
+
+def group_by_domain_or_sort(
+    batch: ColumnBatch,
+    key_name: str,
+    aggs: Sequence[AggSpec],
+    domain: int,
+    row_valid=None,
+    engine: str = "auto",
+    float_mode: str = "f64",
+):
+    """Adaptive aggregation: the domain engine when every live key fits
+    ``[0, domain)``, the general sort-scan otherwise — in ONE jitted
+    program.  Both paths trace; the overflow flag picks which executes
+    at runtime (``lax.cond``), so callers no longer hand-roll the
+    "assert or fall back" dance the raw :func:`group_by_onehot` contract
+    requires.  Only the O(n) bounds check runs outside the cond; the
+    domain partials (the O(n*K) contraction / segment sums) trace inside
+    the domain branch, so an overflowing batch pays the sort-scan alone.
+
+    Output rows are padded to ``max(num_rows, domain + 1)`` so the two
+    branches agree in shape; group ORDER differs by branch (domain: key
+    order with the null group last; sort-scan: key order, nulls first) —
+    Spark defines no group order.  sum/count/mean only (the domain
+    engines' op set).  Returns ``(result, num_groups)``.
+    """
+    n = batch.num_rows
+    K = int(domain)
+    pad_to = max(n, K + 1)
+    col = batch[key_name]
+    row_live = jnp.ones((n,), jnp.bool_) if row_valid is None else \
+        row_valid.astype(jnp.bool_)
+    _, overflow = _domain_bucket_overflow(col, col.validity & row_live, K)
+
+    def pad(res_ng):
+        res, ng = res_ng
+        return (ColumnBatch({name: _pad_rows(c, pad_to)
+                             for name, c in zip(res.names, res.columns)}),
+                ng.astype(jnp.int32))
+
+    def dom(_):
+        parts, _ovf = _domain_partials(batch, key_name, aggs, domain,
+                                       row_valid, engine, float_mode)
+        return pad(_finalize_domain(batch, key_name, K, list(aggs), parts))
+
+    def srt(_):
+        return pad(group_by(batch, [key_name], list(aggs),
+                            row_valid=row_valid))
+
+    return jax.lax.cond(overflow, srt, dom, None)

@@ -1158,3 +1158,83 @@ class TestGroupByDecimalSum:
                             got["m"].to_pylist()[:m])) == want_map, engine
             assert got["m"].dtype.precision == 24
             assert got["m"].dtype.scale == 7
+
+
+class TestGroupByDomainOrSort:
+    """Adaptive domain-or-sort aggregation: one jitted program, runtime
+    branch on the key-overflow flag; both branches padded to a common
+    shape and Spark-equal to the general sort-scan result."""
+
+    @staticmethod
+    def _build(rng, keys):
+        import jax.numpy as jnp
+
+        n = len(keys)
+        return ColumnBatch({
+            "k": Column(jnp.asarray(np.asarray(keys, np.int32)),
+                        jnp.asarray(rng.random(n) > 0.1), T.INT32),
+            "v": Column(jnp.asarray(rng.integers(-(10**9), 10**9, n)),
+                        jnp.asarray(rng.random(n) > 0.2), T.INT64),
+            "p": Column(jnp.asarray(rng.random(n) * 50),
+                        jnp.ones((n,), jnp.bool_), T.FLOAT64),
+        })
+
+    def test_matches_sort_scan_both_branches(self):
+        import jax
+
+        from spark_rapids_jni_tpu.relational import (
+            group_by_domain_or_sort,
+        )
+
+        rng = np.random.default_rng(8)
+        aggs = [AggSpec("sum", "v", "s"), AggSpec("count", None, "c"),
+                AggSpec("mean", "p", "m")]
+        jfn = jax.jit(
+            lambda b: group_by_domain_or_sort(b, "k", aggs, 32))
+
+        def gmap(res, ng):
+            g = int(ng)
+            out = {}
+            for i in range(g):
+                m = res["m"].to_pylist()[i]
+                out[res["k"].to_pylist()[i]] = (
+                    res["s"].to_pylist()[i], res["c"].to_pylist()[i],
+                    None if m is None else round(m, 9))
+            return out
+
+        cases = {
+            "in-domain": list(rng.integers(0, 30, 500)),
+            # one key outside [0, 32): the cond's sort branch must run
+            "overflow": list(rng.integers(0, 30, 499)) + [77],
+        }
+        for name, keys in cases.items():
+            b = self._build(rng, keys)
+            res, ng = jfn(b)
+            want, ngw = group_by(b, ["k"], aggs)
+            assert gmap(res, ng) == gmap(want, ngw), name
+
+    def test_small_batch_pads_to_domain(self):
+        """n < domain+1: the sort branch's rows get PADDED up to K+1 —
+        the one geometry where _pad_rows actually extends live results,
+        so values (not just shapes) must survive the padding."""
+        from spark_rapids_jni_tpu.relational import (
+            group_by_domain_or_sort,
+        )
+
+        rng = np.random.default_rng(9)
+        aggs = [AggSpec("count", None, "c"), AggSpec("sum", "v", "s")]
+        keys = list(rng.integers(0, 30, 8))
+        b = self._build(rng, keys)
+        res, ng = group_by_domain_or_sort(b, "k", aggs, 32)
+        assert res.num_rows == 33  # max(n=8, domain+1)
+        want, ngw = group_by(b, ["k"], aggs)
+        assert int(ng) == int(ngw)
+
+        def gmap(r, m):
+            return {r["k"].to_pylist()[i]:
+                    (r["c"].to_pylist()[i], r["s"].to_pylist()[i])
+                    for i in range(int(m))}
+
+        assert gmap(res, ng) == gmap(want, ngw)
+        # padding rows past num_groups are null
+        assert not bool(np.asarray(res["k"].validity)[int(ng):].any())
