@@ -171,15 +171,19 @@ def fast_path(chars, lengths, validity, path_tuple, max_out):
     # participates in any mask below
     depth_ok = depth_before >= 0
     fb |= jnp.any(span & ~depth_ok, axis=1)
+    # a document of L chars cannot nest deeper than L // 2 (every level
+    # costs an open AND a close bracket), so the per-depth forward-fill
+    # budget shrinks with narrow columns (bucketed small widths) for free
+    ff_depth = max(1, min(MAX_FF_DEPTH, L // 2))
     maxd = jnp.max(jnp.where(span, depth_after, 0), axis=1)
-    fb |= maxd > MAX_FF_DEPTH
+    fb |= maxd > ff_depth
 
     # ---- owner container type per position ---------------------------
     # owner_char_at_depth[d][j] = char of the latest open bracket with
     # depth_after == d at or before j (the bracket owning level d)
     neg1 = jnp.full((n, L), -1, _I32)
     own_idx = []
-    for d in range(1, MAX_FF_DEPTH + 1):
+    for d in range(1, ff_depth + 1):
         cand = jnp.where(opens & span & (depth_after == d), pos, neg1)
         own_idx.append(_ffill_max(cand))
     # container type for a position with depth_before == d: the owner
@@ -188,7 +192,7 @@ def fast_path(chars, lengths, validity, path_tuple, max_out):
         """db: [n, L] depth_before; at: [n, L] positions; -> u8 char,
         0 for ROOT."""
         out = jnp.zeros((n, L), _U8)
-        for d in range(1, MAX_FF_DEPTH + 1):
+        for d in range(1, ff_depth + 1):
             oc = jnp.where(own_idx[d - 1] >= 0,
                            jnp.take_along_axis(
                                ch, jnp.clip(own_idx[d - 1], 0, L - 1),
