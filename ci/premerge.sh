@@ -18,6 +18,23 @@ SRJ_PY_ROOT="$(pwd)" \
   SRJ_ADAPTOR_LIB="$(pwd)/spark_rapids_jni_tpu/mem/native/libtpu_resource_adaptor.so" \
   ./jni/test_glue
 
+# JVM smoke (VERDICT r4 item 4): with a JDK present, `make -C jni`
+# above already compiled the 31 mirror classes + the real JNI .so;
+# run a CastStrings + RmmSpark scenario Java -> JNI -> Python -> XLA.
+if command -v javac >/dev/null 2>&1 && [ -f jni/libspark_rapids_jni_tpu.so ]; then
+  mkdir -p jni/build/testclasses
+  mapfile -t JAVATEST_SRC < <(find jni/javatest -name '*.java')
+  javac -cp jni/build/classes -d jni/build/testclasses "${JAVATEST_SRC[@]}"
+  SRJ_ADAPTOR_LIB="$(pwd)/spark_rapids_jni_tpu/mem/native/libtpu_resource_adaptor.so" \
+    java -cp jni/build/classes:jni/build/testclasses \
+    -Dai.rapids.tpu.libPath="$(pwd)/jni/libspark_rapids_jni_tpu.so" \
+    -Dai.rapids.tpu.pythonPath="$(pwd)" \
+    com.nvidia.spark.rapids.jni.JvmSmokeTest
+else
+  echo "no JDK in this environment: JVM smoke skipped (the fake-JNIEnv"
+  echo "glue driver above already executed the JNIEXPORT layer)"
+fi
+
 # full suite, one pytest process per file: a single long-lived process
 # over the whole suite degraded pathologically on a 1-core box (round 4:
 # >4h and never finished vs 38 min chunked, same tests)

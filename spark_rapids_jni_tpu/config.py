@@ -53,6 +53,13 @@ _register("json_fast_path", True, _parse_bool,
           "data-parallel passes instead of max_len sequential scan "
           "steps; rows it cannot prove it handles fall back to the scan "
           "machine per batch.")
+_register("json_fallback_div", 8, int,
+          "Per-row fallback compaction capacity for the JSON hybrid: "
+          "flagged rows are gathered into fixed chunks of ceil(n/div) "
+          "rows and only those chunks run the serial scan machine "
+          "(lax.while_loop; clean batches run zero iterations). div=1 "
+          "degenerates to whole-batch chunks; 0 disables compaction "
+          "(any flagged row routes the whole batch, pre-r5 behavior).")
 _register("json_scan_unroll", 2, int,
           "Chars processed per while-loop iteration in the JSON scan "
           "(lax.scan unroll): the scan carry round-trips HBM once per "
@@ -76,9 +83,6 @@ _register("bench_rows_cpu", 1 << 20, int,
           "(round 2's 2M-row CPU fallback blew the driver window; the "
           "round-4 scatter engine runs 1M rows in ~35ms, so the refine "
           "step fits the budget comfortably).")
-_register("use_pallas_hashes", False, _parse_bool,
-          "Route murmur3/xxhash64 int64 fast paths through the Pallas "
-          "kernels instead of the jnp formulations.")
 _register("q6_group_path", "onehot", str,
           "Aggregation path for the q6 flagship bench: 'onehot' (MXU "
           "one-hot matmul, group_by_onehot with the bench's static key "

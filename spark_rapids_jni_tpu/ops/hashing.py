@@ -504,21 +504,9 @@ def murmur_hash3_32(columns: Columns, seed: int = 42) -> Column:
     n = _validate(cols)
     from ..columnar.column import ListColumn
 
-    if len(cols) == 1:
-        from .. import config
-
-        if config.get("use_pallas_hashes"):
-            if (isinstance(cols[0], Column)
-                    and cols[0].dtype.kind in (T.Kind.INT64,
-                                               T.Kind.TIMESTAMP)):
-                from .pallas_kernels import murmur3_int64
-
-                return murmur3_int64(cols[0], seed=seed)
-            if isinstance(cols[0], StringColumn):
-                from .pallas_kernels import murmur3_string
-
-                return murmur3_string(cols[0], seed=seed)
-
+    # r5: the Pallas hash kernels were deleted (v5e-measured 10-130x
+    # slower than this jnp formulation — PALLAS_MEMO.md); XLA's fusion
+    # of the chain below IS the TPU fast path.
     h = jnp.full((n,), jnp.uint32(seed & 0xFFFFFFFF))
     for c in cols:
         if isinstance(c, ListColumn):
@@ -540,20 +528,6 @@ def xxhash64(columns: Columns, seed: int = DEFAULT_XXHASH64_SEED) -> Column:
         return pre[0].apply_column(lambda b: xxhash64([b], seed=seed))
     cols = _as_columns(columns)
     n = _validate(cols)
-    if len(cols) == 1:
-        from .. import config
-
-        if config.get("use_pallas_hashes"):
-            if (isinstance(cols[0], Column)
-                    and cols[0].dtype.kind in (T.Kind.INT64,
-                                               T.Kind.TIMESTAMP)):
-                from .pallas_kernels import xxhash64_int64
-
-                return xxhash64_int64(cols[0], seed=seed)
-            if isinstance(cols[0], StringColumn):
-                from .pallas_kernels import xxhash64_string
-
-                return xxhash64_string(cols[0], seed=seed)
     h = jnp.full((n,), jnp.uint64(seed & 0xFFFFFFFFFFFFFFFF))
     for c in cols:
         if isinstance(c, ListColumn):

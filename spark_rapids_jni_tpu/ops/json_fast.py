@@ -29,8 +29,10 @@ falls back when any of these hold:
   machine decides NULL properly — the fast path never declares NULL for
   a doc it cannot fully validate, except provably-structural cases);
 * the matched value needs non-trivial rewriting: a float-containing or
-  ``-0``-containing container copy, control chars inside a container
-  copy, or a float token wider than the static parse window.
+  ``-0``-containing container copy, or control chars inside a container
+  copy.  (Scalar float targets are handled in-engine via the scan
+  machine's own ``_format_floats`` — same parser, same exponent
+  canonicalization, any token length.)
 
 Rows the fast path *keeps* are fully validated: every accepted document
 parses under the reference grammar (numbers, literals, separator
@@ -47,12 +49,9 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from ..columnar import types as T
-from ..columnar.column import StringColumn
-from . import cast_string, float_to_string
+from . import float_to_string
 
 MAX_FF_DEPTH = 16   # owner forward-fill depth budget; deeper rows fall back
-FLOAT_TOK_W = 48    # static float-token parse window; wider tokens fall back
 
 _U8 = jnp.uint8
 _I32 = jnp.int32
@@ -465,12 +464,12 @@ def fast_path(chars, lengths, validity, path_tuple, max_out):
     t_has_ctrl = jnp.any(in_tspan & content & (ch < _U8(0x20)), axis=1)
     fb |= alive & t_is_cont & (t_has_float | t_has_neg0 | t_has_ctrl)
 
-    # scalar float target: parse-window bound
+    # scalar float target (no length bound: the shared formatter below
+    # reads the same <=326-char window the scan machine does)
     t_num_end = t_vend
     t_tok_len = t_num_end - cs + 1
     t_is_float = t_is_num & jnp.any(
         in_tspan & is_num_run & ((ch == _c(".")) | is_e), axis=1)
-    fb |= alive & t_is_float & (t_tok_len > FLOAT_TOK_W)
 
     # ---- materialization ---------------------------------------------
     W = int(max_out)
@@ -520,17 +519,17 @@ def fast_path(chars, lengths, validity, path_tuple, max_out):
     any_float = jnp.any(alive & t_is_float)
 
     def format_floats(_):
-        fsrc = jnp.clip(
-            cs[:, None] + jnp.arange(FLOAT_TOK_W, dtype=_I32)[None, :],
-            0, L - 1)
-        ftok = jnp.where(
-            jnp.arange(FLOAT_TOK_W, dtype=_I32)[None, :] < t_tok_len[:, None],
-            jnp.take_along_axis(ch, fsrc, axis=1), _U8(0))
-        fcol = StringColumn(ftok, jnp.where(t_is_float, t_tok_len, 1),
-                            jnp.ones((n,), jnp.bool_))
-        fvals = cast_string.string_to_float(fcol, T.FLOAT64)
-        fbytes, flens = float_to_string.double_to_json_string(fvals.data)
-        return fbytes, flens.astype(_I32)
+        # the SAME parser+formatter as the scan machine (exponent
+        # canonicalization then string_to_float + Ryu): r5 caught a
+        # >4-exponent-digit golden ('...e0005603...' -> "Infinity")
+        # diverging when this path parsed through a private window —
+        # the serial engine stays the float-semantics source
+        from .get_json_object import _format_floats
+
+        fbytes3, flens2 = _format_floats(
+            ch, cs[:, None],
+            jnp.where(t_is_float, t_tok_len, 0)[:, None], 1)
+        return fbytes3[:, 0], flens2[:, 0].astype(_I32)
 
     fbytes, flens = jax.lax.cond(
         any_float, format_floats,

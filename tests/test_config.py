@@ -36,8 +36,8 @@ def test_unknown_key_raises():
 
 
 def test_bool_parse(monkeypatch):
-    monkeypatch.setenv("SPARK_RAPIDS_TPU_USE_PALLAS_HASHES", "true")
-    assert config.get("use_pallas_hashes") is True
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_JSON_FAST_PATH", "false")
+    assert config.get("json_fast_path") is False
 
 
 def test_describe_lists_all():

@@ -188,6 +188,62 @@ class TestHybridRouting:
         assert list(ok) == [True, False, True]
 
 
+class TestCompactFallback:
+    """r5 per-row fallback compaction (VERDICT r4 weak #2): flagged rows
+    are gathered into fixed-capacity chunks and ONLY those chunks run the
+    scan machine; results must equal the pure-serial engine everywhere,
+    for any dirty-row placement and any chunk count."""
+
+    def _serial(self, col, path):
+        config.set("json_fast_path", False)
+        try:
+            return get_json_object(col, path).to_pylist()
+        finally:
+            config.reset("json_fast_path")
+
+    def _compact(self, col, path, div):
+        config.set("json_fast_path", True)
+        config.set("json_fallback_div", div)
+        try:
+            return get_json_object(col, path).to_pylist()
+        finally:
+            config.reset("json_fallback_div")
+            config.reset("json_fast_path")
+
+    def test_scattered_dirty_rows_match_serial(self):
+        # dirty rows at the first, middle, and last position: the scatter
+        # must land each scan result on its own row
+        docs = list(CLEAN_DOCS)
+        docs[0] = DIRTY_DOCS[0]
+        docs[len(docs) // 2] = DIRTY_DOCS[1]
+        docs[-1] = DIRTY_DOCS[2]
+        col = StringColumn.from_pylist(docs, pad_to_multiple=8)
+        assert self._compact(col, "$.a", 8) == self._serial(col, "$.a")
+
+    def test_all_dirty_overflows_across_chunks(self):
+        # nfb = n >> cap: the while_loop must run ceil(n/cap) iterations
+        # and still cover every row (no cliff at capacity overflow)
+        docs = DIRTY_DOCS * 6                      # 18 rows, all flagged
+        col = StringColumn.from_pylist(docs, pad_to_multiple=8)
+        assert self._compact(col, "$.a", 8) == self._serial(col, "$.a")
+
+    def test_capacity_one_chunk_per_row(self):
+        # div >= n -> cap=1: one loop iteration per dirty row
+        docs = [CLEAN_DOCS[1], DIRTY_DOCS[0], CLEAN_DOCS[4], DIRTY_DOCS[1]]
+        col = StringColumn.from_pylist(docs, pad_to_multiple=8)
+        assert self._compact(col, "$.a", 64) == self._serial(col, "$.a")
+
+    def test_div0_whole_batch_engine_unchanged(self):
+        docs = CLEAN_DOCS[:6] + [DIRTY_DOCS[0]]
+        col = StringColumn.from_pylist(docs, pad_to_multiple=8)
+        assert self._compact(col, "$.a", 0) == self._serial(col, "$.a")
+
+    def test_null_rows_with_dirty_neighbors(self):
+        docs = ['{"a": 1}', None, DIRTY_DOCS[0], None, '{"a": 2}']
+        col = StringColumn.from_pylist(docs, pad_to_multiple=8)
+        assert self._compact(col, "$.a", 2) == self._serial(col, "$.a")
+
+
 class TestFastEngineFuzz:
     def test_random_corpus_parity(self):
         """Random nested docs (ints/strings/literals only — float parity

@@ -299,30 +299,6 @@ class TestHierarchicalJoinSort:
         assert sorted(k_np.tolist()) == sorted(vals.tolist())
 
 
-def test_partition_ids_stable_under_pallas_knob():
-    """Shuffle partition assignment must be bit-identical whichever hash
-    backend the knob selects (partition parity is a wire contract)."""
-    import numpy as np
-
-    import jax
-    import jax.numpy as jnp
-
-    from spark_rapids_jni_tpu import config
-    from spark_rapids_jni_tpu.columnar.column import StringColumn
-    from spark_rapids_jni_tpu.parallel import spark_partition_id
-
-    col = StringColumn.from_pylist(
-        [f"key-{i * 37 % 101}" for i in range(257)] + [None])
-    rv = jnp.ones((col.num_rows,), jnp.bool_)
-    a = spark_partition_id([col], 16, rv)
-    config.set("use_pallas_hashes", True)
-    try:
-        b = spark_partition_id([col], 16, rv)
-    finally:
-        config.reset("use_pallas_hashes")
-    assert (np.asarray(a) == np.asarray(b)).all()
-
-
 def test_exchange_hierarchical_reserved_name():
     import jax.numpy as jnp
     import pytest as _pytest

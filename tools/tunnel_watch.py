@@ -1,21 +1,33 @@
-"""Poll the axon TPU tunnel until it answers, then exit 0.
+"""Poll the axon TPU tunnel until it answers, then exit 0 — or, with
+``--run-session``, immediately launch the staged measurement chain
+(tools/measure_session.sh) the moment a probe succeeds, so a tunnel
+window can never be missed while nobody is watching (VERDICT r4 item 1).
 
 Runs bench.py's --probe child under the same graceful-kill ladder the
 bench parent uses (SIGTERM -> grace -> SIGKILL; a hung probe on a wedged
 tunnel never held a slot, so killing it is safe — the wedge mechanism is
 killing a client mid-RPC on a LIVE tunnel, BASELINE.md).
 
-Exit 0 = tunnel alive (a measurement session may start).
-Exit 3 = gave up after --max-hours.
+One TPU client at a time: session ownership is an ``flock`` on
+``tools/SESSION_RUNNING``.  flock is atomic (no create/remove race
+between contending watchers) and the kernel releases it when the owner
+dies (no stale-lock cleanup to get wrong).  Session stdout/stderr stream
+to ``tools/session_<UTCstamp>.log``.
+
+Exit 0 = tunnel alive (and, with --run-session, the session completed).
+Exit 3 = gave up after --max-hours.  Exit 4 = session failed or was
+killed by the --max-session-hours backstop.
 """
 
 import argparse
+import fcntl
 import os
 import subprocess
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOCK = os.path.join(REPO, "tools", "SESSION_RUNNING")
 
 
 def probe_once(timeout_s: int) -> bool:
@@ -35,11 +47,69 @@ def probe_once(timeout_s: int) -> bool:
         return False
 
 
+def acquire_lock(max_wait_s: float):
+    """Take the session flock, waiting up to ``max_wait_s`` for a live
+    holder.  Returns ``(fd, waited_s)`` or ``(None, waited_s)``."""
+    fd = os.open(LOCK, os.O_CREAT | os.O_RDWR)
+    t0 = time.monotonic()
+    while True:
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            os.ftruncate(fd, 0)
+            os.write(fd, f"{os.getpid()}\n".encode())
+            return fd, time.monotonic() - t0
+        except BlockingIOError:
+            waited = time.monotonic() - t0
+            remaining = max_wait_s - waited
+            if remaining <= 0:
+                os.close(fd)
+                return None, waited
+            print(f"[{time.strftime('%H:%M:%S')}] tunnel ALIVE but another "
+                  "session holds the lock; waiting", flush=True)
+            time.sleep(min(30.0, remaining))
+
+
+def run_session(max_session_s: int) -> int:
+    """Run the staged measurement chain, streaming to a timestamped log.
+    Caller must hold the session flock.
+
+    The outer bound is a backstop only — every stage inside the script
+    already self-enforces a deadline (r4 mitigation), so SIGTERM here
+    should never fire mid-RPC on a live tunnel.
+    """
+    stamp = time.strftime("%Y%m%d_%H%M%S", time.gmtime())
+    log_path = os.path.join(REPO, "tools", f"session_{stamp}.log")
+    print(f"[{time.strftime('%H:%M:%S')}] tunnel ALIVE -> running "
+          f"measure_session.sh (log: {log_path})", flush=True)
+    with open(log_path, "w") as log:
+        proc = subprocess.Popen(
+            ["bash", os.path.join(REPO, "tools", "measure_session.sh")],
+            stdout=log, stderr=subprocess.STDOUT)
+        try:
+            proc.wait(timeout=max_session_s)
+        except subprocess.TimeoutExpired:
+            proc.terminate()  # graceful first: never SIGKILL mid-RPC
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+    print(f"[{time.strftime('%H:%M:%S')}] session done "
+          f"(rc={proc.returncode})", flush=True)
+    # exit contract: 0 = session ran to completion, 4 = session failed/
+    # killed (never the raw child code — a stage exiting 3 must stay
+    # distinguishable from this watcher's own 3 = gave-up-polling)
+    return 0 if proc.returncode == 0 else 4
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--interval", type=float, default=300.0)
     ap.add_argument("--probe-timeout", type=int, default=90)
     ap.add_argument("--max-hours", type=float, default=12.0)
+    ap.add_argument("--run-session", action="store_true",
+                    help="on first live probe, run measure_session.sh")
+    ap.add_argument("--max-session-hours", type=float, default=3.0)
     args = ap.parse_args()
 
     deadline = time.monotonic() + args.max_hours * 3600
@@ -51,7 +121,28 @@ def main() -> int:
         print(f"[{t0}] probe #{attempt}: {'ALIVE' if ok else 'wedged'}",
               flush=True)
         if ok:
-            return 0
+            if not args.run_session:
+                return 0
+            # bound the lock wait by the watcher's own deadline, and if
+            # we waited at all, re-probe: the tunnel state observed
+            # before another watcher's multi-hour session is stale
+            fd, waited = acquire_lock(
+                max(0.0, deadline - time.monotonic()))
+            if fd is None:
+                continue
+            try:
+                if waited > 5 and not probe_once(args.probe_timeout):
+                    print(f"[{time.strftime('%H:%M:%S')}] tunnel no "
+                          "longer answers after the lock wait; back to "
+                          "polling", flush=True)
+                    continue
+                return run_session(int(args.max_session_hours * 3600))
+            finally:
+                # release via close ONLY — never unlink: a waiter holds
+                # an fd to this inode, and unlinking would let it lock
+                # the orphan while a newcomer locks a fresh file at the
+                # path (two sessions again)
+                os.close(fd)
         time.sleep(args.interval)
     return 3
 
