@@ -58,6 +58,35 @@ def _numpy_pipeline(k, v, price):
     return uniq, sums, cnts, avgs
 
 
+def _measure_devgen(step_fn, gen_fn, n_rows, seed_base, reps):
+    """THE generation-subtraction protocol for device-generated inputs,
+    shared by every devgen metric (q6, q95): time gen-only and gen+step
+    on DISTINCT seed variants — the tunnel dedupes repeated (fn,
+    buffers) pairs (round 3's 167 Grows/s artifact came from one drifted
+    copy of this protocol) — then subtract the generation cost.
+
+    Returns ``(net_mrows, note)``; ``note`` carries the gross rate and
+    per-exec generation cost for the emitted JSON line.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    step = jax.jit(step_fn)
+    gen = jax.jit(gen_fn)
+    seeds = [(jnp.int32(seed_base + i),) for i in range(2 * reps + 2)]
+    gen_mrows = _bench_one(gen, seeds[0], n_rows, reps,
+                           variants=seeds[:reps + 1])
+    gross = _bench_one(step, seeds[reps + 1], n_rows, reps,
+                       variants=seeds[reps + 1:])
+    t_gen, t_full = n_rows / (gen_mrows * 1e6), n_rows / (gross * 1e6)
+    note = {"gen_ms": round(t_gen * 1e3, 2),
+            "gross_mrows": round(gross, 2)}
+    net = t_full - t_gen
+    if net <= t_full * 0.05:  # generation dominates; report gross
+        return gross, note
+    return n_rows / net / 1e6, note
+
+
 def _numpy_q95_mrows(n_rows, seed=19):
     """Single-core numpy stand-in for the q95 shape: the unique-key joins
     reduce to payload gathers, the group-by to bincounts (the partition
@@ -169,24 +198,12 @@ def child_main():
 
     def measure(n_rows):
         if use_devgen:
-            import jax.numpy as jnp
-
-            step = jax.jit(lambda s: ge._q6_step(ge._device_batch(s, n_rows)))
-            gen = jax.jit(
-                lambda s: ge._consume_batch(ge._device_batch(s, n_rows)))
-            seeds = [(jnp.int32(1000 + i),) for i in range(2 * REPS + 2)]
-            gen_mrows = _bench_one(gen, seeds[0], n_rows, REPS,
-                                   variants=seeds[:REPS + 1])
-            full_mrows = _bench_one(step, seeds[REPS + 1], n_rows, REPS,
-                                    variants=seeds[REPS + 1:])
-            t_gen, t_full = n_rows / (gen_mrows * 1e6), \
-                n_rows / (full_mrows * 1e6)
-            devgen_note[n_rows] = {"gen_ms": round(t_gen * 1e3, 2),
-                                   "gross_mrows": round(full_mrows, 2)}
-            net = t_full - t_gen
-            if net <= t_full * 0.05:  # generation dominates; report gross
-                return full_mrows
-            return n_rows / net / 1e6
+            mrows, note = _measure_devgen(
+                lambda s: ge._q6_step(ge._device_batch(s, n_rows)),
+                lambda s: ge._consume_batch(ge._device_batch(s, n_rows)),
+                n_rows, 1000, REPS)
+            devgen_note[n_rows] = note
+            return mrows
         # REPS+1 distinct batches: one to warm, REPS timed once each
         variants = [(ge._example_batch(n_rows, seed=7 + i),)
                     for i in range(REPS + 1)]
@@ -261,19 +278,11 @@ def child_main():
               flush=True)
         return 0
     try:
-        import jax.numpy as jnp
-
         if use_devgen:
-            qstep = jax.jit(lambda s: ge._q95_step(*ge._device_q95(s, nq)))
-            qgen = jax.jit(lambda s: ge._consume_q95(*ge._device_q95(s, nq)))
-            seeds = [(jnp.int32(5000 + i),) for i in range(2 * REPS + 2)]
-            gen_mrows = _bench_one(qgen, seeds[0], nq, REPS,
-                                   variants=seeds[:REPS + 1])
-            gross = _bench_one(qstep, seeds[REPS + 1], nq, REPS,
-                               variants=seeds[REPS + 1:])
-            t_gen, t_full = nq / (gen_mrows * 1e6), nq / (gross * 1e6)
-            net = t_full - t_gen
-            qm = gross if net <= t_full * 0.05 else nq / net / 1e6
+            qm, _ = _measure_devgen(
+                lambda s: ge._q95_step(*ge._device_q95(s, nq)),
+                lambda s: ge._consume_q95(*ge._device_q95(s, nq)),
+                nq, 5000, REPS)
         else:
             qv = [ge._q95_batches(nq, seed=19 + i) for i in range(REPS + 1)]
             qm = _bench_one(jax.jit(ge._q95_step), qv[0], nq, REPS,

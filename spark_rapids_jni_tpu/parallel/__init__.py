@@ -23,7 +23,7 @@ same code over a larger mesh — XLA lowers the collective onto ICI within a
 slice and DCN across.
 """
 
-from .partition import spark_partition_id
+from .partition import regroup_order, spark_partition_id
 from .shuffle import exchange, exchange_hierarchical
 from .distributed import (
     data_mesh,
@@ -39,6 +39,7 @@ from .distributed import (
 )
 
 __all__ = [
+    "regroup_order",
     "spark_partition_id",
     "exchange",
     "exchange_hierarchical",

@@ -31,6 +31,29 @@ from spark_rapids_jni_tpu import config as _srj_config  # noqa: E402
 _srj_config.set("json_scan_unroll", 1)
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _freeze_compiled_state():
+    """Keep single-process suite runs linear (r5 item 6 root cause).
+
+    Every compiled jax program leaves a large long-lived object graph
+    (jaxpr + executable) in the cyclic collector's gen-2; the suite's
+    allocation-heavy tracing then fires collections whose cost grows
+    with everything compiled so far — quadratic total time, measured as
+    the r4 collapse (>4h single-process vs 38min chunked; repro:
+    tools/compile_cache_pathology.py, +24%/100 programs unfrozen vs
+    flat with freeze).  After each module, collect the actual garbage,
+    then freeze survivors (compiled programs, session fixtures) out of
+    future GC scans.  Frozen objects are never collected — acceptable
+    for a test process; ci/run_tests_chunked.sh stays the memory-safe
+    CI path.
+    """
+    yield
+    import gc
+
+    gc.collect()
+    gc.freeze()
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     import jax

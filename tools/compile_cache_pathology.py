@@ -1,0 +1,95 @@
+"""Minimal repro for the single-process suite collapse (VERDICT r4 weak
+#8 / r5 item 6): does XLA-CPU compile time grow with the number of live
+compiled programs in one process?
+
+Round-4 facts: the full suite in ONE pytest process ran >4h at 19GB RSS
+and never finished; the SAME files as per-file processes pass in ~38
+min.  Two suspects were named: compiled-program accumulation (each jit
+cache entry keeps its executable alive for the process lifetime) and
+the variadic-sort comparator registry collision (already caught in r4,
+worked around by isolating decimal bench entries).
+
+This script isolates the first suspect: compile K batches of N distinct
+programs each (distinct static shapes force distinct compiles, like a
+suite's many (shape, path) variants do), and report per-batch compile
+wall-clock + RSS.  Linear-ish growth in per-batch time = accumulation
+pathology (upstream jax/XLA issue, file with this repro); flat time but
+growing RSS = memory-only accumulation (the 19GB RSS is explained, the
+4h wall-clock needs another culprit); flat both = the collapse lives in
+pytest/test interaction, not XLA.
+
+Usage:
+  python tools/compile_cache_pathology.py [K batches] [N per batch] \
+      [chain length] [gc_freeze]
+
+``chain length`` scales the per-program jaxpr size (the suite's JSON
+scan programs are enormous; a toy add doesn't reproduce their heap
+load).  ``gc_freeze`` (literal string) calls gc.freeze() after each
+batch — if growth disappears, the pathology is cyclic-GC pauses scaling
+with the live heap, and the fix is freezing long-lived compiled
+programs out of collection.
+"""
+import _bootstrap  # noqa: F401
+import gc
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def rss_mb() -> float:
+    with open(f"/proc/{os.getpid()}/statm") as f:
+        return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE") / 1e6
+
+
+def make_program(i: int, chain: int):
+    """A distinct program per i: unique shape -> unique compile.  The
+    body mixes the primitives the suite leans on (sort, scan, gather,
+    reduce), repeated ``chain`` times so trace size is suite-shaped."""
+    n = 256 + i  # unique static shape
+
+    def f(x):
+        acc = x
+        for j in range(chain):
+            s = jnp.sort(acc)
+            c = jnp.cumsum(s)
+            acc = jnp.take(c, jnp.clip(
+                acc.astype(jnp.int32) % n, 0, n - 1)) * (1.0 + j * 1e-9)
+        return jnp.sum(acc)
+
+    return jax.jit(f), jnp.arange(n, dtype=jnp.float64)
+
+
+def main():
+    k_batches = int(sys.argv[1]) if len(sys.argv) > 1 else 12
+    n_per = int(sys.argv[2]) if len(sys.argv) > 2 else 50
+    chain = int(sys.argv[3]) if len(sys.argv) > 3 else 1
+    freeze = len(sys.argv) > 4 and sys.argv[4] == "gc_freeze"
+    print(f"# {k_batches} batches x {n_per} distinct programs, "
+          f"chain={chain}, gc_freeze={freeze}, "
+          f"platform={jax.default_backend()}", flush=True)
+    total = 0
+    for b in range(k_batches):
+        gc0 = sum(s["collections"] for s in gc.get_stats())
+        t0 = time.perf_counter()
+        for i in range(n_per):
+            f, x = make_program(total + i, chain)
+            jax.block_until_ready(f(x))
+        total += n_per
+        dt = time.perf_counter() - t0
+        gc1 = sum(s["collections"] for s in gc.get_stats())
+        if freeze:
+            gc.collect()
+            gc.freeze()
+        print(f"batch {b:2d}: {dt:6.2f}s for {n_per} compiles "
+              f"({dt / n_per * 1e3:6.1f} ms each), live={total}, "
+              f"rss={rss_mb():.0f}MB, gc_colls={gc1 - gc0}, "
+              f"gc_tracked={len(gc.get_objects())}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

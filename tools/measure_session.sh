@@ -40,7 +40,15 @@ done
 echo "== [$(stamp)] 7. full micro suite"
 BENCH_TOTAL_BUDGET_S=600 python bench.py --micro
 
-echo "== [$(stamp)] 8. json engine A/B: serial scan (fast path off;"
+echo "== [$(stamp)] 8. json fallback-compaction A/B: dirty-row entries"
+echo "   with per-row compaction (default) vs whole-batch fallback (div=0)"
+for entry in get_json_dirty_1pct get_json_dirty_10pct; do
+  BENCH_MICRO_ONLY=$entry BENCH_TOTAL_BUDGET_S=180 python bench.py --micro
+  SPARK_RAPIDS_TPU_JSON_FALLBACK_DIV=0 BENCH_MICRO_ONLY=$entry \
+    BENCH_TOTAL_BUDGET_S=180 python bench.py --micro
+done
+
+echo "== [$(stamp)] 9. json engine A/B: serial scan (fast path off;"
 echo "   the default fast-path numbers are stage 7's get_json entries)"
 SPARK_RAPIDS_TPU_JSON_FAST_PATH=0 BENCH_TOTAL_BUDGET_S=300 \
   python bench.py --micro 2>/dev/null | grep -E "get_json|qstr" || true
