@@ -21,7 +21,7 @@ from .filter import apply_mask, compact
 from .gather import gather_batch, gather_column
 from .sort import SortKey, sort_by
 from .aggregate import AggSpec, group_by, group_by_domain_or_sort
-from .join import hash_join
+from .join import hash_join, join_dense_or_hash
 from .window import WindowSpec, window
 
 __all__ = [
@@ -35,6 +35,7 @@ __all__ = [
     "group_by",
     "group_by_domain_or_sort",
     "hash_join",
+    "join_dense_or_hash",
     "WindowSpec",
     "window",
 ]

@@ -228,6 +228,108 @@ def hash_join(
         lpart = gather_batch(lpart, srcrow, live)
         rpart = gather_batch(rpart, srcrow, live)
 
+    return _merge_parts(lpart, rpart, suffixes), total
+
+
+def join_dense_or_hash(
+    left: ColumnBatch,
+    right: ColumnBatch,
+    left_on: str,
+    right_on: str,
+    domain: int,
+    how: str = "inner",
+    capacity: Optional[int] = None,
+    suffixes: tuple = ("", "_r"),
+    left_valid=None,
+    right_valid=None,
+) -> tuple:
+    """Adaptive inner join for the dimension-table shape: when the build
+    side's keys are UNIQUE ints in ``[0, domain)`` (dense surrogate keys
+    — every TPC-DS dim), the sort+binary-search engine reduces to one
+    scatter (build a ``[domain]`` rowid table) plus gathers; otherwise
+    one ``lax.cond`` runs the general :func:`hash_join`.  Same adaptive
+    pattern as ``group_by_domain_or_sort``: both branches trace, the
+    data picks at runtime, and the output contract (row order = matches
+    compacted in left-row order, ``(result, count)``, ``count >
+    capacity`` = truncation) is bit-identical between branches.
+
+    Only single-int-key inner joins take the dense path; anything else
+    delegates to :func:`hash_join` outright.  Measured r5 on the q95
+    shape (64K fact x 8K dim, 1-core XLA-CPU): the general engine's
+    per-join cost is dominated by the build sort that this path skips.
+    """
+    lcol, rcol = left[left_on], right[right_on]
+    eligible = (how == "inner" and domain > 0
+                and not isinstance(lcol, (StringColumn, Decimal128Column))
+                and not isinstance(rcol, (StringColumn, Decimal128Column))
+                and jnp.issubdtype(lcol.data.dtype, jnp.integer)
+                and jnp.issubdtype(rcol.data.dtype, jnp.integer)
+                and right.num_rows > 0)
+    if not eligible:
+        return hash_join(left, right, [left_on], [right_on], how,
+                         capacity=capacity, suffixes=suffixes,
+                         left_valid=left_valid, right_valid=right_valid)
+
+    nl, nr = left.num_rows, right.num_rows
+    K1 = int(domain)
+    cap = nl if capacity is None else int(capacity)
+
+    rv = (jnp.ones((nr,), jnp.bool_) if right_valid is None
+          else right_valid.astype(jnp.bool_))
+    r_live = rcol.validity & rv
+    rk = rcol.data.astype(jnp.int32)
+    in_dom = r_live & (rk >= 0) & (rk < K1)
+    slot = jnp.where(in_dom, rk, K1)          # K1 = discard slot
+    cnt = jnp.zeros((K1 + 1,), jnp.int32).at[slot].add(1)
+    # wider-than-32-bit keys must round-trip the int32 cast exactly on
+    # BOTH sides, else a key >= 2^32 could wrap into [0, domain) and
+    # fabricate matches the general engine would never produce
+    lv_pre = (jnp.ones((nl,), jnp.bool_) if left_valid is None
+              else left_valid.astype(jnp.bool_))
+    lk32 = lcol.data.astype(jnp.int32)
+    no_wrap = (
+        jnp.all((rk.astype(rcol.data.dtype) == rcol.data) | ~r_live)
+        & jnp.all((lk32.astype(lcol.data.dtype) == lcol.data)
+                  | ~(lcol.validity & lv_pre)))
+    dense_ok = (jnp.all(in_dom | ~r_live) & jnp.all(cnt[:K1] <= 1)
+                & no_wrap)
+
+    def dense(_):
+        rowid = jnp.zeros((K1 + 1,), jnp.int32).at[slot].set(
+            jnp.arange(nr, dtype=jnp.int32))
+        present = cnt[:K1] > 0
+        lv = (jnp.ones((nl,), jnp.bool_) if left_valid is None
+              else left_valid.astype(jnp.bool_))
+        lk = lcol.data.astype(jnp.int32)
+        lk_ok = lcol.validity & lv & (lk >= 0) & (lk < K1)
+        lk_safe = jnp.where(lk_ok, lk, 0)
+        match = lk_ok & present[lk_safe]
+        total = jnp.sum(match, dtype=jnp.int32)
+        from ..parallel.partition import regroup_order
+
+        order = regroup_order(jnp.where(match, 0, 1), 2)  # matches first
+        li = order[:cap] if cap <= nl else jnp.pad(
+            order, (0, cap - nl), constant_values=0)
+        out_valid = jnp.arange(cap, dtype=jnp.int32) < total
+        ri = rowid[jnp.clip(jnp.take(lk_safe, li), 0, K1)]
+        lpart = gather_batch(left, li, out_valid)
+        right_names = [n for n in right.names if n != right_on]
+        rpart = gather_batch(
+            right.select(right_names) if right_names else ColumnBatch({}),
+            ri, out_valid)
+        return _merge_parts(lpart, rpart, suffixes), total
+
+    def general(_):
+        return hash_join(left, right, [left_on], [right_on], "inner",
+                         capacity=cap, suffixes=suffixes,
+                         left_valid=left_valid, right_valid=right_valid)
+
+    return jax.lax.cond(dense_ok, dense, general, None)
+
+
+def _merge_parts(lpart: ColumnBatch, rpart: ColumnBatch,
+                 suffixes: tuple) -> ColumnBatch:
+    """Suffix-disambiguating column merge shared by the join engines."""
     collisions = set(lpart.names) & set(rpart.names)
     merged = {}
     for part, suffix in ((lpart, suffixes[0]), (rpart, suffixes[1])):
@@ -235,10 +337,10 @@ def hash_join(
             out = name + suffix if name in collisions else name
             if out in merged:
                 raise ValueError(
-                    f"join output name collision: {out!r} (suffixes={suffixes!r})"
-                )
+                    f"join output name collision: {out!r} "
+                    f"(suffixes={suffixes!r})")
             merged[out] = col
-    return ColumnBatch(merged), total
+    return ColumnBatch(merged)
 
 
 def _concat_col(a, b):

@@ -1238,3 +1238,115 @@ class TestGroupByDomainOrSort:
         assert gmap(res, ng) == gmap(want, ngw)
         # padding rows past num_groups are null
         assert not bool(np.asarray(res["k"].validity)[int(ng):].any())
+
+
+class TestJoinDenseOrHash:
+    """r5 dimension-join fast path: when the build side has unique dense
+    int keys the join is a scatter-table + gathers; the output must be
+    BIT-identical to hash_join in every case, including the ones where
+    the runtime check rejects the dense path."""
+
+    def _batches(self, lk, rk, lpay=None, rpay=None):
+        import jax.numpy as jnp
+
+        left = ColumnBatch({
+            "k": Column.from_pylist(lk, T.INT32),
+            "lv": Column.from_pylist(
+                lpay or [i * 10 for i in range(len(lk))], T.INT64),
+        })
+        right = ColumnBatch({
+            "k": Column.from_pylist(rk, T.INT32),
+            "rv": Column.from_pylist(
+                rpay or [i * 100 for i in range(len(rk))], T.INT64),
+        })
+        return left, right
+
+    def _both(self, left, right, domain, **kw):
+        from spark_rapids_jni_tpu.relational import (
+            hash_join,
+            join_dense_or_hash,
+        )
+
+        want, wn = hash_join(left, right, ["k"], ["k"], "inner", **kw)
+        got, gn = join_dense_or_hash(left, right, "k", "k", domain, **kw)
+        assert int(gn) == int(wn)
+        m = int(wn)
+        for name in want.names:
+            assert got[name].to_pylist()[:m] == \
+                want[name].to_pylist()[:m], name
+        return int(wn)
+
+    def test_dense_dim_matches_hash_join(self):
+        left, right = self._batches([3, 0, 7, 3, None, 9, 1],
+                                    list(range(8)))
+        # matches: 3, 0, 7, 3, 1 (null key and out-of-dim 9 both drop)
+        n = self._both(left, right, 8)
+        assert n == 5
+
+    def test_partial_dim_coverage(self):
+        # dim covers only even keys; odd fact keys must drop
+        left, right = self._batches([0, 1, 2, 3, 4, 5], [0, 2, 4])
+        n = self._both(left, right, 6)
+        assert n == 3
+
+    def test_duplicate_right_keys_fall_back(self):
+        # duplicate build keys -> dense check fails -> general engine
+        left, right = self._batches([1, 2, 1], [1, 1, 2])
+        n = self._both(left, right, 4)
+        assert n == 5  # rows with k=1 match twice
+
+    def test_out_of_domain_right_keys_fall_back(self):
+        left, right = self._batches([1, 2, 50], [1, 2, 50])
+        self._both(left, right, 4)  # 50 >= domain -> general engine
+
+    def test_valid_masks(self):
+        import jax.numpy as jnp
+
+        left, right = self._batches([0, 1, 2, 3], [0, 1, 2, 3])
+        lv = jnp.asarray([True, False, True, True])
+        rv = jnp.asarray([True, True, False, True])
+        self._both(left, right, 4, left_valid=lv, right_valid=rv)
+
+    def test_capacity_truncation_signals(self):
+        from spark_rapids_jni_tpu.relational import join_dense_or_hash
+
+        left, right = self._batches([0, 1, 2, 3], [0, 1, 2, 3])
+        got, gn = join_dense_or_hash(left, right, "k", "k", 4, capacity=2)
+        assert int(gn) == 4 and got.num_rows == 2  # count>capacity
+
+    def test_non_inner_delegates(self):
+        from spark_rapids_jni_tpu.relational import (
+            hash_join,
+            join_dense_or_hash,
+        )
+
+        left, right = self._batches([0, 5, 2], [0, 1, 2])
+        want, wn = hash_join(left, right, ["k"], ["k"], "left")
+        got, gn = join_dense_or_hash(left, right, "k", "k", 4, how="left")
+        assert int(gn) == int(wn)
+        m = int(wn)
+        for name in want.names:
+            assert got[name].to_pylist()[:m] == want[name].to_pylist()[:m]
+
+    def test_int64_wrap_keys_fall_back(self):
+        # an int64 key >= 2^32 wraps to a small int32; the runtime check
+        # must reject the dense path so no fabricated match appears
+        left = ColumnBatch({
+            "k": Column.from_pylist([3, (1 << 32) + 3], T.INT64),
+            "lv": Column.from_pylist([10, 20], T.INT64),
+        })
+        right = ColumnBatch({
+            "k": Column.from_pylist([3], T.INT64),
+            "rv": Column.from_pylist([100], T.INT64),
+        })
+        from spark_rapids_jni_tpu.relational import (
+            hash_join,
+            join_dense_or_hash,
+        )
+
+        want, wn = hash_join(left, right, ["k"], ["k"], "inner")
+        got, gn = join_dense_or_hash(left, right, "k", "k", 8)
+        assert int(gn) == int(wn) == 1
+        m = int(wn)
+        for name in want.names:
+            assert got[name].to_pylist()[:m] == want[name].to_pylist()[:m]
