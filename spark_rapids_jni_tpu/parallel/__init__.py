@@ -30,6 +30,7 @@ from .distributed import (
     distributed_group_by,
     distributed_group_by_2d,
     distributed_group_by_domain,
+    distributed_broadcast_join,
     distributed_hash_join,
     distributed_hash_join_2d,
     distributed_sort,
@@ -48,6 +49,7 @@ __all__ = [
     "distributed_group_by",
     "distributed_group_by_2d",
     "distributed_group_by_domain",
+    "distributed_broadcast_join",
     "distributed_hash_join",
     "distributed_hash_join_2d",
     "distributed_sort",

@@ -204,6 +204,82 @@ def _join_step(mesh, axis_name, left_on, right_on, how, capacity,
 
 
 
+def distributed_broadcast_join(
+    left: ColumnBatch,
+    right: ColumnBatch,
+    left_on: Sequence[str],
+    right_on: Sequence[str],
+    how: str,
+    mesh: Mesh,
+    axis_name: str = "data",
+    dense_domain: Optional[int] = None,
+    out_capacity: Optional[int] = None,
+):
+    """Broadcast-hash join: the build side is replicated to every device
+    and the sharded probe side never moves — ZERO exchange, vs the
+    two-sided shuffle :func:`distributed_hash_join` pays.  This is the
+    plan Spark picks for every small dimension join
+    (BroadcastHashJoinExec; the reference accelerates exactly those
+    plans), and on a TPU mesh it removes the all-to-all entirely — the
+    only collective cost is XLA replicating the (small) build batch.
+
+    With ``dense_domain`` set and a single join key, each device's local
+    join takes the dense rowid-table path
+    (:func:`~spark_rapids_jni_tpu.relational.join.join_dense_or_hash`);
+    otherwise the general sort-probe engine runs locally.
+
+    Join types: inner / left / semi / anti — the ones whose output is a
+    function of each (probe row, whole build side) pair, so per-shard
+    results compose globally.  ``right``/``full`` emit unmatched BUILD
+    rows, and a replicated build row unmatched on one shard may match on
+    another — every device would append its own copy, inflating the
+    global result — so those types raise here; use
+    :func:`distributed_hash_join` for them.
+
+    Returns ``(result, counts int32[P])`` — result rows are
+    device-local with each shard's matches compacted in front (same
+    layout contract as :func:`distributed_hash_join`, minus the
+    ``dropped`` output: nothing is exchanged, so nothing can drop).
+    """
+    if how in ("right", "full"):
+        raise ValueError(
+            f"broadcast join cannot run {how!r}: unmatched build rows "
+            "are per-shard facts on a replicated build side (each device "
+            "would emit its own copy) — use distributed_hash_join")
+    if len(left_on) != len(right_on):
+        raise ValueError("left_on/right_on length mismatch")
+    step = _bcast_join_step(
+        mesh, axis_name, tuple(left_on), tuple(right_on), how,
+        None if dense_domain is None else int(dense_domain), out_capacity)
+    return step(left, right)
+
+
+@lru_cache(maxsize=None)
+def _bcast_join_step(mesh, axis_name, left_on, right_on, how, dense_domain,
+                     out_capacity):
+    from ..relational.join import hash_join, join_dense_or_hash
+
+    spec = PartitionSpec(axis_name)
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(spec, PartitionSpec()),  # build side replicated
+        out_specs=(spec, spec), check_vma=False,
+    )
+    def step(lb: ColumnBatch, rb: ColumnBatch):
+        if (dense_domain is not None and len(left_on) == 1
+                and len(right_on) == 1):
+            out, count = join_dense_or_hash(
+                lb, rb, left_on[0], right_on[0], dense_domain, how,
+                capacity=out_capacity)
+        else:
+            out, count = hash_join(lb, rb, list(left_on), list(right_on),
+                                   how, capacity=out_capacity)
+        return out, count[None]
+
+    return jax.jit(step)
+
+
 def _sample_splitters(batch: ColumnBatch, key_names, P: int):
     """Host-side sample-sort splitter plan shared by the 1-D and 2-D
     sorts: strided sample of the radix key words, P-1 picks."""

@@ -50,6 +50,15 @@ def _freeze_compiled_state():
     yield
     import gc
 
+    import jax as _jax
+
+    # Release the module's compiled executables BEFORE freezing: the
+    # cyclic-GC cost is gone either way, and clearing also bounds the
+    # native-side accumulation (XLA-CPU's process-global compile state
+    # segfaulted at ~240 accumulated suite programs in the r5 validation
+    # run — modules rarely share shapes, so cross-module recompiles are
+    # negligible).
+    _jax.clear_caches()
     gc.collect()
     gc.freeze()
 

@@ -544,3 +544,83 @@ def test_distributed_domain_combine_overflow_flag():
     _, _, ovf = distributed_group_by_domain(
         shard_batch(b, mesh), "k", [AggSpec("count", None, "c")], 16, mesh)
     assert bool(ovf)
+
+
+def test_distributed_broadcast_join_matches_global():
+    """Broadcast join (replicated build side, zero exchange) must produce
+    the same global match multiset as a single-device hash_join, with
+    per-device counts consistent — dense rowid path and general path."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_jni_tpu.columnar import types as T
+    from spark_rapids_jni_tpu.columnar.column import Column, ColumnBatch
+    from spark_rapids_jni_tpu.parallel import (
+        data_mesh,
+        distributed_broadcast_join,
+        shard_batch,
+    )
+    from spark_rapids_jni_tpu.relational import hash_join
+
+    ndev = 8
+    mesh = data_mesh(ndev)
+    n = 256
+    rng = np.random.default_rng(31)
+    lk = rng.integers(0, 40, n).astype(np.int32)   # 32..39 miss the dim
+    fact = ColumnBatch({
+        "k": Column(jnp.asarray(lk), jnp.ones((n,), jnp.bool_), T.INT32),
+        "lv": Column(jnp.arange(n, dtype=jnp.int64),
+                     jnp.ones((n,), jnp.bool_), T.INT64),
+    })
+    dim = ColumnBatch({
+        "k": Column(jnp.arange(32, dtype=jnp.int32),
+                    jnp.ones((32,), jnp.bool_), T.INT32),
+        "rv": Column(jnp.arange(32, dtype=jnp.int64) * 100,
+                     jnp.ones((32,), jnp.bool_), T.INT64),
+    })
+    want, wn = hash_join(fact, dim, ["k"], ["k"], "inner")
+    m = int(wn)
+    want_rows = sorted(zip(want["k"].to_pylist()[:m],
+                           want["lv"].to_pylist()[:m],
+                           want["rv"].to_pylist()[:m]))
+
+    sharded = shard_batch(fact, mesh)
+    for dense in (32, None):  # rowid-table path and general local engine
+        out, counts = distributed_broadcast_join(
+            sharded, dim, ["k"], ["k"], "inner", mesh, dense_domain=dense)
+        jax.block_until_ready(counts)
+        cnts = np.asarray(jax.device_get(counts))
+        assert int(cnts.sum()) == m, (dense, cnts)
+        per_dev = out.num_rows // ndev
+        ks = np.asarray(jax.device_get(out["k"].data))
+        lv = np.asarray(jax.device_get(out["lv"].data))
+        rv = np.asarray(jax.device_get(out["rv"].data))
+        got_rows = []
+        for d in range(ndev):
+            lo = d * per_dev
+            got_rows += [(int(ks[lo + i]), int(lv[lo + i]),
+                          int(rv[lo + i])) for i in range(int(cnts[d]))]
+        assert sorted(got_rows) == want_rows, dense
+
+
+def test_distributed_broadcast_join_rejects_build_side_outer():
+    """right/full emit unmatched BUILD rows — per-shard facts on a
+    replicated build side — so the broadcast join must refuse them."""
+    import pytest as _pytest
+
+    from spark_rapids_jni_tpu.columnar import types as T
+    from spark_rapids_jni_tpu.columnar.column import Column, ColumnBatch
+    from spark_rapids_jni_tpu.parallel import (
+        data_mesh,
+        distributed_broadcast_join,
+    )
+
+    mesh = data_mesh(8)
+    b = ColumnBatch({"k": Column.from_pylist(list(range(8)), T.INT32)})
+    for how in ("right", "full"):
+        with _pytest.raises(ValueError, match="broadcast"):
+            distributed_broadcast_join(b, b, ["k"], ["k"], how, mesh)
+    with _pytest.raises(ValueError, match="mismatch"):
+        distributed_broadcast_join(b, b, ["k"], ["k", "x"], "inner", mesh)
