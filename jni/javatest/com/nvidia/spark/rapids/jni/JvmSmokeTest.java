@@ -98,7 +98,9 @@ public final class JvmSmokeTest {
       check(RmmSpark.getAndResetNumRetryThrow(1) >= 1,
           "retry metric recorded for task 1");
 
-      // the ladder recovers: a fresh allocation succeeds afterwards
+      // the documented ladder: roll back, block until the scheduler
+      // wakes this thread, then retry — the retry allocation succeeds
+      RmmSpark.blockThreadUntilReady();
       RmmSpark.allocate(256);
       RmmSpark.deallocate(256);
 

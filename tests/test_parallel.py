@@ -319,34 +319,6 @@ def test_regroup_order_engines_match_stable_argsort():
             assert (got == want).all(), (n, slots, engine)
 
 
-def test_q95_step_matches_numpy_oracle():
-    """The bench's q95 pipeline (exchange -> join -> exchange -> join ->
-    domain group-by) end-to-end against a numpy oracle: the dims have
-    unique keys covering every fact row, so the joins are filters and
-    the group sums are bincounts."""
-    import numpy as np
-
-    import __graft_entry__ as ge
-
-    fact, dim1, dim2 = ge._q95_batches(2048, seed=23)
-    res, ng = ge._q95_step(fact, dim1, dim2)
-    m = int(np.asarray(ng))
-    got_orders = dict(zip(res["seg"].to_pylist()[:m],
-                          res["orders"].to_pylist()[:m]))
-    got_net = dict(zip(res["seg"].to_pylist()[:m],
-                       res["net"].to_pylist()[:m]))
-    seg = np.asarray(fact["seg"].data)
-    v = np.asarray(fact["v"].data)
-    want_orders = {s: int(c) for s, c in enumerate(
-        np.bincount(seg, minlength=ge.Q95_SEG)) if c}
-    want_net = {s: int(t) for s, t in enumerate(
-        np.bincount(seg, weights=v.astype(np.float64),
-                    minlength=ge.Q95_SEG).astype(np.int64))
-        if want_orders.get(s)}
-    assert got_orders == want_orders
-    assert got_net == want_net
-
-
 def test_exchange_hierarchical_reserved_name():
     import jax.numpy as jnp
     import pytest as _pytest

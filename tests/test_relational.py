@@ -544,6 +544,57 @@ class TestQueryShapes:
         assert live and max(live) <= 100
 
 
+def test_q95_step_matches_numpy_oracle():
+    """The bench's q95 pipeline (exchange -> join -> exchange -> join ->
+    domain group-by) end-to-end against a numpy oracle: the dims have
+    unique keys covering every fact row, so the joins are filters and
+    the group sums are bincounts."""
+    import __graft_entry__ as ge
+
+    fact, dim1, dim2 = ge._q95_batches(2048, seed=23)
+    res, ng = ge._q95_step(fact, dim1, dim2)
+    m = int(np.asarray(ng))
+    got_orders = dict(zip(res["seg"].to_pylist()[:m],
+                          res["orders"].to_pylist()[:m]))
+    got_net = dict(zip(res["seg"].to_pylist()[:m],
+                       res["net"].to_pylist()[:m]))
+    seg = np.asarray(fact["seg"].data)
+    v = np.asarray(fact["v"].data)
+    want_orders = {s: int(c) for s, c in enumerate(
+        np.bincount(seg, minlength=ge.Q95_SEG)) if c}
+    want_net = {s: int(t) for s, t in enumerate(
+        np.bincount(seg, weights=v.astype(np.float64),
+                    minlength=ge.Q95_SEG).astype(np.int64))
+        if want_orders.get(s)}
+    assert got_orders == want_orders
+    assert got_net == want_net
+
+
+
+def test_q3_step_matches_numpy_oracle():
+    """q3 shape end-to-end (dense dim join + domain group-by): the dim
+    covers every fact key, so group sums reduce to bincounts."""
+    import __graft_entry__ as ge
+
+    fact, dim = ge._q3_batches(1024, seed=23)
+    res, ng = ge._q3_step(fact, dim)
+    m = int(np.asarray(ng))
+    got_rev = dict(zip(res["seg"].to_pylist()[:m],
+                       res["rev"].to_pylist()[:m]))
+    got_cnt = dict(zip(res["seg"].to_pylist()[:m],
+                       res["cnt"].to_pylist()[:m]))
+    seg = np.asarray(fact["seg"].data)
+    v = np.asarray(fact["v"].data)
+    want_cnt = {s: int(c) for s, c in enumerate(np.bincount(seg, minlength=5))
+                if c}
+    want_rev = {s: int(t) for s, t in enumerate(
+        np.bincount(seg, weights=v.astype(np.float64),
+                    minlength=5).astype(np.int64)) if want_cnt.get(s)}
+    assert got_cnt == want_cnt
+    assert got_rev == want_rev
+
+
+
 class TestGroupByOnehot:
     """MXU one-hot path must agree with the sort-scan group_by exactly
     (int sums bit-exact incl. wraparound; float sums within order
