@@ -164,13 +164,14 @@ def _extract(chars, lengths, validity, max_pairs_per_row):
     offsets = jnp.concatenate(
         [jnp.zeros((1,), i32), jnp.cumsum(counts).astype(i32)])
 
-    # flatten pair events row-major and front-compact with a flag sort
+    # flatten pair events row-major and front-compact (platform-aware
+    # stable regroup, r5: counting scatter on CPU, lax.sort elsewhere)
+    from ..parallel.partition import regroup_order
+
     L1 = L + 1
     flat_pair = pair.reshape(n * L1)
-    flat_idx = jnp.arange(n * L1, dtype=i32)
-    order = jax.lax.sort(
-        ((~flat_pair).astype(jnp.uint32), flat_idx), num_keys=1,
-        is_stable=True)[1]
+    order = regroup_order(
+        jnp.where(flat_pair, 0, 1).astype(i32), 2)
     C = n * max_pairs_per_row
     picks = order[:C]
     total = counts.sum()

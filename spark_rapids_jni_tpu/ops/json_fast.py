@@ -491,19 +491,19 @@ def fast_path(chars, lengths, validity, path_tuple, max_out):
                      jnp.take_along_axis(ch, src, axis=1), _U8(0))
 
     # container-compact channel: keep = non-ws within span (strings keep
-    # everything incl. quotes); compact via a 2-operand flag sort.  The
-    # sort only runs when some live row actually has a container target
+    # everything incl. quotes); compacted by left_compact_rows (counting
+    # scatter on CPU, stable argsort on accelerators).  The compaction
+    # only runs when some live row actually has a container target
     # (lax.cond) — the common scalar extraction skips it entirely.
     any_cont = jnp.any(alive & t_is_cont)
 
     def compact_containers(_):
+        # platform-aware row compaction (r5): counting scatter on CPU,
+        # stable argsort on accelerators
+        from .strings import left_compact_rows
+
         keep = in_tspan & (content | isq | (outside & ~ws))
-        flag = (~keep).astype(jnp.uint32)
-        perm = jax.lax.sort(
-            (flag, jnp.broadcast_to(pos, (n, L)).astype(_I32)),
-            num_keys=1, is_stable=True)[1]
-        packed = jnp.take_along_axis(ch, perm, axis=1)
-        return packed, jnp.sum(keep, axis=1, dtype=_I32)
+        return left_compact_rows(ch, keep)
 
     packed, c_len = jax.lax.cond(
         any_cont, compact_containers,
