@@ -22,26 +22,31 @@ from ..columnar.column import StringColumn
 from .regex_rewrite import _decode_utf8
 
 
-def left_compact_rows(mat, keep):
+def left_compact_rows(mat, keep, engine: str = "auto"):
     """Stable left-compaction of kept cells per row; returns
     ``(compacted, counts)`` with the tail beyond each row's count
     zeroed.
 
     The engine is a hardware fact (same pattern as
-    ``parallel.regroup_order``, r5): on CPU a per-row counting
-    compaction — rank kept cells with one masked cumsum, invert the
-    destination map with ONE scatter — because a ``[n, L]`` stable sort
-    is XLA-CPU's worst primitive (the argsort formulation measured
-    ~630 ms for 16K x 788 bytes in the qstr pipeline; the counting path
-    is linear).  On accelerators the stable argsort stays: sorts lower
-    natively on TPU while per-element scatters serialize (BASELINE.md
-    r2 primitive costs).
+    ``parallel.regroup_order``, r5): on CPU (``'scatter'``) a per-row
+    counting compaction — rank kept cells with one masked cumsum,
+    invert the destination map with ONE scatter — because a ``[n, L]``
+    stable sort is XLA-CPU's worst primitive (the argsort formulation
+    measured ~630 ms for 16K x 788 bytes in the qstr pipeline; the
+    counting path is linear).  On accelerators (``'sort'``) the stable
+    argsort stays: sorts lower natively on TPU while per-element
+    scatters serialize (BASELINE.md r2 primitive costs).  ``'auto'``
+    picks by backend; the explicit names exist for tests and A/Bs.
     """
     import jax
 
+    if engine == "auto":
+        engine = "scatter" if jax.default_backend() == "cpu" else "sort"
+    if engine not in ("scatter", "sort"):
+        raise ValueError(f"unknown compaction engine {engine!r}")
     n, L = mat.shape
     counts = jnp.sum(keep, axis=1).astype(jnp.int32)
-    if jax.default_backend() == "cpu":
+    if engine == "scatter":
         ki = keep.astype(jnp.int32)
         within = jnp.cumsum(ki, axis=1) - ki       # rank among kept
         dest = jnp.where(keep, within, L)          # L = discard column

@@ -49,3 +49,28 @@ def test_substring_multibyte_and_nulls():
 def test_substring_to_end():
     col = StringColumn.from_pylist(["abcdef"])
     assert substring(col, 3).to_pylist() == ["cdef"]
+
+
+def test_left_compact_rows_counting_matches_argsort():
+    """The CPU counting compaction must be bit-identical to the stable
+    argsort formulation it replaces (r5; shared by substring, the JSON
+    container channel, and from_json) — including empty rows, all-kept
+    rows, and n=1 edges."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spark_rapids_jni_tpu.ops.strings import left_compact_rows
+
+    rng = np.random.default_rng(13)
+    cases = [(257, 91, 0.4), (64, 8, 0.0), (64, 8, 1.0), (1, 5, 0.5)]
+    for n, L, p in cases:
+        mat = jnp.asarray(rng.integers(1, 255, (n, L)).astype(np.uint8))
+        keep = jnp.asarray(rng.random((n, L)) < p) if 0 < p < 1 else \
+            jnp.full((n, L), bool(p))
+        # explicit engines so BOTH formulations run on any backend
+        got_s, cnt = left_compact_rows(mat, keep, engine="scatter")
+        got_a, cnt_a = left_compact_rows(mat, keep, engine="sort")
+        assert (np.asarray(got_s) == np.asarray(got_a)).all(), (n, L, p)
+        assert (np.asarray(cnt) == np.asarray(cnt_a)).all()
+        assert (np.asarray(cnt) ==
+                np.asarray(keep).sum(axis=1)).all(), (n, L, p)
