@@ -596,3 +596,58 @@ def test_distributed_broadcast_join_rejects_build_side_outer():
             distributed_broadcast_join(b, b, ["k"], ["k"], how, mesh)
     with _pytest.raises(ValueError, match="mismatch"):
         distributed_broadcast_join(b, b, ["k"], ["k", "x"], "inner", mesh)
+
+
+def test_distributed_broadcast_join_semi_anti():
+    """semi/anti through the broadcast join: per-shard filtered left
+    rows must union to the single-device result (left rows live on
+    exactly one shard, so the filter composes globally)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_jni_tpu.columnar import types as T
+    from spark_rapids_jni_tpu.columnar.column import Column, ColumnBatch
+    from spark_rapids_jni_tpu.parallel import (
+        data_mesh,
+        distributed_broadcast_join,
+        shard_batch,
+    )
+    from spark_rapids_jni_tpu.relational import hash_join
+
+    ndev = 8
+    mesh = data_mesh(ndev)
+    n = 128
+    rng = np.random.default_rng(7)
+    lk = rng.integers(0, 20, n).astype(np.int32)  # keys 10..19 miss
+    fact = ColumnBatch({
+        "k": Column(jnp.asarray(lk), jnp.ones((n,), jnp.bool_), T.INT32),
+        "lv": Column(jnp.arange(n, dtype=jnp.int64),
+                     jnp.ones((n,), jnp.bool_), T.INT64),
+    })
+    dim = ColumnBatch({
+        "k": Column(jnp.arange(10, dtype=jnp.int32),
+                    jnp.ones((10,), jnp.bool_), T.INT32),
+        "rv": Column(jnp.arange(10, dtype=jnp.int64),
+                     jnp.ones((10,), jnp.bool_), T.INT64),
+    })
+    for how in ("semi", "anti"):
+        want, wn = hash_join(fact, dim, ["k"], ["k"], how)
+        m = int(wn)
+        want_rows = sorted(zip(want["k"].to_pylist()[:m],
+                               want["lv"].to_pylist()[:m]))
+        out, counts = distributed_broadcast_join(
+            shard_batch(fact, mesh), dim, ["k"], ["k"], how, mesh)
+        jax.block_until_ready(counts)
+        cnts = np.asarray(jax.device_get(counts))
+        assert int(cnts.sum()) == m, (how, cnts)
+        per_dev = out.num_rows // ndev
+        ks = np.asarray(jax.device_get(out["k"].data))
+        lv = np.asarray(jax.device_get(out["lv"].data))
+        got = []
+        for d in range(ndev):
+            lo = d * per_dev
+            got += [(int(ks[lo + i]), int(lv[lo + i]))
+                    for i in range(int(cnts[d]))]
+        assert sorted(got) == want_rows, how
