@@ -1,0 +1,81 @@
+"""The session-slot flock protocol (tools/tunnel_watch.py).
+
+One TPU client at a time is the hardest operational invariant in this
+project (two clients = the tunnel-wedge scenario, BASELINE.md); these
+tests pin the lock's contract with real processes: atomic acquisition,
+bounded give-up, takeover after release, and kernel release when the
+holder dies without cleanup.
+
+The module global ``tw.LOCK`` is pointed at a temp path in every
+process (never the LIVE session slot — a real measurement session could
+be holding it), and children start via the ``spawn`` context: ``fork``
+from a JAX-multithreaded pytest process risks forking while an internal
+lock is held and deadlocking the child.
+"""
+
+import importlib.util
+import multiprocessing as mp
+import os
+import tempfile
+import time
+
+
+def _load_tw(lock_path):
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "tw", os.path.join(root, "tools", "tunnel_watch.py"))
+    tw = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tw)
+    tw.LOCK = lock_path
+    return tw
+
+
+def _tmp_lock():
+    return os.path.join(tempfile.gettempdir(),
+                        f"srj_test_lock_{os.getpid()}")
+
+
+def _holder(q, hold_s, lock_path):
+    tw = _load_tw(lock_path)
+    fd, _ = tw.acquire_lock(1)
+    q.put("held")
+    time.sleep(hold_s)
+    os.close(fd)
+
+
+def _dier(q, lock_path):
+    tw = _load_tw(lock_path)
+    fd, _ = tw.acquire_lock(1)
+    q.put("held")
+    time.sleep(0.5)  # let the queue feeder flush before dying
+    os._exit(1)      # exits holding the lock
+
+
+def test_bounded_giveup_and_takeover():
+    lock = _tmp_lock()
+    tw = _load_tw(lock)
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    p = ctx.Process(target=_holder, args=(q, 6, lock))
+    p.start()
+    assert q.get(timeout=30) == "held"
+    fd, waited = tw.acquire_lock(0.5)     # bounded: must give up fast
+    assert fd is None and waited < 3
+    fd2, waited2 = tw.acquire_lock(10)    # then wait out the holder
+    assert fd2 is not None and 1 < waited2 < 11
+    os.close(fd2)
+    p.join()
+
+
+def test_dead_owner_releases_lock():
+    lock = _tmp_lock()
+    tw = _load_tw(lock)
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    p = ctx.Process(target=_dier, args=(q, lock))
+    p.start()
+    assert q.get(timeout=30) == "held"
+    p.join()
+    fd, waited = tw.acquire_lock(10)      # kernel released the flock
+    assert fd is not None and waited < 5
+    os.close(fd)
