@@ -22,6 +22,10 @@ consumers comparing ``value`` across runs must read ``unit``.
 ``python bench.py --micro`` additionally runs per-kernel microbenchmarks
 mirroring the reference's five nvbench targets (BASELINE.md): row
 conversion, string→float, bloom build+probe, murmur3/xxhash64, group-by.
+
+``python bench.py --spill`` runs the q6 shape under an oversubscribed
+device arena with the tiered spill framework installed; its JSON line adds
+``spill_*_bytes`` counters so captures track spill overhead.
 """
 
 import json
@@ -298,6 +302,109 @@ def child_main():
             "platform": platform, "rows": nq}), flush=True)
     except Exception as e:  # informative stage: never fail the capture
         print(f"# q95 stage failed: {e}", file=sys.stderr, flush=True)
+    return 0
+
+
+# --------------------------------------------------------------------------
+# spill scenario (--spill): q6 under an oversubscribed device arena
+# --------------------------------------------------------------------------
+
+def spill_main():
+    """Two concurrent q6-shaped tasks under a device arena capped below
+    their combined working set, with the spill framework installed and NO
+    manual ``make_spillable`` — completion requires automatic cross-task
+    device→host→disk eviction and read-back.  The emitted line carries the
+    per-transition spill-bytes counters so BENCH_*.json tracks spill
+    overhead round over round alongside throughput."""
+    import tempfile
+    import threading
+
+    import jax
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        platform = jax.devices()[0].platform
+    except Exception as e:  # backend init failure → parent falls back
+        print(f"# backend init failed: {e}", file=sys.stderr, flush=True)
+        return 17
+
+    import __graft_entry__ as ge
+    from spark_rapids_jni_tpu import mem
+    from spark_rapids_jni_tpu.mem.rmm_spark import RmmSpark
+
+    n_rows = int(os.environ.get("BENCH_SPILL_ROWS", str(1 << 16)))
+    n_batches = int(os.environ.get("BENCH_SPILL_BATCHES", "4"))
+    batch_bytes = mem.batch_nbytes(ge._example_batch(n_rows, seed=7))
+    # device arena: 2.5 batches vs the 2x3 live batches the tasks hold at
+    # peak; host tier below ONE batch so demotion cascades to disk
+    pool = int(batch_bytes * 2.5)
+    host_pool = max(batch_bytes // 2, 1 << 16)
+    spill_dir = tempfile.mkdtemp(prefix="bench_spill_")
+    jfn = jax.jit(ge._q6_step)
+    jax.block_until_ready(jfn(ge._example_batch(n_rows, seed=7)))  # warm
+
+    RmmSpark.set_event_handler(pool, host_pool_bytes=host_pool,
+                               poll_ms=10.0)
+    mem.install_spill_framework(spill_dir=spill_dir)
+    fw = mem.get_spill_framework()
+    failures = []
+    t0 = time.perf_counter()
+
+    def task(task_id, seed0):
+        try:
+            with mem.TaskContext(task_id) as ctx:
+                held = []
+                for i in range(n_batches):
+                    def step(i=i):
+                        b = ge._example_batch(n_rows, seed=seed0 + i)
+                        h = mem.SpillableHandle(
+                            b, ctx=ctx, name=f"bench-t{task_id}-{i}")
+                        jax.block_until_ready(jfn(b))
+                        return h
+                    held.append(mem.run_with_retry(step, max_retries=50))
+                    if len(held) > 3:
+                        held.pop(0).close()
+                # read back the survivors: disk→host→device + recompute
+                for h in held:
+                    def read(h=h):
+                        jax.block_until_ready(jfn(h.get()))
+                    mem.run_with_retry(read, max_retries=50)
+                    h.close()
+        except Exception as e:
+            failures.append(f"task {task_id}: {e!r}")
+
+    threads = [threading.Thread(target=task, args=(tid, 100 * tid),
+                                name=f"bench-spill-{tid}")
+               for tid in (1, 2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    snap = fw.metrics.snapshot()
+    mem.shutdown_spill_framework()
+    RmmSpark.clear_event_handler()
+    if failures:
+        print(f"# spill scenario failed: {failures}", file=sys.stderr,
+              flush=True)
+        return 1
+    total_rows = 2 * n_batches * n_rows
+    print(json.dumps({
+        "metric": "q6_spill_oversubscribed",
+        "value": round(total_rows / dt / 1e6, 2),
+        "unit": "Mrows/s",
+        "platform": platform,
+        "rows": total_rows,
+        "device_pool_bytes": pool,
+        "host_pool_bytes": host_pool,
+        "spill_device_to_host_bytes": snap["device_to_host_bytes"],
+        "spill_host_to_disk_bytes": snap["host_to_disk_bytes"],
+        "spill_disk_read_bytes": snap["disk_to_host_bytes"],
+        "spill_read_back_bytes": snap["host_to_device_bytes"],
+        "spill_eviction_ms": round(snap["eviction_ns"] / 1e6, 2),
+        "spill_disk_write_failures": snap["disk_write_failures"],
+    }), flush=True)
     return 0
 
 
@@ -905,11 +1012,15 @@ def main():
         sys.exit(child_main())
     if mode == "--child-micro":
         sys.exit(micro_main())
+    if mode == "--child-spill":
+        sys.exit(spill_main())
     if mode == "--probe":
         sys.exit(_probe_main())
 
     run_micro = mode == "--micro"
-    child_mode = "--child-micro" if run_micro else "--child"
+    run_spill = mode == "--spill"
+    child_mode = ("--child-micro" if run_micro
+                  else "--child-spill" if run_spill else "--child")
     t0 = time.monotonic()
 
     def left():
@@ -947,7 +1058,9 @@ def main():
     if lines is None:
         # Last resort: still emit a valid line so the harness records
         # *something*, labeled for the mode that actually failed.
-        metric = "micro_suite" if run_micro else "q6_pipeline_throughput"
+        metric = ("micro_suite" if run_micro
+                  else "q6_spill_oversubscribed" if run_spill
+                  else "q6_pipeline_throughput")
         print(json.dumps({
             "metric": metric,
             "value": 0.0,

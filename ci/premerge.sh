@@ -35,6 +35,13 @@ else
   echo "glue driver above already executed the JNIEXPORT layer)"
 fi
 
+# spill framework first and by name: cross-task eviction + host/disk
+# tiers gate everything that allocates under pressure, so a spill
+# regression should fail fast before the full chunked sweep below
+# (which also re-runs this file via its tests/test_*.py glob)
+JAX_PLATFORMS=cpu python -m pytest tests/test_spill.py -q \
+  -p no:cacheprovider -p no:randomly
+
 # full suite, one pytest process per file: a single long-lived process
 # over the whole suite degraded pathologically on a 1-core box (round 4:
 # >4h and never finished vs 38 min chunked, same tests)

@@ -305,6 +305,24 @@ class ColumnBatch:
     def to_pydict(self) -> dict:
         return {n: c.to_pylist() for n, c in zip(self._names, self._cols)}
 
+    @property
+    def device_nbytes(self) -> int:
+        """HBM footprint of the batch's distinct buffers (aliased columns
+        count once) — what a TaskContext charge or a spill would move."""
+        from ..mem import batch_nbytes
+
+        return batch_nbytes(self)
+
+    def spillable(self, ctx=None, name: Optional[str] = None):
+        """Register this batch with the spill framework: returns a
+        ``SpillableHandle`` the central store can demote device→host→disk
+        under pressure (charged to ``ctx`` when given).  The batch object
+        itself should be dropped after this — the handle's ``get()`` is
+        the live reference."""
+        from ..mem import SpillableHandle
+
+        return SpillableHandle(self, ctx=ctx, name=name)
+
     def __repr__(self):
         inner = ", ".join(f"{n}={c!r}" for n, c in zip(self._names, self._cols))
         return f"ColumnBatch({inner})"

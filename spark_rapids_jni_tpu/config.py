@@ -74,6 +74,11 @@ _register("json_scan_unroll", 2, int,
           "the scan as the fallback branch of every wildcard-free query, "
           "so the default is a compile-friendly 2 now that the "
           "bit-parallel fast path carries clean batches.")
+_register("spill_dir", "", str,
+          "Directory for the spill framework's disk tier (mem/spill.py). "
+          "Empty (default) = a fresh mkdtemp owned — and removed — by "
+          "the SpillFramework; set it to put spill files on a chosen "
+          "volume (reference: spark.local.dir for RapidsDiskStore).")
 _register("shuffle_capacity_bucket", 256, int,
           "Rounding bucket for auto-planned exchange capacities (bigger = "
           "fewer recompiles, more slot padding).")

@@ -20,7 +20,10 @@ boundary — instrumented jitted callables — since there is no CUPTI:
   CudfException analogue), ``"oom"`` raises
   :class:`~spark_rapids_jni_tpu.mem.RetryOOM` (driving the rollback
   ladder), ``"fatal"`` raises :class:`FatalInjectedFault` (the
-  device-trap analogue — callers must treat the executor as poisoned).
+  device-trap analogue — callers must treat the executor as poisoned),
+  ``"spill_io"`` raises :class:`SpillIOError` at the spill framework's
+  disk boundary (names ``spill_io_write``/``spill_io_read``) — the
+  framework degrades by keeping the batch in the higher tier.
 * ``dynamic: true`` re-reads the file when its mtime changes, matching
   the injector's ``dynamicReconfig`` thread without needing one.
 
@@ -53,13 +56,21 @@ class FatalInjectedFault(RuntimeError):
     """Fatal injected failure (the device trap/assert analogue)."""
 
 
+class SpillIOError(OSError):
+    """Injected spill-path disk failure (kind ``"spill_io"``).
+
+    Subclasses :class:`OSError` so the spill framework's degradation
+    path — keep the batch in the higher tier, count the failure — handles
+    injected and real disk faults identically."""
+
+
 class _Rule:
     def __init__(self, spec: dict):
         self.match = spec.get("match", "*")
         self.probability = float(spec.get("probability", 1.0))
         self.count = spec.get("count")  # None = unlimited
         self.fault = spec.get("fault", "exception")
-        if self.fault not in ("exception", "oom", "fatal"):
+        if self.fault not in ("exception", "oom", "fatal", "spill_io"):
             raise ValueError(f"unknown fault kind {self.fault!r}")
         self.remaining = None if self.count is None else int(self.count)
 
@@ -135,6 +146,8 @@ class _Injector:
             raise RetryOOM(f"injected OOM at {name}")
         if kind == "fatal":
             raise FatalInjectedFault(f"injected fatal fault at {name}")
+        if kind == "spill_io":
+            raise SpillIOError(f"injected spill I/O fault at {name}")
         raise InjectedFault(f"injected exception at {name}")
 
 

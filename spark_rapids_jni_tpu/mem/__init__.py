@@ -12,15 +12,29 @@ surface (``RmmSpark.java``, ``SparkResourceAdaptor.java``,
   registration + allocate/deallocate + OOM-injection + metrics API.
 * :class:`RetryOOM` / :class:`SplitAndRetryOOM` / … — unchecked-exception
   equivalents the query engine catches to roll back, spill, and retry.
+* :mod:`~spark_rapids_jni_tpu.mem.spill` — the tiered spill framework
+  (the plugin-side SpillableDeviceStore/SpillableHostStore equivalent):
+  a central registry with task-aware LRU eviction device→host→disk,
+  bounded host tier, and per-transition spill metrics.
 """
 
 from .executor import (  # noqa: F401
     Spillable,
     TaskContext,
     batch_nbytes,
+    current_task_id,
     is_device_oom,
     run_with_retry,
     translate_device_oom,
+)
+from .spill import (  # noqa: F401
+    SpillableHandle,
+    SpillableStore,
+    SpillFramework,
+    SpillMetrics,
+    get_framework as get_spill_framework,
+    install as install_spill_framework,
+    shutdown as shutdown_spill_framework,
 )
 from .rmm_spark import (  # noqa: F401
     CpuRetryOOM,

@@ -21,6 +21,7 @@ from typing import Callable, Optional
 
 import jax
 
+from . import spill as spill_mod
 from .rmm_spark import (
     CpuRetryOOM,
     CpuSplitAndRetryOOM,
@@ -31,16 +32,49 @@ from .rmm_spark import (
 )
 
 
+def _buffer_key(leaf):
+    """Identity of the underlying buffer, so aliased leaves dedupe.
+
+    jax arrays expose the device buffer address via
+    ``unsafe_buffer_pointer``; anything without one (numpy, scalars)
+    falls back to object identity — same array object twice is still one
+    buffer."""
+    ptr = getattr(leaf, "unsafe_buffer_pointer", None)
+    if ptr is not None:
+        try:
+            return ("ptr", ptr())
+        except Exception:
+            pass
+    return ("id", id(leaf))
+
+
 def batch_nbytes(tree) -> int:
-    """Total device bytes of every array in a pytree (ColumnBatch etc.)."""
+    """Total device bytes of every DISTINCT array buffer in a pytree
+    (ColumnBatch etc.).  A tree referencing the same buffer twice — a
+    column reused across two struct fields, a shared validity mask —
+    charges the arena once, matching what the device actually holds."""
     total = 0
+    seen = set()
     for leaf in jax.tree_util.tree_leaves(tree):
         size = getattr(leaf, "size", None)
         dtype = getattr(leaf, "dtype", None)
         if size is None or dtype is None:
             continue
+        key = _buffer_key(leaf)
+        if key in seen:
+            continue
+        seen.add(key)
         total += int(size) * jax.numpy.dtype(dtype).itemsize
     return total
+
+
+_task_tls = threading.local()
+
+
+def current_task_id() -> Optional[int]:
+    """Task id of the innermost active :class:`TaskContext` on this
+    thread, or None outside any context."""
+    return getattr(_task_tls, "task_id", None)
 
 
 class TaskContext:
@@ -57,10 +91,24 @@ class TaskContext:
         self.task_id = task_id
         self._charged = 0
         self._lock = threading.Lock()
+        self._handles: set = set()
+        self._prev_task_id = None
 
     def __enter__(self):
         RmmSpark.current_thread_is_dedicated_to_task(self.task_id)
+        self._prev_task_id = getattr(_task_tls, "task_id", None)
+        _task_tls.task_id = self.task_id
         return self
+
+    # -- spillable-handle adoption (mem/spill.py registers here so exit
+    #    auto-closes whatever the task leaked) --------------------------
+    def _adopt(self, handle):
+        with self._lock:
+            self._handles.add(handle)
+
+    def _forget(self, handle):
+        with self._lock:
+            self._handles.discard(handle)
 
     def charge(self, tree_or_bytes) -> int:
         n = (tree_or_bytes if isinstance(tree_or_bytes, int)
@@ -76,10 +124,19 @@ class TaskContext:
             self._charged -= nbytes
 
     def __exit__(self, *exc):
+        # close adopted handles FIRST: each releases its own device/host
+        # charge and deletes its disk files, then unregisters from the
+        # spill store — after this the leftover below is only what the
+        # step charged directly and never released
+        with self._lock:
+            handles = list(self._handles)
+        for h in handles:
+            h.close()
         with self._lock:
             leftover, self._charged = self._charged, 0
         if leftover > 0:
             RmmSpark.deallocate(leftover)
+        _task_tls.task_id = self._prev_task_id
         RmmSpark.remove_current_thread_association()
         return False
 
@@ -144,12 +201,37 @@ def run_with_retry(
       input) and retry immediately — the scheduler guarantees this thread
       is the only one running.
 
+    With a :class:`~spark_rapids_jni_tpu.mem.spill.SpillFramework`
+    installed, ``make_spillable`` DEFAULTS to the store's cross-task
+    eviction: a device ``RetryOOM`` evicts other tasks' idle batches
+    device→host (LRU, this task's own pinned inputs skipped), a Cpu
+    flavor demotes host batches to disk.  When the eviction actually
+    freed bytes the retry happens immediately WITHOUT parking — this
+    thread's own deallocations already fired the wake-ups, so parking
+    after them risks waiting for a signal that was consumed before the
+    wait began.  An explicit ``make_spillable`` gets the same fast path
+    when it returns the freed byte count (truthy), and the legacy
+    park-always behavior when it returns None.
+
     Real device OOMs (XLA RESOURCE_EXHAUSTED) are translated into the
     same ladder via :func:`translate_device_oom`.
 
     Raises the last error when the ladder is exhausted.
     """
     step = translate_device_oom(step)
+    default_spill = make_spillable is None
+    if default_spill:
+        from . import spill as _spill
+
+        fw = _spill.get_framework()
+        if fw is not None:
+            tid = current_task_id()
+
+            def make_spillable(oom=None):
+                if isinstance(oom, (CpuRetryOOM, CpuSplitAndRetryOOM)):
+                    return fw.host_spill_to_fit()
+                return fw.spill_to_fit(requesting_task_id=tid)
+
     last = None
     for _ in range(max_retries):
         try:
@@ -167,8 +249,16 @@ def run_with_retry(
             split()
         except RetryOOM as e:
             last = e
+            freed = None
             if make_spillable is not None:
-                make_spillable()
+                freed = (make_spillable(e) if default_spill
+                         else make_spillable())
+            if freed:
+                # this thread reclaimed memory itself; its deallocations
+                # already woke any blocked peers, so retry immediately —
+                # parking now could sleep through the wake that fired
+                # before the wait started
+                continue
             # park on the arena that raised: Cpu* flavors block on the
             # host adaptor, device flavors on the device adaptor
             block = (RmmSpark.cpu_block_thread_until_ready
@@ -186,75 +276,20 @@ def run_with_retry(
     raise last
 
 
-class Spillable:
+class Spillable(spill_mod.SpillableHandle):
     """Device batch that can round-trip to host memory under pressure.
 
     The reference plugin's retry contract is "make inputs spillable ->
-    blockThreadUntilReady -> retry" (RmmSpark.java:402-416); the spill
-    framework itself lives plugin-side.  This is the TPU-side primitive:
-    ``spill()`` copies every device buffer to host numpy and releases the
-    arena charge; ``get()`` re-uploads (re-charging) on next use.
+    blockThreadUntilReady -> retry" (RmmSpark.java:402-416).  This used
+    to be a standalone device↔host round-trip; it now delegates to the
+    process-wide spill framework (:mod:`~spark_rapids_jni_tpu.mem.spill`):
+    with a framework installed every ``Spillable`` registers with the
+    central store, gains the disk tier and cross-task eviction, and is
+    auto-closed when its ``TaskContext`` exits.  Without one it behaves
+    exactly as before — ``spill()`` copies device buffers to host numpy
+    releasing the arena charge, ``get()`` re-uploads and re-charges.
 
-    Typical wiring: ``run_with_retry(step, make_spillable=s.spill)``.
+    Explicit wiring (``run_with_retry(step, make_spillable=s.spill)``)
+    still works; with a framework installed ``run_with_retry`` spills
+    through the store by default, no wiring needed.
     """
-
-    def __init__(self, tree, ctx: Optional[TaskContext] = None):
-        self._tree = tree
-        self._host = None
-        self._treedef = None
-        self._ctx = ctx
-        self._charged = 0
-        if ctx is not None:
-            self._charged = ctx.charge(batch_nbytes(tree))
-
-    @property
-    def is_spilled(self) -> bool:
-        return self._host is not None
-
-    def spill(self):
-        """Device -> host; releases the arena charge.  Idempotent."""
-        if self._host is not None or self._tree is None:
-            return
-        import numpy as np
-
-        leaves, treedef = jax.tree_util.tree_flatten(self._tree)
-        self._host = [np.asarray(jax.device_get(leaf)) for leaf in leaves]
-        self._treedef = treedef
-        self._tree = None
-        if self._ctx is not None and self._charged:
-            self._ctx.release(self._charged)
-            self._charged = 0
-
-    def get(self):
-        """The device tree, re-uploading (and re-charging) if spilled.
-
-        The arena is charged BEFORE the upload (the byte count is known
-        from the host leaves): if ``charge`` raises RetryOOM the batch
-        stays spilled and fully accounted, instead of sitting in HBM
-        uncharged forever.
-        """
-        if self._tree is None:
-            import jax.numpy as jnp
-
-            if self._ctx is not None:
-                nbytes = sum(int(a.nbytes) for a in self._host)
-                self._charged = self._ctx.charge(nbytes)  # may raise RetryOOM
-            try:
-                leaves = [jnp.asarray(a) for a in self._host]
-                self._tree = jax.tree_util.tree_unflatten(
-                    self._treedef, leaves)
-            except BaseException:
-                if self._ctx is not None and self._charged:
-                    self._ctx.release(self._charged)
-                    self._charged = 0
-                raise
-            self._host = None
-            self._treedef = None
-        return self._tree
-
-    def close(self):
-        if self._ctx is not None and self._charged:
-            self._ctx.release(self._charged)
-            self._charged = 0
-        self._tree = None
-        self._host = None

@@ -240,6 +240,7 @@ class SparkResourceAdaptor:
         self._h = self._lib.tra_create(
             ctypes.c_long(pool_bytes),
             (log_path or "").encode())
+        self.pool_bytes = pool_bytes
         self.host_pool_bytes = host_pool_bytes
         if host_pool_bytes > 0:
             # second pool in the SAME state machine: the deadlock scan
@@ -578,6 +579,29 @@ class RmmSpark:
     @classmethod
     def get_state_of(cls, tid: int) -> ThreadState:
         return cls._a().get_state_of(tid)
+
+    # spill metrics (tier transitions recorded by mem/spill.py) ---------
+    @classmethod
+    def spill_metrics(cls) -> dict:
+        """Global spill counters (zeros when no framework is installed)."""
+        from . import spill
+
+        fw = spill.get_framework()
+        if fw is None:
+            return dict.fromkeys(spill.SpillMetrics.FIELDS, 0)
+        return fw.metrics.snapshot()
+
+    @classmethod
+    def get_and_reset_task_spill_metrics(cls, task_id: int) -> dict:
+        """Per-task spill counters, reset on read — same consume-once
+        shape as ``get_and_reset_num_retry`` so the caller can fold both
+        into one task-metrics record."""
+        from . import spill
+
+        fw = spill.get_framework()
+        if fw is None:
+            return dict.fromkeys(spill.SpillMetrics.FIELDS, 0)
+        return fw.metrics.get_and_reset_task(task_id)
 
     # injection ---------------------------------------------------------
     @classmethod

@@ -1,0 +1,605 @@
+"""Tiered spill framework: central store, cross-task eviction, metrics.
+
+The reference keeps the spill framework plugin-side
+(``SpillableDeviceStore``/``SpillableHostStore``/``SpillableDiskStore`` in
+spark-rapids): every spillable buffer registers with a process-wide store,
+and ANY task under memory pressure walks the store's priority order
+evicting OTHER tasks' idle buffers one tier down — device → host → disk —
+with each transition accounted.  Our repro only had the per-batch
+:class:`~spark_rapids_jni_tpu.mem.executor.Spillable` that the *owning*
+thread must spill by hand, so one task's OOM could never reclaim another
+task's idle HBM.  This module closes that gap:
+
+* :class:`SpillableHandle` — one registered batch with three tiers:
+  DEVICE (the jax pytree, charged to the device arena via its
+  ``TaskContext``), HOST (numpy copies, charged to the UNIFIED host arena
+  of ``rmm_spark``), DISK (``numpy`` spill files under a configurable
+  directory).  A per-handle lock makes cross-thread ``spill()`` vs
+  owner-thread ``get()`` safe; ``pin()`` excludes a handle from eviction
+  while a step actively uses it.
+* :class:`SpillableStore` — the thread-safe registry.
+  ``spill_device_to_fit`` walks handles LRU-first (by last ``get()``),
+  other tasks' batches before the requesting task's own, skipping pinned
+  ones — the reference's task-aware spill priority.
+* :class:`SpillFramework` — process-wide singleton
+  (:func:`install`/:func:`shutdown`/:func:`get_framework`) owning the
+  store, the spill directory, and :class:`SpillMetrics`.  The host tier
+  is *bounded*: a device→host demotion that does not fit the host arena
+  first demotes colder host batches to disk, and falls through to disk
+  itself when the arena still refuses (CpuRetryOOM).
+* The retry ladder integration lives in
+  :func:`~spark_rapids_jni_tpu.mem.executor.run_with_retry`: with a
+  framework installed, its *default* ``make_spillable`` calls
+  ``spill_to_fit`` — a ``RetryOOM`` anywhere reclaims other tasks' idle
+  batches automatically, no per-call wiring.
+
+Fault injection: the disk I/O boundary is instrumented
+(``spill_io_write``/``spill_io_read`` via :mod:`~spark_rapids_jni_tpu.faultinj`,
+fault kind ``"spill_io"``); a failed disk write degrades gracefully — the
+batch stays resident in the host tier and the failure is counted, no data
+is lost.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import os
+import shutil
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import faultinj
+from .rmm_spark import CpuRetryOOM, CpuSplitAndRetryOOM, RmmSpark
+
+# monotonic use-clock for LRU ordering (itertools.count is atomic under
+# the GIL, unlike a guarded integer increment)
+_use_clock = itertools.count(1)
+
+
+def _next_use() -> int:
+    return next(_use_clock)
+
+
+# ---------------------------------------------------------------------------
+# instrumented disk I/O (the spill_io fault-injection boundary)
+# ---------------------------------------------------------------------------
+
+def _write_leaf(path: str, arr: np.ndarray) -> None:
+    np.save(path, arr, allow_pickle=False)
+
+
+def _read_leaf(path: str) -> np.ndarray:
+    return np.load(path, allow_pickle=False)
+
+
+_write_leaf = faultinj.instrument(_write_leaf, "spill_io_write")
+_read_leaf = faultinj.instrument(_read_leaf, "spill_io_read")
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+class SpillMetrics:
+    """Bytes/count per tier transition + eviction latency, global and
+    per-task (keyed by the handle OWNER's task id, matching the
+    reference's per-task spill metrics in RapidsBufferCatalog)."""
+
+    FIELDS = (
+        "device_to_host_bytes", "device_to_host_count",
+        "host_to_disk_bytes", "host_to_disk_count",
+        "disk_to_host_bytes", "disk_to_host_count",      # disk read-back
+        "host_to_device_bytes", "host_to_device_count",  # device read-back
+        "eviction_ns",
+        "disk_write_failures",
+    )
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._global = dict.fromkeys(self.FIELDS, 0)
+        self._task: Dict[int, Dict[str, int]] = {}
+
+    def _bucket(self, task_id: Optional[int]) -> List[Dict[str, int]]:
+        out = [self._global]
+        if task_id is not None:
+            out.append(self._task.setdefault(
+                task_id, dict.fromkeys(self.FIELDS, 0)))
+        return out
+
+    def record(self, transition: str, nbytes: int,
+               task_id: Optional[int] = None):
+        with self._lock:
+            for b in self._bucket(task_id):
+                b[transition + "_bytes"] += int(nbytes)
+                b[transition + "_count"] += 1
+
+    def add_eviction_ns(self, ns: int, task_id: Optional[int] = None):
+        with self._lock:
+            for b in self._bucket(task_id):
+                b["eviction_ns"] += int(ns)
+
+    def disk_write_failed(self, task_id: Optional[int] = None):
+        with self._lock:
+            for b in self._bucket(task_id):
+                b["disk_write_failures"] += 1
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._global)
+
+    def task_snapshot(self, task_id: int) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._task.get(task_id)
+                        or dict.fromkeys(self.FIELDS, 0))
+
+    def get_and_reset_task(self, task_id: int) -> Dict[str, int]:
+        with self._lock:
+            return self._task.pop(task_id, None) \
+                or dict.fromkeys(self.FIELDS, 0)
+
+    def reset(self):
+        with self._lock:
+            self._global = dict.fromkeys(self.FIELDS, 0)
+            self._task.clear()
+
+
+# ---------------------------------------------------------------------------
+# SpillableHandle: one batch, three tiers
+# ---------------------------------------------------------------------------
+
+class SpillableHandle:
+    """A device batch that the framework can demote device→host→disk.
+
+    Exactly one tier holds the data at any time (``tier`` property).
+    All mutation happens under a per-handle RLock so the owning thread's
+    ``get()`` and another thread's ``spill()`` cannot interleave; evictors
+    use a non-blocking acquire, so a handle mid-``get()`` is simply
+    skipped rather than deadlocked on.
+
+    With a ``TaskContext`` the device tier is charged to the device arena
+    (released on demotion, re-charged on ``get()``); with an installed
+    :class:`SpillFramework` the host tier is charged to the unified host
+    arena and the disk tier is available.  Without either, it degrades to
+    the legacy uncharged host round-trip.
+    """
+
+    def __init__(self, tree, ctx=None, name: Optional[str] = None):
+        self._lock = threading.RLock()
+        self._tree = tree
+        self._host: Optional[List[np.ndarray]] = None
+        self._disk: Optional[List[str]] = None
+        self._treedef = None
+        self._leaf_index: Optional[List[int]] = None  # leaf -> host buffer
+        self._ctx = ctx
+        self.task_id: Optional[int] = getattr(ctx, "task_id", None)
+        self.name = name or f"spillable-{id(self):x}"
+        self._device_charged = 0
+        self._host_charged = 0
+        self._pins = 0
+        self._closed = False
+        self._last_use = _next_use()
+        self._fw = get_framework()
+        if ctx is not None:
+            from .executor import batch_nbytes
+
+            # charge BEFORE registering: a RetryOOM here leaves no
+            # half-registered handle behind
+            self._device_charged = ctx.charge(batch_nbytes(tree))
+        if self._fw is not None:
+            self._fw.store.register(self)
+        if ctx is not None and hasattr(ctx, "_adopt"):
+            ctx._adopt(self)
+
+    # -- introspection --------------------------------------------------
+    @property
+    def tier(self) -> str:
+        if self._closed:
+            return "closed"
+        if self._tree is not None:
+            return "device"
+        if self._host is not None:
+            return "host"
+        return "disk"
+
+    @property
+    def is_spilled(self) -> bool:
+        return self._tree is None and not self._closed
+
+    @property
+    def last_use(self) -> int:
+        return self._last_use
+
+    # -- pinning --------------------------------------------------------
+    def pin(self):
+        """Exclude this handle from eviction (nestable)."""
+        with self._lock:
+            self._pins += 1
+
+    def unpin(self):
+        with self._lock:
+            self._pins = max(0, self._pins - 1)
+
+    @contextlib.contextmanager
+    def pinned(self):
+        self.pin()
+        try:
+            yield self
+        finally:
+            self.unpin()
+
+    # -- tier transitions ----------------------------------------------
+    def spill(self) -> int:
+        """Demote device→host (cascading to disk under host pressure).
+
+        Returns the DEVICE arena bytes released, 0 when there was nothing
+        to do (already spilled, pinned, closed, or busy in another
+        thread's ``get()``).  Safe to call from any thread.
+        """
+        if not self._lock.acquire(blocking=False):
+            return 0  # mid-get()/close() elsewhere: treat as pinned
+        try:
+            if self._closed or self._tree is None or self._pins > 0:
+                return 0
+            import jax
+
+            from .executor import _buffer_key
+
+            t0 = time.monotonic_ns()
+            leaves, treedef = jax.tree_util.tree_flatten(self._tree)
+            # dedupe aliased leaves by buffer identity: copy each distinct
+            # buffer once and remember the leaf->buffer mapping, so the
+            # round trip preserves aliasing (and the accounting matches
+            # the deduped batch_nbytes charge)
+            uniq: Dict = {}
+            index: List[int] = []
+            host: List[np.ndarray] = []
+            for leaf in leaves:
+                key = _buffer_key(leaf)
+                if key not in uniq:
+                    uniq[key] = len(host)
+                    host.append(np.asarray(jax.device_get(leaf)))
+                index.append(uniq[key])
+            nbytes = int(sum(a.nbytes for a in host))
+            self._host = host
+            self._leaf_index = index
+            self._treedef = treedef
+            self._tree = None
+            freed = self._device_charged
+            if self._ctx is not None and self._device_charged:
+                self._ctx.release(self._device_charged)
+                self._device_charged = 0
+            fw = self._fw
+            if fw is not None:
+                fw.metrics.record("device_to_host", nbytes, self.task_id)
+                # pin across the charge: _charge_host may walk the host
+                # tier to make room, and that walk must not re-enter THIS
+                # handle (the RLock would let the same thread demote it
+                # mid-transition)
+                self._pins += 1
+                try:
+                    verdict = fw._charge_host(nbytes)
+                finally:
+                    self._pins -= 1
+                if verdict == "charged":
+                    self._host_charged = nbytes
+                elif verdict == "full":
+                    # bounded host tier refused even after demoting colder
+                    # host batches: fall through to disk ourselves
+                    self._spill_host_locked()
+                # "unbounded": no host arena — keep host-resident uncharged
+                fw.metrics.add_eviction_ns(time.monotonic_ns() - t0,
+                                           self.task_id)
+            return freed
+        finally:
+            self._lock.release()
+
+    def spill_host(self) -> int:
+        """Demote host→disk.  Returns the HOST arena bytes released."""
+        if not self._lock.acquire(blocking=False):
+            return 0
+        try:
+            if self._closed or self._host is None or self._pins > 0:
+                return 0
+            return self._spill_host_locked()
+        finally:
+            self._lock.release()
+
+    def _spill_host_locked(self) -> int:
+        fw = self._fw
+        if fw is None:
+            return 0  # no framework: no disk tier
+        paths: List[str] = []
+        try:
+            for i, arr in enumerate(self._host):
+                p = os.path.join(fw.spill_dir, f"{self.name}-{i}.npy")
+                _write_leaf(p, arr)
+                paths.append(p)
+        except (faultinj.SpillIOError, OSError):
+            # graceful degradation: the batch STAYS in the host tier —
+            # a broken spill disk must cost capacity, not data
+            for p in paths:
+                with contextlib.suppress(OSError):
+                    os.remove(p)
+            fw.metrics.disk_write_failed(self.task_id)
+            return 0
+        nbytes = int(sum(a.nbytes for a in self._host))
+        self._disk = paths
+        self._host = None
+        freed = self._host_charged
+        if self._host_charged:
+            fw._uncharge_host(self._host_charged)
+            self._host_charged = 0
+        fw.metrics.record("host_to_disk", nbytes, self.task_id)
+        return freed
+
+    def get(self):
+        """The device tree, promoting disk→host→device as needed.
+
+        The device arena is charged BEFORE the upload; if the charge
+        raises ``RetryOOM`` the handle stays fully accounted in its
+        current tier and the retry ladder re-enters ``get()``.
+        """
+        with self._lock:
+            if self._closed:
+                raise ValueError(f"{self.name} is closed")
+            self._last_use = _next_use()
+            if self._tree is not None:
+                return self._tree
+            import jax
+            import jax.numpy as jnp
+
+            fw = self._fw
+            host = self._host
+            from_disk = host is None
+            if from_disk:
+                host = [_read_leaf(p) for p in self._disk]
+                if fw is not None:
+                    fw.metrics.record(
+                        "disk_to_host", int(sum(a.nbytes for a in host)),
+                        self.task_id)
+            nbytes = int(sum(a.nbytes for a in host))
+            if self._ctx is not None:
+                # may raise RetryOOM: the host copies (or disk files) are
+                # still in place, so the retried get() re-promotes
+                self._device_charged = self._ctx.charge(nbytes)
+            try:
+                bufs = [jnp.asarray(a) for a in host]
+                # re-expand via the leaf->buffer map: aliased leaves come
+                # back as the SAME device array, preserving the dedupe
+                leaves = [bufs[i] for i in self._leaf_index]
+                tree = jax.tree_util.tree_unflatten(self._treedef, leaves)
+            except BaseException:
+                if self._ctx is not None and self._device_charged:
+                    self._ctx.release(self._device_charged)
+                    self._device_charged = 0
+                raise
+            self._tree = tree
+            if self._host_charged and fw is not None:
+                fw._uncharge_host(self._host_charged)
+            self._host_charged = 0
+            self._host = None
+            self._remove_disk_files_locked()
+            if fw is not None:
+                fw.metrics.record("host_to_device", nbytes, self.task_id)
+            return tree
+
+    def _remove_disk_files_locked(self):
+        if self._disk:
+            for p in self._disk:
+                with contextlib.suppress(OSError):
+                    os.remove(p)
+        self._disk = None
+
+    def close(self):
+        """Release every charge, delete spill files, unregister."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            if self._ctx is not None and self._device_charged:
+                self._ctx.release(self._device_charged)
+                self._device_charged = 0
+            if self._host_charged and self._fw is not None:
+                self._fw._uncharge_host(self._host_charged)
+                self._host_charged = 0
+            self._remove_disk_files_locked()
+            self._tree = None
+            self._host = None
+            self._treedef = None
+        if self._fw is not None:
+            self._fw.store.unregister(self)
+        if self._ctx is not None and hasattr(self._ctx, "_forget"):
+            self._ctx._forget(self)
+
+
+# ---------------------------------------------------------------------------
+# SpillableStore: the registry + priority walk
+# ---------------------------------------------------------------------------
+
+class SpillableStore:
+    """Thread-safe registry of live handles with the task-aware LRU
+    eviction walk (the SpillableDeviceStore role)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._handles: Dict[int, SpillableHandle] = {}
+
+    def register(self, handle: SpillableHandle):
+        with self._lock:
+            self._handles[id(handle)] = handle
+
+    def unregister(self, handle: SpillableHandle):
+        with self._lock:
+            self._handles.pop(id(handle), None)
+
+    def handles(self) -> List[SpillableHandle]:
+        with self._lock:
+            return list(self._handles.values())
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._handles)
+
+    def spill_device_to_fit(self, nbytes: Optional[int] = None,
+                            requesting_task_id: Optional[int] = None) -> int:
+        """Evict device-tier handles (LRU by last ``get()``) until
+        ``nbytes`` of device arena are released, or everything eligible is
+        spilled when ``nbytes`` is None.
+
+        Priority is task-aware: OTHER tasks' idle batches go first; the
+        requesting task's own unpinned batches go last (its pinned inputs
+        are skipped entirely, as are handles busy in a concurrent
+        ``get()``)."""
+        snap = [h for h in self.handles() if h.tier == "device"]
+        snap.sort(key=lambda h: h.last_use)
+        if requesting_task_id is None:
+            ordered = snap
+        else:
+            ordered = ([h for h in snap if h.task_id != requesting_task_id]
+                       + [h for h in snap if h.task_id == requesting_task_id])
+        freed = 0
+        for h in ordered:
+            if nbytes is not None and freed >= nbytes:
+                break
+            freed += h.spill()
+        return freed
+
+    def spill_host_to_fit(self, nbytes: Optional[int] = None) -> int:
+        """Demote host-tier handles to disk (LRU) until ``nbytes`` of the
+        host arena are released (everything when None)."""
+        snap = [h for h in self.handles() if h.tier == "host"]
+        snap.sort(key=lambda h: h.last_use)
+        freed = 0
+        for h in snap:
+            if nbytes is not None and freed >= nbytes:
+                break
+            freed += h.spill_host()
+        return freed
+
+
+# ---------------------------------------------------------------------------
+# SpillFramework: process-wide singleton
+# ---------------------------------------------------------------------------
+
+class SpillFramework:
+    """Owns the store, the spill directory, and the metrics; arbitrates
+    the bounded host tier against the unified host arena."""
+
+    def __init__(self, spill_dir: Optional[str] = None):
+        from .. import config
+
+        d = spill_dir or config.get("spill_dir")
+        self._own_dir = False
+        if not d:
+            d = tempfile.mkdtemp(prefix="sptpu_spill_")
+            self._own_dir = True
+        else:
+            os.makedirs(d, exist_ok=True)
+        self.spill_dir = d
+        self.store = SpillableStore()
+        self.metrics = SpillMetrics()
+
+    def spill_to_fit(self, nbytes: Optional[int] = None,
+                     requesting_task_id: Optional[int] = None) -> int:
+        """Release device arena bytes by evicting idle batches (see
+        :meth:`SpillableStore.spill_device_to_fit`)."""
+        return self.store.spill_device_to_fit(nbytes, requesting_task_id)
+
+    def host_spill_to_fit(self, nbytes: Optional[int] = None) -> int:
+        return self.store.spill_host_to_fit(nbytes)
+
+    # -- host-tier accounting ------------------------------------------
+    @staticmethod
+    def _host_arena():
+        """(pool_bytes, used_bytes) of whichever host arena is installed,
+        or (None, None) when the host tier is unbounded."""
+        a = RmmSpark._adaptor
+        if a is not None and a.host_pool_bytes > 0:
+            return a.host_pool_bytes, a.host_total_allocated()
+        c = RmmSpark._cpu_adaptor
+        if c is not None:
+            return c.pool_bytes, c.total_allocated()
+        return None, None
+
+    def _charge_host(self, nbytes: int) -> str:
+        """Try to charge ``nbytes`` to the host arena.
+
+        Returns ``"charged"`` (caller owns the charge), ``"unbounded"``
+        (no host arena / unregistered thread: keep host-resident without
+        accounting), or ``"full"`` (the bounded tier cannot take it even
+        after demoting colder host batches to disk — caller must go to
+        disk)."""
+        pool, used = self._host_arena()
+        if pool is None:
+            return "unbounded"
+        if nbytes > pool:
+            return "full"  # can never fit: skip the blocking allocate
+        if nbytes > pool - used:
+            self.host_spill_to_fit(nbytes - (pool - used))
+            pool, used = self._host_arena()
+            if nbytes > pool - used:
+                return "full"
+        try:
+            RmmSpark.cpu_allocate(nbytes)
+            return "charged"
+        except (CpuRetryOOM, CpuSplitAndRetryOOM):
+            # host pressure raced us: one more demotion round, then disk
+            self.host_spill_to_fit(nbytes)
+            try:
+                RmmSpark.cpu_allocate(nbytes)
+                return "charged"
+            except (CpuRetryOOM, CpuSplitAndRetryOOM):
+                return "full"
+        except RuntimeError:
+            # calling thread not registered with the adaptor (e.g. a
+            # framework shutdown path): keep the data, skip the accounting
+            return "unbounded"
+
+    def _uncharge_host(self, nbytes: int):
+        with contextlib.suppress(RuntimeError):
+            RmmSpark.cpu_deallocate(nbytes)
+
+    def close(self):
+        """Close every live handle (releasing charges + disk files)."""
+        for h in self.store.handles():
+            h.close()
+        if self._own_dir:
+            shutil.rmtree(self.spill_dir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# process-wide install/shutdown
+# ---------------------------------------------------------------------------
+
+_fw_lock = threading.Lock()
+_framework: Optional[SpillFramework] = None
+
+
+def install(spill_dir: Optional[str] = None) -> SpillFramework:
+    """Install the process-wide framework (mirrors
+    ``SpillFramework.initialize`` plugin-side).  Handles created while it
+    is installed register with it automatically."""
+    global _framework
+    with _fw_lock:
+        if _framework is not None:
+            raise RuntimeError("spill framework already installed")
+        _framework = SpillFramework(spill_dir)
+        return _framework
+
+
+def shutdown():
+    """Close all handles and uninstall (idempotent)."""
+    global _framework
+    with _fw_lock:
+        fw, _framework = _framework, None
+    if fw is not None:
+        fw.close()
+
+
+def get_framework() -> Optional[SpillFramework]:
+    return _framework

@@ -129,6 +129,21 @@ class Profiler:
             cls._writer(data)
 
 
+def spill_summary() -> dict:
+    """Spill-framework counters for profile reports: bytes/count per tier
+    transition (device→host, host→disk, read-backs), eviction latency,
+    and disk-write failures — the reference surfaces the same counters as
+    task-level spill metrics next to its profiler captures.  All zeros
+    when no spill framework is installed, so report code can emit the
+    section unconditionally."""
+    from .mem import spill
+
+    fw = spill.get_framework()
+    if fw is None:
+        return dict.fromkeys(spill.SpillMetrics.FIELDS, 0)
+    return fw.metrics.snapshot()
+
+
 def trace_range(name: str):
     """Named range in the captured trace — the NVTX-range analogue
     (reference compiles nvtx3 ranges into kernels for nsys, SURVEY §5);

@@ -323,6 +323,96 @@ class TestMonteCarlo:
         assert adaptor._h is None
 
 
+class TestSpillMonteCarlo:
+    """Spill-framework oversubscription fuzz: N threads x spillable
+    batches against a device arena far below the combined working set,
+    with the bounded host tier bouncing overflow to disk.  Asserts no
+    deadlock, no lost bytes (both arenas drain to zero), and that every
+    disk-tier file is cleaned up on close()."""
+
+    @pytest.mark.parametrize(
+        "seed",
+        [int(s) for s in
+         __import__("os").environ.get("SPILL_FUZZ_SEEDS", "7,23").split(",")])
+    def test_spill_fuzz_no_deadlock_no_lost_bytes(self, seed, tmp_path):
+        import numpy as np
+
+        from spark_rapids_jni_tpu.mem import TaskContext, run_with_retry
+        from spark_rapids_jni_tpu.mem import spill as spill_mod
+
+        fw = spill_mod.install(spill_dir=str(tmp_path / "fuzz"))
+        adaptor = RmmSpark.set_event_handler(
+            2 * MB, host_pool_bytes=256 << 10, poll_ms=10.0)
+        failures = []
+        n_threads = 4
+
+        def task_fn(task_id):
+            rng = random.Random(seed * 100 + task_id)
+            try:
+                with TaskContext(task_id) as ctx:
+                    handles = []
+                    for _ in range(12):
+                        rows = {"n": rng.randrange(1 << 10, 96 << 10)}
+
+                        def step():
+                            tree = {"x": np.arange(rows["n"],
+                                                   dtype=np.int32)}
+                            return spill_mod.SpillableHandle(tree, ctx=ctx)
+
+                        def split():
+                            rows["n"] = max(rows["n"] // 2, 16)
+
+                        # NO make_spillable: the framework default carries
+                        # every thread through the shared-arena pressure
+                        handles.append(run_with_retry(step, split=split,
+                                                      max_retries=20))
+                        if rng.random() < 0.35:
+                            victim = rng.choice(handles)
+
+                            def read_step():
+                                t = victim.get()
+                                return int(t["x"][-1]), t["x"].shape[0]
+
+                            last, n = run_with_retry(read_step, split=split,
+                                                     max_retries=20)
+                            assert last == n - 1  # read-back uncorrupted
+                        if rng.random() < 0.3:
+                            handles.pop(rng.randrange(len(handles))).close()
+                    for h in handles:
+                        h.close()
+            except BaseException as e:  # noqa: BLE001
+                failures.append((task_id, e))
+            finally:
+                RmmSpark.task_done(task_id)
+
+        try:
+            threads = [threading.Thread(target=task_fn, args=(i + 1,),
+                                        daemon=True)
+                       for i in range(n_threads)]
+            for th in threads:
+                th.start()
+            deadline = time.monotonic() + 120.0
+            for th in threads:
+                th.join(timeout=max(0.1, deadline - time.monotonic()))
+            alive = [th for th in threads if th.is_alive()]
+            assert not alive, (
+                f"deadlocked spill-fuzz threads: {len(alive)}, "
+                f"states={[adaptor.get_state_of(tid=th.ident) for th in threads]}")
+            assert not failures, failures
+            # no lost bytes: every charge in every tier was released
+            assert adaptor.total_allocated() == 0
+            assert adaptor.host_total_allocated() == 0
+            assert len(fw.store) == 0
+            leftover = [f for f in
+                        __import__("os").listdir(fw.spill_dir)]
+            assert leftover == [], f"disk tier not cleaned: {leftover}"
+            # the arena WAS oversubscribed: the tiers actually moved
+            assert fw.metrics.snapshot()["device_to_host_count"] > 0
+        finally:
+            spill_mod.shutdown()
+            RmmSpark.clear_event_handler()
+
+
 class TestCpuArena:
     def test_cpu_flavored_oom(self):
         RmmSpark.set_event_handler(8 * MB)
