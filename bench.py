@@ -26,6 +26,10 @@ conversion, string→float, bloom build+probe, murmur3/xxhash64, group-by.
 ``python bench.py --spill`` runs the q6 shape under an oversubscribed
 device arena with the tiered spill framework installed; its JSON line adds
 ``spill_*_bytes`` counters so captures track spill overhead.
+
+``python bench.py --shuffle`` runs one heavily skewed exchange through the
+out-of-core ShuffleService under a capped device arena; its JSON line adds
+``shuffle_*`` counters (rounds, skew ratio, spilled bytes).
 """
 
 import json
@@ -404,6 +408,119 @@ def spill_main():
         "spill_read_back_bytes": snap["host_to_device_bytes"],
         "spill_eviction_ms": round(snap["eviction_ns"] / 1e6, 2),
         "spill_disk_write_failures": snap["disk_write_failures"],
+    }), flush=True)
+    return 0
+
+
+# --------------------------------------------------------------------------
+# shuffle scenario (--shuffle): skewed out-of-core exchange
+# --------------------------------------------------------------------------
+
+def shuffle_main():
+    """A heavily skewed ``distributed_group_by`` (most rows share one hot
+    key, so one partition receives most of the shuffle) through the
+    ShuffleService under a device arena capped below the eager shuffle
+    working set: completing it requires the skew planner's multi-round
+    drain plus spill of idle round buffers.  The emitted line carries
+    rounds/capacity/skew/spill counters so BENCH_*.json tracks
+    out-of-core shuffle overhead alongside throughput."""
+    if os.environ.get("BENCH_FORCE_CPU"):
+        # the scenario needs a multi-device mesh; on CPU fallback carve 8
+        # virtual devices (must land before jax initializes)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+
+    import tempfile
+
+    import jax
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        platform = jax.devices()[0].platform
+    except Exception as e:  # backend init failure → parent falls back
+        print(f"# backend init failed: {e}", file=sys.stderr, flush=True)
+        return 17
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spark_rapids_jni_tpu import config, mem
+    from spark_rapids_jni_tpu.columnar import types as T
+    from spark_rapids_jni_tpu.columnar.column import Column, ColumnBatch
+    from spark_rapids_jni_tpu.mem.rmm_spark import RmmSpark
+    from spark_rapids_jni_tpu.parallel import data_mesh, shard_batch
+    from spark_rapids_jni_tpu.shuffle import ShuffleService, get_registry
+
+    from spark_rapids_jni_tpu.parallel import distributed_group_by
+    from spark_rapids_jni_tpu.relational import AggSpec
+
+    P = len(jax.devices())
+    mesh = data_mesh(P)
+    per_dev = int(os.environ.get("BENCH_SHUFFLE_ROWS", str(1 << 14)))
+    n_rows = P * per_dev
+    rng = np.random.default_rng(11)
+    # most rows share one hot key: its partition receives the bulk of the
+    # shuffle, forcing the planner into a multi-round drain
+    keys = np.where(rng.random(n_rows) < 0.7, 3,
+                    rng.integers(0, 4 * P, n_rows)).astype(np.int64)
+    vals = rng.integers(-1000, 1000, n_rows).astype(np.int64)
+    batch = shard_batch(ColumnBatch({
+        "k": Column(jnp.asarray(keys), jnp.ones((n_rows,), jnp.bool_),
+                    T.INT64),
+        "v": Column(jnp.asarray(vals), jnp.ones((n_rows,), jnp.bool_),
+                    T.INT64)}), mesh)
+
+    config.set("shuffle_capacity_bucket", 256)
+    round_rows = int(os.environ.get("BENCH_SHUFFLE_ROUND_ROWS", "512"))
+    config.set("shuffle_round_rows", round_rows)
+    # arena below the eager working set (map buffer + all round chunks
+    # live at once would need several x input size)
+    pool = max(int(mem.batch_nbytes(batch) * 2), 1 << 21)
+    spill_dir = tempfile.mkdtemp(prefix="bench_shuffle_")
+    RmmSpark.set_event_handler(pool, poll_ms=10.0)
+    mem.install_spill_framework(spill_dir=spill_dir)
+    reg = get_registry()
+    reg.reset()
+    failures = []
+    t0 = time.perf_counter()
+    try:
+        with mem.TaskContext(1) as ctx:
+            res, ng, dropped = distributed_group_by(
+                batch, ["k"], [AggSpec("sum", "v", "s")], mesh, ctx=ctx)
+            jax.block_until_ready(res["s"].data)
+        RmmSpark.task_done(1)
+        if int(np.asarray(jax.device_get(dropped)).sum()) != 0:
+            failures.append("dropped rows in skewed group-by")
+    except Exception as e:
+        failures.append(repr(e))
+    dt = time.perf_counter() - t0
+    snap = reg.metrics.snapshot()
+    mem.shutdown_spill_framework()
+    RmmSpark.clear_event_handler()
+    if failures:
+        print(f"# shuffle scenario failed: {failures}", file=sys.stderr,
+              flush=True)
+        return 1
+    capacity = max((i.capacity for i in reg.shuffles().values()),
+                   default=0)
+    print(json.dumps({
+        "metric": "shuffle_skew_outofcore",
+        "value": round(n_rows / dt / 1e6, 2),
+        "unit": "Mrows/s",
+        "platform": platform,
+        "rows": n_rows,
+        "devices": P,
+        "device_pool_bytes": pool,
+        "shuffle_rounds": snap["rounds"],
+        "shuffle_capacity": capacity,
+        "shuffle_skew_ratio": round(snap["max_skew_ratio"], 2),
+        "shuffle_bytes_moved": snap["bytes_moved"],
+        "shuffle_spilled_bytes": snap["spilled_bytes"],
+        "shuffle_dropped_rows": snap["dropped_rows"],
+        "shuffle_io_failures": snap["io_failures"],
     }), flush=True)
     return 0
 
@@ -1014,13 +1131,17 @@ def main():
         sys.exit(micro_main())
     if mode == "--child-spill":
         sys.exit(spill_main())
+    if mode == "--child-shuffle":
+        sys.exit(shuffle_main())
     if mode == "--probe":
         sys.exit(_probe_main())
 
     run_micro = mode == "--micro"
     run_spill = mode == "--spill"
+    run_shuffle = mode == "--shuffle"
     child_mode = ("--child-micro" if run_micro
-                  else "--child-spill" if run_spill else "--child")
+                  else "--child-spill" if run_spill
+                  else "--child-shuffle" if run_shuffle else "--child")
     t0 = time.monotonic()
 
     def left():
@@ -1060,6 +1181,7 @@ def main():
         # *something*, labeled for the mode that actually failed.
         metric = ("micro_suite" if run_micro
                   else "q6_spill_oversubscribed" if run_spill
+                  else "shuffle_skew_outofcore" if run_shuffle
                   else "q6_pipeline_throughput")
         print(json.dumps({
             "metric": metric,

@@ -6,3 +6,6 @@ BENCH_FORCE_CPU=1 BENCH_N_ROWS=65536 BENCH_REPS=2 python bench.py
 # spill scenario: device arena capped below q6's working set; the emitted
 # line carries spill-bytes counters so BENCH_*.json tracks spill overhead
 BENCH_FORCE_CPU=1 BENCH_SPILL_ROWS=65536 python bench.py --spill
+# shuffle scenario: skewed multi-round exchange through the out-of-core
+# ShuffleService under a capped arena (rounds/skew/spill counters)
+BENCH_FORCE_CPU=1 BENCH_SHUFFLE_ROWS=8192 python bench.py --shuffle

@@ -42,6 +42,11 @@ fi
 JAX_PLATFORMS=cpu python -m pytest tests/test_spill.py -q \
   -p no:cacheprovider -p no:randomly
 
+# out-of-core shuffle second and by name: the ShuffleService's lossless
+# multi-round + spill guarantees gate every exchange-shaped operator
+JAX_PLATFORMS=cpu python -m pytest tests/test_shuffle_service.py -q \
+  -p no:cacheprovider -p no:randomly
+
 # full suite, one pytest process per file: a single long-lived process
 # over the whole suite degraded pathologically on a 1-core box (round 4:
 # >4h and never finished vs 38 min chunked, same tests)

@@ -41,6 +41,26 @@ if _os.environ.get("SRJ_FORCE_CPU"):
 
 jax.config.update("jax_enable_x64", True)
 
+if not hasattr(jax, "shard_map"):
+    # jax < 0.5 ships shard_map under jax.experimental with the older
+    # check_rep keyword; the parallel/shuffle layers are written against
+    # the stable ``jax.shard_map(..., check_vma=...)`` API, so bridge it.
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def _shard_map_compat(f=None, *, mesh, in_specs, out_specs,
+                          check_vma=None, check_rep=None, **kwargs):
+        if check_rep is None:
+            check_rep = True if check_vma is None else bool(check_vma)
+
+        def wrap(fn):
+            return _exp_shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                  out_specs=out_specs, check_rep=check_rep,
+                                  **kwargs)
+
+        return wrap if f is None else wrap(f)
+
+    jax.shard_map = _shard_map_compat
+
 from . import columnar  # noqa: E402
 from . import ops  # noqa: E402
 from . import relational  # noqa: E402

@@ -82,6 +82,20 @@ _register("spill_dir", "", str,
 _register("shuffle_capacity_bucket", 256, int,
           "Rounding bucket for auto-planned exchange capacities (bigger = "
           "fewer recompiles, more slot padding).")
+_register("shuffle_round_rows", 1 << 16, int,
+          "Per-(sender,destination) slot rows one ShuffleService round may "
+          "carry (shuffle/planner.py).  Buckets bigger than this drain "
+          "over multiple all_to_all rounds instead of inflating the slot "
+          "grid — the TPU analogue of the reference's fixed-size shuffle "
+          "batch discipline.")
+_register("shuffle_strict_pids", False, _parse_bool,
+          "Raise ShuffleError on out-of-range partition ids (< 0 or > P) "
+          "instead of routing them to the null partition and counting "
+          "them in ShuffleMetrics.oob_rows.")
+_register("shuffle_max_rounds", 64, int,
+          "Cap on ShuffleService rounds per exchange; a plan that would "
+          "exceed it RAISES per-round capacity (never drops rows) so the "
+          "host-side round loop stays bounded under extreme skew.")
 _register("bench_rows", 1 << 21, int,
           "Row count for the flagship q6 benchmark (legacy knob; the "
           "bench now sizes per platform via bench_rows_tpu/cpu).")

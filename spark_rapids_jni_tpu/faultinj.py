@@ -23,7 +23,10 @@ boundary — instrumented jitted callables — since there is no CUPTI:
   device-trap analogue — callers must treat the executor as poisoned),
   ``"spill_io"`` raises :class:`SpillIOError` at the spill framework's
   disk boundary (names ``spill_io_write``/``spill_io_read``) — the
-  framework degrades by keeping the batch in the higher tier.
+  framework degrades by keeping the batch in the higher tier,
+  ``"shuffle_io"`` raises :class:`ShuffleIOError` at the ShuffleService's
+  per-round boundary (name ``shuffle_io_round``) — the service re-drives
+  the round from its intact spillable buffers and counts the failure.
 * ``dynamic: true`` re-reads the file when its mtime changes, matching
   the injector's ``dynamicReconfig`` thread without needing one.
 
@@ -64,13 +67,22 @@ class SpillIOError(OSError):
     injected and real disk faults identically."""
 
 
+class ShuffleIOError(OSError):
+    """Injected shuffle transport failure (kind ``"shuffle_io"``).
+
+    Raised at the ShuffleService's per-round probe; the service re-drives
+    the round from its spillable buffers (nothing was consumed) and
+    counts the failure in ``ShuffleMetrics.io_failures``."""
+
+
 class _Rule:
     def __init__(self, spec: dict):
         self.match = spec.get("match", "*")
         self.probability = float(spec.get("probability", 1.0))
         self.count = spec.get("count")  # None = unlimited
         self.fault = spec.get("fault", "exception")
-        if self.fault not in ("exception", "oom", "fatal", "spill_io"):
+        if self.fault not in ("exception", "oom", "fatal", "spill_io",
+                              "shuffle_io"):
             raise ValueError(f"unknown fault kind {self.fault!r}")
         self.remaining = None if self.count is None else int(self.count)
 
@@ -148,6 +160,8 @@ class _Injector:
             raise FatalInjectedFault(f"injected fatal fault at {name}")
         if kind == "spill_io":
             raise SpillIOError(f"injected spill I/O fault at {name}")
+        if kind == "shuffle_io":
+            raise ShuffleIOError(f"injected shuffle I/O fault at {name}")
         raise InjectedFault(f"injected exception at {name}")
 
 

@@ -603,6 +603,17 @@ class RmmSpark:
             return dict.fromkeys(spill.SpillMetrics.FIELDS, 0)
         return fw.metrics.get_and_reset_task(task_id)
 
+    # shuffle metrics (recorded by the shuffle package's registry) ------
+    @classmethod
+    def shuffle_metrics(cls) -> dict:
+        """Global ShuffleService counters (rounds, rows/bytes moved,
+        spilled bytes, OOB/dropped rows, transport retries) — surfaced
+        here next to :meth:`spill_metrics` so executor-side telemetry can
+        scrape both from one place."""
+        from ..shuffle import get_registry
+
+        return get_registry().metrics.snapshot()
+
     # injection ---------------------------------------------------------
     @classmethod
     def force_retry_oom(cls, tid, num_ooms=1, skip_count=0):

@@ -175,6 +175,7 @@ class SpillableHandle:
         self._disk: Optional[List[str]] = None
         self._treedef = None
         self._leaf_index: Optional[List[int]] = None  # leaf -> host buffer
+        self._shardings: Optional[List] = None        # per distinct buffer
         self._ctx = ctx
         self.task_id: Optional[int] = getattr(ctx, "task_id", None)
         self.name = name or f"spillable-{id(self):x}"
@@ -258,15 +259,21 @@ class SpillableHandle:
             uniq: Dict = {}
             index: List[int] = []
             host: List[np.ndarray] = []
+            shardings: List = []
             for leaf in leaves:
                 key = _buffer_key(leaf)
                 if key not in uniq:
                     uniq[key] = len(host)
                     host.append(np.asarray(jax.device_get(leaf)))
+                    # remember mesh placement so a spilled row-sharded
+                    # array (e.g. a shuffle round chunk) is restored
+                    # sharded, not gathered onto one device
+                    shardings.append(getattr(leaf, "sharding", None))
                 index.append(uniq[key])
             nbytes = int(sum(a.nbytes for a in host))
             self._host = host
             self._leaf_index = index
+            self._shardings = shardings
             self._treedef = treedef
             self._tree = None
             freed = self._device_charged
@@ -368,7 +375,16 @@ class SpillableHandle:
                 # still in place, so the retried get() re-promotes
                 self._device_charged = self._ctx.charge(nbytes)
             try:
-                bufs = [jnp.asarray(a) for a in host]
+                bufs = []
+                shardings = self._shardings or [None] * len(host)
+                for a, sh in zip(host, shardings):
+                    if sh is not None:
+                        try:
+                            bufs.append(jax.device_put(a, sh))
+                            continue
+                        except Exception:
+                            pass  # mesh gone (e.g. process teardown)
+                    bufs.append(jnp.asarray(a))
                 # re-expand via the leaf->buffer map: aliased leaves come
                 # back as the SAME device array, preserving the dedupe
                 leaves = [bufs[i] for i in self._leaf_index]
@@ -383,6 +399,7 @@ class SpillableHandle:
                 fw._uncharge_host(self._host_charged)
             self._host_charged = 0
             self._host = None
+            self._shardings = None
             self._remove_disk_files_locked()
             if fw is not None:
                 fw.metrics.record("host_to_device", nbytes, self.task_id)
@@ -410,6 +427,7 @@ class SpillableHandle:
             self._remove_disk_files_locked()
             self._tree = None
             self._host = None
+            self._shardings = None
             self._treedef = None
         if self._fw is not None:
             self._fw.store.unregister(self)

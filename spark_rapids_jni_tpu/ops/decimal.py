@@ -41,7 +41,10 @@ import numpy as np
 from ..columnar import types as T
 from ..columnar.column import Column, Decimal128Column
 
-_MASK32 = jnp.uint64(0xFFFFFFFF)
+# numpy, not jnp: this module is imported lazily from inside jitted
+# aggregation bodies, and a jnp scalar created under an active trace is a
+# tracer that outlives it (UnexpectedTracerError on the next trace)
+_MASK32 = np.uint64(0xFFFFFFFF)
 
 # pow10 limb table: 10^0 .. 10^76 as uint32[77, 8] little-endian
 _POW10_NP = np.zeros((77, 8), dtype=np.uint32)

@@ -21,8 +21,10 @@ import numpy as np
 from ..columnar import types as T
 from ..columnar.column import Column, Decimal128Column, StringColumn
 
-_M32 = jnp.uint64(0xFFFFFFFF)
-_BILLION = jnp.uint64(10**9)
+# numpy, not jnp: lazily imported modules must not mint jnp scalars at
+# import time — under an active trace they become escaping tracers
+_M32 = np.uint64(0xFFFFFFFF)
+_BILLION = np.uint64(10**9)
 _MAX_DIGITS = 45  # 5 groups of 9 (2^128 has 39 decimal digits)
 _WIDTH = 88
 

@@ -43,6 +43,7 @@ def distributed_group_by(
     axis_name: str = "data",
     row_valid=None,
     capacity: Optional[int] = None,
+    ctx=None,
 ):
     """Shuffle rows by key hash, then group each partition locally.
 
@@ -53,11 +54,22 @@ def distributed_group_by(
     Returns ``(result, num_groups, dropped)``: ``result`` is row-sharded with
     each device's groups in front of its shard, ``num_groups`` int32[P] are
     per-device group counts, ``dropped`` int32[P] counts rows lost to slot
-    overflow (0 unless ``capacity`` was undersized for the key skew).
+    overflow (always zero on the default path — with ``capacity`` unset the
+    exchange runs through the lossless multi-round
+    :class:`~spark_rapids_jni_tpu.shuffle.ShuffleService`, whose buffers
+    spill under pressure instead of dropping; pass an explicit ``capacity``
+    to force the legacy single-round fused exchange).
     """
+    P = mesh.shape[axis_name]
     if capacity is None:
-        capacity = plan_exchange_capacity(batch, key_names, mesh, axis_name,
-                                          row_valid)
+        from ..shuffle import ShuffleService
+
+        res = ShuffleService(mesh, axis_name).exchange(
+            batch, key_names=key_names, row_valid=row_valid, ctx=ctx)
+        local = _local_group_by_step(mesh, axis_name, tuple(key_names),
+                                     tuple(aggs))
+        result, ng = local(res.batch, res.occupancy)
+        return result, ng, jnp.zeros((P,), jnp.int32)
     step = _group_by_step(
         mesh, axis_name, tuple(key_names), tuple(aggs), capacity,
         row_valid is None,
@@ -123,6 +135,44 @@ def _group_by_step(mesh, axis_name, key_names, aggs, capacity, all_valid):
     return jax.jit(step)
 
 
+@lru_cache(maxsize=None)
+def _local_group_by_step(mesh, axis_name, key_names, aggs):
+    """Reduce-side-only step for ShuffleService exchanges: the rows are
+    already on their destination device (occupancy marks slot padding)."""
+    spec = PartitionSpec(axis_name)
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(spec, spec), out_specs=(spec, spec), check_vma=False,
+    )
+    def step(b: ColumnBatch, occ):
+        res, ng = group_by(b, key_names, aggs, row_valid=occ)
+        return res, ng[None]
+
+    return jax.jit(step)
+
+
+@lru_cache(maxsize=None)
+def _local_join_step(mesh, axis_name, left_on, right_on, how, out_capacity):
+    """Reduce-side-only join for ShuffleService exchanges (both sides
+    already routed to their key's device)."""
+    from ..relational.join import hash_join
+
+    spec = PartitionSpec(axis_name)
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(spec,) * 4, out_specs=(spec, spec), check_vma=False,
+    )
+    def step(lb: ColumnBatch, locc, rb: ColumnBatch, rocc):
+        out, count = hash_join(lb, rb, list(left_on), list(right_on), how,
+                               capacity=out_capacity,
+                               left_valid=locc, right_valid=rocc)
+        return out, count[None]
+
+    return jax.jit(step)
+
+
 def collect_groups(result: ColumnBatch, num_groups) -> dict:
     """Host-side: concatenate each device-shard's live group rows.
 
@@ -155,19 +205,31 @@ def distributed_hash_join(
     axis_name: str = "data",
     capacity: Optional[int] = None,
     out_capacity: Optional[int] = None,
+    ctx=None,
 ):
     """Shuffle both sides by key hash, then join each partition locally.
 
     Spark semantics hold globally because matching keys land on the same
     device (identical murmur3 partition ids on both sides).  Returns
-    ``(result, counts int32[P], dropped int32[P*2])`` — result rows are
-    device-local with each shard's matches in front.
+    ``(result, counts int32[P], dropped int32[P, 2])`` — result rows are
+    device-local with each shard's matches in front.  With ``capacity``
+    unset both sides route through the lossless
+    :class:`~spark_rapids_jni_tpu.shuffle.ShuffleService` (dropped is
+    zeros by invariant); an explicit ``capacity`` forces the legacy fused
+    single-round exchange.
     """
+    P = mesh.shape[axis_name]
     if capacity is None:
-        capacity = max(
-            plan_exchange_capacity(left, left_on, mesh, axis_name),
-            plan_exchange_capacity(right, right_on, mesh, axis_name),
-        )
+        from ..shuffle import ShuffleService
+
+        svc = ShuffleService(mesh, axis_name)
+        lres = svc.exchange(left, key_names=left_on, ctx=ctx)
+        rres = svc.exchange(right, key_names=right_on, ctx=ctx)
+        step = _local_join_step(mesh, axis_name, tuple(left_on),
+                                tuple(right_on), how, out_capacity)
+        out, count = step(lres.batch, lres.occupancy,
+                          rres.batch, rres.occupancy)
+        return out, count, jnp.zeros((P, 2), jnp.int32)
     step = _join_step(mesh, axis_name, tuple(left_on), tuple(right_on), how,
                       capacity, out_capacity)
     return step(left, right)

@@ -17,6 +17,17 @@ analogue of the reference's fixed 2GB batch discipline
 (``row_conversion.cu:93-98``): shapes are decided before the data is seen.
 Rows beyond C for one destination are dropped and counted in ``dropped``
 (callers size C for their skew; C = R is always lossless).
+
+Out-of-range partition ids (``pid < 0`` or ``pid > P``) are routed to the
+null pseudo-partition P and counted in ``dropped`` — they used to be
+clamped silently, which DELIVERED negative ids to partition 0 and lost
+``pid > P`` rows without a trace.  The :mod:`~spark_rapids_jni_tpu.shuffle`
+service raises on them under the ``shuffle_strict_pids`` flag and counts
+them in its metrics otherwise.
+
+For lossless exchanges of arbitrary skew without quadratic slot memory,
+use :class:`spark_rapids_jni_tpu.shuffle.ShuffleService` — it runs this
+exchange in multiple planned rounds with spillable buffers.
 """
 
 from __future__ import annotations
@@ -26,6 +37,17 @@ import jax.numpy as jnp
 
 from ..columnar.column import ColumnBatch
 from ..relational.gather import gather_batch
+
+
+def route_out_of_range(pid, num_partitions: int):
+    """Route ids outside ``[0, P]`` to the null partition P; return
+    ``(pid int32, n_oob int32)``.  A negative id must never be delivered
+    (the old clip sent it to partition 0) and an id past P must be
+    counted, not silently absorbed into the padding slot."""
+    pid = pid.astype(jnp.int32)
+    P = jnp.int32(num_partitions)
+    oob = (pid < 0) | (pid > P)
+    return jnp.where(oob, P, pid), oob.sum(dtype=jnp.int32)
 
 
 def exchange(
@@ -39,12 +61,14 @@ def exchange(
 
     ``pid`` is int32[R] in [0, P]; P routes nowhere (padding).  Returns
     ``(out_batch [P*C rows], occupancy bool[P*C], dropped int32)``.
+    ``dropped`` counts rows lost to slot overflow PLUS out-of-range ids
+    (< 0 or > P), which are routed to the null partition, never delivered.
     """
     R = batch.num_rows
     P = num_partitions
     C = R if capacity is None else capacity
 
-    pid = jnp.clip(pid.astype(jnp.int32), 0, P)
+    pid, n_oob = route_out_of_range(pid, P)
     # platform-aware stable regroup (counting sort on CPU, lax.sort on
     # accelerators) — the r5 prof_q95 breakdown showed this local leg
     # dominating the exchange cost on XLA-CPU
@@ -65,7 +89,7 @@ def exchange(
     src = jnp.take(offsets, p_ids) + c_ids
     send_idx = jnp.take(perm, jnp.clip(src, 0, max(R - 1, 0)))
     send = gather_batch(batch, send_idx, valid=slot_occ)
-    dropped = jnp.maximum(counts - C, 0).sum(dtype=jnp.int32)
+    dropped = jnp.maximum(counts - C, 0).sum(dtype=jnp.int32) + n_oob
 
     def a2a(x):
         grid = x.reshape((P, C) + x.shape[1:])
@@ -87,7 +111,7 @@ def plan_capacity(pid, axis_name: str, num_partitions: int):
     """
     R = pid.shape[0]
     P = num_partitions
-    pid = jnp.clip(pid.astype(jnp.int32), 0, P)
+    pid, _ = route_out_of_range(pid, P)
     counts = jax.ops.segment_sum(
         jnp.ones((R,), jnp.int32), pid, num_segments=P + 1
     )[:P]
@@ -122,7 +146,7 @@ def exchange_hierarchical(
     if "__pid__" in batch.names:
         raise ValueError("'__pid__' is reserved by exchange_hierarchical")
     P = n_hosts * n_chips
-    pid = jnp.clip(pid.astype(jnp.int32), 0, P)
+    pid, n_oob = route_out_of_range(pid, P)
     carried = batch.with_column("__pid__", Column(pid, pid < P, T.INT32))
 
     host_dst = jnp.where(pid < P, pid // n_chips, n_hosts)
@@ -136,4 +160,6 @@ def exchange_hierarchical(
     out_b, occ_b, drop_b = exchange(
         out_a.select(list(batch.names)), chip_dst, ici_axis, n_chips,
         capacity_ici)
-    return out_b, occ_b, drop_a + drop_b
+    # OOB ids were routed to the null partition before hop one, so they
+    # surface as padding (never as hop drops) — count them explicitly
+    return out_b, occ_b, drop_a + drop_b + n_oob

@@ -144,6 +144,18 @@ def spill_summary() -> dict:
     return fw.metrics.snapshot()
 
 
+def shuffle_summary() -> dict:
+    """ShuffleService counters for profile reports: shuffles/rounds run,
+    rows and bytes moved, bytes spilled under pressure, out-of-range and
+    dropped row counts, transport retry count, and the worst skew ratio
+    seen — the per-shuffle analogue of :func:`spill_summary`.  Always
+    zeros-safe: the registry exists as soon as the shuffle package
+    imports."""
+    from .shuffle import get_registry
+
+    return get_registry().metrics.snapshot()
+
+
 def trace_range(name: str):
     """Named range in the captured trace — the NVTX-range analogue
     (reference compiles nvtx3 ranges into kernels for nsys, SURVEY §5);

@@ -21,7 +21,8 @@ from .filter import apply_mask, compact
 from .gather import gather_batch, gather_column
 from .sort import SortKey, sort_by
 from .aggregate import AggSpec, group_by, group_by_domain_or_sort
-from .join import hash_join, join_dense_or_hash
+from .join import (hash_join, join_dense_or_hash, spillable_build_table,
+                   SpillableBuildTable)
 from .window import WindowSpec, window
 
 __all__ = [
@@ -36,6 +37,8 @@ __all__ = [
     "group_by_domain_or_sort",
     "hash_join",
     "join_dense_or_hash",
+    "spillable_build_table",
+    "SpillableBuildTable",
     "WindowSpec",
     "window",
 ]

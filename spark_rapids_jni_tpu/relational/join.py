@@ -71,6 +71,7 @@ def hash_join(
     suffixes: tuple = ("", "_r"),
     left_valid=None,
     right_valid=None,
+    prebuilt=None,
 ) -> tuple:
     """Equality join; returns ``(result_batch, count)``.
 
@@ -87,22 +88,47 @@ def hash_join(
     the inputs carry shuffle slot padding: dead right rows never match,
     dead left rows produce no output (not even for left/anti joins, where
     Spark WOULD keep a live null-keyed row).
+
+    ``prebuilt`` skips the build-side sort: either the raw
+    ``(*sorted_rkeys, rperm)`` tuple or a :class:`SpillableBuildTable`
+    from :func:`spillable_build_table` (pinned for the duration, fetched
+    through the retry ladder).  It MUST have been built from the same
+    ``right``/``right_on``/``right_valid`` — nothing re-validates that.
     """
     if how not in _HOWS:
         raise ValueError(f"unknown join type {how!r}")
     if len(left_on) != len(right_on):
         raise ValueError("left_on/right_on length mismatch")
     if how == "right":
+        if prebuilt is not None:
+            # the swap makes the LEFT input the build side; a prebuilt
+            # table for the original right would silently probe wrong
+            raise ValueError("prebuilt build tables are not supported for "
+                             "how='right' (the swap changes the build side)")
         # swapped left join (reference cudf right joins are the same
         # reversal); right side's columns come first in the output
         return hash_join(right, left, right_on, left_on, "left",
                          capacity=capacity, suffixes=(suffixes[1],
                                                       suffixes[0]),
                          left_valid=right_valid, right_valid=left_valid)
+    if prebuilt is not None and hasattr(prebuilt, "get"):
+        from ..mem.executor import run_with_retry
+
+        # hold the pin across the recursive call so an evictor cannot
+        # drop the table (releasing its charge) while the probe is in
+        # flight; get() re-runs the build if it was already dropped
+        with prebuilt.pinned():
+            built = run_with_retry(prebuilt.get)
+            return hash_join(left, right, left_on, right_on, how,
+                             capacity=capacity, suffixes=suffixes,
+                             left_valid=left_valid, right_valid=right_valid,
+                             prebuilt=tuple(built))
 
     nl, nr = left.num_rows, right.num_rows
     padded_right = nr == 0
     if nr == 0:
+        if prebuilt is not None:
+            raise ValueError("prebuilt build table for an empty build side")
         # pad the build side with one unmatchable null row: downstream
         # gathers stay in-bounds and every probe misses (count semantics of
         # an empty build: inner/semi -> 0 rows, left -> all-null right, anti
@@ -120,12 +146,16 @@ def hash_join(
 
     # build: sort right by (null-flag, radix keys); nulls sort last and can
     # never equal a valid probe (flag mismatch)
-    rkeys = K.batch_radix_keys(rcols, equality=True, nulls_first=False)
-    iota_r = jnp.arange(nr, dtype=jnp.int32)
-    sorted_ops = jax.lax.sort(
-        tuple(rkeys) + (iota_r,), num_keys=len(rkeys), is_stable=True
-    )
-    sorted_rkeys, rperm = sorted_ops[:-1], sorted_ops[-1]
+    rkeys = None
+    if prebuilt is not None:
+        sorted_rkeys, rperm = tuple(prebuilt[:-1]), prebuilt[-1]
+    else:
+        rkeys = K.batch_radix_keys(rcols, equality=True, nulls_first=False)
+        iota_r = jnp.arange(nr, dtype=jnp.int32)
+        sorted_ops = jax.lax.sort(
+            tuple(rkeys) + (iota_r,), num_keys=len(rkeys), is_stable=True
+        )
+        sorted_rkeys, rperm = sorted_ops[:-1], sorted_ops[-1]
 
     lkeys = K.batch_radix_keys(lcols, equality=True, nulls_first=False)
     lo, hi = K.equal_range(sorted_rkeys, lkeys)
@@ -189,6 +219,11 @@ def hash_join(
             tuple(lkeys) + (jnp.arange(nl, dtype=jnp.int32),),
             num_keys=len(lkeys), is_stable=True)
         sorted_lkeys = lkeys_sorted_ops[:-1]
+        if rkeys is None:
+            # prebuilt path carries only the SORTED keys; the reverse
+            # probe needs them in right-row order
+            rkeys = K.batch_radix_keys(rcols, equality=True,
+                                       nulls_first=False)
         rlo, rhi = K.equal_range(sorted_lkeys, rkeys)
         r_null = jnp.zeros((nr,), jnp.bool_)
         for c in rcols:
@@ -364,3 +399,121 @@ def _concat_col(a, b):
 
 def _concat_batches(a: ColumnBatch, b: ColumnBatch) -> ColumnBatch:
     return ColumnBatch({n: _concat_col(a[n], b[n]) for n in a.names})
+
+
+# ---------------------------------------------------------------------------
+# spillable build tables: eviction drops, read-back rebuilds
+# ---------------------------------------------------------------------------
+
+def spillable_build_table(right: ColumnBatch, right_on: Sequence[str],
+                          right_valid=None, ctx=None,
+                          name: Optional[str] = None):
+    """Register a join build table (the sorted radix keys + permutation of
+    ``right[right_on]``) in the spill framework as a
+    :class:`SpillableBuildTable`.
+
+    The reference spills hash-join build-side GpuColumnarBatches like any
+    other buffer; here the build product is *derived* state — the source
+    columns stay with the caller — so eviction just DROPS it (releasing
+    the device charge with no host copy) and ``get()`` re-runs the
+    compiled sort.  Recompute-over-copy is the right trade for a product
+    the probe can deterministically regenerate.
+
+    Pass the result as ``hash_join(..., prebuilt=table)`` to reuse one
+    build across many probe batches.  Close it when done.
+
+    Raises for string join keys (their radix width is aligned to the
+    probe side's ``max_len``, so a probe-independent prebuild could
+    disagree with what ``hash_join`` derives) and for an empty build side
+    (which ``hash_join`` pads with a synthetic row).
+    """
+    if right.num_rows == 0:
+        raise ValueError("cannot pre-build an empty build side")
+    rcols = [right[k] for k in right_on]
+    if any(isinstance(c, StringColumn) for c in rcols):
+        raise ValueError(
+            "string join keys cannot be pre-built: their radix key width "
+            "depends on the probe side (align_string_key_columns)")
+    if right_valid is not None:
+        import dataclasses as _dc
+
+        rcols = [_dc.replace(c, validity=c.validity & right_valid)
+                 for c in rcols]
+    nr = right.num_rows
+
+    def builder():
+        rkeys = K.batch_radix_keys(rcols, equality=True, nulls_first=False)
+        iota_r = jnp.arange(nr, dtype=jnp.int32)
+        return tuple(jax.lax.sort(
+            tuple(rkeys) + (iota_r,), num_keys=len(rkeys), is_stable=True))
+
+    return SpillableBuildTable(builder, ctx=ctx, name=name)
+
+
+from ..mem.spill import SpillableHandle as _SpillableHandle  # noqa: E402
+
+
+class SpillableBuildTable(_SpillableHandle):
+    """A :class:`~spark_rapids_jni_tpu.mem.spill.SpillableHandle` whose
+    payload is recomputed rather than copied: ``spill()`` drops the device
+    tree and releases the charge (no host/disk tiers), ``get()``
+    re-charges and re-runs the stored builder."""
+
+    def __init__(self, builder, ctx=None, name: Optional[str] = None):
+        self._builder = builder
+        super().__init__(builder(), ctx=ctx,
+                         name=name or f"build-table-{id(self):x}")
+        from ..mem.executor import batch_nbytes
+
+        self._build_nbytes = batch_nbytes(self._tree)
+        self.rebuilds = 0
+
+    @property
+    def tier(self) -> str:
+        if self._closed:
+            return "closed"
+        return "device" if self._tree is not None else "dropped"
+
+    def spill(self) -> int:
+        if not self._lock.acquire(blocking=False):
+            return 0  # busy in another thread's get(): treat as pinned
+        try:
+            if self._closed or self._tree is None or self._pins > 0:
+                return 0
+            self._tree = None
+            freed = self._device_charged
+            if self._ctx is not None and self._device_charged:
+                self._ctx.release(self._device_charged)
+                self._device_charged = 0
+            if self._fw is not None:
+                # dropping IS this handle's device->host transition for
+                # accounting purposes: zero bytes moved, one eviction
+                self._fw.metrics.record("device_to_host", 0, self.task_id)
+            return freed
+        finally:
+            self._lock.release()
+
+    spill_host = spill  # no host tier to demote; keep the interface
+
+    def get(self):
+        with self._lock:
+            if self._closed:
+                raise ValueError(f"{self.name} is closed")
+            from ..mem.spill import _next_use
+
+            self._last_use = _next_use()
+            if self._tree is not None:
+                return self._tree
+            if self._ctx is not None:
+                # may raise RetryOOM: nothing was built yet, so the
+                # retried get() simply re-enters here
+                self._device_charged = self._ctx.charge(self._build_nbytes)
+            try:
+                self._tree = self._builder()
+            except BaseException:
+                if self._ctx is not None and self._device_charged:
+                    self._ctx.release(self._device_charged)
+                    self._device_charged = 0
+                raise
+            self.rebuilds += 1
+            return self._tree

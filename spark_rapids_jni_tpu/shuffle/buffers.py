@@ -1,0 +1,59 @@
+"""Spillable partition buffers: map output and round chunks that demote
+instead of OOMing.
+
+Each buffer wraps one :class:`~spark_rapids_jni_tpu.mem.spill.SpillableHandle`
+registered with the PR-1 :class:`SpillableStore`, so an exchange whose
+eager footprint exceeds the device arena degrades the reference's way —
+idle buffers walk device→host→disk under the store's cross-task LRU
+priority — and both the creation charge and the read-back promotion run
+under :func:`~spark_rapids_jni_tpu.mem.executor.run_with_retry`: a
+``RetryOOM`` triggers eviction of OTHER buffers (earlier round chunks,
+the map-side regroup) rather than job failure.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..mem.executor import batch_nbytes, run_with_retry
+from ..mem.spill import SpillableHandle
+
+
+class PartitionBuffer:
+    """One spillable tree (map-side regrouped rows + counts, or a received
+    round chunk) with retry-laddered creation and read-back.
+
+    Degrades gracefully: with no spill framework installed the handle
+    still round-trips device↔host on demand; with no ``TaskContext`` the
+    arena is simply not charged (the PR-1 handle contract).
+    """
+
+    def __init__(self, tree, ctx=None, name: Optional[str] = None):
+        self.nbytes = batch_nbytes(tree)
+        # the creation charge is the retryable unit: under arena pressure
+        # the default make_spillable evicts idle store handles and the
+        # charge is retried — out-of-core, not OOM
+        self._handle = run_with_retry(
+            lambda: SpillableHandle(tree, ctx=ctx, name=name))
+
+    @property
+    def tier(self) -> str:
+        return self._handle.tier
+
+    @property
+    def handle(self) -> SpillableHandle:
+        return self._handle
+
+    def get(self):
+        """The device tree, promoted (and re-charged) under the retry
+        ladder if it was evicted."""
+        return run_with_retry(self._handle.get)
+
+    def spill(self) -> int:
+        return self._handle.spill()
+
+    def pinned(self):
+        return self._handle.pinned()
+
+    def close(self):
+        self._handle.close()

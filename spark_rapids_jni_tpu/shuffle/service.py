@@ -1,0 +1,337 @@
+"""The out-of-core exchange: map → plan → drain rounds → reassemble.
+
+One :meth:`ShuffleService.exchange` call is a Spark stage boundary made
+lossless:
+
+1. **map** (one jitted shard_map): route rows to Spark-exact partition
+   ids (or caller-supplied raw ids — out-of-range ones go to the null
+   partition, counted), regroup destination-major, and emit the
+   ``[P, P]`` (sender, destination) count matrix.
+2. **plan** (host): :func:`~spark_rapids_jni_tpu.shuffle.planner.plan_rounds`
+   turns the counts into a static ``(rounds, capacity)`` shape.
+3. **drain** (one compiled program for ALL rounds — the round index is a
+   traced scalar): round ``r`` sends slots ``[r*C, (r+1)*C)`` of every
+   bucket through the static ``lax.all_to_all``; the map output and every
+   received chunk live in spillable
+   :class:`~spark_rapids_jni_tpu.shuffle.buffers.PartitionBuffer`s, so
+   arena pressure between rounds demotes idle chunks device→host→disk
+   instead of failing — each round is a retryable unit under
+   :func:`~spark_rapids_jni_tpu.mem.executor.run_with_retry`.
+4. **reassemble** (per-device concat under shard_map — a global
+   concatenate would interleave shards) + **account**: rows received must
+   equal rows sent and the residual must hit zero, else the service
+   raises — ``dropped == 0`` is an invariant, not a metric you hope for.
+
+Fault injection: each round passes a ``shuffle_io`` probe
+(name ``shuffle_io_round``); an injected
+:class:`~spark_rapids_jni_tpu.faultinj.ShuffleIOError` is retried a
+bounded number of times (the data is still in the buffers) and counted.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import lru_cache, partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec
+
+from .. import faultinj
+from ..columnar.column import ColumnBatch
+from ..mem.executor import run_with_retry
+from ..parallel.partition import regroup_order, spark_partition_id
+from ..parallel.shuffle import route_out_of_range
+from ..relational.gather import gather_batch
+from .buffers import PartitionBuffer
+from .planner import RoundPlan, plan_rounds
+from .registry import ShuffleInfo, ShuffleRegistry, get_registry
+
+
+class ShuffleError(RuntimeError):
+    """Lossless-invariant violation or strict-mode partition id abuse."""
+
+
+# every drain round passes this probe; kind "shuffle_io" rules in the
+# injector make it raise ShuffleIOError (the transport-fault analogue)
+_io_probe = faultinj.instrument(lambda: None, "shuffle_io_round")
+
+_IO_RETRIES = 3  # bounded re-drives of one round on transport faults
+
+
+@dataclass
+class ShuffleResult:
+    """A completed exchange: row-sharded output + its exact accounting."""
+
+    batch: ColumnBatch     # [P * rounds * P * capacity] rows, row-sharded
+    occupancy: jnp.ndarray  # bool, same rows: True = live row
+    shuffle_id: int
+    rounds: int
+    capacity: int
+    rows_moved: int
+    bytes_moved: int
+    spilled_bytes: int
+    skew_ratio: float
+    oob_rows: int
+
+
+def _map_local(b: ColumnBatch, pid, P: int):
+    """Shared map-side body: route OOB → regroup dest-major → count."""
+    pid, n_oob = route_out_of_range(pid, P)
+    perm = regroup_order(pid, P + 1)
+    pid_sorted = jnp.take(pid, perm)
+    counts = jax.ops.segment_sum(
+        jnp.ones(pid.shape, jnp.int32), pid_sorted, num_segments=P + 1,
+        indices_are_sorted=True,
+    )[:P]
+    return gather_batch(b, perm), counts[None], n_oob[None]
+
+
+@lru_cache(maxsize=None)
+def _map_step_keys(mesh, axis_name, key_names, all_valid):
+    P = mesh.shape[axis_name]
+    spec = PartitionSpec(axis_name)
+    n_in = 1 if all_valid else 2
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(spec,) * n_in,
+             out_specs=(spec, spec, spec), check_vma=False)
+    def step(b: ColumnBatch, *rv):
+        rv = jnp.ones((b.num_rows,), jnp.bool_) if all_valid else rv[0]
+        pid = spark_partition_id([b[k] for k in key_names], P, rv)
+        return _map_local(b, pid, P)
+
+    return jax.jit(step)
+
+
+@lru_cache(maxsize=None)
+def _map_step_pid(mesh, axis_name):
+    P = mesh.shape[axis_name]
+    spec = PartitionSpec(axis_name)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec),
+             out_specs=(spec, spec, spec), check_vma=False)
+    def step(b: ColumnBatch, pid):
+        return _map_local(b, pid, P)
+
+    return jax.jit(step)
+
+
+@lru_cache(maxsize=None)
+def _drain_step(mesh, axis_name, capacity):
+    """One compiled program serves every round: the round index is a
+    traced replicated scalar, so round r selects slots [r*C, (r+1)*C) of
+    each bucket without retracing."""
+    P = mesh.shape[axis_name]
+    C = capacity
+    spec = PartitionSpec(axis_name)
+
+    @partial(jax.shard_map, mesh=mesh,
+             in_specs=(spec, spec, PartitionSpec()),
+             out_specs=(spec, spec, spec, spec), check_vma=False)
+    def step(b: ColumnBatch, counts2d, r):
+        counts = counts2d.reshape(-1)[:P]
+        R = b.num_rows
+        offsets = jnp.cumsum(counts) - counts
+        p_ids = jnp.repeat(jnp.arange(P, dtype=jnp.int32), C)
+        c_ids = jnp.tile(jnp.arange(C, dtype=jnp.int32), P)
+        k = r * C + c_ids
+        slot_occ = k < jnp.take(counts, p_ids)
+        src = jnp.take(offsets, p_ids) + k
+        send_idx = jnp.clip(src, 0, max(R - 1, 0))
+        send = gather_batch(b, send_idx, valid=slot_occ)
+
+        def a2a(x):
+            grid = x.reshape((P, C) + x.shape[1:])
+            out = jax.lax.all_to_all(
+                grid, axis_name, split_axis=0, concat_axis=0)
+            return out.reshape((P * C,) + x.shape[1:])
+
+        out = jax.tree_util.tree_map(a2a, send)
+        occ = a2a(slot_occ)
+        got = occ.sum(dtype=jnp.int32)
+        residual = jnp.maximum(counts - (r + 1) * C, 0).sum(dtype=jnp.int32)
+        return out, occ, got[None], residual[None]
+
+    return jax.jit(step)
+
+
+@lru_cache(maxsize=None)
+def _concat_step(mesh, axis_name, n_chunks):
+    """Per-DEVICE row concatenation of the round chunks.  A global
+    ``jnp.concatenate`` on row-sharded arrays would interleave other
+    devices' shards between this device's rounds; under shard_map each
+    device stitches only its own shards."""
+    spec = PartitionSpec(axis_name)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(spec,) * n_chunks,
+             out_specs=spec, check_vma=False)
+    def step(*chunks):
+        return jax.tree_util.tree_map(
+            lambda *xs: jnp.concatenate(xs, axis=0), *chunks)
+
+    return jax.jit(step)
+
+
+def _spill_snapshot():
+    from ..mem import spill as spill_mod
+
+    fw = spill_mod.get_framework()
+    if fw is None:
+        return None
+    m = fw.metrics.snapshot()
+    return m["device_to_host_bytes"] + m["host_to_disk_bytes"]
+
+
+class ShuffleService:
+    """Lossless multi-round exchange over one mesh axis.
+
+    Stateless apart from the shared :class:`ShuffleRegistry`; the
+    compiled map/drain/concat programs are cached module-wide, so
+    constructing a service per call is free.
+    """
+
+    def __init__(self, mesh, axis_name: str = "data",
+                 registry: Optional[ShuffleRegistry] = None):
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.registry = registry or get_registry()
+
+    # -- public API -----------------------------------------------------
+    def exchange(
+        self,
+        batch: ColumnBatch,
+        key_names: Optional[Sequence[str]] = None,
+        pid=None,
+        row_valid=None,
+        ctx=None,
+        round_rows: Optional[int] = None,
+        strict: Optional[bool] = None,
+    ) -> ShuffleResult:
+        """Exchange ``batch`` rows so partition p's rows land on device p.
+
+        Route either by ``key_names`` (Spark-exact
+        ``pmod(murmur3(keys, 42), P)``) or by a caller-supplied ``pid``
+        array (int32 per row; P = padding, routed nowhere).  Out-of-range
+        ids raise :class:`ShuffleError` when ``strict`` (default: the
+        ``shuffle_strict_pids`` knob), else they are routed to the null
+        partition and counted in the metrics.
+
+        ``ctx`` (a :class:`~spark_rapids_jni_tpu.mem.executor.TaskContext`)
+        charges every partition buffer to the device arena, making the
+        exchange a first-class out-of-core citizen; without it buffers are
+        registered but uncharged.
+        """
+        from .. import config
+
+        if (key_names is None) == (pid is None):
+            raise ValueError("pass exactly one of key_names / pid")
+        if strict is None:
+            strict = bool(config.get("shuffle_strict_pids"))
+        mesh, axis = self.mesh, self.axis_name
+        P = mesh.shape[axis]
+        sid = self.registry.begin_shuffle()
+        spill_base = _spill_snapshot()
+
+        # 1. map: regroup destination-major + the count matrix
+        if key_names is not None:
+            step = _map_step_keys(mesh, axis, tuple(key_names),
+                                  row_valid is None)
+            out = (step(batch) if row_valid is None
+                   else step(batch, row_valid))
+        else:
+            step = _map_step_pid(mesh, axis)
+            out = step(batch, pid)
+        regrouped, counts, oob = out
+        counts_np = np.asarray(jax.device_get(counts)).reshape(P, P)
+        oob_total = int(np.asarray(jax.device_get(oob)).sum())
+        if oob_total and strict:
+            raise ShuffleError(
+                f"shuffle {sid}: {oob_total} out-of-range partition ids "
+                f"(strict mode; ids must lie in [0, {P}])")
+
+        # 2. plan: static (rounds, capacity) from the exact counts
+        plan = plan_rounds(counts_np, round_rows=round_rows)
+
+        # 3. drain: multi-round all_to_all over spillable buffers
+        map_buf = PartitionBuffer((regrouped, counts), ctx=ctx,
+                                  name=f"shuffle{sid}-map")
+        drain = _drain_step(mesh, axis, plan.capacity)
+        chunks = []
+        received = 0
+        bytes_moved = 0
+        residual = -1
+        try:
+            for r in range(plan.rounds):
+                out, occ, got_n, residual = self._run_round(
+                    drain, map_buf, r)
+                chunk = PartitionBuffer((out, occ), ctx=ctx,
+                                        name=f"shuffle{sid}-round{r}")
+                chunks.append(chunk)
+                received += got_n
+                bytes_moved += chunk.nbytes
+
+            # 4. account + reassemble
+            sent = int(counts_np.sum())
+            if residual != 0 or received != sent:
+                self.registry.metrics.record_dropped(
+                    max(sent - received, 0) + max(residual, 0))
+                raise ShuffleError(
+                    f"shuffle {sid}: lossless invariant violated "
+                    f"(sent={sent} received={received} residual={residual})")
+            if plan.rounds == 1:
+                final_batch, final_occ = chunks[0].get()
+            else:
+                parts = [c.get() for c in chunks]
+                concat = _concat_step(mesh, axis, len(parts))
+                final_batch, final_occ = concat(*parts)
+        finally:
+            map_buf.close()
+            for c in chunks:
+                c.close()
+
+        spilled = 0
+        if spill_base is not None:
+            after = _spill_snapshot()
+            spilled = (after - spill_base) if after is not None else 0
+        info = ShuffleInfo(
+            shuffle_id=sid, rounds=plan.rounds, capacity=plan.capacity,
+            rows_moved=received, bytes_moved=bytes_moved,
+            spilled_bytes=spilled, skew_ratio=plan.skew_ratio,
+            oob_rows=oob_total)
+        self.registry.record(info)
+        return ShuffleResult(
+            batch=final_batch, occupancy=final_occ, shuffle_id=sid,
+            rounds=plan.rounds, capacity=plan.capacity, rows_moved=received,
+            bytes_moved=bytes_moved, spilled_bytes=spilled,
+            skew_ratio=plan.skew_ratio, oob_rows=oob_total)
+
+    def plan(self, counts, round_rows: Optional[int] = None) -> RoundPlan:
+        """Expose the planner on the service for callers that fetched
+        their own count matrix."""
+        return plan_rounds(counts, round_rows=round_rows)
+
+    # -- internals ------------------------------------------------------
+    def _run_round(self, drain, map_buf: PartitionBuffer, r: int):
+        """One retryable round: arena pressure runs the spill ladder
+        (RetryOOM → cross-task eviction → retry), transport faults are
+        re-driven a bounded number of times from the intact buffers."""
+
+        def round_step():
+            _io_probe()
+            tree, cnts = map_buf.get()
+            out, occ, got, residual = drain(tree, cnts, jnp.int32(r))
+            # fetching the scalars forces the round to execute HERE, so
+            # real device OOMs surface inside the retry ladder
+            got_n = int(np.asarray(jax.device_get(got)).sum())
+            res_n = int(np.asarray(jax.device_get(residual)).sum())
+            return out, occ, got_n, res_n
+
+        for attempt in range(_IO_RETRIES + 1):
+            try:
+                return run_with_retry(round_step)
+            except faultinj.ShuffleIOError:
+                self.registry.metrics.record_io_failure()
+                if attempt == _IO_RETRIES:
+                    raise
