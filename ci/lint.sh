@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Lint-clean gate: graftlint (tools/graftlint/) is the Python/JAX-layer
+# analogue of the reference's test-with-sanitizer profile — six AST rules
+# encoding bug classes this repo has actually shipped (GL001 is the PR 2
+# module-level-jnp UnexpectedTracerError class).  Fails on any finding
+# that is neither per-line-suppressed nor grandfathered in
+# tools/graftlint/baseline.json (the baseline only ever shrinks).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT="$(mktemp)"
+trap 'rm -f "$OUT"' EXIT
+if python -m tools.graftlint spark_rapids_jni_tpu tests \
+    --format json >"$OUT"; then
+  python - "$OUT" <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+c = doc["counts"]
+print(f"graftlint: clean ({c['baselined']} baselined, "
+      f"{c['suppressed']} suppressed, "
+      f"{len(doc['stale_baseline'])} stale baseline entries)")
+EOF
+else
+  echo "graftlint: NEW findings (full JSON report follows)" >&2
+  cat "$OUT" >&2
+  exit 1
+fi

@@ -7,6 +7,12 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# graftlint first: the JAX-hazard lint gate (tools/graftlint/) is pure
+# stdlib AST analysis, so it fails in seconds — before any native build —
+# if a tracer-leak/host-sync/retrace/spill-leak/drift hazard is
+# (re)introduced (e.g. a module-level jnp constant, the PR 2 bug class)
+bash ci/lint.sh
+
 make -C spark_rapids_jni_tpu/mem/native
 make -C spark_rapids_jni_tpu/io/native
 make -C jni

@@ -96,9 +96,8 @@ _register("shuffle_max_rounds", 64, int,
           "Cap on ShuffleService rounds per exchange; a plan that would "
           "exceed it RAISES per-round capacity (never drops rows) so the "
           "host-side round loop stays bounded under extreme skew.")
-_register("bench_rows", 1 << 21, int,
-          "Row count for the flagship q6 benchmark (legacy knob; the "
-          "bench now sizes per platform via bench_rows_tpu/cpu).")
+# (the legacy `bench_rows` knob was dropped: nothing read it after the
+# bench went per-platform — graftlint GL005 now fails on dead knobs)
 _register("bench_rows_tpu", 1 << 24, int,
           "Full-size row count for the q6 bench on an accelerator; "
           "amortizes the ~63ms per-execution tunnel round-trip.")

@@ -75,15 +75,51 @@ class ShuffleIOError(OSError):
     counts the failure in ``ShuffleMetrics.io_failures``."""
 
 
+def _raise_exception(name: str):
+    raise InjectedFault(f"injected exception at {name}")
+
+
+def _raise_oom(name: str):
+    from .mem import RetryOOM
+
+    raise RetryOOM(f"injected OOM at {name}")
+
+
+def _raise_fatal(name: str):
+    raise FatalInjectedFault(f"injected fatal fault at {name}")
+
+
+def _raise_spill_io(name: str):
+    raise SpillIOError(f"injected spill I/O fault at {name}")
+
+
+def _raise_shuffle_io(name: str):
+    raise ShuffleIOError(f"injected shuffle I/O fault at {name}")
+
+
+# The registry of injectable fault flavors: kind -> raiser.  graftlint's
+# GL006 keeps this in sync with every use site statically — a kind used
+# in a config dict but missing here would otherwise only fail when its
+# rule first fires, and a kind registered here but never injected by any
+# test is an untested fault-handling path.
+FAULT_KINDS = {
+    "exception": _raise_exception,
+    "oom": _raise_oom,
+    "fatal": _raise_fatal,
+    "spill_io": _raise_spill_io,
+    "shuffle_io": _raise_shuffle_io,
+}
+
+
 class _Rule:
     def __init__(self, spec: dict):
         self.match = spec.get("match", "*")
         self.probability = float(spec.get("probability", 1.0))
         self.count = spec.get("count")  # None = unlimited
         self.fault = spec.get("fault", "exception")
-        if self.fault not in ("exception", "oom", "fatal", "spill_io",
-                              "shuffle_io"):
-            raise ValueError(f"unknown fault kind {self.fault!r}")
+        if self.fault not in FAULT_KINDS:
+            raise ValueError(f"unknown fault kind {self.fault!r}; known: "
+                             f"{sorted(FAULT_KINDS)}")
         self.remaining = None if self.count is None else int(self.count)
 
     def applies(self, name: str) -> bool:
@@ -152,17 +188,7 @@ class _Injector:
                 break
             else:
                 return
-        if kind == "oom":
-            from .mem import RetryOOM
-
-            raise RetryOOM(f"injected OOM at {name}")
-        if kind == "fatal":
-            raise FatalInjectedFault(f"injected fatal fault at {name}")
-        if kind == "spill_io":
-            raise SpillIOError(f"injected spill I/O fault at {name}")
-        if kind == "shuffle_io":
-            raise ShuffleIOError(f"injected shuffle I/O fault at {name}")
-        raise InjectedFault(f"injected exception at {name}")
+        FAULT_KINDS[kind](name)
 
 
 _injector = _Injector()

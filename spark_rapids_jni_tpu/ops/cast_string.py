@@ -162,17 +162,19 @@ def _raise_on_invalid(col: StringColumn, valid):
 # correctly-rounded signed powers of ten: 1e-340 .. 1e309 (inf past the top,
 # 0.0 past the bottom), indexed by e + _POW10_OFF
 _POW10_OFF = 340
-_POW10_F64 = jnp.asarray(
-    [float(f"1e{k}") for k in range(-_POW10_OFF, 310)], dtype=jnp.float64
+# numpy, not jnp: module scope must not mint device arrays (GL001) — the
+# tables convert per use site, where they trace as compile-time constants
+_POW10_F64 = np.asarray(
+    [float(f"1e{k}") for k in range(-_POW10_OFF, 310)], dtype=np.float64
 )
 
 
 def _pow10f(e):
     """10.0**e in float64 (the reference computes exp10() in double)."""
-    return _POW10_F64[jnp.clip(e + _POW10_OFF, 0, _POW10_OFF + 309)]
+    return jnp.asarray(_POW10_F64)[jnp.clip(e + _POW10_OFF, 0, _POW10_OFF + 309)]
 
 
-_POW10_U64 = jnp.asarray([10**k for k in range(0, 19)], dtype=jnp.uint64)
+_POW10_U64 = np.asarray([10**k for k in range(0, 19)], dtype=np.uint64)
 
 
 def _all_ws_from(chars, lengths, pos):
@@ -274,7 +276,7 @@ def string_to_float(
     exp_k = jnp.clip(real[:, None] - rank, 0, 18)
     digitval = (chars - ord("0")).astype(jnp.uint64)
     digits = jnp.where(
-        contrib_mask, digitval * _POW10_U64[exp_k], jnp.uint64(0)
+        contrib_mask, digitval * jnp.asarray(_POW10_U64)[exp_k], jnp.uint64(0)
     ).sum(axis=1)
 
     decimal_pos_counted = (counted & (idx < dot_pos[:, None])).sum(axis=1).astype(
@@ -742,11 +744,12 @@ def string_to_integer_with_base(
     return Column(bits, valid, dtype)
 
 
-_HEX_DIGITS = jnp.asarray(
-    [ord(c) for c in "0123456789ABCDEF"], dtype=jnp.uint8
+# numpy, not jnp: module scope must not mint device arrays (GL001)
+_HEX_DIGITS = np.asarray(
+    [ord(c) for c in "0123456789ABCDEF"], dtype=np.uint8
 )
-_POW10_CONV = jnp.asarray(
-    [np.uint64(10) ** k for k in range(20)], dtype=jnp.uint64
+_POW10_CONV = np.asarray(
+    [np.uint64(10) ** k for k in range(20)], dtype=np.uint64
 )
 
 
@@ -786,14 +789,14 @@ def integer_to_string_with_base(col: Column, base: int = 10) -> StringColumn:
         )
         out = jnp.where(
             outpos < ndig[:, None],
-            _HEX_DIGITS[digit.astype(jnp.int32)],
+            jnp.asarray(_HEX_DIGITS)[digit.astype(jnp.int32)],
             jnp.uint8(0),
         )
         return StringColumn(out, ndig, col.validity)
 
     max_out = 20  # 2^64-1 has 20 decimal digits
     j = jnp.arange(max_out, dtype=jnp.int32)
-    digs = (u[:, None] // _POW10_CONV[None, :]) % jnp.uint64(10)
+    digs = (u[:, None] // jnp.asarray(_POW10_CONV)[None, :]) % jnp.uint64(10)
     ndig = jnp.maximum((digs != 0).astype(jnp.int32) * (j[None, :] + 1), 0).max(axis=1)
     ndig = jnp.maximum(ndig, 1)
     outpos = j[None, :]

@@ -44,6 +44,7 @@ from typing import Sequence, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..columnar import types as T
 from ..columnar.column import StringColumn
@@ -128,10 +129,13 @@ P_NAMED = 0
 P_INDEX = 1
 P_WILD = 2
 
-_LIT_TABLE = jnp.asarray(
-    [list(b"true\x00"), list(b"false"), list(b"null\x00")], dtype=jnp.uint8
+# numpy, not jnp: module scope must not mint device arrays (GL001) — this
+# module is imported lazily, and a jnp constant created under an active
+# trace escapes as a tracer (the PR 2 decimal bug)
+_LIT_TABLE = np.asarray(
+    [list(b"true\x00"), list(b"false"), list(b"null\x00")], dtype=np.uint8
 )
-_LIT_LEN = jnp.asarray([4, 5, 4], dtype=jnp.int32)
+_LIT_LEN = np.asarray([4, 5, 4], dtype=np.int32)
 
 
 def parse_path(path: str):
@@ -393,10 +397,10 @@ def _step(P, ptypes, pindexes, pnames, pnamelens, carry, xs):
 
     # -- M_LIT ----------------------------------------------------------
     ml = alive & (eff_mode == M_LIT) & ~at_eof
-    expected = _LIT_TABLE[st["lit_id"], jnp.minimum(st["lit_pos"], 4)]
+    expected = jnp.asarray(_LIT_TABLE)[st["lit_id"], jnp.minimum(st["lit_pos"], 4)]
     lit_ok = ml & (c == expected)
     new_lpos = jnp.where(lit_ok, st["lit_pos"] + 1, new_lpos)
-    lit_done = lit_ok & (st["lit_pos"] + 1 == _LIT_LEN[st["lit_id"]])
+    lit_done = lit_ok & (st["lit_pos"] + 1 == jnp.asarray(_LIT_LEN)[st["lit_id"]])
     new_mode = jnp.where(lit_done, i32(M_AFTER), new_mode)
     ev_a = jnp.where(
         lit_done,
@@ -911,13 +915,14 @@ def _hex4(prev3, c4):
             | (_hex_val(prev3[..., 2]) << 4) | _hex_val(c4))
 
 
-_SHORT_ESC_CODE = jnp.zeros((32,), jnp.uint8).at[8].set(ord("b")).at[9].set(
-    ord("t")).at[10].set(ord("n")).at[12].set(ord("f")).at[13].set(ord("r"))
-_ESC_DECODE = (
-    jnp.arange(256, dtype=jnp.uint8)
-    .at[ord("b")].set(8).at[ord("f")].set(12).at[ord("n")].set(10)
-    .at[ord("r")].set(13).at[ord("t")].set(9)
-)
+# numpy, not jnp (GL001): the escape tables are built mutably on host and
+# trace as constants at their use sites
+_SHORT_ESC_CODE = np.zeros((32,), np.uint8)
+for _ctrl, _esc in ((8, "b"), (9, "t"), (10, "n"), (12, "f"), (13, "r")):
+    _SHORT_ESC_CODE[_ctrl] = ord(_esc)
+_ESC_DECODE = np.arange(256, dtype=np.uint8)
+for _ctrl, _esc in ((8, "b"), (12, "f"), (10, "n"), (13, "r"), (9, "t")):
+    _ESC_DECODE[ord(_esc)] = _ctrl
 
 
 def _str_emit_byte(c, prev3, flag, esc, off):
@@ -937,11 +942,12 @@ def _str_emit_byte(c, prev3, flag, esc, off):
     content_esc = jnp.where(
         c32 == ord('"'), jnp.where(off == 0, ord("\\"), ord('"')),
         jnp.where(ctrl & short,
-                  jnp.where(off == 0, ord("\\"), _SHORT_ESC_CODE[c32 % 32]),
+                  jnp.where(off == 0, ord("\\"),
+                            jnp.asarray(_SHORT_ESC_CODE)[c32 % 32]),
                   jnp.where(ctrl, u6, c32)))
     content_b = jnp.where(esc, content_esc, c32)
     # SF_ESCCHAR bytes
-    dec = _ESC_DECODE[c]
+    dec = jnp.asarray(_ESC_DECODE)[c]
     esc2 = jnp.where(off == 0, ord("\\"),
                      jnp.where(c32 == ord('"'), ord('"'),
                      jnp.where(c32 == 0x5C, ord("\\"), c32)))

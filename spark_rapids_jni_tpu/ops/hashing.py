@@ -38,6 +38,7 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..columnar import types as T
 from ..columnar.column import Column, ColumnBatch, Decimal128Column, StringColumn
@@ -48,9 +49,12 @@ DEFAULT_XXHASH64_SEED = 42  # Hash.java:26
 # Murmur3_32 primitives (vectorized over rows; everything uint32)
 # ---------------------------------------------------------------------------
 
-_MM3_C1 = jnp.uint32(0xCC9E2D51)
-_MM3_C2 = jnp.uint32(0x1B873593)
-_MM3_C3 = jnp.uint32(0xE6546B64)
+# numpy, not jnp: module scope must not mint device arrays (GL001) — this
+# module is imported lazily from inside jitted bodies, and a jnp constant
+# created under an active trace escapes as a tracer (the PR 2 decimal bug)
+_MM3_C1 = np.uint32(0xCC9E2D51)
+_MM3_C2 = np.uint32(0x1B873593)
+_MM3_C3 = np.uint32(0xE6546B64)
 
 
 def _rotl32(x, r: int):
@@ -130,11 +134,11 @@ def murmur3_bytes(chars, lengths, seed_u32):
 # XXHash64 primitives (vectorized over rows; everything uint64)
 # ---------------------------------------------------------------------------
 
-_XXH_P1 = jnp.uint64(0x9E3779B185EBCA87)
-_XXH_P2 = jnp.uint64(0xC2B2AE3D27D4EB4F)
-_XXH_P3 = jnp.uint64(0x165667B19E3779F9)
-_XXH_P4 = jnp.uint64(0x85EBCA77C2B2AE63)
-_XXH_P5 = jnp.uint64(0x27D4EB2F165667C5)
+_XXH_P1 = np.uint64(0x9E3779B185EBCA87)
+_XXH_P2 = np.uint64(0xC2B2AE3D27D4EB4F)
+_XXH_P3 = np.uint64(0x165667B19E3779F9)
+_XXH_P4 = np.uint64(0x85EBCA77C2B2AE63)
+_XXH_P5 = np.uint64(0x27D4EB2F165667C5)
 
 
 def _rotl64(x, r: int):
@@ -271,8 +275,8 @@ def xxhash64_bytes(chars, lengths, seed_u64):
 # Value widening (shared by both hash families)
 # ---------------------------------------------------------------------------
 
-_F32_QNAN = jnp.uint32(0x7FC00000)
-_F64_QNAN = jnp.uint64(0x7FF8000000000000)
+_F32_QNAN = np.uint32(0x7FC00000)
+_F64_QNAN = np.uint64(0x7FF8000000000000)
 
 
 def _f64_bits(d):

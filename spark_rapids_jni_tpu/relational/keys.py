@@ -29,12 +29,14 @@ from typing import Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..columnar import types as T
 from ..columnar.column import Column, Decimal128Column, StringColumn
 
-_SIGN32 = jnp.uint32(0x80000000)
-_F64_QNAN = jnp.uint64(0x7FF8000000000000)
+# numpy, not jnp: module scope must not mint device arrays (GL001)
+_SIGN32 = np.uint32(0x80000000)
+_F64_QNAN = np.uint64(0x7FF8000000000000)
 
 
 def _split64(u64):
@@ -44,7 +46,7 @@ def _split64(u64):
     ).astype(jnp.uint32)
 
 
-_F32_QNAN = jnp.uint32(0x7FC00000)
+_F32_QNAN = np.uint32(0x7FC00000)
 
 
 def _f32_total_order(d, normalize_zero: bool):

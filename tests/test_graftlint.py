@@ -1,0 +1,483 @@
+"""graftlint: per-rule fixtures (violating / clean / suppressed), the
+baseline ratchet, the CLI surface, and the live-tree meta-gate.
+
+No JAX import needed — graftlint is pure stdlib ``ast`` analysis, so the
+fixture snippets are *text*, never executed.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from tools.graftlint import engine
+from tools.graftlint.cli import main as cli_main
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint(tmp_path, files, rules=None, baseline=None):
+    """Write ``{relpath: source}`` under tmp_path and lint the tree."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return engine.run([str(tmp_path)], root=str(tmp_path),
+                      baseline=baseline, rules=rules)
+
+
+def new_rules(result):
+    return [(f.rule, f.path) for f in result.new]
+
+
+# ---------------------------------------------------------------------------
+# GL001 — tracer leak (module-scope eager jnp constants: the PR 2 bug)
+# ---------------------------------------------------------------------------
+
+GL001_BAD = """
+    import jax.numpy as jnp
+    TBL = jnp.asarray([1, 2, 3])
+"""
+
+
+class TestGL001:
+    def test_module_scope_asarray_flagged(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": GL001_BAD}, rules=["GL001"])
+        assert new_rules(res) == [("GL001", "mod.py")]
+
+    def test_dtype_scalar_and_at_chain_flagged(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import jax.numpy as jnp
+            C1 = jnp.uint32(0xCC9E2D51)
+            ESC = jnp.zeros((32,), jnp.uint8).at[8].set(1)
+        """}, rules=["GL001"])
+        assert len(res.new) == 2
+
+    def test_default_arg_is_import_time(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import jax.numpy as jnp
+            def f(x, pad=jnp.zeros((3,))):
+                return x + pad
+        """}, rules=["GL001"])
+        assert len(res.new) == 1
+
+    def test_clean_numpy_module_scope_and_jnp_in_function(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import jax.numpy as jnp
+            import numpy as np
+            TBL = np.asarray([1, 2, 3])
+            U64 = jnp.uint64  # dtype alias, not a construction
+            def f(e):
+                return jnp.asarray(TBL)[e] * jnp.uint32(5)
+        """}, rules=["GL001"])
+        assert res.new == []
+
+    def test_suppressed(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import jax.numpy as jnp
+            TBL = jnp.asarray([1, 2, 3])  # graftlint: disable=GL001
+        """}, rules=["GL001"])
+        assert res.new == [] and res.counts()["suppressed"] == 1
+        assert res.exit_code == 0
+
+    def test_test_files_exempt(self, tmp_path):
+        res = lint(tmp_path, {"test_mod.py": GL001_BAD}, rules=["GL001"])
+        assert res.new == []
+
+
+# ---------------------------------------------------------------------------
+# GL002 — host sync under jit
+# ---------------------------------------------------------------------------
+
+
+class TestGL002:
+    def test_item_under_jit_flagged(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import jax
+            @jax.jit
+            def f(x):
+                return x.item()
+        """}, rules=["GL002"])
+        assert new_rules(res) == [("GL002", "mod.py")]
+
+    def test_np_asarray_and_float_flagged(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import jax
+            import numpy as np
+            @jax.jit
+            def f(x):
+                h = np.asarray(x)
+                return float(x) + h
+        """}, rules=["GL002"])
+        assert len(res.new) == 2
+
+    def test_wrap_site_jit_detected(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import jax
+            def g(x):
+                return x.tolist()
+            fast_g = jax.jit(g)
+        """}, rules=["GL002"])
+        assert len(res.new) == 1
+
+    def test_clean_outside_jit_and_static_args(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import jax
+            from functools import partial
+            def eager(x):
+                return x.item()  # not jitted: fine
+            @partial(jax.jit, static_argnames=("n",))
+            def f(x, n):
+                return x * int(n) + x.reshape(int(x.shape[0]))
+        """}, rules=["GL002"])
+        assert res.new == []
+
+    def test_suppressed(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import jax
+            @jax.jit
+            def f(x):
+                return x.item()  # graftlint: disable=GL002
+        """}, rules=["GL002"])
+        assert res.new == [] and res.counts()["suppressed"] == 1
+
+
+# ---------------------------------------------------------------------------
+# GL003 — retrace hazards
+# ---------------------------------------------------------------------------
+
+
+class TestGL003:
+    def test_unhashable_static_default_flagged(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import jax
+            from functools import partial
+            @partial(jax.jit, static_argnames=("opts",))
+            def f(x, opts=[]):
+                return x
+        """}, rules=["GL003"])
+        assert new_rules(res) == [("GL003", "mod.py")]
+
+    def test_static_argnums_jnp_default_flagged(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import jax
+            import jax.numpy as jnp
+            from functools import partial
+            @partial(jax.jit, static_argnums=(1,))
+            def f(x, seed=jnp.uint32(7)):
+                return x
+        """}, rules=["GL003"])
+        assert ("GL003", "mod.py") in new_rules(res)
+
+    def test_inline_jit_invocation_flagged(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import jax
+            def step(x):
+                return jax.jit(lambda y: y + 1)(x)
+        """}, rules=["GL003"])
+        assert new_rules(res) == [("GL003", "mod.py")]
+
+    def test_clean_bound_jit_and_hashable_defaults(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import jax
+            from functools import partial
+            @partial(jax.jit, static_argnames=("opts",))
+            def f(x, opts=()):
+                return x
+            g = jax.jit(f)   # bound once at module scope: fine
+            def step(x):
+                return g(x)
+        """}, rules=["GL003"])
+        assert res.new == []
+
+    def test_pallas_call_inline_is_fine(self, tmp_path):
+        # pallas_call returns a callable *meant* to be invoked inline
+        # under the enclosing jit (ops/pallas_kernels.py does exactly this)
+        res = lint(tmp_path, {"mod.py": """
+            import jax
+            from jax.experimental import pallas as pl
+            @jax.jit
+            def f(x):
+                return pl.pallas_call(_kern, out_shape=None)(x)
+        """}, rules=["GL003"])
+        assert res.new == []
+
+    def test_suppressed(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import jax
+            def step(x):
+                return jax.jit(lambda y: y)(x)  # graftlint: disable=GL003
+        """}, rules=["GL003"])
+        assert res.new == [] and res.counts()["suppressed"] == 1
+
+
+# ---------------------------------------------------------------------------
+# GL004 — spill-handle leak
+# ---------------------------------------------------------------------------
+
+
+class TestGL004:
+    def test_unclosed_handle_flagged(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            from spark_rapids_jni_tpu.mem.spill import SpillableHandle
+            def leak(tree):
+                h = SpillableHandle(tree)
+                return 1
+        """}, rules=["GL004"])
+        assert new_rules(res) == [("GL004", "mod.py")]
+
+    def test_discarded_constructor_flagged(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            from spark_rapids_jni_tpu.mem.executor import TaskContext
+            def leak():
+                TaskContext(7)
+        """}, rules=["GL004"])
+        assert len(res.new) == 1
+
+    def test_clean_closed_managed_adopted_returned(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            from spark_rapids_jni_tpu.mem.spill import SpillableHandle
+            from spark_rapids_jni_tpu.mem.executor import TaskContext
+            def closed(tree):
+                h = SpillableHandle(tree)
+                try:
+                    return h.get()
+                finally:
+                    h.close()
+            def managed(tree):
+                with TaskContext(3) as ctx:
+                    h = SpillableHandle(tree, ctx=ctx)  # adopted by ctx
+                    return h.get()
+            def with_stmt(tree):
+                with SpillableHandle(tree):
+                    pass
+            def escapes(tree, registry):
+                h = SpillableHandle(tree)
+                registry.register(h)
+            def stored(self, tree):
+                self.h = SpillableHandle(tree)
+            def returned(tree):
+                return SpillableHandle(tree)
+        """}, rules=["GL004"])
+        assert res.new == []
+
+    def test_suppressed(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            def leak(tree, SpillableHandle):
+                h = SpillableHandle(tree)  # graftlint: disable=GL004
+        """}, rules=["GL004"])
+        assert res.new == [] and res.counts()["suppressed"] == 1
+
+
+# ---------------------------------------------------------------------------
+# GL005 — config-knob drift
+# ---------------------------------------------------------------------------
+
+GL005_TREE = {
+    "pkg/config.py": """
+        _REGISTRY = {}
+        def _register(key, default, parse, doc):
+            _REGISTRY[key] = (default, parse, doc)
+        _register("documented_read", 1, int, "fine")
+        _register("undocumented", 2, int, "missing from README")
+        _register("never_read", 3, int, "nobody reads me")
+    """,
+    "pkg/user.py": """
+        from . import config
+        def f():
+            return config.get("documented_read") + config.get("undocumented")
+    """,
+    "README.md": "Knobs: `documented_read` and `never_read` are documented.\n",
+}
+
+
+class TestGL005:
+    def test_drift_both_directions(self, tmp_path):
+        for rel, src in GL005_TREE.items():
+            p = tmp_path / rel
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(textwrap.dedent(src) if rel.endswith(".py") else src)
+        res = engine.run([str(tmp_path / "pkg")], root=str(tmp_path),
+                         rules=["GL005"])
+        msgs = sorted(f.message for f in res.new)
+        assert len(msgs) == 2
+        assert "undocumented" in msgs[1] and "README" in msgs[1]
+        assert "never_read" in msgs[0] and "never read" in msgs[0]
+
+    def test_clean_when_documented_and_read(self, tmp_path):
+        res = lint(tmp_path, {
+            "pkg/config.py": """
+                def _register(key, default, parse, doc): pass
+                _register("good_knob", 1, int, "doc")
+            """,
+            "pkg/user.py": """
+                from . import config
+                X = config.get("good_knob")
+            """,
+            "README.md": "`good_knob` documented here\n",
+        }, rules=["GL005"])
+        assert res.new == []
+
+
+# ---------------------------------------------------------------------------
+# GL006 — fault-kind drift
+# ---------------------------------------------------------------------------
+
+
+class TestGL006:
+    def test_unknown_and_orphan_kinds(self, tmp_path):
+        res = lint(tmp_path, {
+            "pkg/faultinj.py": """
+                FAULT_KINDS = {"exception": None, "orphan_kind": None}
+            """,
+            "pkg/use.py": """
+                CFG = {"faults": [{"match": "*", "fault": "exception"},
+                                  {"fault": "bogus"}]}
+            """,
+        }, rules=["GL006"])
+        got = sorted((f.rule, f.path) for f in res.new)
+        assert got == [("GL006", "pkg/faultinj.py"),
+                       ("GL006", "pkg/use.py")]
+        orphan = [f for f in res.new if f.path.endswith("faultinj.py")][0]
+        assert "orphan_kind" in orphan.message
+
+    def test_clean_registry_in_sync(self, tmp_path):
+        res = lint(tmp_path, {
+            "pkg/faultinj.py": """
+                FAULT_KINDS = {"exception": None}
+            """,
+            "pkg/use.py": """
+                CFG = {"faults": [{"fault": "exception"}]}
+            """,
+        }, rules=["GL006"])
+        assert res.new == []
+
+    def test_suppressed_use(self, tmp_path):
+        res = lint(tmp_path, {
+            "pkg/faultinj.py": """
+                FAULT_KINDS = {"exception": None}
+            """,
+            "pkg/use.py": """
+                OK = {"fault": "exception"}
+                BAD = {"fault": "nope"}  # graftlint: disable=GL006
+            """,
+        }, rules=["GL006"])
+        assert res.new == [] and res.counts()["suppressed"] == 1
+
+
+# ---------------------------------------------------------------------------
+# baseline ratchet
+# ---------------------------------------------------------------------------
+
+
+class TestBaselineRatchet:
+    def test_ratchet_lifecycle(self, tmp_path):
+        mod = tmp_path / "mod.py"
+        mod.write_text("import jax.numpy as jnp\nT = jnp.asarray([1])\n")
+        bl = tmp_path / "baseline.json"
+
+        # 1. new finding fails
+        res = engine.run([str(mod)], root=str(tmp_path), rules=["GL001"])
+        assert res.exit_code == 1 and len(res.new) == 1
+
+        # 2. grandfather it: same finding is now a warning, run is green
+        engine.write_baseline(str(bl), res.findings)
+        baseline = engine.load_baseline(str(bl))
+        res = engine.run([str(mod)], root=str(tmp_path),
+                         baseline=baseline, rules=["GL001"])
+        assert res.exit_code == 0
+        assert res.counts() == {"new": 0, "baselined": 1, "suppressed": 0}
+
+        # 3. a *different* violation still fails (ratchet, not a waiver)
+        mod.write_text("import jax.numpy as jnp\nT = jnp.asarray([1])\n"
+                       "U = jnp.zeros((4,))\n")
+        res = engine.run([str(mod)], root=str(tmp_path),
+                         baseline=baseline, rules=["GL001"])
+        assert res.exit_code == 1 and len(res.new) == 1
+        assert res.counts()["baselined"] == 1
+
+        # 4. burn-down: fixing the grandfathered finding leaves a stale
+        #    entry and a green run — the baseline only ever shrinks
+        mod.write_text("import numpy as np\nT = np.asarray([1])\n")
+        res = engine.run([str(mod)], root=str(tmp_path),
+                         baseline=baseline, rules=["GL001"])
+        assert res.exit_code == 0 and res.findings == []
+        assert len(res.stale_baseline) == 1
+
+    def test_fingerprint_survives_line_motion(self, tmp_path):
+        mod = tmp_path / "mod.py"
+        mod.write_text("import jax.numpy as jnp\nT = jnp.asarray([1])\n")
+        res = engine.run([str(mod)], root=str(tmp_path), rules=["GL001"])
+        bl = tmp_path / "b.json"
+        engine.write_baseline(str(bl), res.findings)
+        # shift the finding down two lines: fingerprint is line-number-free
+        mod.write_text("import jax.numpy as jnp\n\n\nT = jnp.asarray([1])\n")
+        res = engine.run([str(mod)], root=str(tmp_path),
+                         baseline=engine.load_baseline(str(bl)),
+                         rules=["GL001"])
+        assert res.exit_code == 0 and res.counts()["baselined"] == 1
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+class TestCli:
+    def test_json_format_and_exit_code(self, tmp_path, capsys):
+        mod = tmp_path / "mod.py"
+        mod.write_text("import jax.numpy as jnp\nT = jnp.asarray([1])\n")
+        rc = cli_main([str(mod), "--root", str(tmp_path), "--format",
+                       "json", "--no-baseline", "--rules", "GL001"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 1 and doc["exit_code"] == 1
+        assert [f["rule"] for f in doc["findings"]] == ["GL001"]
+
+    def test_write_baseline_then_green(self, tmp_path, capsys):
+        mod = tmp_path / "mod.py"
+        mod.write_text("import jax.numpy as jnp\nT = jnp.asarray([1])\n")
+        bl = str(tmp_path / "bl.json")
+        assert cli_main([str(mod), "--root", str(tmp_path), "--baseline",
+                         bl, "--write-baseline", "--rules", "GL001"]) == 0
+        capsys.readouterr()
+        assert cli_main([str(mod), "--root", str(tmp_path), "--baseline",
+                         bl, "--rules", "GL001"]) == 0
+
+    def test_unknown_rule_is_usage_error(self, tmp_path, capsys):
+        assert cli_main([str(tmp_path), "--rules", "GL999"]) == 2
+
+    def test_module_entrypoint(self, tmp_path):
+        mod = tmp_path / "mod.py"
+        mod.write_text("import jax.numpy as jnp\nT = jnp.asarray([1])\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", str(mod),
+             "--root", str(tmp_path), "--no-baseline", "--format", "json"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 1
+        assert json.loads(proc.stdout)["counts"]["new"] == 1
+
+
+# ---------------------------------------------------------------------------
+# live-tree meta-gate: the repo itself stays lint-clean
+# ---------------------------------------------------------------------------
+
+
+class TestLiveTree:
+    def test_live_tree_has_no_new_findings(self):
+        baseline = engine.load_baseline(engine.default_baseline_path())
+        res = engine.run(
+            [os.path.join(REPO_ROOT, "spark_rapids_jni_tpu"),
+             os.path.join(REPO_ROOT, "tests")],
+            root=REPO_ROOT, baseline=baseline)
+        assert res.parse_errors == []
+        assert res.new == [], "\n" + res.to_text()
+
+    def test_live_baseline_is_empty(self):
+        # the GL001 burn-down left nothing grandfathered; keep it that way
+        assert engine.load_baseline(engine.default_baseline_path()) == []
+
+    def test_every_rule_is_registered(self):
+        from tools.graftlint import rules as rules_mod
+        ids = [r.id for r in rules_mod.all_rules()]
+        assert ids == ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006"]

@@ -356,7 +356,7 @@ class TestSpillIOFault:
     def test_spill_io_rule_validates(self):
         faultinj._Rule({"match": "spill_io_*", "fault": "spill_io"})
         with pytest.raises(ValueError):
-            faultinj._Rule({"fault": "bogus"})
+            faultinj._Rule({"fault": "bogus"})  # graftlint: disable=GL006
 
 
 class TestMetricsExport:

@@ -1,0 +1,35 @@
+"""graftlint — AST-based JAX-hazard static analysis for this repo.
+
+The native layer is guarded by compute-sanitizer profiles (``ci/sanitize.sh``
+mirrors the reference's ``test-with-sanitizer``); this package is the same
+idea for the Python/JAX layer, encoding the bug classes this repo has
+actually shipped (PR 2's module-level-``jnp``-constant ``UnexpectedTracerError``)
+or is structurally exposed to:
+
+========  ==================================================================
+GL001     tracer leak: eager ``jnp.*``/``jax.*`` array construction at
+          module scope in ``spark_rapids_jni_tpu/``
+GL002     host sync under jit: ``.item()``/``.tolist()``/``np.asarray``/
+          ``jax.device_get``/``float()`` on traced values inside jitted fns
+GL003     retrace hazard: unhashable static-arg defaults; ``jax.jit(f)(x)``
+          re-jitted at every call
+GL004     spill-handle leak: ``SpillableHandle``/``TaskContext`` constructed
+          and never closed/released/adopted/managed
+GL005     config-knob drift: ``config.py`` keys must be documented in
+          README.md and read somewhere outside ``config.py``
+GL006     fault-kind drift: ``faultinj`` kind strings used anywhere must
+          exist in ``faultinj.FAULT_KINDS``, and vice versa
+========  ==================================================================
+
+Run ``python -m tools.graftlint spark_rapids_jni_tpu tests``; see
+``tools/graftlint/README.md`` for rule rationale, suppressions
+(``# graftlint: disable=GLnnn``) and the baseline ratchet.
+"""
+
+from .engine import (  # noqa: F401
+    Finding,
+    LintResult,
+    ParsedFile,
+    load_baseline,
+    run,
+)

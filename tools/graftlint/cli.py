@@ -1,0 +1,69 @@
+"""graftlint command line.
+
+    python -m tools.graftlint spark_rapids_jni_tpu tests
+    python -m tools.graftlint --format json --baseline tools/graftlint/baseline.json ...
+    python -m tools.graftlint --write-baseline ...   # grandfather current findings
+
+Exit codes: 0 clean (baselined/suppressed findings allowed), 1 new
+findings, 2 bad usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional, Sequence
+
+from . import engine
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="AST-based JAX-hazard linter (rules GL001-GL006); "
+                    "see tools/graftlint/README.md")
+    parser.add_argument("paths", nargs="+",
+                        help="files or directories to lint")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline file (default: "
+                             "tools/graftlint/baseline.json)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline; every finding is new")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="rewrite the baseline from current findings "
+                             "and exit 0")
+    parser.add_argument("--root", default=None,
+                        help="project root (default: the repo containing "
+                             "this tool)")
+    parser.add_argument("--rules", default=None,
+                        help="comma list restricting to these rule ids")
+    args = parser.parse_args(argv)
+
+    baseline_path = args.baseline or engine.default_baseline_path()
+    baseline = [] if args.no_baseline else engine.load_baseline(baseline_path)
+    rules = args.rules.split(",") if args.rules else None
+    try:
+        result = engine.run(args.paths, root=args.root, baseline=baseline,
+                            rules=rules)
+    except ValueError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        engine.write_baseline(baseline_path, result.findings)
+        kept = sum(1 for f in result.findings if f.status != "suppressed")
+        print(f"graftlint: wrote {kept} baseline entr"
+              f"{'y' if kept == 1 else 'ies'} to {baseline_path}")
+        return 0
+
+    out = result.to_json() if args.format == "json" else result.to_text()
+    sys.stdout.write(out)
+    if result.parse_errors:
+        return 2
+    return result.exit_code
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

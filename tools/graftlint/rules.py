@@ -1,0 +1,636 @@
+"""The six graftlint rules.  Each encodes a bug this repo shipped or is
+structurally exposed to; see tools/graftlint/README.md for the full
+rationale with the motivating incident per rule."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .engine import Finding, ParsedFile, Project
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------------
+
+
+def module_aliases(tree: ast.AST) -> Dict[str, str]:
+    """Name bound by an import -> the dotted thing it names.
+
+    ``import jax.numpy as jnp`` -> {"jnp": "jax.numpy"};
+    ``import jax`` / ``import jax.numpy`` -> {"jax": "jax"};
+    ``from jax import jit`` -> {"jit": "jax.jit"};
+    ``from functools import partial`` -> {"partial": "functools.partial"}.
+    """
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    aliases[a.asname] = a.name
+                else:
+                    top = a.name.split(".")[0]
+                    aliases[top] = top
+        elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def resolve(node: ast.AST, aliases: Dict[str, str]) -> Optional[str]:
+    """Dotted path of ``jnp.asarray``-style expressions via the alias map."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    base = aliases.get(node.id)
+    if base is None:
+        return None
+    parts.append(base)
+    return ".".join(reversed(parts))
+
+
+_JNP_ROOTS = ("jax.numpy.", "jax.experimental.numpy.")
+_ARRAY_CTORS = {
+    "array", "asarray", "zeros", "ones", "full", "empty", "arange",
+    "linspace", "eye", "identity", "zeros_like", "ones_like", "full_like",
+    "frombuffer", "stack", "concatenate", "tri", "tril", "triu",
+    # dtype calls mint 0-d device arrays eagerly: jnp.uint32(5) etc.
+    "uint8", "uint16", "uint32", "uint64", "int8", "int16", "int32",
+    "int64", "float16", "float32", "float64", "bfloat16", "bool_",
+    "complex64", "complex128",
+}
+_JAX_EAGER = {"jax.device_put"}
+
+
+def _is_eager_jax_array_call(node: ast.AST, aliases: Dict[str, str]) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    dotted = resolve(node.func, aliases)
+    if dotted is None:
+        return False
+    if dotted in _JAX_EAGER:
+        return True
+    for root in _JNP_ROOTS:
+        if dotted.startswith(root) and dotted[len(root):] in _ARRAY_CTORS:
+            return True
+    return False
+
+
+_JIT_SUFFIXES = ("jit", "pmap", "shard_map", "pallas_call")
+
+
+def _is_jit_wrapper(dotted: Optional[str]) -> bool:
+    if dotted is None:
+        return False
+    last = dotted.rsplit(".", 1)[-1]
+    return last in _JIT_SUFFIXES and dotted.split(".", 1)[0] in (
+        "jax", "pallas")
+
+
+def _jit_call_info(node: ast.AST, aliases: Dict[str, str]):
+    """If ``node`` is a jit-family wrap — ``jax.jit(...)``, ``@jax.jit``,
+    ``partial(jax.jit, ...)`` — return its keyword list, else None."""
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        return [] if _is_jit_wrapper(resolve(node, aliases)) else None
+    if isinstance(node, ast.Call):
+        dotted = resolve(node.func, aliases)
+        if _is_jit_wrapper(dotted):
+            return list(node.keywords)
+        if (dotted in ("functools.partial", "partial")
+                or (dotted or "").endswith(".partial")):
+            if node.args and _is_jit_wrapper(resolve(node.args[0], aliases)):
+                return list(node.keywords)
+        return None
+    return None
+
+
+def _static_names(fn: ast.FunctionDef,
+                  jit_keywords: Sequence[ast.keyword]) -> Set[str]:
+    """Parameter names declared static via static_argnames/static_argnums."""
+    names: Set[str] = set()
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    for kw in jit_keywords:
+        if kw.arg == "static_argnames":
+            for c in ast.walk(kw.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                    names.add(c.value)
+        elif kw.arg == "static_argnums":
+            for c in ast.walk(kw.value):
+                if (isinstance(c, ast.Constant)
+                        and isinstance(c.value, int)
+                        and 0 <= c.value < len(params)):
+                    names.add(params[c.value])
+    return names
+
+
+def _jitted_functions(pf: ParsedFile, aliases: Dict[str, str]):
+    """(FunctionDef, jit keywords) for every function that runs traced:
+    decorated with the jit family, or wrapped by name elsewhere in the
+    module (``fast = jax.jit(fast_impl)`` / ``pl.pallas_call(kernel, ...)``).
+    """
+    defs: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(pf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+    out: List[Tuple[ast.FunctionDef, List[ast.keyword]]] = []
+    seen: Set[int] = set()
+    for fn in defs.values():
+        for dec in fn.decorator_list:
+            kws = _jit_call_info(dec, aliases)
+            if kws is not None and id(fn) not in seen:
+                seen.add(id(fn))
+                out.append((fn, kws))
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        if not _is_jit_wrapper(resolve(node.func, aliases)):
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Name) and arg.id in defs:
+            fn = defs[arg.id]
+            if id(fn) not in seen:
+                seen.add(id(fn))
+                out.append((fn, list(node.keywords)))
+    return out
+
+
+def _walk_scope(node: ast.AST, *, into_functions: bool) -> Iterator[ast.AST]:
+    """Walk children; optionally stop at nested function boundaries.
+    Decorators and default expressions of nested defs are always walked —
+    they execute in the enclosing scope."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)) and not into_functions:
+            if not isinstance(child, ast.Lambda):
+                stack.extend(child.decorator_list)
+                stack.extend(child.args.defaults)
+                stack.extend(d for d in child.args.kw_defaults if d)
+            continue
+        yield child
+        stack.extend(ast.iter_child_nodes(child))
+
+
+# ---------------------------------------------------------------------------
+# rule plumbing
+# ---------------------------------------------------------------------------
+
+
+class Rule:
+    id: str = ""
+    per_file: bool = True
+
+    def check(self, pf: ParsedFile) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, files: List[ParsedFile],
+                      project: Project) -> Iterable[Finding]:
+        return ()
+
+
+# ---------------------------------------------------------------------------
+# GL001 — tracer leak: eager jnp/jax array construction at import time
+# ---------------------------------------------------------------------------
+
+
+class GL001TracerLeak(Rule):
+    """PR 2 shipped this exact bug: ``ops/decimal*.py`` held module-level
+    ``jnp`` constants; the module is imported lazily from inside jitted
+    aggregation bodies, so the constants were minted under an active trace
+    and escaped as tracers -> ``UnexpectedTracerError`` on the next trace.
+    Module scope (and class bodies, and default-arg expressions — anything
+    executed at import time) must build constants from numpy, converting
+    to device arrays inside the function that uses them."""
+
+    id = "GL001"
+
+    def check(self, pf: ParsedFile) -> Iterable[Finding]:
+        if pf.is_test_file:
+            return
+        aliases = module_aliases(pf.tree)
+        if not any(v == "jax" or v.startswith("jax.")
+                   for v in aliases.values()):
+            return
+        for node in _walk_scope(pf.tree, into_functions=False):
+            if _is_eager_jax_array_call(node, aliases):
+                name = resolve(node.func, aliases)
+                yield pf.finding(
+                    self.id, node,
+                    f"eager `{name}(...)` at import time creates a device "
+                    "array at module scope; under an active trace (lazy "
+                    "import inside a jitted body) it leaks a tracer "
+                    "(UnexpectedTracerError — the PR 2 decimal bug). Build "
+                    "the constant with numpy and convert inside the "
+                    "function that uses it.")
+
+
+# ---------------------------------------------------------------------------
+# GL002 — host sync under jit
+# ---------------------------------------------------------------------------
+
+_HOST_SYNC_METHODS = {"item", "tolist"}
+_HOST_SYNC_CALLS = {"numpy.asarray", "numpy.array", "jax.device_get",
+                    "numpy.copy"}
+_HOST_CAST_BUILTINS = {"float", "int", "bool"}
+
+
+class GL002HostSyncUnderJit(Rule):
+    """Inside a jit/shard_map/pallas trace, ``.item()``, ``.tolist()``,
+    ``np.asarray(...)``, ``jax.device_get`` or ``float()/int()/bool()`` on a
+    traced value either raises ``ConcretizationTypeError`` (caught only on
+    the first trace of that shape) or, on a concrete leaked value, silently
+    serializes the device pipeline — the class of stall ``histogram.py``
+    documents for its (eager, intentional) negative-frequency check."""
+
+    id = "GL002"
+
+    def check(self, pf: ParsedFile) -> Iterable[Finding]:
+        aliases = module_aliases(pf.tree)
+        for fn, jit_kws in _jitted_functions(pf, aliases):
+            static = _static_names(fn, jit_kws)
+            params = {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                      + fn.args.kwonlyargs)} - static
+            if fn.args.vararg:
+                params.add(fn.args.vararg.arg)
+            for node in fn.body:
+                yield from self._scan(pf, node, aliases, params, fn.name)
+
+    def _scan(self, pf, root, aliases, params, fn_name):
+        for node in ast.walk(root):
+            if not isinstance(node, ast.Call):
+                continue
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _HOST_SYNC_METHODS
+                    and not node.args):
+                yield pf.finding(
+                    self.id, node,
+                    f"`.{node.func.attr}()` inside jitted `{fn_name}` "
+                    "forces a host sync / concretization of a traced value")
+                continue
+            dotted = resolve(node.func, aliases)
+            if dotted in _HOST_SYNC_CALLS:
+                yield pf.finding(
+                    self.id, node,
+                    f"`{dotted}(...)` inside jitted `{fn_name}` pulls a "
+                    "traced value to host")
+                continue
+            if (isinstance(node.func, ast.Name)
+                    and node.func.id in _HOST_CAST_BUILTINS
+                    and node.func.id not in aliases
+                    and len(node.args) == 1
+                    and self._arg_is_traced(node.args[0], aliases, params)):
+                yield pf.finding(
+                    self.id, node,
+                    f"`{node.func.id}(...)` on a traced value inside jitted "
+                    f"`{fn_name}` concretizes it on host "
+                    "(ConcretizationTypeError or a silent pipeline stall)")
+
+    _STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+    @staticmethod
+    def _arg_is_traced(arg, aliases, params) -> bool:
+        for sub in ast.walk(arg):
+            # int(x.shape[0])-style metadata reads are static under trace
+            if (isinstance(sub, ast.Attribute)
+                    and sub.attr in GL002HostSyncUnderJit._STATIC_ATTRS):
+                return False
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Name) and sub.id in params:
+                return True
+            if isinstance(sub, (ast.Attribute, ast.Call)):
+                dotted = resolve(sub.func if isinstance(sub, ast.Call)
+                                 else sub, aliases)
+                if dotted and dotted.split(".", 1)[0] == "jax":
+                    return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# GL003 — retrace hazards
+# ---------------------------------------------------------------------------
+
+
+class GL003RetraceHazard(Rule):
+    """Two shapes: (a) a static argument whose default is unhashable
+    (list/dict/set or a jnp array) — ``jax.jit`` hashes static args, so
+    the first defaulted call raises ``TypeError: unhashable``; (b)
+    ``jax.jit(f)(x)`` invoked inline — a fresh jit wrapper per call means
+    a fresh trace/compile per call, the compile-cache pathology
+    ``tools/compile_cache_pathology.py`` measures."""
+
+    id = "GL003"
+
+    def check(self, pf: ParsedFile) -> Iterable[Finding]:
+        aliases = module_aliases(pf.tree)
+        for fn, jit_kws in _jitted_functions(pf, aliases):
+            static = _static_names(fn, jit_kws)
+            if static:
+                yield from self._check_static_defaults(pf, fn, static,
+                                                       aliases)
+        if pf.is_test_file:
+            return  # one-shot jit(f)(x) in a test is not a hot path
+        for node in ast.walk(pf.tree):
+            # only jit/pmap: pallas_call and shard_map return callables
+            # *meant* to be invoked inline under an enclosing jit
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Call)
+                    and (resolve(node.func.func, aliases) or "").rsplit(
+                        ".", 1)[-1] in ("jit", "pmap")
+                    and _is_jit_wrapper(resolve(node.func.func, aliases))):
+                yield pf.finding(
+                    self.id, node,
+                    "`jit(...)(...)` invoked inline builds a fresh jit "
+                    "wrapper per call — every call re-traces and "
+                    "re-compiles; bind the jitted callable once at module "
+                    "or closure scope")
+
+    def _check_static_defaults(self, pf, fn, static, aliases):
+        args = fn.args.posonlyargs + fn.args.args
+        defaults = fn.args.defaults
+        offset = len(args) - len(defaults)
+        pairs = [(args[offset + i].arg, d) for i, d in enumerate(defaults)]
+        pairs += [(a.arg, d) for a, d in
+                  zip(fn.args.kwonlyargs, fn.args.kw_defaults) if d]
+        for name, default in pairs:
+            if name not in static:
+                continue
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                kind = type(default).__name__.lower()
+                yield pf.finding(
+                    self.id, default,
+                    f"static arg `{name}` of `{fn.name}` defaults to a "
+                    f"{kind} — jax.jit hashes static args, so the first "
+                    "defaulted call raises TypeError: unhashable; use a "
+                    "tuple/frozenset/None sentinel")
+            elif any(_is_eager_jax_array_call(c, aliases)
+                     for c in ast.walk(default)):
+                yield pf.finding(
+                    self.id, default,
+                    f"static arg `{name}` of `{fn.name}` defaults to a jax "
+                    "array — arrays are unhashable as static args and "
+                    "retrace on every new instance")
+
+
+# ---------------------------------------------------------------------------
+# GL004 — spill-handle leak
+# ---------------------------------------------------------------------------
+
+_HANDLE_CLASSES = {"SpillableHandle", "TaskContext"}
+_CLOSE_METHODS = {"close", "release", "adopt", "adopt_handle", "__exit__"}
+
+
+class GL004SpillHandleLeak(Rule):
+    """A ``SpillableHandle`` registers itself with the process-wide
+    ``SpillableStore`` on construction; a ``TaskContext`` owns arena
+    charge.  One never closed/released/adopted pins its bytes in the
+    store's LRU forever — the leak shows up as every *other* task
+    spilling harder.  Flag constructions whose result is discarded or
+    bound to a name that is never closed, released, returned, yielded,
+    aliased, stored, passed on, or used as a context manager."""
+
+    id = "GL004"
+
+    def check(self, pf: ParsedFile) -> Iterable[Finding]:
+        for node in ast.walk(pf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_fn(pf, node)
+
+    def _ctor_name(self, call: ast.AST) -> Optional[str]:
+        if not isinstance(call, ast.Call):
+            return None
+        func = call.func
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else None)
+        if name not in _HANDLE_CLASSES:
+            return None
+        # `SpillableHandle(..., ctx=task_ctx)` is adopted: the TaskContext
+        # auto-closes adopted handles on __exit__, so ownership transfers
+        # at construction
+        for kw in call.keywords:
+            if kw.arg == "ctx" and not (isinstance(kw.value, ast.Constant)
+                                        and kw.value.value is None):
+                return None
+        return name
+
+    def _check_fn(self, pf, fn):
+        managed: Set[int] = set()   # Call nodes that are withitem contexts
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    managed.add(id(item.context_expr))
+        body_nodes = list(_walk_scope(fn, into_functions=False))
+        for node in body_nodes:
+            if not isinstance(node, ast.Expr):
+                continue
+            name = self._ctor_name(node.value)
+            if name and id(node.value) not in managed:
+                yield pf.finding(
+                    self.id, node,
+                    f"`{name}(...)` constructed and immediately discarded "
+                    "— the handle stays registered and can never be "
+                    "closed")
+        for node in body_nodes:
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            name = self._ctor_name(node.value)
+            if not name:
+                continue
+            var = node.targets[0].id
+            if not self._escapes(fn, node, var):
+                yield pf.finding(
+                    self.id, node,
+                    f"`{var} = {name}(...)` is never closed, released, "
+                    "adopted, returned, stored, or used as a context "
+                    "manager in this scope — the handle leaks its store "
+                    "registration")
+
+    def _escapes(self, fn, assign_node, var: str) -> bool:
+        past = False
+        for node in ast.walk(fn):
+            if node is assign_node:
+                past = True
+                continue
+            if isinstance(node, ast.Call):
+                f = node.func
+                if (isinstance(f, ast.Attribute)
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id == var
+                        and f.attr in _CLOSE_METHODS):
+                    return True
+                for a in list(node.args) + [k.value for k in node.keywords]:
+                    for sub in ast.walk(a):
+                        if isinstance(sub, ast.Name) and sub.id == var:
+                            return True
+            elif isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Name) and sub.id == var:
+                        return True
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    ce = item.context_expr
+                    if isinstance(ce, ast.Name) and ce.id == var:
+                        return True
+                    for sub in ast.walk(ce):
+                        if isinstance(sub, ast.Name) and sub.id == var:
+                            return True
+            elif isinstance(node, ast.Assign) and node is not assign_node:
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Name) and sub.id == var:
+                        return True   # aliased / stored (self.h = h, d[k]=h)
+            elif isinstance(node, (ast.List, ast.Tuple, ast.Dict, ast.Set)):
+                for sub in ast.iter_child_nodes(node):
+                    if isinstance(sub, ast.Name) and sub.id == var:
+                        return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# GL005 — config-knob drift
+# ---------------------------------------------------------------------------
+
+
+class GL005ConfigDrift(Rule):
+    """Every knob registered in ``config.py`` must be (a) documented in
+    README.md and (b) read somewhere outside ``config.py`` — PR 2 left
+    ``bench_rows`` registered after the bench stopped reading it, and
+    nothing noticed.  Dead knobs are worse than no knobs: operators tune
+    them and see no effect."""
+
+    id = "GL005"
+    per_file = False
+
+    def check_project(self, files, project) -> Iterable[Finding]:
+        cfg = next((pf for pf in files
+                    if pf.relpath.endswith("config.py")
+                    and self._register_calls(pf)), None)
+        if cfg is None:
+            return
+        keys = self._register_calls(cfg)
+        readme = project.readme_text()
+        read_strings: Set[str] = set()
+        for pf in project.universe():
+            if pf.path == cfg.path:
+                continue
+            for node in ast.walk(pf.tree):
+                if (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)):
+                    read_strings.add(node.value)
+        for key, node in keys:
+            if key not in readme:
+                yield cfg.finding(
+                    self.id, node,
+                    f"config knob `{key}` is not documented in README.md")
+            if key not in read_strings:
+                yield cfg.finding(
+                    self.id, node,
+                    f"config knob `{key}` is registered but never read "
+                    "outside config.py — dead knob (tune it and nothing "
+                    "changes)")
+
+    @staticmethod
+    def _register_calls(pf) -> List[Tuple[str, ast.AST]]:
+        out = []
+        for node in ast.walk(pf.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "_register"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                out.append((node.args[0].value, node))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# GL006 — fault-kind drift
+# ---------------------------------------------------------------------------
+
+
+class GL006FaultKindDrift(Rule):
+    """``faultinj.FAULT_KINDS`` is the registry of injectable fault
+    flavors.  A config dict naming a kind that isn't registered fails
+    only when its rule first *fires* (``_Rule`` raises at configure
+    time, but only if that code path runs); a registered kind no test
+    ever injects is untested error handling.  Both directions drift
+    silently, so both are checked statically."""
+
+    id = "GL006"
+    per_file = False
+
+    def check_project(self, files, project) -> Iterable[Finding]:
+        finj = next((pf for pf in project.universe()
+                     if pf.relpath.endswith("faultinj.py")
+                     and self._registry(pf)), None)
+        if finj is None:
+            return
+        registry = self._registry(finj)
+        known = {k for k, _ in registry}
+        used: Set[str] = set()
+        for pf in project.universe():
+            if pf.path == finj.path:
+                continue
+            for kind, _node in self._uses(pf):
+                used.add(kind)
+        for pf in files:
+            for kind, node in self._uses(pf):
+                if kind not in known:
+                    yield pf.finding(
+                        self.id, node,
+                        f"fault kind `{kind}` is not in "
+                        "faultinj.FAULT_KINDS — this rule can never fire "
+                        f"(known: {sorted(known)})")
+        for kind, node in registry:
+            if kind not in used:
+                yield finj.finding(
+                    self.id, node,
+                    f"fault kind `{kind}` is registered in FAULT_KINDS but "
+                    "never injected anywhere in the linted tree — "
+                    "untested fault-handling path")
+
+    @staticmethod
+    def _registry(pf) -> List[Tuple[str, ast.AST]]:
+        for node in ast.walk(pf.tree):
+            if (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "FAULT_KINDS"
+                    and isinstance(node.value, ast.Dict)):
+                return [(k.value, k) for k in node.value.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)]
+        return []
+
+    @staticmethod
+    def _uses(pf) -> Iterator[Tuple[str, ast.AST]]:
+        """Dict literals carrying ``"fault": "<kind>"``."""
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Dict):
+                continue
+            for k, v in zip(node.keys, node.values):
+                if (isinstance(k, ast.Constant) and k.value == "fault"
+                        and isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)):
+                    yield v.value, v
+
+
+_ALL: List[Rule] = [GL001TracerLeak(), GL002HostSyncUnderJit(),
+                    GL003RetraceHazard(), GL004SpillHandleLeak(),
+                    GL005ConfigDrift(), GL006FaultKindDrift()]
+
+
+def all_rules(only: Optional[Sequence[str]] = None) -> List[Rule]:
+    if only is None:
+        return list(_ALL)
+    wanted = set(only)
+    unknown = wanted - {r.id for r in _ALL}
+    if unknown:
+        raise ValueError(f"unknown rule ids: {sorted(unknown)}")
+    return [r for r in _ALL if r.id in wanted]
