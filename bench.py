@@ -129,6 +129,65 @@ def _numpy_q95_mrows(n_rows, seed=19):
     return n_rows / ((time.perf_counter() - t0) / 3) / 1e6
 
 
+def _q95_note(ge, nq, qm, use_devgen, left_s):
+    """The q95 line's ``note``: chosen engines + per-stage milliseconds
+    (VERDICT's fallback done-bar — the emitted capture must defend any
+    residual gap by showing where the time goes).  Stage times come from
+    cumulative-prefix programs (``_q95_prefix``), differenced; the full
+    step's time is derived from the already-measured ``qm`` so the
+    breakdown costs three extra small compiles, not four.  Devgen
+    (accelerator) runs skip the prefix timing — three more fresh-shape
+    tunnel compiles at ~40s each don't fit any budget — and still
+    document the engine plan."""
+    import functools
+
+    import jax
+
+    from spark_rapids_jni_tpu.parallel import partition as _pt
+    from spark_rapids_jni_tpu.relational.aggregate import (
+        _resolve_groupby_engine,
+    )
+    from spark_rapids_jni_tpu.relational.join import _resolve_join_engine
+
+    slots = 9  # P=8 partitions + 1 dead pseudo-partition (_q95_prefix)
+    regroup = ("scatter" if jax.default_backend() == "cpu"
+               and slots <= _pt._COUNTING_MAX_SLOTS
+               and nq * slots <= _pt._COUNTING_MAX_CELLS else "sort")
+    note = {"engines": {
+        "groupby": _resolve_groupby_engine(None),
+        "join": _resolve_join_engine(None),
+        "regroup": regroup,
+    }}
+    if use_devgen or left_s < 60:
+        return note
+    reps = 2
+    seed = [4000]
+
+    def stage_ms(upto):
+        jf = jax.jit(functools.partial(ge._q95_prefix, upto=upto))
+        vs = [ge._q95_batches(nq, seed=seed[0] + i)
+              for i in range(reps + 1)]
+        seed[0] += reps + 1
+        mrows = _bench_one(jf, vs[0], nq, reps, variants=vs)
+        return nq / (mrows * 1e6) * 1e3
+
+    try:
+        t1 = stage_ms("exch1")
+        t2 = stage_ms("join1")
+        t3 = stage_ms("join2")
+        t_full = nq / (qm * 1e6) * 1e3
+        note["stages_ms"] = {
+            "exchange1": round(t1, 2),
+            "join1": round(max(t2 - t1, 0.0), 2),
+            "exch2_join2": round(max(t3 - t2, 0.0), 2),
+            "groupby": round(max(t_full - t3, 0.0), 2),
+            "full": round(t_full, 2),
+        }
+    except Exception as e:  # the note must never sink the metric line
+        note["stages_error"] = f"{type(e).__name__}: {e}"
+    return note
+
+
 def _bench_one(jfn, args, n_rows, reps, variants=None):
     """Compile+warm on ``variants[0]``, then time ``variants[1:]`` — each
     executed EXACTLY ONCE.
@@ -191,14 +250,26 @@ def child_main():
     is_accel = platform != "cpu"
     n_full = int(os.environ.get("BENCH_N_ROWS", 0)) or config.get(
         "bench_rows_tpu" if is_accel else "bench_rows_cpu")
-    if not is_accel and (config.get("q6_group_path") != "onehot"
-                        or config.get("q6_onehot_engine")
-                        not in ("auto", "scatter")):
-        # bench_rows_cpu=1M is sized for the scatter engine (~35ms/iter);
+    if not is_accel:
+        from spark_rapids_jni_tpu.relational.aggregate import (
+            _resolve_groupby_engine,
+        )
+
+        # bench_rows_cpu=1M is sized for the scatter engines (~35ms/iter);
         # the sort/onehot/pallas engines are seconds per iteration on
         # XLA-CPU — an A/B override falling back to CPU must not blow the
-        # driver window (the BENCH_r02 failure mode)
-        n_full = min(n_full, 1 << 18)
+        # driver window (the BENCH_r02 failure mode).  The general path
+        # (q6_group_path != 'onehot') is only slow when the groupby_engine
+        # knob resolves to 'sort' — since r6 it delegates to the shared
+        # engine-selectable group_by, whose auto picks scatter on CPU.
+        gp = config.get("q6_group_path")
+        slow_general = (gp != "onehot"
+                        and _resolve_groupby_engine(None) != "scatter")
+        slow_onehot = (gp == "onehot"
+                       and config.get("q6_onehot_engine")
+                       not in ("auto", "scatter"))
+        if slow_general or slow_onehot:
+            n_full = min(n_full, 1 << 18)
     jfn = jax.jit(ge._q6_step)
 
     # Device-side generation (default on accelerators): host-built
@@ -299,11 +370,13 @@ def child_main():
             qv = [ge._q95_batches(nq, seed=19 + i) for i in range(REPS + 1)]
             qm = _bench_one(jax.jit(ge._q95_step), qv[0], nq, REPS,
                             variants=qv)
+        note = _q95_note(ge, nq, qm, use_devgen,
+                         deadline_s - (time.monotonic() - t_start))
         print(json.dumps({
             "metric": "q95_shape_throughput", "value": round(qm, 2),
             "unit": "Mrows/s",
             "vs_baseline": round(qm / _numpy_q95_mrows(nq), 2),
-            "platform": platform, "rows": nq}), flush=True)
+            "platform": platform, "rows": nq, "note": note}), flush=True)
     except Exception as e:  # informative stage: never fail the capture
         print(f"# q95 stage failed: {e}", file=sys.stderr, flush=True)
     return 0
@@ -875,7 +948,8 @@ def micro_main():
     # group-by (100 keys, sum+count) — mirrors the q6 aggregate stage
     from spark_rapids_jni_tpu.relational import AggSpec, group_by
 
-    gbs = [] if not want("group_by_100keys", "group_by_100keys_domain") \
+    gbs = [] if not want("group_by_100keys", "group_by_100keys_scatter",
+                         "group_by_100keys_domain") \
         else [
         (ColumnBatch(
             {
@@ -885,11 +959,28 @@ def micro_main():
         ),)
         for _ in range(V)
     ]
+    # engine pinned to 'sort': this row predates the engine knob and must
+    # keep measuring the sort-scan path round over round
     run(
         "group_by_100keys",
         jax.jit(
             lambda b: group_by(
-                b, ["k"], [AggSpec("sum", "v", "s"), AggSpec("count", None, "c")]
+                b, ["k"], [AggSpec("sum", "v", "s"), AggSpec("count", None, "c")],
+                engine="sort",
+            )
+        ),
+        gbs,
+        m,
+    )
+
+    # same shape on the r6 scatter engine (slot table + segment sums, no
+    # row-sized sort) — the groupby_engine A/B row
+    run(
+        "group_by_100keys_scatter",
+        jax.jit(
+            lambda b: group_by(
+                b, ["k"], [AggSpec("sum", "v", "s"), AggSpec("count", None, "c")],
+                engine="scatter",
             )
         ),
         gbs,
@@ -962,18 +1053,27 @@ def micro_main():
     run("q95_shape_2exch_2join_agg", jax.jit(ge._q95_step), q95in, nq,
         reps=4)
 
-    # dim-join engine A/B (r5): general sort-probe vs the dense
-    # rowid-table path, same fact x dim1 data and output contract
+    # dim-join engine A/B (r5/r6): general sort-probe vs slot-table
+    # hash-probe vs the dense rowid-table path, same fact x dim1 data
+    # and output contract.  join_dim_hash predates the join_engine knob
+    # and stays pinned to the sorted-build binary-search engine so its
+    # round-over-round meaning survives the 'auto' default.
     from spark_rapids_jni_tpu.relational import (
         hash_join as _hj,
         join_dense_or_hash as _jd,
     )
 
-    jv = [] if not want("join_dim_hash", "join_dim_dense") else [
+    jv = [] if not want("join_dim_hash", "join_dim_hashprobe",
+                        "join_dim_dense") else [
         ge._q95_batches(nq, seed=29 + k) for k in range(V)]
     nd_j = max(nq // ge.Q95_ND_DIV, 1)
     run("join_dim_hash",
-        jax.jit(lambda f, d1, d2: _hj(f, d1, ["k"], ["k"], "inner")),
+        jax.jit(lambda f, d1, d2: _hj(f, d1, ["k"], ["k"], "inner",
+                                      engine="sort")),
+        jv, nq, reps=4)
+    run("join_dim_hashprobe",
+        jax.jit(lambda f, d1, d2: _hj(f, d1, ["k"], ["k"], "inner",
+                                      engine="hash")),
         jv, nq, reps=4)
     run("join_dim_dense",
         jax.jit(lambda f, d1, d2: _jd(f, d1, "k", "k", nd_j)),

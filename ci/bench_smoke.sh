@@ -2,7 +2,12 @@
 # CPU smoke of the benchmark harness (the driver runs the real thing on TPU).
 set -euo pipefail
 cd "$(dirname "$0")/.."
-BENCH_FORCE_CPU=1 BENCH_N_ROWS=65536 BENCH_REPS=2 python bench.py
+BENCH_FORCE_CPU=1 BENCH_N_ROWS=65536 BENCH_REPS=2 python bench.py \
+  | tee /tmp/bench_smoke_q6.out
+# the q95 line must be self-explaining (per-stage note + engines) and its
+# vs_baseline must not regress below the recorded floor — a ratchet in the
+# same only-shrinks spirit as graftlint's baseline (ci/q95_floor.json)
+python ci/check_q95_line.py /tmp/bench_smoke_q6.out
 # spill scenario: device arena capped below q6's working set; the emitted
 # line carries spill-bytes counters so BENCH_*.json tracks spill overhead
 BENCH_FORCE_CPU=1 BENCH_SPILL_ROWS=65536 python bench.py --spill

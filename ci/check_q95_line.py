@@ -1,0 +1,66 @@
+"""CI gate for the q95 bench line (ci/bench_smoke.sh).
+
+Two checks, same only-shrinks spirit as graftlint's baseline:
+
+* the emitted ``q95_shape_throughput`` line must be SELF-EXPLAINING —
+  a ``note`` carrying the chosen engines and the per-stage millisecond
+  breakdown (VERDICT's done-bar for the residual CPU gap: every
+  BENCH_r*.json must defend where the time goes);
+* ``vs_baseline`` must not regress below the floor recorded in
+  ``ci/q95_floor.json``.  The floor only ratchets UP: when a change
+  legitimately speeds q95 up, raise it in the same PR so the next
+  regression is caught at the new level.
+"""
+import json
+import os
+import sys
+
+
+def main(path: str) -> int:
+    floor_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "q95_floor.json")
+    with open(floor_path) as f:
+        floor = json.load(f)["vs_baseline_floor"]
+    line = None
+    with open(path) as f:
+        for ln in f:
+            ln = ln.strip()
+            if not ln.startswith("{"):
+                continue
+            try:
+                obj = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            if obj.get("metric") == "q95_shape_throughput":
+                line = obj
+    if line is None:
+        print("check_q95_line: no q95_shape_throughput line in", path)
+        return 1
+    note = line.get("note")
+    errs = []
+    if not isinstance(note, dict) or "engines" not in note:
+        errs.append("note.engines missing: the capture no longer "
+                    "documents which engines ran")
+    stages = (note or {}).get("stages_ms")
+    if not isinstance(stages, dict) or not stages:
+        errs.append("note.stages_ms missing: the capture no longer "
+                    "carries the per-stage breakdown "
+                    f"(note={json.dumps(note)})")
+    vs = line.get("vs_baseline", 0.0)
+    if vs < floor:
+        errs.append(f"vs_baseline {vs} regressed below the recorded "
+                    f"floor {floor} (ci/q95_floor.json)")
+    if errs:
+        for e in errs:
+            print("check_q95_line:", e)
+        return 1
+    print(f"check_q95_line: OK (vs_baseline {vs} >= floor {floor}; "
+          f"engines {json.dumps((note or {}).get('engines'))})")
+    if vs >= 2 * floor and floor > 0:
+        print(f"check_q95_line: note — vs_baseline is >=2x the floor; "
+              f"consider ratcheting ci/q95_floor.json up to ~{vs * 0.7:.2f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1]))

@@ -107,15 +107,20 @@ _register("bench_rows_cpu", 1 << 20, int,
           "round-4 scatter engine runs 1M rows in ~35ms, so the refine "
           "step fits the budget comfortably).")
 _register("q6_group_path", "onehot", str,
-          "Aggregation path for the q6 flagship bench: 'onehot' (MXU "
-          "one-hot matmul, group_by_onehot with the bench's static key "
-          "domain) or 'sort' (sort-scan group_by, the general engine).")
+          "Aggregation path for the q6 flagship bench: 'onehot' "
+          "(group_by_onehot over the bench's static key domain, engine "
+          "picked by q6_onehot_engine) or 'sort' (the general "
+          "engine-selectable group_by — despite the legacy value name it "
+          "honors the groupby_engine knob, so on CPU it runs the "
+          "slot-table scatter engine, not a hard-wired sort).")
 _register("q6_onehot_engine", "auto", str,
           "Engine for the q6 domain-key aggregation: 'auto' (scatter on "
           "CPU, xla on accelerators — measured both ways round 4), 'xla' "
           "(materialized one-hot contraction), 'pallas' (fused VMEM "
-          "one-hot kernel), or 'scatter' (linear segment sums; fast on "
-          "CPU, 2 orders slow on TPU v5e).")
+          "one-hot kernel), or 'scatter' (DOMAIN segment sums — keys "
+          "index segments directly, no key normalization or slot table, "
+          "unlike the general groupby_engine='scatter'; fast on CPU, 2 "
+          "orders slow on TPU v5e).")
 _register("group_sort_payload", "gather", str,
           "How sort-scan group_by moves agg values into sorted order: "
           "'gather' (sort only [keys..., row-id], then one take() per agg "
@@ -124,6 +129,23 @@ _register("group_sort_payload", "gather", str,
           "emulated-64-bit multi-operand sort measured ~1s/iter at 256K "
           "rows on v5e (round 3), so 'gather' is the default; 'ride' is "
           "kept for A/B.")
+_register("groupby_engine", "auto", str,
+          "General group_by engine (relational/aggregate.py): 'sort' "
+          "(one stable multi-operand lax.sort + segmented scans — the "
+          "accelerator engine), 'scatter' (open-addressing slot table + "
+          "segment_* reductions, no row-sized sort — the CPU engine; "
+          "falls back to sort via lax.cond when the slot table "
+          "overflows), or 'auto' (scatter on CPU, sort on accelerators "
+          "— XLA-CPU's lax.sort is its slowest primitive and its "
+          "scatters the fastest; on TPU v5e the inversion holds, "
+          "scatters at 16-150ms per 2M rows).")
+_register("join_engine", "auto", str,
+          "hash_join probe engine (relational/join.py): 'sort' "
+          "(sorted build side + fused binary-search equal_range probe), "
+          "'hash' (open-addressing slot table build + linear-probe "
+          "walk; bit-identical output, no build-side lax.sort), or "
+          "'auto' (hash on CPU, sort on accelerators — same hardware "
+          "facts as groupby_engine).")
 _register("q6_float_mode", "f32x3", str,
           "Float-sum mode for the q6 onehot path: 'f32x3' (exact Dekker "
           "split, MXU-native, order-nondeterministic rounding) or 'f64' "

@@ -47,7 +47,8 @@ _COUNTING_MAX_SLOTS = 64
 _COUNTING_MAX_CELLS = 1 << 25
 
 
-def regroup_order(pid, num_slots: int, engine: str = "auto"):
+def regroup_order(pid, num_slots: int, engine: str = "auto",
+                  secondary=None):
     """Stable permutation that orders rows by partition id — the local
     leg every shuffle pays before its all-to-all.
 
@@ -66,16 +67,31 @@ def regroup_order(pid, num_slots: int, engine: str = "auto"):
       rows, 1-core CPU): exchange leg 17.7 ms -> counting sort ~2 ms.
     * ``'auto'`` — scatter on CPU when the one-hot stays small (few
       slots AND bounded n*num_slots cells), sort otherwise.
+
+    ``secondary`` (optional): extra uint32 sort operands ordered AFTER
+    ``pid`` — an exchange whose regroup also orders rows by their
+    aggregation key words, so a downstream sort-engine ``group_by`` can
+    run ``assume_grouped=True`` instead of re-sorting rows it just
+    received in key order (Spark's exchange-before-HashAggregate shape,
+    fused into ONE row-sized sort).  Secondary operands force the sort
+    engine: a counting sort has no within-slot key order.
     """
     import jax
 
     n = pid.shape[0]
     pid = pid.astype(jnp.int32)
+    if secondary is not None:
+        engine = "sort"
     if engine == "auto":
         engine = ("scatter" if jax.default_backend() == "cpu"
                   and num_slots <= _COUNTING_MAX_SLOTS
                   and n * num_slots <= _COUNTING_MAX_CELLS else "sort")
     if engine == "sort":
+        if secondary is not None:
+            ops = (pid,) + tuple(secondary) + (
+                jnp.arange(n, dtype=jnp.int32),)
+            return jax.lax.sort(ops, num_keys=len(ops) - 1,
+                                is_stable=True)[-1]
         return jnp.argsort(pid, stable=True).astype(jnp.int32)
     if engine != "scatter":
         raise ValueError(f"unknown regroup engine {engine!r}")

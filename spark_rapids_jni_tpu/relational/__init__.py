@@ -11,10 +11,15 @@ compilation model:
 * **Static shapes everywhere**: filters/joins return padded outputs plus a
   device row count instead of dynamically-shaped arrays, so everything stays
   inside one ``jit`` region.
-* **Sort-based grouping/joining**: ``lax.sort`` + segmented reductions and
-  binary-search probes, instead of a pointer-chasing hash table — the MXU/VPU
-  have no efficient scatter-chase, but bitonic sort and vectorized gathers
-  pipeline well.
+* **Engine-selectable grouping/joining**: each hot path ships a SORT
+  engine (``lax.sort`` + segmented scans / binary-search probes — bitonic
+  sort and vectorized gathers pipeline well on the MXU/VPU, which have no
+  efficient scatter-chase) and a SCATTER/HASH engine (vectorized
+  open-addressing slot table + ``segment_*`` reductions, :mod:`hashtable`
+  — XLA-CPU's sort is its slowest primitive and its scatters the
+  fastest).  The ``groupby_engine``/``join_engine`` knobs (default
+  ``auto``: scatter/hash on CPU, sort on accelerators) pick per platform;
+  outputs are bit-identical either way.
 """
 
 from .filter import apply_mask, compact

@@ -1,17 +1,28 @@
-"""Sort-scan group-by aggregation (Spark hash-aggregate semantics).
+"""Engine-selectable group-by aggregation (Spark hash-aggregate semantics).
 
-Three designs were measured on the real chip this round:
+Two general-key engines live here, selected by the ``groupby_engine``
+config knob (``auto | sort | scatter``) or the ``engine=`` argument:
 
-* radix-sort + argsort + segment ops (round 1): 3.2 Mrows/s — the two
-  sorts and the scatter-backed ``segment_*`` ops each cost 95-630ms at 2M
-  rows on this TPU;
-* scatter-min bucket election + segment ops: no better — XLA scatters are
-  the single slowest primitive on this chip (~150ms per 2M-row scatter);
-* THIS design: **one multi-operand sort, then only scans and gathers** —
-  no scatter anywhere, and agg values ride the sort as extra payload
-  operands so no full-width random gather is needed afterwards either.
+* **sort** — one multi-operand ``lax.sort``, then only scans and
+  gathers.  Three designs were measured on the real chip in round 1:
+  radix-sort + argsort + segment ops hit 3.2 Mrows/s (each sort/scatter
+  95-630ms at 2M rows on this TPU); scatter-min bucket election was no
+  better (XLA scatters are the slowest primitive on that chip, ~150ms
+  per 2M-row scatter); the surviving design has **no scatter anywhere**
+  and optionally lets agg values ride the sort as payload operands.
+* **scatter** — no sort anywhere: rows map to key groups through the
+  open-addressing slot table (:mod:`hashtable`), every aggregate is one
+  ``segment_*`` pass, and only the small ``num_slots``-sized table is
+  sorted to emit groups in the same key order as the sort engine.  The
+  inversion is again a hardware fact: on XLA-CPU ``lax.sort`` is the
+  worst primitive and scatters the best (round-4 A/B: segment_sum 80x
+  faster than the one-hot matmul), so ``auto`` resolves to scatter on
+  CPU and sort on accelerators.  If the slot table overflows (more
+  distinct keys than slots) the jitted program falls back to the sort
+  engine via ``lax.cond`` — both engines trace, the data picks one.
 
-Pipeline: lower keys to uint32 radix words (:mod:`keys`, equality domain)
+Sort-engine pipeline: lower keys to uint32 radix words (:mod:`keys`,
+equality domain)
 -> one ``lax.sort`` carrying [keys..., row-id] (agg values are gathered
 along the permutation afterwards by default; config
 ``group_sort_payload='ride'`` makes them ride the sort as extra payload
@@ -176,23 +187,69 @@ def _decimal_avg(s256, cnt, in_dtype):
             T.SparkType.decimal(p_res, s_res))
 
 
+def _resolve_groupby_engine(engine):
+    """``engine=None`` reads the ``groupby_engine`` knob; ``auto`` is a
+    platform call (scatter on CPU, sort on accelerators — see the module
+    docstring for the measurements behind it)."""
+    from .. import config as _config
+
+    if engine is None:
+        engine = _config.get("groupby_engine")
+    if engine == "auto":
+        return "scatter" if jax.default_backend() == "cpu" else "sort"
+    if engine not in ("sort", "scatter"):
+        raise ValueError(f"unknown groupby engine {engine!r} "
+                         "(use 'auto', 'sort', or 'scatter')")
+    return engine
+
+
 def group_by(
     batch: ColumnBatch,
     key_names: Sequence[str],
     aggs: Sequence[AggSpec],
     row_valid=None,
+    *,
+    engine=None,
+    num_slots=None,
+    assume_grouped: bool = False,
 ) -> tuple:
     """Group ``batch`` by ``key_names``; returns (result_batch, num_groups).
 
     The result batch has the key columns (group order = key sort order,
-    nulls first, deterministic) followed by one column per AggSpec, padded
-    to the input row count with null rows past ``num_groups``.
+    nulls first, deterministic — both engines emit the same order)
+    followed by one column per AggSpec, padded to the input row count
+    with null rows past ``num_groups``.
 
     ``row_valid`` (bool[n], optional) marks rows that exist: padding rows
     of an upstream filter/shuffle are excluded from every group.  They
     sort to the back as one trailing pseudo-run that the group count and
     end positions simply never reach.
+
+    ``engine``: ``'sort' | 'scatter' | 'auto'`` (default: the
+    ``groupby_engine`` knob).  The scatter engine's slot table holds
+    ``num_slots`` distinct keys (power of two, default 4096, clamped to
+    2n); data with more distinct keys falls back to the sort engine at
+    runtime inside the same jitted program, so the hint only costs
+    speed, never correctness.  Size it at ~2x the expected key
+    cardinality to keep probe chains short.
+
+    ``assume_grouped``: the caller guarantees rows with equal keys are
+    already adjacent and (when ``row_valid`` is given) dead rows form
+    one trailing run — e.g. the batch came out of an exchange whose sort
+    carried the group key as a secondary operand.  The main sort is
+    skipped entirely (the boundary scan runs on input order) and groups
+    are emitted in first-appearance instead of key order — Spark defines
+    no group order.  Implies the sort engine: with no sort left to skip,
+    the scatter engine has nothing to offer.
     """
+    if not assume_grouped and _resolve_groupby_engine(engine) == "scatter":
+        return _group_by_hash(batch, key_names, aggs, row_valid, num_slots)
+    return _group_by_sortscan(batch, key_names, aggs, row_valid,
+                              assume_grouped)
+
+
+def _group_by_sortscan(batch, key_names, aggs, row_valid, assume_grouped):
+    """The sort engine: one stable multi-operand sort, then scans."""
     n = batch.num_rows
     key_cols = [batch[k] for k in key_names]
     karr = K.batch_radix_keys(key_cols, equality=True, nulls_first=True)
@@ -240,11 +297,20 @@ def group_by(
             payload.extend([col.data, col.validity])
 
     nk = len(karr)
-    res = jax.lax.sort(tuple(karr) + tuple(payload), num_keys=nk,
-                       is_stable=True)
-    skeys = res[:nk]
-    sperm = res[nk]
-    spay = res[nk + 1:]
+    if assume_grouped:
+        # sort-order reuse: an upstream stage already laid equal keys out
+        # adjacently (dead rows in one trailing run), so the boundary
+        # scan below works on input order directly and the whole sort —
+        # the engine's dominant cost — disappears.
+        skeys = tuple(karr)
+        sperm = iota
+        spay = tuple(payload[1:])
+    else:
+        res = jax.lax.sort(tuple(karr) + tuple(payload), num_keys=nk,
+                           is_stable=True)
+        skeys = res[:nk]
+        sperm = res[nk]
+        spay = res[nk + 1:]
 
     boundary = ~K.rows_equal_adjacent(skeys)
     sorted_occ = (skeys[0] == 0) if have_rv else jnp.ones((n,), jnp.bool_)
@@ -398,6 +464,204 @@ def group_by(
             if was_bool:
                 r = r.astype(jnp.bool_)
             out[spec.out_name] = Column(r, out_valid & has_any, col_dtype)
+
+    return ColumnBatch(out), num_groups
+
+
+_DEFAULT_GROUP_SLOTS = 4096
+
+
+def _group_by_hash(batch, key_names, aggs, row_valid, num_slots):
+    """The scatter engine: slot-table key mapping + segment reductions.
+
+    Same contract, semantics, and group order as the sort engine — the
+    only rounding difference is float sums/means (scatter-add order vs
+    segmented-scan order; Spark itself is order-nondeterministic there).
+    Slot-table overflow falls back to the sort engine via ``lax.cond``.
+    """
+    from . import hashtable as H
+
+    n = batch.num_rows
+    key_cols = [batch[k] for k in key_names]
+    karr = K.batch_radix_keys(key_cols, equality=True, nulls_first=True)
+    row_live = jnp.ones((n,), jnp.bool_) if row_valid is None else \
+        row_valid.astype(jnp.bool_)
+    S = H.next_pow2(_DEFAULT_GROUP_SLOTS if num_slots is None
+                    else int(num_slots))
+    S = min(S, H.next_pow2(2 * n))
+    # a spuriously long probe chain only costs a fallback to the sort
+    # engine, so the round bound stays far below the table size
+    owner, slot, overflow = H.build_slot_table(
+        karr, row_live, S, max_rounds=min(S, 128))
+
+    def scat(_):
+        return _scatter_groups(batch, key_names, aggs, karr, row_live,
+                               owner, slot, S)
+
+    def srt(_):
+        return _group_by_sortscan(batch, key_names, aggs, row_valid, False)
+
+    return jax.lax.cond(overflow, srt, scat, None)
+
+
+def _scatter_groups(batch, key_names, aggs, karr, row_live, owner, slot, S):
+    """Segment-reduction group-by over a resolved slot table.
+
+    ``slot`` (int32[n], dead rows -> S) is the segment id; every
+    aggregate is one ``segment_*`` over ``S + 1`` segments (segment S
+    discards dead rows).  The S slots then sort by their owner's key
+    words — a table-sized sort, not a row-sized one — so groups come out
+    in exactly the sort engine's order (key order, nulls first), with
+    the same representative row per group (the slot owner is the
+    minimum row id of its key, which is also what the stable sort
+    exposes as the group's first row).
+    """
+    from jax.ops import segment_max, segment_min, segment_sum
+
+    n = batch.num_rows
+    iota = jnp.arange(n, dtype=jnp.int32)
+    dead_slot = owner == n
+    oc = jnp.clip(owner, 0, n - 1)
+
+    ops = [dead_slot.astype(jnp.uint32)] + [
+        jnp.where(dead_slot, jnp.zeros((), k.dtype), jnp.take(k, oc))
+        for k in karr] + [jnp.arange(S, dtype=jnp.int32)]
+    rank2slot = jax.lax.sort(tuple(ops), num_keys=len(ops) - 1,
+                             is_stable=True)[-1]
+    num_groups = (~dead_slot).sum(dtype=jnp.int32)
+    out_valid = iota < num_groups
+
+    def per_group(per_slot):
+        """[S+1] (or [S+1, ...]) segment result -> [n] in group-rank
+        order (pad with zeros when the table is smaller than the batch;
+        live groups always fit — there are at most n of them)."""
+        a = jnp.take(per_slot[:S], rank2slot, axis=0)
+        if a.shape[0] >= n:
+            return a[:n]
+        pad = jnp.zeros((n - a.shape[0],) + a.shape[1:], a.dtype)
+        return jnp.concatenate([a, pad], axis=0)
+
+    def seg_sum(vals):
+        return per_group(segment_sum(vals, slot, num_segments=S + 1))
+
+    rows0 = per_group(oc)
+    out = {}
+    for name in key_names:
+        out[name] = gather_column(batch[name], rows0, out_valid)
+
+    for spec in aggs:
+        if spec.column is not None and \
+                isinstance(batch[spec.column], StringColumn):
+            raise NotImplementedError(
+                f"{spec.op} over {batch[spec.column].dtype!r} groups "
+                "not implemented yet")
+        if spec.op == "count":
+            if spec.column is None:
+                ones = row_live.astype(jnp.int64)
+            else:
+                ones = (batch[spec.column].validity
+                        & row_live).astype(jnp.int64)
+            out[spec.out_name] = Column(seg_sum(ones), out_valid, T.INT64)
+            continue
+
+        col = batch[spec.column]
+        valid = col.validity & row_live
+        nn = seg_sum(valid.astype(jnp.int32))
+        has_any = nn > 0
+
+        if isinstance(col, Decimal128Column):
+            from ..ops import decimal as D
+
+            has_any_d = out_valid & has_any
+            if spec.op in ("min", "max"):
+                # signed-128 min/max in two passes: elect the extreme hi
+                # limb (signed), then the extreme unsigned lo limb among
+                # rows holding it.  Fills match the sort engine's and the
+                # segment identities (so empty/all-null groups agree).
+                if spec.op == "min":
+                    flo = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+                    fhi = jnp.uint64(0x7FFFFFFFFFFFFFFF)
+                    seg_mm = segment_min
+                else:
+                    flo = jnp.uint64(0)
+                    fhi = jnp.uint64(0x8000000000000000)
+                    seg_mm = segment_max
+                lo = jnp.where(valid, col.limbs[:, 0], flo)
+                hi_i = jax.lax.bitcast_convert_type(
+                    jnp.where(valid, col.limbs[:, 1], fhi), jnp.int64)
+                m_hi = seg_mm(hi_i, slot, num_segments=S + 1)
+                at_best = valid & (hi_i == jnp.take(m_hi, slot))
+                m_lo = seg_mm(jnp.where(at_best, lo, flo), slot,
+                              num_segments=S + 1)
+                out[spec.out_name] = Decimal128Column(
+                    jnp.stack([per_group(m_lo),
+                               jax.lax.bitcast_convert_type(
+                                   per_group(m_hi), jnp.uint64)], axis=1),
+                    has_any_d, col.dtype)
+                continue
+            # sum / mean: exact 256-bit sums, u32 lanes summed in u64
+            # (n <= 2^31 rows of < 2^32 stays under 2^63), carry-folded
+            # once — the same argument as _domain_partials_scatter
+            u = D._from_i128(jnp.where(valid[:, None], col.limbs,
+                                       jnp.zeros((), jnp.uint64)))
+            lanes = segment_sum(u.astype(jnp.uint64), slot,
+                                num_segments=S + 1)
+            s256 = per_group(_carry_fold_u64_lanes(lanes[:S]))
+            if spec.op == "mean":
+                limbs128, ok, out_t = _decimal_avg(s256, nn, col.dtype)
+                out[spec.out_name] = Decimal128Column(
+                    limbs128, has_any_d & ok, out_t)
+                continue
+            out_p = min(38, col.dtype.precision + 10)
+            mag, _ = D._abs(s256)
+            dovf = ~D._lt_u(mag, jnp.broadcast_to(D._pow10(out_p),
+                                                  mag.shape))
+            out[spec.out_name] = Decimal128Column(
+                D._to_i128(s256), has_any_d & ~dovf,
+                T.SparkType.decimal(out_p, col.dtype.scale))
+            continue
+
+        data = col.data
+        if spec.op in ("sum", "mean"):
+            out_t = T.FLOAT64 if spec.op == "mean" else _sum_dtype(col.dtype)
+            acc = data.astype(out_t.jnp_dtype if spec.op == "sum"
+                              else jnp.float64)
+            acc = jnp.where(valid, acc, jnp.zeros((), acc.dtype))
+            s = seg_sum(acc)
+            if spec.op == "mean":
+                s = s / jnp.maximum(nn, 1).astype(jnp.float64)
+            out[spec.out_name] = Column(s, out_valid & has_any, out_t)
+        else:  # min / max — same fills and NaN rules as the sort engine
+            is_float = jnp.issubdtype(data.dtype, jnp.floating)
+            was_bool = data.dtype == jnp.bool_
+            if is_float:
+                fill = jnp.array(jnp.inf if spec.op == "min" else -jnp.inf,
+                                 data.dtype)
+                nan_in = valid & jnp.isnan(data)
+                valid_num = valid & ~jnp.isnan(data)
+            elif was_bool:
+                data = data.astype(jnp.uint8)
+                fill = jnp.uint8(1 if spec.op == "min" else 0)
+                valid_num = valid
+            else:
+                info = jnp.iinfo(data.dtype)
+                fill = jnp.array(info.max if spec.op == "min" else info.min,
+                                 data.dtype)
+                valid_num = valid
+            masked = jnp.where(valid_num, data, fill)
+            seg_mm = segment_min if spec.op == "min" else segment_max
+            r = per_group(seg_mm(masked, slot, num_segments=S + 1))
+            if is_float:
+                seg_nan = seg_sum(nan_in.astype(jnp.int32)) > 0
+                seg_num = seg_sum(valid_num.astype(jnp.int32)) > 0
+                nan = jnp.array(jnp.nan, r.dtype)
+                if spec.op == "max":
+                    r = jnp.where(seg_nan, nan, r)
+                else:
+                    r = jnp.where(seg_nan & ~seg_num, nan, r)
+            if was_bool:
+                r = r.astype(jnp.bool_)
+            out[spec.out_name] = Column(r, out_valid & has_any, col.dtype)
 
     return ColumnBatch(out), num_groups
 
@@ -834,6 +1098,15 @@ def group_by_scatter(
     (small static integer key domain, null keys in bucket K, returns
     ``(result, num_groups, overflow)``), but each aggregate is ONE
     ``segment_sum`` pass over the rows instead of a one-hot contraction.
+
+    Distinct from the general ``engine="scatter"`` of :func:`group_by`
+    (r6 delete-or-measure verdict: NOT redundant, both stay): here the
+    keys ARE the segment ids — dense ints in a static domain — so there
+    is no key normalization, no slot-table build, no probe walk, and no
+    overflow fallback.  The general scatter engine pays all four to
+    handle arbitrary multi-column keys; at q6's shape the domain engine
+    stays measurably ahead (micro rows ``group_by_100keys_scatter`` vs
+    ``group_by_100keys_domain``).
 
     Engine choice is a hardware fact, not a preference: XLA scatters
     measured 16-150ms per 2M rows on TPU v5e (BASELINE.md) — two orders

@@ -1,0 +1,163 @@
+"""Vectorized open-addressing slot table over radix key words.
+
+The scatter/hash engines in :mod:`aggregate` and :mod:`join` need a
+"which distinct key is this row" primitive that does NOT sort.  This
+module provides it as two data-parallel loops over a static power-of-two
+slot table:
+
+* :func:`build_slot_table` — every row hashes its uint32 key words
+  (:func:`fold_hash`) and linear-probes for a slot.  Each round, still
+  unplaced rows propose themselves for their candidate slot and EMPTY
+  slots elect the minimum proposing row id (a plain scatter-min over the
+  whole table would let a later round's smaller row id steal a slot
+  another key already owns, silently merging two key groups — the
+  claim is therefore masked to empty slots only).  Rows whose candidate
+  slot's owner has equal key words retire; everyone else steps to the
+  next slot.  Equal keys share a hash, hence a probe sequence, hence a
+  slot: the table is a perfect row -> key-group map when the loop
+  drains.
+* :func:`probe_slot_table` — the read-only walk: a probe row follows
+  its chain until the owner's words match (hit) or an empty slot proves
+  the key absent (the linear-probing invariant: a key's chain never
+  crosses a slot that was empty at insert time).
+
+Everything is fixed-shape and jit-safe: the while loops are bounded by
+``max_rounds`` (insert reports ``overflow`` when rows remain unplaced,
+callers fall back to the sort engine under ``lax.cond``), and one round
+costs a handful of n-sized gathers/compares — with a table at most half
+full the expected round count is the expected probe-chain length, low
+single digits.
+
+Because the slot election picks the MINIMUM row id, a slot's owner is
+the first occurrence of its key in row order — the same representative
+row the stable sort-scan engine exposes, which is what lets the scatter
+group-by emit bit-identical key columns.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+# FNV-1a over uint32 words, then a lowbias32-style finalizer so every
+# key word influences the low bits that pick the slot.
+_FNV_OFFSET = np.uint32(2166136261)
+_FNV_PRIME = np.uint32(16777619)
+_MIX1 = np.uint32(0x7FEB352D)
+_MIX2 = np.uint32(0x846CA68B)
+
+
+def next_pow2(x: int) -> int:
+    """Smallest power of two >= max(x, 1)."""
+    return 1 << max(int(x) - 1, 0).bit_length() if x > 1 else 1
+
+
+def fold_hash(words):
+    """uint32[n] hash per row from a sequence of uint32[n] key words."""
+    h = jnp.full(words[0].shape, jnp.asarray(_FNV_OFFSET))
+    for w in words:
+        h = (h ^ w) * jnp.asarray(_FNV_PRIME)
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * jnp.asarray(_MIX1)
+    h = h ^ (h >> jnp.uint32(15))
+    h = h * jnp.asarray(_MIX2)
+    return h ^ (h >> jnp.uint32(16))
+
+
+def build_slot_table(words, live, num_slots: int, max_rounds=None):
+    """Insert rows keyed by ``words`` into an open-addressed slot table.
+
+    ``words``: uint32[n] arrays (radix key words, :mod:`keys`);
+    ``live``: bool[n], rows to place (dead rows never probe and never
+    own a slot); ``num_slots``: static power of two.
+
+    Returns ``(owner, slot, overflow)``:
+
+    * ``owner`` int32[num_slots] — row id owning each slot (the minimum
+      live row id of that slot's key group), ``n`` where empty;
+    * ``slot`` int32[n] — each live row's slot, ``num_slots`` for dead
+      or unplaced rows (usable directly as a segment id with
+      ``num_segments=num_slots + 1``);
+    * ``overflow`` bool[] — True when some live row failed to place
+      within ``max_rounds`` (more distinct keys than slots, or a probe
+      chain past the round bound); the table is then NOT a complete
+      key map and callers must fall back.
+    """
+    n = words[0].shape[0]
+    S = int(num_slots)
+    if S & (S - 1):
+        raise ValueError(f"num_slots must be a power of two, got {S}")
+    if max_rounds is None:
+        max_rounds = S
+    imask = jnp.int32(S - 1)
+    sentinel = jnp.int32(n)
+    rowid = jnp.arange(n, dtype=jnp.int32)
+    cand0 = (fold_hash(words) & jnp.uint32(S - 1)).astype(jnp.int32)
+
+    def cond(state):
+        rnd, _cand, _slot, active, _owner = state
+        return (rnd < max_rounds) & jnp.any(active)
+
+    def body(state):
+        rnd, cand, slot, active, owner = state
+        claim = jnp.where(active, rowid, sentinel)
+        prop = jnp.full((S,), sentinel, jnp.int32).at[cand].min(claim)
+        owner = jnp.where(owner == sentinel, prop, owner)
+        o = jnp.clip(jnp.take(owner, cand), 0, max(n - 1, 0))
+        match = active
+        for w in words:
+            match = match & (jnp.take(w, o) == w)
+        slot = jnp.where(match, cand, slot)
+        active = active & ~match
+        cand = (cand + 1) & imask
+        return rnd + 1, cand, slot, active, owner
+
+    state = (jnp.int32(0), cand0, jnp.full((n,), S, jnp.int32),
+             live.astype(jnp.bool_), jnp.full((S,), sentinel, jnp.int32))
+    _, _, slot, active, owner = jax.lax.while_loop(cond, body, state)
+    return owner, slot, jnp.any(active)
+
+
+def probe_slot_table(owner, build_words, probe_words, live):
+    """Look probe rows' keys up in a built slot table.
+
+    ``owner``: int32[S] from :func:`build_slot_table` (sentinel = number
+    of build rows); ``build_words``/``probe_words``: matching uint32
+    word sequences for the build and probe sides; ``live``: bool[m]
+    probe rows to look up.
+
+    Returns ``(found, slot)``: bool[m] and int32[m] (slot is ``S`` for
+    misses and dead rows).
+    """
+    S = owner.shape[0]
+    n = build_words[0].shape[0]
+    sentinel = jnp.int32(n)
+    imask = jnp.int32(S - 1)
+    cand0 = (fold_hash(probe_words) & jnp.uint32(S - 1)).astype(jnp.int32)
+    m = probe_words[0].shape[0]
+
+    def cond(state):
+        rnd, _cand, _slot, _found, active = state
+        return (rnd < S) & jnp.any(active)
+
+    def body(state):
+        rnd, cand, slot, found, active = state
+        o = jnp.take(owner, cand)
+        empty = o == sentinel
+        oc = jnp.clip(o, 0, max(n - 1, 0))
+        match = ~empty
+        for bw, pw in zip(build_words, probe_words):
+            match = match & (jnp.take(bw, oc) == pw)
+        hit = active & match
+        slot = jnp.where(hit, cand, slot)
+        found = found | hit
+        # an empty slot ends the chain: the key cannot live past it
+        active = active & ~match & ~empty
+        cand = (cand + 1) & imask
+        return rnd + 1, cand, slot, found, active
+
+    state = (jnp.int32(0), cand0, jnp.full((m,), S, jnp.int32),
+             jnp.zeros((m,), jnp.bool_), live.astype(jnp.bool_))
+    _, _, slot, found, _ = jax.lax.while_loop(cond, body, state)
+    return found, slot

@@ -1,10 +1,23 @@
-"""Joins: sorted-build + binary-search probe, static-shape outputs.
+"""Equality joins with static-shape outputs, engine-selectable probe.
 
-libcudf joins use a GPU hash table; on TPU pointer-chasing scatters serialize
-on the VPU, while sort + vectorized lexicographic binary search (log2(n)
-gather rounds, every probe row in flight at once) pipelines well and needs
-no dynamic shapes.  Matches expand via the classic offsets/searchsorted
-expansion, padded to a static ``capacity``.
+libcudf joins use a GPU hash table; here two engines share one output
+contract, picked by the ``join_engine`` knob (``auto | sort | hash``) or
+the ``engine=`` argument:
+
+* **sort** — sorted build side + fused lexicographic binary search
+  (:func:`keys.equal_range`): log2(n) gather rounds, every probe row in
+  flight at once, no scatter anywhere.  The accelerator engine — on TPU
+  pointer-chasing scatters serialize on the VPU.
+* **hash** — open-addressing slot table over the build side
+  (:mod:`hashtable`) + a linear-probe walk per probe row: expected O(1)
+  rounds against the sort engine's fixed ~log2(32n) bisection steps,
+  and no build-side ``lax.sort``.  The CPU engine — XLA-CPU's sort is
+  its slowest primitive.  Output is bit-identical to the sort engine
+  (matches enumerate in original right-row order under both; the build
+  groups rows by slot with ONE stable single-operand sort).
+
+Both expand matches via the classic offsets/searchsorted expansion,
+padded to a static ``capacity``.
 
 Spark semantics: SQL equality join keys — ``null`` matches nothing (inner
 drops null-keyed rows, left outer emits them with a null right side, left
@@ -32,6 +45,55 @@ from .filter import compact
 from .gather import gather_batch
 
 _HOWS = ("inner", "left", "right", "full", "semi", "anti")
+
+
+def _resolve_join_engine(engine):
+    """``engine=None`` reads the ``join_engine`` knob; ``auto`` is the
+    same platform call as ``groupby_engine`` (hash on CPU, sort on
+    accelerators)."""
+    from .. import config as _config
+
+    if engine is None:
+        engine = _config.get("join_engine")
+    if engine == "auto":
+        return "hash" if jax.default_backend() == "cpu" else "sort"
+    if engine not in ("sort", "hash"):
+        raise ValueError(f"unknown join engine {engine!r} "
+                         "(use 'auto', 'sort', or 'hash')")
+    return engine
+
+
+def _hash_build(rkeys, nr):
+    """Hash-engine build product over the build side's radix words.
+
+    Returns the flat tuple ``(owner, rslot, rperm, counts_slot,
+    off_slot, *rkeys)`` — the same shape :func:`hash_join` accepts as a
+    ``prebuilt`` when ``engine='hash'``:
+
+    * ``owner`` int32[S] — slot table (S = 2x the build rows rounded up
+      to a power of two: load factor <= 1/2, so insertion always
+      terminates and overflow is impossible);
+    * ``rslot`` int32[nr] — each build row's slot (== its key group);
+    * ``rperm`` int32[nr] — build rows grouped by slot, original order
+      within a slot (ONE stable single-operand sort; within one key
+      group this is exactly the order the sort engine's stable key sort
+      yields, which is what makes the engines bit-identical);
+    * ``counts_slot`` int32[S+1] / ``off_slot`` int32[S+1] — per-slot
+      row counts and exclusive offsets into ``rperm``.
+    """
+    from . import hashtable as H
+
+    S = H.next_pow2(2 * nr)
+    iota_r = jnp.arange(nr, dtype=jnp.int32)
+    owner, rslot, _ = H.build_slot_table(
+        rkeys, jnp.ones((nr,), jnp.bool_), S)
+    counts_slot = jax.ops.segment_sum(
+        jnp.ones((nr,), jnp.int32), rslot, num_segments=S + 1)
+    off_slot = jnp.cumsum(counts_slot) - counts_slot
+    rperm = jax.lax.sort((rslot, iota_r), num_keys=1, is_stable=True)[-1]
+    return (owner, rslot, rperm,
+            counts_slot.astype(jnp.int32), off_slot.astype(jnp.int32)) \
+        + tuple(rkeys)
 
 
 def _one_null_row_like(batch: ColumnBatch) -> ColumnBatch:
@@ -72,13 +134,16 @@ def hash_join(
     left_valid=None,
     right_valid=None,
     prebuilt=None,
+    engine=None,
 ) -> tuple:
     """Equality join; returns ``(result_batch, count)``.
 
-    ``capacity`` is the static output row budget for inner/left joins
-    (default: ``left.num_rows``, exact whenever the build side is unique,
-    e.g. joining a fact table to a key-unique dimension).  ``count`` is the
-    true match total; ``count > capacity`` signals truncation and callers
+    ``capacity`` is the static output row budget for the inner/left-join
+    region; when omitted it defaults to ``left.num_rows``, which is
+    exact whenever the build side is key-unique (fact-to-dimension) and
+    a best-effort budget otherwise (full joins always append up to
+    ``right.num_rows`` more rows on top of it).  ``count`` is the true
+    match total; ``count > capacity`` signals truncation and callers
     re-run with a bigger budget — the TPU analogue of the reference's
     split-and-retry contract on output-size overflow.
 
@@ -89,10 +154,17 @@ def hash_join(
     dead left rows produce no output (not even for left/anti joins, where
     Spark WOULD keep a live null-keyed row).
 
-    ``prebuilt`` skips the build-side sort: either the raw
-    ``(*sorted_rkeys, rperm)`` tuple or a :class:`SpillableBuildTable`
-    from :func:`spillable_build_table` (pinned for the duration, fetched
-    through the retry ladder).  It MUST have been built from the same
+    ``engine``: ``'sort' | 'hash' | 'auto'`` (default: the
+    ``join_engine`` knob).  Both engines produce bit-identical live
+    rows; see the module docstring for when each wins.
+
+    ``prebuilt`` skips the build: either a raw build product tuple —
+    ``(*sorted_rkeys, rperm)`` for the sort engine, :func:`_hash_build`'s
+    tuple for the hash engine; it must match the engine this call
+    resolves to — or a :class:`SpillableBuildTable` from
+    :func:`spillable_build_table` (pinned for the duration, fetched
+    through the retry ladder, probed under whichever engine it was
+    (re)built with).  It MUST have been built from the same
     ``right``/``right_on``/``right_valid`` — nothing re-validates that.
     """
     if how not in _HOWS:
@@ -110,19 +182,24 @@ def hash_join(
         return hash_join(right, left, right_on, left_on, "left",
                          capacity=capacity, suffixes=(suffixes[1],
                                                       suffixes[0]),
-                         left_valid=right_valid, right_valid=left_valid)
+                         left_valid=right_valid, right_valid=left_valid,
+                         engine=engine)
     if prebuilt is not None and hasattr(prebuilt, "get"):
         from ..mem.executor import run_with_retry
 
         # hold the pin across the recursive call so an evictor cannot
         # drop the table (releasing its charge) while the probe is in
-        # flight; get() re-runs the build if it was already dropped
+        # flight; get() re-runs the build if it was already dropped —
+        # under whatever engine the join_engine knob selects at THAT
+        # moment, which is why the probe takes the engine from the
+        # handle rather than from this call's arguments
         with prebuilt.pinned():
             built = run_with_retry(prebuilt.get)
             return hash_join(left, right, left_on, right_on, how,
                              capacity=capacity, suffixes=suffixes,
                              left_valid=left_valid, right_valid=right_valid,
-                             prebuilt=tuple(built))
+                             prebuilt=tuple(built),
+                             engine=getattr(prebuilt, "engine", "sort"))
 
     nl, nr = left.num_rows, right.num_rows
     padded_right = nr == 0
@@ -135,6 +212,15 @@ def hash_join(
         # -> all left rows)
         right = _one_null_row_like(right)
         nr = 1
+    if nl == 0:
+        # empty probe side (e.g. how='right' over an empty right input):
+        # one DEAD pad row keeps every downstream gather in-bounds while
+        # producing no output — count semantics of an empty probe are 0
+        # rows for every join type except full, which still appends the
+        # unmatched right rows
+        left = _one_null_row_like(left)
+        nl = 1
+        left_valid = jnp.zeros((1,), jnp.bool_)
     lcols, rcols = K.align_string_key_columns(
         [left[k] for k in left_on], [right[k] for k in right_on]
     )
@@ -144,29 +230,54 @@ def hash_join(
         rcols = [_dc.replace(c, validity=c.validity & right_valid)
                  for c in rcols]
 
-    # build: sort right by (null-flag, radix keys); nulls sort last and can
-    # never equal a valid probe (flag mismatch)
-    rkeys = None
-    if prebuilt is not None:
-        sorted_rkeys, rperm = tuple(prebuilt[:-1]), prebuilt[-1]
-    else:
-        rkeys = K.batch_radix_keys(rcols, equality=True, nulls_first=False)
-        iota_r = jnp.arange(nr, dtype=jnp.int32)
-        sorted_ops = jax.lax.sort(
-            tuple(rkeys) + (iota_r,), num_keys=len(rkeys), is_stable=True
-        )
-        sorted_rkeys, rperm = sorted_ops[:-1], sorted_ops[-1]
-
+    engine = _resolve_join_engine(engine)
     lkeys = K.batch_radix_keys(lcols, equality=True, nulls_first=False)
-    lo, hi = K.equal_range(sorted_rkeys, lkeys)
-
     l_null = jnp.zeros((nl,), jnp.bool_)
     for c in lcols:
         l_null = l_null | ~c.validity
-    counts = jnp.where(l_null, 0, hi - lo).astype(jnp.int32)
     l_live = (jnp.ones((nl,), jnp.bool_) if left_valid is None
               else left_valid.astype(jnp.bool_))
-    counts = jnp.where(l_live, counts, 0)
+
+    # build + probe.  Null build keys can never match: under the sort
+    # engine they sort last and their flag word mismatches every valid
+    # probe; under the hash engine they sit in their own slot that no
+    # valid probe's words equal.  Null/dead probe rows are masked either
+    # way.  Both engines yield the same (counts, lo, rperm) semantics:
+    # a probe row's matches are rperm[lo .. lo+counts), enumerated in
+    # original right-row order.
+    rkeys = None
+    if engine == "hash":
+        from . import hashtable as H
+
+        if prebuilt is not None:
+            owner, rslot, rperm = prebuilt[0], prebuilt[1], prebuilt[2]
+            counts_slot, off_slot = prebuilt[3], prebuilt[4]
+            rkeys = tuple(prebuilt[5:])
+        else:
+            rkeys = K.batch_radix_keys(rcols, equality=True,
+                                       nulls_first=False)
+            built = _hash_build(rkeys, nr)
+            owner, rslot, rperm, counts_slot, off_slot = built[:5]
+        probe_live = ~l_null & l_live
+        found, lslot = H.probe_slot_table(owner, rkeys, lkeys, probe_live)
+        counts = jnp.where(found, jnp.take(counts_slot, lslot),
+                           jnp.int32(0))
+        lo = jnp.take(off_slot, lslot)
+    else:
+        if prebuilt is not None:
+            sorted_rkeys, rperm = tuple(prebuilt[:-1]), prebuilt[-1]
+        else:
+            rkeys = K.batch_radix_keys(rcols, equality=True,
+                                       nulls_first=False)
+            iota_r = jnp.arange(nr, dtype=jnp.int32)
+            sorted_ops = jax.lax.sort(
+                tuple(rkeys) + (iota_r,), num_keys=len(rkeys),
+                is_stable=True
+            )
+            sorted_rkeys, rperm = sorted_ops[:-1], sorted_ops[-1]
+        lo, hi = K.equal_range(sorted_rkeys, lkeys)
+        counts = jnp.where(l_null, 0, hi - lo).astype(jnp.int32)
+        counts = jnp.where(l_live, counts, 0)
 
     if how == "semi":
         return compact(left, (counts > 0) & l_live)
@@ -205,33 +316,43 @@ def hash_join(
     )
 
     if how == "full":
-        # unmatched right rows: probe the LEFT keys with the right keys.
-        # Dead (shuffle-padding) left rows must not count as matches:
-        # re-key them as nulls, which sort last and match nothing.
-        if left_valid is not None:
-            import dataclasses as _dc
-
-            lcols_live = [_dc.replace(c, validity=c.validity & l_live)
-                          for c in lcols]
-            lkeys = K.batch_radix_keys(lcols_live, equality=True,
-                                       nulls_first=False)
-        lkeys_sorted_ops = jax.lax.sort(
-            tuple(lkeys) + (jnp.arange(nl, dtype=jnp.int32),),
-            num_keys=len(lkeys), is_stable=True)
-        sorted_lkeys = lkeys_sorted_ops[:-1]
-        if rkeys is None:
-            # prebuilt path carries only the SORTED keys; the reverse
-            # probe needs them in right-row order
-            rkeys = K.batch_radix_keys(rcols, equality=True,
-                                       nulls_first=False)
-        rlo, rhi = K.equal_range(sorted_lkeys, rkeys)
-        r_null = jnp.zeros((nr,), jnp.bool_)
-        for c in rcols:
-            r_null = r_null | ~c.validity
         r_live = (jnp.ones((nr,), jnp.bool_) if right_valid is None
                   else right_valid.astype(jnp.bool_))
-        rcounts = jnp.where(r_null | ~r_live, 0, rhi - rlo)
-        unmatched = (rcounts == 0) & r_live
+        if engine == "hash":
+            # a right row is matched iff some live non-null probe row
+            # FOUND its slot: scatter-OR the probe hits over the slot
+            # table, then read each build row's slot back.  (Misses and
+            # dead probes carry lslot == S, the absorbing extra slot.)
+            S = owner.shape[0]
+            hit = jnp.zeros((S + 1,), jnp.bool_).at[lslot].max(found)
+            unmatched = ~jnp.take(hit, rslot) & r_live
+        else:
+            # unmatched right rows: probe the LEFT keys with the right
+            # keys.  Dead (shuffle-padding) left rows must not count as
+            # matches: re-key them as nulls, which sort last and match
+            # nothing.
+            if left_valid is not None:
+                import dataclasses as _dc
+
+                lcols_live = [_dc.replace(c, validity=c.validity & l_live)
+                              for c in lcols]
+                lkeys = K.batch_radix_keys(lcols_live, equality=True,
+                                           nulls_first=False)
+            lkeys_sorted_ops = jax.lax.sort(
+                tuple(lkeys) + (jnp.arange(nl, dtype=jnp.int32),),
+                num_keys=len(lkeys), is_stable=True)
+            sorted_lkeys = lkeys_sorted_ops[:-1]
+            if rkeys is None:
+                # prebuilt path carries only the SORTED keys; the reverse
+                # probe needs them in right-row order
+                rkeys = K.batch_radix_keys(rcols, equality=True,
+                                           nulls_first=False)
+            rlo, rhi = K.equal_range(sorted_lkeys, rkeys)
+            r_null = jnp.zeros((nr,), jnp.bool_)
+            for c in rcols:
+                r_null = r_null | ~c.validity
+            rcounts = jnp.where(r_null | ~r_live, 0, rhi - rlo)
+            unmatched = (rcounts == 0) & r_live
         if padded_right:
             # the synthetic 1-row pad (empty build side) is not a real
             # right row; it must not be appended
@@ -408,7 +529,7 @@ def _concat_batches(a: ColumnBatch, b: ColumnBatch) -> ColumnBatch:
 def spillable_build_table(right: ColumnBatch, right_on: Sequence[str],
                           right_valid=None, ctx=None,
                           name: Optional[str] = None):
-    """Register a join build table (the sorted radix keys + permutation of
+    """Register a join build table (the build product over
     ``right[right_on]``) in the spill framework as a
     :class:`SpillableBuildTable`.
 
@@ -416,8 +537,15 @@ def spillable_build_table(right: ColumnBatch, right_on: Sequence[str],
     other buffer; here the build product is *derived* state — the source
     columns stay with the caller — so eviction just DROPS it (releasing
     the device charge with no host copy) and ``get()`` re-runs the
-    compiled sort.  Recompute-over-copy is the right trade for a product
+    compiled build.  Recompute-over-copy is the right trade for a product
     the probe can deterministically regenerate.
+
+    The build product's SHAPE follows the active ``join_engine`` knob
+    (sorted keys + permutation for the sort engine, :func:`_hash_build`'s
+    slot-table tuple for the hash engine), re-read at every rebuild: a
+    table built under one engine and evicted rebuilds under whatever
+    engine is active THEN, and the handle's ``engine`` attribute tells
+    ``hash_join(prebuilt=...)`` how to probe what it got.
 
     Pass the result as ``hash_join(..., prebuilt=table)`` to reuse one
     build across many probe batches.  Close it when done.
@@ -442,9 +570,12 @@ def spillable_build_table(right: ColumnBatch, right_on: Sequence[str],
     nr = right.num_rows
 
     def builder():
+        eng = _resolve_join_engine(None)  # the knob, at (re)build time
         rkeys = K.batch_radix_keys(rcols, equality=True, nulls_first=False)
+        if eng == "hash":
+            return eng, _hash_build(rkeys, nr)
         iota_r = jnp.arange(nr, dtype=jnp.int32)
-        return tuple(jax.lax.sort(
+        return eng, tuple(jax.lax.sort(
             tuple(rkeys) + (iota_r,), num_keys=len(rkeys), is_stable=True))
 
     return SpillableBuildTable(builder, ctx=ctx, name=name)
@@ -457,16 +588,25 @@ class SpillableBuildTable(_SpillableHandle):
     """A :class:`~spark_rapids_jni_tpu.mem.spill.SpillableHandle` whose
     payload is recomputed rather than copied: ``spill()`` drops the device
     tree and releases the charge (no host/disk tiers), ``get()``
-    re-charges and re-runs the stored builder."""
+    re-charges and re-runs the stored builder.
+
+    ``builder`` returns ``(engine, tree)``; the engine tag of the most
+    recent (re)build is exposed as ``self.engine`` so the probe side
+    interprets the tree correctly even when the ``join_engine`` knob
+    changed between eviction and read-back."""
 
     def __init__(self, builder, ctx=None, name: Optional[str] = None):
         self._builder = builder
-        super().__init__(builder(), ctx=ctx,
+        super().__init__(self._build(), ctx=ctx,
                          name=name or f"build-table-{id(self):x}")
         from ..mem.executor import batch_nbytes
 
         self._build_nbytes = batch_nbytes(self._tree)
         self.rebuilds = 0
+
+    def _build(self):
+        self.engine, tree = self._builder()
+        return tree
 
     @property
     def tier(self) -> str:
@@ -509,7 +649,7 @@ class SpillableBuildTable(_SpillableHandle):
                 # retried get() simply re-enters here
                 self._device_charged = self._ctx.charge(self._build_nbytes)
             try:
-                self._tree = self._builder()
+                self._tree = self._build()
             except BaseException:
                 if self._ctx is not None and self._device_charged:
                     self._ctx.release(self._device_charged)

@@ -1,0 +1,404 @@
+"""Engine parity: the scatter/hash engines must match the sort engines
+(README "Engine playbook" invariants).
+
+Both group-by engines emit groups in the same deterministic order (key
+sort order, nulls first) and both join engines enumerate matches in the
+same order (build-side original order within a key group), so outputs
+are compared positionally over the live prefix:
+
+* exact / bit-identical: key columns, counts, int sums, min/max picks,
+  decimals, validity;
+* ``allclose``: float sum/mean (the engines reduce in different orders);
+* float min/max: +-0.0 compare EQUAL (both are valid Spark answers for
+  the same group — the engines may pick either zero);
+* padding-region DATA past the live count may differ — only validity
+  there is contractual.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu import config
+from spark_rapids_jni_tpu.columnar import types as T
+from spark_rapids_jni_tpu.columnar.column import (
+    Column, ColumnBatch, Decimal128Column)
+from spark_rapids_jni_tpu.relational import (
+    AggSpec, group_by, hash_join, spillable_build_table)
+from spark_rapids_jni_tpu.relational import keys as K
+from spark_rapids_jni_tpu.relational.join import _hash_build
+
+
+@pytest.fixture(autouse=True)
+def _reset_config():
+    yield
+    config.reset()
+
+
+def col_i32(vals, valid=None):
+    vals = np.asarray(vals, np.int32)
+    v = np.ones(len(vals), bool) if valid is None else np.asarray(valid, bool)
+    return Column(jnp.asarray(vals), jnp.asarray(v), T.INT32)
+
+
+def col_f64(vals, valid=None):
+    vals = np.asarray(vals, np.float64)
+    v = np.ones(len(vals), bool) if valid is None else np.asarray(valid, bool)
+    return Column(jnp.asarray(vals), jnp.asarray(v), T.FLOAT64)
+
+
+def assert_columns_match(name, ca, cb, live, *, float_exact=True):
+    va, vb = np.asarray(ca.validity), np.asarray(cb.validity)
+    da, db = np.asarray(ca.data), np.asarray(cb.data)
+    assert np.array_equal(va & live, vb & live), f"{name}: validity"
+    m = va & live
+    if da.dtype.kind == "f":
+        a, b = da[m], db[m]
+        if float_exact:
+            # +-0.0 equal, NaN == NaN, otherwise bitwise-equal values
+            ok = (a == b) | (np.isnan(a) & np.isnan(b))
+            assert ok.all(), f"{name}: float data"
+        else:
+            ok = np.isclose(a, b, rtol=1e-12, atol=0) | (
+                np.isnan(a) & np.isnan(b))
+            assert ok.all(), f"{name}: float data (allclose)"
+    else:
+        assert np.array_equal(da[m], db[m]), f"{name}: data"
+
+
+def assert_batches_match(name, a, b, count_a, count_b, approx=()):
+    ca, cb = int(count_a), int(count_b)
+    assert ca == cb, f"{name}: count {ca} != {cb}"
+    assert a.names == b.names, f"{name}: columns {a.names} vs {b.names}"
+    n = len(np.asarray(a[a.names[0]].validity))
+    live = np.arange(n) < ca
+    for col in a.names:
+        assert_columns_match(f"{name}/{col}", a[col], b[col], live,
+                             float_exact=col not in approx)
+
+
+# ---------------------------------------------------------------------------
+# join: hash-probe engine vs sorted-build binary-search engine
+# ---------------------------------------------------------------------------
+
+HOWS = ("inner", "left", "right", "full", "semi", "anti")
+SKEWS = ("uniform", "80one", "allone")
+
+
+def make_sides(nl, nr, skew, seed=42, nullfrac=0.1):
+    rng = np.random.default_rng(seed)
+    if skew == "uniform":
+        lk = rng.integers(0, nr, nl)
+        rk = rng.permutation(nr)
+    elif skew == "80one":
+        lk = np.where(rng.random(nl) < 0.8, 7, rng.integers(0, nr, nl))
+        rk = rng.permutation(nr)
+    else:  # allone: every probe row hits the same hot build key group
+        lk = np.full(nl, 3)
+        rk = np.concatenate([[3] * (nr // 2),
+                             rng.integers(100, 200, nr - nr // 2)])
+    lv = rng.random(nl) > nullfrac
+    rv = rng.random(nr) > nullfrac
+    # float key column exercising -0.0 == 0.0 and NaN == NaN key semantics
+    lf = rng.choice([1.5, -0.0, 0.0, np.nan, 2.5], nl)
+    rf = rng.choice([1.5, -0.0, 0.0, np.nan, 2.5], nr)
+    left = ColumnBatch({"k": col_i32(lk, lv), "kf": col_f64(lf),
+                        "lpay": col_i32(rng.integers(0, 1000, nl))})
+    right = ColumnBatch({"k": col_i32(rk, rv), "kf": col_f64(rf),
+                         "rpay": col_i32(rng.integers(0, 1000, nr))})
+    return left, right
+
+
+def both_engines(left, right, lk, rk, how, cap, **kw):
+    rs, cs = hash_join(left, right, lk, rk, how, capacity=cap,
+                       engine="sort", **kw)
+    rh, ch = hash_join(left, right, lk, rk, how, capacity=cap,
+                       engine="hash", **kw)
+    return rs, cs, rh, ch
+
+
+class TestJoinEngineParity:
+    @pytest.mark.parametrize("skew", SKEWS)
+    def test_all_hows_one_and_two_keys(self, skew):
+        left, right = make_sides(120, 48, skew)
+        for how in HOWS:
+            for keys in (["k"], ["k", "kf"]):
+                rs, cs, rh, ch = both_engines(left, right, keys, keys,
+                                              how, 6000)
+                assert_batches_match(f"{skew}/{how}/{keys}", rs, rh, cs, ch)
+
+    def test_validity_masks(self):
+        rng = np.random.default_rng(7)
+        left, right = make_sides(100, 40, "uniform", seed=7)
+        lval = jnp.asarray(rng.random(100) > 0.2)
+        rval = jnp.asarray(rng.random(40) > 0.2)
+        for how in HOWS:
+            rs, cs, rh, ch = both_engines(left, right, ["k"], ["k"], how,
+                                          3000, left_valid=lval,
+                                          right_valid=rval)
+            assert_batches_match(f"valid/{how}", rs, rh, cs, ch)
+
+    def test_empty_build_and_probe_sides(self):
+        # empty right: the build side is padded with one dead null row;
+        # under how='right' the swap makes it the PROBE side, exercising
+        # the empty-probe pad in both engines
+        left, _ = make_sides(50, 8, "uniform")
+        empty = ColumnBatch({"k": col_i32([]), "kf": col_f64([]),
+                             "rpay": col_i32([])})
+        for how in HOWS:
+            rs, cs, rh, ch = both_engines(left, empty, ["k"], ["k"], how, 60)
+            assert_batches_match(f"empty/{how}", rs, rh, cs, ch)
+
+    def test_prebuilt_raw_tuples(self):
+        left, right = make_sides(100, 32, "uniform", seed=3)
+        rkeys = K.batch_radix_keys([right["k"]], equality=True,
+                                   nulls_first=False)
+        nr = right.num_rows
+        pre_sort = tuple(jax.lax.sort(
+            tuple(rkeys) + (jnp.arange(nr, dtype=jnp.int32),),
+            num_keys=len(rkeys), is_stable=True))
+        pre_hash = _hash_build(rkeys, nr)
+        for how in ("inner", "left", "full", "semi", "anti"):
+            rs, cs = hash_join(left, right, ["k"], ["k"], how,
+                               capacity=2000, prebuilt=pre_sort,
+                               engine="sort")
+            rh, ch = hash_join(left, right, ["k"], ["k"], how,
+                               capacity=2000, prebuilt=pre_hash,
+                               engine="hash")
+            assert_batches_match(f"prebuilt/{how}", rs, rh, cs, ch)
+
+    def test_truncation_count_parity(self):
+        # count reports the TRUE match count past capacity on both engines
+        left, right = make_sides(100, 32, "allone", seed=5)
+        _, cs, _, ch = both_engines(left, right, ["k"], ["k"], "inner", 16)
+        assert int(cs) == int(ch) and int(cs) > 16
+
+    def test_hash_engine_single_trace_under_jit(self):
+        traces = {"n": 0}
+
+        @jax.jit
+        def jj(lb, rb):
+            traces["n"] += 1
+            return hash_join(lb, rb, ["k"], ["k"], "full", capacity=4000,
+                             engine="hash")
+
+        left, right = make_sides(120, 48, "uniform", seed=11)
+        jj(left, right)
+        left2, right2 = make_sides(120, 48, "80one", seed=12)
+        r2, c2 = jj(left2, right2)
+        assert traces["n"] == 1, "hash engine retraced on same shapes"
+        rs, cs = hash_join(left2, right2, ["k"], ["k"], "full",
+                           capacity=4000, engine="sort")
+        assert_batches_match("jit/full", rs, r2, cs, c2)
+
+
+class TestSpillableBuildTableEngine:
+    def test_rebuild_honors_active_knob(self):
+        """A spilled-and-dropped build table must rebuild under whichever
+        join_engine is active at get() time, not the one it was built
+        under — the probe side dispatches on the handle's engine."""
+        left, right = make_sides(100, 32, "uniform", seed=9)
+        config.set("join_engine", "sort")
+        tbl = spillable_build_table(right, ["k"])
+        try:
+            assert tbl.engine == "sort"
+            rs, cs = hash_join(left, right, ["k"], ["k"], "inner",
+                               capacity=2000, prebuilt=tbl)
+            config.set("join_engine", "hash")
+            tbl.spill()
+            assert tbl.tier == "dropped"
+            rh, ch = hash_join(left, right, ["k"], ["k"], "inner",
+                               capacity=2000, prebuilt=tbl)
+            assert tbl.engine == "hash"
+            assert tbl.rebuilds == 1
+            assert_batches_match("spillable-rebuild", rs, rh, cs, ch)
+        finally:
+            tbl.close()
+
+
+# ---------------------------------------------------------------------------
+# group-by: scatter engine vs sort engine
+# ---------------------------------------------------------------------------
+
+ALL_AGGS = [AggSpec("count", None, "cstar"), AggSpec("sum", "v", "s"),
+            AggSpec("count", "v", "c"), AggSpec("min", "v", "mn"),
+            AggSpec("max", "v", "mx"), AggSpec("mean", "v", "avg"),
+            AggSpec("sum", "f", "fs"), AggSpec("min", "f", "fmn"),
+            AggSpec("max", "f", "fmx"), AggSpec("mean", "f", "favg")]
+FLOAT_APPROX = ("fs", "favg")  # float reductions: order differs by engine
+
+
+def make_groupby_batch(n, skew, seed=21, nullfrac=0.15):
+    rng = np.random.default_rng(seed)
+    if skew == "uniform":
+        k = rng.integers(0, 40, n)
+    elif skew == "80one":
+        k = np.where(rng.random(n) < 0.8, 7, rng.integers(0, 40, n))
+    else:  # allone
+        k = np.full(n, 7)
+    kv = rng.random(n) > nullfrac
+    v = rng.integers(-1000, 1000, n)
+    vv = rng.random(n) > nullfrac
+    f = rng.choice([1.5, -0.0, 0.0, np.nan, -2.5, 1e300], n)
+    return ColumnBatch({"k": col_i32(k, kv), "v": col_i32(v, vv),
+                        "f": col_f64(f)})
+
+
+def both_groupby(batch, keys, aggs, **kw):
+    ra, na = group_by(batch, keys, aggs, engine="sort", **kw)
+    rb, nb = group_by(batch, keys, aggs, engine="scatter", **kw)
+    return ra, na, rb, nb
+
+
+class TestGroupByEngineParity:
+    @pytest.mark.parametrize("skew", SKEWS)
+    def test_all_aggs_all_skews(self, skew):
+        batch = make_groupby_batch(500, skew)
+        ra, na, rb, nb = both_groupby(batch, ["k"], ALL_AGGS)
+        assert_batches_match(f"gb/{skew}", ra, rb, na, nb,
+                             approx=FLOAT_APPROX)
+
+    def test_float_keys_normalized(self):
+        # -0.0 and 0.0 one group; every NaN one group; nulls one group
+        batch = make_groupby_batch(300, "uniform", seed=33)
+        ra, na, rb, nb = both_groupby(batch, ["k", "f"],
+                                      [AggSpec("count", None, "c"),
+                                       AggSpec("sum", "v", "s")])
+        assert_batches_match("gb/floatkeys", ra, rb, na, nb)
+
+    def test_row_valid(self):
+        rng = np.random.default_rng(4)
+        batch = make_groupby_batch(400, "80one", seed=4)
+        rv = jnp.asarray(rng.random(400) > 0.3)
+        ra, na, rb, nb = both_groupby(batch, ["k"], ALL_AGGS, row_valid=rv)
+        assert_batches_match("gb/row_valid", ra, rb, na, nb,
+                             approx=FLOAT_APPROX)
+
+    def test_decimal_sum_parity(self):
+        rng = np.random.default_rng(8)
+        keys = rng.integers(0, 10, 200).tolist()
+        vals = [None if rng.random() < 0.1
+                else int(rng.integers(-(10 ** 18), 10 ** 18)) * 10 ** 10
+                for _ in range(200)]
+        batch = ColumnBatch({
+            "k": Column.from_pylist(keys, T.INT32),
+            "d": Decimal128Column.from_unscaled(vals, 38, 4)})
+        aggs = [AggSpec("sum", "d", "ds"), AggSpec("count", "d", "dc"),
+                AggSpec("min", "d", "dmn"), AggSpec("max", "d", "dmx"),
+                AggSpec("mean", "d", "davg")]
+        ra, na = group_by(batch, ["k"], aggs, engine="sort")
+        rb, nb = group_by(batch, ["k"], aggs, engine="scatter")
+        n = int(na)
+        assert n == int(nb)
+        for c in ("k", "ds", "dc", "dmn", "dmx", "davg"):
+            assert ra[c].to_pylist()[:n] == rb[c].to_pylist()[:n], c
+
+    def test_overflow_falls_back_inside_jit(self):
+        """num_slots below the key cardinality: the scatter engine's
+        runtime cond falls back to the sort path inside the same program
+        — the hint costs speed, never correctness."""
+        batch = make_groupby_batch(300, "uniform", seed=13)  # ~40 keys
+        ra, na = group_by(batch, ["k"], ALL_AGGS, engine="sort")
+        rb, nb = group_by(batch, ["k"], ALL_AGGS, engine="scatter",
+                          num_slots=4)
+        assert_batches_match("gb/overflow", ra, rb, na, nb,
+                             approx=FLOAT_APPROX)
+
+    def test_assume_grouped_matches_plain(self):
+        """A pre-sorted batch under assume_grouped=True must produce the
+        same groups; order is first-appearance (== key order here, since
+        the batch is key-sorted with the dead rows trailing)."""
+        rng = np.random.default_rng(17)
+        n = 300
+        k = np.sort(rng.integers(0, 20, n)).astype(np.int32)
+        v = rng.integers(0, 100, n).astype(np.int32)
+        rv = np.ones(n, bool)
+        rv[-30:] = False  # one trailing dead run, as the contract demands
+        batch = ColumnBatch({"k": col_i32(k), "v": col_i32(v)})
+        aggs = [AggSpec("count", None, "c"), AggSpec("sum", "v", "s")]
+        ra, na = group_by(batch, ["k"], aggs, engine="sort",
+                          row_valid=jnp.asarray(rv))
+        rb, nb = group_by(batch, ["k"], aggs, row_valid=jnp.asarray(rv),
+                          assume_grouped=True)
+        assert_batches_match("gb/assume_grouped", ra, rb, na, nb)
+
+    def test_knob_and_auto_dispatch(self):
+        batch = make_groupby_batch(200, "uniform", seed=29)
+        aggs = [AggSpec("sum", "v", "s")]
+        config.set("groupby_engine", "scatter")
+        rk, nk = group_by(batch, ["k"], aggs)
+        config.set("groupby_engine", "sort")
+        rs, ns = group_by(batch, ["k"], aggs)
+        config.reset()
+        assert_batches_match("gb/knob", rs, rk, ns, nk)
+        with pytest.raises(ValueError, match="engine"):
+            group_by(batch, ["k"], aggs, engine="Scatter")
+
+
+# ---------------------------------------------------------------------------
+# q95: the three plans (auto / pinned sort-fused / pinned scatter) agree
+# ---------------------------------------------------------------------------
+
+
+class TestQ95PlansAgree:
+    def _groups(self, res, ng):
+        n = int(ng)
+        k = np.asarray(res["seg"].data)
+        kv = np.asarray(res["seg"].validity)
+        o = np.asarray(res["orders"].data)
+        net = np.asarray(res["net"].data)
+        return {int(k[i]) if kv[i] else None: (int(o[i]), float(net[i]))
+                for i in range(n)}
+
+    def test_three_plans_and_ground_truth(self):
+        import __graft_entry__ as ge
+
+        nq = 1 << 10
+        fact, dim1, dim2 = ge._q95_batches(nq, seed=19)
+        res0, ng0 = jax.jit(ge._q95_step)(fact, dim1, dim2)
+        g0 = self._groups(res0, ng0)
+        plans = {"auto": g0}
+        for knob in ("sort", "scatter"):
+            config.set("groupby_engine", knob)
+            try:
+                res, ng = jax.jit(
+                    lambda f, a, b: ge._q95_step(f, a, b))(fact, dim1, dim2)
+                plans[knob] = self._groups(res, ng)
+            finally:
+                config.reset()
+        assert plans["auto"] == plans["sort"] == plans["scatter"]
+        # numpy ground truth: q95's dim joins hit unique keys, so the
+        # whole query reduces to a seg-keyed count/sum over the fact rows
+        seg = np.asarray(fact["seg"].data)
+        v = np.asarray(fact["v"].data)
+        want = {int(s): (int((seg == s).sum()), float(v[seg == s].sum()))
+                for s in np.unique(seg)}
+        assert g0 == want
+
+    def test_prefix_stages_run(self):
+        import functools
+
+        import __graft_entry__ as ge
+
+        fact, dim1, dim2 = ge._q95_batches(1 << 10, seed=23)
+        for upto in ("exch1", "join1", "join2"):
+            out = jax.jit(functools.partial(ge._q95_prefix, upto=upto))(
+                fact, dim1, dim2)
+            jax.block_until_ready(out)
+
+
+class TestRegroupOrderSecondary:
+    def test_matches_python_sorted(self):
+        from spark_rapids_jni_tpu.parallel.partition import regroup_order
+
+        rng = np.random.default_rng(0)
+        n = 3000
+        pid = jnp.asarray(rng.integers(0, 9, n).astype(np.int32))
+        w1 = jnp.asarray(rng.integers(0, 50, n).astype(np.uint32))
+        got = np.asarray(regroup_order(pid, 9, secondary=(w1,)))
+        keys = list(zip(np.asarray(pid).tolist(), np.asarray(w1).tolist(),
+                        range(n)))
+        want = np.asarray([i for _, _, i in sorted(keys)], np.int32)
+        assert np.array_equal(got, want)

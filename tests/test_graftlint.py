@@ -367,6 +367,102 @@ class TestGL006:
 
 
 # ---------------------------------------------------------------------------
+# GL007 — donated-buffer reuse
+# ---------------------------------------------------------------------------
+
+
+class TestGL007:
+    def test_decorated_donation_reuse_flagged(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(x, dt):
+                return x + dt
+
+            def run(x, dt):
+                y = step(x, dt)
+                return y + x.sum()
+        """}, rules=["GL007"])
+        assert new_rules(res) == [("GL007", "mod.py")]
+        assert "donated" in res.new[0].message
+
+    def test_bound_name_and_argnames_flagged(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import jax
+
+            def _impl(acc, upd):
+                return acc + upd
+
+            fast = jax.jit(_impl, donate_argnames=("acc",))
+
+            def drive(acc, upd):
+                out = fast(acc, upd)
+                return out, acc
+        """}, rules=["GL007"])
+        assert new_rules(res) == [("GL007", "mod.py")]
+
+    def test_rebind_idiom_and_reassign_clean(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(x, dt):
+                return x + dt
+
+            def run(x, dt):
+                x = step(x, dt)        # rebind idiom: donation is safe
+                return x * 2
+
+            def run2(x, dt):
+                y = step(x, dt)
+                x = y - dt             # reassigned before the read
+                return x + y
+        """}, rules=["GL007"])
+        assert res.new == []
+
+    def test_undonated_jit_and_undecorated_inner_clean(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import jax
+
+            @jax.jit
+            def plain(x):
+                return x * 2
+
+            def _impl(acc, upd):
+                return acc + upd
+
+            fast = jax.jit(_impl, donate_argnums=(0,))
+
+            def run(x):
+                y = plain(x)
+                return y + x           # no donation: reuse is fine
+
+            def eager(acc, upd):
+                out = _impl(acc, upd)  # undecorated inner: runs eagerly
+                return out + acc
+        """}, rules=["GL007"])
+        assert res.new == []
+
+    def test_suppressed(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(x):
+                return x + 1
+
+            def run(x):
+                y = step(x)
+                return y, x  # graftlint: disable=GL007
+        """}, rules=["GL007"])
+        assert res.new == [] and res.counts()["suppressed"] == 1
+
+
+# ---------------------------------------------------------------------------
 # baseline ratchet
 # ---------------------------------------------------------------------------
 
@@ -480,4 +576,5 @@ class TestLiveTree:
     def test_every_rule_is_registered(self):
         from tools.graftlint import rules as rules_mod
         ids = [r.id for r in rules_mod.all_rules()]
-        assert ids == ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006"]
+        assert ids == ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
+                       "GL007"]

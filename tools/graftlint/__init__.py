@@ -19,6 +19,8 @@ GL005     config-knob drift: ``config.py`` keys must be documented in
           README.md and read somewhere outside ``config.py``
 GL006     fault-kind drift: ``faultinj`` kind strings used anywhere must
           exist in ``faultinj.FAULT_KINDS``, and vice versa
+GL007     donated-buffer reuse: a variable passed at a donated position of
+          a ``jax.jit(..., donate_argnums=...)`` callable and read again
 ========  ==================================================================
 
 Run ``python -m tools.graftlint spark_rapids_jni_tpu tests``; see

@@ -20,7 +20,7 @@ from . import engine
 def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tools.graftlint",
-        description="AST-based JAX-hazard linter (rules GL001-GL006); "
+        description="AST-based JAX-hazard linter (rules GL001-GL007); "
                     "see tools/graftlint/README.md")
     parser.add_argument("paths", nargs="+",
                         help="files or directories to lint")

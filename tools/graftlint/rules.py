@@ -1,4 +1,4 @@
-"""The six graftlint rules.  Each encodes a bug this repo shipped or is
+"""The seven graftlint rules.  Each encodes a bug this repo shipped or is
 structurally exposed to; see tools/graftlint/README.md for the full
 rationale with the motivating incident per rule."""
 
@@ -621,9 +621,162 @@ class GL006FaultKindDrift(Rule):
                     yield v.value, v
 
 
+# ---------------------------------------------------------------------------
+# GL007 — donated-buffer reuse
+# ---------------------------------------------------------------------------
+
+
+class GL007DonatedBufferReuse(Rule):
+    """``jax.jit(f, donate_argnums=...)`` hands the argument's device
+    buffer to XLA for in-place reuse; after the call the caller-side
+    array is *deleted* — any later read raises ``Array has been deleted``
+    (or, pre-deletion-check builds, silently reads clobbered memory).
+    The r6 donation audit of the engine entry points found exactly the
+    trap shape: the bench reps-loop calls each jitted entry repeatedly
+    with the SAME input arrays, so donating there would invalidate the
+    inputs for rep 2 — which is why no entry donates today and why this
+    rule gates anyone adding ``donate_argnums`` later.  Flags a variable
+    passed at a donated position and read again afterwards in the same
+    scope.  The rebind idiom ``x = step(x)`` and any re-assignment
+    between the call and the read are clean."""
+
+    id = "GL007"
+
+    def check(self, pf: ParsedFile) -> Iterable[Finding]:
+        aliases = module_aliases(pf.tree)
+        defs: Dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(pf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, node)
+        donors = self._donating_callables(pf, aliases, defs)
+        if not donors:
+            return
+        scopes: List[ast.AST] = [pf.tree]
+        scopes.extend(fn for fn in ast.walk(pf.tree)
+                      if isinstance(fn, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)))
+        for scope in scopes:
+            yield from self._scan_scope(pf, scope, donors)
+
+    @staticmethod
+    def _donation(jit_kws: Sequence[ast.keyword],
+                  fn: Optional[ast.FunctionDef]):
+        """(donated positions, donated kwarg names) from jit keywords;
+        donate_argnames are mapped to positions when the wrapped def is
+        known in-module."""
+        nums: Set[int] = set()
+        names: Set[str] = set()
+        for kw in jit_kws:
+            if kw.arg == "donate_argnums":
+                for c in ast.walk(kw.value):
+                    if (isinstance(c, ast.Constant)
+                            and isinstance(c.value, int)):
+                        nums.add(c.value)
+            elif kw.arg == "donate_argnames":
+                for c in ast.walk(kw.value):
+                    if (isinstance(c, ast.Constant)
+                            and isinstance(c.value, str)):
+                        names.add(c.value)
+        if fn is not None and names:
+            params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+            for n in names:
+                if n in params:
+                    nums.add(params.index(n))
+        return nums, names
+
+    def _donating_callables(self, pf, aliases, defs):
+        """Name -> (donated positions, donated kwarg names) for every
+        callable in this module that donates: a def decorated with a
+        donating jit wrap, or ``fast = jax.jit(f, donate_argnums=...)``.
+        Calls to an *undecorated* inner ``f`` run eagerly and do not
+        donate, so only the bound name is registered in that case."""
+        donors: Dict[str, Tuple[Set[int], Set[str]]] = {}
+        for fn in defs.values():
+            for dec in fn.decorator_list:
+                kws = _jit_call_info(dec, aliases)
+                if kws is None:
+                    continue
+                nums, names = self._donation(kws, fn)
+                if nums or names:
+                    donors[fn.name] = (nums, names)
+        for node in ast.walk(pf.tree):
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            kws = _jit_call_info(node.value, aliases)
+            if not kws:
+                continue
+            inner = None
+            if isinstance(node.value, ast.Call) and node.value.args:
+                a0 = node.value.args[0]
+                if isinstance(a0, ast.Name):
+                    inner = defs.get(a0.id)
+            nums, names = self._donation(kws, inner)
+            if nums or names:
+                donors[node.targets[0].id] = (nums, names)
+        return donors
+
+    def _scan_scope(self, pf, scope, donors):
+        nodes = list(_walk_scope(scope, into_functions=False))
+        loads: Dict[str, List[ast.Name]] = {}
+        stores: Dict[str, List[ast.Name]] = {}
+        for n in nodes:
+            if isinstance(n, ast.Name):
+                bucket = loads if isinstance(n.ctx, ast.Load) else stores
+                bucket.setdefault(n.id, []).append(n)
+        # call node -> names rebound by its enclosing assignment
+        rebinds: Dict[int, Set[str]] = {}
+        for n in nodes:
+            if not isinstance(n, ast.Assign):
+                continue
+            tgts = set()
+            for t in n.targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name):
+                        tgts.add(sub.id)
+            for sub in ast.walk(n.value):
+                if isinstance(sub, ast.Call):
+                    rebinds[id(sub)] = tgts
+        for call in nodes:
+            if not (isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Name)
+                    and call.func.id in donors):
+                continue
+            nums, names = donors[call.func.id]
+            donated = [call.args[i] for i in sorted(nums)
+                       if i < len(call.args)]
+            donated += [kw.value for kw in call.keywords if kw.arg in names]
+            for arg in donated:
+                if not isinstance(arg, ast.Name):
+                    continue
+                var = arg.id
+                if var in rebinds.get(id(call), ()):
+                    continue  # x = step(x): the donation idiom
+                for ld in sorted(loads.get(var, ()),
+                                 key=lambda x: x.lineno):
+                    if ld.lineno <= call.lineno or ld is arg:
+                        continue
+                    if any(call.lineno < st.lineno <= ld.lineno
+                           for st in stores.get(var, ())):
+                        break  # rebound before this read — fresh value
+                    yield pf.finding(
+                        self.id, ld,
+                        f"`{var}` was donated to `{call.func.id}` "
+                        f"(donate_argnums, call at line {call.lineno}) "
+                        "and is read again here — the donated buffer is "
+                        "deleted by the call (`Array has been deleted`); "
+                        "rebind the result (`x = f(x)`) or drop the "
+                        "donation")
+                    break  # one finding per donated var per call
+
+        return
+
+
 _ALL: List[Rule] = [GL001TracerLeak(), GL002HostSyncUnderJit(),
                     GL003RetraceHazard(), GL004SpillHandleLeak(),
-                    GL005ConfigDrift(), GL006FaultKindDrift()]
+                    GL005ConfigDrift(), GL006FaultKindDrift(),
+                    GL007DonatedBufferReuse()]
 
 
 def all_rules(only: Optional[Sequence[str]] = None) -> List[Rule]:

@@ -93,7 +93,15 @@ def stage_groupby_sortscan(fact, dim1, dim2):
     return group_by(
         fact, ["seg"],
         [AggSpec("count", None, "orders"), AggSpec("sum", "v", "net")],
-        row_valid=live)
+        row_valid=live, engine="sort")
+
+
+def stage_groupby_scatter(fact, dim1, dim2):
+    live = jnp.ones((fact.num_rows,), jnp.bool_)
+    return group_by(
+        fact, ["seg"],
+        [AggSpec("count", None, "orders"), AggSpec("sum", "v", "net")],
+        row_valid=live, engine="scatter")
 
 
 def stage_groupby_domain(fact, dim1, dim2):
@@ -104,12 +112,37 @@ def stage_groupby_domain(fact, dim1, dim2):
         ge.Q95_SEG, row_valid=live)
 
 
+def stage_join1_sortprobe(fact, dim1, dim2):
+    return hash_join(fact, dim1, ["k"], ["k"], "inner", engine="sort")
+
+
+def stage_join1_hashprobe(fact, dim1, dim2):
+    return hash_join(fact, dim1, ["k"], ["k"], "inner", engine="hash")
+
+
+def full_fused_sort(fact, dim1, dim2):
+    """The sort-order-reuse plan: groupby_engine pinned to 'sort' routes
+    the final aggregation through a seg-keyed exchange whose regroup
+    sort carries the seg radix words, then assume_grouped group_by."""
+    from spark_rapids_jni_tpu import config
+
+    config.set("groupby_engine", "sort")
+    try:
+        return ge._q95_prefix(fact, dim1, dim2, "full")
+    finally:
+        config.reset("groupby_engine")
+
+
 print("devices:", jax.devices(), "rows:", N, flush=True)
 bench("partition_id_only", stage_pid)
 bench("exchange1 (regroup auto)", stage_exchange1)
 bench("exchange1 (regroup sort)", stage_exchange1_sort)
 bench("exchange1 + join1", stage_join1)
 bench("through join2 (2 exch, 2 join)", stage_through_join2)
+bench("join1 only (sort probe)", stage_join1_sortprobe)
+bench("join1 only (hash probe)", stage_join1_hashprobe)
 bench("group_by(seg) sort-scan", stage_groupby_sortscan)
+bench("group_by(seg) scatter", stage_groupby_scatter)
 bench("group_by(seg) domain auto", stage_groupby_domain)
 bench("full q95 step", ge._q95_step)
+bench("full q95 (fused sort plan)", full_fused_sort)
