@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Chaos-campaign gate: deterministic fault sweep over spill/shuffle/q95.
+#
+# Runs tools/chaos.py — every faultinj.FAULT_KINDS entry fired at every
+# instrumented boundary (one fault per trial, exhaustively) plus seeded
+# multi-fault trials — and fails unless every faulted run is bit-identical
+# to its fault-free baseline with clean post-run invariants (arenas
+# drained, spill store empty, no orphaned files, attempts bounded).  On
+# failure the runner dumps each failing trial's faultinj.fired_log() to
+# stderr: the (name, occurrence) pairs are the exact replay recipe.
+#
+# Deterministic by construction (fixed --seed, occurrence-clock rules),
+# so a red gate is a real regression, never flake.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+CHAOS_SEED="${CHAOS_SEED:-0}"
+
+echo "== chaos campaign (seed=${CHAOS_SEED}) =="
+BENCH_FORCE_CPU=1 python -m tools.chaos --seed "${CHAOS_SEED}" \
+    --report /tmp/chaos_report.json
+echo "== chaos campaign OK (report: /tmp/chaos_report.json) =="

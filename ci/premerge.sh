@@ -13,6 +13,13 @@ cd "$(dirname "$0")/.."
 # (re)introduced (e.g. a module-level jnp constant, the PR 2 bug class)
 bash ci/lint.sh
 
+# chaos campaign second: the fault-domain gate (tools/chaos.py) sweeps
+# every faultinj.FAULT_KINDS entry across the spill/shuffle/q95 recovery
+# boundaries and requires bit-identical results + drained arenas, so a
+# broken recovery path (checksum, lineage rebuild, round re-drive, retry
+# ladder) fails in under a minute, before any native build
+bash ci/chaos.sh
+
 make -C spark_rapids_jni_tpu/mem/native
 make -C spark_rapids_jni_tpu/io/native
 make -C jni

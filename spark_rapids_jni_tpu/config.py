@@ -96,6 +96,26 @@ _register("shuffle_max_rounds", 64, int,
           "Cap on ShuffleService rounds per exchange; a plan that would "
           "exceed it RAISES per-round capacity (never drops rows) so the "
           "host-side round loop stays bounded under extreme skew.")
+_register("spill_checksum", True, _parse_bool,
+          "Record a CRC32 + byte length for every leaf the spill "
+          "framework writes to disk and verify both on read-back "
+          "(mem/spill.py).  A mismatch means the spilled copy is damaged: "
+          "the handle rebuilds via its recompute= lineage when it has "
+          "one, else raises SpillCorruptionError LOUDLY instead of "
+          "silently computing on garbage.  Off = trust the filesystem.")
+_register("shuffle_max_recoveries", 8, int,
+          "Per-exchange budget for lineage recoveries in the "
+          "ShuffleService (shuffle/service.py): each lost/corrupt "
+          "PartitionBuffer rebuilt by re-running its map shards or "
+          "re-driving its round counts against this bound "
+          "(ShuffleMetrics.recovered_partitions); exceeding it raises "
+          "ShuffleError so a flapping disk cannot loop a shuffle "
+          "forever.")
+_register("chaos_trials", 4, int,
+          "Seeded multi-fault trials per scenario in the chaos campaign "
+          "(tools/chaos.py) on top of the exhaustive one-fault-per-trial "
+          "sweep; each trial samples 2-3 recoverable fault rules with "
+          "deterministic skip/count offsets from the campaign seed.")
 # (the legacy `bench_rows` knob was dropped: nothing read it after the
 # bench went per-platform — graftlint GL005 now fails on dead knobs)
 _register("bench_rows_tpu", 1 << 24, int,

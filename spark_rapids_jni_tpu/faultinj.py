@@ -12,10 +12,16 @@ boundary — instrumented jitted callables — since there is no CUPTI:
       {"seed": 42, "dynamic": true,
        "faults": [{"match": "q6*",  "probability": 0.01,
                    "fault": "exception"},
-                  {"match": "*",    "count": 2, "fault": "oom"}]}
+                  {"match": "*",    "count": 2, "skip": 1,
+                   "fault": "oom"}]}
 
   ``match`` is an fnmatch pattern on the instrumented name; ``count``
-  limits firings (omit for unlimited); ``probability`` defaults to 1.
+  limits firings (omit for unlimited); ``probability`` defaults to 1;
+  ``skip`` passes over the first N matching occurrences before the rule
+  becomes eligible — with ``probability`` 1 this pins the firing to an
+  exact occurrence, which is what makes chaos schedules deterministic
+  and replayable (tools/chaos.py sweeps ``skip`` to hit every boundary
+  crossing of a scenario).
 * faults: ``"exception"`` raises :class:`InjectedFault` (the retryable
   CudfException analogue), ``"oom"`` raises
   :class:`~spark_rapids_jni_tpu.mem.RetryOOM` (driving the rollback
@@ -26,9 +32,26 @@ boundary — instrumented jitted callables — since there is no CUPTI:
   framework degrades by keeping the batch in the higher tier,
   ``"shuffle_io"`` raises :class:`ShuffleIOError` at the ShuffleService's
   per-round boundary (name ``shuffle_io_round``) — the service re-drives
-  the round from its intact spillable buffers and counts the failure.
+  the round from its intact spillable buffers and counts the failure,
+  ``"spill_corrupt"`` raises :class:`SpillCorruptionError` at the spill
+  framework's post-write probe (name ``spill_corrupt_file``) — the
+  framework responds by FLIPPING BYTES in the file it just wrote, so the
+  checksum verification and lineage-recompute paths are proven against
+  real on-disk damage, not just a raised exception.
 * ``dynamic: true`` re-reads the file when its mtime changes, matching
   the injector's ``dynamicReconfig`` thread without needing one.
+
+Observability (all reset by :func:`configure` / :func:`reset_stats`):
+:func:`check_counts` counts every screening per instrumented name (the
+deterministic occurrence clock that ``skip`` indexes into),
+:func:`fire_counts` counts actual injections per name, and
+:func:`fired_log` returns the ordered trace of every injection —
+``{"seq", "name", "fault", "match", "occurrence"}`` — which is enough to
+reproduce a failing chaos schedule exactly (``skip = occurrence - 1``).
+
+:func:`scope` applies a config for a ``with`` block and restores the
+previous rules on exit; the block's stats survive the exit so a failing
+trial can still be reported from its log.
 
 Usage::
 
@@ -36,17 +59,20 @@ Usage::
     faultinj.configure(path_or_dict)          # or env var + configure()
     step = faultinj.instrument(jax.jit(fn), "q6_step")
     step(batch)   # may raise per config
+    with faultinj.scope({"faults": [...]}):   # scoped schedule
+        step(batch)
 """
 
 from __future__ import annotations
 
+import contextlib
 import fnmatch
 import functools
 import json
 import os
 import random
 import threading
-from typing import Optional, Union
+from typing import Dict, List, Optional, Union
 
 ENV_CONFIG = "SPARK_RAPIDS_TPU_FAULT_CONFIG"
 
@@ -75,6 +101,17 @@ class ShuffleIOError(OSError):
     counts the failure in ``ShuffleMetrics.io_failures``."""
 
 
+class SpillCorruptionError(OSError):
+    """Spilled data came back wrong or not at all (kind ``"spill_corrupt"``).
+
+    Raised two ways: by the injector at the spill framework's post-write
+    probe (where the framework converts it into real byte flips in the
+    just-written file), and by the framework itself when a read-back
+    fails checksum/length verification and the handle has no
+    ``recompute=`` lineage to rebuild from.  Subclasses :class:`OSError`
+    so callers treating disk loss generically catch both."""
+
+
 def _raise_exception(name: str):
     raise InjectedFault(f"injected exception at {name}")
 
@@ -97,17 +134,25 @@ def _raise_shuffle_io(name: str):
     raise ShuffleIOError(f"injected shuffle I/O fault at {name}")
 
 
+def _raise_spill_corrupt(name: str):
+    raise SpillCorruptionError(f"injected spill corruption at {name}")
+
+
 # The registry of injectable fault flavors: kind -> raiser.  graftlint's
 # GL006 keeps this in sync with every use site statically — a kind used
 # in a config dict but missing here would otherwise only fail when its
 # rule first fires, and a kind registered here but never injected by any
-# test is an untested fault-handling path.
+# test is an untested fault-handling path.  tools/chaos.py additionally
+# proves every kind DYNAMICALLY: the premerge chaos campaign fails unless
+# each entry here fired at least once across the spill/shuffle/q95
+# scenarios with a bit-identical recovery.
 FAULT_KINDS = {
     "exception": _raise_exception,
     "oom": _raise_oom,
     "fatal": _raise_fatal,
     "spill_io": _raise_spill_io,
     "shuffle_io": _raise_shuffle_io,
+    "spill_corrupt": _raise_spill_corrupt,
 }
 
 
@@ -116,11 +161,15 @@ class _Rule:
         self.match = spec.get("match", "*")
         self.probability = float(spec.get("probability", 1.0))
         self.count = spec.get("count")  # None = unlimited
+        self.skip = int(spec.get("skip", 0))
+        if self.skip < 0:
+            raise ValueError(f"skip must be >= 0, got {self.skip}")
         self.fault = spec.get("fault", "exception")
         if self.fault not in FAULT_KINDS:
             raise ValueError(f"unknown fault kind {self.fault!r}; known: "
                              f"{sorted(FAULT_KINDS)}")
         self.remaining = None if self.count is None else int(self.count)
+        self.skip_remaining = self.skip
 
     def applies(self, name: str) -> bool:
         return fnmatch.fnmatchcase(name, self.match)
@@ -134,65 +183,141 @@ class _Injector:
         self._path: Optional[str] = None
         self._mtime: float = 0.0
         self._dynamic = False
+        # deterministic observability: per-name screening/firing counters
+        # and the ordered injection trace (see fired_log())
+        self._checks: Dict[str, int] = {}
+        self._fired: Dict[str, int] = {}
+        self._log: List[dict] = []
+        self._seq = 0
+
+    def _reset_stats_locked(self):
+        self._checks = {}
+        self._fired = {}
+        self._log = []
+        self._seq = 0
 
     def configure(self, config: Union[None, str, dict] = None):
-        """Load config from a dict, a path, or the env var."""
+        """Load config from a dict, a path, or the env var.
+
+        Every (re)configuration resets the fire counters and the trace —
+        a schedule's observability starts at its installation.  All state
+        is swapped under one lock acquisition so a concurrent ``check()``
+        sees either the old or the new schedule, never a mix (the
+        ``_maybe_reload`` race of record: ``_dynamic``/``_path`` used to
+        be readable mid-write)."""
         if config is None:
             config = os.environ.get(ENV_CONFIG)
             if config is None:
                 with self._lock:
                     self._rules = []
                     self._path = None
+                    self._dynamic = False
+                    self._reset_stats_locked()
                 return
         if isinstance(config, str):
-            path = config
+            path: Optional[str] = config
             with open(path) as f:
                 doc = json.load(f)
-            with self._lock:
-                self._path = path
-                self._mtime = os.path.getmtime(path)
+            mtime = os.path.getmtime(path)
         else:
-            doc = config
-            with self._lock:
-                self._path = None
+            doc, path, mtime = config, None, 0.0
         rules = [_Rule(r) for r in doc.get("faults", [])]
         with self._lock:
             self._rules = rules
             self._rng = random.Random(doc.get("seed", 0))
             self._dynamic = bool(doc.get("dynamic", False))
+            self._path = path
+            self._mtime = mtime
+            self._reset_stats_locked()
 
     def _maybe_reload(self):
-        if not self._dynamic or self._path is None:
+        with self._lock:
+            dynamic, path, known_mtime = self._dynamic, self._path, \
+                self._mtime
+        if not dynamic or path is None:
             return
         try:
-            mtime = os.path.getmtime(self._path)
+            mtime = os.path.getmtime(path)
         except OSError:
             return
-        if mtime != self._mtime:
-            self.configure(self._path)
+        if mtime != known_mtime:
+            self.configure(path)
 
     def check(self, name: str):
         """Called at each instrumented execution; raises if a rule fires."""
         self._maybe_reload()
         with self._lock:
+            self._checks[name] = self._checks.get(name, 0) + 1
             for rule in self._rules:
                 if not rule.applies(name):
                     continue
                 if rule.remaining is not None and rule.remaining <= 0:
                     continue
+                if rule.skip_remaining > 0:
+                    # deterministic pass-over: this matching occurrence is
+                    # consumed whether or not probability would have fired
+                    rule.skip_remaining -= 1
+                    continue
                 if self._rng.random() >= rule.probability:
                     continue
                 if rule.remaining is not None:
                     rule.remaining -= 1
+                self._seq += 1
+                self._fired[name] = self._fired.get(name, 0) + 1
+                self._log.append({
+                    "seq": self._seq, "name": name, "fault": rule.fault,
+                    "match": rule.match,
+                    # occurrence is 1-based: replay with skip=occurrence-1
+                    "occurrence": self._checks[name],
+                })
                 kind = rule.fault
                 break
             else:
                 return
         FAULT_KINDS[kind](name)
 
+    # -- observability ---------------------------------------------------
+    def check_counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._checks)
+
+    def fire_counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._fired)
+
+    def fired_log(self) -> List[dict]:
+        with self._lock:
+            return [dict(r) for r in self._log]
+
+    def reset_stats(self):
+        with self._lock:
+            self._reset_stats_locked()
+
+    @contextlib.contextmanager
+    def scope(self, config: Union[str, dict]):
+        """Apply ``config`` for the block, restoring the previous schedule
+        (rules, rng, dynamic-reload state) on exit.  Entry resets the
+        stats (via :meth:`configure`); exit leaves them in place so the
+        block's :func:`fired_log` stays readable after a failing trial."""
+        with self._lock:
+            saved = (self._rules, self._rng, self._dynamic, self._path,
+                     self._mtime)
+        self.configure(config)
+        try:
+            yield self
+        finally:
+            with self._lock:
+                (self._rules, self._rng, self._dynamic, self._path,
+                 self._mtime) = saved
+
 
 _injector = _Injector()
 configure = _injector.configure
+scope = _injector.scope
+check_counts = _injector.check_counts
+fire_counts = _injector.fire_counts
+fired_log = _injector.fired_log
+reset_stats = _injector.reset_stats
 
 
 def instrument(fn, name: Optional[str] = None):

@@ -249,30 +249,41 @@ def run_with_retry(
             split()
         except RetryOOM as e:
             last = e
-            freed = None
-            if make_spillable is not None:
-                freed = (make_spillable(e) if default_spill
-                         else make_spillable())
-            if freed:
-                # this thread reclaimed memory itself; its deallocations
-                # already woke any blocked peers, so retry immediately —
-                # parking now could sleep through the wake that fired
-                # before the wait started
-                continue
-            # park on the arena that raised: Cpu* flavors block on the
-            # host adaptor, device flavors on the device adaptor
-            block = (RmmSpark.cpu_block_thread_until_ready
-                     if isinstance(e, (CpuRetryOOM, CpuSplitAndRetryOOM))
-                     else RmmSpark.block_thread_until_ready)
-            try:
-                block()
-            except SplitAndRetryOOM as e2:
-                last = e2
-                if split is None:
-                    raise
-                split()
-            except RetryOOM as e2:
-                last = e2
+            # spill-then-maybe-park, repeated when the PARK ITSELF raises
+            # RetryOOM: that inner OOM is a fresh memory signal and must
+            # run make_spillable again before the step retries (skipping
+            # it would retry into the exact pressure that raised it)
+            for _park_attempt in range(max_retries):
+                oom = last
+                freed = None
+                if make_spillable is not None:
+                    freed = (make_spillable(oom) if default_spill
+                             else make_spillable())
+                if freed:
+                    # this thread reclaimed memory itself; its
+                    # deallocations already woke any blocked peers, so
+                    # retry immediately — parking now could sleep through
+                    # the wake that fired before the wait started
+                    break
+                # park on the arena that raised: Cpu* flavors block on
+                # the host adaptor, device flavors on the device adaptor
+                block = (RmmSpark.cpu_block_thread_until_ready
+                         if isinstance(oom, (CpuRetryOOM,
+                                             CpuSplitAndRetryOOM))
+                         else RmmSpark.block_thread_until_ready)
+                try:
+                    block()
+                    break
+                except SplitAndRetryOOM as e2:
+                    last = e2
+                    if split is None:
+                        raise
+                    split()
+                    break
+                except RetryOOM as e2:
+                    last = e2
+            else:
+                raise last
     raise last
 
 

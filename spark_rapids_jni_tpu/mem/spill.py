@@ -38,6 +38,24 @@ Fault injection: the disk I/O boundary is instrumented
 fault kind ``"spill_io"``); a failed disk write degrades gracefully — the
 batch stays resident in the host tier and the failure is counted, no data
 is lost.
+
+Integrity + lineage (the fault-domain hardening layer):
+
+* every leaf written to disk records a CRC32 + byte length (knob
+  ``spill_checksum``), verified on read-back — a flipped bit in a spill
+  file is DETECTED, never silently computed on;
+* a handle constructed with ``recompute=`` carries its lineage: when the
+  spilled copy comes back corrupt (checksum mismatch), truncated, or not
+  at all (file deleted, unreadable header), the handle discards the
+  damaged tier and re-runs ``recompute()`` to rebuild the device tree —
+  the generalization of ``SpillableBuildTable``'s drop-and-rebuild,
+  counted in ``SpillMetrics.lineage_rebuilds``.  Without lineage the
+  same damage raises :class:`~spark_rapids_jni_tpu.faultinj.SpillCorruptionError`
+  loudly;
+* the post-write probe ``spill_corrupt_file`` (fault kind
+  ``"spill_corrupt"``) turns an injected fault into REAL byte flips in
+  the file just written, so the verify/rebuild path is proven against
+  actual on-disk damage by tools/chaos.py.
 """
 
 from __future__ import annotations
@@ -49,7 +67,8 @@ import shutil
 import tempfile
 import threading
 import time
-from typing import Dict, List, Optional
+import zlib
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -80,6 +99,33 @@ def _read_leaf(path: str) -> np.ndarray:
 _write_leaf = faultinj.instrument(_write_leaf, "spill_io_write")
 _read_leaf = faultinj.instrument(_read_leaf, "spill_io_read")
 
+# post-write corruption probe: fires AFTER a leaf lands on disk; the
+# handler converts the injected SpillCorruptionError into real byte flips
+# in that file (fault kind "spill_corrupt"), so verification is exercised
+# against genuine damage, not a synthetic exception
+_corrupt_probe = faultinj.instrument(lambda: None, "spill_corrupt_file")
+
+
+def _flip_file_bytes(path: str, n: int = 8) -> None:
+    """XOR the last ``n`` bytes of ``path`` — damages the npy DATA region
+    (the header sits at the front), leaving the file loadable but wrong,
+    the nastiest corruption shape: only a checksum catches it."""
+    size = os.path.getsize(path)
+    n = min(n, size)
+    if n <= 0:
+        return
+    with open(path, "r+b") as f:
+        f.seek(size - n)
+        tail = f.read(n)
+        f.seek(size - n)
+        f.write(bytes(b ^ 0xFF for b in tail))
+
+
+def _leaf_meta(arr: np.ndarray) -> Tuple[int, int]:
+    """(crc32, nbytes) of a host leaf, computed from the in-memory array
+    — the authoritative content — before it is entrusted to disk."""
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()), int(arr.nbytes)
+
 
 # ---------------------------------------------------------------------------
 # metrics
@@ -97,6 +143,8 @@ class SpillMetrics:
         "host_to_device_bytes", "host_to_device_count",  # device read-back
         "eviction_ns",
         "disk_write_failures",
+        "corrupt_reads",       # read-backs that failed verification/load
+        "lineage_rebuilds",    # recoveries via a handle's recompute= hook
     )
 
     def __init__(self):
@@ -127,6 +175,16 @@ class SpillMetrics:
         with self._lock:
             for b in self._bucket(task_id):
                 b["disk_write_failures"] += 1
+
+    def corrupt_read(self, task_id: Optional[int] = None):
+        with self._lock:
+            for b in self._bucket(task_id):
+                b["corrupt_reads"] += 1
+
+    def lineage_rebuilt(self, task_id: Optional[int] = None):
+        with self._lock:
+            for b in self._bucket(task_id):
+                b["lineage_rebuilds"] += 1
 
     def snapshot(self) -> Dict[str, int]:
         with self._lock:
@@ -166,13 +224,26 @@ class SpillableHandle:
     :class:`SpillFramework` the host tier is charged to the unified host
     arena and the disk tier is available.  Without either, it degrades to
     the legacy uncharged host round-trip.
+
+    ``recompute=`` attaches lineage: a zero-arg callable returning a
+    fresh device tree, deterministic and bit-identical to the original
+    (a map-shard re-run, a build-table re-build).  When the spilled copy
+    is lost or fails checksum verification, ``get()`` discards the
+    damaged tier and rebuilds through it instead of raising — Spark-style
+    lineage recomputation at the handle granularity, counted in
+    ``lineage_rebuilds``.  Without lineage the same damage raises
+    :class:`~spark_rapids_jni_tpu.faultinj.SpillCorruptionError`.
     """
 
-    def __init__(self, tree, ctx=None, name: Optional[str] = None):
+    def __init__(self, tree, ctx=None, name: Optional[str] = None,
+                 recompute=None):
         self._lock = threading.RLock()
         self._tree = tree
         self._host: Optional[List[np.ndarray]] = None
         self._disk: Optional[List[str]] = None
+        self._disk_meta: Optional[List[Tuple[int, int]]] = None
+        self._recompute = recompute
+        self.lineage_rebuilds = 0
         self._treedef = None
         self._leaf_index: Optional[List[int]] = None  # leaf -> host buffer
         self._shardings: Optional[List] = None        # per distinct buffer
@@ -185,12 +256,19 @@ class SpillableHandle:
         self._closed = False
         self._last_use = _next_use()
         self._fw = get_framework()
-        if ctx is not None:
+        self._lineage_nbytes = 0
+        if ctx is not None or recompute is not None:
             from .executor import batch_nbytes
 
-            # charge BEFORE registering: a RetryOOM here leaves no
-            # half-registered handle behind
-            self._device_charged = ctx.charge(batch_nbytes(tree))
+            nbytes = batch_nbytes(tree)
+            if recompute is not None:
+                # a deterministic recompute reproduces this exact tree, so
+                # its size now is the charge a future rebuild needs
+                self._lineage_nbytes = nbytes
+            if ctx is not None:
+                # charge BEFORE registering: a RetryOOM here leaves no
+                # half-registered handle behind
+                self._device_charged = ctx.charge(nbytes)
         if self._fw is not None:
             self._fw.store.register(self)
         if ctx is not None and hasattr(ctx, "_adopt"):
@@ -205,7 +283,11 @@ class SpillableHandle:
             return "device"
         if self._host is not None:
             return "host"
-        return "disk"
+        if self._disk is not None:
+            return "disk"
+        # no tier holds data: only lineage can bring it back (a dropped
+        # build table, or a rebuild interrupted by RetryOOM mid-charge)
+        return "dropped"
 
     @property
     def is_spilled(self) -> bool:
@@ -317,15 +399,29 @@ class SpillableHandle:
             self._lock.release()
 
     def _spill_host_locked(self) -> int:
+        from .. import config
+
         fw = self._fw
         if fw is None:
             return 0  # no framework: no disk tier
+        checksum = bool(config.get("spill_checksum"))
         paths: List[str] = []
+        meta: List[Tuple[int, int]] = []
         try:
             for i, arr in enumerate(self._host):
                 p = os.path.join(fw.spill_dir, f"{self.name}-{i}.npy")
+                # integrity metadata comes from the in-memory array, the
+                # authoritative content, BEFORE disk touches it
+                meta.append(_leaf_meta(arr) if checksum
+                            else (0, int(arr.nbytes)))
                 _write_leaf(p, arr)
                 paths.append(p)
+                try:
+                    _corrupt_probe()
+                except faultinj.SpillCorruptionError:
+                    # injected corruption becomes REAL damage in the file
+                    # just written; detection is read-back's job
+                    _flip_file_bytes(p)
         except (faultinj.SpillIOError, OSError):
             # graceful degradation: the batch STAYS in the host tier —
             # a broken spill disk must cost capacity, not data
@@ -336,6 +432,7 @@ class SpillableHandle:
             return 0
         nbytes = int(sum(a.nbytes for a in self._host))
         self._disk = paths
+        self._disk_meta = meta if checksum else None
         self._host = None
         freed = self._host_charged
         if self._host_charged:
@@ -350,6 +447,12 @@ class SpillableHandle:
         The device arena is charged BEFORE the upload; if the charge
         raises ``RetryOOM`` the handle stays fully accounted in its
         current tier and the retry ladder re-enters ``get()``.
+
+        A disk read-back that fails (checksum mismatch, truncation,
+        unreadable/missing file, injected ``spill_io``) routes through
+        the lineage path: with ``recompute=`` the damaged tier is
+        discarded and the tree rebuilt; without, it raises
+        ``SpillCorruptionError`` — damage is never silently computed on.
         """
         with self._lock:
             if self._closed:
@@ -357,14 +460,33 @@ class SpillableHandle:
             self._last_use = _next_use()
             if self._tree is not None:
                 return self._tree
+            fw = self._fw
+            if self._host is None and self._disk is None:
+                # "dropped": nothing resident anywhere — a prior rebuild
+                # was interrupted by RetryOOM mid-charge, or a subclass
+                # drops on spill.  Only lineage can proceed.
+                if self._recompute is None:
+                    raise ValueError(
+                        f"{self.name} holds no data and has no lineage")
+                return self._rebuild_locked()
             import jax
             import jax.numpy as jnp
 
-            fw = self._fw
             host = self._host
             from_disk = host is None
             if from_disk:
-                host = [_read_leaf(p) for p in self._disk]
+                try:
+                    host = self._read_disk_verified_locked()
+                except (faultinj.SpillCorruptionError, OSError,
+                        ValueError) as e:
+                    if fw is not None:
+                        fw.metrics.corrupt_read(self.task_id)
+                    if self._recompute is None:
+                        raise faultinj.SpillCorruptionError(
+                            f"{self.name}: spilled data lost or corrupt "
+                            f"and no recompute= lineage to rebuild from: "
+                            f"{e!r}") from e
+                    return self._rebuild_locked()
                 if fw is not None:
                     fw.metrics.record(
                         "disk_to_host", int(sum(a.nbytes for a in host)),
@@ -405,12 +527,64 @@ class SpillableHandle:
                 fw.metrics.record("host_to_device", nbytes, self.task_id)
             return tree
 
+    def _read_disk_verified_locked(self) -> List[np.ndarray]:
+        """Load the disk tier, verifying each leaf against its recorded
+        CRC32 + byte length when ``spill_checksum`` recorded them."""
+        host: List[np.ndarray] = []
+        meta = self._disk_meta or [None] * len(self._disk)
+        for p, m in zip(self._disk, meta):
+            arr = _read_leaf(p)
+            if m is not None:
+                crc, nbytes = m
+                got_crc, got_nbytes = _leaf_meta(arr)
+                if got_nbytes != nbytes or got_crc != crc:
+                    raise faultinj.SpillCorruptionError(
+                        f"checksum mismatch reading {p}: wrote "
+                        f"{nbytes}B crc={crc:#010x}, read "
+                        f"{got_nbytes}B crc={got_crc:#010x}")
+            host.append(arr)
+        return host
+
+    def _rebuild_locked(self):
+        """Lineage recovery: discard whatever tier was damaged/dropped
+        and re-run ``recompute()`` for a fresh device tree.
+
+        The device arena is charged BEFORE recomputing (the rebuild
+        produces a bit-identical tree, so the construction-time size is
+        the right charge); a ``RetryOOM`` from the charge leaves the
+        handle in the "dropped" state and the retry ladder re-enters
+        here.
+        """
+        self._remove_disk_files_locked()
+        self._host = None
+        self._treedef = None
+        self._leaf_index = None
+        self._shardings = None
+        if self._host_charged and self._fw is not None:
+            self._fw._uncharge_host(self._host_charged)
+        self._host_charged = 0
+        if self._ctx is not None:
+            self._device_charged = self._ctx.charge(self._lineage_nbytes)
+        try:
+            tree = self._recompute()
+        except BaseException:
+            if self._ctx is not None and self._device_charged:
+                self._ctx.release(self._device_charged)
+                self._device_charged = 0
+            raise
+        self._tree = tree
+        self.lineage_rebuilds += 1
+        if self._fw is not None:
+            self._fw.metrics.lineage_rebuilt(self.task_id)
+        return tree
+
     def _remove_disk_files_locked(self):
         if self._disk:
             for p in self._disk:
                 with contextlib.suppress(OSError):
                     os.remove(p)
         self._disk = None
+        self._disk_meta = None
 
     def close(self):
         """Release every charge, delete spill files, unregister."""

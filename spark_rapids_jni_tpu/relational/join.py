@@ -587,8 +587,9 @@ from ..mem.spill import SpillableHandle as _SpillableHandle  # noqa: E402
 class SpillableBuildTable(_SpillableHandle):
     """A :class:`~spark_rapids_jni_tpu.mem.spill.SpillableHandle` whose
     payload is recomputed rather than copied: ``spill()`` drops the device
-    tree and releases the charge (no host/disk tiers), ``get()``
-    re-charges and re-runs the stored builder.
+    tree and releases the charge (no host/disk tiers); read-back goes
+    through the base class's generalized ``recompute=`` lineage path,
+    which re-charges and re-runs the stored builder.
 
     ``builder`` returns ``(engine, tree)``; the engine tag of the most
     recent (re)build is exposed as ``self.engine`` so the probe side
@@ -598,21 +599,16 @@ class SpillableBuildTable(_SpillableHandle):
     def __init__(self, builder, ctx=None, name: Optional[str] = None):
         self._builder = builder
         super().__init__(self._build(), ctx=ctx,
-                         name=name or f"build-table-{id(self):x}")
-        from ..mem.executor import batch_nbytes
-
-        self._build_nbytes = batch_nbytes(self._tree)
-        self.rebuilds = 0
+                         name=name or f"build-table-{id(self):x}",
+                         recompute=self._build)
 
     def _build(self):
         self.engine, tree = self._builder()
         return tree
 
     @property
-    def tier(self) -> str:
-        if self._closed:
-            return "closed"
-        return "device" if self._tree is not None else "dropped"
+    def rebuilds(self) -> int:
+        return self.lineage_rebuilds
 
     def spill(self) -> int:
         if not self._lock.acquire(blocking=False):
@@ -634,26 +630,3 @@ class SpillableBuildTable(_SpillableHandle):
             self._lock.release()
 
     spill_host = spill  # no host tier to demote; keep the interface
-
-    def get(self):
-        with self._lock:
-            if self._closed:
-                raise ValueError(f"{self.name} is closed")
-            from ..mem.spill import _next_use
-
-            self._last_use = _next_use()
-            if self._tree is not None:
-                return self._tree
-            if self._ctx is not None:
-                # may raise RetryOOM: nothing was built yet, so the
-                # retried get() simply re-enters here
-                self._device_charged = self._ctx.charge(self._build_nbytes)
-            try:
-                self._tree = self._build()
-            except BaseException:
-                if self._ctx is not None and self._device_charged:
-                    self._ctx.release(self._device_charged)
-                    self._device_charged = 0
-                raise
-            self.rebuilds += 1
-            return self._tree

@@ -26,15 +26,27 @@ class PartitionBuffer:
     Degrades gracefully: with no spill framework installed the handle
     still round-trips device↔host on demand; with no ``TaskContext`` the
     arena is simply not charged (the PR-1 handle contract).
+
+    ``recompute=`` is the buffer's map lineage (see
+    :meth:`ShuffleService.exchange`): a deterministic re-run of the map
+    shards (or round drain) that produced this tree, invoked by the
+    handle when the spilled copy is lost or fails its checksum, so one
+    damaged partition costs a partial re-map instead of the shuffle.
     """
 
-    def __init__(self, tree, ctx=None, name: Optional[str] = None):
+    def __init__(self, tree, ctx=None, name: Optional[str] = None,
+                 recompute=None):
         self.nbytes = batch_nbytes(tree)
         # the creation charge is the retryable unit: under arena pressure
         # the default make_spillable evicts idle store handles and the
         # charge is retried — out-of-core, not OOM
         self._handle = run_with_retry(
-            lambda: SpillableHandle(tree, ctx=ctx, name=name))
+            lambda: SpillableHandle(tree, ctx=ctx, name=name,
+                                    recompute=recompute))
+
+    @property
+    def lineage_rebuilds(self) -> int:
+        return self._handle.lineage_rebuilds
 
     @property
     def tier(self) -> str:

@@ -31,6 +31,7 @@ class ShuffleInfo:
     spilled_bytes: int     # device->host + host->disk bytes during it
     skew_ratio: float      # max bucket / mean bucket from the plan
     oob_rows: int          # out-of-range pids routed to the null partition
+    recovered_partitions: int = 0  # buffers rebuilt via map lineage
 
 
 class ShuffleMetrics:
@@ -45,6 +46,7 @@ class ShuffleMetrics:
     FIELDS = (
         "shuffles", "rounds", "rows_moved", "bytes_moved",
         "spilled_bytes", "oob_rows", "dropped_rows", "io_failures",
+        "recovered_partitions",
     )
 
     def __init__(self):
@@ -69,6 +71,15 @@ class ShuffleMetrics:
     def record_io_failure(self):
         with self._lock:
             self._c["io_failures"] += 1
+
+    def record_recovered(self):
+        """One lost/corrupt partition buffer rebuilt from map lineage.
+
+        Recorded LIVE at recovery time (not summed from ShuffleInfo at
+        exchange completion) so a recovery is visible even when the
+        exchange later fails for an unrelated reason."""
+        with self._lock:
+            self._c["recovered_partitions"] += 1
 
     def snapshot(self) -> dict:
         with self._lock:

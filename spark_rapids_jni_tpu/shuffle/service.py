@@ -26,6 +26,16 @@ Fault injection: each round passes a ``shuffle_io`` probe
 (name ``shuffle_io_round``); an injected
 :class:`~spark_rapids_jni_tpu.faultinj.ShuffleIOError` is retried a
 bounded number of times (the data is still in the buffers) and counted.
+
+Lineage recovery: every :class:`PartitionBuffer` carries its map lineage
+as the handle's ``recompute=`` hook — the map buffer re-runs the map
+shards, a round chunk re-drives round ``r`` against the (recovered) map
+buffer.  A buffer whose spilled copy is lost or fails its checksum is
+therefore rebuilt by re-running ONLY the affected shards, not the whole
+shuffle; each rebuild counts in ``ShuffleMetrics.recovered_partitions``
+and draws on the per-exchange ``shuffle_max_recoveries`` budget
+(exhaustion raises :class:`ShuffleError` so a flapping disk cannot loop
+an exchange forever).
 """
 
 from __future__ import annotations
@@ -75,6 +85,7 @@ class ShuffleResult:
     spilled_bytes: int
     skew_ratio: float
     oob_rows: int
+    recovered_partitions: int = 0
 
 
 def _map_local(b: ColumnBatch, pid, P: int):
@@ -238,12 +249,12 @@ class ShuffleService:
         if key_names is not None:
             step = _map_step_keys(mesh, axis, tuple(key_names),
                                   row_valid is None)
-            out = (step(batch) if row_valid is None
-                   else step(batch, row_valid))
+            run_map = ((lambda: step(batch)) if row_valid is None
+                       else (lambda: step(batch, row_valid)))
         else:
             step = _map_step_pid(mesh, axis)
-            out = step(batch, pid)
-        regrouped, counts, oob = out
+            run_map = lambda: step(batch, pid)  # noqa: E731
+        regrouped, counts, oob = run_map()
         counts_np = np.asarray(jax.device_get(counts)).reshape(P, P)
         oob_total = int(np.asarray(jax.device_get(oob)).sum())
         if oob_total and strict:
@@ -254,10 +265,39 @@ class ShuffleService:
         # 2. plan: static (rounds, capacity) from the exact counts
         plan = plan_rounds(counts_np, round_rows=round_rows)
 
+        # lineage: each buffer's recompute= re-runs only the shards that
+        # produced it, metered against the per-exchange recovery budget
+        max_recoveries = int(config.get("shuffle_max_recoveries"))
+        recovered = [0]
+
+        def _lineage(rebuild, what):
+            def run():
+                if recovered[0] >= max_recoveries:
+                    raise ShuffleError(
+                        f"shuffle {sid}: {what} lost or corrupt and the "
+                        f"recovery budget is exhausted (max_recoveries="
+                        f"{max_recoveries}; see shuffle_max_recoveries)")
+                recovered[0] += 1
+                self.registry.metrics.record_recovered()
+                return rebuild()
+            return run
+
         # 3. drain: multi-round all_to_all over spillable buffers
-        map_buf = PartitionBuffer((regrouped, counts), ctx=ctx,
-                                  name=f"shuffle{sid}-map")
+        map_buf = PartitionBuffer(
+            (regrouped, counts), ctx=ctx, name=f"shuffle{sid}-map",
+            recompute=_lineage(lambda: run_map()[:2], "map output"))
         drain = _drain_step(mesh, axis, plan.capacity)
+
+        def _redrive(rr):
+            # round rr's partitions depend only on the map buffer and
+            # the static plan: rebuilding them re-runs ONE drain round
+            # (which may itself recover the map buffer first)
+            def rebuild():
+                tree, cnts = map_buf.get()
+                out_r, occ_r, _, _ = drain(tree, cnts, jnp.int32(rr))
+                return out_r, occ_r
+            return rebuild
+
         chunks = []
         received = 0
         bytes_moved = 0
@@ -266,8 +306,9 @@ class ShuffleService:
             for r in range(plan.rounds):
                 out, occ, got_n, residual = self._run_round(
                     drain, map_buf, r)
-                chunk = PartitionBuffer((out, occ), ctx=ctx,
-                                        name=f"shuffle{sid}-round{r}")
+                chunk = PartitionBuffer(
+                    (out, occ), ctx=ctx, name=f"shuffle{sid}-round{r}",
+                    recompute=_lineage(_redrive(r), f"round {r} chunk"))
                 chunks.append(chunk)
                 received += got_n
                 bytes_moved += chunk.nbytes
@@ -299,13 +340,14 @@ class ShuffleService:
             shuffle_id=sid, rounds=plan.rounds, capacity=plan.capacity,
             rows_moved=received, bytes_moved=bytes_moved,
             spilled_bytes=spilled, skew_ratio=plan.skew_ratio,
-            oob_rows=oob_total)
+            oob_rows=oob_total, recovered_partitions=recovered[0])
         self.registry.record(info)
         return ShuffleResult(
             batch=final_batch, occupancy=final_occ, shuffle_id=sid,
             rounds=plan.rounds, capacity=plan.capacity, rows_moved=received,
             bytes_moved=bytes_moved, spilled_bytes=spilled,
-            skew_ratio=plan.skew_ratio, oob_rows=oob_total)
+            skew_ratio=plan.skew_ratio, oob_rows=oob_total,
+            recovered_partitions=recovered[0])
 
     def plan(self, counts, round_rows: Optional[int] = None) -> RoundPlan:
         """Expose the planner on the service for callers that fetched

@@ -1,0 +1,291 @@
+"""Fault-domain hardening tests: integrity-checked spill, lineage
+recovery, and the chaos campaign (tools/chaos.py) tier-1 subset.
+
+The chaos campaign itself (the premerge gate, ci/chaos.sh) is the
+exhaustive sweep; here we run its ``--fast`` subset plus targeted unit
+tests for each new mechanism — checksum round-trip and verification,
+``spill_corrupt`` → lineage rebuild, partition loss → partial re-map in
+the ShuffleService — and a ``slow``-marked multi-fault soak.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu import config, faultinj
+from spark_rapids_jni_tpu.columnar import types as T
+from spark_rapids_jni_tpu.columnar.column import Column, ColumnBatch
+from spark_rapids_jni_tpu.mem import RmmSpark, SpillableHandle, TaskContext
+from spark_rapids_jni_tpu.mem import spill as spill_mod
+
+MB = 1 << 20
+KB = 1 << 10
+
+
+@pytest.fixture
+def framework(tmp_path):
+    fw = spill_mod.install(spill_dir=str(tmp_path / "spill"))
+    yield fw
+    spill_mod.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faultinj.configure({})
+
+
+def _tree(seed=0, n=2048):
+    return {"x": jnp.asarray(
+        np.random.default_rng(seed).integers(0, 1 << 20, n,
+                                             dtype=np.int64))}
+
+
+def _to_disk(h):
+    h.spill()
+    h.spill_host()
+    assert h.tier == "disk"
+
+
+# -- checksum integrity ----------------------------------------------------
+
+
+class TestSpillChecksum:
+    def test_round_trip_verifies_clean(self, framework):
+        src = _tree(1)
+        h = SpillableHandle(src, name="crc-clean")
+        _to_disk(h)
+        assert h._disk_meta is not None  # checksums recorded at write
+        out = h.get()
+        np.testing.assert_array_equal(np.asarray(out["x"]),
+                                      np.asarray(src["x"]))
+        assert framework.metrics.snapshot()["corrupt_reads"] == 0
+        h.close()
+
+    def test_corrupt_file_detected(self, framework):
+        h = SpillableHandle(_tree(2), name="crc-bad")
+        _to_disk(h)
+        spill_mod._flip_file_bytes(h._disk[0])
+        with pytest.raises(spill_mod.faultinj.SpillCorruptionError,
+                           match="no recompute"):
+            h.get()
+        assert framework.metrics.snapshot()["corrupt_reads"] == 1
+        h.close()
+
+    def test_truncated_file_detected(self, framework):
+        # byte-length check catches truncation even when crc of the
+        # prefix could never match anyway
+        h = SpillableHandle(_tree(3), name="crc-short")
+        _to_disk(h)
+        with open(h._disk[0], "r+b") as f:
+            f.truncate(os.path.getsize(h._disk[0]) - 64)
+        with pytest.raises((spill_mod.faultinj.SpillCorruptionError,
+                            ValueError, OSError)):
+            h.get()
+        h.close()
+
+    def test_knob_off_skips_verification(self, framework):
+        old = config.get("spill_checksum")
+        config.set("spill_checksum", False)
+        try:
+            h = SpillableHandle(_tree(4), name="crc-off")
+            _to_disk(h)
+            assert h._disk_meta is None  # nothing recorded, nothing checked
+            assert np.asarray(h.get()["x"]).shape == (2048,)
+            h.close()
+        finally:
+            config.set("spill_checksum", old)
+
+
+# -- lineage rebuild -------------------------------------------------------
+
+
+class TestLineageRebuild:
+    def test_corrupt_spill_rebuilds_via_recompute(self, framework):
+        src = _tree(5)
+        h = SpillableHandle(src, name="lin-crc",
+                            recompute=lambda: {"x": jnp.asarray(
+                                np.asarray(src["x"]))})
+        _to_disk(h)
+        spill_mod._flip_file_bytes(h._disk[0])
+        out = h.get()  # checksum mismatch -> drop -> recompute
+        np.testing.assert_array_equal(np.asarray(out["x"]),
+                                      np.asarray(src["x"]))
+        assert h.lineage_rebuilds == 1
+        snap = framework.metrics.snapshot()
+        assert snap["corrupt_reads"] == 1
+        assert snap["lineage_rebuilds"] == 1
+        h.close()
+
+    def test_missing_file_rebuilds_via_recompute(self, framework):
+        src = _tree(6)
+        h = SpillableHandle(src, name="lin-lost",
+                            recompute=lambda: dict(src))
+        _to_disk(h)
+        os.remove(h._disk[0])
+        np.testing.assert_array_equal(np.asarray(h.get()["x"]),
+                                      np.asarray(src["x"]))
+        assert h.lineage_rebuilds == 1
+        h.close()
+
+    def test_injected_spill_corrupt_fault(self, framework):
+        # the chaos kind end-to-end: the probe flips real bytes in the
+        # just-written file, read-back detects and rebuilds
+        src = _tree(7)
+        h = SpillableHandle(src, name="lin-inj",
+                            recompute=lambda: dict(src))
+        with faultinj.scope({"faults": [{"match": "spill_corrupt_file",
+                                         "fault": "spill_corrupt",
+                                         "count": 1}]}):
+            _to_disk(h)
+            out = h.get()
+            assert faultinj.fire_counts() == {"spill_corrupt_file": 1}
+        np.testing.assert_array_equal(np.asarray(out["x"]),
+                                      np.asarray(src["x"]))
+        assert h.lineage_rebuilds == 1
+        h.close()
+
+    def test_dropped_handle_without_lineage_raises(self, framework):
+        h = SpillableHandle(_tree(8), name="lin-none")
+        _to_disk(h)
+        spill_mod._flip_file_bytes(h._disk[0])
+        with pytest.raises(spill_mod.faultinj.SpillCorruptionError):
+            h.get()
+        h.close()
+
+
+# -- ShuffleService partition recovery -------------------------------------
+
+
+def _exchange(mesh, batch, pid, reg, ctx=None):
+    from spark_rapids_jni_tpu.shuffle import ShuffleService
+
+    return ShuffleService(mesh, registry=reg).exchange(
+        batch, pid=pid, ctx=ctx, round_rows=128)
+
+
+def _delivered(res):
+    return (np.asarray(jax.device_get(res.batch["v"].data)),
+            np.asarray(jax.device_get(res.occupancy)))
+
+
+class TestShufflePartitionRecovery:
+    def _setup(self, eight_devices):
+        from spark_rapids_jni_tpu.parallel import data_mesh, shard_batch
+
+        # all-to-one skew, 32 rounds of chunks: the accumulated round
+        # buffers overrun the 512KB/128KB arenas and demote to disk,
+        # putting spilled partitions in the corruption probe's path
+        P = 8
+        n = P * 1024
+        vals = (np.arange(n, dtype=np.int64) * 977) % (1 << 30)
+        mesh = data_mesh(P)
+        batch = shard_batch(ColumnBatch({
+            "v": Column(jnp.asarray(vals), jnp.ones((n,), jnp.bool_),
+                        T.INT64)}), mesh)
+        pid = jax.device_put(
+            jnp.zeros((n,), jnp.int32),
+            jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec("data")))
+        return mesh, batch, pid
+
+    def test_lost_partition_recovers_with_partial_remap(
+            self, framework, eight_devices):
+        from spark_rapids_jni_tpu.shuffle import ShuffleRegistry
+
+        mesh, batch, pid = self._setup(eight_devices)
+        old = config.get("shuffle_capacity_bucket")
+        config.set("shuffle_capacity_bucket", 256)
+        adaptor = RmmSpark.set_event_handler(
+            512 * KB, host_pool_bytes=128 * KB, poll_ms=10.0)
+        try:
+            # clean run for the parity oracle
+            clean_reg = ShuffleRegistry()
+            with TaskContext(31) as ctx:
+                vals_c, occ_c = _delivered(
+                    _exchange(mesh, batch, pid, clean_reg, ctx))
+            RmmSpark.task_done(31)
+
+            # faulted run: tight arenas force buffers to disk; every
+            # disk write is corrupted twice over -> lineage re-map
+            reg = ShuffleRegistry()
+            with faultinj.scope({"faults": [{"match": "spill_corrupt_file",
+                                             "fault": "spill_corrupt",
+                                             "count": 2}]}):
+                with TaskContext(32) as ctx:
+                    res = _exchange(mesh, batch, pid, reg, ctx)
+                    vals_f, occ_f = _delivered(res)
+            RmmSpark.task_done(32)
+
+            assert res.recovered_partitions > 0
+            snap = reg.metrics.snapshot()
+            assert snap["recovered_partitions"] == res.recovered_partitions
+            info = reg.shuffles()[res.shuffle_id]
+            assert info.recovered_partitions == res.recovered_partitions
+            # parity: recovery is invisible in the delivered rows
+            np.testing.assert_array_equal(occ_f, occ_c)
+            np.testing.assert_array_equal(vals_f, vals_c)
+            assert adaptor.total_allocated() == 0
+        finally:
+            RmmSpark.clear_event_handler()
+            config.set("shuffle_capacity_bucket", old)
+
+    def test_recovery_budget_exhaustion_raises(
+            self, framework, eight_devices):
+        from spark_rapids_jni_tpu.shuffle import ShuffleError, ShuffleRegistry
+
+        mesh, batch, pid = self._setup(eight_devices)
+        old_bucket = config.get("shuffle_capacity_bucket")
+        old_budget = config.get("shuffle_max_recoveries")
+        config.set("shuffle_capacity_bucket", 256)
+        config.set("shuffle_max_recoveries", 0)
+        adaptor = RmmSpark.set_event_handler(
+            512 * KB, host_pool_bytes=128 * KB, poll_ms=10.0)
+        try:
+            reg = ShuffleRegistry()
+            with faultinj.scope({"faults": [{"match": "spill_corrupt_file",
+                                             "fault": "spill_corrupt",
+                                             "count": 1}]}):
+                with TaskContext(33) as ctx:
+                    with pytest.raises(ShuffleError,
+                                       match="recovery budget"):
+                        _exchange(mesh, batch, pid, reg, ctx)
+            RmmSpark.task_done(33)
+        finally:
+            RmmSpark.clear_event_handler()
+            config.set("shuffle_capacity_bucket", old_bucket)
+            config.set("shuffle_max_recoveries", old_budget)
+
+
+# -- the campaign ----------------------------------------------------------
+
+
+class TestChaosCampaign:
+    def test_fast_campaign_green(self, eight_devices):
+        from tools.chaos import run_campaign
+
+        report = run_campaign(fast=True, seed=0)
+        failures = [f"{f.get('label')}: {f.get('error')}"
+                    for f in report["failures"]]
+        assert report["ok"], failures
+        # the fast subset still proves the distinctive recovery kinds
+        for kind in ("spill_io", "spill_corrupt", "shuffle_io"):
+            assert kind in report["kinds_fired"]
+        # and every trial actually injected something
+        assert all(t["fired"] for t in report["trials"])
+
+    @pytest.mark.slow
+    def test_full_campaign_soak(self, eight_devices):
+        # full matrix at a different seed than CI's: seeded multi-fault
+        # schedules must hold for ANY seed, not just the gate's
+        from tools.chaos import run_campaign
+
+        report = run_campaign(fast=False, seed=1, trials=6)
+        failures = [f"{f.get('label')}: {f.get('error')}"
+                    for f in report["failures"]]
+        assert report["ok"], failures
+        assert set(report["kinds_fired"]) == set(faultinj.FAULT_KINDS)

@@ -80,3 +80,141 @@ def test_no_config_is_noop():
     faultinj.configure({})
     f = faultinj.instrument(lambda: "ok")
     assert f() == "ok"
+
+
+# -- PR: fault-domain hardening -------------------------------------------
+
+
+def test_skip_is_deterministic():
+    # skip=2 consumes exactly the first two matching occurrences, then
+    # count=1 fires on the third — no probability draw involved
+    faultinj.configure({"faults": [{"match": "s", "skip": 2, "count": 1,
+                                    "fault": "exception"}]})
+    f = faultinj.instrument(lambda: 1, "s")
+    assert f() == 1
+    assert f() == 1
+    with pytest.raises(faultinj.InjectedFault):
+        f()
+    assert f() == 1  # count exhausted
+
+
+def test_skip_consumed_before_probability():
+    # even with probability=1.0 the skipped occurrences never fire: skip
+    # is an occurrence-clock decrement, not a failed draw
+    faultinj.configure({"faults": [{"match": "s", "skip": 1,
+                                    "probability": 1.0, "count": 1,
+                                    "fault": "exception"}]})
+    f = faultinj.instrument(lambda: 1, "s")
+    assert f() == 1
+    with pytest.raises(faultinj.InjectedFault):
+        f()
+
+
+def test_negative_skip_rejected():
+    with pytest.raises(ValueError):
+        faultinj.configure({"faults": [{"match": "*", "skip": -1,
+                                        "fault": "exception"}]})
+
+
+def test_check_and_fire_counters():
+    faultinj.configure({"faults": [{"match": "a", "count": 1,
+                                    "fault": "exception"}]})
+    a = faultinj.instrument(lambda: 1, "a")
+    b = faultinj.instrument(lambda: 1, "b")
+    with pytest.raises(faultinj.InjectedFault):
+        a()
+    a()
+    b()
+    assert faultinj.check_counts() == {"a": 2, "b": 1}
+    assert faultinj.fire_counts() == {"a": 1}
+
+
+def test_fired_log_records_replay_info():
+    faultinj.configure({"faults": [{"match": "x", "skip": 1, "count": 1,
+                                    "fault": "oom"}]})
+    f = faultinj.instrument(lambda: 1, "x")
+    f()
+    with pytest.raises(RetryOOM):
+        f()
+    log = faultinj.fired_log()
+    assert len(log) == 1
+    entry = log[0]
+    assert entry["name"] == "x"
+    assert entry["fault"] == "oom"
+    assert entry["match"] == "x"
+    assert entry["occurrence"] == 2  # the second crossing fired
+    assert entry["seq"] == 1
+
+
+def test_configure_resets_stats():
+    faultinj.configure({"faults": [{"match": "*", "count": 1,
+                                    "fault": "exception"}]})
+    f = faultinj.instrument(lambda: 1, "z")
+    with pytest.raises(faultinj.InjectedFault):
+        f()
+    faultinj.configure({"faults": []})
+    assert faultinj.check_counts() == {}
+    assert faultinj.fire_counts() == {}
+    assert faultinj.fired_log() == []
+
+
+def test_scope_restores_schedule_and_keeps_stats():
+    faultinj.configure({"faults": []})
+    f = faultinj.instrument(lambda: 1, "sc")
+    with faultinj.scope({"faults": [{"match": "sc", "count": 1,
+                                     "fault": "exception"}]}):
+        with pytest.raises(faultinj.InjectedFault):
+            f()
+        fired_inside = faultinj.fire_counts()
+    # schedule restored: no more injection...
+    assert f() == 1
+    # ...but the trace from inside the scope survives for post-mortems
+    assert fired_inside == {"sc": 1}
+    assert faultinj.fire_counts() == {"sc": 1}
+    assert [e["name"] for e in faultinj.fired_log()] == ["sc"]
+
+
+def test_scope_restores_on_exception():
+    f = faultinj.instrument(lambda: 1, "se")
+    with pytest.raises(RuntimeError, match="user error"):
+        with faultinj.scope({"faults": [{"match": "*",
+                                         "fault": "exception"}]}):
+            raise RuntimeError("user error")
+    assert f() == 1
+
+
+def test_concurrent_configure_and_check_is_safe():
+    # regression for the _maybe_reload race: dynamic reload state used to
+    # be readable mid-configure; hammer both paths from threads
+    import threading
+
+    f = faultinj.instrument(lambda: 1, "race")
+    stop = threading.Event()
+    errors = []
+
+    def reconfigure():
+        while not stop.is_set():
+            faultinj.configure({"dynamic": False, "faults": [
+                {"match": "race", "probability": 0.0,
+                 "fault": "exception"}]})
+
+    def call():
+        while not stop.is_set():
+            try:
+                f()
+            except faultinj.InjectedFault:
+                pass
+            except Exception as e:  # noqa: BLE001 - the race would land here
+                errors.append(e)
+
+    threads = [threading.Thread(target=reconfigure),
+               threading.Thread(target=call), threading.Thread(target=call)]
+    for t in threads:
+        t.start()
+    import time
+
+    time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert errors == []

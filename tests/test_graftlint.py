@@ -463,6 +463,88 @@ class TestGL007:
 
 
 # ---------------------------------------------------------------------------
+# GL008 — file/stream handles opened inside jitted scope
+# ---------------------------------------------------------------------------
+
+
+class TestGL008:
+    def test_open_and_bytesio_under_jit_flagged(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import io
+            import jax
+
+            @jax.jit
+            def bad(x):
+                f = open("/tmp/dump.bin", "wb")
+                f.write(b"...")
+                return x + 1
+
+            @jax.jit
+            def also_bad(x):
+                buf = io.BytesIO()
+                return x * 2
+        """}, rules=["GL008"])
+        assert new_rules(res) == [("GL008", "mod.py"), ("GL008", "mod.py")]
+        assert "trace time" in res.new[0].message
+
+    def test_wrap_site_jit_and_tempfile_flagged(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import tempfile
+            import jax
+
+            def _impl(x):
+                tmp = tempfile.NamedTemporaryFile()
+                return x + 1
+
+            fast = jax.jit(_impl)
+        """}, rules=["GL008"])
+        assert new_rules(res) == [("GL008", "mod.py")]
+        assert "tempfile.NamedTemporaryFile" in res.new[0].message
+
+    def test_io_outside_jit_clean(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import io
+            import jax
+
+            @jax.jit
+            def compute(x):
+                return x + 1
+
+            def load(path):
+                # host-side I/O around the traced computation: the
+                # spill-framework idiom, not a hazard
+                with open(path, "rb") as f:
+                    raw = f.read()
+                buf = io.BytesIO(raw)
+                return compute(len(raw))
+        """}, rules=["GL008"])
+        assert res.new == []
+
+    def test_shadowed_open_clean(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import jax
+            from mystore import open  # not the builtin: device-side reader
+
+            @jax.jit
+            def ok(x):
+                h = open(x)
+                return h + 1
+        """}, rules=["GL008"])
+        assert res.new == []
+
+    def test_suppressed(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import jax
+
+            @jax.jit
+            def pinned(x):
+                f = open("/dev/null")  # graftlint: disable=GL008
+                return x
+        """}, rules=["GL008"])
+        assert res.new == [] and res.counts()["suppressed"] == 1
+
+
+# ---------------------------------------------------------------------------
 # baseline ratchet
 # ---------------------------------------------------------------------------
 
@@ -577,4 +659,4 @@ class TestLiveTree:
         from tools.graftlint import rules as rules_mod
         ids = [r.id for r in rules_mod.all_rules()]
         assert ids == ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
-                       "GL007"]
+                       "GL007", "GL008"]

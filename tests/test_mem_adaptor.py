@@ -834,3 +834,78 @@ class TestUnifiedArenaDeadlock:
             RmmSpark.task_done(3)
         finally:
             RmmSpark.clear_event_handler()
+
+
+class TestRetryLadderInnerOOM:
+    """run_with_retry: a RetryOOM raised from block_thread_until_ready()
+    itself (a peer freed memory and the adaptor converts the park into an
+    immediate retry) must loop back through make_spillable, not abort the
+    ladder (the pre-hardening bug: the inner raise propagated out)."""
+
+    def test_inner_retryoom_reruns_make_spillable(self, monkeypatch):
+        from spark_rapids_jni_tpu.mem import run_with_retry
+
+        spills = []
+        attempts = []
+
+        def step():
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise RetryOOM("pressure")
+            return "done"
+
+        def make_spillable():
+            spills.append(1)
+            return 0  # nothing freed: the ladder must park
+
+        blocks = []
+
+        def fake_block(*a, **k):
+            blocks.append(1)
+            if len(blocks) == 1:
+                # the adaptor's park can itself surface RetryOOM; the
+                # ladder must treat it as "try to free again", not a crash
+                raise RetryOOM("woken for retry")
+
+        monkeypatch.setattr(RmmSpark, "block_thread_until_ready",
+                            staticmethod(fake_block))
+        assert run_with_retry(step, make_spillable=make_spillable) == "done"
+        # first step OOM -> spill (0) -> park raises -> spill again (0)
+        # -> park ok -> second step OOM -> spill -> park ok -> third step
+        assert len(spills) >= 3
+        assert len(blocks) >= 2
+
+    def test_inner_split_still_honored(self, monkeypatch):
+        from spark_rapids_jni_tpu.mem import run_with_retry
+
+        attempts = []
+        splits = []
+
+        def step():
+            attempts.append(1)
+            if len(attempts) == 1:
+                raise RetryOOM("pressure")
+            return len(attempts)
+
+        def fake_block(*a, **k):
+            raise SplitAndRetryOOM("split instead")
+
+        monkeypatch.setattr(RmmSpark, "block_thread_until_ready",
+                            staticmethod(fake_block))
+        assert run_with_retry(step, make_spillable=lambda: 0,
+                              split=lambda: splits.append(1)) == 2
+        assert splits == [1]
+
+    def test_inner_retryoom_bounded(self, monkeypatch):
+        from spark_rapids_jni_tpu.mem import run_with_retry
+
+        def step():
+            raise RetryOOM("always")
+
+        def fake_block(*a, **k):
+            raise RetryOOM("always woken")
+
+        monkeypatch.setattr(RmmSpark, "block_thread_until_ready",
+                            staticmethod(fake_block))
+        with pytest.raises(RetryOOM):
+            run_with_retry(step, make_spillable=lambda: 0, max_retries=3)

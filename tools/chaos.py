@@ -1,0 +1,513 @@
+"""Deterministic chaos campaign: every fault kind, every boundary, zero drift.
+
+The premerge gate (ci/chaos.sh) that proves the fault-domain story
+end-to-end, the way ci/q95_floor.json proves perf: it sweeps every
+registered ``faultinj.FAULT_KINDS`` entry across every instrumented
+boundary of three scenarios — a spill walk (device→host→disk→back), an
+out-of-core skewed shuffle, and the single-chip q95 pipeline — one fault
+per trial exhaustively, plus ``chaos_trials`` seeded multi-fault trials
+per scenario.  Every trial must end with
+
+* a result **bit-identical** to the scenario's fault-free baseline
+  (sha256 over every output leaf's dtype/shape/bytes), and
+* clean post-run invariants: device and host arena totals zero, spill
+  store empty, spill directory empty, attempt counts within the
+  replacement bound.
+
+Fault schedules are deterministic by construction: rules pin their
+firing to an exact boundary crossing via ``skip``/``count`` (the
+injector's per-name occurrence clock), multi-fault trials derive from
+``--seed``, and every injection lands in ``faultinj.fired_log()`` — a
+failing trial prints the log, and replaying it needs nothing but the
+(name, occurrence) pairs it contains.
+
+Fault handling per kind mirrors production roles: ``spill_io`` /
+``spill_corrupt`` / ``shuffle_io`` / ``oom`` recover INSIDE the run
+(degradation, checksum+lineage rebuild, round re-drive, retry ladder);
+``exception`` / ``fatal`` abort the attempt and the campaign re-runs the
+scenario from scratch — the "replacement executor", whose teardown the
+harness guarantees via the same close/shutdown path every attempt.
+
+Usage::
+
+    python -m tools.chaos [--fast] [--seed N] [--trials N] [--report F]
+"""
+
+import os
+import sys
+
+# the shuffle scenario needs an 8-device mesh; both flags must be set
+# BEFORE jax initializes (same contract as tests/conftest.py)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+if __package__ in (None, ""):
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+import argparse
+import contextlib
+import dataclasses
+import hashlib
+import json
+import random
+import shutil
+import tempfile
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+import jax
+
+if os.environ.get("BENCH_FORCE_CPU"):
+    # tools/_bootstrap.py convention: env JAX_PLATFORMS can be too late
+    # (a sitecustomize may import jax first); config.update is not
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu import config, faultinj
+from spark_rapids_jni_tpu.columnar import types as T
+from spark_rapids_jni_tpu.columnar.column import Column, ColumnBatch
+from spark_rapids_jni_tpu.mem import spill as spill_mod
+from spark_rapids_jni_tpu.mem.executor import TaskContext, run_with_retry
+from spark_rapids_jni_tpu.mem.rmm_spark import RmmSpark
+
+KB = 1 << 10
+MB = 1 << 20
+
+# bounded replacement: an aborting fault (exception/fatal) costs one
+# attempt; rules carry finite counts, so this bound only trips when a
+# recovery path is genuinely broken
+_MAX_ATTEMPTS = 8
+
+
+class ChaosError(AssertionError):
+    """A trial violated the campaign contract (drift, residue, or a
+    boundary that never fired)."""
+
+
+# the scenario-level probes: one per scenario, crossed at its step
+# boundaries so exception/oom/fatal kinds have a deterministic seam
+_spill_probe = faultinj.instrument(lambda: None, "chaos_spill_step")
+_shuffle_probe = faultinj.instrument(lambda: None, "chaos_shuffle_step")
+_q95_probe = faultinj.instrument(lambda: None, "chaos_q95_step")
+
+
+def _digest(tree) -> str:
+    """sha256 over every leaf's dtype/shape/bytes — bit-identity, not
+    approximate equality, is the campaign's bar."""
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(tree):
+        a = np.asarray(jax.device_get(leaf))
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()
+
+
+@contextlib.contextmanager
+def _harness(device_bytes: int, host_bytes: int, tag: str):
+    """Fresh framework + arenas per attempt; teardown is unconditional
+    (the replacement-executor guarantee), invariants are checked only on
+    the success path by the caller via :func:`_check_invariants`."""
+    spill_dir = tempfile.mkdtemp(prefix=f"sptpu_chaos_{tag}_")
+    fw = spill_mod.install(spill_dir=spill_dir)
+    adaptor = RmmSpark.set_event_handler(device_bytes,
+                                         host_pool_bytes=host_bytes,
+                                         poll_ms=10.0)
+    try:
+        yield fw, adaptor
+    finally:
+        RmmSpark.clear_event_handler()
+        spill_mod.shutdown()
+        shutil.rmtree(spill_dir, ignore_errors=True)
+
+
+def _check_invariants(fw, adaptor):
+    """Post-run residue check: a recovered run must look like a run in
+    which nothing ever went wrong."""
+    problems = []
+    if adaptor.total_allocated() != 0:
+        problems.append(
+            f"device arena not drained: {adaptor.total_allocated()}B")
+    if adaptor.host_total_allocated() != 0:
+        problems.append(
+            f"host arena not drained: {adaptor.host_total_allocated()}B")
+    if len(fw.store) != 0:
+        problems.append(
+            f"{len(fw.store)} orphaned handle(s) left in the spill store")
+    leftovers = os.listdir(fw.spill_dir)
+    if leftovers:
+        problems.append(f"spill dir not empty: {sorted(leftovers)[:4]}")
+    if problems:
+        raise ChaosError("post-run invariants violated: "
+                         + "; ".join(problems))
+
+
+def _always_retry(fw):
+    """Outer-body make_spillable for scenario steps: evict what can be
+    evicted and report truthy so an injected RetryOOM retries
+    immediately instead of parking (the chaos driver is single-threaded;
+    there is no peer whose deallocation would wake a parked thread)."""
+    return lambda: (fw.spill_to_fit() or 0) + 1
+
+
+# ---------------------------------------------------------------------------
+# scenarios
+# ---------------------------------------------------------------------------
+
+class SpillScenario:
+    """Two lineage-backed handles walked device→host→disk and read back:
+    crosses spill_io_write / spill_corrupt_file on the way down and
+    spill_io_read (plus checksum verification) on the way up."""
+
+    name = "spill"
+    task_id = 201
+
+    def run(self) -> Dict:
+        srcs = [np.arange(16 * KB, dtype=np.int64) * (i + 3)
+                for i in range(2)]  # 128 KB each
+        with _harness(2 * MB, 512 * KB, self.name) as (fw, adaptor):
+            with TaskContext(self.task_id) as ctx:
+                def body():
+                    _spill_probe()
+                    handles = []
+                    try:
+                        for i, s in enumerate(srcs):
+                            def mk(s=s):
+                                return {"x": jnp.asarray(s)}
+                            handles.append(spill_mod.SpillableHandle(
+                                mk(), ctx=ctx, name=f"chaos-spill-{i}",
+                                recompute=mk))
+                        for h in handles:
+                            h.spill()
+                            h.spill_host()  # → disk: write + corrupt probes
+                        _spill_probe()
+                        out = [np.asarray(h.get()["x"]).copy()
+                               for h in handles]  # read-back + verify
+                        _spill_probe()
+                        return _digest(out)
+                    finally:
+                        for h in handles:
+                            h.close()
+                digest = run_with_retry(body,
+                                        make_spillable=_always_retry(fw))
+            RmmSpark.task_done(self.task_id)
+            _check_invariants(fw, adaptor)
+        return {"digest": digest, "extra": {}}
+
+
+class ShuffleScenario:
+    """All-to-one skewed multi-round exchange under arenas tight enough
+    that partition buffers demote all the way to disk: crosses
+    shuffle_io_round every round and the whole spill boundary set for
+    the buffers — a corrupted/lost buffer recovers via map lineage
+    (ShuffleMetrics.recovered_partitions)."""
+
+    name = "shuffle"
+    task_id = 202
+
+    def run(self) -> Dict:
+        from spark_rapids_jni_tpu.parallel import data_mesh, shard_batch
+        from spark_rapids_jni_tpu.shuffle import (
+            ShuffleRegistry,
+            ShuffleService,
+        )
+
+        if len(jax.devices()) < 8:
+            raise ChaosError(
+                "shuffle scenario needs 8 devices; set XLA_FLAGS="
+                "--xla_force_host_platform_device_count=8 before jax init")
+        P = 8
+        n = P * 1024
+        vals = (np.arange(n, dtype=np.int64) * 2654435761) % (1 << 40)
+        mesh = data_mesh(P)
+        batch = shard_batch(ColumnBatch({
+            "v": Column(jnp.asarray(vals), jnp.ones((n,), jnp.bool_),
+                        T.INT64)}), mesh)
+        pid = jax.device_put(
+            jnp.zeros((n,), jnp.int32),  # all-to-one: forces multi-round
+            jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec("data")))
+        old_bucket = config.get("shuffle_capacity_bucket")
+        config.set("shuffle_capacity_bucket", 256)
+        try:
+            with _harness(512 * KB, 128 * KB, self.name) as (fw, adaptor):
+                reg = ShuffleRegistry()
+                with TaskContext(self.task_id) as ctx:
+                    def body():
+                        _shuffle_probe()
+                        res = ShuffleService(mesh, registry=reg).exchange(
+                            batch, pid=pid, ctx=ctx, round_rows=128)
+                        return _digest((res.batch, res.occupancy))
+                    digest = run_with_retry(
+                        body, make_spillable=_always_retry(fw))
+                RmmSpark.task_done(self.task_id)
+                _check_invariants(fw, adaptor)
+        finally:
+            config.set("shuffle_capacity_bucket", old_bucket)
+        snap = reg.metrics.snapshot()
+        return {"digest": digest,
+                "extra": {"recovered_partitions":
+                          snap["recovered_partitions"],
+                          "io_failures": snap["io_failures"],
+                          "rounds": snap["rounds"]}}
+
+
+class Q95Scenario:
+    """The single-chip q95 pipeline (exchange → join → exchange → join →
+    group-by): the compute-shaped scenario, proving injected faults at a
+    query step boundary replay to bit-identical aggregates."""
+
+    name = "q95"
+
+    def run(self) -> Dict:
+        import __graft_entry__ as ge
+
+        fact, dim1, dim2 = ge._q95_batches(4096, seed=19)
+        with _harness(16 * MB, 4 * MB, self.name) as (fw, adaptor):
+            def body():
+                _q95_probe()
+                res, ng = ge._q95_step(fact, dim1, dim2)
+                _q95_probe()  # post-compute seam: skip=1 rules land here
+                return _digest((res, ng))
+            digest = run_with_retry(body, make_spillable=_always_retry(fw))
+            _check_invariants(fw, adaptor)
+        return {"digest": digest, "extra": {}}
+
+
+SCENARIOS = {s.name: s for s in (SpillScenario(), ShuffleScenario(),
+                                 Q95Scenario())}
+
+
+# ---------------------------------------------------------------------------
+# the trial matrix
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Trial:
+    scenario: str
+    rules: List[dict]
+    label: str
+    # shuffle trials that damage a spilled partition must prove the
+    # partial re-map actually ran
+    expect_recovered: bool = False
+
+
+def single_fault_trials(fast: bool = False) -> List[Trial]:
+    """One fault per trial, exhaustive over (scenario boundary × kind):
+    every FAULT_KINDS entry appears, recoverable kinds at every
+    instrumented seam they can reach, with skip variants pinning later
+    occurrences (second file written, second round drained)."""
+    t: List[Trial] = []
+
+    def one(scenario, match, kind, skip=0, count=1, expect_recovered=False):
+        rule = {"match": match, "fault": kind, "count": count}
+        if skip:
+            rule["skip"] = skip
+        label = f"{scenario}:{match}[{kind}"
+        label += f"+skip{skip}]" if skip else "]"
+        t.append(Trial(scenario, [rule], label,
+                       expect_recovered=expect_recovered))
+
+    # spill scenario: step seam + the full disk boundary set
+    for kind in ("exception", "oom", "fatal"):
+        one("spill", "chaos_spill_step", kind)
+    one("spill", "chaos_spill_step", "exception", skip=1)
+    one("spill", "spill_io_write", "spill_io")
+    one("spill", "spill_io_write", "spill_io", skip=1)
+    one("spill", "spill_io_read", "spill_io")
+    one("spill", "spill_corrupt_file", "spill_corrupt")
+    one("spill", "spill_corrupt_file", "spill_corrupt", skip=1)
+
+    # shuffle scenario: transport seam, step seam, and spilled-buffer
+    # damage that must recover via map lineage
+    one("shuffle", "shuffle_io_round", "shuffle_io")
+    one("shuffle", "shuffle_io_round", "oom")
+    one("shuffle", "spill_corrupt_file", "spill_corrupt",
+        expect_recovered=True)
+    if not fast:
+        one("shuffle", "shuffle_io_round", "shuffle_io", skip=1)
+        one("shuffle", "chaos_shuffle_step", "exception")
+        one("shuffle", "chaos_shuffle_step", "fatal")
+        one("shuffle", "spill_io_read", "spill_io", expect_recovered=True)
+        one("shuffle", "spill_io_write", "spill_io")
+
+    # q95 scenario: the compute seam
+    if not fast:
+        for kind in ("exception", "oom", "fatal"):
+            one("q95", "chaos_q95_step", kind)
+    return t
+
+
+# multi-fault sampling pools: kinds that recover INSIDE a run (plus
+# exception, whose replacement re-run is itself a recovery path)
+_MULTI_POOL = {
+    "spill": [("chaos_spill_step", "oom"), ("chaos_spill_step", "exception"),
+              ("spill_io_write", "spill_io"), ("spill_io_read", "spill_io"),
+              ("spill_corrupt_file", "spill_corrupt")],
+    "shuffle": [("shuffle_io_round", "shuffle_io"),
+                ("shuffle_io_round", "oom"),
+                ("spill_corrupt_file", "spill_corrupt"),
+                ("spill_io_write", "spill_io")],
+    "q95": [("chaos_q95_step", "oom"), ("chaos_q95_step", "exception")],
+}
+
+
+def multi_fault_trials(seed: int, per_scenario: int) -> List[Trial]:
+    """Seeded composite schedules: 2-3 rules per trial drawn from the
+    scenario's recoverable pool with derived skip/count offsets.  Same
+    seed → same schedules, bit for bit."""
+    trials: List[Trial] = []
+    for scenario, pool in _MULTI_POOL.items():
+        for i in range(per_scenario):
+            rng = random.Random(seed * 7919 + hash(scenario) % 1009 + i)
+            picks = rng.sample(pool, k=min(rng.randint(2, 3), len(pool)))
+            rules = []
+            for match, kind in picks:
+                rule = {"match": match, "fault": kind,
+                        "count": rng.randint(1, 2)}
+                # q95 crosses its probe only twice per attempt; larger
+                # skips could out-run the occurrence clock (vacuous trial)
+                skip = rng.randint(0, 1 if scenario == "q95" else 2)
+                if skip:
+                    rule["skip"] = skip
+                rules.append(rule)
+            trials.append(Trial(
+                scenario, rules, f"{scenario}:multi[seed={seed} #{i}]"))
+    return trials
+
+
+# ---------------------------------------------------------------------------
+# the campaign
+# ---------------------------------------------------------------------------
+
+def _run_with_replacement(scenario) -> Dict:
+    """Run a scenario to completion under the active fault schedule:
+    recoverable kinds resolve inside run(); exception/fatal abort the
+    attempt and a replacement run starts from scratch (the harness tore
+    everything down).  The attempt bound is the campaign's 'retry counts
+    bounded' invariant."""
+    last: Optional[BaseException] = None
+    for attempt in range(1, _MAX_ATTEMPTS + 1):
+        try:
+            out = scenario.run()
+            out["attempts"] = attempt
+            return out
+        except (faultinj.InjectedFault, faultinj.FatalInjectedFault) as e:
+            last = e
+    raise ChaosError(
+        f"{scenario.name}: not done after {_MAX_ATTEMPTS} replacement "
+        f"attempts (last: {last!r})")
+
+
+def run_campaign(fast: bool = False, seed: int = 0,
+                 trials: Optional[int] = None,
+                 log: Callable[[str], None] = lambda s: None) -> Dict:
+    """Execute the full matrix; returns the report dict (``ok`` key).
+    Raises nothing on trial failure — failures are collected so one bad
+    trial does not hide the others' evidence."""
+    faultinj.configure()  # clean slate: no inherited schedules
+    per_scenario = (0 if fast else
+                    (trials if trials is not None
+                     else int(config.get("chaos_trials"))))
+    matrix = single_fault_trials(fast) + multi_fault_trials(
+        seed, per_scenario)
+    used = {t.scenario for t in matrix}
+
+    baselines: Dict[str, Dict] = {}
+    for name in sorted(used):
+        log(f"baseline: {name}")
+        baselines[name] = SCENARIOS[name].run()
+
+    report = {"fast": fast, "seed": seed, "trials": [],
+              "kinds_fired": [], "failures": [], "ok": False}
+    kinds_fired = set()
+    for trial in matrix:
+        sc = SCENARIOS[trial.scenario]
+        rec = {"label": trial.label, "rules": trial.rules}
+        try:
+            with faultinj.scope({"seed": seed, "faults": trial.rules}):
+                out = _run_with_replacement(sc)
+                fired = faultinj.fired_log()
+            rec["attempts"] = out["attempts"]
+            rec["fired"] = fired
+            rec.update(out["extra"])
+            if not fired:
+                raise ChaosError(
+                    f"{trial.label}: vacuous trial — no rule fired, the "
+                    f"boundary was never crossed")
+            if out["digest"] != baselines[trial.scenario]["digest"]:
+                raise ChaosError(
+                    f"{trial.label}: faulted result DIFFERS from the "
+                    f"fault-free baseline "
+                    f"({out['digest'][:12]} != "
+                    f"{baselines[trial.scenario]['digest'][:12]})")
+            if (trial.expect_recovered
+                    and not out["extra"].get("recovered_partitions")):
+                raise ChaosError(
+                    f"{trial.label}: expected a lineage recovery "
+                    f"(recovered_partitions > 0) but none was recorded")
+            kinds_fired.update(f["fault"] for f in fired)
+            rec["ok"] = True
+            log(f"ok: {trial.label} (attempts={out['attempts']}, "
+                f"fired={len(fired)})")
+        except Exception as e:  # collect, don't abort the sweep
+            rec["ok"] = False
+            rec["error"] = f"{type(e).__name__}: {e}"
+            rec.setdefault("fired", faultinj.fired_log())
+            report["failures"].append(rec)
+            log(f"FAIL: {trial.label}: {rec['error']}")
+        report["trials"].append(rec)
+
+    report["kinds_fired"] = sorted(kinds_fired)
+    missing = set(faultinj.FAULT_KINDS) - kinds_fired
+    if missing and not fast:
+        report["failures"].append({
+            "label": "coverage",
+            "error": f"FAULT_KINDS never fired: {sorted(missing)}"})
+        log(f"FAIL: kinds never fired: {sorted(missing)}")
+    report["ok"] = not report["failures"]
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--fast", action="store_true",
+                    help="tier-1 subset: fewer single-fault trials, no "
+                         "multi-fault soak, no q95 scenario")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--trials", type=int, default=None,
+                    help="multi-fault trials per scenario "
+                         "(default: the chaos_trials knob)")
+    ap.add_argument("--report", default=None,
+                    help="also write the JSON report to this path")
+    args = ap.parse_args(argv)
+
+    report = run_campaign(fast=args.fast, seed=args.seed,
+                          trials=args.trials,
+                          log=lambda s: print(f"[chaos] {s}", flush=True))
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump(report, f, indent=2)
+    n = len(report["trials"])
+    n_ok = sum(1 for t in report["trials"] if t.get("ok"))
+    print(f"[chaos] {n_ok}/{n} trials ok; kinds fired: "
+          f"{report['kinds_fired']}")
+    if not report["ok"]:
+        print("[chaos] CAMPAIGN FAILED — fired_log() per failing trial:",
+              file=sys.stderr)
+        for f_rec in report["failures"]:
+            print(f"  {f_rec.get('label')}: {f_rec.get('error')}",
+                  file=sys.stderr)
+            for entry in f_rec.get("fired", []):
+                print(f"    {entry}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,4 +1,4 @@
-"""The seven graftlint rules.  Each encodes a bug this repo shipped or is
+"""The eight graftlint rules.  Each encodes a bug this repo shipped or is
 structurally exposed to; see tools/graftlint/README.md for the full
 rationale with the motivating incident per rule."""
 
@@ -773,10 +773,63 @@ class GL007DonatedBufferReuse(Rule):
         return
 
 
+# ---------------------------------------------------------------------------
+# GL008 — file/stream handles opened inside jitted scope
+# ---------------------------------------------------------------------------
+
+_IO_HANDLE_CALLS = {"io.BytesIO", "io.StringIO", "io.open", "io.FileIO",
+                    "tempfile.TemporaryFile", "tempfile.NamedTemporaryFile"}
+
+
+class GL008JittedIOHandle(Rule):
+    """``open(...)`` / ``io.BytesIO(...)`` inside a jit/shard_map/pallas
+    body runs ONCE, at trace time, not per execution: the side effect is
+    baked out of the compiled program, later executions silently reuse
+    (or never see) the handle, and a handle opened mid-trace is never
+    deterministically closed — the exact hazard class the spill
+    framework avoids by keeping all disk I/O host-side behind
+    ``run_with_retry`` (mem/spill.py's ``_write_leaf``/``_read_leaf``
+    boundary).  Do I/O outside the traced computation and pass arrays
+    in; use ``jax.debug.callback``/``io_callback`` when a traced value
+    genuinely must reach the host per execution."""
+
+    id = "GL008"
+
+    def check(self, pf: ParsedFile) -> Iterable[Finding]:
+        aliases = module_aliases(pf.tree)
+        for fn, _jit_kws in _jitted_functions(pf, aliases):
+            for stmt in fn.body:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    dotted = resolve(node.func, aliases)
+                    if dotted in _IO_HANDLE_CALLS:
+                        yield pf.finding(
+                            self.id, node,
+                            f"`{dotted}(...)` inside jitted `{fn.name}` "
+                            "opens a handle at TRACE time, not per "
+                            "execution — the I/O is baked out of the "
+                            "compiled program and the handle is never "
+                            "deterministically closed; do I/O outside "
+                            "the trace (or via jax.experimental."
+                            "io_callback)")
+                    elif (isinstance(node.func, ast.Name)
+                          and node.func.id == "open"
+                          and node.func.id not in aliases):
+                        yield pf.finding(
+                            self.id, node,
+                            f"builtin `open(...)` inside jitted "
+                            f"`{fn.name}` runs once at trace time — "
+                            "later executions reuse a stale (possibly "
+                            "closed) handle and the write/read never "
+                            "re-executes; move file I/O outside the "
+                            "traced computation")
+
+
 _ALL: List[Rule] = [GL001TracerLeak(), GL002HostSyncUnderJit(),
                     GL003RetraceHazard(), GL004SpillHandleLeak(),
                     GL005ConfigDrift(), GL006FaultKindDrift(),
-                    GL007DonatedBufferReuse()]
+                    GL007DonatedBufferReuse(), GL008JittedIOHandle()]
 
 
 def all_rules(only: Optional[Sequence[str]] = None) -> List[Rule]:
