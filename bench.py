@@ -379,6 +379,63 @@ def child_main():
             "platform": platform, "rows": nq, "note": note}), flush=True)
     except Exception as e:  # informative stage: never fail the capture
         print(f"# q95 stage failed: {e}", file=sys.stderr, flush=True)
+
+    # encoded-execution rows (r7): the string-keyed q6 shape decoded vs
+    # dictionary-encoded (the acceptance A/B — encoded must win on the
+    # CPU smoke shape), and the q95 stage set on encoded wh/seg codes.
+    # Encoding is a host-boundary op (np.unique over byte rows), so the
+    # devgen path can't build these on device; the variants share one
+    # dictionary per column (one dict_token → one compile, the per-file
+    # reuse shape encoded execution is designed for).
+    left = deadline_s - (time.monotonic() - t_start)
+    if use_devgen or left < 60:
+        print(f"# skipping encoded rows (devgen={use_devgen}, "
+              f"{left:.0f}s left)", file=sys.stderr, flush=True)
+        return 0
+    ns = min(n_small, 1 << 16)
+    try:
+        jstr = jax.jit(ge._q6str_step)
+        dec_v = [(ge._q6str_batch(ns, seed=37 + i),)
+                 for i in range(REPS + 1)]
+        dec = _bench_one(jstr, dec_v[0], ns, REPS, variants=dec_v)
+        enc_v = ge._q6str_encoded_variants(ns, [37 + i
+                                                for i in range(REPS + 1)])
+        enc = _bench_one(jstr, enc_v[0], ns, REPS, variants=enc_v)
+        print(json.dumps({
+            "metric": "q6_strkey_throughput", "value": round(dec, 2),
+            "unit": "Mrows/s", "platform": platform, "rows": ns}),
+            flush=True)
+        print(json.dumps({
+            "metric": "q6_encoded_throughput", "value": round(enc, 2),
+            "unit": "Mrows/s", "platform": platform, "rows": ns,
+            "vs_decoded": round(enc / dec, 2)}), flush=True)
+    except Exception as e:
+        print(f"# encoded q6 rows failed: {e}", file=sys.stderr, flush=True)
+    left = deadline_s - (time.monotonic() - t_start)
+    if left < 45:
+        print(f"# skipping encoded q95 row: {left:.0f}s left",
+              file=sys.stderr, flush=True)
+        return 0
+    try:
+        from spark_rapids_jni_tpu.relational.aggregate import (
+            _resolve_groupby_engine,
+        )
+        from spark_rapids_jni_tpu.relational.join import _resolve_join_engine
+
+        qv = ge._q95_encoded_variants(nq, [59 + i for i in range(REPS + 1)])
+        qm_enc = _bench_one(jax.jit(ge._q95_encoded_step), qv[0], nq, REPS,
+                            variants=qv)
+        print(json.dumps({
+            "metric": "q95_shape_encoded_throughput",
+            "value": round(qm_enc, 2), "unit": "Mrows/s",
+            "vs_baseline": round(qm_enc / _numpy_q95_mrows(nq), 2),
+            "platform": platform, "rows": nq,
+            "note": {"encoded": ["wh", "seg"],
+                     "engines": {"groupby": _resolve_groupby_engine(None),
+                                 "join": _resolve_join_engine(None)}}}),
+            flush=True)
+    except Exception as e:
+        print(f"# encoded q95 row failed: {e}", file=sys.stderr, flush=True)
     return 0
 
 
@@ -1003,6 +1060,72 @@ def micro_main():
         gbs,
         m,
     )
+
+    if over():
+        skipped.append("<remaining suite>")
+        return finish()
+
+    # encoded-execution micro rows (r7): a join keyed on dictionary
+    # CODES (both sides share one dictionary/token, so the probe
+    # compares single canon words instead of padded-string radix words)
+    # and a group-by over an RLE key.  Every variant shares the same
+    # dictionary/run-count so the set compiles ONCE (fresh tokens or
+    # run shapes would recompile per variant — the same per-file reuse
+    # shape the q6/q95 encoded rows measure).
+    import dataclasses as _dc
+
+    from spark_rapids_jni_tpu.columnar.encoded import (
+        RunLengthColumn,
+        dictionary_from_arrays,
+    )
+    from spark_rapids_jni_tpu.relational import AggSpec as _ASpec
+    from spark_rapids_jni_tpu.relational import group_by as _gb
+    from spark_rapids_jni_tpu.relational import hash_join as _hjoin
+
+    jds = []
+    if want("dict_join_codes"):
+        dim_strs = StringColumn.from_pylist(
+            [f"sku-{i:04d}" for i in range(1000)], max_len=12)
+        base = dictionary_from_arrays(
+            rng.integers(0, 1000, m).astype(np.uint32), mones, dim_strs)
+        dim_k = _dc.replace(base,
+                            codes=jnp.arange(1000, dtype=jnp.uint32),
+                            validity=jnp.ones((1000,), jnp.bool_))
+        dim = ColumnBatch({
+            "k": dim_k,
+            "dv": Column(jnp.arange(1000, dtype=jnp.int64),
+                         jnp.ones((1000,), jnp.bool_), T.INT64)})
+        for i in range(V):
+            f = base if i == 0 else _dc.replace(base, codes=jnp.asarray(
+                rng.integers(0, 1000, m).astype(np.uint32)))
+            jds.append((ColumnBatch({
+                "k": f,
+                "v": Column(jnp.asarray(rng.integers(0, 100, m)), mones,
+                            T.INT64)}), dim))
+    run("dict_join_codes",
+        jax.jit(lambda f, d: _hjoin(f, d, ["k"], ["k"], "inner")),
+        jds, m, reps=4)
+
+    rbs = []
+    if want("group_by_rle"):
+        runs = 1 << 10
+        for i in range(V):
+            r = np.random.default_rng(90 + i)
+            # cumsum of steps in [1, 50) mod 997: adjacent runs always
+            # differ (the RLE invariant encode_rle guarantees)
+            vals = (np.cumsum(r.integers(1, 50, runs)) % 997).astype(
+                np.int32)
+            k = RunLengthColumn(jnp.asarray(vals),
+                                jnp.full((runs,), m // runs, jnp.int32),
+                                mones, T.INT32)
+            rbs.append((ColumnBatch({
+                "k": k,
+                "v": Column(jnp.asarray(r.integers(0, 1000, m)), mones,
+                            T.INT64)}),))
+    run("group_by_rle",
+        jax.jit(lambda b: _gb(b, ["k"], [_ASpec("sum", "v", "s"),
+                                         _ASpec("count", None, "c")])),
+        rbs, m, reps=4)
 
     if over():
         skipped.append("<remaining suite>")

@@ -10,6 +10,11 @@ Two checks, same only-shrinks spirit as graftlint's baseline:
   ``ci/q95_floor.json``.  The floor only ratchets UP: when a change
   legitimately speeds q95 up, raise it in the same PR so the next
   regression is caught at the new level.
+
+The encoded variant ``q95_shape_encoded_throughput`` (dictionary codes
+through exchange + join + group-by) gets the same treatment against
+``encoded_vs_baseline_floor`` — a missing line fails the gate, so the
+encoded path can't silently fall out of the smoke.
 """
 import json
 import os
@@ -20,8 +25,10 @@ def main(path: str) -> int:
     floor_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "q95_floor.json")
     with open(floor_path) as f:
-        floor = json.load(f)["vs_baseline_floor"]
-    line = None
+        floors = json.load(f)
+    floor = floors["vs_baseline_floor"]
+    enc_floor = floors["encoded_vs_baseline_floor"]
+    line = enc_line = None
     with open(path) as f:
         for ln in f:
             ln = ln.strip()
@@ -33,6 +40,8 @@ def main(path: str) -> int:
                 continue
             if obj.get("metric") == "q95_shape_throughput":
                 line = obj
+            elif obj.get("metric") == "q95_shape_encoded_throughput":
+                enc_line = obj
     if line is None:
         print("check_q95_line: no q95_shape_throughput line in", path)
         return 1
@@ -50,11 +59,26 @@ def main(path: str) -> int:
     if vs < floor:
         errs.append(f"vs_baseline {vs} regressed below the recorded "
                     f"floor {floor} (ci/q95_floor.json)")
+    enc_vs = None
+    if enc_line is None:
+        errs.append("no q95_shape_encoded_throughput line: the encoded "
+                    "q95 row fell out of the smoke (bench.py child_main)")
+    else:
+        enc_note = enc_line.get("note")
+        if not isinstance(enc_note, dict) or "encoded" not in enc_note:
+            errs.append("encoded line's note.encoded missing: the capture "
+                        "no longer documents which columns ran encoded")
+        enc_vs = enc_line.get("vs_baseline", 0.0)
+        if enc_vs < enc_floor:
+            errs.append(f"encoded vs_baseline {enc_vs} regressed below "
+                        f"the recorded floor {enc_floor} "
+                        f"(ci/q95_floor.json)")
     if errs:
         for e in errs:
             print("check_q95_line:", e)
         return 1
     print(f"check_q95_line: OK (vs_baseline {vs} >= floor {floor}; "
+          f"encoded {enc_vs} >= floor {enc_floor}; "
           f"engines {json.dumps((note or {}).get('engines'))})")
     if vs >= 2 * floor and floor > 0:
         print(f"check_q95_line: note — vs_baseline is >=2x the floor; "

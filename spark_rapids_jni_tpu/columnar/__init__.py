@@ -1,6 +1,20 @@
 from . import types
 from .bucketed import BucketedStringColumn
-from .column import AnyColumn, Column, ColumnBatch, Decimal128Column, StringColumn
+from .column import Column, ColumnBatch, Decimal128Column, StringColumn
+# encoded extends column.AnyColumn in place; import it BEFORE binding
+# AnyColumn here so every downstream importer sees the extended tuple
+from .encoded import (
+    DictionaryColumn,
+    RunLengthColumn,
+    decode_batch,
+    encode_batch,
+    encode_column,
+    encode_rle,
+    is_encoded,
+    materialize_batch,
+    materialize_column,
+)
+from .column import AnyColumn
 from .arrow import from_arrow, to_arrow, array_to_column
 
 __all__ = [
@@ -11,6 +25,15 @@ __all__ = [
     "Decimal128Column",
     "StringColumn",
     "BucketedStringColumn",
+    "DictionaryColumn",
+    "RunLengthColumn",
+    "encode_batch",
+    "decode_batch",
+    "encode_column",
+    "encode_rle",
+    "is_encoded",
+    "materialize_batch",
+    "materialize_column",
     "from_arrow",
     "to_arrow",
     "array_to_column",

@@ -108,11 +108,36 @@ def _decimal_array_to_column(arr: pa.Array) -> Decimal128Column:
     )
 
 
+def _dictionary_array_to_column(arr: pa.Array):
+    """Arrow dictionary array -> DictionaryColumn (codes stay codes).
+
+    The pass-through half of encoded execution: Parquet dictionary pages
+    arrive here still split as (indices, values), and when the
+    ``encoded_execution`` knob resolves on they upload as-is — no decode
+    on ingest, no re-encode later.  Falls back to the decoded path when
+    the knob is off, the dictionary is empty (an all-null column), or a
+    writer put nulls IN the dictionary (ours covers live values only).
+    """
+    from .encoded import dictionary_from_arrays, resolve_encoded_execution
+
+    t = arr.type
+    if (not resolve_encoded_execution()
+            or len(arr.dictionary) == 0
+            or arr.dictionary.null_count):
+        return array_to_column(arr.cast(t.value_type))
+    valid = np.asarray(arr.is_valid())
+    codes = np.asarray(arr.indices.fill_null(0)).astype(np.uint32)
+    values = array_to_column(arr.dictionary)
+    return dictionary_from_arrays(codes, jnp.asarray(valid), values)
+
+
 def array_to_column(arr):
     """One Arrow array/chunked-array -> device column."""
     if isinstance(arr, pa.ChunkedArray):
         arr = arr.combine_chunks()
     t = arr.type
+    if pa.types.is_dictionary(t):
+        return _dictionary_array_to_column(arr)
     if pa.types.is_list(t) or pa.types.is_large_list(t):
         from .column import ListColumn
 
@@ -188,7 +213,12 @@ def from_arrow(table: pa.Table) -> ColumnBatch:
 
 def _column_to_array(col) -> pa.Array:
     from .column import ListColumn, StructColumn
+    from .encoded import is_encoded, materialize_column
 
+    if is_encoded(col):
+        # Arrow export is a host output boundary — the sanctioned end of
+        # late materialization (values gather once, here)
+        col = materialize_column(col)
     if isinstance(col, ListColumn):
         child = _column_to_array(col.child)
         offsets = np.asarray(jax.device_get(col.offsets))

@@ -1,0 +1,505 @@
+"""Encoded column variants: dictionary codes and run-length runs.
+
+"GPU Acceleration of SQL Analytics on Compressed Data" (PAPERS.md) shows
+the win of executing filters, joins and group-bys *directly* on encoded
+columns with late materialization: less arena per batch, fewer bytes per
+shuffle round, and u32 code comparisons instead of padded-string compares.
+
+* :class:`DictionaryColumn` stores ``codes uint32[n]`` into a small
+  ``dictionary`` column of ``d`` unique values.  The dictionary is
+  **bit-distinct**: entries are unique over raw byte patterns, so
+  ``-0.0``/``0.0`` and differently-payloaded NaNs stay separate entries
+  and ``decode()`` is bit-exact against the pre-encode column.
+* ``canon uint32[d]`` maps each dictionary entry to the rank of its
+  *equality class* in equality-domain radix-word order (Spark equality:
+  ``-0.0 == 0.0``, one canonical NaN).  Because it is an order-preserving
+  rank, the single word ``canon[codes]`` is both equality- AND
+  order-equivalent to the column's full gathered key words — group-by and
+  join can key on one u32 word and still produce bit-identical output
+  order.  Valid only *within* one dictionary.
+* ``dict_token`` is a static identity minted per dictionary: two columns
+  carry directly comparable codes iff their tokens match (same
+  ``encode_batch`` call, a gather of the same column, or an explicit
+  :func:`reconcile_dictionaries`).  It rides the pytree aux, so the check
+  happens at trace time — a join can pick the canon fast path or the
+  gathered-words fallback inside the same program family with no device
+  sync.  Columns with different tokens still join/group correctly: the
+  default key lowering gathers the dictionary's OWN value words by code
+  (relational/keys.py), which is cross-dictionary safe.
+* :class:`RunLengthColumn` stores ``run_values`` + ``run_lengths`` for
+  low-cardinality int columns; validity stays row-level so masks compose.
+  Gather decodes RLE (runs do not survive permutation), so RLE columns
+  never flow deep into join/shuffle internals.
+
+Late materialization contract: ``decode()`` / ``materialize_*`` are the
+ONLY sanctioned materialization points; graftlint GL009 flags decode
+calls inside jitted hot paths outside the sanctioned helpers.  The string
+dictionary rides the bucketed-padding machinery (``plan_widths``) so a
+dictionary of short strings is not padded to a pathological width.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import types as T
+from .bucketed import plan_widths
+from .column import Column, ColumnBatch, Decimal128Column, StringColumn
+from . import column as _column_mod
+
+# monotone token source: equal tokens <=> provably the same dictionary
+_TOKENS = itertools.count(1)
+
+
+def _host(arr) -> np.ndarray:
+    return np.asarray(jax.device_get(arr))
+
+
+def _bitview_rows(col) -> np.ndarray:
+    """uint8[n, k] raw-byte rows of a column's values (host side).
+
+    Uniqueness over these rows is uniqueness over bit patterns — the
+    bit-distinct dictionary that makes decode() exact.
+    """
+    if isinstance(col, StringColumn):
+        chars = np.ascontiguousarray(_host(col.chars), dtype=np.uint8)
+        lens = np.ascontiguousarray(_host(col.lengths).astype(np.int32))
+        return np.hstack([chars, lens.view(np.uint8).reshape(len(lens), 4)])
+    if isinstance(col, Decimal128Column):
+        limbs = np.ascontiguousarray(_host(col.limbs))
+        return limbs.view(np.uint8).reshape(limbs.shape[0], 16)
+    data = np.ascontiguousarray(_host(col.data))
+    n = data.shape[0]
+    return data.view(np.uint8).reshape(n, -1) if n else np.zeros(
+        (0, max(data.dtype.itemsize, 1)), np.uint8)
+
+
+def _build_canon(dictionary) -> jax.Array:
+    """uint32[d]: equality-class rank per dictionary entry.
+
+    Ranks follow equality-domain radix-word order (first word most
+    significant — the same lexicographic order np.unique(axis=0) uses),
+    so substituting ``canon[codes]`` for the full word list preserves
+    both equality AND sort order of composite keys.
+    """
+    from ..relational import keys as K  # deferred: keys imports columnar
+
+    d = dictionary.num_rows
+    if d == 0:
+        return jnp.zeros((0,), jnp.uint32)
+    words = K.column_radix_keys(dictionary, equality=True)
+    mat = np.stack([_host(w).astype(np.uint32) for w in words], axis=1)
+    _, inv = np.unique(mat, axis=0, return_inverse=True)
+    return jnp.asarray(inv.astype(np.uint32))
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class DictionaryColumn:
+    """Dictionary-encoded column: ``codes uint32[n]`` into ``dictionary``.
+
+    ``dictionary`` is a plain column (Column / StringColumn /
+    Decimal128Column) of ``d`` all-valid, bit-distinct entries; ``canon``
+    is the per-entry equality-class rank (see module docstring).
+    ``dict_token`` is static aux: equal tokens guarantee comparable codes.
+    """
+
+    codes: jax.Array      # uint32 [n]
+    validity: jax.Array   # bool [n]
+    canon: jax.Array      # uint32 [d] (None while detached for shuffle)
+    dictionary: object    # Column | StringColumn | Decimal128Column | None
+    dtype: T.SparkType
+    dict_token: int = 0
+
+    def tree_flatten(self):
+        return (self.codes, self.validity, self.canon, self.dictionary), (
+            self.dtype, self.dict_token)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        codes, validity, canon, dictionary = children
+        return cls(codes, validity, canon, dictionary, aux[0], aux[1])
+
+    @property
+    def num_rows(self) -> int:
+        return self.codes.shape[0]
+
+    @property
+    def num_entries(self) -> int:
+        return self.dictionary.num_rows
+
+    def decode(self):
+        """Materialize the plain column (the late-materialization point)."""
+        d = self.dictionary
+        idx = self.codes.astype(jnp.int32)
+        v = self.validity
+        if isinstance(d, StringColumn):
+            return StringColumn(d.chars[idx], d.lengths[idx] * v, v, d.dtype)
+        if isinstance(d, Decimal128Column):
+            return Decimal128Column(d.limbs[idx], v, self.dtype)
+        return Column(d.data[idx], v, self.dtype)
+
+    def to_pylist(self) -> list:
+        vals = self.dictionary.to_pylist()
+        codes = _host(self.codes)
+        valid = _host(self.validity)
+        return [vals[int(c)] if ok else None for c, ok in zip(codes, valid)]
+
+    def __repr__(self):
+        return (f"DictionaryColumn({self.dtype!r}, n={self.num_rows}, "
+                f"d={self.dictionary.num_rows if self.dictionary is not None else '?'}, "
+                f"token={self.dict_token})")
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class RunLengthColumn:
+    """Run-length-encoded fixed-width column: ``run_values[r]`` +
+    ``run_lengths int32[r]`` (summing to ``n``); validity stays a
+    row-level ``bool[n]`` so filters/masks compose without touching runs.
+    """
+
+    run_values: jax.Array   # [r] values dtype
+    run_lengths: jax.Array  # int32 [r]
+    validity: jax.Array     # bool [n]
+    dtype: T.SparkType
+
+    def tree_flatten(self):
+        return (self.run_values, self.run_lengths, self.validity), self.dtype
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        run_values, run_lengths, validity = children
+        return cls(run_values, run_lengths, validity, aux)
+
+    @property
+    def num_rows(self) -> int:
+        return self.validity.shape[0]
+
+    @property
+    def num_runs(self) -> int:
+        return self.run_values.shape[0]
+
+    def row_to_run(self) -> jax.Array:
+        """int32[n]: which run each row belongs to."""
+        n = self.num_rows
+        if self.num_runs == 0:
+            return jnp.zeros((n,), jnp.int32)
+        ends = jnp.cumsum(self.run_lengths.astype(jnp.int32))
+        row = jnp.arange(n, dtype=jnp.int32)
+        run = jnp.searchsorted(ends, row, side="right").astype(jnp.int32)
+        return jnp.clip(run, 0, self.num_runs - 1)
+
+    def decode(self) -> Column:
+        """Materialize the plain column (the late-materialization point)."""
+        n = self.num_rows
+        if self.num_runs == 0:
+            data = jnp.zeros((n,), self.dtype.jnp_dtype)
+            return Column(data, self.validity, self.dtype)
+        return Column(self.run_values[self.row_to_run()], self.validity,
+                      self.dtype)
+
+    def to_pylist(self) -> list:
+        return self.decode().to_pylist()
+
+    def __repr__(self):
+        return (f"RunLengthColumn({self.dtype!r}, n={self.num_rows}, "
+                f"runs={self.num_runs})")
+
+
+# encoded columns join the AnyColumn family (column.py marks the tuple
+# "extended below"; columnar/__init__ imports this module right after
+# column, so every downstream `from columnar.column import AnyColumn`
+# binds the extended tuple)
+_column_mod.AnyColumn = _column_mod.AnyColumn + (
+    DictionaryColumn, RunLengthColumn)
+
+ENCODED_COLUMNS = (DictionaryColumn, RunLengthColumn)
+
+
+def is_encoded(col) -> bool:
+    return isinstance(col, ENCODED_COLUMNS)
+
+
+# ---- encode (host boundary) ------------------------------------------------
+
+def encode_column(col, ladder=None) -> DictionaryColumn:
+    """Dictionary-encode one column (host-side; ingest-time op).
+
+    Null rows map to an existing entry so the dictionary covers live
+    values only.  String dictionaries are width-planned with the bucketed
+    ladder (``plan_widths``) so short-string dictionaries stay narrow.
+    """
+    if is_encoded(col):
+        return col if isinstance(col, DictionaryColumn) else \
+            encode_column(col.decode(), ladder)
+    rows = _bitview_rows(col)
+    valid = _host(col.validity).astype(bool)
+    n = rows.shape[0]
+    # null rows borrow the first valid row's identity (bytes AND source
+    # index) so the dictionary covers live values only; src maps every
+    # unique-row first occurrence back to a row whose payload matches it
+    src = np.arange(n)
+    if n and not valid.all():
+        src[~valid] = int(valid.argmax()) if valid.any() else 0
+        rows = rows[src]
+    _, uidx, inv = np.unique(rows, axis=0, return_index=True,
+                             return_inverse=True)
+    codes = jnp.asarray(inv.reshape(n).astype(np.uint32))
+    dictionary = _take_dictionary(col, src[uidx], ladder)
+    canon = _build_canon(dictionary)
+    return DictionaryColumn(codes, col.validity, canon, dictionary,
+                            col.dtype, next(_TOKENS))
+
+
+def _take_dictionary(col, uidx: np.ndarray, ladder=None):
+    """Build the all-valid dictionary column from row indices ``uidx``."""
+    d = uidx.shape[0]
+    ones = jnp.ones((d,), jnp.bool_)
+    if isinstance(col, StringColumn):
+        chars = _host(col.chars)
+        lens = _host(col.lengths)
+        sel = lens[uidx]
+        width = plan_widths(sel.tolist(), ladder) if ladder else \
+            plan_widths(sel.tolist())
+        w = width[-1]
+        sub = np.zeros((d, w), np.uint8)
+        take = min(w, chars.shape[1])
+        sub[:, :take] = chars[uidx, :take]
+        return StringColumn(jnp.asarray(sub),
+                            jnp.asarray(sel.astype(np.int32)), ones)
+    if isinstance(col, Decimal128Column):
+        return Decimal128Column(jnp.asarray(_host(col.limbs)[uidx]), ones,
+                                col.dtype)
+    return Column(jnp.asarray(_host(col.data)[uidx]), ones, col.dtype)
+
+
+def dictionary_from_arrays(codes, validity, dictionary,
+                           dtype=None) -> DictionaryColumn:
+    """Wrap pre-split buffers (Parquet dictionary pages) as a column;
+    computes canon and mints a fresh token."""
+    dtype = dtype or dictionary.dtype
+    return DictionaryColumn(jnp.asarray(codes, jnp.uint32).reshape(-1),
+                            validity, _build_canon(dictionary), dictionary,
+                            dtype, next(_TOKENS))
+
+
+def encode_rle(col) -> RunLengthColumn:
+    """Run-length-encode a fixed-width column (host-side; ingest-time op).
+
+    Runs split on raw-byte inequality (bit-distinct, like the
+    dictionary), so decode() is bit-exact; pays off only when the column
+    actually has long runs (sorted / clustered low-cardinality ints).
+    """
+    if isinstance(col, RunLengthColumn):
+        return col
+    if is_encoded(col):
+        col = col.decode()
+    if not isinstance(col, Column):
+        raise TypeError(f"RLE supports fixed-width columns, not {col!r}")
+    rows = _bitview_rows(col)
+    n = rows.shape[0]
+    if n == 0:
+        return RunLengthColumn(jnp.zeros((0,), col.dtype.jnp_dtype),
+                               jnp.zeros((0,), jnp.int32), col.validity,
+                               col.dtype)
+    change = np.any(rows[1:] != rows[:-1], axis=1)
+    starts = np.flatnonzero(np.concatenate([[True], change]))
+    lengths = np.diff(np.append(starts, n)).astype(np.int32)
+    data = _host(col.data)
+    return RunLengthColumn(jnp.asarray(data[starts]), jnp.asarray(lengths),
+                           col.validity, col.dtype)
+
+
+def encode_batch(batch: ColumnBatch, dictionary: Optional[Sequence[str]] = None,
+                 rle: Sequence[str] = (), max_card_frac: float = 0.5
+                 ) -> ColumnBatch:
+    """Encode a batch's columns (host boundary).
+
+    ``dictionary=None`` auto-picks: every string column, plus fixed-width
+    columns whose distinct-value count is below ``max_card_frac`` of the
+    rows.  ``rle`` names columns to run-length-encode instead.
+    """
+    out = {}
+    for name, col in zip(batch.names, batch.columns):
+        if name in rle:
+            out[name] = encode_rle(col)
+            continue
+        if dictionary is not None:
+            out[name] = encode_column(col) if name in dictionary else col
+            continue
+        if isinstance(col, StringColumn):
+            out[name] = encode_column(col)
+        elif isinstance(col, Column) and col.num_rows:
+            enc = encode_column(col)
+            keep = enc.num_entries <= max(1, int(
+                col.num_rows * max_card_frac))
+            out[name] = enc if keep else col
+        else:
+            out[name] = col
+    return ColumnBatch(out)
+
+
+# ---- materialize (late) ----------------------------------------------------
+
+def materialize_column(col):
+    """Decode if encoded, identity otherwise — the project/output-time
+    materialization helper (sanctioned for GL009)."""
+    return col.decode() if is_encoded(col) else col
+
+
+def materialize_batch(batch: ColumnBatch) -> ColumnBatch:
+    return ColumnBatch({n: materialize_column(c)
+                        for n, c in zip(batch.names, batch.columns)})
+
+
+decode_batch = materialize_batch
+
+
+# ---- encoded-domain operators ----------------------------------------------
+
+def predicate_mask(col: DictionaryColumn, pred) -> jax.Array:
+    """bool[n] filter mask: evaluate ``pred`` over the d-entry dictionary
+    ONCE, then map to rows with one gather — the code-set filter."""
+    hits = pred(col.dictionary)
+    if not isinstance(hits, jax.Array) and hasattr(hits, "data"):
+        hits = hits.data  # pred returned a Column
+    return hits.astype(jnp.bool_)[col.codes.astype(jnp.int32)] & col.validity
+
+
+def canon_key_column(col: DictionaryColumn) -> Column:
+    """Single-word key substitute: ``canon[codes]`` as an int32 Column.
+
+    Equality- and order-equivalent to the column's full radix words, but
+    ONLY against keys from the same dictionary (same ``dict_token``) —
+    callers must check tokens (see ``align_encoded_key_columns``).
+    """
+    data = col.canon[col.codes.astype(jnp.int32)].astype(jnp.int32)
+    return Column(data, col.validity, T.INT32)
+
+
+def align_encoded_key_columns(lcols, rcols):
+    """Pairwise canon fast path for join keys: where BOTH sides are
+    dictionary columns over the same dictionary (token match — a static,
+    trace-safe check), substitute the single canon word; everything else
+    passes through to the gathered-words lowering, which is correct
+    across dictionaries."""
+    lout, rout = [], []
+    for lc, rc in zip(lcols, rcols):
+        if (isinstance(lc, DictionaryColumn)
+                and isinstance(rc, DictionaryColumn)
+                and lc.dict_token == rc.dict_token and lc.dict_token > 0):
+            lout.append(canon_key_column(lc))
+            rout.append(canon_key_column(rc))
+        else:
+            lout.append(lc)
+            rout.append(rc)
+    return lout, rout
+
+
+def reconcile_dictionaries(a: DictionaryColumn, b: DictionaryColumn):
+    """Re-encode two independently-encoded columns over ONE merged
+    dictionary (host-side) so joins between them take the canon fast
+    path.  O(d_a + d_b) — never touches row data."""
+    da, db = a.dictionary, b.dictionary
+    if type(da) is not type(db):
+        raise TypeError(f"dictionary type mismatch: {da!r} vs {db!r}")
+    if isinstance(da, StringColumn):
+        w = max(da.max_len, db.max_len)
+
+        def widen(c):
+            if c.max_len == w:
+                return c
+            chars = jnp.pad(c.chars, ((0, 0), (0, w - c.max_len)))
+            return StringColumn(chars, c.lengths, c.validity, c.dtype)
+
+        da, db = widen(da), widen(db)
+        merged = StringColumn(jnp.concatenate([da.chars, db.chars]),
+                              jnp.concatenate([da.lengths, db.lengths]),
+                              jnp.concatenate([da.validity, db.validity]))
+    elif isinstance(da, Decimal128Column):
+        merged = Decimal128Column(jnp.concatenate([da.limbs, db.limbs]),
+                                  jnp.concatenate([da.validity, db.validity]),
+                                  da.dtype)
+    else:
+        merged = Column(jnp.concatenate([da.data, db.data]),
+                        jnp.concatenate([da.validity, db.validity]),
+                        da.dtype)
+    rows = _bitview_rows(merged)
+    _, uidx, inv = np.unique(rows, axis=0, return_index=True,
+                             return_inverse=True)
+    dictionary = _take_dictionary(merged, uidx)
+    canon = _build_canon(dictionary)
+    token = next(_TOKENS)
+    na = a.dictionary.num_rows
+    remap = inv.reshape(-1).astype(np.uint32)
+    ra = jnp.asarray(remap[:na])
+    rb = jnp.asarray(remap[na:])
+
+    def rewrap(col, r):
+        return DictionaryColumn(r[col.codes.astype(jnp.int32)], col.validity,
+                                canon, dictionary, col.dtype, token)
+
+    return rewrap(a, ra), rewrap(b, rb)
+
+
+# ---- shuffle detach/reattach -----------------------------------------------
+
+def detach_dictionaries(batch: ColumnBatch):
+    """Strip dictionary + canon children so an exchange moves CODES only.
+
+    Returns ``(stripped, dicts)``: ``dicts`` maps column name ->
+    (canon, dictionary, dtype, token) for the once-per-shuffle broadcast;
+    ``stripped`` has ``None`` in their place (an empty pytree subtree, so
+    ``PartitionBuffer.nbytes`` and ``bytes_moved`` shrink automatically).
+    """
+    dicts = {}
+    cols = {}
+    for name, col in zip(batch.names, batch.columns):
+        if isinstance(col, DictionaryColumn) and col.dictionary is not None:
+            dicts[name] = (col.canon, col.dictionary, col.dtype,
+                           col.dict_token)
+            cols[name] = dataclasses.replace(col, canon=None, dictionary=None)
+        else:
+            cols[name] = col
+    return ColumnBatch(cols), dicts
+
+
+def reattach_dictionaries(batch: ColumnBatch, dicts) -> ColumnBatch:
+    """Rebind broadcast dictionaries onto a reassembled exchange output."""
+    if not dicts:
+        return batch
+    cols = {}
+    for name, col in zip(batch.names, batch.columns):
+        if name in dicts and isinstance(col, DictionaryColumn):
+            canon, dictionary, dtype, token = dicts[name]
+            cols[name] = DictionaryColumn(col.codes, col.validity, canon,
+                                          dictionary, dtype, token)
+        else:
+            cols[name] = col
+    return ColumnBatch(cols)
+
+
+# ---- knob ------------------------------------------------------------------
+
+def resolve_encoded_execution() -> bool:
+    """Resolve the ``encoded_execution`` knob (auto/on/off) at trace time.
+
+    'auto' = on for CPU, off for accelerators: the encoded paths lean on
+    gathers, which serialize on the TPU VPU, while XLA-CPU gathers are
+    near-free (same hardware facts as groupby_engine/join_engine).
+    """
+    from .. import config
+
+    mode = config.get("encoded_execution")
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(f"encoded_execution must be auto/on/off, got {mode!r}")
+    if mode == "auto":
+        return jax.default_backend() == "cpu"
+    return mode == "on"

@@ -166,6 +166,19 @@ _register("join_engine", "auto", str,
           "walk; bit-identical output, no build-side lax.sort), or "
           "'auto' (hash on CPU, sort on accelerators — same hardware "
           "facts as groupby_engine).")
+_register("encoded_execution", "auto", str,
+          "Dictionary/RLE encoded columnar execution "
+          "(columnar/encoded.py): 'on' encodes eligible columns at the "
+          "host boundary (Parquet dictionary pages pass through as "
+          "DictionaryColumn, bench inputs encode) and operators run on "
+          "u32 codes with late materialization; 'off' decodes "
+          "everything up front (the pre-PR-6 behavior); 'auto' = on for "
+          "CPU, off for accelerators (the encoded paths lean on "
+          "gathers, which serialize on the TPU VPU).  Bit-parity with "
+          "the decoded path is the correctness contract either way — "
+          "relational operators accept encoded and plain columns "
+          "mixed, so the knob only gates where encoding is "
+          "INTRODUCED.")
 _register("q6_float_mode", "f32x3", str,
           "Float-sum mode for the q6 onehot path: 'f32x3' (exact Dekker "
           "split, MXU-native, order-nondeterministic rounding) or 'f64' "

@@ -37,7 +37,13 @@ boundary — instrumented jitted callables — since there is no CUPTI:
   framework's post-write probe (name ``spill_corrupt_file``) — the
   framework responds by FLIPPING BYTES in the file it just wrote, so the
   checksum verification and lineage-recompute paths are proven against
-  real on-disk damage, not just a raised exception.
+  real on-disk damage, not just a raised exception,
+  ``"host_corrupt"`` raises :class:`HostCorruptionError` at the spill
+  framework's post-demotion probe (name ``host_corrupt_probe``) — the
+  framework flips bytes in the numpy HOST copy it just made, proving the
+  host tier's demotion-time CRC32s catch DRAM-resident damage on
+  promotion (and, via the handed-down disk metadata, after a host→disk
+  cascade).
 * ``dynamic: true`` re-reads the file when its mtime changes, matching
   the injector's ``dynamicReconfig`` thread without needing one.
 
@@ -112,6 +118,21 @@ class SpillCorruptionError(OSError):
     so callers treating disk loss generically catch both."""
 
 
+class HostCorruptionError(SpillCorruptionError):
+    """The HOST-tier copy of a spilled batch was damaged (kind
+    ``"host_corrupt"``).
+
+    Raised by the injector at the spill framework's post-demotion probe
+    (name ``host_corrupt_probe``), where the framework converts it into
+    real byte flips in the numpy copy it just made — the DRAM-error /
+    stray-write analogue of ``"spill_corrupt"``'s disk damage.  The
+    host tier records per-buffer CRC32s at demotion time and verifies
+    them on promotion (and hands them to the disk tier unchanged, so
+    damage that cascades host->disk is still caught at read-back).
+    Subclasses :class:`SpillCorruptionError` so the framework's existing
+    verify/lineage-rebuild path handles both damage sites."""
+
+
 def _raise_exception(name: str):
     raise InjectedFault(f"injected exception at {name}")
 
@@ -138,6 +159,10 @@ def _raise_spill_corrupt(name: str):
     raise SpillCorruptionError(f"injected spill corruption at {name}")
 
 
+def _raise_host_corrupt(name: str):
+    raise HostCorruptionError(f"injected host-tier corruption at {name}")
+
+
 # The registry of injectable fault flavors: kind -> raiser.  graftlint's
 # GL006 keeps this in sync with every use site statically — a kind used
 # in a config dict but missing here would otherwise only fail when its
@@ -153,6 +178,7 @@ FAULT_KINDS = {
     "spill_io": _raise_spill_io,
     "shuffle_io": _raise_shuffle_io,
     "spill_corrupt": _raise_spill_corrupt,
+    "host_corrupt": _raise_host_corrupt,
 }
 
 

@@ -69,10 +69,31 @@ def read_parquet(
     part_length: int = 1 << 62,
     ignore_case: bool = False,
 ) -> ColumnBatch:
-    """Read (a split of) a parquet file into a device ColumnBatch."""
+    """Read (a split of) a parquet file into a device ColumnBatch.
+
+    With the ``encoded_execution`` knob resolved on, string columns read
+    with ``read_dictionary``: their dictionary pages skip pyarrow's
+    decode and hand through as
+    :class:`~spark_rapids_jni_tpu.columnar.DictionaryColumn` (codes +
+    values), so the char-matrix padding cost is paid once per distinct
+    value instead of once per row.
+    """
+    from ..columnar.encoded import resolve_encoded_execution
+
     f = pq.ParquetFile(path)
     keep = select_row_groups(f.metadata, part_offset, part_length)
-    names = _match_columns(f.schema_arrow.names, columns, ignore_case)
+    schema = f.schema_arrow
+    names = _match_columns(schema.names, columns, ignore_case)
+    if resolve_encoded_execution():
+        import pyarrow as pa
+
+        dict_names = [n for n in names
+                      if pa.types.is_string(schema.field(n).type)
+                      or pa.types.is_large_string(schema.field(n).type)]
+        if dict_names:
+            # reopen with the dictionary set: pq decides per column chunk
+            # (a chunk that fell back to plain encoding still decodes)
+            f = pq.ParquetFile(path, read_dictionary=dict_names)
     if not keep:
         table = f.schema_arrow.empty_table().select(names)
     else:

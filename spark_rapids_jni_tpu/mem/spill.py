@@ -44,6 +44,12 @@ Integrity + lineage (the fault-domain hardening layer):
 * every leaf written to disk records a CRC32 + byte length (knob
   ``spill_checksum``), verified on read-back — a flipped bit in a spill
   file is DETECTED, never silently computed on;
+* the HOST tier records the same metadata at device→host demotion and
+  verifies it at promotion, and the disk tier inherits the
+  demotion-time record rather than re-hashing at write time — so damage
+  to the DRAM copy is caught whether the batch promotes straight back
+  or first cascades host→disk (probe ``host_corrupt_probe``, fault
+  kind ``"host_corrupt"``, exercised by the chaos campaign);
 * a handle constructed with ``recompute=`` carries its lineage: when the
   spilled copy comes back corrupt (checksum mismatch), truncated, or not
   at all (file deleted, unreadable header), the handle discards the
@@ -104,6 +110,22 @@ _read_leaf = faultinj.instrument(_read_leaf, "spill_io_read")
 # in that file (fault kind "spill_corrupt"), so verification is exercised
 # against genuine damage, not a synthetic exception
 _corrupt_probe = faultinj.instrument(lambda: None, "spill_corrupt_file")
+
+# post-demotion corruption probe: fires AFTER the device tree is copied
+# into host numpy buffers; the handler flips bytes in the copy just made
+# (fault kind "host_corrupt") — the DRAM-error analogue of the disk probe
+_host_corrupt_probe = faultinj.instrument(lambda: None, "host_corrupt_probe")
+
+
+def _flip_host_bytes(arr: np.ndarray, n: int = 8) -> np.ndarray:
+    """XOR the last ``n`` bytes of a host buffer (returned as a copy:
+    ``device_get`` views may be read-only) — same damage shape as
+    :func:`_flip_file_bytes`, applied to DRAM instead of disk."""
+    flat = np.ascontiguousarray(arr).view(np.uint8).reshape(-1).copy()
+    n = min(n, flat.size)
+    if n > 0:
+        flat[-n:] ^= 0xFF
+    return flat.view(arr.dtype)[: arr.size].reshape(arr.shape)
 
 
 def _flip_file_bytes(path: str, n: int = 8) -> None:
@@ -240,6 +262,7 @@ class SpillableHandle:
         self._lock = threading.RLock()
         self._tree = tree
         self._host: Optional[List[np.ndarray]] = None
+        self._host_meta: Optional[List[Tuple[int, int]]] = None
         self._disk: Optional[List[str]] = None
         self._disk_meta: Optional[List[Tuple[int, int]]] = None
         self._recompute = recompute
@@ -352,6 +375,23 @@ class SpillableHandle:
                     # sharded, not gathered onto one device
                     shardings.append(getattr(leaf, "sharding", None))
                 index.append(uniq[key])
+            from .. import config
+
+            if bool(config.get("spill_checksum")):
+                # demotion-time CRCs: the device tree is the authoritative
+                # content — promotions verify against these, and the disk
+                # tier inherits them, so damage in EITHER lower tier is
+                # detected before anything computes on it
+                self._host_meta = [_leaf_meta(a) for a in host]
+            else:
+                self._host_meta = None
+            try:
+                _host_corrupt_probe()
+            except faultinj.HostCorruptionError:
+                # injected corruption becomes real byte flips in the host
+                # copy just made; detection is promotion's job
+                if host:
+                    host[-1] = _flip_host_bytes(host[-1])
             nbytes = int(sum(a.nbytes for a in host))
             self._host = host
             self._leaf_index = index
@@ -410,10 +450,16 @@ class SpillableHandle:
         try:
             for i, arr in enumerate(self._host):
                 p = os.path.join(fw.spill_dir, f"{self.name}-{i}.npy")
-                # integrity metadata comes from the in-memory array, the
-                # authoritative content, BEFORE disk touches it
-                meta.append(_leaf_meta(arr) if checksum
-                            else (0, int(arr.nbytes)))
+                # integrity metadata comes from the DEMOTION-time record
+                # when the host tier kept one: if the host copy was
+                # damaged while resident, the bad bytes land on disk with
+                # the original CRC and read-back verification catches it
+                # (re-hashing here would launder the damage)
+                if self._host_meta is not None:
+                    meta.append(self._host_meta[i])
+                else:
+                    meta.append(_leaf_meta(arr) if checksum
+                                else (0, int(arr.nbytes)))
                 _write_leaf(p, arr)
                 paths.append(p)
                 try:
@@ -432,8 +478,10 @@ class SpillableHandle:
             return 0
         nbytes = int(sum(a.nbytes for a in self._host))
         self._disk = paths
-        self._disk_meta = meta if checksum else None
+        self._disk_meta = (meta if checksum or self._host_meta is not None
+                           else None)
         self._host = None
+        self._host_meta = None
         freed = self._host_charged
         if self._host_charged:
             fw._uncharge_host(self._host_charged)
@@ -491,6 +539,18 @@ class SpillableHandle:
                     fw.metrics.record(
                         "disk_to_host", int(sum(a.nbytes for a in host)),
                         self.task_id)
+            else:
+                try:
+                    self._verify_host_locked(host)
+                except faultinj.SpillCorruptionError as e:
+                    if fw is not None:
+                        fw.metrics.corrupt_read(self.task_id)
+                    if self._recompute is None:
+                        raise faultinj.HostCorruptionError(
+                            f"{self.name}: host-tier copy corrupt and no "
+                            f"recompute= lineage to rebuild from: {e!r}"
+                        ) from e
+                    return self._rebuild_locked()
             nbytes = int(sum(a.nbytes for a in host))
             if self._ctx is not None:
                 # may raise RetryOOM: the host copies (or disk files) are
@@ -521,11 +581,26 @@ class SpillableHandle:
                 fw._uncharge_host(self._host_charged)
             self._host_charged = 0
             self._host = None
+            self._host_meta = None
             self._shardings = None
             self._remove_disk_files_locked()
             if fw is not None:
                 fw.metrics.record("host_to_device", nbytes, self.task_id)
             return tree
+
+    def _verify_host_locked(self, host: List[np.ndarray]) -> None:
+        """Verify host-resident leaves against their demotion-time CRC32
+        + byte length (recorded when ``spill_checksum`` was on)."""
+        if self._host_meta is None:
+            return
+        for i, (arr, (crc, nbytes)) in enumerate(
+                zip(host, self._host_meta)):
+            got_crc, got_nbytes = _leaf_meta(arr)
+            if got_nbytes != nbytes or got_crc != crc:
+                raise faultinj.HostCorruptionError(
+                    f"host buffer {i} of {self.name}: demoted {nbytes}B "
+                    f"crc={crc:#010x}, resident {got_nbytes}B "
+                    f"crc={got_crc:#010x}")
 
     def _read_disk_verified_locked(self) -> List[np.ndarray]:
         """Load the disk tier, verifying each leaf against its recorded
@@ -557,6 +632,7 @@ class SpillableHandle:
         """
         self._remove_disk_files_locked()
         self._host = None
+        self._host_meta = None
         self._treedef = None
         self._leaf_index = None
         self._shardings = None
@@ -601,6 +677,7 @@ class SpillableHandle:
             self._remove_disk_files_locked()
             self._tree = None
             self._host = None
+            self._host_meta = None
             self._shardings = None
             self._treedef = None
         if self._fw is not None:

@@ -404,11 +404,19 @@ def _as_columns(columns: Columns):
     this)."""
     from ..columnar.bucketed import BucketedStringColumn
     from ..columnar.column import StructColumn
+    from ..columnar.encoded import is_encoded, materialize_column
 
     cols = columns.columns if isinstance(columns, ColumnBatch) else list(columns)
     out = []
 
     def expand(c, parent_valid=None):
+        if is_encoded(c):
+            # hash VALUES, not codes: Spark-exact row hashes must agree
+            # bit-for-bit with the decoded path, and the murmur/xxhash
+            # fold threads per-row carry state, so the per-entry hash is
+            # not separable — one gather materializes the column here (a
+            # sanctioned late-materialization point)
+            c = materialize_column(c)
         if isinstance(c, BucketedStringColumn):
             c = c.merge()
         if isinstance(c, StructColumn):

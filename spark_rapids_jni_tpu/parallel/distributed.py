@@ -7,6 +7,7 @@ sees the collective and the surrounding compute together and overlaps them.
 
 from __future__ import annotations
 
+import dataclasses
 from functools import lru_cache, partial
 from typing import Optional, Sequence
 
@@ -16,6 +17,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..columnar.column import ColumnBatch
+from ..columnar.encoded import DictionaryColumn, RunLengthColumn
 from ..relational.aggregate import AggSpec, group_by
 from .partition import spark_partition_id
 from .shuffle import exchange, plan_capacity
@@ -30,9 +32,32 @@ def data_mesh(num_devices: Optional[int] = None, axis_name: str = "data") -> Mes
 
 
 def shard_batch(batch: ColumnBatch, mesh: Mesh, axis_name: str = "data") -> ColumnBatch:
-    """Place a batch row-sharded over the mesh (rows % devices == 0)."""
+    """Place a batch row-sharded over the mesh (rows % devices == 0).
+
+    Encoded columns shard by their ROW-length leaves: dictionary + canon
+    are [d]-sized lookup tables every device reads, so they replicate;
+    RLE's [r]-sized run leaves have no row decomposition at all, so runs
+    decode here (sharding is an output boundary for a local encoding).
+    """
     sharding = NamedSharding(mesh, PartitionSpec(axis_name))
-    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)
+    replicated = NamedSharding(mesh, PartitionSpec())
+    cols = {}
+    for name, col in zip(batch.names, batch.columns):
+        if isinstance(col, RunLengthColumn):
+            col = col.decode()
+        if isinstance(col, DictionaryColumn) and col.dictionary is not None:
+            cols[name] = dataclasses.replace(
+                col,
+                codes=jax.device_put(col.codes, sharding),
+                validity=jax.device_put(col.validity, sharding),
+                canon=jax.device_put(col.canon, replicated),
+                dictionary=jax.tree_util.tree_map(
+                    lambda x: jax.device_put(x, replicated),
+                    col.dictionary))
+        else:
+            cols[name] = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, sharding), col)
+    return ColumnBatch(cols)
 
 
 def distributed_group_by(

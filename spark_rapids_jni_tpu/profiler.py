@@ -18,6 +18,9 @@ captures into JSON offline).  The TPU equivalent wraps the XLA profiler
 Frame format: ``b"SPTPUPRF" u32(version) [u32(len) bytes]*`` — the same
 size-prefixed-records idea as ``profiler.fbs`` (``ProfileHeader`` magic +
 ``ActivityRecords``), carrying trace files instead of CUPTI activities.
+When a fault-injection schedule fired during the window, one synthetic
+``faultinj.fired.json`` frame carries :func:`faultinj.fired_log` so the
+capture explains its own anomalies.
 """
 
 from __future__ import annotations
@@ -124,6 +127,19 @@ class Profiler:
             buf.write(struct.pack("<I", len(rec)))
             buf.write(rec)
             os.remove(path)
+        # fault-injection trace rides the capture: when a schedule fired
+        # inside this collection window the (name, fault, occurrence)
+        # log lands as a synthetic frame, so a profile of a chaos run is
+        # self-describing about which faults shaped its timeline
+        from . import faultinj
+
+        fired = faultinj.fired_log()
+        if fired:
+            name = b"faultinj.fired.json"
+            payload = json.dumps(fired).encode()
+            rec = struct.pack("<I", len(name)) + name + payload
+            buf.write(struct.pack("<I", len(rec)))
+            buf.write(rec)
         data = buf.getvalue()
         if data:
             cls._writer(data)
@@ -326,7 +342,10 @@ def convert_profile(capture_path: str) -> List[dict]:
       "tid", "pid"} records;
     * ``*.xplane.pb`` XSpace protos -> {"name", "ts_us", "dur_us",
       "plane", "line"} records, where device planes carry the per-kernel
-      activity (the reference's CUPTI record role).
+      activity (the reference's CUPTI record role);
+    * the synthetic ``faultinj.fired.json`` frame -> one
+      ``faultinj:<kind>@<boundary>`` record per injection that fired in
+      the window, carrying the injector's (seq, occurrence) clock.
     """
     with open(capture_path, "rb") as f:
         data = f.read()
@@ -349,6 +368,18 @@ def convert_profile(capture_path: str) -> List[dict]:
                     )
         elif name.endswith(".xplane.pb"):
             events.extend(_decode_xspace(payload))
+        elif name == "faultinj.fired.json":
+            for e in json.loads(payload):
+                events.append({
+                    "name": (f"faultinj:{e.get('fault')}"
+                             f"@{e.get('name')}"),
+                    "ts_us": 0.0,
+                    "dur_us": 0.0,
+                    "fault": e.get("fault"),
+                    "boundary": e.get("name"),
+                    "occurrence": e.get("occurrence"),
+                    "seq": e.get("seq"),
+                })
     return events
 
 

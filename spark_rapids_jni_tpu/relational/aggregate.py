@@ -61,10 +61,43 @@ import jax.numpy as jnp
 
 from ..columnar import types as T
 from ..columnar.column import Column, ColumnBatch, Decimal128Column, StringColumn
+from ..columnar.encoded import (
+    DictionaryColumn,
+    canon_key_column,
+    is_encoded,
+    materialize_batch,
+    materialize_column,
+)
 from . import keys as K
 from .gather import gather_column
 
 _OPS = ("sum", "count", "min", "max", "mean")
+
+
+def _canon_keys(key_cols):
+    """Key-column substitution for the encoded fast path: within ONE
+    batch every dictionary column's ``canon[codes]`` single word is both
+    equality- and order-equivalent to its full gathered radix words, so
+    both engines key on one u32 word and still emit bit-identical group
+    order.  Output key columns gather from the ORIGINAL (still encoded)
+    batch columns — only the key lowering is substituted."""
+    return [canon_key_column(c) if isinstance(c, DictionaryColumn) else c
+            for c in key_cols]
+
+
+def _materialize_agg_values(batch, aggs):
+    """Late-materialize encoded agg VALUE columns at the point of need
+    (aggregation arithmetic runs on values, not codes); key columns stay
+    encoded all the way to the output gather."""
+    repl = {}
+    for spec in aggs:
+        c = spec.column
+        if c is not None and c not in repl and is_encoded(batch[c]):
+            repl[c] = materialize_column(batch[c])
+    if not repl:
+        return batch
+    return ColumnBatch({n: repl.get(n, col)
+                        for n, col in zip(batch.names, batch.columns)})
 
 
 @dataclasses.dataclass(frozen=True)
@@ -251,7 +284,8 @@ def group_by(
 def _group_by_sortscan(batch, key_names, aggs, row_valid, assume_grouped):
     """The sort engine: one stable multi-operand sort, then scans."""
     n = batch.num_rows
-    key_cols = [batch[k] for k in key_names]
+    batch = _materialize_agg_values(batch, aggs)
+    key_cols = _canon_keys([batch[k] for k in key_names])
     karr = K.batch_radix_keys(key_cols, equality=True, nulls_first=True)
     have_rv = row_valid is not None
     if have_rv:
@@ -482,7 +516,8 @@ def _group_by_hash(batch, key_names, aggs, row_valid, num_slots):
     from . import hashtable as H
 
     n = batch.num_rows
-    key_cols = [batch[k] for k in key_names]
+    batch = _materialize_agg_values(batch, aggs)
+    key_cols = _canon_keys([batch[k] for k in key_names])
     karr = K.batch_radix_keys(key_cols, equality=True, nulls_first=True)
     row_live = jnp.ones((n,), jnp.bool_) if row_valid is None else \
         row_valid.astype(jnp.bool_)
@@ -747,6 +782,9 @@ def _domain_partials(batch, key_name, aggs, domain, row_valid=None,
     the parts of their concatenated rows.  min/max are not expressible
     this way under psum and stay on the sort-scan path.
     """
+    # the domain engines run arithmetic on raw key/value buffers: encoded
+    # columns materialize here (their late point of need)
+    batch = materialize_batch(batch)
     if engine == "auto":
         engine = "scatter" if jax.default_backend() == "cpu" else "xla"
     if engine == "scatter":
@@ -1128,6 +1166,7 @@ def _domain_partials_scatter(batch, key_name, aggs, domain, row_valid=None):
     """Scatter/segment-sum engine for :func:`_domain_partials`."""
     from jax.ops import segment_sum
 
+    batch = materialize_batch(batch)  # direct group_by_scatter entry
     K = int(domain)
     col = batch[key_name]
     if col.dtype.kind not in (T.Kind.INT8, T.Kind.INT16, T.Kind.INT32,
@@ -1235,7 +1274,7 @@ def group_by_domain_or_sort(
     n = batch.num_rows
     K = int(domain)
     pad_to = max(n, K + 1)
-    col = batch[key_name]
+    col = materialize_column(batch[key_name])
     row_live = jnp.ones((n,), jnp.bool_) if row_valid is None else \
         row_valid.astype(jnp.bool_)
     _, overflow = _domain_bucket_overflow(col, col.validity & row_live, K)

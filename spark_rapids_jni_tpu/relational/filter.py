@@ -15,6 +15,9 @@ import dataclasses
 import jax.numpy as jnp
 
 from ..columnar.column import ColumnBatch
+from ..columnar.encoded import predicate_mask  # noqa: F401  (encoded filter
+# path: evaluate the predicate over the d-entry dictionary once, map to
+# rows with one gather — re-exported here as part of the filter API)
 from .gather import gather_batch
 
 

@@ -8,16 +8,30 @@ matrix gathers whole padded rows (a 2-D gather with a broadcast index).
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax.numpy as jnp
 
 from ..columnar.column import Column, ColumnBatch, Decimal128Column, StringColumn
+from ..columnar.encoded import DictionaryColumn, RunLengthColumn
 
 
 def gather_column(col, idx, valid=None):
     """Take rows ``idx`` (int32[m], clipped); rows where ``valid`` is False
     become nulls (used for padded filter/join outputs)."""
+    if isinstance(col, RunLengthColumn):
+        # runs do not survive an arbitrary permutation: decode here (a
+        # sanctioned materialization point) so RLE never flows deeper
+        col = col.decode()
     n = col.num_rows
     idx = jnp.clip(idx, 0, max(n - 1, 0))
+    if isinstance(col, DictionaryColumn):
+        # gather CODES; the dictionary (and its token) ride along, so the
+        # output stays encoded through compaction and join materialization
+        v = col.validity[idx]
+        if valid is not None:
+            v = v & valid
+        return dataclasses.replace(col, codes=col.codes[idx], validity=v)
     if isinstance(col, StringColumn):
         v = col.validity[idx]
         if valid is not None:

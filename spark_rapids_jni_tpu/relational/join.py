@@ -40,6 +40,11 @@ import jax
 import jax.numpy as jnp
 
 from ..columnar.column import Column, ColumnBatch, Decimal128Column, StringColumn
+from ..columnar.encoded import (
+    DictionaryColumn,
+    RunLengthColumn,
+    align_encoded_key_columns,
+)
 from . import keys as K
 from .filter import compact
 from .gather import gather_batch
@@ -102,9 +107,21 @@ def _one_null_row_like(batch: ColumnBatch) -> ColumnBatch:
     The padding row can never match: its null flag differs from every valid
     probe key, and ``counts`` is forced to zero anyway.
     """
+    import dataclasses as _dc
+
     out = {}
     for name, col in zip(batch.names, batch.columns):
         invalid = jnp.zeros((1,), jnp.bool_)
+        if isinstance(col, DictionaryColumn):
+            # keep the dictionary (and token): downstream concat/keys see
+            # a same-dictionary column whose one row is null
+            out[name] = _dc.replace(col, codes=jnp.zeros((1,), jnp.uint32),
+                                    validity=invalid)
+            continue
+        if isinstance(col, RunLengthColumn):
+            out[name] = Column(
+                jnp.zeros((1,), col.dtype.jnp_dtype), invalid, col.dtype)
+            continue
         if isinstance(col, StringColumn):
             out[name] = StringColumn(
                 jnp.zeros((1, col.max_len), jnp.uint8),
@@ -221,9 +238,17 @@ def hash_join(
         left = _one_null_row_like(left)
         nl = 1
         left_valid = jnp.zeros((1,), jnp.bool_)
-    lcols, rcols = K.align_string_key_columns(
-        [left[k] for k in left_on], [right[k] for k in right_on]
-    )
+    lkcols = [left[k] for k in left_on]
+    rkcols = [right[k] for k in right_on]
+    if prebuilt is None:
+        # canon fast path: key pairs over the SAME dictionary (static
+        # dict_token match) collapse to one u32 word per column; pairs
+        # from different dictionaries keep the gathered-value-words
+        # lowering, which is correct across dictionaries — the decoded
+        # fallback inside the same program.  A prebuilt table's keys are
+        # always value words, so substitution is skipped for it.
+        lkcols, rkcols = align_encoded_key_columns(lkcols, rkcols)
+    lcols, rcols = K.align_string_key_columns(lkcols, rkcols)
     if right_valid is not None:
         import dataclasses as _dc
 
@@ -416,8 +441,10 @@ def join_dense_or_hash(
     """
     lcol, rcol = left[left_on], right[right_on]
     eligible = (how == "inner" and domain > 0
-                and not isinstance(lcol, (StringColumn, Decimal128Column))
-                and not isinstance(rcol, (StringColumn, Decimal128Column))
+                and not isinstance(lcol, (StringColumn, Decimal128Column,
+                                          DictionaryColumn, RunLengthColumn))
+                and not isinstance(rcol, (StringColumn, Decimal128Column,
+                                          DictionaryColumn, RunLengthColumn))
                 and jnp.issubdtype(lcol.data.dtype, jnp.integer)
                 and jnp.issubdtype(rcol.data.dtype, jnp.integer)
                 and right.num_rows > 0)
@@ -500,6 +527,18 @@ def _merge_parts(lpart: ColumnBatch, rpart: ColumnBatch,
 
 
 def _concat_col(a, b):
+    if isinstance(a, DictionaryColumn) or isinstance(b, DictionaryColumn):
+        import dataclasses as _dc
+
+        if (isinstance(a, DictionaryColumn) and isinstance(b, DictionaryColumn)
+                and a.dict_token == b.dict_token and a.dict_token > 0):
+            # same dictionary: codes concatenate directly, stays encoded
+            return _dc.replace(a, codes=jnp.concatenate([a.codes, b.codes]),
+                               validity=jnp.concatenate([a.validity,
+                                                         b.validity]))
+        from ..columnar.encoded import materialize_column
+
+        a, b = materialize_column(a), materialize_column(b)
     if isinstance(a, StringColumn):
         W = max(a.max_len, b.max_len)
 
@@ -558,7 +597,10 @@ def spillable_build_table(right: ColumnBatch, right_on: Sequence[str],
     if right.num_rows == 0:
         raise ValueError("cannot pre-build an empty build side")
     rcols = [right[k] for k in right_on]
-    if any(isinstance(c, StringColumn) for c in rcols):
+    if any(isinstance(c, StringColumn)
+           or (isinstance(c, DictionaryColumn)
+               and isinstance(c.dictionary, StringColumn))
+           for c in rcols):
         raise ValueError(
             "string join keys cannot be pre-built: their radix key width "
             "depends on the probe side (align_string_key_columns)")

@@ -25,6 +25,7 @@ detection (group-by) and lexicographic binary search (join probe).
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Sequence
 
 import jax
@@ -33,6 +34,7 @@ import numpy as np
 
 from ..columnar import types as T
 from ..columnar.column import Column, Decimal128Column, StringColumn
+from ..columnar.encoded import DictionaryColumn, RunLengthColumn
 
 # numpy, not jnp: module scope must not mint device arrays (GL001)
 _SIGN32 = np.uint32(0x80000000)
@@ -82,6 +84,20 @@ def column_radix_keys(col, *, equality: bool = False) -> list:
     ``Double.compare``.  NaNs canonicalize in both domains (Java has one NaN,
     greater than +Inf).
     """
+    if isinstance(col, DictionaryColumn):
+        # words computed once on the d-entry dictionary, then gathered by
+        # code: cross-dictionary safe (both sides lower to VALUE words),
+        # and the per-row cost is one gather instead of a padded compare.
+        # The single-word canon fast path lives in encoded.py and is
+        # substituted by callers only under a dict_token match.
+        idx = col.codes.astype(jnp.int32)
+        return [w[idx] for w in
+                column_radix_keys(col.dictionary, equality=equality)]
+    if isinstance(col, RunLengthColumn):
+        run = col.row_to_run()
+        values = Column(col.run_values,
+                        jnp.ones((col.num_runs,), jnp.bool_), col.dtype)
+        return [w[run] for w in column_radix_keys(values, equality=equality)]
     if isinstance(col, StringColumn):
         chars, L = col.chars, col.max_len
         nwords = max(1, -(-L // 4))
@@ -262,20 +278,35 @@ def align_string_key_columns(lcols: Sequence, rcols: Sequence):
     """
     from ..columnar.column import StringColumn as _S
 
+    def str_width(c):
+        """Char-matrix width if the column lowers to string words."""
+        if isinstance(c, _S):
+            return c.max_len
+        if isinstance(c, DictionaryColumn) and isinstance(c.dictionary, _S):
+            return c.dictionary.max_len
+        return None
+
+    def pad_to(c, width):
+        if isinstance(c, DictionaryColumn):
+            d = c.dictionary
+            if d.max_len == width:
+                return c
+            chars = jnp.pad(d.chars, ((0, 0), (0, width - d.max_len)))
+            return dataclasses.replace(
+                c, dictionary=_S(chars, d.lengths, d.validity, d.dtype))
+        if c.max_len == width:
+            return c
+        chars = jnp.pad(c.chars, ((0, 0), (0, width - c.max_len)))
+        return _S(chars, c.lengths, c.validity, c.dtype)
+
     lout, rout = [], []
     for lc, rc in zip(lcols, rcols):
-        if isinstance(lc, _S) != isinstance(rc, _S):
+        lw, rw = str_width(lc), str_width(rc)
+        if (lw is None) != (rw is None):
             raise TypeError(f"join key type mismatch: {lc.dtype!r} vs {rc.dtype!r}")
-        if isinstance(lc, _S) and lc.max_len != rc.max_len:
-            width = max(lc.max_len, rc.max_len)
-
-            def pad(c):
-                if c.max_len == width:
-                    return c
-                chars = jnp.pad(c.chars, ((0, 0), (0, width - c.max_len)))
-                return _S(chars, c.lengths, c.validity, c.dtype)
-
-            lc, rc = pad(lc), pad(rc)
+        if lw is not None and lw != rw:
+            width = max(lw, rw)
+            lc, rc = pad_to(lc, width), pad_to(rc, width)
         lout.append(lc)
         rout.append(rc)
     return lout, rout

@@ -51,6 +51,12 @@ from jax.sharding import PartitionSpec
 
 from .. import faultinj
 from ..columnar.column import ColumnBatch
+from ..columnar.encoded import (
+    DictionaryColumn,
+    RunLengthColumn,
+    detach_dictionaries,
+    reattach_dictionaries,
+)
 from ..mem.executor import run_with_retry
 from ..parallel.partition import regroup_order, spark_partition_id
 from ..parallel.shuffle import route_out_of_range
@@ -245,6 +251,30 @@ class ShuffleService:
         sid = self.registry.begin_shuffle()
         spill_base = _spill_snapshot()
 
+        # 0. encoded columns: the exchange moves CODES; each dictionary is
+        # broadcast ONCE per shuffle (host-side reattach after reassembly)
+        # so plan_rounds capacity math and every all_to_all see the u32
+        # code width, not the value width.  RLE decodes here: runs do not
+        # survive the destination-major regroup, and their [r]-shaped
+        # leaves cannot ride the row-sharded specs.
+        if any(isinstance(c, RunLengthColumn) for c in batch.columns):
+            batch = ColumnBatch({
+                name: c.decode() if isinstance(c, RunLengthColumn) else c
+                for name, c in zip(batch.names, batch.columns)})
+        dicts = {}
+        if any(isinstance(c, DictionaryColumn) for c in batch.columns):
+            if key_names is not None and any(
+                    isinstance(batch[k], DictionaryColumn)
+                    for k in key_names):
+                # Spark-exact pids hash key VALUES; compute them before
+                # stripping the dictionaries (elementwise, so it runs on
+                # the row-sharded globals without a shard_map) and route
+                # the map step by pid — bit-identical to the keyed path.
+                pid = spark_partition_id(
+                    [batch[k] for k in key_names], P, row_valid)
+                key_names = None
+            batch, dicts = detach_dictionaries(batch)
+
         # 1. map: regroup destination-major + the count matrix
         if key_names is not None:
             step = _map_step_keys(mesh, axis, tuple(key_names),
@@ -331,6 +361,16 @@ class ShuffleService:
             map_buf.close()
             for c in chunks:
                 c.close()
+
+        if dicts:
+            # the once-per-shuffle broadcast: rebind each dictionary to
+            # the reassembled codes and charge its bytes ONCE (not once
+            # per round) so bytes_moved stays an honest transfer count
+            final_batch = reattach_dictionaries(final_batch, dicts)
+            bytes_moved += sum(
+                leaf.size * leaf.dtype.itemsize
+                for _, (canon, dictionary, _, _) in sorted(dicts.items())
+                for leaf in jax.tree_util.tree_leaves((canon, dictionary)))
 
         spilled = 0
         if spill_base is not None:

@@ -1,0 +1,582 @@
+"""Encoded columnar execution: dictionary/RLE columns with late
+materialization (columnar/encoded.py).
+
+The correctness contract is BIT-PARITY with the decoded path: every
+relational operator fed encoded columns must produce output that decodes
+to exactly what the plain-column plan produces — same values, same
+validity, same group/match order.  Covers:
+
+* encode/decode round trips are bit-exact (bit-distinct dictionary:
+  ``-0.0``/``0.0`` stay separate entries, NaNs keep their payloads);
+* the code-set filter (``predicate_mask``) matches the row-wise mask;
+* joins on encoded keys across every how — the same-token canon fast
+  path, the cross-dictionary gathered-words fallback, mixed
+  encoded/plain sides, and ``reconcile_dictionaries``;
+* group-by on encoded/RLE keys across all aggs and both engines, with
+  encoded VALUE columns late-materializing at the point of need;
+* the ShuffleService exchange moves CODES (fewer bytes than the decoded
+  exchange; dictionary broadcast charged once) and reattaches
+  dictionaries losslessly;
+* SpillableHandle round-trips encoded batches through all three tiers,
+  and the ``host_corrupt`` fault is detected at promotion / disk
+  read-back and recovered through ``recompute=`` lineage.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu import config, faultinj
+from spark_rapids_jni_tpu.columnar import types as T
+from spark_rapids_jni_tpu.columnar.column import (
+    Column, ColumnBatch, Decimal128Column, StringColumn)
+from spark_rapids_jni_tpu.columnar.encoded import (
+    DictionaryColumn,
+    RunLengthColumn,
+    align_encoded_key_columns,
+    dictionary_from_arrays,
+    encode_batch,
+    encode_column,
+    encode_rle,
+    is_encoded,
+    materialize_batch,
+    predicate_mask,
+    reconcile_dictionaries,
+    resolve_encoded_execution,
+)
+from spark_rapids_jni_tpu.mem import SpillableHandle
+from spark_rapids_jni_tpu.mem import spill as spill_mod
+from spark_rapids_jni_tpu.relational import AggSpec, group_by, hash_join
+from spark_rapids_jni_tpu.relational.filter import apply_mask
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    yield
+    config.reset()
+    faultinj.configure({})
+
+
+def col_i32(vals, valid=None):
+    vals = np.asarray(vals, np.int32)
+    v = np.ones(len(vals), bool) if valid is None else np.asarray(valid, bool)
+    return Column(jnp.asarray(vals), jnp.asarray(v), T.INT32)
+
+
+def col_f64(vals, valid=None):
+    vals = np.asarray(vals, np.float64)
+    v = np.ones(len(vals), bool) if valid is None else np.asarray(valid, bool)
+    return Column(jnp.asarray(vals), jnp.asarray(v), T.FLOAT64)
+
+
+def assert_bit_exact(name, got, want):
+    """Decoded column == original column over VALID rows, bitwise."""
+    gv, wv = np.asarray(got.validity), np.asarray(want.validity)
+    assert np.array_equal(gv, wv), f"{name}: validity"
+    if isinstance(want, StringColumn):
+        assert got.to_pylist() == want.to_pylist(), f"{name}: strings"
+        return
+    gd = np.asarray(got.data)[wv]
+    wd = np.asarray(want.data)[wv]
+    # bitwise: -0.0 != 0.0, NaN payloads compared as raw bytes
+    assert np.array_equal(gd.view(np.uint8), wd.view(np.uint8)), \
+        f"{name}: data bits"
+
+
+def assert_batches_equal(name, a, ca, b, cb, approx=()):
+    """Live-prefix equality via to_pylist (decodes encoded outputs)."""
+    na, nb = int(ca), int(cb)
+    assert na == nb, f"{name}: count {na} != {nb}"
+    assert a.names == b.names, f"{name}: {a.names} vs {b.names}"
+    for coln in a.names:
+        la = a[coln].to_pylist()[:na]
+        lb = b[coln].to_pylist()[:na]
+        if coln in approx:
+            for x, y in zip(la, lb):
+                if x is None or y is None:
+                    assert x == y, f"{name}/{coln}: null mismatch"
+                elif isinstance(x, float) and np.isnan(x):
+                    assert np.isnan(y), f"{name}/{coln}: NaN"
+                else:
+                    assert y == pytest.approx(x, rel=1e-12), f"{name}/{coln}"
+        else:
+            # NaN != NaN under ==, so compare via repr-stable numpy
+            for x, y in zip(la, lb):
+                same = (x == y) or (
+                    isinstance(x, float) and isinstance(y, float)
+                    and np.isnan(x) and np.isnan(y))
+                assert same, f"{name}/{coln}: {x!r} != {y!r}"
+
+
+# ---------------------------------------------------------------------------
+# encode / decode round trips
+# ---------------------------------------------------------------------------
+
+class TestRoundTrip:
+    def test_int_with_nulls(self):
+        rng = np.random.default_rng(1)
+        c = col_i32(rng.integers(0, 20, 200), rng.random(200) > 0.15)
+        enc = encode_column(c)
+        assert is_encoded(enc) and enc.num_rows == 200
+        # nulls borrow an existing identity: dictionary covers live only
+        live = np.unique(np.asarray(c.data)[np.asarray(c.validity)])
+        assert enc.num_entries <= len(live) + 1
+        assert_bit_exact("int", enc.decode(), c)
+        assert enc.to_pylist() == c.to_pylist()
+
+    def test_float_bit_distinct_entries(self):
+        vals = np.array([1.5, -0.0, 0.0, np.nan, -0.0, 1.5, np.nan])
+        c = col_f64(vals)
+        enc = encode_column(c)
+        # -0.0 and 0.0 are DISTINCT entries (decode must be bit-exact)...
+        assert enc.num_entries == 4
+        dec = enc.decode()
+        assert_bit_exact("float", dec, c)
+        assert np.signbit(np.asarray(dec.data)[1]) and not np.signbit(
+            np.asarray(dec.data)[2])
+        # ...but ONE equality class: canon collapses -0.0 == 0.0
+        canon = np.asarray(enc.canon)
+        codes = np.asarray(enc.codes)
+        assert canon[codes[1]] == canon[codes[2]]
+
+    def test_string_with_nulls(self):
+        vals = ["ab", None, "abcdef", "ab", "", None, "zz"]
+        c = StringColumn.from_pylist(vals, max_len=128)
+        enc = encode_column(c)
+        assert enc.num_entries == 4  # ab, abcdef, "", zz
+        assert enc.to_pylist() == vals
+        assert enc.decode().to_pylist() == vals
+        # the dictionary is width-planned (bucketed ladder), not inflated
+        # to the row column's 128-byte pad width
+        assert enc.dictionary.max_len < 128
+
+    def test_decimal(self):
+        vals = [10 ** 20, -(10 ** 19), None, 10 ** 20, 0]
+        c = Decimal128Column.from_unscaled(vals, 38, 2)
+        enc = encode_column(c)
+        assert enc.num_entries == 3
+        assert enc.to_pylist() == c.to_pylist()
+
+    def test_empty(self):
+        enc = encode_column(col_i32([]))
+        assert enc.num_rows == 0
+        assert enc.decode().to_pylist() == []
+
+    def test_rle_round_trip(self):
+        vals = np.repeat([3, 7, 7, 1, 9], [10, 5, 4, 20, 1])
+        v = np.ones(40, bool)
+        v[::7] = False
+        c = Column(jnp.asarray(vals.astype(np.int64)), jnp.asarray(v),
+                   T.INT64)
+        r = encode_rle(c)
+        # adjacent equal values merge: 7,7 is one run
+        assert r.num_runs == 4
+        assert_bit_exact("rle", r.decode(), c)
+        assert r.to_pylist() == c.to_pylist()
+        run = np.asarray(r.row_to_run())
+        assert run[0] == 0 and run[9] == 0 and run[10] == 1
+        assert run[-1] == r.num_runs - 1
+
+    def test_rle_rejects_strings(self):
+        with pytest.raises(TypeError):
+            encode_rle(StringColumn.from_pylist(["a", "b"], max_len=4))
+
+    def test_encode_batch_auto_and_explicit(self):
+        rng = np.random.default_rng(2)
+        n = 256
+        batch = ColumnBatch({
+            "s": StringColumn.from_pylist(
+                [f"c{i % 5}" for i in range(n)], max_len=8),
+            "low": col_i32(rng.integers(0, 4, n)),
+            "high": col_i32(np.arange(n)),
+        })
+        auto = encode_batch(batch)
+        assert isinstance(auto["s"], DictionaryColumn)
+        assert isinstance(auto["low"], DictionaryColumn)
+        assert not is_encoded(auto["high"])  # cardinality == rows: skip
+        exp = encode_batch(batch, dictionary=["s"], rle=["low"])
+        assert isinstance(exp["s"], DictionaryColumn)
+        assert isinstance(exp["low"], RunLengthColumn)
+        assert not is_encoded(exp["high"])
+        assert_batches_equal("encode_batch", materialize_batch(auto), n,
+                             batch, n)
+
+    def test_knob_validation(self):
+        config.set("encoded_execution", "on")
+        assert resolve_encoded_execution() is True
+        config.set("encoded_execution", "off")
+        assert resolve_encoded_execution() is False
+        config.set("encoded_execution", "bogus")
+        with pytest.raises(ValueError, match="encoded_execution"):
+            resolve_encoded_execution()
+
+
+# ---------------------------------------------------------------------------
+# code-set filter
+# ---------------------------------------------------------------------------
+
+class TestPredicateMask:
+    def test_matches_rowwise_mask(self):
+        rng = np.random.default_rng(3)
+        n = 300
+        c = col_i32(rng.integers(0, 30, n), rng.random(n) > 0.1)
+        enc = encode_column(c)
+        got = np.asarray(predicate_mask(enc, lambda d: d.data < 15))
+        want = (np.asarray(c.data) < 15) & np.asarray(c.validity)
+        assert np.array_equal(got, want)
+
+    def test_filter_keeps_columns_encoded(self):
+        vals = [f"g{i % 4}" for i in range(64)]
+        batch = encode_batch(ColumnBatch({
+            "k": StringColumn.from_pylist(vals, max_len=8),
+            "v": col_i32(np.arange(64)),
+        }), dictionary=["k"])
+        mask = predicate_mask(batch["k"],
+                              lambda d: d.lengths > 0)  # all live
+        out = apply_mask(batch, mask)
+        assert isinstance(out["k"], DictionaryColumn)
+        assert out["k"].to_pylist() == vals
+
+
+# ---------------------------------------------------------------------------
+# joins on encoded keys
+# ---------------------------------------------------------------------------
+
+HOWS = ("inner", "left", "right", "full", "semi", "anti")
+
+
+def _join_sides(nl=120, nr=40, seed=11):
+    rng = np.random.default_rng(seed)
+    cats = [f"cat-{i:03d}" for i in range(24)]
+    lk = [cats[i] for i in rng.integers(0, 24, nl)]
+    rk = [cats[i] for i in rng.integers(0, 32 if True else 24, nr) % 24] + []
+    # some right keys miss the left domain entirely
+    rk = [cats[i] if i < 24 else f"miss-{i}" for i in rng.integers(0, 32, nr)]
+    left = ColumnBatch({
+        "k": StringColumn.from_pylist(lk, max_len=12),
+        "lpay": col_i32(rng.integers(0, 1000, nl),
+                        rng.random(nl) > 0.1)})
+    right = ColumnBatch({
+        "k": StringColumn.from_pylist(rk, max_len=12),
+        "rpay": col_i32(rng.integers(0, 1000, nr))})
+    return left, right
+
+
+class TestJoinParity:
+    @pytest.mark.parametrize("how", HOWS)
+    def test_cross_dictionary_fallback(self, how):
+        """Independently-encoded sides (distinct tokens) take the
+        gathered-words lowering and still match the decoded join."""
+        left, right = _join_sides()
+        eleft = encode_batch(left, dictionary=["k"])
+        eright = encode_batch(right, dictionary=["k"])
+        assert eleft["k"].dict_token != eright["k"].dict_token
+        rd, cd = hash_join(left, right, ["k"], ["k"], how, capacity=6000)
+        re_, ce = hash_join(eleft, eright, ["k"], ["k"], how, capacity=6000)
+        assert_batches_equal(f"cross/{how}", rd, cd, re_, ce)
+
+    @pytest.mark.parametrize("how", HOWS)
+    def test_reconciled_canon_fast_path(self, how):
+        left, right = _join_sides(seed=13)
+        eleft = encode_batch(left, dictionary=["k"])
+        eright = encode_batch(right, dictionary=["k"])
+        lk, rk = reconcile_dictionaries(eleft["k"], eright["k"])
+        assert lk.dict_token == rk.dict_token
+        # the alignment actually substitutes the single canon word
+        lout, rout = align_encoded_key_columns([lk], [rk])
+        assert isinstance(lout[0], Column) and isinstance(rout[0], Column)
+        eleft = ColumnBatch({"k": lk, "lpay": eleft["lpay"]})
+        eright = ColumnBatch({"k": rk, "rpay": eright["rpay"]})
+        rd, cd = hash_join(left, right, ["k"], ["k"], how, capacity=6000)
+        re_, ce = hash_join(eleft, eright, ["k"], ["k"], how, capacity=6000)
+        assert_batches_equal(f"canon/{how}", rd, cd, re_, ce)
+
+    @pytest.mark.parametrize("how", ("inner", "left", "full"))
+    def test_mixed_encoded_and_plain(self, how):
+        """Encoded probe side against a PLAIN build side."""
+        left, right = _join_sides(seed=17)
+        eleft = encode_batch(left, dictionary=["k"])
+        rd, cd = hash_join(left, right, ["k"], ["k"], how, capacity=6000)
+        re_, ce = hash_join(eleft, right, ["k"], ["k"], how, capacity=6000)
+        assert_batches_equal(f"mixed/{how}", rd, cd, re_, ce)
+
+    def test_align_passthrough_on_token_mismatch(self):
+        a = encode_column(col_i32([1, 2, 3]))
+        b = encode_column(col_i32([2, 3, 4]))
+        lout, rout = align_encoded_key_columns([a], [b])
+        assert lout[0] is a and rout[0] is b
+
+    def test_engine_parity_on_encoded_keys(self):
+        left, right = _join_sides(seed=19)
+        el = encode_batch(left, dictionary=["k"])
+        er = encode_batch(right, dictionary=["k"])
+        for how in ("inner", "full", "anti"):
+            rs, cs = hash_join(el, er, ["k"], ["k"], how, capacity=6000,
+                               engine="sort")
+            rh, ch = hash_join(el, er, ["k"], ["k"], how, capacity=6000,
+                               engine="hash")
+            assert_batches_equal(f"engines/{how}", rs, cs, rh, ch)
+
+
+# ---------------------------------------------------------------------------
+# group-by on encoded keys / values
+# ---------------------------------------------------------------------------
+
+ALL_AGGS = [AggSpec("count", None, "cstar"), AggSpec("sum", "v", "s"),
+            AggSpec("count", "v", "c"), AggSpec("min", "v", "mn"),
+            AggSpec("max", "v", "mx"), AggSpec("mean", "v", "avg"),
+            AggSpec("sum", "f", "fs"), AggSpec("mean", "f", "favg")]
+FLOAT_APPROX = ("fs", "favg")
+
+
+def _gb_batch(n=400, seed=23):
+    rng = np.random.default_rng(seed)
+    k = [f"grp-{i:02d}" for i in rng.integers(0, 25, n)]
+    return ColumnBatch({
+        "k": StringColumn.from_pylist(
+            [None if rng.random() < 0.1 else s for s in k], max_len=8),
+        "v": col_i32(rng.integers(-1000, 1000, n), rng.random(n) > 0.15),
+        "f": col_f64(rng.choice([1.5, -0.0, 0.0, np.nan, 2.5], n))})
+
+
+class TestGroupByParity:
+    @pytest.mark.parametrize("engine", ("sort", "scatter"))
+    def test_encoded_string_key_all_aggs(self, engine):
+        batch = _gb_batch()
+        enc = encode_batch(batch, dictionary=["k"])
+        rd, nd = group_by(batch, ["k"], ALL_AGGS, engine=engine)
+        re_, ne = group_by(enc, ["k"], ALL_AGGS, engine=engine)
+        assert_batches_equal(f"gb/{engine}", rd, nd, re_, ne,
+                             approx=FLOAT_APPROX)
+
+    def test_row_valid(self):
+        rng = np.random.default_rng(29)
+        batch = _gb_batch(seed=29)
+        enc = encode_batch(batch, dictionary=["k"])
+        rv = jnp.asarray(rng.random(400) > 0.3)
+        rd, nd = group_by(batch, ["k"], ALL_AGGS, row_valid=rv)
+        re_, ne = group_by(enc, ["k"], ALL_AGGS, row_valid=rv)
+        assert_batches_equal("gb/row_valid", rd, nd, re_, ne,
+                             approx=FLOAT_APPROX)
+
+    def test_rle_key(self):
+        rng = np.random.default_rng(31)
+        k = np.sort(rng.integers(0, 12, 300)).astype(np.int32)
+        batch = ColumnBatch({"k": col_i32(k),
+                             "v": col_i32(rng.integers(0, 100, 300))})
+        enc = ColumnBatch({"k": encode_rle(batch["k"]), "v": batch["v"]})
+        aggs = [AggSpec("count", None, "c"), AggSpec("sum", "v", "s")]
+        rd, nd = group_by(batch, ["k"], aggs)
+        re_, ne = group_by(enc, ["k"], aggs)
+        assert_batches_equal("gb/rle", rd, nd, re_, ne)
+
+    def test_encoded_value_column_materializes(self):
+        """Dictionary-encoded agg VALUES late-materialize at the point of
+        need — sums match the plain plan exactly."""
+        rng = np.random.default_rng(37)
+        n = 300
+        batch = ColumnBatch({
+            "k": col_i32(rng.integers(0, 10, n)),
+            "v": col_i32(rng.integers(0, 5, n))})  # low-card: encodable
+        enc = ColumnBatch({"k": batch["k"],
+                           "v": encode_column(batch["v"])})
+        aggs = [AggSpec("sum", "v", "s"), AggSpec("min", "v", "mn"),
+                AggSpec("max", "v", "mx")]
+        rd, nd = group_by(batch, ["k"], aggs)
+        re_, ne = group_by(enc, ["k"], aggs)
+        assert_batches_equal("gb/encval", rd, nd, re_, ne)
+
+    def test_jit_single_trace_same_dictionary(self):
+        """Batches over ONE dictionary (shared token) share a treedef —
+        the jitted group-by traces once across them."""
+        cats = StringColumn.from_pylist(
+            [f"g{i}" for i in range(8)], max_len=4)
+        rng = np.random.default_rng(41)
+        ones = jnp.ones((64,), jnp.bool_)
+        base = dictionary_from_arrays(
+            rng.integers(0, 8, 64).astype(np.uint32), ones, cats)
+        traces = {"n": 0}
+
+        @jax.jit
+        def jgb(b):
+            traces["n"] += 1
+            return group_by(b, ["k"], [AggSpec("count", None, "c")])
+
+        for seed in (1, 2, 3):
+            codes = np.random.default_rng(seed).integers(0, 8, 64)
+            k = dataclasses.replace(
+                base, codes=jnp.asarray(codes.astype(np.uint32)))
+            jgb(ColumnBatch({"k": k}))
+        assert traces["n"] == 1
+
+
+# ---------------------------------------------------------------------------
+# shuffle: codes move, dictionaries broadcast once
+# ---------------------------------------------------------------------------
+
+P8 = 8
+
+
+class TestShuffleEncoded:
+    def _batches(self, n):
+        rng = np.random.default_rng(43)
+        # wide strings make the decoded exchange pay real byte width
+        vals = [f"warehouse-{i:02d}-{'x' * 12}" for i in
+                rng.integers(0, 16, n)]
+        plain = ColumnBatch({
+            "k": StringColumn.from_pylist(vals, max_len=28),
+            "v": Column(jnp.asarray(rng.integers(0, 1000, n)),
+                        jnp.ones((n,), jnp.bool_), T.INT64)})
+        return plain, encode_batch(plain, dictionary=["k"])
+
+    def test_codes_move_fewer_bytes_lossless(self, eight_devices):
+        from spark_rapids_jni_tpu.parallel import data_mesh, shard_batch
+        from spark_rapids_jni_tpu.shuffle import (
+            ShuffleRegistry, ShuffleService)
+
+        mesh = data_mesh(P8)
+        n = P8 * 64
+        plain, enc = self._batches(n)
+        pid = jax.device_put(
+            jnp.asarray(np.arange(n, dtype=np.int32) % P8),
+            jax.sharding.NamedSharding(mesh,
+                                       jax.sharding.PartitionSpec("data")))
+        svc = ShuffleService(mesh, registry=ShuffleRegistry())
+        rp = svc.exchange(shard_batch(plain, mesh), pid=pid)
+        re_ = svc.exchange(shard_batch(enc, mesh), pid=pid)
+        assert rp.rows_moved == re_.rows_moved == n
+        # the encoded exchange moves u32 codes + ONE dictionary broadcast
+        assert re_.bytes_moved < rp.bytes_moved
+        # lossless: delivered rows decode to the same multiset
+        occ_p = np.asarray(jax.device_get(rp.occupancy))
+        occ_e = np.asarray(jax.device_get(re_.occupancy))
+        kp = [v for v, ok in zip(rp.batch["k"].to_pylist(), occ_p) if ok]
+        ke = [v for v, ok in zip(re_.batch["k"].to_pylist(), occ_e) if ok]
+        assert sorted(kp) == sorted(ke)
+        assert isinstance(re_.batch["k"], DictionaryColumn)
+
+    def test_keyed_routing_matches_decoded(self, eight_devices):
+        """Routing BY an encoded key hashes the VALUES (codes are
+        dictionary-local) — per-partition row sets match the plain path."""
+        from spark_rapids_jni_tpu.parallel import data_mesh, shard_batch
+        from spark_rapids_jni_tpu.shuffle import (
+            ShuffleRegistry, ShuffleService)
+
+        mesh = data_mesh(P8)
+        n = P8 * 32
+        plain, enc = self._batches(n)
+        svc = ShuffleService(mesh, registry=ShuffleRegistry())
+        rp = svc.exchange(shard_batch(plain, mesh), key_names=["k"])
+        re_ = svc.exchange(shard_batch(enc, mesh), key_names=["k"])
+        assert rp.rows_moved == re_.rows_moved == n
+
+        def per_shard(res):
+            occ = np.asarray(jax.device_get(res.occupancy))
+            ks = res.batch["k"].to_pylist()
+            rows = len(occ) // P8
+            return [sorted(k for k, ok in zip(
+                ks[d * rows:(d + 1) * rows], occ[d * rows:(d + 1) * rows])
+                if ok) for d in range(P8)]
+
+        assert per_shard(rp) == per_shard(re_)
+
+
+# ---------------------------------------------------------------------------
+# spill: encoded trees through the tiers; host_corrupt detection/recovery
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def framework(tmp_path):
+    fw = spill_mod.install(spill_dir=str(tmp_path / "spill"))
+    yield fw
+    spill_mod.shutdown()
+
+
+def _enc_tree(seed=5):
+    rng = np.random.default_rng(seed)
+    n = 256
+    batch = ColumnBatch({
+        "k": StringColumn.from_pylist(
+            [f"s{i % 9}" for i in rng.integers(0, 9, n)], max_len=4),
+        "r": col_i32(np.sort(rng.integers(0, 6, n))),
+        "v": col_i32(rng.integers(0, 1000, n))})
+    return encode_batch(batch, dictionary=["k"], rle=["r"])
+
+
+class TestSpillEncoded:
+    def test_three_tier_round_trip(self, framework):
+        enc = _enc_tree()
+        want = {c: enc[c].to_pylist() for c in enc.names}
+        h = SpillableHandle(enc, name="enc")
+        h.spill()
+        assert h.tier == "host"
+        h.spill_host()
+        assert h.tier == "disk"
+        got = h.get()
+        assert h.tier == "device"
+        # encodings survive the walk: still encoded, bit-identical
+        assert isinstance(got["k"], DictionaryColumn)
+        assert isinstance(got["r"], RunLengthColumn)
+        assert got["k"].dict_token == enc["k"].dict_token
+        for c in enc.names:
+            assert got[c].to_pylist() == want[c]
+        h.close()
+
+    def test_host_corrupt_detected_loudly(self, framework):
+        faultinj.configure({"faults": [
+            {"match": "host_corrupt_probe", "fault": "host_corrupt",
+             "count": 1}]})
+        h = SpillableHandle(_enc_tree(), name="hc")
+        h.spill()  # the injected flip damages the host copy
+        assert h.tier == "host"
+        with pytest.raises(faultinj.HostCorruptionError):
+            h.get()
+        assert framework.metrics.snapshot()["corrupt_reads"] == 1
+        h.close()
+
+    def test_host_corrupt_recovers_via_lineage(self, framework):
+        enc = _enc_tree(seed=7)
+        want = {c: enc[c].to_pylist() for c in enc.names}
+        faultinj.configure({"faults": [
+            {"match": "host_corrupt_probe", "fault": "host_corrupt",
+             "count": 1}]})
+        h = SpillableHandle(enc, name="hcr", recompute=lambda: _enc_tree(
+            seed=7))
+        h.spill()
+        got = h.get()  # detect → discard → rebuild from lineage
+        for c in enc.names:
+            assert got[c].to_pylist() == want[c]
+        assert framework.metrics.snapshot()["corrupt_reads"] == 1
+        h.close()
+
+    def test_host_corrupt_cascades_to_disk_readback(self, framework):
+        """Damage in the host tier lands on disk with the DEMOTION-time
+        CRC (re-hashing would launder it) — the disk read-back detects."""
+        faultinj.configure({"faults": [
+            {"match": "host_corrupt_probe", "fault": "host_corrupt",
+             "count": 1}]})
+        h = SpillableHandle(_enc_tree(seed=9), name="hcd")
+        h.spill()
+        h.spill_host()
+        assert h.tier == "disk"
+        with pytest.raises(faultinj.SpillCorruptionError):
+            h.get()
+        h.close()
+
+    def test_checksum_off_skips_detection(self, framework):
+        """Without spill_checksum there is no demotion-time CRC: the
+        flip goes undetected (documented trade-off, not a promise)."""
+        config.set("spill_checksum", False)
+        faultinj.configure({"faults": [
+            {"match": "host_corrupt_probe", "fault": "host_corrupt",
+             "count": 1}]})
+        h = SpillableHandle({"x": jnp.arange(64, dtype=jnp.int32)},
+                            name="nock")
+        h.spill()
+        h.get()  # no meta recorded -> promotion cannot verify
+        assert framework.metrics.snapshot()["corrupt_reads"] == 0
+        h.close()

@@ -545,6 +545,72 @@ class TestGL008:
 
 
 # ---------------------------------------------------------------------------
+# GL009 — late-materialization breach (decode under jit off-boundary)
+# ---------------------------------------------------------------------------
+
+
+class TestGL009:
+    def test_decode_and_materialize_under_jit_flagged(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import jax
+            from spark_rapids_jni_tpu.columnar.encoded import (
+                materialize_batch)
+
+            @jax.jit
+            def bad(batch):
+                k = batch["k"].decode()
+                return k
+
+            @jax.jit
+            def also_bad(batch):
+                return materialize_batch(batch)
+        """}, rules=["GL009"])
+        assert new_rules(res) == [("GL009", "mod.py"), ("GL009", "mod.py")]
+        assert "late-materialization" in res.new[1].message
+
+    def test_decode_outside_jit_and_bytes_decode_clean(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import jax
+
+            @jax.jit
+            def compute(codes):
+                return codes + 1
+
+            def output_boundary(batch):
+                # host-side materialization around the traced plan: the
+                # sanctioned idiom, not a breach
+                return batch["k"].decode()
+
+            @jax.jit
+            def reads_bytes(x, raw):
+                label = raw.decode("utf-8")
+                return x
+        """}, rules=["GL009"])
+        assert res.new == []
+
+    def test_sanctioned_module_clean(self, tmp_path):
+        res = lint(tmp_path, {
+            "spark_rapids_jni_tpu/relational/gather.py": """
+                import jax
+
+                @jax.jit
+                def gather_column(col, idx):
+                    return col.decode()
+            """}, rules=["GL009"])
+        assert res.new == []
+
+    def test_suppressed(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import jax
+
+            @jax.jit
+            def pinned(batch):
+                return batch["k"].decode()  # graftlint: disable=GL009
+        """}, rules=["GL009"])
+        assert res.new == [] and res.counts()["suppressed"] == 1
+
+
+# ---------------------------------------------------------------------------
 # baseline ratchet
 # ---------------------------------------------------------------------------
 
@@ -659,4 +725,4 @@ class TestLiveTree:
         from tools.graftlint import rules as rules_mod
         ids = [r.id for r in rules_mod.all_rules()]
         assert ids == ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
-                       "GL007", "GL008"]
+                       "GL007", "GL008", "GL009"]

@@ -22,7 +22,8 @@ failing trial prints the log, and replaying it needs nothing but the
 (name, occurrence) pairs it contains.
 
 Fault handling per kind mirrors production roles: ``spill_io`` /
-``spill_corrupt`` / ``shuffle_io`` / ``oom`` recover INSIDE the run
+``spill_corrupt`` / ``host_corrupt`` / ``shuffle_io`` / ``oom`` recover
+INSIDE the run
 (degradation, checksum+lineage rebuild, round re-drive, retry ladder);
 ``exception`` / ``fatal`` abort the attempt and the campaign re-runs the
 scenario from scratch — the "replacement executor", whose teardown the
@@ -161,8 +162,10 @@ def _always_retry(fw):
 
 class SpillScenario:
     """Two lineage-backed handles walked device→host→disk and read back:
-    crosses spill_io_write / spill_corrupt_file on the way down and
-    spill_io_read (plus checksum verification) on the way up."""
+    crosses host_corrupt_probe then spill_io_write / spill_corrupt_file
+    on the way down and spill_io_read (plus checksum verification, which
+    inherits demotion-time CRCs so host damage survives the host→disk
+    cascade) on the way up."""
 
     name = "spill"
     task_id = 201
@@ -322,6 +325,11 @@ def single_fault_trials(fast: bool = False) -> List[Trial]:
     one("spill", "spill_io_read", "spill_io")
     one("spill", "spill_corrupt_file", "spill_corrupt")
     one("spill", "spill_corrupt_file", "spill_corrupt", skip=1)
+    # host-tier damage: flips land in the host copy at demotion; the
+    # read-back (or the inherited-meta disk verify after a host→disk
+    # cascade) detects them and lineage rebuilds — recovery INSIDE run()
+    one("spill", "host_corrupt_probe", "host_corrupt")
+    one("spill", "host_corrupt_probe", "host_corrupt", skip=1)
 
     # shuffle scenario: transport seam, step seam, and spilled-buffer
     # damage that must recover via map lineage
@@ -348,7 +356,8 @@ def single_fault_trials(fast: bool = False) -> List[Trial]:
 _MULTI_POOL = {
     "spill": [("chaos_spill_step", "oom"), ("chaos_spill_step", "exception"),
               ("spill_io_write", "spill_io"), ("spill_io_read", "spill_io"),
-              ("spill_corrupt_file", "spill_corrupt")],
+              ("spill_corrupt_file", "spill_corrupt"),
+              ("host_corrupt_probe", "host_corrupt")],
     "shuffle": [("shuffle_io_round", "shuffle_io"),
                 ("shuffle_io_round", "oom"),
                 ("spill_corrupt_file", "spill_corrupt"),

@@ -1,4 +1,4 @@
-"""The eight graftlint rules.  Each encodes a bug this repo shipped or is
+"""The nine graftlint rules.  Each encodes a bug this repo shipped or is
 structurally exposed to; see tools/graftlint/README.md for the full
 rationale with the motivating incident per rule."""
 
@@ -826,10 +826,83 @@ class GL008JittedIOHandle(Rule):
                             "traced computation")
 
 
+# ---------------------------------------------------------------------------
+# GL009 — late-materialization breach: decode under jit outside the
+# sanctioned points of need
+# ---------------------------------------------------------------------------
+
+_MATERIALIZE_CALLS = {"materialize_column", "materialize_batch",
+                      "decode_batch"}
+# The designed materialization points (columnar/encoded.py's
+# late-materialization contract): only these modules may decode inside a
+# traced computation — everywhere else a decode under jit silently turns
+# an encoded plan back into the full-width plan, erasing the arena and
+# shuffle-byte wins the encoding paid for at ingest.
+_GL009_SANCTIONED = frozenset({
+    "spark_rapids_jni_tpu/columnar/encoded.py",
+    "spark_rapids_jni_tpu/relational/gather.py",
+    "spark_rapids_jni_tpu/relational/aggregate.py",
+    "spark_rapids_jni_tpu/shuffle/service.py",
+    "spark_rapids_jni_tpu/parallel/distributed.py",
+})
+
+
+class GL009LateMaterializationBreach(Rule):
+    """``col.decode()`` / ``materialize_*`` inside a jitted body outside
+    the sanctioned modules defeats late materialization: the encoded
+    column widens to its full value width mid-plan, so every downstream
+    op (and the arena charge, and any shuffle round) pays decoded bytes
+    while the metrics still claim the encoded plan ran.  Decode at the
+    designed points of need — the output gather (relational/gather.py),
+    agg-value consumption (relational/aggregate.py), the exchange's RLE
+    boundary (shuffle/service.py) — or materialize OUTSIDE the trace
+    before calling in."""
+
+    id = "GL009"
+
+    def check(self, pf: ParsedFile) -> Iterable[Finding]:
+        if pf.relpath in _GL009_SANCTIONED or pf.is_test_file:
+            return
+        aliases = module_aliases(pf.tree)
+        for fn, _jit_kws in _jitted_functions(pf, aliases):
+            for stmt in fn.body:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    func = node.func
+                    # zero-arg `.decode()`: the encoded-column signature
+                    # (bytes.decode under jit takes codec args and bytes
+                    # don't trace anyway)
+                    if (isinstance(func, ast.Attribute)
+                            and func.attr == "decode"
+                            and not node.args and not node.keywords):
+                        yield pf.finding(
+                            self.id, node,
+                            f"`.decode()` inside jitted `{fn.name}` "
+                            "materializes an encoded column mid-plan — "
+                            "every downstream op pays full value width; "
+                            "decode at a sanctioned point of need "
+                            "(gather/aggregate/shuffle boundaries) or "
+                            "materialize outside the trace")
+                        continue
+                    name = (func.id if isinstance(func, ast.Name)
+                            else (resolve(func, aliases) or
+                                  "").rsplit(".", 1)[-1])
+                    if name in _MATERIALIZE_CALLS:
+                        yield pf.finding(
+                            self.id, node,
+                            f"`{name}(...)` inside jitted `{fn.name}` "
+                            "breaches the late-materialization contract "
+                            "outside the sanctioned modules; keep "
+                            "columns encoded through the plan and "
+                            "materialize at the output boundary")
+
+
 _ALL: List[Rule] = [GL001TracerLeak(), GL002HostSyncUnderJit(),
                     GL003RetraceHazard(), GL004SpillHandleLeak(),
                     GL005ConfigDrift(), GL006FaultKindDrift(),
-                    GL007DonatedBufferReuse(), GL008JittedIOHandle()]
+                    GL007DonatedBufferReuse(), GL008JittedIOHandle(),
+                    GL009LateMaterializationBreach()]
 
 
 def all_rules(only: Optional[Sequence[str]] = None) -> List[Rule]:
