@@ -30,6 +30,11 @@ device arena with the tiered spill framework installed; its JSON line adds
 ``python bench.py --shuffle`` runs one heavily skewed exchange through the
 out-of-core ShuffleService under a capped device arena; its JSON line adds
 ``shuffle_*`` counters (rounds, skew ratio, spilled bytes).
+
+``python bench.py --plan`` runs q6/q95 plus the IR-only q9 through the
+whole-plan compiler (spark_rapids_jni_tpu/plan/); each row's ``note``
+carries the plan-cache outcome and the adaptive decisions, and the q95 IR
+row's ``vs_baseline`` rides its own only-shrinks floor (ci/q95_floor.json).
 """
 
 import json
@@ -653,6 +658,95 @@ def shuffle_main():
         "shuffle_io_failures": snap["io_failures"],
     }), flush=True)
     return 0
+
+
+# --------------------------------------------------------------------------
+# plan scenario (--plan): q6/q95/q9 through the whole-plan IR compiler
+# --------------------------------------------------------------------------
+
+def plan_main():
+    """q6, q95 and the IR-only q9 lowered from logical IR into ONE
+    jitted program each (spark_rapids_jni_tpu/plan/).  Every timed rep
+    goes back through ``compile_plan`` — the first lookup is the miss
+    that traces, every later one must be a plan-cache HIT replayed with
+    zero retraces — and each emitted row's ``note`` records the cache
+    outcome, the retrace count and the adaptive decisions, so
+    BENCH_*.json defends the physical plan the compiler actually chose.
+    ci/check_q95_line.py holds the q95 IR row to its own only-shrinks
+    floor and fails when the q9 row goes missing."""
+    import jax
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        platform = jax.devices()[0].platform
+    except Exception as e:  # backend init failure → parent falls back
+        print(f"# backend init failed: {e}", file=sys.stderr, flush=True)
+        return 17
+
+    import __graft_entry__ as ge
+    from spark_rapids_jni_tpu import plan
+    from spark_rapids_jni_tpu.plan import queries
+
+    n_rows = int(os.environ.get("BENCH_PLAN_ROWS",
+                                os.environ.get("BENCH_N_ROWS",
+                                               str(1 << 16))))
+    failures = 0
+
+    def run_query(metric, plan_obj, make_inputs, rows, baseline_mrows=None):
+        nonlocal failures
+        try:
+            variants = [make_inputs(i) for i in range(REPS + 1)]
+            t_before = plan.trace_count()
+            lookups = []
+
+            def step(inputs):
+                cp = plan.compile_plan(plan_obj, inputs)
+                lookups.append(cp.last_lookup)
+                return cp(inputs)
+
+            mrows = _bench_one(step, (variants[0],), rows, REPS,
+                               variants=[(v,) for v in variants])
+            retraces = plan.trace_count() - t_before
+            cp = plan.compile_plan(plan_obj, variants[0])
+            note = {
+                # 'hit' only when every post-warm lookup replayed the
+                # cached program (the zero-retrace acceptance bar)
+                "cache": ("hit" if lookups[0] == "miss"
+                          and all(lk == "hit" for lk in lookups[1:])
+                          and retraces == 1 else "miss"),
+                "retraces": retraces,
+                "decisions": cp.decisions,
+            }
+            cp.close()
+            line = {"metric": metric, "value": round(mrows, 2),
+                    "unit": "Mrows/s", "platform": platform, "rows": rows,
+                    "note": note}
+            if baseline_mrows:
+                line["vs_baseline"] = round(mrows / baseline_mrows, 2)
+            print(json.dumps(line), flush=True)
+        except Exception as e:  # emit the other rows; fail the scenario
+            failures += 1
+            print(f"# {metric} failed: {e!r}", file=sys.stderr, flush=True)
+
+    run_query("q6_ir_throughput", queries.q6_plan(),
+              lambda i: {"batch": ge._example_batch(n_rows, seed=7 + i)},
+              n_rows)
+
+    nq = min(n_rows, 1 << 17)
+    run_query("q95_ir_throughput", queries.q95_plan(),
+              lambda i: dict(zip(("fact", "dim1", "dim2"),
+                                 ge._q95_batches(nq, seed=19 + i))),
+              nq, baseline_mrows=_numpy_q95_mrows(nq))
+
+    # q9 exists ONLY as IR — its broadcast joins are the adaptive
+    # layer's decision (the dims sit under broadcast_threshold_rows),
+    # recorded in the row's note.decisions
+    run_query("q9_ir_throughput", queries.q9_plan(),
+              lambda i: dict(zip(("fact", "dim1", "dim2"),
+                                 ge._q95_batches(nq, seed=101 + i))),
+              nq, baseline_mrows=_numpy_q95_mrows(nq))
+    return 1 if failures else 0
 
 
 # --------------------------------------------------------------------------
@@ -1356,15 +1450,19 @@ def main():
         sys.exit(spill_main())
     if mode == "--child-shuffle":
         sys.exit(shuffle_main())
+    if mode == "--child-plan":
+        sys.exit(plan_main())
     if mode == "--probe":
         sys.exit(_probe_main())
 
     run_micro = mode == "--micro"
     run_spill = mode == "--spill"
     run_shuffle = mode == "--shuffle"
+    run_plan = mode == "--plan"
     child_mode = ("--child-micro" if run_micro
                   else "--child-spill" if run_spill
-                  else "--child-shuffle" if run_shuffle else "--child")
+                  else "--child-shuffle" if run_shuffle
+                  else "--child-plan" if run_plan else "--child")
     t0 = time.monotonic()
 
     def left():
@@ -1405,6 +1503,7 @@ def main():
         metric = ("micro_suite" if run_micro
                   else "q6_spill_oversubscribed" if run_spill
                   else "shuffle_skew_outofcore" if run_shuffle
+                  else "q6_ir_throughput" if run_plan
                   else "q6_pipeline_throughput")
         print(json.dumps({
             "metric": metric,

@@ -4,10 +4,16 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 BENCH_FORCE_CPU=1 BENCH_N_ROWS=65536 BENCH_REPS=2 python bench.py \
   | tee /tmp/bench_smoke_q6.out
-# the q95 line must be self-explaining (per-stage note + engines) and its
-# vs_baseline must not regress below the recorded floor — a ratchet in the
-# same only-shrinks spirit as graftlint's baseline (ci/q95_floor.json)
-python ci/check_q95_line.py /tmp/bench_smoke_q6.out
+# plan-IR scenario: q6/q95 plus the IR-only q9 lowered by the whole-plan
+# compiler; each row's note carries the plan-cache outcome + the adaptive
+# decisions (cache must be a hit — zero retraces on repeated shapes)
+BENCH_FORCE_CPU=1 BENCH_PLAN_ROWS=65536 BENCH_REPS=2 python bench.py --plan \
+  | tee /tmp/bench_smoke_plan.out
+# the q95 lines must be self-explaining (per-stage note + engines; cache +
+# decisions on the IR rows) and their vs_baseline must not regress below
+# the recorded floors — ratchets in the same only-shrinks spirit as
+# graftlint's baseline (ci/q95_floor.json); a missing q9 IR row fails too
+python ci/check_q95_line.py /tmp/bench_smoke_q6.out /tmp/bench_smoke_plan.out
 # spill scenario: device arena capped below q6's working set; the emitted
 # line carries spill-bytes counters so BENCH_*.json tracks spill overhead
 BENCH_FORCE_CPU=1 BENCH_SPILL_ROWS=65536 python bench.py --spill

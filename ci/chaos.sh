@@ -1,5 +1,6 @@
 #!/usr/bin/env bash
-# Chaos-campaign gate: deterministic fault sweep over spill/shuffle/q95.
+# Chaos-campaign gate: deterministic fault sweep over
+# spill/shuffle/q95/sort/jni.
 #
 # Runs tools/chaos.py — every faultinj.FAULT_KINDS entry fired at every
 # instrumented boundary (one fault per trial, exhaustively) plus seeded
@@ -19,4 +20,19 @@ CHAOS_SEED="${CHAOS_SEED:-0}"
 echo "== chaos campaign (seed=${CHAOS_SEED}) =="
 BENCH_FORCE_CPU=1 python -m tools.chaos --seed "${CHAOS_SEED}" \
     --report /tmp/chaos_report.json
+
+# the full matrix must cover the distributed-sort and JNI-boundary
+# fault domains — a silently shrunken scenario set would pass the
+# campaign's own exit code, so assert the report
+python - /tmp/chaos_report.json <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+for scenario in ("sort", "jni"):
+    trials = [t for t in doc["trials"]
+              if t["label"].startswith(scenario + ":")]
+    assert trials, f"chaos report has no {scenario!r} trials"
+    bad = [t["label"] for t in trials if not t.get("ok")]
+    assert not bad, f"{scenario!r} trials failed: {bad}"
+    print(f"chaos gate: {len(trials)} {scenario} trial(s) ok")
+EOF
 echo "== chaos campaign OK (report: /tmp/chaos_report.json) =="

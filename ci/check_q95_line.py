@@ -1,6 +1,6 @@
-"""CI gate for the q95 bench line (ci/bench_smoke.sh).
+"""CI gate for the q95 bench lines (ci/bench_smoke.sh).
 
-Two checks, same only-shrinks spirit as graftlint's baseline:
+Checks in the same only-shrinks spirit as graftlint's baseline:
 
 * the emitted ``q95_shape_throughput`` line must be SELF-EXPLAINING —
   a ``note`` carrying the chosen engines and the per-stage millisecond
@@ -15,35 +15,57 @@ The encoded variant ``q95_shape_encoded_throughput`` (dictionary codes
 through exchange + join + group-by) gets the same treatment against
 ``encoded_vs_baseline_floor`` — a missing line fails the gate, so the
 encoded path can't silently fall out of the smoke.
+
+The plan-IR rows (``bench.py --plan``, usually a separate capture file —
+the gate accepts multiple paths and scans them all):
+
+* ``q95_ir_throughput`` — q95 lowered from logical IR by the whole-plan
+  compiler — rides its own ``ir_vs_baseline_floor`` ratchet, and its
+  ``note`` must record the plan-cache outcome as a HIT (a repeated
+  shape re-tracing every rep is a plan-cache regression even when
+  throughput survives);
+* ``q9_ir_throughput`` must exist with recorded adaptive decisions —
+  q9 is the proof that new queries are data, so it silently falling
+  out of the smoke would un-prove it.
 """
 import json
 import os
 import sys
 
 
-def main(path: str) -> int:
+def _scan(paths):
+    lines = {}
+    for path in paths:
+        with open(path) as f:
+            for ln in f:
+                ln = ln.strip()
+                if not ln.startswith("{"):
+                    continue
+                try:
+                    obj = json.loads(ln)
+                except json.JSONDecodeError:
+                    continue
+                if "metric" in obj:
+                    lines[obj["metric"]] = obj
+    return lines
+
+
+def main(paths) -> int:
     floor_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "q95_floor.json")
     with open(floor_path) as f:
         floors = json.load(f)
     floor = floors["vs_baseline_floor"]
     enc_floor = floors["encoded_vs_baseline_floor"]
-    line = enc_line = None
-    with open(path) as f:
-        for ln in f:
-            ln = ln.strip()
-            if not ln.startswith("{"):
-                continue
-            try:
-                obj = json.loads(ln)
-            except json.JSONDecodeError:
-                continue
-            if obj.get("metric") == "q95_shape_throughput":
-                line = obj
-            elif obj.get("metric") == "q95_shape_encoded_throughput":
-                enc_line = obj
+    ir_floor = floors["ir_vs_baseline_floor"]
+    lines = _scan(paths)
+    line = lines.get("q95_shape_throughput")
+    enc_line = lines.get("q95_shape_encoded_throughput")
+    ir_line = lines.get("q95_ir_throughput")
+    q9_line = lines.get("q9_ir_throughput")
     if line is None:
-        print("check_q95_line: no q95_shape_throughput line in", path)
+        print("check_q95_line: no q95_shape_throughput line in",
+              " ".join(paths))
         return 1
     note = line.get("note")
     errs = []
@@ -73,12 +95,37 @@ def main(path: str) -> int:
             errs.append(f"encoded vs_baseline {enc_vs} regressed below "
                         f"the recorded floor {enc_floor} "
                         f"(ci/q95_floor.json)")
+    ir_vs = None
+    if ir_line is None:
+        errs.append("no q95_ir_throughput line: the plan-IR q95 row fell "
+                    "out of the smoke (bench.py plan_main)")
+    else:
+        ir_note = ir_line.get("note")
+        if not isinstance(ir_note, dict) or ir_note.get("cache") != "hit":
+            errs.append("IR line's note.cache is not 'hit': repeated "
+                        "shapes are re-tracing instead of replaying the "
+                        f"plan cache (note={json.dumps(ir_note)})")
+        if not isinstance((ir_note or {}).get("decisions"), dict):
+            errs.append("IR line's note.decisions missing: the capture no "
+                        "longer documents the adaptive physical plan")
+        ir_vs = ir_line.get("vs_baseline", 0.0)
+        if ir_vs < ir_floor:
+            errs.append(f"IR vs_baseline {ir_vs} regressed below the "
+                        f"recorded floor {ir_floor} (ci/q95_floor.json)")
+    if q9_line is None:
+        errs.append("no q9_ir_throughput line: the IR-only q9 row fell "
+                    "out of the smoke — new-queries-are-data is no "
+                    "longer being exercised (bench.py plan_main)")
+    elif not isinstance((q9_line.get("note") or {}).get("decisions"), dict):
+        errs.append("q9 line's note.decisions missing: the adaptive "
+                    "broadcast decisions are no longer recorded")
     if errs:
         for e in errs:
             print("check_q95_line:", e)
         return 1
     print(f"check_q95_line: OK (vs_baseline {vs} >= floor {floor}; "
           f"encoded {enc_vs} >= floor {enc_floor}; "
+          f"IR {ir_vs} >= floor {ir_floor}; q9 row present; "
           f"engines {json.dumps((note or {}).get('engines'))})")
     if vs >= 2 * floor and floor > 0:
         print(f"check_q95_line: note — vs_baseline is >=2x the floor; "
@@ -87,4 +134,4 @@ def main(path: str) -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main(sys.argv[1]))
+    sys.exit(main(sys.argv[1:]))

@@ -1,6 +1,7 @@
 #!/usr/bin/env bash
 # Lint-clean gate: graftlint (tools/graftlint/) is the Python/JAX-layer
-# analogue of the reference's test-with-sanitizer profile — eight AST rules
+# analogue of the reference's test-with-sanitizer profile — ten AST rules
+# (GL001-GL010)
 # encoding bug classes this repo has actually shipped (GL001 is the PR 2
 # module-level-jnp UnexpectedTracerError class).  Fails on any finding
 # that is neither per-line-suppressed nor grandfathered in

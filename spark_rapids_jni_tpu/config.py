@@ -179,6 +179,23 @@ _register("encoded_execution", "auto", str,
           "relational operators accept encoded and plain columns "
           "mixed, so the knob only gates where encoding is "
           "INTRODUCED.")
+_register("plan_cache_size", 64, int,
+          "Max compiled programs the plan cache (plan/cache.py) holds; "
+          "LRU past it.  Keys are (canonical IR shape, input schema, "
+          "config fingerprint), so a hit replays an already-traced "
+          "program with zero retraces.")
+_register("broadcast_threshold_rows", 1 << 16, int,
+          "Adaptive-join build-side row cutoff (plan/adaptive.py): a "
+          "strategy='auto' join whose observed build side is at or "
+          "under this goes broadcast (spill-registered prebuilt build "
+          "table), over it shuffled — Spark's "
+          "autoBroadcastJoinThreshold, in rows.")
+_register("adaptive_execution", True, _parse_bool,
+          "Plan-time adaptive decisions (plan/adaptive.py): broadcast "
+          "vs shuffled joins from observed build sizes, group-by engine "
+          "from skewed counts passes, per-exchange round capacity from "
+          "ShuffleMetrics.  Off = the static defaults everywhere "
+          "(shuffled joins, knob-resolved engines).")
 _register("q6_float_mode", "f32x3", str,
           "Float-sum mode for the q6 onehot path: 'f32x3' (exact Dekker "
           "split, MXU-native, order-nondeterministic rounding) or 'f64' "

@@ -614,6 +614,18 @@ class RmmSpark:
 
         return get_registry().metrics.snapshot()
 
+    # plan-cache metrics (recorded by the plan compiler's cache) --------
+    @classmethod
+    def plan_cache_metrics(cls) -> dict:
+        """Global plan-cache counters (hits/misses/evictions/size) —
+        surfaced here next to :meth:`spill_metrics` and
+        :meth:`shuffle_metrics` so executor-side telemetry scrapes the
+        whole retrace story from one place (zeros-safe: an import that
+        never compiled a plan reports an empty cache)."""
+        from ..plan.cache import plan_cache_metrics
+
+        return plan_cache_metrics()
+
     # injection ---------------------------------------------------------
     @classmethod
     def force_retry_oom(cls, tid, num_ooms=1, skip_count=0):

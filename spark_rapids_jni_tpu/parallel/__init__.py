@@ -26,6 +26,7 @@ slice and DCN across.
 from .partition import regroup_order, spark_partition_id
 from .shuffle import exchange, exchange_hierarchical
 from .distributed import (
+    broadcast_build_handle,
     data_mesh,
     distributed_group_by,
     distributed_group_by_2d,
@@ -40,6 +41,7 @@ from .distributed import (
 )
 
 __all__ = [
+    "broadcast_build_handle",
     "regroup_order",
     "spark_partition_id",
     "exchange",

@@ -291,9 +291,26 @@ def _join_step(mesh, axis_name, left_on, right_on, how, capacity,
 
 
 
+def broadcast_build_handle(right: ColumnBatch, ctx=None,
+                           name: Optional[str] = None):
+    """Register a broadcast-join build batch with the spill store under
+    the owning query's ``ctx`` (TaskContext).
+
+    Shuffled builds were already spillable
+    (``relational.join.spillable_build_table``); this closes the gap the
+    broadcast path left — a parked tenant's replicated build batch was
+    unevictable device residency.  Pass the handle to
+    :func:`distributed_broadcast_join` as ``build=``; it is fetched
+    through the retry ladder per call, so between calls (the tenant
+    parked) the central store may demote it device→host→disk and the
+    next call promotes it back.
+    """
+    return right.spillable(ctx=ctx, name=name or "broadcast-build")
+
+
 def distributed_broadcast_join(
     left: ColumnBatch,
-    right: ColumnBatch,
+    right: Optional[ColumnBatch],
     left_on: Sequence[str],
     right_on: Sequence[str],
     how: str,
@@ -301,6 +318,8 @@ def distributed_broadcast_join(
     axis_name: str = "data",
     dense_domain: Optional[int] = None,
     out_capacity: Optional[int] = None,
+    build=None,
+    ctx=None,
 ):
     """Broadcast-hash join: the build side is replicated to every device
     and the sharded probe side never moves — ZERO exchange, vs the
@@ -327,6 +346,14 @@ def distributed_broadcast_join(
     device-local with each shard's matches compacted in front (same
     layout contract as :func:`distributed_hash_join`, minus the
     ``dropped`` output: nothing is exchanged, so nothing can drop).
+
+    The build side registers with the spill store under the owning
+    query's TaskContext: pass ``build=`` (a handle from
+    :func:`broadcast_build_handle`, reusable across calls — the parked-
+    tenant eviction story) or ``ctx=`` (a per-call handle is created,
+    fetched through the retry ladder, and closed after the step).  With
+    neither, ``right`` is used directly (the pre-registration
+    behavior).
     """
     if how in ("right", "full"):
         raise ValueError(
@@ -335,10 +362,35 @@ def distributed_broadcast_join(
             "would emit its own copy) — use distributed_hash_join")
     if len(left_on) != len(right_on):
         raise ValueError("left_on/right_on length mismatch")
-    step = _bcast_join_step(
-        mesh, axis_name, tuple(left_on), tuple(right_on), how,
-        None if dense_domain is None else int(dense_domain), out_capacity)
-    return step(left, right)
+    owned = None
+    if build is None and ctx is not None:
+        if right is None:
+            raise ValueError("ctx= registration needs the right batch")
+        owned = build = broadcast_build_handle(right, ctx=ctx)
+    try:
+        if build is not None:
+            from ..mem.executor import run_with_retry
+
+            # pin across the fetch AND the step: the central store must
+            # not demote the build tree while the collective that
+            # replicates it is in flight
+            with build.pinned():
+                right = run_with_retry(build.get)
+                step = _bcast_join_step(
+                    mesh, axis_name, tuple(left_on), tuple(right_on), how,
+                    None if dense_domain is None else int(dense_domain),
+                    out_capacity)
+                return step(left, right)
+        if right is None:
+            raise ValueError("need either right= or build=")
+        step = _bcast_join_step(
+            mesh, axis_name, tuple(left_on), tuple(right_on), how,
+            None if dense_domain is None else int(dense_domain),
+            out_capacity)
+        return step(left, right)
+    finally:
+        if owned is not None:
+            owned.close()
 
 
 @lru_cache(maxsize=None)

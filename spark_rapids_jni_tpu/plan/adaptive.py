@@ -1,0 +1,159 @@
+"""Adaptive plan-time decisions from stats the system already collects.
+
+Spark's AQE re-plans at stage boundaries from observed shuffle
+statistics; here the equivalent inputs already exist — the
+:class:`~spark_rapids_jni_tpu.shuffle.registry.ShuffleMetrics` snapshot
+(rows moved, skew peak), the skew planner's per-partition counts pass
+(``plan_rounds``), and the per-stage millisecond notes the bench emits
+(``stages_ms``) — so the decisions are pure functions over a ``stats``
+dict with those optional keys::
+
+    {"shuffle":   RmmSpark.shuffle_metrics() snapshot,
+     "counts":    per-partition/bucket row counts (the planner pass),
+     "stages_ms": {"exch1": .., "join1": .., "agg": ..}}
+
+Everything gates on the ``adaptive_execution`` knob: off means the
+static defaults (shuffled joins, knob-resolved engines, knob-bucketed
+capacities) — the pre-plan behavior, bit-for-bit.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from .. import config
+from . import ir
+
+# past this max/mean per-partition ratio the scatter group-by engine's
+# slot table degenerates on the hot key and its runtime sort fallback
+# fires anyway — pick the sort engine up front instead of paying the
+# failed scatter pass first
+SKEW_SORT_RATIO = 4.0
+
+
+def _enabled() -> bool:
+    return bool(config.get("adaptive_execution"))
+
+
+def choose_join_strategy(build_rows: int,
+                         threshold: Optional[int] = None) -> str:
+    """``'broadcast'`` when the observed build side fits under the
+    ``broadcast_threshold_rows`` knob, else ``'shuffled'`` — Spark's
+    autoBroadcastJoinThreshold in rows.  Adaptive off = always
+    shuffled."""
+    if not _enabled():
+        return "shuffled"
+    if threshold is None:
+        threshold = int(config.get("broadcast_threshold_rows"))
+    return "broadcast" if int(build_rows) <= threshold else "shuffled"
+
+
+def choose_join_engine() -> str:
+    """The probe/build engine a broadcast build table is PINNED to.
+
+    Resolved once at plan time (knob + platform, same resolution
+    ``hash_join`` itself would do) and handed to
+    ``spillable_build_table(engine=...)`` so an evicted build rebuilds
+    under the SAME engine the compiled program was traced against —
+    the engine re-read and the plan decision cannot disagree."""
+    from ..relational.join import _resolve_join_engine
+
+    return _resolve_join_engine(None)
+
+
+def choose_groupby_engine(counts=None,
+                          stages_ms: Optional[dict] = None) -> Optional[str]:
+    """Engine hint for a general (domainless) aggregation, or ``None``
+    to defer to the ``groupby_engine`` knob.
+
+    Two signals, strongest first: a skewed counts pass (max/mean >=
+    ``SKEW_SORT_RATIO``) forces the sort engine; a ``stages_ms`` note
+    whose aggregation stage dominates (> half the total) re-resolves
+    the platform default explicitly so the decision is recorded rather
+    than implicit.  No signal, no opinion."""
+    if not _enabled():
+        return None
+    if counts is not None:
+        vals = [int(c) for c in counts]
+        if vals and max(vals) > 0:
+            mean = sum(vals) / len(vals)
+            if mean > 0 and max(vals) / mean >= SKEW_SORT_RATIO:
+                return "sort"
+    if stages_ms:
+        total = sum(float(v) for v in stages_ms.values())
+        agg = float(stages_ms.get("agg", 0.0))
+        if total > 0 and agg > 0.5 * total:
+            from ..relational.aggregate import _resolve_groupby_engine
+
+            return _resolve_groupby_engine(None)
+    return None
+
+
+def choose_exchange_capacity(counts=None, metrics: Optional[dict] = None,
+                             partitions: int = 8):
+    """Per-exchange round capacity via the skew planner.
+
+    With a counts pass available this is exactly
+    :func:`~spark_rapids_jni_tpu.shuffle.planner.plan_rounds`; with only
+    a ``ShuffleMetrics`` snapshot the per-partition count is estimated
+    as rows_moved / (shuffles * partitions) inflated by the recorded
+    skew peak.  Returns the planner's ``RoundPlan`` (or ``None`` with no
+    signal), whose ``capacity`` is the per-round slot budget."""
+    from ..shuffle.planner import plan_rounds
+
+    if not _enabled():
+        return None
+    if counts is not None:
+        return plan_rounds([int(c) for c in counts])
+    if metrics:
+        shuffles = int(metrics.get("shuffles", 0))
+        rows = int(metrics.get("rows_moved", 0))
+        if shuffles > 0 and rows > 0:
+            mean = rows // (shuffles * max(partitions, 1))
+            peak = max(float(metrics.get("max_skew", 1.0)), 1.0)
+            est = max(int(mean * peak), 1)
+            return plan_rounds([est] * max(partitions, 1))
+    return None
+
+
+def plan_decisions(plan: ir.PlanNode, inputs: dict,
+                   stats: Optional[dict] = None) -> dict:
+    """Walk ``plan`` and record every adaptive decision the compiler
+    will consume — keyed ``join<i>:<left_on>``/``exchange<i>:<key>``/
+    ``aggregate<i>:<keys>`` (ordinals in walk order, so the compiler's
+    own walk lines up) — plus the resolved strategy for each
+    ``strategy='auto'`` join from the OBSERVED build row count."""
+    stats = stats or {}
+    decisions: dict = {"adaptive": _enabled()}
+    ji = xi = ai = 0
+    for node in plan.walk():
+        if isinstance(node, ir.Join):
+            strategy = node.strategy
+            build_rows = None
+            if isinstance(node.right, ir.Scan) and node.right.name in inputs:
+                build_rows = int(inputs[node.right.name].num_rows)
+            if strategy == "auto":
+                strategy = (choose_join_strategy(build_rows)
+                            if build_rows is not None else "shuffled")
+            d = {"strategy": strategy, "build_rows": build_rows}
+            if strategy == "broadcast":
+                d["engine"] = choose_join_engine()
+            decisions[f"join{ji}:{node.left_on}"] = d
+            ji += 1
+        elif isinstance(node, ir.Exchange):
+            rp = choose_exchange_capacity(
+                counts=stats.get("counts"), metrics=stats.get("shuffle"),
+                partitions=node.partitions)
+            if rp is not None:
+                decisions[f"exchange{xi}:{node.key}"] = {
+                    "capacity": rp.capacity, "rounds": rp.rounds,
+                    "skew_ratio": round(rp.skew_ratio, 3)}
+            xi += 1
+        elif isinstance(node, ir.Aggregate):
+            hint = choose_groupby_engine(counts=stats.get("counts"),
+                                         stages_ms=stats.get("stages_ms"))
+            if hint is not None:
+                decisions[f"aggregate{ai}:{','.join(node.keys)}"] = {
+                    "engine": hint}
+            ai += 1
+    return decisions

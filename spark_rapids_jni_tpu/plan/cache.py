@@ -1,0 +1,99 @@
+"""Plan cache: canonical IR shape + input schema + config fingerprint
+-> compiled program, with LRU eviction and hit/miss counters.
+
+A hit returns the SAME :class:`~spark_rapids_jni_tpu.plan.compile.
+CompiledPlan` object, whose jitted callable has already traced for the
+cached shapes — so a repeated-shape execution costs zero retraces (the
+property tests assert via :func:`~spark_rapids_jni_tpu.plan.compile.
+trace_count`).  Any knob flip changes the config fingerprint and any
+shape/dtype/dict-token change the schema fingerprint, so both are
+misses by construction rather than by invalidation logic.
+
+Counters surface the same way the spill/shuffle metrics do:
+``RmmSpark.plan_cache_metrics()`` and ``profiler.plan_cache_summary()``
+read :func:`plan_cache_metrics`.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+from .. import config
+
+
+class PlanCache:
+    """LRU cache with explicit hit/miss/eviction counters.
+
+    ``maxsize`` defaults to the ``plan_cache_size`` knob, re-read at
+    every insert so a live knob change takes effect without rebuilding
+    the cache (shrinking evicts immediately).
+    """
+
+    def __init__(self, maxsize=None):
+        self._maxsize = maxsize
+        self._lock = threading.Lock()
+        self._entries: OrderedDict = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def _capacity(self) -> int:
+        if self._maxsize is not None:
+            return int(self._maxsize)
+        return int(config.get("plan_cache_size"))
+
+    def get(self, key):
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return entry
+
+    def put(self, key, value) -> None:
+        with self._lock:
+            self._entries[key] = value
+            self._entries.move_to_end(key)
+            cap = max(self._capacity(), 1)
+            while len(self._entries) > cap:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def metrics(self) -> dict:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "size": len(self._entries),
+                "capacity": self._capacity(),
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+_cache = PlanCache()
+
+
+def get_plan_cache() -> PlanCache:
+    return _cache
+
+
+def plan_cache_metrics() -> dict:
+    """Snapshot of the global plan cache's counters (zeros-safe)."""
+    return _cache.metrics()
+
+
+def reset_plan_cache() -> None:
+    """Drop every cached plan AND zero the counters (test isolation)."""
+    global _cache
+    _cache = PlanCache()

@@ -1,0 +1,439 @@
+"""Whole-plan compiler: lower a logical plan into ONE jitted program.
+
+The lowering rules are the hand-fused flagship pipelines, factored:
+
+* Filter -> a row mask carried forward (never a compaction pass); on a
+  dictionary-encoded column the predicate evaluates over the d-entry
+  dictionary once and pushes down onto codes (``predicate_mask``) —
+  late materialization preserved, no decode under jit.
+* Exchange -> the local shuffle leg (Spark-exact murmur3 pid + stable
+  ``regroup_order``), dead rows routed to the trailing
+  pseudo-partition so live prefixes survive the permutation.
+* Exchange directly under an Aggregate on the same key FUSES, exactly
+  the way ``_q95_prefix`` does: under the pinned sort group-by engine
+  the group key's radix words ride the regroup sort as SECONDARY
+  operands and ``group_by(assume_grouped=True)`` skips its own sort
+  (one row-sized sort where the naive plan pays two); under the
+  scatter/auto engines — and on encoded keys — the single-chip
+  exchange is a no-op before a complete local aggregation, so it is
+  ELIDED outright.
+* Join -> ``join_dense_or_hash`` on plain inputs with a dense-domain
+  hint, the general engine-selectable ``hash_join`` otherwise (the
+  encoded lowering — the rowid fast path keys on raw ``.data``, which
+  an encoded column deliberately does not expose).  A broadcast join
+  (adaptive decision) probes a spill-registered prebuilt
+  :class:`~spark_rapids_jni_tpu.relational.join.SpillableBuildTable`,
+  pinned to the engine the plan decided so eviction-driven rebuilds
+  cannot disagree with the compiled program's traced shapes.
+* Aggregate -> ``group_by_onehot`` / ``group_by_domain_or_sort`` /
+  general ``group_by`` by exactly the hand paths' dispatch (domain
+  hints apply only to plain int keys; string/encoded keys run the
+  general engine).
+
+One ``jax.jit`` wraps the whole lowered pipeline, so XLA sees every
+stage together.  Programs are cached in :mod:`cache` keyed on
+(canonical IR signature, input schema fingerprint, config fingerprint,
+adaptive decisions); a cache hit reuses the already-traced program —
+:func:`trace_count` observes that ZERO retraces happen on repeats.
+"""
+
+from __future__ import annotations
+
+import operator
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import config
+from ..columnar.column import Column, ColumnBatch
+from ..columnar.encoded import is_encoded, predicate_mask
+from . import adaptive, ir
+from .cache import get_plan_cache
+
+# incremented INSIDE the traced program body — a trace-time side effect,
+# so it counts (re)traces, not executions.  The plan-cache acceptance
+# bar ("repeated shape -> zero retraces") is asserted against this.
+_TRACE_COUNT = [0]
+
+
+def trace_count() -> int:
+    return _TRACE_COUNT[0]
+
+
+# ---------------------------------------------------------------------------
+# fingerprints
+# ---------------------------------------------------------------------------
+
+def _schema_fingerprint(inputs: dict) -> tuple:
+    """Hashable identity of the input schemas: pytree structure (which
+    carries column names, dtypes and dictionary tokens as static aux)
+    plus every leaf's shape/dtype — any row-count, dtype, column-set or
+    dictionary change misses the cache by construction."""
+    out = []
+    for name in sorted(inputs):
+        batch = inputs[name]
+        leaves, treedef = jax.tree_util.tree_flatten(batch)
+        out.append((name, treedef,
+                    tuple((tuple(l.shape), str(l.dtype)) for l in leaves)))
+    return tuple(out)
+
+
+def _config_fingerprint() -> tuple:
+    """Every registered knob's resolved value — a flip of ANY knob is a
+    plan-cache miss (knobs select engines and fusion shapes, so a stale
+    hit could replay the wrong physical plan)."""
+    return tuple((k, repr(config.get(k))) for k in sorted(config.describe()))
+
+
+def _freeze(obj):
+    if isinstance(obj, dict):
+        return tuple(sorted((k, _freeze(v)) for k, v in obj.items()))
+    if isinstance(obj, (list, tuple)):
+        return tuple(_freeze(v) for v in obj)
+    return obj
+
+
+def plan_cache_key(plan: ir.PlanNode, inputs: dict,
+                   decisions: Optional[dict] = None) -> tuple:
+    return (plan.signature(), _schema_fingerprint(inputs),
+            _config_fingerprint(), _freeze(decisions or {}))
+
+
+# ---------------------------------------------------------------------------
+# lowering
+# ---------------------------------------------------------------------------
+
+_FILTER_OPS = {
+    "<": operator.lt, "<=": operator.le, ">": operator.gt,
+    ">=": operator.ge, "==": operator.eq, "!=": operator.ne,
+}
+
+
+def _filter_mask(col, op: str, value):
+    """Row mask for ``col <op> value`` — pushed onto dictionary codes
+    for encoded columns (one d-entry predicate + one gather)."""
+    fn = _FILTER_OPS[op]
+    if is_encoded(col) and hasattr(col, "codes"):
+        return predicate_mask(col, lambda d: fn(d.data, value))
+    return fn(col.data, value)
+
+
+def _exchange_local(b: ColumnBatch, key: str, live, partitions: int,
+                    secondary=None) -> ColumnBatch:
+    """The hand paths' ``exchange_local``: dead rows get pseudo-partition
+    P (``spark_partition_id``) and the stable regroup sends them LAST,
+    so live rows stay compacted in front and an arange<count mask
+    remains valid after the regroup."""
+    from ..parallel.partition import regroup_order, spark_partition_id
+    from ..relational.gather import gather_column
+
+    pid = spark_partition_id([b[key]], partitions, live)
+    order = regroup_order(pid, partitions + 1, secondary=secondary)
+    return ColumnBatch({name: gather_column(col, order)
+                        for name, col in zip(b.names, b.columns)})
+
+
+def _plain_int_key(col) -> bool:
+    return (isinstance(col, Column)
+            and jnp.issubdtype(col.data.dtype, jnp.integer))
+
+
+class _State:
+    """Per-trace lowering cursor: ordinals into the compile-time join
+    plans / aggregate hints, consumed in walk order (lowering recursion
+    visits nodes in the same children-first order as ``PlanNode.walk``).
+    """
+
+    def __init__(self, join_plans, agg_hints):
+        self.join_plans = join_plans
+        self.agg_hints = agg_hints
+        self.join_i = 0
+        self.agg_i = 0
+
+
+def _lower(node: ir.PlanNode, env: dict, prebuilts: tuple, st: _State):
+    """Returns ``(batch, live, prefix)``: ``live`` is a bool row mask or
+    None (statically all-live); ``prefix`` records that the mask is of
+    arange<count form (live rows compacted in front), which is what
+    lets it pass through an exchange untouched — a scattered filter
+    mask instead becomes ``arange < sum(live)`` on the far side."""
+    if isinstance(node, ir.Scan):
+        return env[node.name], None, True
+
+    if isinstance(node, ir.Filter):
+        b, live, _pfx = _lower(node.child, env, prebuilts, st)
+        mask = _filter_mask(b[node.column], node.op, node.value)
+        live = mask if live is None else live & mask
+        return b, live, False
+
+    if isinstance(node, ir.Project):
+        b, live, pfx = _lower(node.child, env, prebuilts, st)
+        return b.select(list(node.columns)), live, pfx
+
+    if isinstance(node, ir.Exchange):
+        b, live, pfx = _lower(node.child, env, prebuilts, st)
+        live_arr = (jnp.ones((b.num_rows,), jnp.bool_) if live is None
+                    else live)
+        staged = _exchange_local(b, node.key, live_arr, node.partitions)
+        if live is None or pfx:
+            return staged, live, pfx
+        n = staged.num_rows
+        new_live = jnp.arange(n, dtype=jnp.int32) < jnp.sum(
+            live.astype(jnp.int32))
+        return staged, new_live, True
+
+    if isinstance(node, ir.Sort):
+        return _lower_sort(node, env, prebuilts, st)
+
+    if isinstance(node, ir.Join):
+        return _lower_join(node, env, prebuilts, st)
+
+    if isinstance(node, ir.Aggregate):
+        return _lower_aggregate(node, env, prebuilts, st)
+
+    raise TypeError(f"cannot lower {type(node).__name__}")
+
+
+def _lower_sort(node: ir.Sort, env, prebuilts, st):
+    from ..columnar import types as T
+    from ..relational.sort import SortKey, sort_by
+
+    b, live, _pfx = _lower(node.child, env, prebuilts, st)
+    keys = [SortKey(k) for k in node.keys]
+    if live is None:
+        return sort_by(b, keys), None, True
+    # dead rows last (same __occ trick as the distributed sort epilogue)
+    aug = b.with_column("__occ", Column(live.astype(jnp.int32),
+                                        jnp.ones_like(live), T.INT32))
+    out = sort_by(aug, [SortKey("__occ", ascending=False)] + keys)
+    n = out.num_rows
+    new_live = jnp.arange(n, dtype=jnp.int32) < jnp.sum(
+        live.astype(jnp.int32))
+    return (out.select([nm for nm in out.names if nm != "__occ"]),
+            new_live, True)
+
+
+def _lower_join(node: ir.Join, env, prebuilts, st):
+    from ..relational.join import hash_join, join_dense_or_hash
+
+    b, live, _pfx = _lower(node.child, env, prebuilts, st)
+    rb, rlive, _rpfx = _lower(node.right, env, prebuilts, st)
+    info = st.join_plans[st.join_i]
+    st.join_i += 1
+
+    if info["strategy"] == "broadcast":
+        out, cnt = hash_join(
+            b, rb, [node.left_on], [node.right_on], node.how,
+            left_valid=live, right_valid=rlive,
+            prebuilt=prebuilts[info["prebuilt"]], engine=info["engine"])
+    elif info["dense_domain"] is not None:
+        out, cnt = join_dense_or_hash(
+            b, rb, node.left_on, node.right_on, info["dense_domain"],
+            node.how, left_valid=live, right_valid=rlive)
+    else:
+        out, cnt = hash_join(b, rb, [node.left_on], [node.right_on],
+                             node.how, left_valid=live, right_valid=rlive)
+    new_live = jnp.arange(out.num_rows, dtype=jnp.int32) < cnt
+    return out, new_live, True
+
+
+def _lower_aggregate(node: ir.Aggregate, env, prebuilts, st):
+    from ..relational import keys as _rk
+    from ..relational.aggregate import (AggSpec, group_by,
+                                        group_by_domain_or_sort,
+                                        group_by_onehot)
+
+    aggs = [AggSpec(a.op, a.column, a.out_name) for a in node.aggs]
+    hint = st.agg_hints[st.agg_i]
+    st.agg_i += 1
+
+    child = node.child
+    fuse = (isinstance(child, ir.Exchange) and len(node.keys) == 1
+            and child.key == node.keys[0])
+    if fuse:
+        b, live, pfx = _lower(child.child, env, prebuilts, st)
+        key_col = b[node.keys[0]]
+        if (_plain_int_key(key_col)
+                and config.get("groupby_engine") == "sort"):
+            # sort-order reuse: the seg radix words ride the regroup
+            # sort as secondary operands, so the group-by receives an
+            # already-grouped input and skips its own sort
+            segkeys = _rk.batch_radix_keys([key_col], equality=True,
+                                           nulls_first=True)
+            live_arr = (jnp.ones((b.num_rows,), jnp.bool_) if live is None
+                        else live)
+            staged = _exchange_local(b, child.key, live_arr,
+                                     child.partitions, secondary=segkeys)
+            if live is not None and not pfx:
+                live = jnp.arange(staged.num_rows, dtype=jnp.int32) < \
+                    jnp.sum(live.astype(jnp.int32))
+            res, ng = group_by(staged, [node.keys[0]], aggs,
+                               row_valid=live, assume_grouped=True)
+            return res, ng, True
+        # scatter/auto engines and encoded keys: the single-chip
+        # exchange feeds a complete local aggregation — elide it
+    else:
+        b, live, _pfx = _lower(child, env, prebuilts, st)
+
+    key_col = b[node.keys[0]] if len(node.keys) == 1 else None
+    domain_ok = (node.domain is not None and key_col is not None
+                 and _plain_int_key(key_col))
+    if node.onehot and domain_ok:
+        if config.get("q6_group_path") == "onehot":
+            res, ng, _overflow = group_by_onehot(
+                b, node.keys[0], aggs, domain=int(node.domain),
+                row_valid=live, float_mode=config.get("q6_float_mode"),
+                engine=config.get("q6_onehot_engine"))
+            return res, ng, True
+        res, ng = group_by(b, list(node.keys), aggs, row_valid=live)
+        return res, ng, True
+    if domain_ok and not node.onehot:
+        res, ng = group_by_domain_or_sort(b, node.keys[0], aggs,
+                                          int(node.domain), row_valid=live)
+        return res, ng, True
+    kwargs = {"engine": hint} if hint else {}
+    res, ng = group_by(b, list(node.keys), aggs, row_valid=live, **kwargs)
+    return res, ng, True
+
+
+# ---------------------------------------------------------------------------
+# compiled plans
+# ---------------------------------------------------------------------------
+
+class CompiledPlan:
+    """One whole-plan jitted program plus its execute-time adjuncts:
+    the spill-registered broadcast build handles (fetched per run
+    through the retry ladder, OUTSIDE the jitted region) and the
+    recorded adaptive decisions.  ``last_lookup`` says whether the most
+    recent :func:`compile_plan` returning this object was a cache hit.
+    """
+
+    def __init__(self, plan, key, fn, input_names, build_handles,
+                 decisions):
+        self.plan = plan
+        self.key = key
+        self.fn = fn
+        self.input_names = input_names
+        self.build_handles = build_handles
+        self.decisions = decisions
+        self.last_lookup = "miss"
+
+    def __call__(self, inputs: dict):
+        from ..mem.executor import run_with_retry
+
+        missing = [n for n in self.input_names if n not in inputs]
+        if missing:
+            raise KeyError(f"plan inputs missing: {missing}")
+        env = {n: inputs[n] for n in self.input_names}
+        prebuilts = []
+        for h in self.build_handles:
+            # pin across get(): an evictor may not drop the table while
+            # the fetch is in flight; the returned arrays keep their
+            # buffers alive on their own afterwards
+            with h.pinned():
+                prebuilts.append(tuple(run_with_retry(h.get)))
+        return self.fn(env, tuple(prebuilts))
+
+    def close(self):
+        for h in self.build_handles:
+            h.close()
+
+
+def _resolve_join_plans(plan, inputs, decisions, ctx):
+    """Walk-order physical join plans + broadcast build handles.
+
+    Broadcast builds are registered as spillable tables under the
+    owning query's ``ctx`` (TaskContext) with the decided engine PINNED
+    — a parked tenant's broadcast can be evicted, and its rebuild comes
+    back in the shape the compiled program was traced against."""
+    from ..relational.join import spillable_build_table
+
+    join_plans = []
+    agg_hints = []
+    handles = []
+    ji = ai = 0
+    for node in plan.walk():
+        if isinstance(node, ir.Join):
+            d = decisions.get(f"join{ji}:{node.left_on}", {})
+            strategy = d.get("strategy", node.strategy)
+            if strategy == "auto":
+                strategy = "shuffled"
+            rb = inputs.get(node.right.name) \
+                if isinstance(node.right, ir.Scan) else None
+            dense = node.dense_domain
+            if dense == "build":
+                dense = rb.num_rows if rb is not None else None
+            if _inputs_encoded(inputs):
+                # the rowid fast path keys on raw .data, which encoded
+                # columns do not expose — the hand encoded q95 lowering
+                dense = None
+            info = {"strategy": strategy, "dense_domain": dense,
+                    "prebuilt": None, "engine": None}
+            if strategy == "broadcast":
+                if rb is None:
+                    raise ValueError(
+                        "broadcast join needs a Scan build side bound "
+                        "to an input batch")
+                engine = d.get("engine") or adaptive.choose_join_engine()
+                h = spillable_build_table(
+                    rb, [node.right_on], ctx=ctx,
+                    name=f"plan-bcast-{ji}-{node.left_on}", engine=engine)
+                info["prebuilt"] = len(handles)
+                info["engine"] = engine
+                handles.append(h)
+            join_plans.append(info)
+            ji += 1
+        elif isinstance(node, ir.Aggregate):
+            d = decisions.get(f"aggregate{ai}:{','.join(node.keys)}", {})
+            agg_hints.append(d.get("engine"))
+            ai += 1
+    return join_plans, agg_hints, handles
+
+
+def _inputs_encoded(inputs: dict) -> bool:
+    return any(is_encoded(c) for b in inputs.values() for c in b.columns)
+
+
+def compile_plan(plan: ir.PlanNode, inputs: dict, ctx=None,
+                 stats: Optional[dict] = None) -> CompiledPlan:
+    """Compile ``plan`` against the schemas/stats of ``inputs`` (a dict
+    binding every Scan name to a ``ColumnBatch``), consulting the plan
+    cache first.  ``ctx`` (TaskContext) owns any broadcast build tables
+    the adaptive layer decides to create; ``stats`` feeds the adaptive
+    decisions (see :func:`adaptive.plan_decisions`)."""
+    decisions = adaptive.plan_decisions(plan, inputs, stats)
+    key = plan_cache_key(plan, inputs, decisions)
+    cache = get_plan_cache()
+    cached = cache.get(key)
+    if cached is not None:
+        cached.last_lookup = "hit"
+        return cached
+
+    join_plans, agg_hints, handles = _resolve_join_plans(
+        plan, inputs, decisions, ctx)
+    input_names = ir.scan_names(plan)
+
+    def run(env, prebuilts):
+        _TRACE_COUNT[0] += 1
+        st = _State(join_plans, agg_hints)
+        out = _lower(plan, env, prebuilts, st)
+        if isinstance(plan, ir.Aggregate):
+            res, ng, _pfx = out
+            return res, ng
+        batch, live, _pfx = out
+        return batch if live is None else (batch, live)
+
+    compiled = CompiledPlan(plan, key, jax.jit(run), input_names, handles,
+                            decisions)
+    cache.put(key, compiled)
+    return compiled
+
+
+def execute(plan: ir.PlanNode, inputs: dict, ctx=None,
+            stats: Optional[dict] = None):
+    """Compile (or fetch) and run ``plan`` over ``inputs``.  Aggregate
+    roots return ``(result, num_groups)`` — the hand-fused steps'
+    contract; other roots return the batch (plus a live mask when one
+    is in flight)."""
+    return compile_plan(plan, inputs, ctx=ctx, stats=stats)(inputs)

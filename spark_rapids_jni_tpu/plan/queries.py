@@ -1,0 +1,74 @@
+"""The flagship queries as DATA: pure IR, no hand-written lowering.
+
+``q6_plan``/``q95_plan`` are the IR spellings of the hand-fused
+``_q6_step``/``_q95_step`` pipelines in ``__graft_entry__.py`` — the
+compiler's lowering rules reproduce those paths exactly, and
+tests/test_plan.py gates the outputs bit-identical on plain AND
+encoded inputs under both engine knob settings.  ``q9_plan`` is the
+proof that new queries are now data, not code: a q9-shaped pipeline
+(multi-join + conditional aggregate) that exists ONLY as IR — there is
+no hand-fused ``_q9_step`` anywhere.
+"""
+
+from __future__ import annotations
+
+from .ir import Agg, Aggregate, Exchange, Filter, Join, Scan
+
+# the q9 conditional: high-value orders only (the WHEN net > threshold
+# arm of q9's conditional aggregate, expressed as filter -> row_valid)
+Q9_V_THRESHOLD = 250
+
+
+def q6_plan() -> Aggregate:
+    """q6: filter (price < 50) -> group by k: sum(v), count(*),
+    avg(price).  One plan serves the int-keyed, string-keyed AND
+    dictionary-encoded batches: the domain/onehot hints only engage for
+    a plain int key, exactly like the hand paths (``_q6_step`` vs
+    ``_q6str_step``)."""
+    return Aggregate(
+        Filter(Scan("batch"), "price", "<", 50.0),
+        keys=("k",),
+        aggs=(Agg("sum", "v", "sum_v"),
+              Agg("count", None, "cnt"),
+              Agg("mean", "price", "avg_price")),
+        domain=100, onehot=True)
+
+
+def q95_plan() -> Aggregate:
+    """q95: exchange -> join dim1 -> exchange -> join dim2 -> exchange
+    -> group by seg.  The trailing Exchange+Aggregate pair is what the
+    compiler fuses (sort engine: secondary operands; scatter/auto or
+    encoded: elision) — the IR says WHAT Spark's plan says
+    (exchange-before-HashAggregate), the compiler decides the fused
+    physical form."""
+    from __graft_entry__ import Q95_SEG
+
+    j1 = Join(Exchange(Scan("fact"), "k"), Scan("dim1"), "k", "k",
+              dense_domain="build")
+    j2 = Join(Exchange(j1, "wh"), Scan("dim2"), "wh", "wh",
+              dense_domain="build")
+    return Aggregate(
+        Exchange(j2, "seg"),
+        keys=("seg",),
+        aggs=(Agg("count", None, "orders"), Agg("sum", "v", "net")),
+        domain=Q95_SEG)
+
+
+def q9_plan() -> Aggregate:
+    """q9 shape, IR-only: fact joins both dims (adaptive strategy — the
+    dims are small, so the plan-time decision goes broadcast under the
+    default ``broadcast_threshold_rows``), then a conditional aggregate
+    (only orders with v >= threshold count) grouped by segment."""
+    from __graft_entry__ import Q95_SEG
+
+    j1 = Join(Scan("fact"), Scan("dim1"), "k", "k",
+              dense_domain="build", strategy="auto")
+    j2 = Join(j1, Scan("dim2"), "wh", "wh",
+              dense_domain="build", strategy="auto")
+    return Aggregate(
+        Filter(j2, "v", ">=", Q9_V_THRESHOLD),
+        keys=("seg",),
+        aggs=(Agg("sum", "v", "net_hi"),
+              Agg("count", None, "orders_hi"),
+              Agg("mean", "v", "avg_hi")),
+        domain=Q95_SEG)

@@ -172,6 +172,18 @@ def shuffle_summary() -> dict:
     return get_registry().metrics.snapshot()
 
 
+def plan_cache_summary() -> dict:
+    """Plan-cache counters for profile reports: compiled-program hits,
+    misses, LRU evictions, and current size/capacity — the retrace
+    story next to :func:`spill_summary`/:func:`shuffle_summary` (a hit
+    means a repeated plan shape re-executed with zero retraces).
+    Always zeros-safe: the cache exists as soon as the plan package
+    imports."""
+    from .plan.cache import plan_cache_metrics
+
+    return plan_cache_metrics()
+
+
 def trace_range(name: str):
     """Named range in the captured trace — the NVTX-range analogue
     (reference compiles nvtx3 ranges into kernels for nsys, SURVEY §5);

@@ -567,7 +567,7 @@ def _concat_batches(a: ColumnBatch, b: ColumnBatch) -> ColumnBatch:
 
 def spillable_build_table(right: ColumnBatch, right_on: Sequence[str],
                           right_valid=None, ctx=None,
-                          name: Optional[str] = None):
+                          name: Optional[str] = None, engine=None):
     """Register a join build table (the build product over
     ``right[right_on]``) in the spill framework as a
     :class:`SpillableBuildTable`.
@@ -579,12 +579,17 @@ def spillable_build_table(right: ColumnBatch, right_on: Sequence[str],
     compiled build.  Recompute-over-copy is the right trade for a product
     the probe can deterministically regenerate.
 
-    The build product's SHAPE follows the active ``join_engine`` knob
-    (sorted keys + permutation for the sort engine, :func:`_hash_build`'s
-    slot-table tuple for the hash engine), re-read at every rebuild: a
-    table built under one engine and evicted rebuilds under whatever
-    engine is active THEN, and the handle's ``engine`` attribute tells
-    ``hash_join(prebuilt=...)`` how to probe what it got.
+    The build product's SHAPE follows ``engine`` (sorted keys +
+    permutation for the sort engine, :func:`_hash_build`'s slot-table
+    tuple for the hash engine).  With ``engine=None`` the
+    ``join_engine`` knob is re-read at every rebuild: a table built
+    under one engine and evicted rebuilds under whatever engine is
+    active THEN, and the handle's ``engine`` attribute tells
+    ``hash_join(prebuilt=...)`` how to probe what it got.  Pass an
+    explicit engine to PIN it across rebuilds — what the plan
+    compiler's adaptive broadcast decision does, so an eviction-driven
+    rebuild can never disagree with the engine the compiled program was
+    traced against.
 
     Pass the result as ``hash_join(..., prebuilt=table)`` to reuse one
     build across many probe batches.  Close it when done.
@@ -612,7 +617,8 @@ def spillable_build_table(right: ColumnBatch, right_on: Sequence[str],
     nr = right.num_rows
 
     def builder():
-        eng = _resolve_join_engine(None)  # the knob, at (re)build time
+        # pinned engine, else the knob at (re)build time
+        eng = _resolve_join_engine(engine)
         rkeys = K.batch_radix_keys(rcols, equality=True, nulls_first=False)
         if eng == "hash":
             return eng, _hash_build(rkeys, nr)

@@ -611,6 +611,115 @@ class TestGL009:
 
 
 # ---------------------------------------------------------------------------
+# GL010 — sharding-constraint drift (shard_map axis names vs the mesh)
+# ---------------------------------------------------------------------------
+
+
+class TestGL010:
+    def test_collective_axis_drift_flagged(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            from functools import partial
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, PartitionSpec as P
+            from jax.experimental.shard_map import shard_map
+
+            def make(devs):
+                return Mesh(np.array(devs), ("data",))
+
+            @partial(shard_map, in_specs=P("data"), out_specs=P("data"))
+            def step(x):
+                return jax.lax.psum(x, "batch")
+        """}, rules=["GL010"])
+        assert new_rules(res) == [("GL010", "mod.py")]
+        assert "unbound axis name" in res.new[0].message
+
+    def test_spec_literal_drift_flagged(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            from functools import partial
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, PartitionSpec as P
+            from jax.experimental.shard_map import shard_map
+
+            def make(devs):
+                return Mesh(np.array(devs), ("data",))
+
+            @partial(shard_map, in_specs=P("model"), out_specs=P("model"))
+            def step(x):
+                return x
+        """}, rules=["GL010"])
+        assert [f.rule for f in res.new] == ["GL010", "GL010"]
+        assert "PartitionSpec axis 'model'" in res.new[0].message
+
+    def test_matching_axes_and_variable_axis_name_clean(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            from functools import partial
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, PartitionSpec as P
+            from jax.experimental.shard_map import shard_map
+
+            def make(devs, axis_name="data"):
+                return Mesh(np.array(devs), (axis_name,))
+
+            @partial(shard_map, in_specs=P("data"), out_specs=P("data"))
+            def step(x):
+                return jax.lax.psum(x, "data")
+
+            def threaded(mesh, axis_name):
+                # the repo idiom: the axis name is a VARIABLE, one
+                # source of truth — nothing for the rule to check
+                @partial(shard_map, mesh=mesh, in_specs=P(axis_name),
+                         out_specs=P(axis_name))
+                def inner(x):
+                    return jax.lax.pmax(x, axis_name)
+                return inner
+        """}, rules=["GL010"])
+        assert res.new == []
+
+    def test_no_declared_mesh_spec_literals_anchor(self, tmp_path):
+        # no Mesh(...) in the file: the wrap's own PartitionSpec
+        # literals are the only source of truth for the body
+        res = lint(tmp_path, {"mod.py": """
+            from functools import partial
+            import jax
+            from jax.sharding import PartitionSpec as P
+            from jax.experimental.shard_map import shard_map
+
+            @partial(shard_map, in_specs=P("x"), out_specs=P("x"))
+            def good(v):
+                return jax.lax.psum(v, "x")
+
+            @partial(shard_map, in_specs=P("x"), out_specs=P("x"))
+            def bad(v):
+                return jax.lax.psum(v, "y")
+        """}, rules=["GL010"])
+        assert new_rules(res) == [("GL010", "mod.py")]
+
+    def test_test_file_exempt_and_suppressed(self, tmp_path):
+        src = """
+            from functools import partial
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh, PartitionSpec as P
+            from jax.experimental.shard_map import shard_map
+
+            def make(devs):
+                return Mesh(np.array(devs), ("data",))
+
+            @partial(shard_map, in_specs=P("data"), out_specs=P("data"))
+            def step(x):
+                return jax.lax.psum(x, "batch")  # graftlint: disable=GL010
+        """
+        res = lint(tmp_path, {"mod.py": src}, rules=["GL010"])
+        assert res.new == [] and res.counts()["suppressed"] == 1
+        res = lint(tmp_path, {"test_shard.py": src.replace(
+            "  # graftlint: disable=GL010", "")}, rules=["GL010"])
+        assert res.new == []
+
+
+# ---------------------------------------------------------------------------
 # baseline ratchet
 # ---------------------------------------------------------------------------
 
@@ -725,4 +834,4 @@ class TestLiveTree:
         from tools.graftlint import rules as rules_mod
         ids = [r.id for r in rules_mod.all_rules()]
         assert ids == ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
-                       "GL007", "GL008", "GL009"]
+                       "GL007", "GL008", "GL009", "GL010"]

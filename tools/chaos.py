@@ -3,10 +3,11 @@
 The premerge gate (ci/chaos.sh) that proves the fault-domain story
 end-to-end, the way ci/q95_floor.json proves perf: it sweeps every
 registered ``faultinj.FAULT_KINDS`` entry across every instrumented
-boundary of three scenarios — a spill walk (device→host→disk→back), an
-out-of-core skewed shuffle, and the single-chip q95 pipeline — one fault
-per trial exhaustively, plus ``chaos_trials`` seeded multi-fault trials
-per scenario.  Every trial must end with
+boundary of five scenarios — a spill walk (device→host→disk→back), an
+out-of-core skewed shuffle, the single-chip q95 pipeline, a global
+distributed sort across the 8-device mesh, and a JNI host-boundary
+round-trip — one fault per trial exhaustively, plus ``chaos_trials``
+seeded multi-fault trials per scenario.  Every trial must end with
 
 * a result **bit-identical** to the scenario's fault-free baseline
   (sha256 over every output leaf's dtype/shape/bytes), and
@@ -95,6 +96,8 @@ class ChaosError(AssertionError):
 _spill_probe = faultinj.instrument(lambda: None, "chaos_spill_step")
 _shuffle_probe = faultinj.instrument(lambda: None, "chaos_shuffle_step")
 _q95_probe = faultinj.instrument(lambda: None, "chaos_q95_step")
+_sort_probe = faultinj.instrument(lambda: None, "chaos_sort_step")
+_jni_probe = faultinj.instrument(lambda: None, "chaos_jni_step")
 
 
 def _digest(tree) -> str:
@@ -282,8 +285,90 @@ class Q95Scenario:
         return {"digest": digest, "extra": {}}
 
 
+class SortScenario:
+    """Global sample-sort across the 8-device mesh (range partition by
+    host-sampled splitters → shard_map exchange → local sort with dead
+    slots last): the distributed-sort fault domain.  Crosses the
+    chaos_sort_step seam before planning and after the sorted result
+    lands, proving a faulted ``distributed_sort`` replays bit-identical
+    (rows, occupancy, dropped) — the splitter sample, capacity plan and
+    exchange are all re-derived from scratch by the replacement run."""
+
+    name = "sort"
+
+    def run(self) -> Dict:
+        from spark_rapids_jni_tpu.parallel import (
+            data_mesh,
+            distributed_sort,
+            shard_batch,
+        )
+
+        if len(jax.devices()) < 8:
+            raise ChaosError(
+                "sort scenario needs 8 devices; set XLA_FLAGS="
+                "--xla_force_host_platform_device_count=8 before jax init")
+        P = 8
+        n = P * 1024
+        keys = (np.arange(n, dtype=np.int64) * 2654435761) % (1 << 20)
+        mesh = data_mesh(P)
+        batch = shard_batch(ColumnBatch({
+            "k": Column(jnp.asarray(keys), jnp.ones((n,), jnp.bool_),
+                        T.INT64),
+            "v": Column(jnp.asarray(np.arange(n, dtype=np.int64)),
+                        jnp.ones((n,), jnp.bool_), T.INT64)}), mesh)
+        with _harness(4 * MB, 1 * MB, self.name) as (fw, adaptor):
+            def body():
+                _sort_probe()
+                out, occ, dropped = distributed_sort(batch, ["k"], mesh)
+                _sort_probe()  # post-sort seam: skip=1 rules land here
+                return _digest((out, occ, dropped))
+            digest = run_with_retry(body, make_spillable=_always_retry(fw))
+            _check_invariants(fw, adaptor)
+        return {"digest": digest, "extra": {}}
+
+
+class JniScenario:
+    """The Java/JNI host boundary: columns cross as Arrow-style host
+    buffers, ops dispatch through ``jni_bridge.invoke`` (hash → bloom
+    create/put/probe), results round-trip back through
+    ``column_to_host`` — the embedded-host analogue of a Spark executor
+    driving the bridge library.  A replacement attempt rebuilds every
+    handle from the original host buffers, so an aborting fault
+    mid-round-trip leaks nothing across attempts."""
+
+    name = "jni"
+
+    def run(self) -> Dict:
+        from spark_rapids_jni_tpu import jni_bridge as jb
+
+        n = 4096
+        vals = (np.arange(n, dtype=np.int64) * 0x9E3779B9) % (1 << 31)
+        data = vals.tobytes()
+        with _harness(8 * MB, 2 * MB, self.name) as (fw, adaptor):
+            def body():
+                _jni_probe()
+                col = jb.column_from_host("int64", n, data, b"")
+                hashed, _meta = jb.invoke(
+                    "Hash.murmurHash32", json.dumps({"seed": 42}), [col])
+                _jni_probe()
+                bf, _ = jb.invoke(
+                    "BloomFilter.create",
+                    json.dumps({"bits": 1 << 14, "num_hashes": 3}), [])
+                put, _ = jb.invoke("BloomFilter.put", "", [bf[0], col])
+                hits, _ = jb.invoke("BloomFilter.probe", "", [put[0], col])
+                _jni_probe()
+                out = [jb.column_to_host(hashed[0]),
+                       jb.column_to_host(hits[0])]
+                return _digest([np.frombuffer(c[2], dtype=np.uint8)
+                                for c in out])
+            digest = run_with_retry(body, make_spillable=_always_retry(fw))
+            _check_invariants(fw, adaptor)
+        return {"digest": digest, "extra": {}}
+
+
 SCENARIOS = {s.name: s for s in (SpillScenario(), ShuffleScenario(),
-                                 Q95Scenario())}
+                                 Q95Scenario(), SortScenario(),
+                                 JniScenario())}
 
 
 # ---------------------------------------------------------------------------
@@ -348,6 +433,18 @@ def single_fault_trials(fast: bool = False) -> List[Trial]:
     if not fast:
         for kind in ("exception", "oom", "fatal"):
             one("q95", "chaos_q95_step", kind)
+
+    # sort scenario: the distributed-sort seam (pre-plan and post-sort)
+    if not fast:
+        for kind in ("exception", "oom", "fatal"):
+            one("sort", "chaos_sort_step", kind)
+        one("sort", "chaos_sort_step", "exception", skip=1)
+
+    # jni scenario: the host-boundary seam (between bridge invocations)
+    if not fast:
+        for kind in ("exception", "oom", "fatal"):
+            one("jni", "chaos_jni_step", kind)
+        one("jni", "chaos_jni_step", "oom", skip=1)
     return t
 
 
@@ -363,6 +460,8 @@ _MULTI_POOL = {
                 ("spill_corrupt_file", "spill_corrupt"),
                 ("spill_io_write", "spill_io")],
     "q95": [("chaos_q95_step", "oom"), ("chaos_q95_step", "exception")],
+    "sort": [("chaos_sort_step", "oom"), ("chaos_sort_step", "exception")],
+    "jni": [("chaos_jni_step", "oom"), ("chaos_jni_step", "exception")],
 }
 
 
@@ -379,9 +478,11 @@ def multi_fault_trials(seed: int, per_scenario: int) -> List[Trial]:
             for match, kind in picks:
                 rule = {"match": match, "fault": kind,
                         "count": rng.randint(1, 2)}
-                # q95 crosses its probe only twice per attempt; larger
-                # skips could out-run the occurrence clock (vacuous trial)
-                skip = rng.randint(0, 1 if scenario == "q95" else 2)
+                # q95/sort cross their probe only twice per attempt;
+                # larger skips could out-run the occurrence clock
+                # (vacuous trial)
+                skip = rng.randint(
+                    0, 1 if scenario in ("q95", "sort") else 2)
                 if skip:
                     rule["skip"] = skip
                 rules.append(rule)

@@ -1,4 +1,4 @@
-"""The nine graftlint rules.  Each encodes a bug this repo shipped or is
+"""The ten graftlint rules.  Each encodes a bug this repo shipped or is
 structurally exposed to; see tools/graftlint/README.md for the full
 rationale with the motivating incident per rule."""
 
@@ -898,11 +898,219 @@ class GL009LateMaterializationBreach(Rule):
                             "materialize at the output boundary")
 
 
+# ---------------------------------------------------------------------------
+# GL010 — sharding-constraint drift: shard_map axis names vs the file's
+# declared mesh axes
+# ---------------------------------------------------------------------------
+
+# lax collectives whose axis argument names a mesh axis; the int is the
+# positional index of the axis argument when it isn't passed by keyword.
+_GL010_COLLECTIVES = {
+    "psum": 1, "pmax": 1, "pmin": 1, "pmean": 1, "psum_scatter": 1,
+    "all_gather": 1, "all_to_all": 1, "ppermute": 1, "axis_index": 0,
+}
+_GL010_SPEC_KWARGS = ("in_specs", "out_specs")
+
+
+def _is_shard_map(dotted: Optional[str]) -> bool:
+    return (dotted is not None
+            and dotted.rsplit(".", 1)[-1] == "shard_map"
+            and dotted.split(".", 1)[0] == "jax")
+
+
+def _shard_map_call_info(node: ast.AST, aliases: Dict[str, str]):
+    """Like ``_jit_call_info`` but only for the shard_map wrapper —
+    returns its keyword list (``mesh=``, ``in_specs=``, ``out_specs=``)
+    or None."""
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        return [] if _is_shard_map(resolve(node, aliases)) else None
+    if isinstance(node, ast.Call):
+        dotted = resolve(node.func, aliases)
+        if _is_shard_map(dotted):
+            return list(node.keywords)
+        if (dotted in ("functools.partial", "partial")
+                or (dotted or "").endswith(".partial")):
+            if node.args and _is_shard_map(resolve(node.args[0], aliases)):
+                return list(node.keywords)
+        return None
+    return None
+
+
+def _str_constants(node: ast.AST) -> Iterator[Tuple[str, ast.AST]]:
+    for c in ast.walk(node):
+        if isinstance(c, ast.Constant) and isinstance(c.value, str):
+            yield c.value, c
+
+
+class GL010ShardingConstraintDrift(Rule):
+    """A shard_map body's collectives (``lax.psum(x, "data")``) and its
+    wrap's ``PartitionSpec`` literals name mesh axes as STRINGS, while
+    the mesh itself declares them in a tuple somewhere else in the file
+    — rename one and the other keeps compiling against the stale name
+    until trace time raises ``unbound axis name`` on real hardware (or,
+    for a spec that happens to still name a valid axis, silently shards
+    over the wrong dimension).  The repo's own collectives thread
+    ``axis_name`` through as a variable precisely to keep one source of
+    truth; this rule gates string-literal drift for code that doesn't.
+    Flags (a) a collective axis literal inside a shard_map-wrapped
+    function that names no axis declared by the file's ``Mesh(...)``
+    tuples / ``axis_name=`` bindings nor by the wrap's own
+    ``PartitionSpec`` literals, and (b) a ``PartitionSpec`` literal in
+    the wrap's specs outside the file's declared mesh axes."""
+
+    id = "GL010"
+
+    def check(self, pf: ParsedFile) -> Iterable[Finding]:
+        if pf.is_test_file:
+            return
+        aliases = module_aliases(pf.tree)
+        declared = self._declared_axes(pf.tree, aliases)
+        for fn, kws in self._shard_map_wraps(pf, aliases):
+            spec_axes = set()
+            spec_nodes: List[Tuple[str, ast.AST]] = []
+            for kw in kws:
+                if kw.arg in _GL010_SPEC_KWARGS:
+                    for name, node in self._spec_literals(kw.value, aliases):
+                        spec_axes.add(name)
+                        spec_nodes.append((name, node))
+            if declared:
+                for name, node in spec_nodes:
+                    if name not in declared:
+                        yield pf.finding(
+                            self.id, node,
+                            f"PartitionSpec axis '{name}' on the "
+                            f"shard_map wrap of `{fn.name}` is not an "
+                            "axis this file's mesh declares "
+                            f"({sorted(declared)}) — the spec drifted "
+                            "from the Mesh axis tuple and shard_map "
+                            "will reject it (or shard the wrong "
+                            "dimension) at trace time; rename in "
+                            "lockstep or thread the axis name through "
+                            "a shared constant")
+            known = declared | spec_axes
+            if not known:
+                continue  # no literal source of truth to drift from
+            for coll, name, node in self._collective_axes(fn, aliases):
+                if name not in known:
+                    yield pf.finding(
+                        self.id, node,
+                        f"`{coll}(..., '{name}')` inside shard_map-"
+                        f"wrapped `{fn.name}` names a mesh axis the "
+                        "file never declares (mesh axes: "
+                        f"{sorted(known)}) — the collective raises "
+                        "`unbound axis name` at trace time on the real "
+                        "mesh; use the declared axis name (or bind it "
+                        "once and pass it as a variable)")
+
+    # -- declared axes: Mesh(..., ("a", "b")) tuples and axis_name= ----
+
+    @staticmethod
+    def _declared_axes(tree: ast.AST, aliases: Dict[str, str]) -> Set[str]:
+        declared: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                dotted = resolve(node.func, aliases) or ""
+                last = dotted.rsplit(".", 1)[-1]
+                if last in ("Mesh", "AbstractMesh", "make_mesh"):
+                    if len(node.args) > 1:
+                        declared.update(
+                            s for s, _ in _str_constants(node.args[1]))
+                    for kw in node.keywords:
+                        if kw.arg == "axis_names":
+                            declared.update(
+                                s for s, _ in _str_constants(kw.value))
+                for kw in node.keywords:
+                    if kw.arg == "axis_name":
+                        declared.update(
+                            s for s, _ in _str_constants(kw.value))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                args = node.args
+                params = args.posonlyargs + args.args
+                defaults = args.defaults
+                for p, d in zip(params[len(params) - len(defaults):],
+                                defaults):
+                    if p.arg == "axis_name" and d is not None:
+                        declared.update(s for s, _ in _str_constants(d))
+                for p, d in zip(args.kwonlyargs, args.kw_defaults):
+                    if p.arg == "axis_name" and d is not None:
+                        declared.update(s for s, _ in _str_constants(d))
+        return declared
+
+    # -- shard_map-wrapped functions (decorator or assigned wrap) ------
+
+    @staticmethod
+    def _shard_map_wraps(pf: ParsedFile, aliases: Dict[str, str]):
+        defs: Dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(pf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, node)
+        out: List[Tuple[ast.FunctionDef, List[ast.keyword]]] = []
+        seen: Set[int] = set()
+        for fn in defs.values():
+            for dec in fn.decorator_list:
+                kws = _shard_map_call_info(dec, aliases)
+                if kws is not None and id(fn) not in seen:
+                    seen.add(id(fn))
+                    out.append((fn, kws))
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if not _is_shard_map(resolve(node.func, aliases)):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Name) and arg.id in defs:
+                fn = defs[arg.id]
+                if id(fn) not in seen:
+                    seen.add(id(fn))
+                    out.append((fn, list(node.keywords)))
+        return out
+
+    # -- axis literals inside PartitionSpec(...) / P(...) calls --------
+
+    @staticmethod
+    def _spec_literals(node: ast.AST, aliases: Dict[str, str]):
+        for c in ast.walk(node):
+            if not isinstance(c, ast.Call):
+                continue
+            dotted = resolve(c.func, aliases) or ""
+            if dotted.rsplit(".", 1)[-1] != "PartitionSpec":
+                continue
+            for arg in c.args:
+                yield from _str_constants(arg)
+
+    # -- collective calls with string-literal axis arguments -----------
+
+    @staticmethod
+    def _collective_axes(fn: ast.FunctionDef, aliases: Dict[str, str]):
+        for stmt in fn.body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = resolve(node.func, aliases)
+                if dotted is None or dotted.split(".", 1)[0] != "jax":
+                    continue
+                coll = dotted.rsplit(".", 1)[-1]
+                pos = _GL010_COLLECTIVES.get(coll)
+                if pos is None:
+                    continue
+                axis_expr = None
+                for kw in node.keywords:
+                    if kw.arg == "axis_name":
+                        axis_expr = kw.value
+                if axis_expr is None and pos < len(node.args):
+                    axis_expr = node.args[pos]
+                if axis_expr is None:
+                    continue
+                for name, lit in _str_constants(axis_expr):
+                    yield coll, name, lit
+
+
 _ALL: List[Rule] = [GL001TracerLeak(), GL002HostSyncUnderJit(),
                     GL003RetraceHazard(), GL004SpillHandleLeak(),
                     GL005ConfigDrift(), GL006FaultKindDrift(),
                     GL007DonatedBufferReuse(), GL008JittedIOHandle(),
-                    GL009LateMaterializationBreach()]
+                    GL009LateMaterializationBreach(),
+                    GL010ShardingConstraintDrift()]
 
 
 def all_rules(only: Optional[Sequence[str]] = None) -> List[Rule]:
