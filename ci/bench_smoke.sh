@@ -9,11 +9,18 @@ BENCH_FORCE_CPU=1 BENCH_N_ROWS=65536 BENCH_REPS=2 python bench.py \
 # decisions (cache must be a hit — zero retraces on repeated shapes)
 BENCH_FORCE_CPU=1 BENCH_PLAN_ROWS=65536 BENCH_REPS=2 python bench.py --plan \
   | tee /tmp/bench_smoke_plan.out
+# streaming scan scenario: morsel-driven scan→shuffle on an over-arena
+# Parquet input; the note must show >=2 rounds draining while later
+# morsels still decode (scan_main fails the run otherwise)
+BENCH_FORCE_CPU=1 BENCH_SCAN_ROWS=32768 python bench.py --scan \
+  | tee /tmp/bench_smoke_scan.out
 # the q95 lines must be self-explaining (per-stage note + engines; cache +
 # decisions on the IR rows) and their vs_baseline must not regress below
 # the recorded floors — ratchets in the same only-shrinks spirit as
-# graftlint's baseline (ci/q95_floor.json); a missing q9 IR row fails too
-python ci/check_q95_line.py /tmp/bench_smoke_q6.out /tmp/bench_smoke_plan.out
+# graftlint's baseline (ci/q95_floor.json); a missing q9 IR row or
+# streaming-scan row fails too
+python ci/check_q95_line.py /tmp/bench_smoke_q6.out \
+  /tmp/bench_smoke_plan.out /tmp/bench_smoke_scan.out
 # spill scenario: device arena capped below q6's working set; the emitted
 # line carries spill-bytes counters so BENCH_*.json tracks spill overhead
 BENCH_FORCE_CPU=1 BENCH_SPILL_ROWS=65536 python bench.py --spill

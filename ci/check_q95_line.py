@@ -27,6 +27,14 @@ the gate accepts multiple paths and scans them all):
 * ``q9_ir_throughput`` must exist with recorded adaptive decisions —
   q9 is the proof that new queries are data, so it silently falling
   out of the smoke would un-prove it.
+
+The streaming-scan row (``bench.py --scan``, its own capture file)
+rides ``scan_vs_baseline_floor``: ``scan_stream_throughput`` must exist
+(a missing line fails, matching the encoded/IR precedent), its note
+must carry the overlap evidence (``rounds_overlapped >= 2``,
+decode/drain ms), and its ``vs_baseline`` — streaming over the
+materialized decode-then-exchange baseline — must not shrink below the
+recorded floor.
 """
 import json
 import os
@@ -58,11 +66,13 @@ def main(paths) -> int:
     floor = floors["vs_baseline_floor"]
     enc_floor = floors["encoded_vs_baseline_floor"]
     ir_floor = floors["ir_vs_baseline_floor"]
+    scan_floor = floors["scan_vs_baseline_floor"]
     lines = _scan(paths)
     line = lines.get("q95_shape_throughput")
     enc_line = lines.get("q95_shape_encoded_throughput")
     ir_line = lines.get("q95_ir_throughput")
     q9_line = lines.get("q9_ir_throughput")
+    scan_line = lines.get("scan_stream_throughput")
     if line is None:
         print("check_q95_line: no q95_shape_throughput line in",
               " ".join(paths))
@@ -119,6 +129,27 @@ def main(paths) -> int:
     elif not isinstance((q9_line.get("note") or {}).get("decisions"), dict):
         errs.append("q9 line's note.decisions missing: the adaptive "
                     "broadcast decisions are no longer recorded")
+    scan_vs = None
+    if scan_line is None:
+        errs.append("no scan_stream_throughput line: the streaming scan "
+                    "row fell out of the smoke (bench.py scan_main)")
+    else:
+        scan_note = scan_line.get("note")
+        if (not isinstance(scan_note, dict)
+                or "decode_ms" not in scan_note
+                or "drain_ms" not in scan_note):
+            errs.append("scan line's note decode_ms/drain_ms missing: "
+                        "the capture no longer documents the overlap "
+                        f"(note={json.dumps(scan_note)})")
+        elif int(scan_note.get("rounds_overlapped", 0)) < 2:
+            errs.append("scan line's note.rounds_overlapped < 2: decode "
+                        "no longer overlaps at least two round drains "
+                        f"(note={json.dumps(scan_note)})")
+        scan_vs = scan_line.get("vs_baseline", 0.0)
+        if scan_vs < scan_floor:
+            errs.append(f"scan vs_baseline {scan_vs} regressed below "
+                        f"the recorded floor {scan_floor} "
+                        f"(ci/q95_floor.json)")
     if errs:
         for e in errs:
             print("check_q95_line:", e)
@@ -126,6 +157,7 @@ def main(paths) -> int:
     print(f"check_q95_line: OK (vs_baseline {vs} >= floor {floor}; "
           f"encoded {enc_vs} >= floor {enc_floor}; "
           f"IR {ir_vs} >= floor {ir_floor}; q9 row present; "
+          f"scan {scan_vs} >= floor {scan_floor}; "
           f"engines {json.dumps((note or {}).get('engines'))})")
     if vs >= 2 * floor and floor > 0:
         print(f"check_q95_line: note — vs_baseline is >=2x the floor; "

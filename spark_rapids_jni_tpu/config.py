@@ -111,6 +111,28 @@ _register("shuffle_max_recoveries", 8, int,
           "(ShuffleMetrics.recovered_partitions); exceeding it raises "
           "ShuffleError so a flapping disk cannot loop a shuffle "
           "forever.")
+_register("scan_morsel_rows", 4096, int,
+          "Per-device rows in one scan morsel (shuffle/morsel.py): the "
+          "streaming scan→shuffle pipeline decodes, maps and routes one "
+          "morsel at a time so earlier exchange rounds drain while later "
+          "morsels are still decoding.  Smaller = finer overlap and a "
+          "lower device-resident peak; bigger = fewer map dispatches.")
+_register("shuffle_stream", False, _parse_bool,
+          "Lower Exchange(Scan) plans bound to a MorselSource through "
+          "ShuffleService.exchange_stream (plan/compile.py) instead of "
+          "materializing the whole scan before round 1 drains.  The "
+          "streaming path is bit-identical on delivered rows; off = "
+          "always materialize.")
+_register("shuffle_capacity_dcn", 0, int,
+          "Override for the per-(sender, destination-host) slot capacity "
+          "of hop one (DCN) in hierarchical exchanges "
+          "(shuffle/planner.py plan_hierarchical); 0 = plan it from the "
+          "observed count matrix instead of the flat worst-case grid.")
+_register("shuffle_capacity_ici", 0, int,
+          "Override for the per-(sender, destination-chip) slot capacity "
+          "of hop two (ICI) in hierarchical exchanges "
+          "(shuffle/planner.py plan_hierarchical); 0 = plan it from the "
+          "observed count matrix.")
 _register("chaos_trials", 4, int,
           "Seeded multi-fault trials per scenario in the chaos campaign "
           "(tools/chaos.py) on top of the exhaustive one-fault-per-trial "

@@ -99,3 +99,35 @@ def read_parquet(
     else:
         table = f.read_row_groups(keep, columns=names)
     return from_arrow(table)
+
+
+def row_group_readers(
+    path: str,
+    columns: Optional[Sequence[str]] = None,
+    part_offset: int = 0,
+    part_length: int = 1 << 62,
+    ignore_case: bool = False,
+) -> list:
+    """Replayable per-row-group readers for the streaming scan.
+
+    Returns ``[(read, rows), ...]`` — one entry per split-surviving row
+    group, in file order.  ``read()`` decodes JUST that row group into a
+    ColumnBatch and may be called again at any time with a bit-identical
+    result: it is the streaming pipeline's lineage hook (a lost or
+    corrupt morsel-derived buffer re-decodes from source instead of
+    keeping a second copy resident).  ``rows`` comes from the footer, so
+    the morsel schedule is planned without touching any data pages.
+    """
+    f = pq.ParquetFile(path)
+    keep = select_row_groups(f.metadata, part_offset, part_length)
+    names = _match_columns(f.schema_arrow.names, columns, ignore_case)
+
+    def make(i):
+        def read() -> ColumnBatch:
+            # a fresh ParquetFile per call: replay must not depend on a
+            # shared reader's stream position or lifetime
+            g = pq.ParquetFile(path)
+            return from_arrow(g.read_row_groups([i], columns=names))
+        return read
+
+    return [(make(i), f.metadata.row_group(i).num_rows) for i in keep]

@@ -457,6 +457,7 @@ def distributed_sort(
     mesh: Mesh,
     axis_name: str = "data",
     capacity: Optional[int] = None,
+    ctx=None,
 ):
     """Global sort: range-partition by sampled splitters, then sort locally.
 
@@ -464,16 +465,28 @@ def distributed_sort(
     d-th global key range in sorted order (with slot padding interleaved).
     Splitters are sampled on the host from the first key column's radix
     words, the classic sample-sort plan pass.
+
+    With ``capacity`` unset the range exchange routes through the
+    lossless multi-round :class:`~spark_rapids_jni_tpu.shuffle.ShuffleService`
+    (spillable buffers, skew-aware rounds, exact accounting — ``dropped``
+    is zero by construction, and ``ctx`` charges the round buffers to the
+    task's arena); pass an explicit ``capacity`` to force the legacy
+    single-round fused exchange.
     """
     P = mesh.shape[axis_name]
     splitters = _sample_splitters(batch, key_names, P)
 
     if capacity is None:
-        # plan: count destinations per device
-        plan = _sort_plan_step(mesh, axis_name, tuple(key_names),
-                               splitters.shape)
-        cmax = int(np.asarray(jax.device_get(plan(batch, splitters)))[0])
-        capacity = max(256, -(-cmax // 256) * 256)
+        from ..shuffle import ShuffleService
+
+        # _range_pid is elementwise over rows against the replicated
+        # splitters, so it runs straight on the row-sharded globals
+        pid = _range_pid(batch, key_names, splitters, P)
+        res = ShuffleService(mesh, axis_name).exchange(
+            batch, pid=pid, ctx=ctx)
+        local = _local_sort_step(mesh, axis_name, tuple(key_names))
+        out, occ_sorted = local(res.batch, res.occupancy)
+        return out, occ_sorted, jnp.zeros((P,), jnp.int32)
     step = _sort_step(mesh, axis_name, tuple(key_names), splitters.shape,
                       capacity)
     return step(batch, splitters)
@@ -498,17 +511,17 @@ def _range_pid(b, key_names, splitters, P):
 
 
 @lru_cache(maxsize=None)
-def _sort_plan_step(mesh, axis_name, key_names, splitter_shape):
-    P = mesh.shape[axis_name]
+def _local_sort_step(mesh, axis_name, key_names):
+    """Reduce-side local sort over service-exchanged rows (dead shuffle
+    slots sort last via the shared occupancy epilogue)."""
     spec = PartitionSpec(axis_name)
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=(spec, PartitionSpec()),
-             out_specs=spec, check_vma=False)
-    def plan(b, splitters):
-        pid = _range_pid(b, key_names, splitters, P)
-        return plan_capacity(pid, axis_name, P)[None]
+    @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec),
+             out_specs=(spec, spec), check_vma=False)
+    def step(shuffled: ColumnBatch, occ):
+        return _local_sort_with_occ(shuffled, occ, key_names)
 
-    return jax.jit(plan)
+    return jax.jit(step)
 
 
 @lru_cache(maxsize=None)
@@ -545,6 +558,39 @@ def hierarchical_mesh(n_hosts: int, chips_per_host: int,
                 (dcn_axis, ici_axis))
 
 
+def _hier_count_matrix(pid, P: int):
+    """Host-side ``[P senders, P destinations]`` count matrix from a
+    row-sharded pid array (rows are sender-major over the flattened
+    mesh, so the sender index is just the row block)."""
+    a = np.asarray(jax.device_get(pid)).reshape(P, -1)
+    counts = np.zeros((P, P), np.int64)
+    for s in range(P):
+        row = a[s]
+        counts[s] = np.bincount(row[(row >= 0) & (row < P)],
+                                minlength=P)[:P]
+    return counts
+
+
+def _plan_2d_capacities(pid, H: int, D: int, capacity_dcn, capacity_ici):
+    """Resolve per-hop capacities: keep explicit values, plan the rest
+    from the observed count matrix (plan_hierarchical — per-hop buckets
+    instead of the flat ``rows_per_device`` / ``H * C_dcn`` worst case)."""
+    from ..shuffle import plan_hierarchical
+
+    if capacity_dcn is not None and capacity_ici is not None:
+        return capacity_dcn, capacity_ici
+    hplan = plan_hierarchical(_hier_count_matrix(pid, H * D), H, D)
+    if capacity_dcn is None:
+        capacity_dcn = hplan.capacity_dcn
+        if capacity_ici is None:
+            capacity_ici = hplan.capacity_ici
+    if capacity_ici is None:
+        # explicit hop-one override without a hop-two one keeps the
+        # legacy always-lossless coupling
+        capacity_ici = H * capacity_dcn
+    return capacity_dcn, capacity_ici
+
+
 def distributed_group_by_2d(
     batch: ColumnBatch,
     key_names: Sequence[str],
@@ -558,18 +604,19 @@ def distributed_group_by_2d(
     """Group-by over a multi-host mesh via the two-hop hierarchical shuffle
     (rows cross DCN once, ICI once; see shuffle.exchange_hierarchical).
 
-    Capacities default to the always-lossless bounds: every sender holds R
-    rows so a host bucket holds <= R; after hop one a device holds up to
-    ``n_hosts * C_dcn`` live rows, all of which may share one chip.  Pass
-    planned capacities to shrink the grids when the key distribution is
-    known (plan_capacity per hop).
+    Unset capacities are PLANNED: one elementwise pid pass feeds
+    :func:`~spark_rapids_jni_tpu.shuffle.plan_hierarchical`, which sizes
+    each hop's slot grid to its observed max bucket (bucket-rounded,
+    overridable via ``shuffle_capacity_dcn`` / ``shuffle_capacity_ici``)
+    instead of the flat worst case — multi-host meshes stop paying
+    ``rows_per_device`` DCN slots and ``n_hosts * C_dcn`` ICI slots for
+    uniformly hashed keys.  Pass explicit capacities to pin the grids.
     """
     H, D = mesh.shape[dcn_axis], mesh.shape[ici_axis]
-    R = batch.num_rows // (H * D)
-    if capacity_dcn is None:
-        capacity_dcn = R
-    if capacity_ici is None:
-        capacity_ici = H * capacity_dcn
+    if capacity_dcn is None or capacity_ici is None:
+        pid = spark_partition_id([batch[k] for k in key_names], H * D)
+        capacity_dcn, capacity_ici = _plan_2d_capacities(
+            pid, H, D, capacity_dcn, capacity_ici)
     step = _group_by_2d_step(mesh, dcn_axis, ici_axis, tuple(key_names),
                              tuple(aggs), capacity_dcn, capacity_ici)
     return step(batch)
@@ -613,19 +660,30 @@ def distributed_hash_join_2d(
 ):
     """Hash join over a multi-host mesh via the two-hop shuffle (both
     sides routed by the same Spark-exact partition ids, so matching keys
-    still meet on one chip).  Lossless default capacities as in
-    :func:`distributed_group_by_2d`."""
+    still meet on one chip).  With ``capacity_dcn`` unset both sides'
+    count matrices feed the hierarchical planner and each hop's grid is
+    sized to the larger side's observed bucket (see
+    :func:`distributed_group_by_2d`)."""
     H, D = mesh.shape[dcn_axis], mesh.shape[ici_axis]
+    P = H * D
     if capacity_dcn is None:
-        capacity_dcn = max(left.num_rows, right.num_rows) // (H * D)
+        lpid = spark_partition_id([left[k] for k in left_on], P)
+        rpid = spark_partition_id([right[k] for k in right_on], P)
+        lc_dcn, lc_ici = _plan_2d_capacities(lpid, H, D, None, None)
+        rc_dcn, rc_ici = _plan_2d_capacities(rpid, H, D, None, None)
+        capacity_dcn = max(lc_dcn, rc_dcn)
+        capacity_ici = max(lc_ici, rc_ici)
+    else:
+        capacity_ici = H * capacity_dcn
     step = _join_2d_step(mesh, dcn_axis, ici_axis, tuple(left_on),
-                         tuple(right_on), how, capacity_dcn, out_capacity)
+                         tuple(right_on), how, capacity_dcn, capacity_ici,
+                         out_capacity)
     return step(left, right)
 
 
 @lru_cache(maxsize=None)
 def _join_2d_step(mesh, dcn_axis, ici_axis, left_on, right_on, how,
-                  capacity_dcn, out_capacity):
+                  capacity_dcn, capacity_ici, out_capacity):
     from ..relational.join import hash_join
     from .shuffle import exchange_hierarchical
 
@@ -644,10 +702,10 @@ def _join_2d_step(mesh, dcn_axis, ici_axis, left_on, right_on, how,
         rpid = spark_partition_id([rb[k] for k in right_on], P, rv)
         ls, locc, ldrop = exchange_hierarchical(
             lb, lpid, dcn_axis, ici_axis, H, D, capacity_dcn,
-            H * capacity_dcn)
+            capacity_ici)
         rs, rocc, rdrop = exchange_hierarchical(
             rb, rpid, dcn_axis, ici_axis, H, D, capacity_dcn,
-            H * capacity_dcn)
+            capacity_ici)
         out, count = hash_join(ls, rs, list(left_on), list(right_on), how,
                                capacity=out_capacity,
                                left_valid=locc, right_valid=rocc)
@@ -667,21 +725,31 @@ def distributed_sort_2d(
     """Global sample-sort over a multi-host mesh: same splitter plan as
     :func:`distributed_sort` with P = hosts * chips range partitions,
     routed through the two-hop exchange.  Device (h, d) holds global
-    range ``h * chips + d`` in sorted order."""
+    range ``h * chips + d`` in sorted order.  With ``capacity_dcn``
+    unset the range pids feed the hierarchical planner so each hop's
+    grid tracks its observed bucket (a well-split sort is near-uniform,
+    so this beats the flat ``rows // P`` worst case on multi-host
+    meshes)."""
     H, D = mesh.shape[dcn_axis], mesh.shape[ici_axis]
     P = H * D
     splitters = _sample_splitters(batch, key_names, P)
 
     if capacity_dcn is None:
-        capacity_dcn = batch.num_rows // P
+        # elementwise over rows against replicated splitters: runs
+        # straight on the row-sharded globals, same as distributed_sort
+        pid = _range_pid(batch, key_names, splitters, P)
+        capacity_dcn, capacity_ici = _plan_2d_capacities(
+            pid, H, D, None, None)
+    else:
+        capacity_ici = H * capacity_dcn
     step = _sort_2d_step(mesh, dcn_axis, ici_axis, tuple(key_names),
-                         splitters.shape, capacity_dcn)
+                         splitters.shape, capacity_dcn, capacity_ici)
     return step(batch, splitters)
 
 
 @lru_cache(maxsize=None)
 def _sort_2d_step(mesh, dcn_axis, ici_axis, key_names, splitter_shape,
-                  capacity_dcn):
+                  capacity_dcn, capacity_ici):
     from .shuffle import exchange_hierarchical
 
     H, D = mesh.shape[dcn_axis], mesh.shape[ici_axis]
@@ -694,7 +762,7 @@ def _sort_2d_step(mesh, dcn_axis, ici_axis, key_names, splitter_shape,
         pid = _range_pid(b, key_names, splitters, P)
         shuffled, occ, dropped = exchange_hierarchical(
             b, pid, dcn_axis, ici_axis, H, D, capacity_dcn,
-            H * capacity_dcn)
+            capacity_ici)
         out, occ_sorted = _local_sort_with_occ(shuffled, occ, key_names)
         return out, occ_sorted, dropped[None]
 

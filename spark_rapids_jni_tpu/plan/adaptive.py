@@ -110,7 +110,8 @@ def choose_exchange_capacity(counts=None, metrics: Optional[dict] = None,
         rows = int(metrics.get("rows_moved", 0))
         if shuffles > 0 and rows > 0:
             mean = rows // (shuffles * max(partitions, 1))
-            peak = max(float(metrics.get("max_skew", 1.0)), 1.0)
+            peak = max(float(metrics.get(
+                "max_skew", metrics.get("max_skew_ratio", 1.0))), 1.0)
             est = max(int(mean * peak), 1)
             return plan_rounds([est] * max(partitions, 1))
     return None

@@ -395,13 +395,33 @@ def _inputs_encoded(inputs: dict) -> bool:
     return any(is_encoded(c) for b in inputs.values() for c in b.columns)
 
 
+def _default_stats() -> Optional[dict]:
+    """Live stats the system already recorded: the process-wide
+    :class:`~spark_rapids_jni_tpu.shuffle.registry.ShuffleMetrics`
+    snapshot, when any shuffle has actually run.  An empty registry
+    returns ``None`` so first-query planning is byte-identical to the
+    explicit ``stats=None`` behavior (and the plan-cache key does not
+    pick up a noise dict)."""
+    from ..shuffle import get_registry
+
+    snap = get_registry().metrics.snapshot()
+    if snap.get("shuffles"):
+        return {"shuffle": snap}
+    return None
+
+
 def compile_plan(plan: ir.PlanNode, inputs: dict, ctx=None,
                  stats: Optional[dict] = None) -> CompiledPlan:
     """Compile ``plan`` against the schemas/stats of ``inputs`` (a dict
     binding every Scan name to a ``ColumnBatch``), consulting the plan
     cache first.  ``ctx`` (TaskContext) owns any broadcast build tables
     the adaptive layer decides to create; ``stats`` feeds the adaptive
-    decisions (see :func:`adaptive.plan_decisions`)."""
+    decisions (see :func:`adaptive.plan_decisions`) and defaults to the
+    ShuffleRegistry's recorded metrics — Spark's AQE loop: earlier
+    exchanges' observed skew/rows inform later plans with no caller
+    plumbing."""
+    if stats is None:
+        stats = _default_stats()
     decisions = adaptive.plan_decisions(plan, inputs, stats)
     key = plan_cache_key(plan, inputs, decisions)
     cache = get_plan_cache()
@@ -430,10 +450,49 @@ def compile_plan(plan: ir.PlanNode, inputs: dict, ctx=None,
     return compiled
 
 
+def _maybe_execute_streaming(plan: ir.PlanNode, inputs: dict, ctx=None):
+    """The streaming lowering: a root ``Exchange(Scan)`` whose input
+    binds a :class:`~spark_rapids_jni_tpu.shuffle.MorselSource` under
+    the ``shuffle_stream`` knob runs the morsel-driven out-of-core
+    :meth:`~spark_rapids_jni_tpu.shuffle.ShuffleService.exchange_stream`
+    — decode overlaps round drains, round chunks spill host→disk —
+    instead of materializing the scan for the jitted local exchange.
+    Returns ``(batch, occupancy)`` (the "batch plus live mask" root
+    contract) or ``None`` when the pattern does not apply."""
+    from ..shuffle import ShuffleService
+    from ..shuffle.morsel import MorselSource
+
+    if not config.get("shuffle_stream"):
+        return None
+    if not (isinstance(plan, ir.Exchange)
+            and isinstance(plan.child, ir.Scan)):
+        return None
+    src = inputs.get(plan.child.name)
+    if not isinstance(src, MorselSource):
+        return None
+    if src.mesh is None:
+        raise ValueError(
+            "streaming lowering needs a MorselSource built against a "
+            "mesh (use MorselSource.from_batch/from_parquet)")
+    P = src.mesh.shape[src.axis_name]
+    if plan.partitions != P:
+        raise ValueError(
+            f"Exchange(partitions={plan.partitions}) cannot stream over "
+            f"a {P}-device mesh: the service partitions across devices")
+    res = ShuffleService(src.mesh, src.axis_name).exchange_stream(
+        src, key_names=[plan.key], ctx=ctx)
+    return res.batch, res.occupancy
+
+
 def execute(plan: ir.PlanNode, inputs: dict, ctx=None,
             stats: Optional[dict] = None):
     """Compile (or fetch) and run ``plan`` over ``inputs``.  Aggregate
     roots return ``(result, num_groups)`` — the hand-fused steps'
     contract; other roots return the batch (plus a live mask when one
-    is in flight)."""
+    is in flight).  With the ``shuffle_stream`` knob on, a root
+    ``Exchange(Scan)`` bound to a ``MorselSource`` takes the streaming
+    out-of-core path instead (see :func:`_maybe_execute_streaming`)."""
+    streamed = _maybe_execute_streaming(plan, inputs, ctx=ctx)
+    if streamed is not None:
+        return streamed
     return compile_plan(plan, inputs, ctx=ctx, stats=stats)(inputs)

@@ -41,8 +41,15 @@ knob and are routed to the null partition (and counted) otherwise;
 ``shuffle_max_rounds`` caps the round count by raising capacity.
 """
 
-from .buffers import PartitionBuffer
-from .planner import RoundPlan, plan_rounds
+from .buffers import MorselBuffer, PartitionBuffer, RoundChunk
+from .morsel import MorselSource
+from .planner import (
+    HierarchicalPlan,
+    RoundPlan,
+    plan_hierarchical,
+    plan_rounds,
+    plan_stream_capacity,
+)
 from .registry import (
     ShuffleInfo,
     ShuffleMetrics,
@@ -52,9 +59,15 @@ from .registry import (
 from .service import ShuffleError, ShuffleResult, ShuffleService
 
 __all__ = [
+    "MorselBuffer",
+    "MorselSource",
     "PartitionBuffer",
+    "RoundChunk",
+    "HierarchicalPlan",
     "RoundPlan",
+    "plan_hierarchical",
     "plan_rounds",
+    "plan_stream_capacity",
     "ShuffleInfo",
     "ShuffleMetrics",
     "ShuffleRegistry",

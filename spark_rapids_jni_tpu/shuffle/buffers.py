@@ -69,3 +69,74 @@ class PartitionBuffer:
 
     def close(self):
         self._handle.close()
+
+
+class MorselBuffer(PartitionBuffer):
+    """One mapped morsel in flight: the per-morsel regrouped rows plus
+    the morsel's ``[P, P]`` count matrix, alive only between the map
+    step and the scatter into its round chunks.
+
+    Same spillable contract as :class:`PartitionBuffer` (it IS one), but
+    a distinct type so graftlint's GL004 handle-leak rule can hold the
+    streaming path to the same close-or-escape discipline as the
+    materialized buffers — an unclosed morsel pins a morsel's worth of
+    arena for the rest of the stream.  ``recompute=`` is the morsel's
+    replay lineage: re-decode the source morsel and re-run its map
+    shards.
+    """
+
+
+class RoundChunk:
+    """The send-side state of ONE streaming round: ``P * capacity``
+    destination-major slot rows plus their occupancy mask, accumulated
+    scatter-by-scatter as morsel counts arrive.
+
+    The service plans and charges this round before it is fully
+    received: each :meth:`update` replaces the spillable tree under a
+    fresh creation charge (retry-laddered, so arena pressure demotes
+    OTHER rounds rather than failing), and carries the chunk's lineage —
+    a re-scatter of every morsel contribution recorded so far — so a
+    half-received round whose spilled copy is lost or corrupt rebuilds
+    exactly, not approximately.  The chunk stays open after its drain to
+    back the received chunk's re-drive lineage; :meth:`close` releases
+    the final handle.
+    """
+
+    def __init__(self, tree, ctx=None, name: Optional[str] = None,
+                 recompute=None):
+        self._ctx = ctx
+        self._name = name
+        self._buf = PartitionBuffer(tree, ctx=ctx, name=name,
+                                    recompute=recompute)
+
+    @property
+    def nbytes(self) -> int:
+        return self._buf.nbytes
+
+    @property
+    def tier(self) -> str:
+        return self._buf.tier
+
+    @property
+    def lineage_rebuilds(self) -> int:
+        return self._buf.lineage_rebuilds
+
+    def get(self):
+        return self._buf.get()
+
+    def update(self, tree, recompute=None):
+        """Swap in the post-scatter tree (close the stale handle first so
+        the arena never holds both generations of the round)."""
+        old = self._buf
+        self._buf = None
+        old.close()
+        self._buf = PartitionBuffer(tree, ctx=self._ctx, name=self._name,
+                                    recompute=recompute)
+
+    def spill(self) -> int:
+        return self._buf.spill()
+
+    def close(self):
+        if self._buf is not None:
+            self._buf.close()
+            self._buf = None

@@ -1,0 +1,197 @@
+"""Morsel scheduling: replayable fixed-shape slices feeding the
+streaming exchange.
+
+A *morsel* is the streaming pipeline's unit of work: a fixed
+``scan_morsel_rows``-per-device slice of the scan, decoded → mapped →
+scattered into round chunks while earlier rounds drain
+(:meth:`ShuffleService.exchange_stream`).  Fixed shape is the whole
+point — every morsel reuses the SAME compiled map/scatter programs, so
+a thousand-morsel stream traces exactly once per program.
+
+Each morsel is delivered as a zero-arg *replay* callable returning
+``(batch, row_valid)``: calling it again must reproduce the morsel
+bit-identically.  That replay IS the streaming lineage — a corrupt
+half-received round chunk rebuilds by re-mapping its contributing
+morsels from source (a Parquet row group re-read, a shard re-slice),
+never from a second copy held in RAM.
+
+Two sources:
+
+* :meth:`MorselSource.from_batch` — slice an already row-sharded batch
+  per DEVICE SHARD (a global row range would interleave senders and
+  break bit-identity with the materialized path); the pad and slice
+  steps are compiled shard_maps with a TRACED morsel index, so the
+  morsel count never shows up in a trace key.
+* :meth:`MorselSource.from_parquet` — one replayable reader per Parquet
+  row-group slice (:func:`~spark_rapids_jni_tpu.io.parquet.row_group_readers`),
+  padded host-side to the fixed shape and row-sharded; decode of morsel
+  ``k+1`` overlaps the drain of rounds fed by morsels ``<= k``.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import lru_cache, partial
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..columnar.column import ColumnBatch
+
+
+def _pad_rows(x, pad: int):
+    if pad == 0:
+        return x
+    return jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1))
+
+
+@lru_cache(maxsize=None)
+def _pad_step(mesh, axis_name, target_rows):
+    """Pad each device shard to ``target_rows`` (padding rows invalid)."""
+    spec = PartitionSpec(axis_name)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec),
+             out_specs=(spec, spec), check_vma=False)
+    def step(b: ColumnBatch, rv):
+        pad = target_rows - b.num_rows
+        padded = jax.tree_util.tree_map(lambda x: _pad_rows(x, pad), b)
+        return padded, _pad_rows(rv, pad)
+
+    return jax.jit(step)
+
+
+@lru_cache(maxsize=None)
+def _slice_step(mesh, axis_name, morsel_rows):
+    """Morsel ``j``: rows ``[j*M, (j+1)*M)`` of EVERY device shard.  The
+    morsel index is a traced replicated scalar, so one compiled program
+    serves the whole stream."""
+    spec = PartitionSpec(axis_name)
+
+    @partial(jax.shard_map, mesh=mesh,
+             in_specs=(spec, spec, PartitionSpec()),
+             out_specs=(spec, spec), check_vma=False)
+    def step(b: ColumnBatch, rv, j):
+        start = j * morsel_rows
+        sl = jax.tree_util.tree_map(
+            lambda x: jax.lax.dynamic_slice_in_dim(x, start, morsel_rows, 0),
+            b)
+        return sl, jax.lax.dynamic_slice_in_dim(rv, start, morsel_rows, 0)
+
+    return jax.jit(step)
+
+
+class MorselSource:
+    """An ordered sequence of replayable morsels with one fixed shape.
+
+    Iterating yields the replay callables themselves (what
+    ``exchange_stream`` consumes); ``len`` is the morsel count.  The
+    per-device ``morsel_rows`` and total source ``rows`` are exposed for
+    planners and the bench harness.
+    """
+
+    def __init__(self, replays: List[Callable], morsel_rows: int,
+                 rows: int, mesh=None, axis_name: str = "data"):
+        self._replays = list(replays)
+        self.morsel_rows = int(morsel_rows)
+        self.rows = int(rows)
+        # the mesh the morsels are sharded over — what lets the plan
+        # compiler build the ShuffleService without a side channel
+        self.mesh = mesh
+        self.axis_name = axis_name
+
+    def __iter__(self):
+        return iter(self._replays)
+
+    def __len__(self) -> int:
+        return len(self._replays)
+
+    # -- constructors ---------------------------------------------------
+    @classmethod
+    def from_batch(cls, batch: ColumnBatch, mesh, axis_name: str = "data",
+                   morsel_rows: Optional[int] = None,
+                   row_valid=None) -> "MorselSource":
+        """Slice a row-sharded batch into per-shard morsels.
+
+        Each device shard is padded (invalid rows) to a whole number of
+        morsels and sliced in place; concatenating the valid rows of
+        every morsel reproduces each shard in row order, which is what
+        makes the streamed exchange bit-identical to
+        ``exchange(batch, ...)`` on the same batch.
+        """
+        from .. import config
+
+        if morsel_rows is None:
+            morsel_rows = int(config.get("scan_morsel_rows"))
+        if morsel_rows <= 0:
+            raise ValueError("morsel_rows must be positive")
+        P = mesh.shape[axis_name]
+        if batch.num_rows % P:
+            raise ValueError(
+                f"batch rows {batch.num_rows} not divisible by mesh "
+                f"size {P}")
+        per_dev = batch.num_rows // P
+        k = max(1, math.ceil(per_dev / morsel_rows))
+        if row_valid is None:
+            row_valid = jax.device_put(
+                jnp.ones((batch.num_rows,), jnp.bool_),
+                NamedSharding(mesh, PartitionSpec(axis_name)))
+        padded, valid = _pad_step(mesh, axis_name, k * morsel_rows)(
+            batch, row_valid)
+        sl = _slice_step(mesh, axis_name, morsel_rows)
+
+        def make(j):
+            return lambda: sl(padded, valid, jnp.int32(j))
+
+        return cls([make(j) for j in range(k)], morsel_rows,
+                   batch.num_rows, mesh=mesh, axis_name=axis_name)
+
+    @classmethod
+    def from_parquet(cls, path, mesh, axis_name: str = "data",
+                     columns: Optional[Sequence[str]] = None,
+                     morsel_rows: Optional[int] = None,
+                     ignore_case: bool = False) -> "MorselSource":
+        """One morsel per ``P * morsel_rows``-row slice of each Parquet
+        row group: the replay re-reads its row group from the file (the
+        natural lineage — a damaged buffer costs one decode, not a
+        cached copy), pads to the fixed shape and row-shards it."""
+        from .. import config
+        from ..io.parquet import row_group_readers
+
+        if morsel_rows is None:
+            morsel_rows = int(config.get("scan_morsel_rows"))
+        if morsel_rows <= 0:
+            raise ValueError("morsel_rows must be positive")
+        P = mesh.shape[axis_name]
+        gm = P * morsel_rows
+        readers = row_group_readers(path, columns=columns,
+                                    ignore_case=ignore_case)
+        sharding = NamedSharding(mesh, PartitionSpec(axis_name))
+
+        def make(read, lo, n):
+            def replay():
+                rg = read()
+                cols = {}
+                for name, col in zip(rg.names, rg.columns):
+                    if hasattr(col, "decode"):
+                        col = col.decode()
+                    cols[name] = jax.tree_util.tree_map(
+                        lambda x: jax.device_put(
+                            _pad_rows(x[lo:lo + n], gm - n), sharding),
+                        col)
+                rv = jax.device_put(
+                    _pad_rows(jnp.ones((n,), jnp.bool_), gm - n), sharding)
+                return ColumnBatch(cols), rv
+            return replay
+
+        replays = []
+        total = 0
+        for read, rg_rows in readers:
+            total += rg_rows
+            for lo in range(0, max(rg_rows, 1), gm):
+                n = min(gm, rg_rows - lo) if rg_rows else 0
+                replays.append(make(read, lo, max(n, 0)))
+        return cls(replays, morsel_rows, total, mesh=mesh,
+                   axis_name=axis_name)

@@ -86,3 +86,102 @@ def plan_rounds(
         cap = _round_up(-(-cmax // max_rounds), bucket)
         rounds = -(-cmax // cap)
     return RoundPlan(rounds, cap, cmax, total, skew)
+
+
+def plan_stream_capacity(round_rows: Optional[int] = None,
+                         bucket: Optional[int] = None) -> int:
+    """Slot capacity for ONE streaming round chunk.
+
+    The streaming path must fix its capacity before any counts exist (the
+    scatter/drain programs compile against it and are reused for every
+    round), so it is always the bucket-rounded ``round_rows`` budget —
+    the same shape the materialized planner picks whenever an exchange
+    actually goes multi-round, which is what keeps the two paths
+    bit-identical on delivered rows.
+    """
+    from .. import config
+
+    if round_rows is None:
+        round_rows = int(config.get("shuffle_round_rows"))
+    if bucket is None:
+        bucket = int(config.get("shuffle_capacity_bucket"))
+    if round_rows <= 0 or bucket <= 0:
+        raise ValueError("round_rows and bucket must be positive")
+    return _round_up(round_rows, bucket)
+
+
+@dataclass(frozen=True)
+class HierarchicalPlan:
+    """Per-hop capacities for one DCN×ICI two-hop exchange."""
+
+    capacity_dcn: int    # slot rows per (sender device, destination host)
+    capacity_ici: int    # slot rows per (sender device, destination chip)
+    max_bucket_dcn: int  # largest hop-one bucket observed
+    max_bucket_ici: int  # largest hop-two bucket observed
+    total_rows: int
+    skew_dcn: float      # max hop-one bucket / mean nonzero-grid bucket
+    skew_ici: float
+
+    @property
+    def lossless(self) -> bool:
+        return (self.capacity_dcn >= self.max_bucket_dcn
+                and self.capacity_ici >= self.max_bucket_ici)
+
+
+def plan_hierarchical(
+    counts,
+    n_hosts: int,
+    n_chips: int,
+    bucket: Optional[int] = None,
+) -> HierarchicalPlan:
+    """Turn a ``[P, P]`` (sender device, destination partition) count
+    matrix into per-hop capacities for
+    :func:`~spark_rapids_jni_tpu.parallel.shuffle.exchange_hierarchical`
+    (``P = n_hosts * n_chips``, destination partition ``p`` living on
+    host ``p // n_chips``, chip ``p % n_chips``).
+
+    * **hop one (DCN)** moves sender ``(h, d)``'s rows to host
+      ``p // n_chips`` without changing the chip index, so its bucket for
+      ``(sender, destination host)`` is the row sum over that host's
+      partitions — the capacity is the bucket-rounded max of those sums,
+      not the flat ``rows_per_device`` worst case.
+    * **hop two (ICI)** then moves the rows device ``(h', d)`` collected
+      (from every sender with chip index ``d``) to their final chip, so
+      its bucket for ``(collector, destination partition)`` sums the
+      column over senders sharing that chip index.
+
+    The ``shuffle_capacity_dcn`` / ``shuffle_capacity_ici`` knobs (> 0)
+    override the planned values — per-hop escape hatches for meshes whose
+    DCN:ICI bandwidth ratio makes padding cheaper than precision.
+    Both capacities are lossless for THESE counts by construction.
+    """
+    from .. import config
+
+    if bucket is None:
+        bucket = int(config.get("shuffle_capacity_bucket"))
+    H, D = int(n_hosts), int(n_chips)
+    P = H * D
+    c = np.asarray(counts, dtype=np.int64).reshape(P, P)
+    total = int(c.sum())
+
+    # hop one: [P senders, H destination hosts]
+    hop_a = c.reshape(P, H, D).sum(axis=2)
+    amax = int(hop_a.max()) if hop_a.size else 0
+    amean = hop_a.sum() / hop_a.size if hop_a.size else 0.0
+    # hop two: collector (h', d) holds, for destination partition p on
+    # host h', the rows every sender with chip index d routed to p
+    hop_b = c.reshape(H, D, H, D).sum(axis=0)      # [d, h', D] per dest chip
+    bmax = int(hop_b.max()) if hop_b.size else 0
+    bmean = hop_b.sum() / hop_b.size if hop_b.size else 0.0
+
+    cap_a = int(config.get("shuffle_capacity_dcn"))
+    cap_b = int(config.get("shuffle_capacity_ici"))
+    if cap_a <= 0:
+        cap_a = _round_up(max(amax, 1), bucket)
+    if cap_b <= 0:
+        cap_b = _round_up(max(bmax, 1), bucket)
+    return HierarchicalPlan(
+        capacity_dcn=cap_a, capacity_ici=cap_b,
+        max_bucket_dcn=amax, max_bucket_ici=bmax, total_rows=total,
+        skew_dcn=amax / amean if amean > 0 else 0.0,
+        skew_ici=bmax / bmean if bmean > 0 else 0.0)

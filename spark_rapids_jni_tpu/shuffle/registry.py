@@ -32,6 +32,11 @@ class ShuffleInfo:
     skew_ratio: float      # max bucket / mean bucket from the plan
     oob_rows: int          # out-of-range pids routed to the null partition
     recovered_partitions: int = 0  # buffers rebuilt via map lineage
+    streamed: bool = False         # went through exchange_stream
+    morsels: int = 0               # morsels mapped (streamed only)
+    rounds_overlapped: int = 0     # rounds drained before end-of-stream
+    decode_ms: float = 0.0         # cumulative morsel decode+map time
+    drain_ms: float = 0.0          # cumulative round drain time
 
 
 class ShuffleMetrics:

@@ -40,6 +40,7 @@ an exchange forever).
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from functools import lru_cache, partial
 from typing import Optional, Sequence
@@ -61,8 +62,8 @@ from ..mem.executor import run_with_retry
 from ..parallel.partition import regroup_order, spark_partition_id
 from ..parallel.shuffle import route_out_of_range
 from ..relational.gather import gather_batch
-from .buffers import PartitionBuffer
-from .planner import RoundPlan, plan_rounds
+from .buffers import MorselBuffer, PartitionBuffer, RoundChunk
+from .planner import RoundPlan, plan_rounds, plan_stream_capacity
 from .registry import ShuffleInfo, ShuffleRegistry, get_registry
 
 
@@ -92,6 +93,11 @@ class ShuffleResult:
     skew_ratio: float
     oob_rows: int
     recovered_partitions: int = 0
+    streamed: bool = False          # produced by exchange_stream
+    morsels: int = 0                # morsels mapped (streamed only)
+    rounds_overlapped: int = 0      # rounds drained before end-of-stream
+    decode_ms: float = 0.0          # cumulative morsel decode+map time
+    drain_ms: float = 0.0           # cumulative round drain time
 
 
 def _map_local(b: ColumnBatch, pid, P: int):
@@ -170,6 +176,106 @@ def _drain_step(mesh, axis_name, capacity):
         got = occ.sum(dtype=jnp.int32)
         residual = jnp.maximum(counts - (r + 1) * C, 0).sum(dtype=jnp.int32)
         return out, occ, got[None], residual[None]
+
+    return jax.jit(step)
+
+
+# traces of the streaming drain program, bumped INSIDE the traced body
+# (the plan-cache _TRACE_COUNT pattern): a thousand-morsel stream must
+# compile the drain exactly once, and the parity tests assert it.
+_STREAM_DRAIN_TRACES = [0]
+
+
+@lru_cache(maxsize=None)
+def _chunk_init_step(mesh, axis_name, capacity):
+    """An empty round chunk shaped like the stream: ``P * capacity``
+    destination-major slot rows (zeros) + an all-false occupancy mask,
+    with dtypes/structure taken from a mapped morsel."""
+    P = mesh.shape[axis_name]
+    C = capacity
+    spec = PartitionSpec(axis_name)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(spec,),
+             out_specs=(spec, spec), check_vma=False)
+    def step(b: ColumnBatch):
+        zeros = jax.tree_util.tree_map(
+            lambda x: jnp.zeros((P * C,) + x.shape[1:], x.dtype), b)
+        return zeros, jnp.zeros((P * C,), jnp.bool_)
+
+    return jax.jit(step)
+
+
+@lru_cache(maxsize=None)
+def _scatter_step(mesh, axis_name, capacity):
+    """Scatter one mapped morsel into round ``r``'s send chunk.
+
+    Bucket ``(s, d)``'s rows occupy GLOBAL slots ``base[s,d] ..
+    base[s,d]+count-1`` (``base`` = the host's cumulative counts before
+    this morsel), so slot ``k`` belongs to round ``k // C`` at position
+    ``k % C`` of destination ``d``'s C-slot region.  Rows outside round
+    ``r`` — and null-partition / padding rows — scatter to index ``P*C``
+    and drop.  Scatter targets are disjoint per (morsel, round) and the
+    values deterministic, so replaying a scatter is idempotent: the
+    chunk's lineage rebuild can safely re-apply every recorded
+    contribution.  The round index and base matrix are traced, so one
+    compiled program serves the whole stream.
+    """
+    P = mesh.shape[axis_name]
+    C = capacity
+    spec = PartitionSpec(axis_name)
+
+    @partial(jax.shard_map, mesh=mesh,
+             in_specs=(spec, spec, spec, spec, PartitionSpec(),
+                       PartitionSpec()),
+             out_specs=(spec, spec), check_vma=False)
+    def step(chunk: ColumnBatch, occv, morsel: ColumnBatch, m_counts,
+             base, r):
+        s = jax.lax.axis_index(axis_name)
+        cnts = m_counts.reshape(-1)[:P]
+        my_base = base[s]
+        M = morsel.num_rows
+        ends = jnp.cumsum(cnts)
+        offs = ends - cnts
+        i = jnp.arange(M, dtype=jnp.int32)
+        d = jnp.searchsorted(ends, i, side="right").astype(jnp.int32)
+        d_c = jnp.minimum(d, P - 1)
+        k = jnp.take(my_base, d_c) + (i - jnp.take(offs, d_c))
+        in_round = (d < P) & (k >= r * C) & (k < (r + 1) * C)
+        t = jnp.where(in_round, d_c * C + (k - r * C), P * C)
+        new_chunk = jax.tree_util.tree_map(
+            lambda acc, x: acc.at[t].set(x, mode="drop"), chunk, morsel)
+        new_occ = occv.at[t].set(True, mode="drop")
+        return new_chunk, new_occ
+
+    return jax.jit(step)
+
+
+@lru_cache(maxsize=None)
+def _stream_drain_step(mesh, axis_name, capacity):
+    """Drain ONE streaming round: the chunk is already destination-major
+    packed by the scatter, so this is just the static all_to_all plus
+    the received-row count — and the single program every round of every
+    stream at this capacity reuses (``_STREAM_DRAIN_TRACES`` proves it).
+    """
+    P = mesh.shape[axis_name]
+    C = capacity
+    spec = PartitionSpec(axis_name)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec),
+             out_specs=(spec, spec, spec), check_vma=False)
+    def step(chunk: ColumnBatch, slot_occ):
+        _STREAM_DRAIN_TRACES[0] += 1
+
+        def a2a(x):
+            grid = x.reshape((P, C) + x.shape[1:])
+            out = jax.lax.all_to_all(
+                grid, axis_name, split_axis=0, concat_axis=0)
+            return out.reshape((P * C,) + x.shape[1:])
+
+        out = jax.tree_util.tree_map(a2a, chunk)
+        occ = a2a(slot_occ)
+        got = occ.sum(dtype=jnp.int32)
+        return out, occ, got[None]
 
     return jax.jit(step)
 
@@ -297,20 +403,8 @@ class ShuffleService:
 
         # lineage: each buffer's recompute= re-runs only the shards that
         # produced it, metered against the per-exchange recovery budget
-        max_recoveries = int(config.get("shuffle_max_recoveries"))
         recovered = [0]
-
-        def _lineage(rebuild, what):
-            def run():
-                if recovered[0] >= max_recoveries:
-                    raise ShuffleError(
-                        f"shuffle {sid}: {what} lost or corrupt and the "
-                        f"recovery budget is exhausted (max_recoveries="
-                        f"{max_recoveries}; see shuffle_max_recoveries)")
-                recovered[0] += 1
-                self.registry.metrics.record_recovered()
-                return rebuild()
-            return run
+        _lineage = self._lineage_factory(sid, recovered)
 
         # 3. drain: multi-round all_to_all over spillable buffers
         map_buf = PartitionBuffer(
@@ -389,12 +483,296 @@ class ShuffleService:
             skew_ratio=plan.skew_ratio, oob_rows=oob_total,
             recovered_partitions=recovered[0])
 
+    def exchange_stream(
+        self,
+        morsels,
+        key_names: Optional[Sequence[str]] = None,
+        ctx=None,
+        round_rows: Optional[int] = None,
+        strict: Optional[bool] = None,
+    ) -> ShuffleResult:
+        """Morsel-driven exchange: map and route ``morsels`` one at a
+        time, draining earlier rounds while later morsels are still
+        decoding — bit-identical on delivered rows to
+        :meth:`exchange` over the same rows, without ever materializing
+        the whole map output.
+
+        ``morsels`` yields either a morsel directly or (preferably) a
+        zero-arg REPLAY callable returning one (see
+        :class:`~spark_rapids_jni_tpu.shuffle.morsel.MorselSource`); a
+        morsel is a row-sharded ``ColumnBatch`` or a ``(batch, aux)``
+        pair where ``aux`` is the per-row validity (key mode) or the
+        partition id array (pid mode, ``key_names=None``).  Replay
+        callables are the stream's lineage: a lost or corrupt buffer
+        re-decodes and re-maps its source morsels instead of holding a
+        second copy resident.
+
+        The round-chunk capacity is fixed up front
+        (:func:`~.planner.plan_stream_capacity` — the counts don't exist
+        yet) and the ROUND SCHEDULE is re-planned as morsel counts
+        arrive: chunks are created and charged the moment a morsel first
+        touches their round (long before the round is fully received),
+        round ``r`` drains EARLY once every bucket's cumulative count
+        clears ``(r+1) * capacity`` (no later morsel can touch it), and
+        the final round count is whatever the observed maximum bucket
+        needs.  ``shuffle_max_rounds`` does not apply here — a stream
+        cannot raise a capacity it has already scattered into; bound
+        round count via ``round_rows`` instead.  Encoded columns decode
+        per morsel (codes-only streaming would need cross-morsel
+        dictionary identity).
+        """
+        from .. import config
+
+        if strict is None:
+            strict = bool(config.get("shuffle_strict_pids"))
+        mesh, axis = self.mesh, self.axis_name
+        P = mesh.shape[axis]
+        sid = self.registry.begin_shuffle()
+        spill_base = _spill_snapshot()
+        C = plan_stream_capacity(round_rows=round_rows)
+        scatter = _scatter_step(mesh, axis, C)
+        init = _chunk_init_step(mesh, axis, C)
+        drain = _stream_drain_step(mesh, axis, C)
+        recovered = [0]
+        _lineage = self._lineage_factory(sid, recovered)
+
+        def _make_run_map(replay):
+            def run():
+                item = replay()
+                b, aux = item if isinstance(item, tuple) else (item, None)
+                if any(isinstance(c, (RunLengthColumn, DictionaryColumn))
+                       for c in b.columns):
+                    b = ColumnBatch({
+                        n: (c.decode() if isinstance(
+                            c, (RunLengthColumn, DictionaryColumn)) else c)
+                        for n, c in zip(b.names, b.columns)})
+                if key_names is not None:
+                    step = _map_step_keys(mesh, axis, tuple(key_names),
+                                          aux is None)
+                    return step(b) if aux is None else step(b, aux)
+                if aux is None:
+                    raise ValueError(
+                        "pid-mode streaming morsels must be (batch, pid) "
+                        "pairs")
+                return _map_step_pid(mesh, axis)(b, aux)
+            return run
+
+        cum = np.zeros((P, P), np.int64)
+        send_chunks = {}
+        contribs = {}
+        recv = []
+        first_map = [None]
+        oob_total = 0
+        received = 0
+        bytes_moved = 0
+        next_drain = 0
+        n_morsels = 0
+        rounds_overlapped = 0
+        decode_ms = 0.0
+        drain_ms = 0.0
+
+        def _rebuild_chunk(rr):
+            # re-scatter every contribution recorded for round rr (a
+            # superset of the lost state is fine: scatters are
+            # idempotent and disjoint per contribution)
+            def rebuild():
+                state = None
+                for run_m, base_j in contribs.get(rr, ()):
+                    m_tree, m_counts = run_m()[:2]
+                    if state is None:
+                        state = init(m_tree)
+                    state = scatter(state[0], state[1], m_tree, m_counts,
+                                    jnp.asarray(base_j, jnp.int32),
+                                    jnp.int32(rr))
+                if state is None:
+                    m_tree, _ = first_map[0]()[:2]
+                    state = init(m_tree)
+                return state
+            return rebuild
+
+        def _open_chunk(rr, m_tree):
+            send_chunks[rr] = RoundChunk(
+                init(m_tree), ctx=ctx, name=f"shuffle{sid}-send{rr}",
+                recompute=_lineage(_rebuild_chunk(rr),
+                                   f"round {rr} send chunk"))
+            contribs[rr] = []
+
+        def _drain_round(rr):
+            nonlocal received, bytes_moved
+            chunk = send_chunks[rr]
+
+            def round_step():
+                _io_probe()
+                tree, occv = chunk.get()
+                out, occ2, got = drain(tree, occv)
+                got_n = int(np.asarray(jax.device_get(got)).sum())
+                return out, occ2, got_n
+
+            for attempt in range(_IO_RETRIES + 1):
+                try:
+                    out, occ2, got_n = run_with_retry(round_step)
+                    break
+                except faultinj.ShuffleIOError:
+                    self.registry.metrics.record_io_failure()
+                    if attempt == _IO_RETRIES:
+                        raise
+
+            def redrive():
+                tree, occv = chunk.get()
+                o, oc, _ = drain(tree, occv)
+                return o, oc
+
+            buf = PartitionBuffer(
+                (out, occ2), ctx=ctx, name=f"shuffle{sid}-recv{rr}",
+                recompute=_lineage(redrive, f"round {rr} chunk"))
+            recv.append(buf)
+            received += got_n
+            bytes_moved += buf.nbytes
+
+        try:
+            for item in morsels:
+                replay = item if callable(item) else (lambda it=item: it)
+                run_map_m = _make_run_map(replay)
+                t0 = time.perf_counter()
+                regrouped, counts, oob = run_map_m()
+                counts_np = np.asarray(
+                    jax.device_get(counts), np.int64).reshape(P, P)
+                decode_ms += (time.perf_counter() - t0) * 1e3
+                oob_n = int(np.asarray(jax.device_get(oob)).sum())
+                oob_total += oob_n
+                if oob_n and strict:
+                    raise ShuffleError(
+                        f"shuffle {sid}: {oob_n} out-of-range partition "
+                        f"ids (strict mode; ids must lie in [0, {P}])")
+                if first_map[0] is None:
+                    first_map[0] = run_map_m
+                base = cum.copy()
+                cum = cum + counts_np
+                m_idx = n_morsels
+                n_morsels += 1
+                mbuf = MorselBuffer(
+                    (regrouped, counts), ctx=ctx,
+                    name=f"shuffle{sid}-morsel{m_idx}",
+                    recompute=_lineage(lambda rm=run_map_m: rm()[:2],
+                                       f"morsel {m_idx} map output"))
+                try:
+                    nz = counts_np > 0
+                    if m_idx == 0:
+                        # round 0 always exists: an all-empty stream
+                        # still drains one schema-bearing empty round
+                        _open_chunk(0, mbuf.get()[0])
+                    if nz.any():
+                        r_lo = int((base[nz] // C).min())
+                        r_hi = int(((cum[nz] - 1) // C).max())
+                        for rr in range(r_lo, r_hi + 1):
+                            if rr not in send_chunks:
+                                _open_chunk(rr, mbuf.get()[0])
+                            contribs[rr].append((run_map_m, base))
+                            chunk = send_chunks[rr]
+                            tree, occv = chunk.get()
+                            m_tree, m_counts = mbuf.get()
+                            new = run_with_retry(
+                                lambda: scatter(
+                                    tree, occv, m_tree, m_counts,
+                                    jnp.asarray(base, jnp.int32),
+                                    jnp.int32(rr)))
+                            chunk.update(
+                                new,
+                                recompute=_lineage(
+                                    _rebuild_chunk(rr),
+                                    f"round {rr} send chunk"))
+                finally:
+                    mbuf.close()
+                # early drain: rounds no future morsel can touch
+                t0 = time.perf_counter()
+                while (int(cum.min()) >= (next_drain + 1) * C
+                       and next_drain in send_chunks):
+                    _drain_round(next_drain)
+                    rounds_overlapped += 1
+                    next_drain += 1
+                drain_ms += (time.perf_counter() - t0) * 1e3
+
+            if first_map[0] is None:
+                raise ValueError(
+                    "exchange_stream needs at least one morsel (the "
+                    "stream defines the output schema)")
+            cmax = int(cum.max())
+            rounds = max(1, -(-cmax // C))
+            t0 = time.perf_counter()
+            for rr in range(next_drain, rounds):
+                _drain_round(rr)
+            drain_ms += (time.perf_counter() - t0) * 1e3
+
+            sent = int(cum.sum())
+            if received != sent:
+                self.registry.metrics.record_dropped(abs(sent - received))
+                raise ShuffleError(
+                    f"shuffle {sid}: lossless invariant violated "
+                    f"(sent={sent} received={received} "
+                    f"rounds={rounds})")
+            if len(recv) == 1:
+                final_batch, final_occ = recv[0].get()
+            else:
+                parts = [b.get() for b in recv]
+                concat = _concat_step(mesh, axis, len(parts))
+                final_batch, final_occ = concat(*parts)
+        finally:
+            for c in send_chunks.values():
+                c.close()
+            for b in recv:
+                b.close()
+
+        spilled = 0
+        if spill_base is not None:
+            after = _spill_snapshot()
+            spilled = (after - spill_base) if after is not None else 0
+        # the materialized planner over the FINAL counts supplies the
+        # skew diagnostics; rounds/capacity record what actually ran
+        plan = plan_rounds(cum, round_rows=round_rows)
+        info = ShuffleInfo(
+            shuffle_id=sid, rounds=rounds, capacity=C,
+            rows_moved=received, bytes_moved=bytes_moved,
+            spilled_bytes=spilled, skew_ratio=plan.skew_ratio,
+            oob_rows=oob_total, recovered_partitions=recovered[0],
+            streamed=True, morsels=n_morsels,
+            rounds_overlapped=rounds_overlapped,
+            decode_ms=decode_ms, drain_ms=drain_ms)
+        self.registry.record(info)
+        return ShuffleResult(
+            batch=final_batch, occupancy=final_occ, shuffle_id=sid,
+            rounds=rounds, capacity=C, rows_moved=received,
+            bytes_moved=bytes_moved, spilled_bytes=spilled,
+            skew_ratio=plan.skew_ratio, oob_rows=oob_total,
+            recovered_partitions=recovered[0], streamed=True,
+            morsels=n_morsels, rounds_overlapped=rounds_overlapped,
+            decode_ms=decode_ms, drain_ms=drain_ms)
+
     def plan(self, counts, round_rows: Optional[int] = None) -> RoundPlan:
         """Expose the planner on the service for callers that fetched
         their own count matrix."""
         return plan_rounds(counts, round_rows=round_rows)
 
     # -- internals ------------------------------------------------------
+    def _lineage_factory(self, sid: int, recovered):
+        """The per-exchange lineage wrapper: every rebuild draws on the
+        shared ``shuffle_max_recoveries`` budget and is counted live."""
+        from .. import config
+
+        max_recoveries = int(config.get("shuffle_max_recoveries"))
+
+        def _lineage(rebuild, what):
+            def run():
+                if recovered[0] >= max_recoveries:
+                    raise ShuffleError(
+                        f"shuffle {sid}: {what} lost or corrupt and the "
+                        f"recovery budget is exhausted (max_recoveries="
+                        f"{max_recoveries}; see shuffle_max_recoveries)")
+                recovered[0] += 1
+                self.registry.metrics.record_recovered()
+                return rebuild()
+            return run
+        return _lineage
+
     def _run_round(self, drain, map_buf: PartitionBuffer, r: int):
         """One retryable round: arena pressure runs the spill ladder
         (RetryOOM → cross-task eviction → retry), transport faults are

@@ -262,6 +262,37 @@ class TestGL004:
         """}, rules=["GL004"])
         assert res.new == []
 
+    def test_streaming_handle_types_flagged(self, tmp_path):
+        # the morsel loop mints one MorselBuffer per morsel and one
+        # RoundChunk per round — a missed close there scales with input
+        # size, so the streaming handle types get the same treatment
+        res = lint(tmp_path, {"mod.py": """
+            from spark_rapids_jni_tpu.shuffle import MorselBuffer, RoundChunk
+            def leak_morsel(tree):
+                mbuf = MorselBuffer(tree)
+                return 1
+            def leak_chunk(state):
+                RoundChunk(state)
+        """}, rules=["GL004"])
+        assert sorted(f.rule for f in res.new) == ["GL004", "GL004"]
+
+    def test_streaming_handle_types_clean(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            from spark_rapids_jni_tpu.shuffle import MorselBuffer, RoundChunk
+            def adopted(tree, ctx):
+                mbuf = MorselBuffer(tree, ctx=ctx)  # ctx adopts the handle
+                return mbuf.get()
+            def closed(state):
+                chunk = RoundChunk(state)
+                try:
+                    return chunk.get()
+                finally:
+                    chunk.close()
+            def stored(chunks, rr, state, ctx):
+                chunks[rr] = RoundChunk(state, ctx=ctx)
+        """}, rules=["GL004"])
+        assert res.new == []
+
     def test_suppressed(self, tmp_path):
         res = lint(tmp_path, {"mod.py": """
             def leak(tree, SpillableHandle):

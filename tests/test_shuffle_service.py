@@ -298,6 +298,166 @@ class TestOutOfCore:
 
 
 # ---------------------------------------------------------------------------
+# streaming morsel-driven exchange (bit-identical to the materialized path)
+# ---------------------------------------------------------------------------
+
+class TestStreamingExchange:
+    """``exchange_stream`` must deliver rows BIT-IDENTICALLY to
+    ``exchange`` over the same rows — same content, same per-shard
+    order — while draining earlier rounds before the stream ends and
+    tracing its drain program exactly once."""
+
+    def _kv_batch(self, keys, vals):
+        k = np.asarray(keys, np.int64)
+        v = np.asarray(vals, np.int64)
+        ones = jnp.ones((len(k),), jnp.bool_)
+        return ColumnBatch({
+            "k": Column(jnp.asarray(k), ones, T.INT64),
+            "v": Column(jnp.asarray(v), ones, T.INT64)})
+
+    @staticmethod
+    def _rows(res):
+        occ = np.asarray(jax.device_get(res.occupancy))
+        k = np.asarray(jax.device_get(res.batch["k"].data))
+        v = np.asarray(jax.device_get(res.batch["v"].data))
+        return k, v, occ
+
+    def _assert_bit_identical(self, mat, stream):
+        """Delivered (occupancy-masked) rows equal per destination
+        shard, in order.  Shapes may differ only when both paths fit in
+        one round (the materialized capacity shrinks to its bucket) —
+        the masked sequences still line up row for row."""
+        mk, mv, mo = self._rows(mat)
+        sk, sv, so = self._rows(stream)
+        ra, rb = mk.shape[0] // P8, sk.shape[0] // P8
+        for d in range(P8):
+            a = slice(d * ra, (d + 1) * ra)
+            b = slice(d * rb, (d + 1) * rb)
+            assert np.array_equal(mk[a][mo[a]], sk[b][so[b]])
+            assert np.array_equal(mv[a][mo[a]], sv[b][so[b]])
+
+    def _run_both(self, keys, vals, round_rows, morsel_rows,
+                  extra_morsels=None):
+        from spark_rapids_jni_tpu.shuffle import MorselSource
+
+        mesh = data_mesh(P8)
+        batch = shard_batch(self._kv_batch(keys, vals), mesh)
+        svc = ShuffleService(mesh, registry=ShuffleRegistry())
+        mat = svc.exchange(batch, key_names=["k"], round_rows=round_rows)
+        src = MorselSource.from_batch(batch, mesh, morsel_rows=morsel_rows)
+        morsels = list(src)
+        if extra_morsels:
+            for at, m in extra_morsels:
+                morsels.insert(at, m)
+        res = svc.exchange_stream(morsels, key_names=["k"],
+                                  round_rows=round_rows)
+        self._assert_bit_identical(mat, res)
+        return mat, res
+
+    def test_uniform_multiround_overlaps_decode(self, eight_devices,
+                                                small_buckets):
+        n = P8 * 512
+        rng = np.random.default_rng(5)
+        keys = rng.integers(0, 1 << 20, n)
+        mat, res = self._run_both(keys, np.arange(n), round_rows=16,
+                                  morsel_rows=64)
+        assert res.streamed and res.morsels == 8
+        assert res.rows_moved == n and mat.rows_moved == n
+        assert res.rounds >= 2
+        # >= 2 rounds were IN FLIGHT: drained while later morsels were
+        # still decoding, not after end-of-stream
+        assert res.rounds_overlapped >= 2
+        assert res.rounds == mat.rounds and res.capacity == mat.capacity
+
+    def test_all_to_one_skew(self, eight_devices, small_buckets):
+        # one constant key: every row hashes to a single destination,
+        # the worst skew the planner can see
+        n = P8 * 256
+        mat, res = self._run_both(np.full(n, 7), np.arange(n),
+                                  round_rows=64, morsel_rows=64)
+        assert res.rows_moved == n
+        assert res.rounds >= 2
+        assert res.skew_ratio == pytest.approx(mat.skew_ratio)
+
+    def test_zipf_keys_empty_partitions_and_empty_morsel(
+            self, eight_devices, small_buckets):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh = data_mesh(P8)
+        n = P8 * 128
+        M = 32
+        rng = np.random.default_rng(11)
+        # zipf mass folded onto 5 distinct keys: several destinations
+        # receive nothing at all
+        keys = (np.minimum(rng.zipf(1.5, n), 1 << 20) % 5).astype(np.int64)
+        sh = NamedSharding(mesh, PartitionSpec("data"))
+        zeros = jax.device_put(jnp.zeros((P8 * M,), jnp.int64), sh)
+        ones = jax.device_put(jnp.ones((P8 * M,), jnp.bool_), sh)
+        empty = (ColumnBatch({"k": Column(zeros, ones, T.INT64),
+                              "v": Column(zeros, ones, T.INT64)}),
+                 jax.device_put(jnp.zeros((P8 * M,), jnp.bool_), sh))
+        _, res = self._run_both(
+            keys, np.arange(n), round_rows=32, morsel_rows=M,
+            # an all-invalid morsel mid-stream contributes zero rows
+            # everywhere and must not disturb accounting or order
+            extra_morsels=[(2, lambda: empty)])
+        assert res.rows_moved == n
+        assert res.morsels == 5  # the empty one still counts as mapped
+
+    def test_drain_program_traces_once(self, eight_devices, small_buckets):
+        from spark_rapids_jni_tpu.shuffle.service import \
+            _STREAM_DRAIN_TRACES
+
+        n = P8 * 256
+        rng = np.random.default_rng(13)
+        self._run_both(rng.integers(0, 99, n), np.arange(n),
+                       round_rows=16, morsel_rows=64)
+        before = _STREAM_DRAIN_TRACES[0]
+        # a second stream at the same capacity (fresh data, many
+        # morsels, several rounds) must reuse every compiled program
+        self._run_both(rng.integers(0, 99, n), np.arange(n) * 3,
+                       round_rows=16, morsel_rows=64)
+        assert _STREAM_DRAIN_TRACES[0] == before
+
+    def test_out_of_core_stream_spills_and_stays_lossless(
+            self, eight_devices, tmp_path):
+        from spark_rapids_jni_tpu.mem import RmmSpark, TaskContext
+        from spark_rapids_jni_tpu.mem import spill as spill_mod
+        from spark_rapids_jni_tpu.shuffle import MorselSource
+
+        old_bucket = config.get("shuffle_capacity_bucket")
+        config.set("shuffle_capacity_bucket", 256)
+        get_registry().reset()
+        mesh = data_mesh(P8)
+        n = P8 * 4096
+        rng = np.random.default_rng(17)
+        batch = shard_batch(
+            self._kv_batch(np.full(n, 3), rng.integers(0, 1 << 40, n)),
+            mesh)
+        spill_mod.install(spill_dir=str(tmp_path))
+        RmmSpark.set_event_handler(1 << 20, poll_ms=10.0)  # 1 MB arena
+        try:
+            with TaskContext(78) as ctx:
+                src = MorselSource.from_batch(batch, mesh,
+                                              morsel_rows=1024)
+                res = ShuffleService(mesh).exchange_stream(
+                    src, key_names=["k"], ctx=ctx, round_rows=512)
+                k, _, occ = self._rows(res)
+            RmmSpark.task_done(78)
+        finally:
+            RmmSpark.clear_event_handler()
+            spill_mod.shutdown()
+            config.set("shuffle_capacity_bucket", old_bucket)
+
+        assert res.rows_moved == n
+        assert (k[occ] == 3).all() and int(occ.sum()) == n
+        summary = profiler.shuffle_summary()
+        assert summary["rounds"] >= 2
+        assert summary["spilled_bytes"] > 0  # the arena forced demotion
+        assert summary["dropped_rows"] == 0
+
+
+# ---------------------------------------------------------------------------
 # transport fault injection (kind "shuffle_io")
 # ---------------------------------------------------------------------------
 

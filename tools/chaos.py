@@ -98,6 +98,9 @@ _shuffle_probe = faultinj.instrument(lambda: None, "chaos_shuffle_step")
 _q95_probe = faultinj.instrument(lambda: None, "chaos_q95_step")
 _sort_probe = faultinj.instrument(lambda: None, "chaos_sort_step")
 _jni_probe = faultinj.instrument(lambda: None, "chaos_jni_step")
+# crossed at every morsel decode of the streaming scan — "mid-morsel"
+# faults land between a round being half-received and its drain
+_stream_probe = faultinj.instrument(lambda: None, "chaos_stream_morsel")
 
 
 def _digest(tree) -> str:
@@ -327,6 +330,83 @@ class SortScenario:
         return {"digest": digest, "extra": {}}
 
 
+class StreamingScanScenario:
+    """The morsel-driven scan→shuffle pipeline under fire: a uniform
+    stream goes multi-round with rounds draining while later morsels
+    decode, under arenas tight enough that half-received round chunks
+    demote through the host→disk spill tiers.  Every morsel decode
+    crosses the ``chaos_stream_morsel`` seam (exception/oom/fatal land
+    MID-STREAM, with open round chunks that the service must close on
+    the way out); ``shuffle_io_round`` fires on the early drains; and
+    spill/host corruption of a half-received chunk must recover by
+    replaying its recorded morsel contributions
+    (ShuffleMetrics.recovered_partitions) — never by holding a second
+    copy resident."""
+
+    name = "streaming_scan"
+    task_id = 203
+
+    def run(self) -> Dict:
+        from spark_rapids_jni_tpu.parallel import data_mesh, shard_batch
+        from spark_rapids_jni_tpu.shuffle import (
+            MorselSource,
+            ShuffleRegistry,
+            ShuffleService,
+        )
+
+        if len(jax.devices()) < 8:
+            raise ChaosError(
+                "streaming_scan scenario needs 8 devices; set XLA_FLAGS="
+                "--xla_force_host_platform_device_count=8 before jax init")
+        P = 8
+        n = P * 2048
+        keys = (np.arange(n, dtype=np.int64) * 2654435761) % (1 << 20)
+        mesh = data_mesh(P)
+        ones = jnp.ones((n,), jnp.bool_)
+        batch = shard_batch(ColumnBatch({
+            "k": Column(jnp.asarray(keys), ones, T.INT64),
+            "v": Column(jnp.asarray(np.arange(n, dtype=np.int64)), ones,
+                        T.INT64)}), mesh)
+        old_bucket = config.get("shuffle_capacity_bucket")
+        config.set("shuffle_capacity_bucket", 16)
+        try:
+            with _harness(512 * KB, 128 * KB, self.name) as (fw, adaptor):
+                reg = ShuffleRegistry()
+                with TaskContext(self.task_id) as ctx:
+                    def body():
+                        src = MorselSource.from_batch(batch, mesh,
+                                                      morsel_rows=512)
+                        # the mid-morsel seam: every decode (including a
+                        # lineage replay) crosses the probe first
+                        morsels = [
+                            (lambda r=r: (_stream_probe(), r())[1])
+                            for r in src]
+                        res = ShuffleService(
+                            mesh, registry=reg).exchange_stream(
+                                morsels, key_names=["k"], ctx=ctx,
+                                round_rows=32)
+                        return (_digest((res.batch, res.occupancy)),
+                                res.rounds, res.rounds_overlapped)
+                    digest, rounds, overlapped = run_with_retry(
+                        body, make_spillable=_always_retry(fw))
+                RmmSpark.task_done(self.task_id)
+                _check_invariants(fw, adaptor)
+        finally:
+            config.set("shuffle_capacity_bucket", old_bucket)
+        if rounds < 2 or overlapped < 1:
+            raise ChaosError(
+                f"streaming_scan degenerated: rounds={rounds} "
+                f"overlapped={overlapped} — the stream no longer drains "
+                "while morsels decode, so the trial proves nothing")
+        snap = reg.metrics.snapshot()
+        return {"digest": digest,
+                "extra": {"recovered_partitions":
+                          snap["recovered_partitions"],
+                          "io_failures": snap["io_failures"],
+                          "rounds": rounds,
+                          "rounds_overlapped": overlapped}}
+
+
 class JniScenario:
     """The Java/JNI host boundary: columns cross as Arrow-style host
     buffers, ops dispatch through ``jni_bridge.invoke`` (hash → bloom
@@ -368,7 +448,7 @@ class JniScenario:
 
 SCENARIOS = {s.name: s for s in (SpillScenario(), ShuffleScenario(),
                                  Q95Scenario(), SortScenario(),
-                                 JniScenario())}
+                                 StreamingScanScenario(), JniScenario())}
 
 
 # ---------------------------------------------------------------------------
@@ -434,6 +514,37 @@ def single_fault_trials(fast: bool = False) -> List[Trial]:
         for kind in ("exception", "oom", "fatal"):
             one("q95", "chaos_q95_step", kind)
 
+    # streaming scan: every fault kind lands mid-morsel (the decode
+    # seam), on the early-drain transport, and on a half-received round
+    # chunk's spill tiers (corruption must recover by replaying the
+    # chunk's recorded morsel contributions).  The corruption trials pin
+    # OCCURRENCES: the demotion order is deterministic (fixed data,
+    # fixed arenas), and the first spill victim is the already-drained
+    # round-0 send chunk, which is never read again — damage there is
+    # harmless but proves nothing.  skip=8 demotions / skip=40 leaf
+    # writes land on the HALF-RECEIVED send chunk for round 4 (demoted
+    # mid-stream, promoted again for later scatters and its drain), so
+    # detection MUST fire and the chunk MUST rebuild from its recorded
+    # morsel contributions; the not-fast variants hit a received round
+    # chunk instead, which rebuilds by re-draining from its send chunk.
+    for kind in ("exception", "oom", "fatal"):
+        one("streaming_scan", "chaos_stream_morsel", kind)
+    one("streaming_scan", "shuffle_io_round", "shuffle_io")
+    one("streaming_scan", "spill_corrupt_file", "spill_corrupt",
+        skip=40, expect_recovered=True)
+    one("streaming_scan", "host_corrupt_probe", "host_corrupt",
+        skip=8, expect_recovered=True)
+    if not fast:
+        one("streaming_scan", "chaos_stream_morsel", "exception", skip=2)
+        one("streaming_scan", "shuffle_io_round", "oom")
+        one("streaming_scan", "spill_corrupt_file", "spill_corrupt",
+            skip=5, expect_recovered=True)
+        one("streaming_scan", "host_corrupt_probe", "host_corrupt",
+            skip=1, expect_recovered=True)
+        one("streaming_scan", "spill_io_write", "spill_io")
+        one("streaming_scan", "spill_io_read", "spill_io",
+            expect_recovered=True)
+
     # sort scenario: the distributed-sort seam (pre-plan and post-sort)
     if not fast:
         for kind in ("exception", "oom", "fatal"):
@@ -459,6 +570,11 @@ _MULTI_POOL = {
                 ("shuffle_io_round", "oom"),
                 ("spill_corrupt_file", "spill_corrupt"),
                 ("spill_io_write", "spill_io")],
+    "streaming_scan": [("chaos_stream_morsel", "oom"),
+                       ("chaos_stream_morsel", "exception"),
+                       ("shuffle_io_round", "shuffle_io"),
+                       ("spill_corrupt_file", "spill_corrupt"),
+                       ("host_corrupt_probe", "host_corrupt")],
     "q95": [("chaos_q95_step", "oom"), ("chaos_q95_step", "exception")],
     "sort": [("chaos_sort_step", "oom"), ("chaos_sort_step", "exception")],
     "jni": [("chaos_jni_step", "oom"), ("chaos_jni_step", "exception")],

@@ -381,18 +381,23 @@ class GL003RetraceHazard(Rule):
 # GL004 — spill-handle leak
 # ---------------------------------------------------------------------------
 
-_HANDLE_CLASSES = {"SpillableHandle", "TaskContext"}
+_HANDLE_CLASSES = {"SpillableHandle", "TaskContext",
+                   "MorselBuffer", "RoundChunk"}
 _CLOSE_METHODS = {"close", "release", "adopt", "adopt_handle", "__exit__"}
 
 
 class GL004SpillHandleLeak(Rule):
     """A ``SpillableHandle`` registers itself with the process-wide
     ``SpillableStore`` on construction; a ``TaskContext`` owns arena
-    charge.  One never closed/released/adopted pins its bytes in the
-    store's LRU forever — the leak shows up as every *other* task
-    spilling harder.  Flag constructions whose result is discarded or
-    bound to a name that is never closed, released, returned, yielded,
-    aliased, stored, passed on, or used as a context manager."""
+    charge.  The streaming pipeline's ``MorselBuffer`` / ``RoundChunk``
+    subclasses carry the same registration — and leak HARDER, because
+    the morsel loop mints one per morsel/round, so a missed close scales
+    with input size instead of query count.  One never
+    closed/released/adopted pins its bytes in the store's LRU forever —
+    the leak shows up as every *other* task spilling harder.  Flag
+    constructions whose result is discarded or bound to a name that is
+    never closed, released, returned, yielded, aliased, stored, passed
+    on, or used as a context manager."""
 
     id = "GL004"
 
