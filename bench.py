@@ -548,6 +548,155 @@ def spill_main():
 
 
 # --------------------------------------------------------------------------
+# serving scenario (--serve): N concurrent tenant streams, solo-identical
+# --------------------------------------------------------------------------
+
+def serve_main():
+    """N (>=4) concurrent q6-shaped tenant streams through the
+    multi-tenant ``ServeRuntime`` sharing one capped arena.  The same
+    query set first runs SOLO (``max_concurrent=1`` — same admission /
+    ladder / unwind path, zero interleaving) to record per-query latency
+    and the per-query result digests; the concurrent wave must be
+    bit-identical to solo, and the emitted line carries solo vs
+    concurrent p50/p99 so BENCH_*.json tracks the isolation tax.
+    ``vs_baseline`` is solo_p99 / concurrent_p99 — the fairness ratio
+    the ci/q95_floor.json ``serve_p99_floor`` ratchet guards."""
+    import hashlib
+    import tempfile
+
+    import jax
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        platform = jax.devices()[0].platform
+    except Exception as e:  # backend init failure → parent falls back
+        print(f"# backend init failed: {e}", file=sys.stderr, flush=True)
+        return 17
+
+    import numpy as np
+
+    import __graft_entry__ as ge
+    from spark_rapids_jni_tpu import config, mem
+    from spark_rapids_jni_tpu.mem.rmm_spark import RmmSpark
+    from spark_rapids_jni_tpu.serve import ServeRuntime
+
+    n_streams = max(4, int(os.environ.get("BENCH_SERVE_STREAMS", "4")))
+    n_queries = int(os.environ.get("BENCH_SERVE_QUERIES", "3"))
+    n_rows = int(os.environ.get("BENCH_SERVE_ROWS", str(1 << 14)))
+    steps = 2  # q6 steps per query
+    batch_bytes = mem.batch_nbytes(ge._example_batch(n_rows, seed=7))
+    # arena: one in-flight batch per stream plus headroom — enough
+    # contention that admission and the LRU matter, not enough to stall
+    pool = int(batch_bytes * (n_streams + 1))
+    host_pool = max(batch_bytes, 1 << 16)
+    spill_dir = tempfile.mkdtemp(prefix="bench_serve_")
+    jfn = jax.jit(ge._q6_step)
+    jax.block_until_ready(jfn(ge._example_batch(n_rows, seed=7)))  # warm
+
+    def make_query(stream, k):
+        def q(ctx):
+            t0 = time.perf_counter()
+            dig = hashlib.sha256()
+            for s in range(steps):
+                b = ge._example_batch(
+                    n_rows, seed=1000 * stream + 10 * k + s)
+                h = mem.SpillableHandle(
+                    b, ctx=ctx, name=f"bench-serve-{stream}-{k}-{s}")
+                out = jax.block_until_ready(jfn(b))
+                for leaf in jax.tree_util.tree_leaves(out):
+                    a = np.asarray(jax.device_get(leaf))
+                    dig.update(str(a.dtype).encode())
+                    dig.update(str(a.shape).encode())
+                    dig.update(np.ascontiguousarray(a).tobytes())
+                h.close()
+            return dig.hexdigest(), time.perf_counter() - t0
+        return q
+
+    def run_wave(max_conc, base):
+        rt = ServeRuntime(max_concurrent=max_conc, task_id_base=base)
+        t0 = time.perf_counter()
+        try:
+            sessions = {}
+            for i in range(n_streams):
+                for k in range(n_queries):
+                    sessions[(i, k)] = rt.submit(
+                        make_query(i, k), est_bytes=batch_bytes,
+                        tenant=f"stream-{i}")
+            outs = {key: s.result(timeout=300.0)
+                    for key, s in sessions.items()}
+        finally:
+            clean = rt.shutdown()
+        wall = time.perf_counter() - t0
+        if not clean:
+            raise RuntimeError("ServeRuntime.shutdown() left wedged "
+                               "sessions")
+        return outs, wall
+
+    def _pct(vals, q):
+        vals = sorted(vals)
+        return vals[min(len(vals) - 1, int(round(q * (len(vals) - 1))))]
+
+    adaptor = RmmSpark.set_event_handler(pool, host_pool_bytes=host_pool,
+                                         poll_ms=10.0)
+    mem.install_spill_framework(spill_dir=spill_dir)
+    # solo may queue the whole wave behind one slot; don't let the
+    # admission deadline turn a slow CPU box into a bogus QueryTimeout
+    config.set("serve_admit_timeout_s", 300.0)
+    try:
+        solo, solo_wall = run_wave(1, 30_000)
+        conc, wall = run_wave(n_streams, 40_000)
+        # read residue BEFORE teardown: clear_event_handler frees the
+        # native adaptor, so a later call would touch freed memory
+        residue = (adaptor.total_allocated(),
+                   adaptor.host_total_allocated())
+    except Exception as e:
+        print(f"# serve scenario failed: {e!r}", file=sys.stderr,
+              flush=True)
+        return 1
+    finally:
+        config.reset("serve_admit_timeout_s")
+        mem.shutdown_spill_framework()
+        RmmSpark.clear_event_handler()
+
+    drift = [key for key in solo if solo[key][0] != conc[key][0]]
+    if drift:
+        print(f"# serve scenario: concurrent results DIFFER from solo "
+              f"for {sorted(drift)}", file=sys.stderr, flush=True)
+        return 1
+    if any(residue):
+        print(f"# serve scenario: arena not drained after shutdown "
+              f"(device={residue[0]}B host={residue[1]}B)",
+              file=sys.stderr, flush=True)
+        return 1
+    solo_lat = [dt * 1e3 for _, dt in solo.values()]
+    conc_lat = [dt * 1e3 for _, dt in conc.values()]
+    total_rows = n_streams * n_queries * steps * n_rows
+    conc_p99 = _pct(conc_lat, 0.99)
+    print(json.dumps({
+        "metric": "serve_concurrent_throughput",
+        "value": round(total_rows / wall / 1e6, 2),
+        "unit": "Mrows/s",
+        "vs_baseline": round(_pct(solo_lat, 0.99) / conc_p99, 3)
+        if conc_p99 else 0.0,
+        "platform": platform,
+        "rows": total_rows,
+        "note": {
+            "streams": n_streams,
+            "queries_per_stream": n_queries,
+            "bit_identical": True,
+            "solo_p50_ms": round(_pct(solo_lat, 0.5), 2),
+            "solo_p99_ms": round(_pct(solo_lat, 0.99), 2),
+            "concurrent_p50_ms": round(_pct(conc_lat, 0.5), 2),
+            "concurrent_p99_ms": round(conc_p99, 2),
+            "solo_wall_s": round(solo_wall, 3),
+            "concurrent_wall_s": round(wall, 3),
+        },
+    }), flush=True)
+    return 0
+
+
+# --------------------------------------------------------------------------
 # shuffle scenario (--shuffle): skewed out-of-core exchange
 # --------------------------------------------------------------------------
 
@@ -1606,6 +1755,8 @@ def main():
         sys.exit(micro_main())
     if mode == "--child-spill":
         sys.exit(spill_main())
+    if mode == "--child-serve":
+        sys.exit(serve_main())
     if mode == "--child-shuffle":
         sys.exit(shuffle_main())
     if mode == "--child-plan":
@@ -1617,11 +1768,13 @@ def main():
 
     run_micro = mode == "--micro"
     run_spill = mode == "--spill"
+    run_serve = mode == "--serve"
     run_shuffle = mode == "--shuffle"
     run_plan = mode == "--plan"
     run_scan = mode == "--scan"
     child_mode = ("--child-micro" if run_micro
                   else "--child-spill" if run_spill
+                  else "--child-serve" if run_serve
                   else "--child-shuffle" if run_shuffle
                   else "--child-plan" if run_plan
                   else "--child-scan" if run_scan else "--child")
@@ -1664,6 +1817,7 @@ def main():
         # *something*, labeled for the mode that actually failed.
         metric = ("micro_suite" if run_micro
                   else "q6_spill_oversubscribed" if run_spill
+                  else "serve_concurrent_throughput" if run_serve
                   else "shuffle_skew_outofcore" if run_shuffle
                   else "q6_ir_throughput" if run_plan
                   else "scan_stream_throughput" if run_scan

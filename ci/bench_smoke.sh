@@ -14,13 +14,19 @@ BENCH_FORCE_CPU=1 BENCH_PLAN_ROWS=65536 BENCH_REPS=2 python bench.py --plan \
 # morsels still decode (scan_main fails the run otherwise)
 BENCH_FORCE_CPU=1 BENCH_SCAN_ROWS=32768 python bench.py --scan \
   | tee /tmp/bench_smoke_scan.out
+# serving scenario: >=4 concurrent tenant streams through the
+# ServeRuntime; the wave must be bit-identical to the solo pass and the
+# note carries solo vs concurrent p50/p99 (the serve_p99_floor ratchet)
+BENCH_FORCE_CPU=1 BENCH_SERVE_ROWS=16384 python bench.py --serve \
+  | tee /tmp/bench_smoke_serve.out
 # the q95 lines must be self-explaining (per-stage note + engines; cache +
 # decisions on the IR rows) and their vs_baseline must not regress below
 # the recorded floors — ratchets in the same only-shrinks spirit as
-# graftlint's baseline (ci/q95_floor.json); a missing q9 IR row or
-# streaming-scan row fails too
+# graftlint's baseline (ci/q95_floor.json); a missing q9 IR row,
+# streaming-scan row, or serving row fails too
 python ci/check_q95_line.py /tmp/bench_smoke_q6.out \
-  /tmp/bench_smoke_plan.out /tmp/bench_smoke_scan.out
+  /tmp/bench_smoke_plan.out /tmp/bench_smoke_scan.out \
+  /tmp/bench_smoke_serve.out
 # spill scenario: device arena capped below q6's working set; the emitted
 # line carries spill-bytes counters so BENCH_*.json tracks spill overhead
 BENCH_FORCE_CPU=1 BENCH_SPILL_ROWS=65536 python bench.py --spill

@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # Chaos-campaign gate: deterministic fault sweep over
-# spill/shuffle/q95/sort/streaming_scan/jni.
+# spill/shuffle/q95/sort/streaming_scan/jni/serving.
 #
 # Runs tools/chaos.py — every faultinj.FAULT_KINDS entry fired at every
 # instrumented boundary (one fault per trial, exhaustively) plus seeded
@@ -21,13 +21,14 @@ echo "== chaos campaign (seed=${CHAOS_SEED}) =="
 BENCH_FORCE_CPU=1 python -m tools.chaos --seed "${CHAOS_SEED}" \
     --report /tmp/chaos_report.json
 
-# the full matrix must cover the distributed-sort, streaming-scan and
-# JNI-boundary fault domains — a silently shrunken scenario set would
-# pass the campaign's own exit code, so assert the report
+# the full matrix must cover the distributed-sort, streaming-scan,
+# JNI-boundary and multi-tenant-serving fault domains — a silently
+# shrunken scenario set would pass the campaign's own exit code, so
+# assert the report
 python - /tmp/chaos_report.json <<'EOF'
 import json, sys
 doc = json.load(open(sys.argv[1]))
-for scenario in ("sort", "streaming_scan", "jni"):
+for scenario in ("sort", "streaming_scan", "jni", "serving"):
     trials = [t for t in doc["trials"]
               if t["label"].startswith(scenario + ":")]
     assert trials, f"chaos report has no {scenario!r} trials"

@@ -35,6 +35,13 @@ must carry the overlap evidence (``rounds_overlapped >= 2``,
 decode/drain ms), and its ``vs_baseline`` — streaming over the
 materialized decode-then-exchange baseline — must not shrink below the
 recorded floor.
+
+The serving row (``bench.py --serve``, its own capture file) rides
+``serve_p99_floor``: ``serve_concurrent_throughput`` must exist, its
+note must record ``bit_identical`` true (the concurrent wave matched
+the solo pass digest for digest) with at least 4 streams, and its
+``vs_baseline`` — the solo-p99 / concurrent-p99 fairness ratio — must
+not shrink below the recorded floor.
 """
 import json
 import os
@@ -67,12 +74,14 @@ def main(paths) -> int:
     enc_floor = floors["encoded_vs_baseline_floor"]
     ir_floor = floors["ir_vs_baseline_floor"]
     scan_floor = floors["scan_vs_baseline_floor"]
+    serve_floor = floors["serve_p99_floor"]
     lines = _scan(paths)
     line = lines.get("q95_shape_throughput")
     enc_line = lines.get("q95_shape_encoded_throughput")
     ir_line = lines.get("q95_ir_throughput")
     q9_line = lines.get("q9_ir_throughput")
     scan_line = lines.get("scan_stream_throughput")
+    serve_line = lines.get("serve_concurrent_throughput")
     if line is None:
         print("check_q95_line: no q95_shape_throughput line in",
               " ".join(paths))
@@ -150,6 +159,25 @@ def main(paths) -> int:
             errs.append(f"scan vs_baseline {scan_vs} regressed below "
                         f"the recorded floor {scan_floor} "
                         f"(ci/q95_floor.json)")
+    serve_vs = None
+    if serve_line is None:
+        errs.append("no serve_concurrent_throughput line: the serving "
+                    "row fell out of the smoke (bench.py serve_main)")
+    else:
+        serve_note = serve_line.get("note")
+        if (not isinstance(serve_note, dict)
+                or serve_note.get("bit_identical") is not True):
+            errs.append("serve line's note.bit_identical is not true: "
+                        "the concurrent wave no longer proves it matched "
+                        f"the solo pass (note={json.dumps(serve_note)})")
+        elif int(serve_note.get("streams", 0)) < 4:
+            errs.append("serve line ran fewer than 4 concurrent streams "
+                        f"(note={json.dumps(serve_note)})")
+        serve_vs = serve_line.get("vs_baseline", 0.0)
+        if serve_vs < serve_floor:
+            errs.append(f"serve vs_baseline {serve_vs} (solo p99 / "
+                        f"concurrent p99) regressed below the recorded "
+                        f"floor {serve_floor} (ci/q95_floor.json)")
     if errs:
         for e in errs:
             print("check_q95_line:", e)
@@ -158,6 +186,7 @@ def main(paths) -> int:
           f"encoded {enc_vs} >= floor {enc_floor}; "
           f"IR {ir_vs} >= floor {ir_floor}; q9 row present; "
           f"scan {scan_vs} >= floor {scan_floor}; "
+          f"serve {serve_vs} >= floor {serve_floor}; "
           f"engines {json.dumps((note or {}).get('engines'))})")
     if vs >= 2 * floor and floor > 0:
         print(f"check_q95_line: note — vs_baseline is >=2x the floor; "

@@ -222,6 +222,30 @@ _register("q6_float_mode", "f32x3", str,
           "Float-sum mode for the q6 onehot path: 'f32x3' (exact Dekker "
           "split, MXU-native, order-nondeterministic rounding) or 'f64' "
           "(emulated f64 contraction, sort-path-compatible rounding).")
+_register("serve_max_concurrent", 4, int,
+          "Admission slots of the serving runtime (serve/runtime.py): "
+          "how many tenant queries may hold a TaskContext at once; the "
+          "rest wait in the admission queue (their wait is visible to "
+          "the deadlock scan via ThreadStateRegistry).")
+_register("serve_admit_timeout_s", 30.0, float,
+          "Max seconds a submitted query may wait in the admission "
+          "queue before failing with QueryTimeout (per admission "
+          "attempt; re-admissions get a fresh window).")
+_register("serve_stall_break_ms", 2000.0, float,
+          "Serving-mode watchdog escalation: threads continuously "
+          "blocked past this are treated as a cross-tenant deadlock "
+          "cycle even while OTHER tenants keep running (the global scan "
+          "only fires when every task thread is blocked), and the "
+          "lowest-priority one is rolled back (RetryOOM).  0 disables; "
+          "armed by ServeRuntime on construction.")
+_register("serve_max_readmissions", 2, int,
+          "How many times a query killed by its own timeout is backed "
+          "off and re-admitted before QueryTimeout surfaces to the "
+          "caller (bounded re-admission; external cancels never "
+          "re-admit).")
+_register("serve_backoff_ms", 50.0, float,
+          "Base backoff between a query's timeout-kill and its "
+          "re-admission, doubled per attempt (serve/runtime.py).")
 
 
 def get(key: str):

@@ -43,7 +43,12 @@ boundary — instrumented jitted callables — since there is no CUPTI:
   framework flips bytes in the numpy HOST copy it just made, proving the
   host tier's demotion-time CRC32s catch DRAM-resident damage on
   promotion (and, via the handed-down disk metadata, after a host→disk
-  cascade).
+  cascade),
+  ``"task_cancel"`` raises :class:`TaskCancelled` — the tenant-kill
+  analogue for the serving runtime: landing it at any instrumented
+  boundary (via the occurrence clock) simulates a client killing its
+  query mid-BUFN / mid-round / mid-spill, and the session must unwind
+  kill-safe exactly as for an external ``ServeRuntime.cancel()``.
 * ``dynamic: true`` re-reads the file when its mtime changes, matching
   the injector's ``dynamicReconfig`` thread without needing one.
 
@@ -163,6 +168,23 @@ def _raise_host_corrupt(name: str):
     raise HostCorruptionError(f"injected host-tier corruption at {name}")
 
 
+class TaskCancelled(RuntimeError):
+    """Injected tenant kill (kind ``"task_cancel"``).
+
+    Raised at any instrumented boundary — the occurrence clock lands it
+    mid-BUFN, mid-shuffle-round, or mid-spill deterministically.  The
+    serving runtime (``serve/runtime.py``) treats it exactly like an
+    external ``ServeRuntime.cancel()`` arriving at that boundary: the
+    session unwinds kill-safe (arena drained, spill files deleted,
+    plan-cache pins released) and reports itself cancelled, so chaos
+    trials can resubmit the tenant and compare against the fault-free
+    baseline."""
+
+
+def _raise_task_cancel(name: str):
+    raise TaskCancelled(f"injected task cancel at {name}")
+
+
 # The registry of injectable fault flavors: kind -> raiser.  graftlint's
 # GL006 keeps this in sync with every use site statically — a kind used
 # in a config dict but missing here would otherwise only fail when its
@@ -179,6 +201,7 @@ FAULT_KINDS = {
     "shuffle_io": _raise_shuffle_io,
     "spill_corrupt": _raise_spill_corrupt,
     "host_corrupt": _raise_host_corrupt,
+    "task_cancel": _raise_task_cancel,
 }
 
 

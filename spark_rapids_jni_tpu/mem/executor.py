@@ -16,6 +16,7 @@ same contract a first-class, testable piece of the framework:
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from typing import Callable, Optional
 
@@ -191,6 +192,7 @@ def run_with_retry(
     make_spillable: Optional[Callable[[], None]] = None,
     split: Optional[Callable[[], None]] = None,
     max_retries: int = 8,
+    cancel_check: Optional[Callable[[], None]] = None,
 ):
     """Execute ``step()`` under the reference's rollback ladder.
 
@@ -216,6 +218,10 @@ def run_with_retry(
     Real device OOMs (XLA RESOURCE_EXHAUSTED) are translated into the
     same ladder via :func:`translate_device_oom`.
 
+    ``cancel_check`` (the serving runtime's kill hook) runs before every
+    attempt; whatever it raises aborts the ladder immediately, so a
+    tenant killed mid-retry never parks again on a dead task.
+
     Raises the last error when the ladder is exhausted.
     """
     step = translate_device_oom(step)
@@ -234,6 +240,8 @@ def run_with_retry(
 
     last = None
     for _ in range(max_retries):
+        if cancel_check is not None:
+            cancel_check()
         try:
             result = step()
             if last is not None and RmmSpark._adaptor is not None:
@@ -285,6 +293,28 @@ def run_with_retry(
             else:
                 raise last
     raise last
+
+
+@contextlib.contextmanager
+def borrowed_task(task_id: int, shuffle: bool = False):
+    """Register the calling thread as a pool thread working for
+    ``task_id`` for the duration of the block — the serving runtime's
+    shared drain lane brackets each shuffle round with this so the lane
+    thread's arena charges are attributed (and deadlock-scanned) under
+    the tenant that owns the round.  ``shuffle=True`` grants the
+    reference's shuffle-thread priority (outranks every task thread in
+    victim selection)."""
+    if shuffle:
+        RmmSpark.shuffle_thread_working_on_tasks([task_id])
+    else:
+        RmmSpark.pool_thread_working_on_tasks([task_id])
+    prev = getattr(_task_tls, "task_id", None)
+    _task_tls.task_id = task_id
+    try:
+        yield
+    finally:
+        _task_tls.task_id = prev
+        RmmSpark.pool_thread_finished_for_tasks([task_id])
 
 
 class Spillable(spill_mod.SpillableHandle):

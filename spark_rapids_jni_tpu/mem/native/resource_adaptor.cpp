@@ -82,6 +82,12 @@ struct ThreadInfo {
   int blocked_pool = 0;          // which pool the thread last blocked on
   std::condition_variable cv;
   Clock::time_point blocked_since{};
+  // start of the current FAILURE STREAK, cleared only by an alloc
+  // success.  blocked_since resets on every wake->fail->re-block cycle,
+  // so a churning peer (tiny alloc/free loop) would keep a starved
+  // thread's continuous-block clock near zero forever; the stall breaker
+  // keys off this instead.
+  Clock::time_point stall_since{};
 
   long priority() const {
     // higher value = higher priority; shuffle outranks everything, then the
@@ -191,10 +197,12 @@ class ResourceAdaptor {
       auto tt = task_threads_.find(task_id);
       if (tt != task_threads_.end()) tt->second.erase(tid);
     }
-    if (it->second.tasks.empty() && !it->second.is_pool) {
-      threads_.erase(it);
-    }
+    if (it->second.tasks.empty()) release_thread_locked(it);
     wake_next_highest_priority_blocked(/*from_free=*/true);
+    // the released thread may have been the only runner keeping the
+    // remaining (all-blocked) set out of deadlock: re-scan now instead
+    // of waiting a watchdog period
+    check_and_update_for_bufn_locked();
   }
 
   void task_done(long task_id) {
@@ -205,12 +213,12 @@ class ResourceAdaptor {
         auto it = threads_.find(tid);
         if (it == threads_.end()) continue;
         it->second.tasks.erase(task_id);
-        if (it->second.tasks.empty() && !it->second.is_pool)
-          threads_.erase(it);
+        if (it->second.tasks.empty()) release_thread_locked(it);
       }
       task_threads_.erase(tt);
     }
     wake_next_highest_priority_blocked(/*from_free=*/true);
+    check_and_update_for_bufn_locked();
   }
 
   // ---- injection ------------------------------------------------------
@@ -350,12 +358,20 @@ class ResourceAdaptor {
     auto it = threads_.find(tid);
     if (it == threads_.end()) return UNKNOWN_THREAD;
     ThreadInfo& t = it->second;
+    if (t.state == State::REMOVE_THROW) {  // task released before the park
+      threads_.erase(it);
+      return UNKNOWN_THREAD;
+    }
     if (t.state == State::BUFN_WAIT) {
       set_state(t, State::BUFN, "bufn_wait");
       t.blocked_since = Clock::now();
       check_and_update_for_bufn_locked();
       while (t.state == State::BUFN) t.cv.wait(lk);
       add_block_time(t);
+      if (t.state == State::REMOVE_THROW) {  // task released mid-park
+        threads_.erase(it);
+        return UNKNOWN_THREAD;
+      }
       if (t.state == State::BUFN_THROW) {  // re-escalated while waiting
         set_state(t, State::BUFN_WAIT, "rethrow");
         return RETRY_OOM;
@@ -406,8 +422,78 @@ class ResourceAdaptor {
     return max_allocated_[pool];
   }
 
+  // Serving-mode deadlock breaker: the global scan above only acts when
+  // EVERY task thread is blocked, so a BUFN/BLOCKED cycle among a subset
+  // of tenants starves indefinitely behind any tenant that keeps running.
+  // Treat threads continuously blocked past stall_ms as that smaller
+  // deadlock: roll back the lowest-priority stalled BLOCKED thread, or —
+  // when every stalled thread is already BUFN — split the
+  // highest-priority one.  A false positive (the thread would have been
+  // woken eventually) is benign: RETRY_OOM re-enters the retry ladder.
+  int break_stalled_cycles(long stall_ms) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto now = Clock::now();
+    auto stalled = [&](const ThreadInfo& t) {
+      if (t.stall_since == Clock::time_point{}) return false;
+      return std::chrono::duration_cast<std::chrono::milliseconds>(
+                 now - t.stall_since)
+                 .count() >= stall_ms;
+    };
+    ThreadInfo* victim = nullptr;
+    for (auto& [id, t] : threads_) {
+      if (t.state != State::BLOCKED || t.tasks.empty() || !stalled(t))
+        continue;
+      if (!victim || t.priority() < victim->priority()) victim = &t;
+    }
+    if (victim) {
+      bump_metric(*victim, &TaskMetrics::num_retry);
+      set_state(*victim, State::BUFN_THROW, "stall_break");
+      victim->cv.notify_all();
+      return 1;
+    }
+    ThreadInfo* chosen = nullptr;
+    for (auto& [id, t] : threads_) {
+      if (t.state != State::BUFN || t.tasks.empty() || !stalled(t)) continue;
+      if (!chosen || t.priority() > chosen->priority()) chosen = &t;
+    }
+    if (chosen) {
+      set_state(*chosen, State::SPLIT_THROW, "stall_split");
+      chosen->cv.notify_all();
+      return 1;
+    }
+    return 0;
+  }
+
  private:
   // ---- state helpers (mu_ held) --------------------------------------
+  // A thread whose LAST task was released while it is parked (or between
+  // a throw and its park) cannot simply be erased: destroying the cv
+  // under a live waiter is UB, and the waiter would otherwise sleep
+  // until the 10s watchdog join timeout.  Wake it with REMOVE_THROW so
+  // it fails out of pre_alloc / block_thread_until_ready with
+  // UNKNOWN_THREAD and erases itself.  Threads not parked are erased
+  // (dedicated) or kept idle (pool) exactly as before.
+  void release_thread_locked(std::map<long, ThreadInfo>::iterator it) {
+    ThreadInfo& t = it->second;
+    switch (t.state) {
+      case State::BLOCKED:
+      case State::BUFN:
+      case State::BUFN_THROW:
+      case State::BUFN_WAIT:
+      case State::SPLIT_THROW:
+        set_state(t, State::REMOVE_THROW, "task_released");
+        t.cv.notify_all();
+        return;
+      case State::REMOVE_THROW:
+        // already failed out (or never re-entered): safe to drop now
+        threads_.erase(it);
+        return;
+      default:
+        break;
+    }
+    if (!t.is_pool) threads_.erase(it);
+  }
+
   void set_state(ThreadInfo& t, State s, const char* why) {
     log_op("transition", t.thread_id, -1, t.state, s, why);
     t.state = s;
@@ -494,6 +580,7 @@ class ResourceAdaptor {
   void post_alloc_success_locked(ThreadInfo& t) {
     set_state(t, State::RUNNING, "alloc_ok");
     t.retry_count = 0;
+    t.stall_since = Clock::time_point{};  // the failure streak is over
     wake_next_highest_priority_blocked(/*from_free=*/false);
   }
 
@@ -514,6 +601,7 @@ class ResourceAdaptor {
     set_state(t, State::BLOCKED,
               pool == POOL_HOST ? "host_alloc_failed" : "alloc_failed");
     t.blocked_since = Clock::now();
+    if (t.stall_since == Clock::time_point{}) t.stall_since = t.blocked_since;
     check_and_update_for_bufn_locked();
     return true;
   }
@@ -686,6 +774,9 @@ int tra_get_state_of(void* h, long tid) {
 }
 int tra_check_and_break_deadlocks(void* h) {
   return static_cast<ResourceAdaptor*>(h)->check_and_break_deadlocks();
+}
+int tra_break_stalled_cycles(void* h, long stall_ms) {
+  return static_cast<ResourceAdaptor*>(h)->break_stalled_cycles(stall_ms);
 }
 void tra_force_retry_oom(void* h, long tid, int count, int skip) {
   static_cast<ResourceAdaptor*>(h)->force_retry_oom(tid, count, skip);

@@ -60,6 +60,15 @@ class InjectedException(RuntimeError):
     """Test-injected failure (forceCudfException equivalent)."""
 
 
+class UnknownThreadError(RuntimeError):
+    """The calling thread is not (or no longer) registered with the
+    adaptor.  The serving runtime relies on this as its kill signal: when
+    ``task_done`` releases a task whose threads are still parked in the
+    arena, those threads are woken with REMOVE_THROW and their next
+    protocol call fails with this error instead of wedging until the
+    watchdog ``join`` timeout."""
+
+
 class ThreadState(enum.IntEnum):
     """Mirror of the native enum (reference RmmSparkThreadState.java)."""
 
@@ -94,8 +103,9 @@ def _raise_for(code: int, cpu: bool = False):
         raise OOMError()
     if code == _INJECTED:
         raise InjectedException()
-    raise RuntimeError(f"thread not registered with the resource adaptor "
-                       f"(native code {code})")
+    raise UnknownThreadError(
+        f"thread not registered with the resource adaptor "
+        f"(native code {code})")
 
 
 # ---------------------------------------------------------------------------
@@ -165,6 +175,9 @@ def _load_lib() -> ctypes.CDLL:
         lib.tra_get_state_of.argtypes = [ctypes.c_void_p, ctypes.c_long]
         lib.tra_check_and_break_deadlocks.restype = ctypes.c_int
         lib.tra_check_and_break_deadlocks.argtypes = [ctypes.c_void_p]
+        lib.tra_break_stalled_cycles.restype = ctypes.c_int
+        lib.tra_break_stalled_cycles.argtypes = [ctypes.c_void_p,
+                                                 ctypes.c_long]
         for f in ("tra_force_retry_oom", "tra_force_split_retry_oom",
                   "tra_force_cudf_exception"):
             fn = getattr(lib, f)
@@ -250,6 +263,10 @@ class SparkResourceAdaptor:
                                         ctypes.c_long(host_pool_bytes))
         self._lib.tra_set_blocked_callback(self._h, _is_blocked_cb)
         self._closed = threading.Event()
+        # serving mode: > 0 makes the watchdog ALSO break cycles that are
+        # stalled past this bound even while other tenants keep running
+        # (the global scan requires every task thread blocked)
+        self._stall_break_ms = 0.0
         self._watchdog = threading.Thread(
             target=self._watch, args=(poll_ms / 1000.0,),
             name="tra-watchdog", daemon=True)
@@ -259,6 +276,10 @@ class SparkResourceAdaptor:
         while not self._closed.wait(period_s):
             try:
                 self._lib.tra_check_and_break_deadlocks(self._h)
+                stall_ms = self._stall_break_ms
+                if stall_ms > 0:
+                    self._lib.tra_break_stalled_cycles(
+                        self._h, ctypes.c_long(int(stall_ms)))
             except Exception:
                 return
 
@@ -347,6 +368,20 @@ class SparkResourceAdaptor:
 
     def check_and_break_deadlocks(self) -> bool:
         return bool(self._lib.tra_check_and_break_deadlocks(self._h))
+
+    def set_stall_break_ms(self, stall_ms: float):
+        """Enable (``> 0``) or disable (``0``) the watchdog's cross-tenant
+        stall breaker; see ``break_stalled_cycles``."""
+        self._stall_break_ms = float(stall_ms)
+
+    def break_stalled_cycles(self, stall_ms: float) -> bool:
+        """Break a deadlock cycle confined to a SUBSET of tenants: among
+        threads continuously blocked past ``stall_ms``, roll back the
+        lowest-priority BLOCKED one (RetryOOM), or split the
+        highest-priority BUFN one when none are plain BLOCKED.  Returns
+        True when a thread was broken."""
+        return bool(self._lib.tra_break_stalled_cycles(
+            self._h, ctypes.c_long(int(stall_ms))))
 
     # -- injection ------------------------------------------------------
     def force_retry_oom(self, tid=None, num_ooms=1, skip_count=0):
@@ -579,6 +614,13 @@ class RmmSpark:
     @classmethod
     def get_state_of(cls, tid: int) -> ThreadState:
         return cls._a().get_state_of(tid)
+
+    @classmethod
+    def set_stall_break_ms(cls, stall_ms: float):
+        """Arm the watchdog's cross-tenant stall breaker on every
+        installed arena (serving mode; 0 disables)."""
+        for a in cls._each():
+            a.set_stall_break_ms(stall_ms)
 
     # spill metrics (tier transitions recorded by mem/spill.py) ---------
     @classmethod

@@ -697,6 +697,12 @@ class SpillableStore:
     def __init__(self):
         self._lock = threading.Lock()
         self._handles: Dict[int, SpillableHandle] = {}
+        # serving-mode fairness: task_id -> eviction priority.  Higher
+        # keeps residency longer; unset tasks sit at 0.0.  The serving
+        # runtime assigns by admission order (earlier-admitted tenants
+        # outrank later ones), so a tenant storm evicts the newcomers'
+        # batches before the established tenants'.
+        self._task_prio: Dict[int, float] = {}
 
     def register(self, handle: SpillableHandle):
         with self._lock:
@@ -705,6 +711,18 @@ class SpillableStore:
     def unregister(self, handle: SpillableHandle):
         with self._lock:
             self._handles.pop(id(handle), None)
+
+    def set_task_priority(self, task_id: int, priority: float):
+        with self._lock:
+            self._task_prio[task_id] = float(priority)
+
+    def clear_task_priority(self, task_id: int):
+        with self._lock:
+            self._task_prio.pop(task_id, None)
+
+    def task_priority(self, task_id) -> float:
+        with self._lock:
+            return self._task_prio.get(task_id, 0.0)
 
     def handles(self) -> List[SpillableHandle]:
         with self._lock:
@@ -723,9 +741,11 @@ class SpillableStore:
         Priority is task-aware: OTHER tasks' idle batches go first; the
         requesting task's own unpinned batches go last (its pinned inputs
         are skipped entirely, as are handles busy in a concurrent
-        ``get()``)."""
+        ``get()``).  Among other tasks, lower ``set_task_priority`` goes
+        first (the serving runtime's fair-eviction ranking); LRU breaks
+        ties within a priority band."""
         snap = [h for h in self.handles() if h.tier == "device"]
-        snap.sort(key=lambda h: h.last_use)
+        snap.sort(key=lambda h: (self.task_priority(h.task_id), h.last_use))
         if requesting_task_id is None:
             ordered = snap
         else:

@@ -34,6 +34,11 @@ class PlanCache:
         self._maxsize = maxsize
         self._lock = threading.Lock()
         self._entries: OrderedDict = OrderedDict()
+        # key -> set of owners holding the entry resident (serving
+        # tenants pin plans they are executing; pinned entries are
+        # skipped by LRU eviction so one tenant's compile storm cannot
+        # evict a plan another tenant is mid-flight on)
+        self._pins: dict = {}
         self.hits = 0
         self.misses = 0
         self.evictions = 0
@@ -59,8 +64,42 @@ class PlanCache:
             self._entries.move_to_end(key)
             cap = max(self._capacity(), 1)
             while len(self._entries) > cap:
-                self._entries.popitem(last=False)
+                victim = next((k for k in self._entries
+                               if k not in self._pins), None)
+                if victim is None:
+                    break  # everything pinned: overflow beats breaking a tenant
+                del self._entries[victim]
                 self.evictions += 1
+
+    def pin(self, key, owner) -> None:
+        """Hold ``key`` resident on behalf of ``owner`` (any hashable —
+        the serving runtime uses its session id).  Pinning a key not in
+        the cache is allowed: the pin applies when the plan lands."""
+        with self._lock:
+            self._pins.setdefault(key, set()).add(owner)
+
+    def unpin(self, key, owner) -> None:
+        with self._lock:
+            owners = self._pins.get(key)
+            if owners is None:
+                return
+            owners.discard(owner)
+            if not owners:
+                del self._pins[key]
+
+    def release_owner(self, owner) -> None:
+        """Drop every pin ``owner`` holds — the kill-safe unwind path: a
+        cancelled tenant must not leave plans unevictable."""
+        with self._lock:
+            for key in list(self._pins):
+                owners = self._pins[key]
+                owners.discard(owner)
+                if not owners:
+                    del self._pins[key]
+
+    def pinned(self, key) -> bool:
+        with self._lock:
+            return key in self._pins
 
     def __len__(self) -> int:
         with self._lock:
@@ -74,11 +113,13 @@ class PlanCache:
                 "evictions": self.evictions,
                 "size": len(self._entries),
                 "capacity": self._capacity(),
+                "pinned": len(self._pins),
             }
 
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
+            self._pins.clear()
 
 
 _cache = PlanCache()

@@ -1,0 +1,23 @@
+"""Multi-tenant serving runtime over the unified memory arena.
+
+See :mod:`~spark_rapids_jni_tpu.serve.runtime` for the admission /
+run / cancel lifecycle and the kill-safety contract.
+"""
+
+from .runtime import (
+    AdmissionTicket,
+    QueryCancelled,
+    QueryTimeout,
+    ServeError,
+    ServeRuntime,
+    TenantSession,
+)
+
+__all__ = [
+    "AdmissionTicket",
+    "QueryCancelled",
+    "QueryTimeout",
+    "ServeError",
+    "ServeRuntime",
+    "TenantSession",
+]

@@ -1,0 +1,452 @@
+"""Long-running multi-tenant executor service over the unified arena.
+
+The reference repo schedules ONE query at a time through the
+SparkResourceAdaptor's retry/block/split state machine; a serving
+deployment ("Accelerating Presto with GPUs" shape — PAPERS.md) runs many
+interactive queries over one shared accelerator.  This runtime stacks
+that workload on the existing machinery rather than beside it:
+
+* **Admission** — a submitted query first waits for one of
+  ``serve_max_concurrent`` slots (the wait is bracketed with
+  :class:`~spark_rapids_jni_tpu.mem.rmm_spark.ThreadStateRegistry.
+  blocked_section`, so the native deadlock scan counts queued tenants as
+  blocked), then proves its ESTIMATED footprint fits by charging it
+  against the unified arena through the standard
+  :func:`~spark_rapids_jni_tpu.mem.executor.run_with_retry` ladder: a
+  can't-fit reservation parks in BUFN, spills idle tenants' handles via
+  the cross-task ``SpillableStore`` LRU, or splits (halving the granted
+  footprint, surfaced as ``session.granted_bytes``).  The probe charge
+  is returned once admission succeeds — the query's own charges account
+  the actual residency.
+* **Isolation & fairness** — each session runs in its own worker thread
+  under a per-tenant :class:`~spark_rapids_jni_tpu.mem.executor.
+  TaskContext`; the spill store ranks tenants by admission order
+  (earlier admitted = higher eviction priority), so a newcomer's
+  pressure evicts the newest tenants' batches first.  The
+  :class:`~spark_rapids_jni_tpu.plan.cache.PlanCache` is shared across
+  tenants, with per-session pins (``session.pin_plan``) released on any
+  exit path.
+* **Cross-tenant drain overlap** — the runtime installs a shared
+  shuffle drain lane (:func:`~spark_rapids_jni_tpu.shuffle.service.
+  install_drain_lane`): round k of tenant B's exchange runs on the lane
+  thread while tenant A's worker computes its round-(k+1) map, the
+  double-buffered drain.
+* **Deadlock breaking across tenants** — the global scan only fires
+  when EVERY task thread is blocked, so an A↔B BUFN cycle starves
+  behind any third tenant that keeps running; constructing the runtime
+  arms the watchdog's stall breaker (``serve_stall_break_ms``), which
+  rolls back the lowest-priority thread continuously blocked past the
+  bound.
+* **Kill-safe cancellation** — :meth:`ServeRuntime.cancel` (or a query
+  timeout, or an injected ``task_cancel`` fault) is honored at ANY
+  point: waiting in the admission queue, mid-retry-ladder, mid-shuffle
+  round, or parked in BUFN.  The kill path releases the task
+  (``RmmSpark.task_done``), which wakes threads parked in the arena
+  with REMOVE_THROW → :class:`~spark_rapids_jni_tpu.mem.rmm_spark.
+  UnknownThreadError`; the worker unwinds through ``TaskContext.
+  __exit__`` (adopted spill handles closed → disk files deleted, arena
+  charges drained), drops its plan-cache pins, clears its eviction
+  priority, and frees its admission slot.  tools/chaos.py's ``serving``
+  scenario asserts the post-kill invariants (drained arenas, empty
+  store, no orphan spill files, no wedged threads) under every fault
+  kind.
+
+Timeouts re-admit: a query killed by its own ``timeout_s`` backs off
+(``serve_backoff_ms``, doubled per attempt) and is re-admitted up to
+``serve_max_readmissions`` times before ``QueryTimeout`` surfaces.
+External cancels never re-admit.
+"""
+
+from __future__ import annotations
+
+import inspect
+import itertools
+import threading
+import time
+from concurrent import futures
+from typing import Callable, Optional
+
+from .. import config, faultinj
+from ..mem.executor import TaskContext, borrowed_task, run_with_retry
+from ..mem import spill as spill_mod
+from ..mem.rmm_spark import RmmSpark, ThreadStateRegistry, UnknownThreadError
+from ..plan.cache import get_plan_cache
+from ..shuffle import service as shuffle_service
+
+
+class ServeError(RuntimeError):
+    """Base class of the serving runtime's failures."""
+
+
+class QueryCancelled(ServeError):
+    """The session was killed (external cancel, shutdown, or timeout
+    kill) and has unwound; ``reason`` says which."""
+
+    def __init__(self, message: str, reason: str = "cancelled"):
+        super().__init__(message)
+        self.reason = reason
+
+
+class QueryTimeout(ServeError):
+    """Admission or execution exceeded its deadline (after bounded
+    re-admission for execution timeouts)."""
+
+
+# instrumented kill boundaries: chaos lands `task_cancel` here (plus at
+# every pre-existing probe the query crosses — spill_io_*, shuffle_io_round)
+_admit_probe = faultinj.instrument(lambda: None, "serve_admit")
+_step_probe = faultinj.instrument(lambda: None, "serve_step")
+
+_MIN_GRANT = 1 << 16  # reservation split floor: 64 KiB
+_ADMIT_TICK_S = 0.05  # cancellation latency while queued
+
+
+class AdmissionTicket:
+    """One admission slot, held from admission until the session's
+    unwind.  Exactly-once release discipline — graftlint GL011 flags
+    acquisition sites without a matching release/close path."""
+
+    def __init__(self, slots: threading.Semaphore, session: "TenantSession"):
+        self._slots = slots
+        self.session = session
+        self._released = False
+        self._lock = threading.Lock()
+
+    def release(self):
+        with self._lock:
+            if self._released:
+                return
+            self._released = True
+        self._slots.release()
+
+    close = release
+
+
+class TenantSession:
+    """Handle for one submitted query.
+
+    Status walks ``queued → admitted → running → done`` on the happy
+    path, ending in ``cancelled`` / ``timeout`` / ``failed`` otherwise.
+    ``result()`` blocks for the outcome and re-raises the terminal
+    error; ``cancel()`` / ``close()`` kill at any point.
+    """
+
+    def __init__(self, runtime: "ServeRuntime", session_id: int,
+                 task_id: int, tenant, query_fn: Callable,
+                 est_bytes: int, timeout_s: Optional[float]):
+        self._runtime = runtime
+        self.session_id = session_id
+        self.task_id = task_id
+        self.tenant = tenant if tenant is not None else f"tenant-{session_id}"
+        self.query_fn = query_fn
+        self.est_bytes = int(est_bytes or 0)
+        self.timeout_s = timeout_s
+        self.pin_owner = ("serve", session_id)
+        self.status = "queued"
+        self.result_value = None
+        self.error: Optional[BaseException] = None
+        self.granted_bytes: Optional[int] = None
+        self.attempts = 0
+        self._cancelled = threading.Event()
+        self._cancel_reason: Optional[str] = None
+        self._done = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- caller API -----------------------------------------------------
+    def cancel(self):
+        self._runtime.cancel(self)
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"session {self.session_id} still {self.status} "
+                f"after {timeout}s")
+        if self.error is not None:
+            raise self.error
+        return self.result_value
+
+    def close(self, timeout: Optional[float] = 10.0):
+        """Idempotent terminal release: cancel if still in flight and
+        wait for the unwind."""
+        if not self._done.is_set():
+            self._runtime.cancel(self)
+        self._done.wait(timeout)
+
+    def pin_plan(self, key):
+        """Pin a shared plan-cache entry for this session's lifetime;
+        every exit path (done/cancel/kill) releases the pin."""
+        get_plan_cache().pin(key, self.pin_owner)
+
+    # -- worker-side helpers --------------------------------------------
+    def _check_cancelled(self):
+        if self._cancelled.is_set():
+            reason = self._cancel_reason or "cancelled"
+            raise QueryCancelled(
+                f"session {self.session_id} cancelled ({reason})",
+                reason=reason)
+
+    def _rearm(self):
+        # fresh Event: a stale timeout-kill racing in after re-admission
+        # must not cancel the new attempt
+        self._cancelled = threading.Event()
+        self._cancel_reason = None
+
+
+class _DrainLane:
+    """The shared shuffle drain thread (one per runtime).  Each round is
+    bracketed with :func:`~spark_rapids_jni_tpu.mem.executor.
+    borrowed_task` so the lane thread's arena charges — and its place in
+    the deadlock scan — belong to the tenant that owns the round, at
+    shuffle-thread priority (matching the reference's shuffle threads
+    outranking task threads)."""
+
+    def __init__(self):
+        self._ex = futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="serve-drain")
+
+    def submit(self, task_id, fn):
+        def run():
+            if task_id is None:
+                return fn()
+            with borrowed_task(task_id, shuffle=True):
+                return fn()
+        return self._ex.submit(run)
+
+    def close(self):
+        self._ex.shutdown(wait=True, cancel_futures=True)
+
+
+class ServeRuntime:
+    """The long-running executor service: ``submit`` → session handle,
+    ``cancel`` at any point, ``shutdown`` to drain everything."""
+
+    def __init__(self, max_concurrent: Optional[int] = None,
+                 task_id_base: int = 10_000):
+        if max_concurrent is None:
+            max_concurrent = int(config.get("serve_max_concurrent"))
+        self._max_concurrent = int(max_concurrent)
+        self._slots = threading.Semaphore(self._max_concurrent)
+        self._task_id_base = int(task_id_base)
+        self._ids = itertools.count(1)
+        self._admit_seq = itertools.count(1)
+        self._lock = threading.Lock()
+        self._sessions: list = []
+        self._shutdown = False
+        # arm the watchdog's cross-tenant stall breaker (no-op with no
+        # adaptor installed; 0 disables)
+        self._stall_ms = float(config.get("serve_stall_break_ms"))
+        if self._stall_ms > 0:
+            RmmSpark.set_stall_break_ms(self._stall_ms)
+        self._lane = _DrainLane()
+        shuffle_service.install_drain_lane(self._lane)
+
+    # -- public API -----------------------------------------------------
+    def submit(self, query_fn: Callable, est_bytes: int = 0, tenant=None,
+               timeout_s: Optional[float] = None) -> TenantSession:
+        """Queue ``query_fn`` for admission and return its session.
+
+        ``query_fn(ctx)`` (or ``query_fn(ctx, session)``) runs on a
+        dedicated worker thread inside the session's ``TaskContext``;
+        ``est_bytes`` is the footprint admission charges through the
+        retry ladder; ``timeout_s`` kills-and-re-admits per the
+        ``serve_max_readmissions`` budget."""
+        if self._shutdown:
+            raise ServeError("runtime is shut down")
+        sid = next(self._ids)
+        sess = TenantSession(self, sid, self._task_id_base + sid, tenant,
+                             query_fn, est_bytes, timeout_s)
+        with self._lock:
+            self._sessions.append(sess)
+        t = threading.Thread(target=self._run_session, args=(sess,),
+                             name=f"serve-{sess.task_id}", daemon=True)
+        sess._thread = t
+        t.start()
+        return sess
+
+    def cancel(self, sess: TenantSession, reason: str = "cancelled"):
+        """Kill-safe cancellation, honored wherever the session is:
+        queued (next admission tick), mid-ladder (``cancel_check``),
+        parked in BLOCKED/BUFN (``task_done`` wakes the thread with
+        REMOVE_THROW → UnknownThreadError), or mid-shuffle-round (the
+        lane thread's charges fail the same way)."""
+        if sess._cancel_reason is None:
+            sess._cancel_reason = reason
+        sess._cancelled.set()
+        # releasing the task is what reaches threads parked inside the
+        # native arena; it also re-runs the deadlock scan for survivors
+        RmmSpark.task_done(sess.task_id)
+
+    def sessions(self) -> list:
+        with self._lock:
+            return list(self._sessions)
+
+    def shutdown(self, timeout_s: float = 10.0) -> bool:
+        """Cancel every live session, drain the lane, disarm the stall
+        breaker.  Returns True when every worker unwound in time."""
+        self._shutdown = True
+        with self._lock:
+            sessions = list(self._sessions)
+        for s in sessions:
+            if not s._done.is_set():
+                self.cancel(s, reason="shutdown")
+        deadline = time.monotonic() + timeout_s
+        for s in sessions:
+            s._done.wait(max(0.0, deadline - time.monotonic()))
+        shuffle_service.clear_drain_lane()
+        self._lane.close()
+        if self._stall_ms > 0:
+            RmmSpark.set_stall_break_ms(0.0)
+        ok = True
+        for s in sessions:
+            if s._thread is not None:
+                s._thread.join(max(0.0, deadline - time.monotonic()) + 1.0)
+                ok = ok and not s._thread.is_alive()
+        return ok
+
+    # -- worker ---------------------------------------------------------
+    def _run_session(self, sess: TenantSession):
+        try:
+            self._session_loop(sess)
+        finally:
+            sess._done.set()
+
+    def _session_loop(self, sess: TenantSession):
+        max_readmissions = int(config.get("serve_max_readmissions"))
+        backoff_s = float(config.get("serve_backoff_ms")) / 1000.0
+        readmissions = 0
+        while True:
+            sess.attempts += 1
+            try:
+                self._run_once(sess)
+                return
+            except (QueryCancelled, UnknownThreadError) as e:
+                reason = sess._cancel_reason or "cancelled"
+                if reason == "timeout" and readmissions < max_readmissions:
+                    # bounded re-admission: back off and try again with a
+                    # fresh kill flag and a fresh deadline
+                    readmissions += 1
+                    sess._rearm()
+                    sess.status = "queued"
+                    time.sleep(backoff_s * (2 ** (readmissions - 1)))
+                    continue
+                if reason == "timeout":
+                    sess.status = "timeout"
+                    sess.error = QueryTimeout(
+                        f"session {sess.session_id} exceeded "
+                        f"{sess.timeout_s}s ({readmissions} re-admissions)")
+                else:
+                    sess.status = "cancelled"
+                    sess.error = (e if isinstance(e, QueryCancelled)
+                                  else QueryCancelled(str(e), reason=reason))
+                return
+            except faultinj.TaskCancelled as e:
+                # injected tenant kill: by contract identical to an
+                # external cancel landing at that boundary
+                sess.status = "cancelled"
+                sess.error = e
+                return
+            except QueryTimeout as e:  # admission queue wait expired
+                sess.status = "timeout"
+                sess.error = e
+                return
+            except BaseException as e:
+                sess.status = "failed"
+                sess.error = e
+                return
+
+    def _run_once(self, sess: TenantSession):
+        sess._check_cancelled()
+        ticket = self._admit(sess)
+        fw = spill_mod.get_framework()
+        cache = get_plan_cache()
+        timer: Optional[threading.Timer] = None
+        try:
+            if sess.timeout_s:
+                timer = threading.Timer(
+                    sess.timeout_s, self.cancel, args=(sess,),
+                    kwargs={"reason": "timeout"})
+                timer.daemon = True
+                timer.start()
+            with TaskContext(sess.task_id) as ctx:
+                if fw is not None:
+                    # fair eviction priority: earlier-admitted tenants
+                    # keep residency longer
+                    fw.store.set_task_priority(
+                        sess.task_id, -float(next(self._admit_seq)))
+                self._reserve(sess, ctx)
+                sess.status = "running"
+
+                def step():
+                    _step_probe()
+                    sess._check_cancelled()
+                    return self._invoke(sess, ctx)
+
+                out = run_with_retry(step,
+                                     cancel_check=sess._check_cancelled)
+                sess.result_value = out
+            sess.status = "done"
+        finally:
+            # the kill-safe unwind, shared by every exit path: by here
+            # TaskContext.__exit__ already closed adopted spill handles
+            # (disk files deleted) and drained the arena charges
+            if timer is not None:
+                timer.cancel()
+            cache.release_owner(sess.pin_owner)
+            if fw is not None:
+                fw.store.clear_task_priority(sess.task_id)
+            RmmSpark.task_done(sess.task_id)
+            ticket.release()
+
+    @staticmethod
+    def _invoke(sess: TenantSession, ctx: TaskContext):
+        try:
+            n_params = len(inspect.signature(sess.query_fn).parameters)
+        except (TypeError, ValueError):
+            n_params = 1
+        if n_params >= 2:
+            return sess.query_fn(ctx, sess)
+        return sess.query_fn(ctx)
+
+    def _admit(self, sess: TenantSession) -> AdmissionTicket:
+        _admit_probe()  # chaos boundary: a kill while still queued
+        timeout_s = float(config.get("serve_admit_timeout_s"))
+        deadline = time.monotonic() + timeout_s
+        while True:
+            sess._check_cancelled()
+            # the queue wait is a HOST-side block: bracket it so the
+            # native deadlock scan counts queued tenants as blocked
+            with ThreadStateRegistry.blocked_section():
+                got = self._slots.acquire(timeout=_ADMIT_TICK_S)
+            if got:
+                sess.status = "admitted"
+                return AdmissionTicket(self._slots, sess)
+            if time.monotonic() >= deadline:
+                raise QueryTimeout(
+                    f"session {sess.session_id}: admission queue wait "
+                    f"exceeded {timeout_s:g}s")
+
+    def _reserve(self, sess: TenantSession, ctx: TaskContext):
+        """Prove the estimated footprint fits NOW, through the full
+        ladder: park in BUFN, spill idle tenants, or split the
+        reservation (halving ``granted_bytes``).  The probe charge is
+        returned on success — actual residency is accounted by the
+        query's own charges."""
+        est = sess.est_bytes
+        if est <= 0:
+            sess.granted_bytes = 0
+            return
+        granted = [est]
+
+        def probe():
+            return ctx.charge(granted[0])
+
+        def split():
+            granted[0] = max(granted[0] // 2, _MIN_GRANT)
+
+        n = run_with_retry(probe, split=split, max_retries=16,
+                           cancel_check=sess._check_cancelled)
+        ctx.release(n)
+        sess.granted_bytes = granted[0]

@@ -77,6 +77,29 @@ _io_probe = faultinj.instrument(lambda: None, "shuffle_io_round")
 
 _IO_RETRIES = 3  # bounded re-drives of one round on transport faults
 
+# Serving-mode shared drain lane (installed by serve/runtime.py): when
+# present, exchange() pipelines round r's all_to_all on the lane thread
+# while the calling thread wraps round r-1's chunk — and because ONE
+# lane is shared by every tenant, tenant A's round-(k+1) map/chunk work
+# overlaps tenant B's round-k all-to-all (the double-buffered
+# cross-tenant drain).  The lane contract: ``submit(task_id, fn)``
+# returns a Future whose ``result()`` re-raises; task_id attributes the
+# lane thread's arena charges (and deadlock-scan membership) to the
+# tenant that owns the round.
+_drain_lane = [None]
+
+
+def install_drain_lane(lane) -> None:
+    _drain_lane[0] = lane
+
+
+def clear_drain_lane() -> None:
+    _drain_lane[0] = None
+
+
+def get_drain_lane():
+    return _drain_lane[0]
+
 
 @dataclass
 class ShuffleResult:
@@ -426,10 +449,38 @@ class ShuffleService:
         received = 0
         bytes_moved = 0
         residual = -1
+        lane = get_drain_lane()
+        overlapped = 0
+
+        def _rounds():
+            # double-buffer depth 1 on the shared lane: round r+1 is in
+            # flight on the lane thread while round r's result is wrapped
+            # here.  Without a lane (or a single round) run sequentially.
+            nonlocal overlapped
+            if lane is None or plan.rounds <= 1:
+                for r in range(plan.rounds):
+                    yield (r, *self._run_round(drain, map_buf, r))
+                return
+            owner = getattr(ctx, "task_id", None)
+            pending = []
+            try:
+                for r in range(plan.rounds):
+                    pending.append((r, lane.submit(
+                        owner,
+                        lambda rr=r: self._run_round(drain, map_buf, rr))))
+                    if len(pending) == 2:
+                        rr, fut = pending.pop(0)
+                        overlapped += 1
+                        yield (rr, *fut.result())
+                while pending:
+                    rr, fut = pending.pop(0)
+                    yield (rr, *fut.result())
+            finally:
+                for _, fut in pending:  # consumer bailed: drop queued rounds
+                    fut.cancel()
+
         try:
-            for r in range(plan.rounds):
-                out, occ, got_n, residual = self._run_round(
-                    drain, map_buf, r)
+            for r, out, occ, got_n, residual in _rounds():
                 chunk = PartitionBuffer(
                     (out, occ), ctx=ctx, name=f"shuffle{sid}-round{r}",
                     recompute=_lineage(_redrive(r), f"round {r} chunk"))
@@ -481,7 +532,8 @@ class ShuffleService:
             rounds=plan.rounds, capacity=plan.capacity, rows_moved=received,
             bytes_moved=bytes_moved, spilled_bytes=spilled,
             skew_ratio=plan.skew_ratio, oob_rows=oob_total,
-            recovered_partitions=recovered[0])
+            recovered_partitions=recovered[0],
+            rounds_overlapped=overlapped)
 
     def exchange_stream(
         self,

@@ -751,6 +751,71 @@ class TestGL010:
 
 
 # ---------------------------------------------------------------------------
+# GL011 — serve runtime / session leak
+# ---------------------------------------------------------------------------
+
+
+class TestGL011:
+    def test_discarded_runtime_and_session_flagged(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            from spark_rapids_jni_tpu.serve import ServeRuntime
+
+            def fire_and_forget(q):
+                rt = ServeRuntime()
+                rt.submit(q)
+        """}, rules=["GL011"])
+        # the runtime is never shut down AND the session is discarded
+        assert [f.rule for f in res.new] == ["GL011", "GL011"]
+
+    def test_unobserved_session_flagged(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            from spark_rapids_jni_tpu.serve import ServeRuntime
+
+            def wave(q):
+                rt = ServeRuntime()
+                try:
+                    s = rt.submit(q)
+                finally:
+                    rt.shutdown()
+        """}, rules=["GL011"])
+        assert new_rules(res) == [("GL011", "mod.py")]
+
+    def test_result_cancel_store_and_unknown_receiver_clean(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            from spark_rapids_jni_tpu.serve import ServeRuntime
+
+            def wave(q):
+                rt = ServeRuntime()
+                try:
+                    s = rt.submit(q)
+                    return s.result(timeout=30.0)
+                finally:
+                    rt.shutdown()
+
+            def killed(q):
+                rt = ServeRuntime(max_concurrent=1)
+                s = rt.submit(q)
+                rt.cancel(s)          # session passed on: escapes
+                rt.shutdown()
+
+            def other_pools(q, ex, out):
+                ex.submit(q)          # unknown receiver: not a runtime
+                keeper = ServeRuntime()
+                out.append(keeper)    # escapes via call arg
+        """}, rules=["GL011"])
+        assert res.new == []
+
+    def test_suppression_comment(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            from spark_rapids_jni_tpu.serve import ServeRuntime
+
+            def leak():
+                ServeRuntime()  # graftlint: disable=GL011
+        """}, rules=["GL011"])
+        assert res.new == [] and res.counts()["suppressed"] == 1
+
+
+# ---------------------------------------------------------------------------
 # baseline ratchet
 # ---------------------------------------------------------------------------
 
@@ -865,4 +930,4 @@ class TestLiveTree:
         from tools.graftlint import rules as rules_mod
         ids = [r.id for r in rules_mod.all_rules()]
         assert ids == ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
-                       "GL007", "GL008", "GL009", "GL010"]
+                       "GL007", "GL008", "GL009", "GL010", "GL011"]

@@ -909,3 +909,95 @@ class TestRetryLadderInnerOOM:
                             staticmethod(fake_block))
         with pytest.raises(RetryOOM):
             run_with_retry(step, make_spillable=lambda: 0, max_retries=3)
+
+
+class TestTaskDoneReleasesParkedThreads:
+    """Serving kill-safety regression: ``task_done()`` for a task whose
+    thread is parked inside the arena (BLOCKED on an allocate, or BUFN
+    after a rollback) must WAKE that thread and fail its pending call
+    promptly.  The pre-fix adaptor erased the ThreadInfo out from under
+    the live condition-variable waiter (UB) or left the thread parked
+    forever, which also wedged the watchdog join in ``close()``."""
+
+    def test_task_done_wakes_blocked_thread(self, adaptor):
+        from spark_rapids_jni_tpu.mem.rmm_spark import UnknownThreadError
+
+        runner = TaskThread(adaptor, 1)  # stays RUNNING: the global
+        runner.do(lambda: adaptor.allocate(1 * MB, tid=runner.tid))
+        assert runner.expect()[0] == "ok"  # deadlock scan cannot rescue
+        victim = TaskThread(adaptor, 2)
+        victim.do(lambda: adaptor.allocate(20 * MB, tid=victim.tid))
+        assert poll_for_state(adaptor, victim.tid, ThreadState.BLOCKED) \
+            == ThreadState.BLOCKED
+        adaptor.task_done(2)  # the external kill path
+        kind, exc = victim.expect(timeout=5.0)
+        assert kind == "exc" and isinstance(exc, UnknownThreadError)
+        # the entry was fully released, not leaked in REMOVE_THROW
+        assert adaptor.get_state_of(victim.tid) == ThreadState.UNKNOWN
+        victim.finish()
+        runner.do(lambda: adaptor.deallocate(1 * MB, tid=runner.tid))
+        assert runner.expect()[0] == "ok"
+        runner.finish()
+        assert adaptor.total_allocated() == 0
+
+    def test_task_done_wakes_bufn_parked_thread(self, adaptor):
+        from spark_rapids_jni_tpu.mem.rmm_spark import UnknownThreadError
+
+        a = TaskThread(adaptor, 1)
+        b = TaskThread(adaptor, 2)
+        a.do(lambda: adaptor.allocate(8 * MB, tid=a.tid))
+        assert a.expect()[0] == "ok"
+        b.do(lambda: adaptor.allocate(4 * MB, tid=b.tid))
+        assert poll_for_state(adaptor, b.tid, ThreadState.BLOCKED) \
+            == ThreadState.BLOCKED
+        # a over-asks too -> full deadlock -> the scan hands b (lowest
+        # priority) a RetryOOM, then a (the only BLOCKED left) as well
+        a.do(lambda: adaptor.allocate(4 * MB, tid=a.tid))
+        kind, exc = b.expect()
+        assert kind == "exc" and isinstance(exc, RetryOOM)
+        kind, exc = a.expect()
+        assert kind == "exc" and isinstance(exc, RetryOOM)
+        # a recovers with a small alloc and keeps RUNNING, so the global
+        # deadlock scan stays idle and nothing can rescue b
+        a.do(lambda: adaptor.allocate(1 * MB, tid=a.tid))
+        assert a.expect()[0] == "ok"
+        # b has nothing to spill and parks in BUFN
+        b.do(lambda: adaptor.block_thread_until_ready(tid=b.tid))
+        assert poll_for_state(adaptor, b.tid, ThreadState.BUFN) \
+            == ThreadState.BUFN
+        adaptor.task_done(2)  # kill while BUFN-parked
+        kind, exc = b.expect(timeout=5.0)
+        assert kind == "exc" and isinstance(exc, UnknownThreadError)
+        assert adaptor.get_state_of(b.tid) == ThreadState.UNKNOWN
+        b.finish()
+        a.do(lambda: adaptor.deallocate(9 * MB, tid=a.tid))
+        assert a.expect()[0] == "ok"
+        a.finish()
+        assert adaptor.total_allocated() == 0
+
+
+class TestBreakStalledCycles:
+    """Cross-tenant stall breaker: the classic scan only fires when EVERY
+    task thread is blocked, so a blocked subset starves behind an
+    unrelated running tenant.  ``break_stalled_cycles`` rolls back the
+    lowest-priority thread blocked past the stall bound."""
+
+    def test_subset_stall_is_broken(self, adaptor):
+        runner = TaskThread(adaptor, 1)  # unrelated tenant, keeps running
+        runner.do(lambda: adaptor.allocate(1 * MB, tid=runner.tid))
+        assert runner.expect()[0] == "ok"
+        stuck = TaskThread(adaptor, 2)
+        stuck.do(lambda: adaptor.allocate(20 * MB, tid=stuck.tid))
+        assert poll_for_state(adaptor, stuck.tid, ThreadState.BLOCKED) \
+            == ThreadState.BLOCKED
+        # too young to be considered stalled yet
+        assert not adaptor.break_stalled_cycles(stall_ms=60_000)
+        time.sleep(0.06)
+        assert adaptor.break_stalled_cycles(stall_ms=50)
+        kind, exc = stuck.expect(timeout=5.0)
+        assert kind == "exc" and isinstance(exc, RetryOOM)
+        assert adaptor.get_and_reset_num_retry(2) >= 1
+        stuck.finish()
+        runner.do(lambda: adaptor.deallocate(1 * MB, tid=runner.tid))
+        assert runner.expect()[0] == "ok"
+        runner.finish()

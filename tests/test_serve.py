@@ -1,0 +1,366 @@
+"""Multi-tenant serving runtime tests.
+
+The robustness core of the serving PR: admission control over the
+unified arena, cross-tenant deadlock breaking (the classic all-blocked
+scan AND the stall breaker for cycles starving behind a running
+tenant), kill-safe cancellation at every lifecycle point, bounded
+timeout re-admission, and the double-buffered shuffle drain lane.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import config, faultinj
+from spark_rapids_jni_tpu.mem import RetryOOM, RmmSpark, SplitAndRetryOOM
+from spark_rapids_jni_tpu.serve import (
+    QueryCancelled,
+    QueryTimeout,
+    ServeRuntime,
+)
+
+MB = 1 << 20
+
+
+@pytest.fixture
+def arena():
+    adaptor = RmmSpark.set_event_handler(10 * MB, poll_ms=20.0)
+    yield adaptor
+    RmmSpark.clear_event_handler()
+
+
+@pytest.fixture
+def runtime(arena):
+    # fast stall breaker so cross-tenant cycle tests stay sub-second
+    config.set("serve_stall_break_ms", 200.0)
+    rt = ServeRuntime()
+    yield rt
+    rt.shutdown()
+    config.reset("serve_stall_break_ms")
+
+
+def _poll(pred, timeout=5.0, interval=0.005):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def _deadlocking_tenant(hold, want, state, lock, barrier):
+    """Charge ``hold``, rendezvous, then fight over ``want`` more.
+
+    Exactly one tenant — the deadlock victim — rolls back (releases its
+    hold and returns "victim"); any other escalated tenant follows the
+    standard retry contract (block until ready, retry) and survives.
+    """
+
+    def q(ctx, sess):
+        held = ctx.charge(hold)
+        barrier.wait(timeout=10)
+        for _ in range(50):
+            try:
+                n = ctx.charge(want)
+                ctx.release(n)
+                ctx.release(held)
+                return "survivor"
+            except (RetryOOM, SplitAndRetryOOM):
+                with lock:
+                    first = state["victim"] is None
+                    if first:
+                        state["victim"] = sess.tenant
+                if first:
+                    ctx.release(held)
+                    return "victim"
+                try:
+                    RmmSpark.block_thread_until_ready()
+                except (RetryOOM, SplitAndRetryOOM):
+                    pass
+        raise AssertionError("no progress after 50 retries")
+
+    return q
+
+
+class TestLifecycle:
+    def test_happy_path(self, arena, runtime):
+        s = runtime.submit(lambda ctx: "ok", est_bytes=1 * MB,
+                           tenant="alpha")
+        assert s.result(timeout=10) == "ok"
+        assert s.status == "done"
+        assert s.attempts == 1
+        assert s.tenant == "alpha"
+        assert s.granted_bytes == 1 * MB  # fit without splitting
+        assert arena.total_allocated() == 0
+
+    def test_reservation_splits_under_pressure(self, arena, runtime):
+        gate = threading.Event()
+
+        def holder(ctx):
+            n = ctx.charge(6 * MB)
+            gate.wait(15)
+            ctx.release(n)
+            return "held"
+
+        h = runtime.submit(holder)
+        assert _poll(lambda: arena.total_allocated() >= 6 * MB)
+        # 8 MB cannot fit beside the 6 MB resident tenant: the admission
+        # probe walks the ladder (park -> stall-break -> split) and is
+        # granted the halved footprint that does fit
+        s = runtime.submit(lambda ctx: "fit", est_bytes=8 * MB)
+        assert s.result(timeout=20) == "fit"
+        assert s.granted_bytes == 4 * MB
+        gate.set()
+        assert h.result(timeout=10) == "held"
+        assert arena.total_allocated() == 0
+
+
+class TestCrossTenantDeadlock:
+    def test_two_tenant_bufn_cycle_broken_by_watchdog(self, arena, runtime):
+        """Satellite #3: A<->B both hold 5 MB of the 10 MB arena and both
+        demand 4 MB more — a cycle no tenant can resolve.  The watchdog
+        hands the victim RetryOOM/SplitAndRetryOOM; it rolls back, the
+        survivor completes, and both arenas drain."""
+        state = {"victim": None}
+        lock = threading.Lock()
+        barrier = threading.Barrier(2)
+        q = _deadlocking_tenant(5 * MB, 4 * MB, state, lock, barrier)
+        a = runtime.submit(q, tenant="A")
+        b = runtime.submit(q, tenant="B")
+        outcomes = sorted([a.result(timeout=15), b.result(timeout=15)])
+        assert outcomes == ["survivor", "victim"]
+        assert state["victim"] in ("A", "B")
+        assert a.status == "done" and b.status == "done"
+        assert runtime.shutdown()
+        assert arena.total_allocated() == 0
+        assert arena.host_total_allocated() == 0
+
+    def test_cycle_behind_running_tenant_needs_stall_breaker(
+            self, arena, runtime):
+        """The classic scan only fires when EVERY task thread is
+        blocked: with tenant C happily running, an A<->B cycle starves
+        until the stall breaker rolls the victim back."""
+        stop = threading.Event()
+
+        def busy(ctx):
+            while not stop.is_set():
+                n = ctx.charge(1024)
+                ctx.release(n)
+                time.sleep(0.005)
+            return "busy-done"
+
+        state = {"victim": None}
+        lock = threading.Lock()
+        barrier = threading.Barrier(2)
+        q = _deadlocking_tenant(4 * MB, 4 * MB, state, lock, barrier)
+        c = runtime.submit(busy, tenant="C")
+        assert _poll(lambda: c.status == "running")
+        a = runtime.submit(q, tenant="A")
+        b = runtime.submit(q, tenant="B")
+        outcomes = sorted([a.result(timeout=15), b.result(timeout=15)])
+        assert outcomes == ["survivor", "victim"]
+        assert state["victim"] is not None
+        stop.set()
+        assert c.result(timeout=10) == "busy-done"
+        assert runtime.shutdown()
+        assert arena.total_allocated() == 0
+
+
+class TestKillSafety:
+    def test_cancel_unparks_tenant_blocked_in_arena(self, arena, runtime):
+        """A tenant parked in native BLOCKED (its demand can never fit,
+        and a running peer keeps the global scan idle) must unwind
+        promptly on cancel — the task_done kill path wakes it with
+        REMOVE_THROW."""
+        stop = threading.Event()
+
+        def busy(ctx):
+            while not stop.is_set():
+                n = ctx.charge(1024)
+                ctx.release(n)
+                time.sleep(0.005)
+            return "busy-done"
+
+        c = runtime.submit(busy)
+        assert _poll(lambda: c.status == "running")
+
+        def hog(ctx):
+            ctx.charge(100 * MB)  # can never fit: parks forever
+            return "unreachable"
+
+        h = runtime.submit(hog)
+        assert _poll(lambda: h.status == "running")
+        time.sleep(0.1)  # let the charge park in the native arena
+        t0 = time.monotonic()
+        runtime.cancel(h)
+        with pytest.raises(QueryCancelled):
+            h.result(timeout=5)
+        assert time.monotonic() - t0 < 2.0  # woken, not watchdog-timed-out
+        assert h.status == "cancelled"
+        stop.set()
+        assert c.result(timeout=10) == "busy-done"
+        assert runtime.shutdown()
+        assert arena.total_allocated() == 0
+
+    def test_cancel_while_queued_for_admission(self, arena):
+        rt = ServeRuntime(max_concurrent=1)
+        try:
+            gate = threading.Event()
+            a = rt.submit(lambda ctx: (gate.wait(15), "held")[1])
+            assert _poll(lambda: a.status == "running")
+            b = rt.submit(lambda ctx: "never")
+            assert _poll(lambda: b.status == "queued", timeout=1.0)
+            rt.cancel(b)
+            with pytest.raises(QueryCancelled):
+                b.result(timeout=5)
+            assert b.status == "cancelled"
+            gate.set()
+            assert a.result(timeout=10) == "held"
+        finally:
+            assert rt.shutdown()
+
+    def test_admission_queue_timeout(self, arena):
+        rt = ServeRuntime(max_concurrent=1)
+        config.set("serve_admit_timeout_s", 0.3)
+        try:
+            gate = threading.Event()
+            a = rt.submit(lambda ctx: (gate.wait(15), "held")[1])
+            assert _poll(lambda: a.status == "running")
+            b = rt.submit(lambda ctx: "never")
+            with pytest.raises(QueryTimeout):
+                b.result(timeout=5)
+            assert b.status == "timeout"
+            gate.set()
+            assert a.result(timeout=10) == "held"
+        finally:
+            config.reset("serve_admit_timeout_s")
+            assert rt.shutdown()
+
+    def test_plan_cache_pin_released_on_kill(self, arena, runtime):
+        from spark_rapids_jni_tpu.plan.cache import get_plan_cache
+
+        cache = get_plan_cache()
+        key = "serve-test-pinned-plan"
+
+        def q(ctx, sess):
+            sess.pin_plan(key)
+            while True:
+                sess._check_cancelled()
+                time.sleep(0.01)
+
+        s = runtime.submit(q)
+        assert _poll(lambda: cache.pinned(key))
+        runtime.cancel(s)
+        with pytest.raises(QueryCancelled):
+            s.result(timeout=5)
+        assert not cache.pinned(key)  # the kill-safe unwind dropped it
+
+    def test_injected_task_cancel_is_a_kill(self, arena, runtime):
+        faultinj.configure({"faults": [{"match": "serve_step", "count": 1,
+                                        "fault": "task_cancel"}]})
+        try:
+            s = runtime.submit(lambda ctx: "nope")
+            with pytest.raises(faultinj.TaskCancelled):
+                s.result(timeout=10)
+            assert s.status == "cancelled"
+            assert arena.total_allocated() == 0
+        finally:
+            faultinj.configure({})
+
+
+class TestTimeoutReadmission:
+    def test_timeout_kills_then_readmits_with_backoff(self, arena, runtime):
+        def q(ctx, sess):
+            # attempts 1 and 2 out-sleep the deadline; attempt 3 returns
+            end = time.monotonic() + (10.0 if sess.attempts <= 2 else 0.0)
+            while time.monotonic() < end:
+                sess._check_cancelled()
+                time.sleep(0.02)
+            return "eventually"
+
+        s = runtime.submit(q, timeout_s=0.25)
+        assert s.result(timeout=20) == "eventually"
+        assert s.status == "done"
+        assert s.attempts == 3  # initial + serve_max_readmissions
+        assert arena.total_allocated() == 0
+
+    def test_timeout_budget_exhausts_to_query_timeout(self, arena, runtime):
+        def q(ctx, sess):
+            end = time.monotonic() + 10.0
+            while time.monotonic() < end:
+                sess._check_cancelled()
+                time.sleep(0.02)
+            return "never"
+
+        s = runtime.submit(q, timeout_s=0.2)
+        with pytest.raises(QueryTimeout):
+            s.result(timeout=20)
+        assert s.status == "timeout"
+        assert s.attempts == 3
+        assert arena.total_allocated() == 0
+
+
+class TestDrainLaneOverlap:
+    def test_exchange_rounds_pipeline_through_lane(self, eight_devices,
+                                                   arena):
+        """With the runtime's drain lane installed, a multi-round
+        exchange drains round k on the lane thread while the tenant's
+        worker runs round k+1 — and stays bit-identical to the solo
+        (lane-less) exchange."""
+        import jax
+        import jax.numpy as jnp
+
+        from spark_rapids_jni_tpu.columnar import types as T
+        from spark_rapids_jni_tpu.columnar.column import Column, ColumnBatch
+        from spark_rapids_jni_tpu.parallel import data_mesh, shard_batch
+        from spark_rapids_jni_tpu.shuffle import ShuffleRegistry, ShuffleService
+
+        P = 8
+        n = P * 64
+        mesh = data_mesh(P)
+        vals = np.arange(n, dtype=np.int64)
+        batch = shard_batch(ColumnBatch({
+            "v": Column(jnp.asarray(vals), jnp.ones((n,), jnp.bool_),
+                        T.INT64)}), mesh)
+        # all rows to one destination: the worst skew, forcing rounds >= 2
+        pid = jax.device_put(
+            jnp.zeros((n,), jnp.int32),
+            jax.sharding.NamedSharding(mesh,
+                                       jax.sharding.PartitionSpec("data")))
+
+        def delivered(res):
+            return (np.asarray(jax.device_get(res.batch["v"].data)),
+                    np.asarray(jax.device_get(res.occupancy)))
+
+        old_bucket = config.get("shuffle_capacity_bucket")
+        config.set("shuffle_capacity_bucket", 16)
+        try:
+            solo = ShuffleService(mesh, registry=ShuffleRegistry()).exchange(
+                batch, pid=pid, round_rows=16)
+            solo_v, solo_occ = delivered(solo)
+            assert solo.rounds >= 2
+            assert solo.rounds_overlapped == 0  # no lane installed yet
+
+            rt = ServeRuntime()
+            try:
+                def q(ctx):
+                    res = ShuffleService(
+                        mesh, registry=ShuffleRegistry()).exchange(
+                            batch, pid=pid, round_rows=16, ctx=ctx)
+                    return delivered(res) + (res.rounds,
+                                             res.rounds_overlapped)
+
+                s = rt.submit(q, tenant="shuffler")
+                v, occ, rounds, overlapped = s.result(timeout=120)
+                assert rounds == solo.rounds
+                assert overlapped >= 1  # the double-buffered drain ran
+                # bit-identical to the solo run
+                assert np.array_equal(v, solo_v)
+                assert np.array_equal(occ, solo_occ)
+            finally:
+                assert rt.shutdown()
+            assert arena.total_allocated() == 0
+        finally:
+            config.set("shuffle_capacity_bucket", old_bucket)

@@ -3,10 +3,12 @@
 The premerge gate (ci/chaos.sh) that proves the fault-domain story
 end-to-end, the way ci/q95_floor.json proves perf: it sweeps every
 registered ``faultinj.FAULT_KINDS`` entry across every instrumented
-boundary of five scenarios — a spill walk (device→host→disk→back), an
+boundary of seven scenarios — a spill walk (device→host→disk→back), an
 out-of-core skewed shuffle, the single-chip q95 pipeline, a global
-distributed sort across the 8-device mesh, and a JNI host-boundary
-round-trip — one fault per trial exhaustively, plus ``chaos_trials``
+distributed sort across the 8-device mesh, a JNI host-boundary
+round-trip, a streaming morsel scan, and a multi-tenant serving wave
+(concurrent sessions through the ServeRuntime, killed and re-submitted
+mid-flight) — one fault per trial exhaustively, plus ``chaos_trials``
 seeded multi-fault trials per scenario.  Every trial must end with
 
 * a result **bit-identical** to the scenario's fault-free baseline
@@ -57,6 +59,7 @@ import json
 import random
 import shutil
 import tempfile
+import threading
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
@@ -446,9 +449,97 @@ class JniScenario:
         return {"digest": digest, "extra": {}}
 
 
+class ServingScenario:
+    """A wave of concurrent tenants through the multi-tenant
+    ``ServeRuntime``: each tenant's query builds a lineage-backed
+    spillable handle inside its per-session ``TaskContext``, walks it
+    device→host→disk and reads it back — crossing ``serve_admit`` /
+    ``serve_step`` plus the whole spill boundary set from inside worker
+    threads.  A killed tenant (``task_cancel`` anywhere on its path, or
+    an aborting ``exception``) is re-submitted as a fresh session —
+    the serving analogue of the replacement executor — while surviving
+    tenants must stay bit-identical to the fault-free baseline.  The
+    per-tenant results are position-stable, so the digest is
+    deterministic even though WHICH concurrent tenant absorbs a given
+    occurrence of a shared-clock fault is not.  After the wave the
+    runtime must shut down cleanly: drained arenas, empty store, no
+    orphan spill files, and no live ``serve-*`` worker threads."""
+
+    name = "serving"
+    n_tenants = 3
+
+    def run(self) -> Dict:
+        from spark_rapids_jni_tpu.mem import RetryOOM
+        from spark_rapids_jni_tpu.serve import QueryCancelled, ServeRuntime
+
+        srcs = [np.arange(8 * KB, dtype=np.int64) * (i + 5)
+                for i in range(self.n_tenants)]  # 64 KB each
+        results: List[Optional[np.ndarray]] = [None] * self.n_tenants
+        kills = 0
+        with _harness(2 * MB, 512 * KB, self.name) as (fw, adaptor):
+            runtime = ServeRuntime(task_id_base=20_000)
+            try:
+                def make_query(i):
+                    def q(ctx):
+                        def mk(s=srcs[i]):
+                            return {"x": jnp.asarray(s)}
+                        h = spill_mod.SpillableHandle(
+                            mk(), ctx=ctx, name=f"chaos-serve-{i}",
+                            recompute=mk)
+                        h.spill()
+                        h.spill_host()  # → disk: write + corrupt probes
+                        return np.asarray(h.get()["x"]).copy()
+                    return q
+
+                pending = list(range(self.n_tenants))
+                attempts = {i: 0 for i in pending}
+                while pending:
+                    wave = [(i, runtime.submit(make_query(i),
+                                               est_bytes=64 * KB,
+                                               tenant=f"tenant-{i}"))
+                            for i in pending]
+                    pending = []
+                    for i, sess in wave:
+                        try:
+                            results[i] = sess.result(timeout=30.0)
+                        except faultinj.FatalInjectedFault:
+                            raise  # whole-scenario replacement
+                        except (faultinj.TaskCancelled,
+                                faultinj.InjectedFault,
+                                QueryCancelled, RetryOOM):
+                            # a killed/aborted tenant resubmits as a
+                            # FRESH session; its unwind must leave the
+                            # shared arena consistent for the survivors.
+                            # RetryOOM lands here only when injected at
+                            # the ADMISSION probe — before the session's
+                            # retry ladder exists to absorb it
+                            kills += 1
+                            attempts[i] += 1
+                            if attempts[i] >= _MAX_ATTEMPTS:
+                                raise ChaosError(
+                                    f"serving: tenant {i} not done after "
+                                    f"{_MAX_ATTEMPTS} re-submissions")
+                            pending.append(i)
+            finally:
+                clean = runtime.shutdown()
+            if not clean:
+                raise ChaosError(
+                    "serving: runtime.shutdown() left wedged sessions")
+            _check_invariants(fw, adaptor)
+            stragglers = [t.name for t in threading.enumerate()
+                          if t.name.startswith("serve-")]
+            if stragglers:
+                raise ChaosError(
+                    f"serving: live worker threads after shutdown: "
+                    f"{stragglers}")
+        return {"digest": _digest(results),
+                "extra": {"tenant_kills": kills}}
+
+
 SCENARIOS = {s.name: s for s in (SpillScenario(), ShuffleScenario(),
                                  Q95Scenario(), SortScenario(),
-                                 StreamingScanScenario(), JniScenario())}
+                                 StreamingScanScenario(), JniScenario(),
+                                 ServingScenario())}
 
 
 # ---------------------------------------------------------------------------
@@ -556,6 +647,26 @@ def single_fault_trials(fast: bool = False) -> List[Trial]:
         for kind in ("exception", "oom", "fatal"):
             one("jni", "chaos_jni_step", kind)
         one("jni", "chaos_jni_step", "oom", skip=1)
+
+    # serving scenario: tenant kills at every lifecycle boundary — still
+    # queued (serve_admit), mid-query (serve_step), and mid-spill-write —
+    # plus the abort/recover kinds at the step seam and the full disk
+    # boundary set crossed from inside worker threads.  task_cancel
+    # appears ONLY here and in the serve tests: this is the trial set
+    # that keeps the kind in the campaign's coverage check.
+    one("serving", "serve_step", "task_cancel")
+    one("serving", "serve_admit", "task_cancel")
+    one("serving", "spill_io_write", "task_cancel")
+    for kind in ("exception", "oom", "fatal"):
+        one("serving", "serve_step", kind)
+    one("serving", "spill_io_write", "spill_io")
+    one("serving", "spill_corrupt_file", "spill_corrupt")
+    if not fast:
+        one("serving", "serve_step", "task_cancel", skip=1)
+        one("serving", "serve_admit", "oom")
+        one("serving", "spill_io_read", "spill_io")
+        one("serving", "host_corrupt_probe", "host_corrupt")
+        one("serving", "spill_corrupt_file", "spill_corrupt", skip=1)
     return t
 
 
@@ -578,6 +689,10 @@ _MULTI_POOL = {
     "q95": [("chaos_q95_step", "oom"), ("chaos_q95_step", "exception")],
     "sort": [("chaos_sort_step", "oom"), ("chaos_sort_step", "exception")],
     "jni": [("chaos_jni_step", "oom"), ("chaos_jni_step", "exception")],
+    "serving": [("serve_step", "oom"), ("serve_step", "task_cancel"),
+                ("serve_step", "exception"),
+                ("spill_io_write", "spill_io"),
+                ("spill_corrupt_file", "spill_corrupt")],
 }
 
 

@@ -458,43 +458,52 @@ class GL004SpillHandleLeak(Rule):
                     "registration")
 
     def _escapes(self, fn, assign_node, var: str) -> bool:
-        past = False
-        for node in ast.walk(fn):
-            if node is assign_node:
-                past = True
-                continue
-            if isinstance(node, ast.Call):
-                f = node.func
-                if (isinstance(f, ast.Attribute)
-                        and isinstance(f.value, ast.Name)
-                        and f.value.id == var
-                        and f.attr in _CLOSE_METHODS):
+        return _name_escapes(fn, assign_node, var, _CLOSE_METHODS)
+
+
+def _name_escapes(fn, assign_node, var: str,
+                  close_methods: Set[str]) -> bool:
+    """Shared GL004/GL011 escape analysis: does ``var`` (bound by
+    ``assign_node``) ever get closed via ``close_methods``, returned,
+    yielded, passed on, stored, aliased, or used as a context manager
+    anywhere in ``fn``?"""
+    past = False
+    for node in ast.walk(fn):
+        if node is assign_node:
+            past = True
+            continue
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == var
+                    and f.attr in close_methods):
+                return True
+            for a in list(node.args) + [k.value for k in node.keywords]:
+                for sub in ast.walk(a):
+                    if isinstance(sub, ast.Name) and sub.id == var:
+                        return True
+        elif isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name) and sub.id == var:
                     return True
-                for a in list(node.args) + [k.value for k in node.keywords]:
-                    for sub in ast.walk(a):
-                        if isinstance(sub, ast.Name) and sub.id == var:
-                            return True
-            elif isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
-                for sub in ast.walk(node):
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                ce = item.context_expr
+                if isinstance(ce, ast.Name) and ce.id == var:
+                    return True
+                for sub in ast.walk(ce):
                     if isinstance(sub, ast.Name) and sub.id == var:
                         return True
-            elif isinstance(node, (ast.With, ast.AsyncWith)):
-                for item in node.items:
-                    ce = item.context_expr
-                    if isinstance(ce, ast.Name) and ce.id == var:
-                        return True
-                    for sub in ast.walk(ce):
-                        if isinstance(sub, ast.Name) and sub.id == var:
-                            return True
-            elif isinstance(node, ast.Assign) and node is not assign_node:
-                for sub in ast.walk(node.value):
-                    if isinstance(sub, ast.Name) and sub.id == var:
-                        return True   # aliased / stored (self.h = h, d[k]=h)
-            elif isinstance(node, (ast.List, ast.Tuple, ast.Dict, ast.Set)):
-                for sub in ast.iter_child_nodes(node):
-                    if isinstance(sub, ast.Name) and sub.id == var:
-                        return True
-        return False
+        elif isinstance(node, ast.Assign) and node is not assign_node:
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Name) and sub.id == var:
+                    return True   # aliased / stored (self.h = h, d[k]=h)
+        elif isinstance(node, (ast.List, ast.Tuple, ast.Dict, ast.Set)):
+            for sub in ast.iter_child_nodes(node):
+                if isinstance(sub, ast.Name) and sub.id == var:
+                    return True
+    return False
 
 
 # ---------------------------------------------------------------------------
@@ -1110,12 +1119,121 @@ class GL010ShardingConstraintDrift(Rule):
                     yield coll, name, lit
 
 
+# ---------------------------------------------------------------------------
+# GL011 — serve runtime / session leak
+# ---------------------------------------------------------------------------
+
+_SERVE_CLASSES = {"ServeRuntime", "AdmissionTicket"}
+_SERVE_RELEASE_METHODS = {"result", "cancel", "close", "shutdown",
+                          "release", "__exit__"}
+
+
+class GL011ServeSessionLeak(Rule):
+    """A ``ServeRuntime`` owns OS worker threads, the process-wide
+    shuffle drain lane, and the armed stall breaker; an
+    ``AdmissionTicket`` holds one of ``serve_max_concurrent`` admission
+    slots.  One constructed and never shut down / released keeps daemon
+    threads and the drain-lane hook alive past the query wave that made
+    it — and a ``submit()`` whose ``TenantSession`` is discarded is a
+    fire-and-forget tenant nobody can cancel, observe, or unwind, so
+    its arena charge and plan-cache pins outlive every caller.  The
+    GL004 analysis applied to the serving layer: flags serve-class
+    constructions and ``submit()`` results (on a variable bound to a
+    ``ServeRuntime(...)`` in the same scope) that are discarded or
+    never released, returned, stored, passed on, or used as a context
+    manager."""
+
+    id = "GL011"
+
+    def check(self, pf: ParsedFile) -> Iterable[Finding]:
+        for node in ast.walk(pf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_fn(pf, node)
+
+    @staticmethod
+    def _ctor_name(call: ast.AST) -> Optional[str]:
+        if not isinstance(call, ast.Call):
+            return None
+        func = call.func
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else None)
+        return name if name in _SERVE_CLASSES else None
+
+    @staticmethod
+    def _is_runtime_submit(call: ast.AST, runtimes: Set[str]) -> bool:
+        return (isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)
+                and call.func.attr == "submit"
+                and isinstance(call.func.value, ast.Name)
+                and call.func.value.id in runtimes)
+
+    def _check_fn(self, pf, fn):
+        managed: Set[int] = set()   # Call nodes that are withitem contexts
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    managed.add(id(item.context_expr))
+        body_nodes = list(_walk_scope(fn, into_functions=False))
+        # variables bound to a ServeRuntime(...) in THIS scope: only
+        # their .submit() is flagged, so executor/future submit() on
+        # unrelated receivers never false-positives
+        runtimes = {node.targets[0].id for node in body_nodes
+                    if isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and self._ctor_name(node.value) == "ServeRuntime"}
+        for node in body_nodes:
+            if not isinstance(node, ast.Expr):
+                continue
+            if id(node.value) in managed:
+                continue
+            name = self._ctor_name(node.value)
+            if name:
+                yield pf.finding(
+                    self.id, node,
+                    f"`{name}(...)` constructed and immediately "
+                    "discarded — its worker threads / admission slot "
+                    "can never be released")
+            elif self._is_runtime_submit(node.value, runtimes):
+                yield pf.finding(
+                    self.id, node,
+                    "`submit(...)` session discarded — a fire-and-"
+                    "forget tenant nobody can result()/cancel(); its "
+                    "arena charge and pins outlive every caller")
+        for node in body_nodes:
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            var = node.targets[0].id
+            name = self._ctor_name(node.value)
+            if name:
+                if not _name_escapes(fn, node, var,
+                                     _SERVE_RELEASE_METHODS):
+                    yield pf.finding(
+                        self.id, node,
+                        f"`{var} = {name}(...)` is never shut down, "
+                        "released, returned, stored, or used as a "
+                        "context manager in this scope — worker "
+                        "threads and the drain-lane hook leak")
+            elif self._is_runtime_submit(node.value, runtimes):
+                if not _name_escapes(fn, node, var,
+                                     _SERVE_RELEASE_METHODS):
+                    yield pf.finding(
+                        self.id, node,
+                        f"`{var} = ...submit(...)` session is never "
+                        "result()-ed, cancelled, stored, or passed on "
+                        "— the tenant's outcome (and its unwind) is "
+                        "unobservable")
+
+
 _ALL: List[Rule] = [GL001TracerLeak(), GL002HostSyncUnderJit(),
                     GL003RetraceHazard(), GL004SpillHandleLeak(),
                     GL005ConfigDrift(), GL006FaultKindDrift(),
                     GL007DonatedBufferReuse(), GL008JittedIOHandle(),
                     GL009LateMaterializationBreach(),
-                    GL010ShardingConstraintDrift()]
+                    GL010ShardingConstraintDrift(),
+                    GL011ServeSessionLeak()]
 
 
 def all_rules(only: Optional[Sequence[str]] = None) -> List[Rule]:
