@@ -669,8 +669,48 @@ def serve_main():
               f"(device={residue[0]}B host={residue[1]}B)",
               file=sys.stderr, flush=True)
         return 1
+    # multi-process wave: the SAME query set again, now through the
+    # FrontDoor's supervised executor worker processes (each with its
+    # own arena + spill store).  The worker-side ``q6_digest`` kind
+    # replays the exact solo seeds, so the digests must match solo
+    # bit-for-bit across the process boundary.  Runs after the
+    # in-process teardown — the supervisor hosts no arena of its own.
+    from spark_rapids_jni_tpu.serve import FrontDoor
+    mp_workers = max(2, int(os.environ.get("BENCH_SERVE_MP_WORKERS", "2")))
+    fd = FrontDoor(workers=mp_workers, pool_bytes=pool,
+                   host_pool_bytes=host_pool, max_concurrent=n_streams)
+    mp_t0 = time.perf_counter()
+    try:
+        mp_sessions = {
+            (i, k): fd.submit(
+                "q6_digest",
+                {"rows": n_rows, "stream": i, "query": k, "steps": steps},
+                tenant=f"stream-{i}", est_bytes=batch_bytes)
+            for i in range(n_streams) for k in range(n_queries)}
+        mp = {key: s.result(timeout=300.0)
+              for key, s in mp_sessions.items()}
+        mp_wall = time.perf_counter() - mp_t0
+    except Exception as e:
+        print(f"# serve MP wave failed: {e!r}", file=sys.stderr,
+              flush=True)
+        return 1
+    finally:
+        mp_report = fd.shutdown()
+    mp_drift = [key for key in solo if solo[key][0] != mp[key][0]]
+    if mp_drift:
+        print(f"# serve scenario: MP results DIFFER from solo for "
+              f"{sorted(mp_drift)}", file=sys.stderr, flush=True)
+        return 1
+    if not mp_report["clean"]:
+        print(f"# serve scenario: MP fleet shutdown unclean: "
+              f"{mp_report['workers']} orphans="
+              f"{mp_report['orphan_spill_files']}",
+              file=sys.stderr, flush=True)
+        return 1
+
     solo_lat = [dt * 1e3 for _, dt in solo.values()]
     conc_lat = [dt * 1e3 for _, dt in conc.values()]
+    mp_lat = [dt * 1e3 for _, dt in mp.values()]
     total_rows = n_streams * n_queries * steps * n_rows
     conc_p99 = _pct(conc_lat, 0.99)
     print(json.dumps({
@@ -691,6 +731,11 @@ def serve_main():
             "concurrent_p99_ms": round(conc_p99, 2),
             "solo_wall_s": round(solo_wall, 3),
             "concurrent_wall_s": round(wall, 3),
+            "mp_workers": mp_workers,
+            "mp_bit_identical": True,
+            "mp_p50_ms": round(_pct(mp_lat, 0.5), 2),
+            "mp_p99_ms": round(_pct(mp_lat, 0.99), 2),
+            "mp_wall_s": round(mp_wall, 3),
         },
     }), flush=True)
     return 0

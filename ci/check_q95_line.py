@@ -41,7 +41,10 @@ The serving row (``bench.py --serve``, its own capture file) rides
 note must record ``bit_identical`` true (the concurrent wave matched
 the solo pass digest for digest) with at least 4 streams, and its
 ``vs_baseline`` — the solo-p99 / concurrent-p99 fairness ratio — must
-not shrink below the recorded floor.
+not shrink below the recorded floor.  The same note must also record
+``mp_bit_identical`` true with ``mp_workers >= 2``: the multi-process
+front-door wave (supervised executor workers) replays the same query
+set across the process boundary and must match solo digest for digest.
 """
 import json
 import os
@@ -173,6 +176,14 @@ def main(paths) -> int:
         elif int(serve_note.get("streams", 0)) < 4:
             errs.append("serve line ran fewer than 4 concurrent streams "
                         f"(note={json.dumps(serve_note)})")
+        elif serve_note.get("mp_bit_identical") is not True:
+            errs.append("serve line's note.mp_bit_identical is not true: "
+                        "the multi-process front-door wave no longer "
+                        "proves it matched the solo pass "
+                        f"(note={json.dumps(serve_note)})")
+        elif int(serve_note.get("mp_workers", 0)) < 2:
+            errs.append("serve line's MP wave ran fewer than 2 executor "
+                        f"workers (note={json.dumps(serve_note)})")
         serve_vs = serve_line.get("vs_baseline", 0.0)
         if serve_vs < serve_floor:
             errs.append(f"serve vs_baseline {serve_vs} (solo p99 / "

@@ -245,7 +245,30 @@ _register("serve_max_readmissions", 2, int,
           "re-admit).")
 _register("serve_backoff_ms", 50.0, float,
           "Base backoff between a query's timeout-kill and its "
-          "re-admission, doubled per attempt (serve/runtime.py).")
+          "re-admission, doubled per attempt (serve/runtime.py); the "
+          "front door reuses it as the base delay of its session "
+          "re-placement and worker-respawn ladders.")
+_register("serve_workers", 2, int,
+          "Executor worker processes the multi-process front door "
+          "(serve/frontdoor.py) spawns; each hosts its own ServeRuntime, "
+          "arena, spill store, and plan cache, with tenant sessions "
+          "pinned to one worker over the local-socket protocol — one "
+          "wedged interpreter can't take the fleet down.")
+_register("serve_heartbeat_ms", 100.0, float,
+          "Front-door heartbeat period: the supervisor pings every "
+          "worker this often; a worker silent for ~3.5 periods (or "
+          "whose native stall-breaker epoch keeps climbing with no "
+          "completions) is declared wedged and SIGKILLed.")
+_register("serve_respawn_max", 3, int,
+          "Circuit breaker on worker respawns: how many times one "
+          "worker slot may be respawned (with exponential backoff) "
+          "before the front door stops replacing it and serves "
+          "degraded on the surviving workers.")
+_register("serve_shed_threshold", 0.5, float,
+          "Degradation threshold: when the healthy fraction of "
+          "configured workers drops below this, the front door sheds "
+          "lowest-priority pending admissions beyond the surviving "
+          "capacity (AdmissionShed) instead of queueing unboundedly.")
 
 
 def get(key: str):

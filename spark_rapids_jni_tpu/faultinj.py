@@ -48,7 +48,14 @@ boundary — instrumented jitted callables — since there is no CUPTI:
   analogue for the serving runtime: landing it at any instrumented
   boundary (via the occurrence clock) simulates a client killing its
   query mid-BUFN / mid-round / mid-spill, and the session must unwind
-  kill-safe exactly as for an external ``ServeRuntime.cancel()``.
+  kill-safe exactly as for an external ``ServeRuntime.cancel()``,
+  ``"worker_crash"`` / ``"worker_stall"`` are PROCESS-level kinds for
+  the multi-process front door (``serve/frontdoor.py``): inside an
+  executor worker they kill -9 the interpreter mid-query or wedge it so
+  it stops answering heartbeats (hooks installed by
+  :func:`set_worker_fault_hooks`); in a process with no hooks installed
+  they raise :class:`WorkerCrash` / :class:`WorkerStalled` so a stray
+  rule match in a test harness is loud instead of fatal.
 * ``dynamic: true`` re-reads the file when its mtime changes, matching
   the injector's ``dynamicReconfig`` thread without needing one.
 
@@ -63,6 +70,16 @@ reproduce a failing chaos schedule exactly (``skip = occurrence - 1``).
 :func:`scope` applies a config for a ``with`` block and restores the
 previous rules on exit; the block's stats survive the exit so a failing
 trial can still be reported from its log.
+
+Cross-process support (the front door's supervisor/worker split):
+:func:`current_config` returns the live schedule as a config dict so a
+supervisor can re-export it to spawned workers (each worker gets its own
+occurrence clock); ``SPARK_RAPIDS_TPU_FAULT_MIRROR`` names a file every
+firing is appended to (one JSON line, ``O_APPEND``, written BEFORE the
+raiser runs) so a worker's injection trace survives even its own
+SIGKILL; :func:`record_external` merges such a trace back into this
+process's :func:`fired_log`, keeping the chaos campaign's
+vacuous-trial and kind-coverage checks honest across the fleet.
 
 Usage::
 
@@ -86,6 +103,7 @@ import threading
 from typing import Dict, List, Optional, Union
 
 ENV_CONFIG = "SPARK_RAPIDS_TPU_FAULT_CONFIG"
+ENV_MIRROR = "SPARK_RAPIDS_TPU_FAULT_MIRROR"
 
 
 class InjectedFault(RuntimeError):
@@ -185,6 +203,55 @@ def _raise_task_cancel(name: str):
     raise TaskCancelled(f"injected task cancel at {name}")
 
 
+class WorkerCrash(RuntimeError):
+    """An executor worker process was killed -9 (kind ``"worker_crash"``).
+
+    Inside a worker the registered hook never returns — it SIGKILLs the
+    interpreter, so there is no unwind, no atexit, no spill cleanup: the
+    front door's reaper is the only recovery path, which is exactly what
+    the chaos trials are proving.  In a process with no hook installed
+    (pytest, the supervisor itself) this exception is raised instead."""
+
+
+class WorkerStalled(RuntimeError):
+    """An executor worker wedged mid-query (kind ``"worker_stall"``).
+
+    Inside a worker the registered hook blocks the calling thread forever
+    and flips a flag that stops the heartbeat loop answering pings — the
+    supervisor must detect the missed heartbeats and SIGKILL the worker.
+    With no hook installed this exception is raised instead."""
+
+
+# Process-level fault hooks: only an executor worker installs these (see
+# serve/worker.py); everywhere else the worker kinds degrade to loud
+# exceptions via the default raisers below.
+_worker_hooks: Dict[str, Optional[object]] = {"crash": None, "stall": None}
+
+
+def set_worker_fault_hooks(crash=None, stall=None):
+    """Install process-level handlers for the worker fault kinds.
+
+    ``crash``/``stall`` are called with the instrumented name and are
+    expected NOT to return (SIGKILL / block forever); if one does return,
+    the corresponding exception is raised as a fallback."""
+    _worker_hooks["crash"] = crash
+    _worker_hooks["stall"] = stall
+
+
+def _raise_worker_crash(name: str):
+    hook = _worker_hooks["crash"]
+    if hook is not None:
+        hook(name)
+    raise WorkerCrash(f"injected worker crash at {name} (no hook installed)")
+
+
+def _raise_worker_stall(name: str):
+    hook = _worker_hooks["stall"]
+    if hook is not None:
+        hook(name)
+    raise WorkerStalled(f"injected worker stall at {name} (no hook installed)")
+
+
 # The registry of injectable fault flavors: kind -> raiser.  graftlint's
 # GL006 keeps this in sync with every use site statically — a kind used
 # in a config dict but missing here would otherwise only fail when its
@@ -202,11 +269,16 @@ FAULT_KINDS = {
     "spill_corrupt": _raise_spill_corrupt,
     "host_corrupt": _raise_host_corrupt,
     "task_cancel": _raise_task_cancel,
+    "worker_crash": _raise_worker_crash,
+    "worker_stall": _raise_worker_stall,
 }
 
 
 class _Rule:
     def __init__(self, spec: dict):
+        # the original spec survives so current_config() can re-export
+        # the schedule verbatim to a spawned worker process
+        self.spec = dict(spec)
         self.match = spec.get("match", "*")
         self.probability = float(spec.get("probability", 1.0))
         self.count = spec.get("count")  # None = unlimited
@@ -232,6 +304,12 @@ class _Injector:
         self._path: Optional[str] = None
         self._mtime: float = 0.0
         self._dynamic = False
+        self._seed = 0
+        # crash-durable per-fire mirror (see module docstring): the fd is
+        # opened lazily O_APPEND so a line is on disk before the raiser
+        # runs — even a SIGKILL from _raise_worker_crash can't lose it
+        self._mirror_path: Optional[str] = os.environ.get(ENV_MIRROR)
+        self._mirror_fd: Optional[int] = None
         # deterministic observability: per-name screening/firing counters
         # and the ordered injection trace (see fired_log())
         self._checks: Dict[str, int] = {}
@@ -261,6 +339,7 @@ class _Injector:
                     self._rules = []
                     self._path = None
                     self._dynamic = False
+                    self._seed = 0
                     self._reset_stats_locked()
                 return
         if isinstance(config, str):
@@ -273,7 +352,8 @@ class _Injector:
         rules = [_Rule(r) for r in doc.get("faults", [])]
         with self._lock:
             self._rules = rules
-            self._rng = random.Random(doc.get("seed", 0))
+            self._seed = int(doc.get("seed", 0))
+            self._rng = random.Random(self._seed)
             self._dynamic = bool(doc.get("dynamic", False))
             self._path = path
             self._mtime = mtime
@@ -313,17 +393,68 @@ class _Injector:
                     rule.remaining -= 1
                 self._seq += 1
                 self._fired[name] = self._fired.get(name, 0) + 1
-                self._log.append({
+                entry = {
                     "seq": self._seq, "name": name, "fault": rule.fault,
                     "match": rule.match,
                     # occurrence is 1-based: replay with skip=occurrence-1
                     "occurrence": self._checks[name],
-                })
+                }
+                self._log.append(entry)
+                self._mirror_locked(entry)
                 kind = rule.fault
                 break
             else:
                 return
         FAULT_KINDS[kind](name)
+
+    def _mirror_locked(self, entry: dict):
+        """Append one fired entry to the mirror file, durably, pre-raise."""
+        if not self._mirror_path:
+            return
+        try:
+            if self._mirror_fd is None:
+                self._mirror_fd = os.open(
+                    self._mirror_path,
+                    os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644)
+            os.write(self._mirror_fd,
+                     (json.dumps(entry) + "\n").encode("utf-8"))
+        except OSError:
+            # observability must never take the workload down with it
+            self._mirror_fd = None
+
+    def record_external(self, entries: List[dict],
+                        source: Optional[str] = None):
+        """Merge another process's fired entries into this injector's log.
+
+        The front door calls this with a dead or drained worker's mirror
+        file (or last pong's trace) so a chaos trial's ``fired_log()``
+        covers the whole fleet.  Entries are re-sequenced locally;
+        ``source`` tags where they came from."""
+        with self._lock:
+            for e in entries:
+                self._seq += 1
+                rec = {
+                    "seq": self._seq,
+                    "name": e.get("name", "?"),
+                    "fault": e.get("fault", "?"),
+                    "match": e.get("match", "*"),
+                    "occurrence": e.get("occurrence", 0),
+                }
+                if source is not None:
+                    rec["source"] = source
+                elif "source" in e:
+                    rec["source"] = e["source"]
+                self._fired[rec["name"]] = self._fired.get(rec["name"], 0) + 1
+                self._log.append(rec)
+
+    def current_config(self) -> dict:
+        """The live schedule as a config dict (original rule specs).
+
+        What a supervisor exports to a spawned worker; the worker's
+        injector starts a fresh occurrence clock over the same rules."""
+        with self._lock:
+            return {"seed": self._seed,
+                    "faults": [dict(r.spec) for r in self._rules]}
 
     # -- observability ---------------------------------------------------
     def check_counts(self) -> Dict[str, int]:
@@ -350,14 +481,14 @@ class _Injector:
         block's :func:`fired_log` stays readable after a failing trial."""
         with self._lock:
             saved = (self._rules, self._rng, self._dynamic, self._path,
-                     self._mtime)
+                     self._mtime, self._seed)
         self.configure(config)
         try:
             yield self
         finally:
             with self._lock:
                 (self._rules, self._rng, self._dynamic, self._path,
-                 self._mtime) = saved
+                 self._mtime, self._seed) = saved
 
 
 _injector = _Injector()
@@ -367,6 +498,8 @@ check_counts = _injector.check_counts
 fire_counts = _injector.fire_counts
 fired_log = _injector.fired_log
 reset_stats = _injector.reset_stats
+record_external = _injector.record_external
+current_config = _injector.current_config
 
 
 def instrument(fn, name: Optional[str] = None):

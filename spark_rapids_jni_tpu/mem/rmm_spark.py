@@ -267,6 +267,10 @@ class SparkResourceAdaptor:
         # stalled past this bound even while other tenants keep running
         # (the global scan requires every task thread blocked)
         self._stall_break_ms = 0.0
+        # cumulative stall-breaker firings — the "stall epoch" a front-door
+        # worker reports in its heartbeat pongs: an epoch that keeps
+        # climbing while no sessions complete marks the worker as wedged
+        self.stall_breaks = 0
         self._watchdog = threading.Thread(
             target=self._watch, args=(poll_ms / 1000.0,),
             name="tra-watchdog", daemon=True)
@@ -278,8 +282,7 @@ class SparkResourceAdaptor:
                 self._lib.tra_check_and_break_deadlocks(self._h)
                 stall_ms = self._stall_break_ms
                 if stall_ms > 0:
-                    self._lib.tra_break_stalled_cycles(
-                        self._h, ctypes.c_long(int(stall_ms)))
+                    self.break_stalled_cycles(stall_ms)
             except Exception:
                 return
 
@@ -379,9 +382,12 @@ class SparkResourceAdaptor:
         threads continuously blocked past ``stall_ms``, roll back the
         lowest-priority BLOCKED one (RetryOOM), or split the
         highest-priority BUFN one when none are plain BLOCKED.  Returns
-        True when a thread was broken."""
-        return bool(self._lib.tra_break_stalled_cycles(
+        True when a thread was broken (also bumping ``stall_breaks``)."""
+        broke = bool(self._lib.tra_break_stalled_cycles(
             self._h, ctypes.c_long(int(stall_ms))))
+        if broke:
+            self.stall_breaks += 1
+        return broke
 
     # -- injection ------------------------------------------------------
     def force_retry_oom(self, tid=None, num_ooms=1, skip_count=0):
@@ -622,6 +628,14 @@ class RmmSpark:
         for a in cls._each():
             a.set_stall_break_ms(stall_ms)
 
+    @classmethod
+    def stall_break_count(cls) -> int:
+        """Cumulative native stall-breaker firings across installed
+        arenas — the stall EPOCH a front-door worker carries in its
+        heartbeat pongs (0 with no adaptor installed)."""
+        with cls._lock:
+            return sum(a.stall_breaks for a in cls._each())
+
     # spill metrics (tier transitions recorded by mem/spill.py) ---------
     @classmethod
     def spill_metrics(cls) -> dict:
@@ -667,6 +681,18 @@ class RmmSpark:
         from ..plan.cache import plan_cache_metrics
 
         return plan_cache_metrics()
+
+    # fleet metrics (recorded by the multi-process front door) ----------
+    @classmethod
+    def fleet_metrics(cls) -> dict:
+        """Front-door fleet counters (per-worker liveness, re-placements,
+        sheds, respawns, crashes/stalls, circuit-breaker opens) —
+        surfaced here next to the other telemetry scrapes (zeros-safe:
+        a process that never ran a front door reports all-zero
+        counters and no workers)."""
+        from ..serve.frontdoor import fleet_metrics
+
+        return fleet_metrics()
 
     # injection ---------------------------------------------------------
     @classmethod

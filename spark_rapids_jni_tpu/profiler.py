@@ -184,6 +184,19 @@ def plan_cache_summary() -> dict:
     return plan_cache_metrics()
 
 
+def fleet_summary() -> dict:
+    """Front-door fleet counters for profile reports: workers spawned
+    and respawned, crashes/stalls detected, session re-placements,
+    ``WorkerLost`` failures, load-shed admissions, circuit-breaker
+    opens, and the per-worker liveness map — the process-supervision
+    story next to :func:`spill_summary`.  Always zeros-safe: a process
+    that never constructed a :class:`~spark_rapids_jni_tpu.serve.
+    frontdoor.FrontDoor` reports all-zero counters and no workers."""
+    from .serve.frontdoor import fleet_metrics
+
+    return fleet_metrics()
+
+
 def trace_range(name: str):
     """Named range in the captured trace — the NVTX-range analogue
     (reference compiles nvtx3 ranges into kernels for nsys, SURVEY §5);

@@ -1,9 +1,19 @@
 """Multi-tenant serving runtime over the unified memory arena.
 
-See :mod:`~spark_rapids_jni_tpu.serve.runtime` for the admission /
-run / cancel lifecycle and the kill-safety contract.
+See :mod:`~spark_rapids_jni_tpu.serve.runtime` for the in-process
+admission / run / cancel lifecycle and the kill-safety contract, and
+:mod:`~spark_rapids_jni_tpu.serve.frontdoor` for the multi-process
+front door that supervises executor worker processes (crash detection,
+session re-placement, load-shedding degradation).
 """
 
+from .frontdoor import (
+    AdmissionShed,
+    FrontDoor,
+    FrontDoorSession,
+    WorkerLost,
+    fleet_metrics,
+)
 from .runtime import (
     AdmissionTicket,
     QueryCancelled,
@@ -14,10 +24,15 @@ from .runtime import (
 )
 
 __all__ = [
+    "AdmissionShed",
     "AdmissionTicket",
+    "FrontDoor",
+    "FrontDoorSession",
     "QueryCancelled",
     "QueryTimeout",
     "ServeError",
     "ServeRuntime",
     "TenantSession",
+    "WorkerLost",
+    "fleet_metrics",
 ]

@@ -1,0 +1,818 @@
+"""Multi-process serving front door: supervised executor workers.
+
+PR 9's ``ServeRuntime`` kept the whole fleet in one interpreter — one
+wedged or OOM-killed process took every tenant down.  The front door
+splits that blast radius along the process boundary (the ROADMAP's
+"tenants as clients over a socket, sessions pinned to executor
+processes"; the same isolation argument "Accelerating Presto with GPUs"
+makes for production query fleets):
+
+* **Supervisor** (:class:`FrontDoor`) — listens on a Unix-domain socket
+  under a private fleet directory and spawns ``serve_workers`` executor
+  processes (``python -m spark_rapids_jni_tpu.serve.worker``), each
+  hosting its OWN ``ServeRuntime``, arena, spill store, and plan cache.
+* **Pinning** — a tenant's sessions stick to one worker (least-loaded on
+  first sight, re-pinned only when the pinned worker is gone), so its
+  spill-store residency and plan-cache pins stay process-local.
+* **Heartbeats** — every ``serve_heartbeat_ms`` the supervisor pings
+  each worker; pongs carry the native stall-breaker EPOCH
+  (``RmmSpark.stall_break_count()``) and the worker's live-session
+  count.  A worker silent past ~3.5 periods, or whose stall epoch keeps
+  climbing across many pongs with no sessions completing, is declared
+  wedged.
+* **Loss protocol** — a crashed (waitpid), wedged, or never-connected
+  worker is SIGKILLed, its spill directory reaped, and its durable
+  injection trace (the ``SPARK_RAPIDS_TPU_FAULT_MIRROR`` file) merged
+  into this process's :func:`faultinj.fired_log`.  Its sessions split
+  two ways: queued-or-replayable sessions re-place onto healthy workers
+  through the bounded ``serve_max_readmissions``/``serve_backoff_ms``
+  ladder; in-flight non-replayable ones fail loudly with
+  :class:`WorkerLost` carrying the worker's last fired_log.
+* **Respawn** — lost workers are respawned with exponential backoff; a
+  slot respawned more than ``serve_respawn_max`` times opens its
+  circuit breaker and the fleet serves degraded on the survivors.
+* **Degradation** — when the alive fraction of configured workers drops
+  below ``serve_shed_threshold``, pending admissions beyond the
+  surviving capacity are shed lowest-priority-first
+  (:class:`AdmissionShed`) instead of queueing unboundedly; when NO
+  worker can ever come back (all dead, circuits open) pending sessions
+  fail with :class:`WorkerLost`.
+
+The chaos ``frontdoor`` scenario (tools/chaos.py) SIGKILLs workers at
+every session lifecycle point and asserts survivors' digests are
+bit-identical, victims re-placed or loudly failed, every worker arena
+drained, and zero orphan spill files fleet-wide.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .. import config, faultinj
+from . import wire
+from .runtime import QueryCancelled, QueryTimeout, ServeError
+
+_MISS_BUDGET = 3.5       # heartbeat periods of silence before SIGKILL
+_STALL_EPOCH_LIMIT = 8   # consecutive no-progress epoch bumps before kill
+_STARTUP_GRACE_S = 30.0  # max wait for a spawned worker's hello
+
+
+class WorkerLost(ServeError):
+    """The worker process hosting this session died (crash, SIGKILL, or
+    missed heartbeats) and the session could not be re-placed: it was
+    mid-flight and not replayable, its re-placement budget ran out, or
+    no healthy worker can ever come back.  Carries the dead worker's
+    last injection trace so the failure is diagnosable post-mortem."""
+
+    def __init__(self, message: str, worker_id: Optional[int] = None,
+                 fired_log: Optional[List[dict]] = None):
+        super().__init__(message)
+        self.worker_id = worker_id
+        self.fired_log = list(fired_log or [])
+
+
+class AdmissionShed(ServeError):
+    """Degraded-mode load shedding: healthy capacity dropped below
+    ``serve_shed_threshold`` and this pending admission was in the
+    lowest priority class beyond the surviving capacity."""
+
+
+class FleetMetrics:
+    """Fleet-level counters + per-worker liveness, scraped via
+    :func:`fleet_metrics` → ``RmmSpark.fleet_metrics()`` →
+    ``profiler.fleet_summary()``."""
+
+    FIELDS = ("workers_spawned", "respawns", "crashes", "stalls",
+              "replacements", "worker_lost", "sheds", "circuit_open")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = dict.fromkeys(self.FIELDS, 0)
+        self._liveness: Dict[int, str] = {}
+
+    def bump(self, field: str, n: int = 1):
+        with self._lock:
+            self._counts[field] += n
+
+    def set_liveness(self, worker_id: int, state: str):
+        with self._lock:
+            self._liveness[int(worker_id)] = state
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = dict(self._counts)
+            out["liveness"] = dict(self._liveness)
+            return out
+
+
+# the last-constructed front door's metrics; zeros-safe before any ran
+_last_metrics = FleetMetrics()
+
+
+def fleet_metrics() -> dict:
+    return _last_metrics.snapshot()
+
+
+class FrontDoorSession:
+    """Supervisor-side handle for one submitted query.
+
+    Status walks ``pending → placed → running → done`` on the happy
+    path, ending in ``failed`` / ``cancelled`` / ``shed`` otherwise;
+    ``replacements`` counts how many worker losses it survived.
+    ``replayable=False`` declares the query non-idempotent: once seen
+    ``running`` it is never re-placed — a worker loss fails it with
+    :class:`WorkerLost` instead of silently re-running side effects."""
+
+    def __init__(self, door: "FrontDoor", sid: int, kind: str,
+                 params: Optional[dict], tenant, priority: int,
+                 est_bytes: int, timeout_s: Optional[float],
+                 replayable: bool):
+        self._door = door
+        self.sid = sid
+        self.kind = kind
+        self.params = dict(params or {})
+        self.tenant = tenant
+        self.priority = int(priority)
+        self.est_bytes = int(est_bytes or 0)
+        self.timeout_s = timeout_s
+        self.replayable = bool(replayable)
+        self.status = "pending"
+        self.worker_id: Optional[int] = None
+        self.replacements = 0
+        self.result_value = None
+        self.error: Optional[BaseException] = None
+        self._cancel_requested = False
+        self._done = threading.Event()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"session {self.sid} still {self.status} after {timeout}s")
+        if self.error is not None:
+            raise self.error
+        return self.result_value
+
+    def cancel(self):
+        self._door.cancel(self)
+
+    def close(self, timeout: Optional[float] = 10.0):
+        if not self._done.is_set():
+            self._door.cancel(self)
+        self._done.wait(timeout)
+
+    def _finish(self, value=None, error: Optional[BaseException] = None,
+                status: Optional[str] = None):
+        if self._done.is_set():
+            return
+        self.result_value = value
+        self.error = error
+        if status is not None:
+            self.status = status
+        elif error is not None:
+            self.status = "failed"
+        else:
+            self.status = "done"
+        self._done.set()
+
+
+class WorkerHandle:
+    """Supervisor-side record of one executor worker process: the child
+    handle, its socket, its private directory (spill files + fault
+    mirror + log), heartbeat state, and the sessions placed on it.
+    ``kill()``/``close()`` release the process and socket — graftlint
+    GL012 flags constructions with no release on some exit path."""
+
+    def __init__(self, worker_id: int, gen: int, wdir: str,
+                 proc: subprocess.Popen):
+        self.worker_id = int(worker_id)
+        self.gen = int(gen)
+        self.dir = wdir
+        self.proc = proc
+        self.conn: Optional[socket.socket] = None
+        self.send_lock = threading.Lock()
+        self.state = "starting"  # starting | healthy | dead
+        self.spawned_at = time.monotonic()
+        self.last_pong = time.monotonic()
+        self.stall_breaks = 0
+        self.stall_suspect = 0
+        self.results_since_pong = 0
+        self.fired: List[dict] = []
+        self.merged = False
+        self.bye: Optional[dict] = None
+        self.sessions: Dict[int, FrontDoorSession] = {}
+
+    def kill(self):
+        with contextlib.suppress(OSError):
+            self.proc.kill()
+
+    def close(self):
+        conn, self.conn = self.conn, None
+        if conn is not None:
+            with contextlib.suppress(OSError):
+                conn.close()
+
+
+class FrontDoor:
+    """The supervisor: ``submit(kind, params)`` → session handle pinned
+    to a worker process; ``shutdown()`` drains the fleet and returns a
+    per-worker cleanliness report (idempotent)."""
+
+    def __init__(self, workers: Optional[int] = None,
+                 pool_bytes: int = 64 << 20,
+                 host_pool_bytes: int = 16 << 20,
+                 max_concurrent: Optional[int] = None,
+                 heartbeat_ms: Optional[float] = None,
+                 respawn_max: Optional[int] = None,
+                 shed_threshold: Optional[float] = None,
+                 setup: Optional[str] = None):
+        global _last_metrics
+        self._n_workers = int(workers if workers is not None
+                              else config.get("serve_workers"))
+        self._pool_bytes = int(pool_bytes)
+        self._host_pool_bytes = int(host_pool_bytes)
+        self._max_concurrent = int(
+            max_concurrent if max_concurrent is not None
+            else config.get("serve_max_concurrent"))
+        self._hb_s = float(heartbeat_ms if heartbeat_ms is not None
+                           else config.get("serve_heartbeat_ms")) / 1000.0
+        self._respawn_max = int(respawn_max if respawn_max is not None
+                                else config.get("serve_respawn_max"))
+        self._shed_threshold = float(
+            shed_threshold if shed_threshold is not None
+            else config.get("serve_shed_threshold"))
+        self._replace_max = int(config.get("serve_max_readmissions"))
+        self._backoff_s = float(config.get("serve_backoff_ms")) / 1000.0
+        self._setup = setup
+        self.fleet_dir = tempfile.mkdtemp(prefix="sptpu_frontdoor_")
+        self.metrics = FleetMetrics()
+        _last_metrics = self.metrics
+        self._lock = threading.RLock()
+        self._sids = itertools.count(1)
+        self._gens = itertools.count(1)
+        self._pending: List[list] = []   # [not_before, session]
+        self._pins: Dict[object, int] = {}   # tenant -> worker slot
+        self._workers: Dict[int, WorkerHandle] = {}
+        self._respawn_count = dict.fromkeys(range(self._n_workers), 0)
+        self._respawn_at: Dict[int, float] = {}
+        self._broken: set = set()
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._shutdown_started = False
+        self._shutdown_done = threading.Event()
+        self._shutdown_result: Optional[dict] = None
+
+        self._sock_path = os.path.join(self.fleet_dir, "frontdoor.sock")
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(self._sock_path)
+        self._listener.listen(self._n_workers * 2)
+        self._listener.settimeout(0.2)
+
+        with self._lock:
+            for slot in range(self._n_workers):
+                self._spawn_locked(slot)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="frontdoor-accept", daemon=True)
+        self._monitor_thread = threading.Thread(
+            target=self._monitor_loop, name="frontdoor-monitor", daemon=True)
+        self._accept_thread.start()
+        self._monitor_thread.start()
+
+    # -- public API -----------------------------------------------------
+    def submit(self, kind: str, params: Optional[dict] = None, tenant=None,
+               priority: int = 0, est_bytes: int = 0,
+               timeout_s: Optional[float] = None,
+               replayable: bool = True) -> FrontDoorSession:
+        """Queue a query of registered worker-side ``kind`` and return
+        its session.  ``params`` must be JSON-serializable; everything
+        else matches ``ServeRuntime.submit`` plus ``replayable`` (see
+        :class:`FrontDoorSession`)."""
+        if self._shutdown_started:
+            raise ServeError("front door is shut down")
+        sid = next(self._sids)
+        sess = FrontDoorSession(
+            self, sid, kind, params,
+            tenant if tenant is not None else f"tenant-{sid}",
+            priority, est_bytes, timeout_s, replayable)
+        now = time.monotonic()
+        with self._lock:
+            self._pending.append([now, sess])
+            self._maybe_shed_locked()
+            self._dispatch_locked(now)
+        self._wake.set()
+        return sess
+
+    def cancel(self, sess: FrontDoorSession):
+        """Cancel wherever the session is: pending (finished here),
+        placed/running (forwarded to its worker, which unwinds it
+        kill-safe and reports ``cancelled``)."""
+        with self._lock:
+            if sess._done.is_set():
+                return
+            sess._cancel_requested = True
+            if sess.worker_id is None:
+                self._pending = [e for e in self._pending if e[1] is not sess]
+                sess._finish(error=QueryCancelled(
+                    f"session {sess.sid} cancelled while pending"),
+                    status="cancelled")
+                return
+            w = self._workers.get(sess.worker_id)
+            if w is not None and w.conn is not None and w.state == "healthy":
+                with contextlib.suppress(OSError):
+                    wire.send_msg(w.conn, {"op": "cancel", "sid": sess.sid},
+                                  w.send_lock)
+
+    def sessions(self) -> List[FrontDoorSession]:
+        with self._lock:
+            out = [e[1] for e in self._pending]
+            for w in self._workers.values():
+                out.extend(w.sessions.values())
+            return out
+
+    def shutdown(self, timeout_s: float = 30.0) -> dict:
+        """Drain the fleet: graceful ``shutdown`` to every live worker
+        (its runtime cancels in-flight sessions kill-safe and reports a
+        ``bye`` with residue), SIGKILL for stragglers, reap every worker
+        directory, remove the fleet dir.  Returns a report with
+        per-worker cleanliness, fleet counters, and any orphan spill
+        files found before the reap.  Idempotent: later (or racing)
+        calls wait for the first and return its report."""
+        with self._lock:
+            first = not self._shutdown_started
+            self._shutdown_started = True
+        if not first:
+            self._shutdown_done.wait(timeout_s + 10.0)
+            return self._shutdown_result or {"clean": False, "workers": {}}
+        self._stop.set()
+        self._wake.set()
+        self._monitor_thread.join(timeout=10.0)
+        with contextlib.suppress(OSError):
+            self._listener.close()
+        self._accept_thread.join(timeout=10.0)
+
+        report: dict = {"clean": True, "workers": {}, "orphan_spill_files": []}
+        with self._lock:
+            pending = [e[1] for e in self._pending]
+            self._pending = []
+            workers = list(self._workers.values())
+        for sess in pending:
+            sess._finish(error=QueryCancelled(
+                f"session {sess.sid} cancelled: front door shutdown",
+                reason="shutdown"), status="cancelled")
+        for w in workers:
+            if w.state != "dead" and w.conn is not None:
+                with contextlib.suppress(OSError):
+                    wire.send_msg(w.conn, {"op": "shutdown"}, w.send_lock)
+        deadline = time.monotonic() + timeout_s
+        for w in workers:
+            entry: dict
+            if w.state == "dead":
+                entry = {"state": "dead", "clean": True}
+            else:
+                try:
+                    w.proc.wait(max(0.1, deadline - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    w.kill()
+                    with contextlib.suppress(Exception):
+                        w.proc.wait(5.0)
+                    entry = {"state": "wedged", "clean": False}
+                else:
+                    bye = w.bye or {}
+                    residue = bye.get("residue") or [0, 0]
+                    entry = {
+                        "state": "ok" if bye else "no-bye",
+                        "clean": bool(bye.get("clean")) and not any(residue)
+                        and not bye.get("leftovers")
+                        and not bye.get("store_len"),
+                        "residue": residue,
+                        "leftovers": bye.get("leftovers", []),
+                    }
+                self._merge_fired(w)
+                w.state = "dead"
+                self.metrics.set_liveness(w.worker_id, "shutdown")
+            w.close()
+            for sess in list(w.sessions.values()):
+                sess._finish(error=QueryCancelled(
+                    f"session {sess.sid} cancelled: front door shutdown",
+                    reason="shutdown"), status="cancelled")
+            w.sessions = {}
+            report["workers"][w.worker_id] = entry
+            report["clean"] = report["clean"] and entry["clean"]
+        # zero-orphan-spill-files invariant, checked BEFORE the reap:
+        # a gracefully drained worker leaves an empty spill dir, a
+        # killed one had its dir reaped at loss time
+        for root, _dirs, files in os.walk(self.fleet_dir):
+            for f in files:
+                if "spill" in root.split(os.sep)[-1:] or f.endswith(".spill"):
+                    report["orphan_spill_files"].append(
+                        os.path.join(root, f))
+        report["clean"] = report["clean"] and not report["orphan_spill_files"]
+        report["fleet"] = self.metrics.snapshot()
+        shutil.rmtree(self.fleet_dir, ignore_errors=True)
+        self._shutdown_result = report
+        self._shutdown_done.set()
+        return report
+
+    # -- spawning -------------------------------------------------------
+    def _child_fault_config(self) -> Optional[dict]:
+        """The supervisor's live fault schedule, with each rule's count
+        decremented by the firings already merged from the fleet — so a
+        respawned replacement doesn't re-arm a fault the fleet already
+        absorbed (the fleet-wide occurrence clock)."""
+        cfg = faultinj.current_config()
+        if not cfg.get("faults"):
+            return None
+        fired = faultinj.fired_log()
+        out = []
+        for spec in cfg["faults"]:
+            spec = dict(spec)
+            cnt = spec.get("count")
+            if cnt is not None:
+                used = sum(
+                    1 for e in fired
+                    if e.get("match") == spec.get("match", "*")
+                    and e.get("fault") == spec.get("fault", "exception"))
+                left = int(cnt) - used
+                if left <= 0:
+                    continue
+                spec["count"] = left
+            out.append(spec)
+        if not out:
+            return None
+        return {"seed": cfg.get("seed", 0), "faults": out}
+
+    def _spawn_locked(self, slot: int) -> WorkerHandle:
+        gen = next(self._gens)
+        wdir = os.path.join(self.fleet_dir, f"worker-{slot}-{gen}")
+        os.makedirs(wdir, exist_ok=True)
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        fault_cfg = self._child_fault_config()
+        if fault_cfg is not None:
+            cfg_path = os.path.join(wdir, "fault.json")
+            with open(cfg_path, "w") as f:
+                json.dump(fault_cfg, f)
+            env[faultinj.ENV_CONFIG] = cfg_path
+        else:
+            # the supervisor's live schedule is authoritative — don't
+            # let a stale inherited env re-arm faults in the child
+            env.pop(faultinj.ENV_CONFIG, None)
+        env[faultinj.ENV_MIRROR] = os.path.join(wdir, "fired.jsonl")
+        cmd = [sys.executable, "-m", "spark_rapids_jni_tpu.serve.worker",
+               "--socket", self._sock_path,
+               "--worker-id", str(slot),
+               "--dir", wdir,
+               "--pool-bytes", str(self._pool_bytes),
+               "--host-pool-bytes", str(self._host_pool_bytes),
+               "--max-concurrent", str(self._max_concurrent),
+               "--task-id-base", str(10_000 + slot * 1_000)]
+        if self._setup:
+            cmd += ["--setup", self._setup]
+        log = open(os.path.join(wdir, "worker.log"), "ab")
+        try:
+            proc = subprocess.Popen(
+                cmd, cwd=pkg_root, env=env, stdout=log,
+                stderr=subprocess.STDOUT, start_new_session=True)
+        finally:
+            log.close()
+        w = WorkerHandle(slot, gen, wdir, proc)
+        self._workers[slot] = w
+        self.metrics.bump("workers_spawned")
+        self.metrics.set_liveness(slot, "starting")
+        return w
+
+    # -- accept/reader threads ------------------------------------------
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                conn.settimeout(5.0)
+                hello = wire.recv_msg(conn)
+                slot = int(hello.get("worker_id", -1))
+                pid = hello.get("pid")
+            except (wire.WireError, socket.timeout, OSError, ValueError):
+                with contextlib.suppress(OSError):
+                    conn.close()
+                continue
+            with self._lock:
+                w = self._workers.get(slot)
+                if w is None or w.state == "dead" or w.proc.pid != pid:
+                    # stale incarnation raced its own SIGKILL: drop it
+                    with contextlib.suppress(OSError):
+                        conn.close()
+                    continue
+                conn.settimeout(None)
+                w.conn = conn
+                w.state = "healthy"
+                w.last_pong = time.monotonic()
+                self.metrics.set_liveness(slot, "healthy")
+                threading.Thread(
+                    target=self._reader, args=(w,),
+                    name=f"frontdoor-reader-{slot}-{w.gen}",
+                    daemon=True).start()
+            self._wake.set()
+
+    def _reader(self, w: WorkerHandle):
+        while True:
+            conn = w.conn
+            if conn is None:
+                return
+            try:
+                msg = wire.recv_msg(conn)
+            except (wire.WireError, OSError, ValueError):
+                return  # EOF/kill: the monitor's waitpid handles the rest
+            op = msg.get("op")
+            if op == "pong":
+                self._on_pong(w, msg)
+            elif op == "running":
+                with self._lock:
+                    sess = w.sessions.get(int(msg.get("sid", -1)))
+                    if sess is not None and not sess._done.is_set():
+                        sess.status = "running"
+            elif op == "result":
+                self._on_result(w, msg)
+            elif op == "bye":
+                w.bye = msg
+                w.fired = list(msg.get("fired") or [])
+                w.last_pong = time.monotonic()
+
+    def _on_pong(self, w: WorkerHandle, msg: dict):
+        with self._lock:
+            w.last_pong = time.monotonic()
+            w.fired = list(msg.get("fired") or [])
+            epoch = int(msg.get("stall_breaks") or 0)
+            live = int(msg.get("live_sessions") or 0)
+            # the native stall-breaker epoch backs the wedge detector: an
+            # epoch that keeps climbing while nothing completes means the
+            # breaker is firing but the worker isn't recovering
+            if epoch > w.stall_breaks and live > 0 \
+                    and w.results_since_pong == 0:
+                w.stall_suspect += 1
+            else:
+                w.stall_suspect = 0
+            w.stall_breaks = epoch
+            w.results_since_pong = 0
+
+    def _rebuild_error(self, msg: dict) -> BaseException:
+        err = msg.get("error") or "ServeError"
+        text = msg.get("message") or ""
+        if err == "QueryCancelled":
+            return QueryCancelled(text)
+        if err == "QueryTimeout":
+            return QueryTimeout(text)
+        for cls in (faultinj.TaskCancelled, faultinj.InjectedFault,
+                    faultinj.FatalInjectedFault, faultinj.WorkerCrash,
+                    faultinj.WorkerStalled):
+            if err == cls.__name__:
+                return cls(text)
+        if err in ("RetryOOM", "CpuRetryOOM", "SplitAndRetryOOM"):
+            from ..mem import RetryOOM
+            return RetryOOM(text)
+        return ServeError(f"{err}: {text}")
+
+    def _on_result(self, w: WorkerHandle, msg: dict):
+        with self._lock:
+            sess = w.sessions.pop(int(msg.get("sid", -1)), None)
+            w.results_since_pong += 1
+            w.stall_suspect = 0
+        if sess is None:
+            return
+        if msg.get("ok"):
+            sess._finish(value=msg.get("value"), status="done")
+        else:
+            status = msg.get("status") or "failed"
+            sess._finish(error=self._rebuild_error(msg),
+                         status=status if status in
+                         ("cancelled", "timeout", "failed") else "failed")
+        self._wake.set()
+
+    # -- monitor loop ---------------------------------------------------
+    def _monitor_loop(self):
+        while not self._stop.is_set():
+            self._wake.wait(self._hb_s)
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            now = time.monotonic()
+            with self._lock:
+                for w in list(self._workers.values()):
+                    if w.state == "dead":
+                        continue
+                    if w.proc.poll() is not None:
+                        self._on_worker_lost_locked(
+                            w, f"exited rc={w.proc.returncode}", "crashes",
+                            now)
+                        continue
+                    if w.state == "healthy":
+                        with contextlib.suppress(OSError):
+                            wire.send_msg(w.conn, {"op": "ping", "t": now},
+                                          w.send_lock)
+                        if now - w.last_pong > self._hb_s * _MISS_BUDGET:
+                            w.kill()
+                            self._on_worker_lost_locked(
+                                w, "missed heartbeats", "stalls", now)
+                            continue
+                        if w.stall_suspect >= _STALL_EPOCH_LIMIT:
+                            w.kill()
+                            self._on_worker_lost_locked(
+                                w, "stall epoch climbing without progress",
+                                "stalls", now)
+                            continue
+                    elif now - w.spawned_at > _STARTUP_GRACE_S:
+                        w.kill()
+                        self._on_worker_lost_locked(
+                            w, "never connected", "crashes", now)
+                self._maybe_respawn_locked(now)
+                self._maybe_shed_locked()
+                self._dispatch_locked(now)
+
+    def _merge_fired(self, w: WorkerHandle):
+        """Merge the worker's injection trace into this process's log —
+        the durable mirror file is authoritative (it survives SIGKILL);
+        the last pong's copy is the fallback."""
+        if w.merged:
+            return
+        w.merged = True
+        entries: List[dict] = []
+        mirror = os.path.join(w.dir, "fired.jsonl")
+        try:
+            with open(mirror) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        with contextlib.suppress(ValueError):
+                            entries.append(json.loads(line))
+        except OSError:
+            entries = list(w.fired)
+        if entries:
+            faultinj.record_external(
+                entries, source=f"worker-{w.worker_id}-{w.gen}")
+            w.fired = entries
+
+    def _on_worker_lost_locked(self, w: WorkerHandle, why: str,
+                               kind: str, now: float):
+        w.state = "dead"
+        self.metrics.bump(kind)
+        self.metrics.set_liveness(w.worker_id, "dead")
+        w.close()
+        self._merge_fired(w)
+        fired = list(w.fired)
+        # reap the dead worker's spill files (and its whole directory)
+        shutil.rmtree(w.dir, ignore_errors=True)
+        # triage its sessions: re-place what never ran (or is declared
+        # replayable) through the bounded backoff ladder; fail the rest
+        for sess in list(w.sessions.values()):
+            if sess._done.is_set():
+                continue
+            if sess._cancel_requested:
+                sess._finish(error=QueryCancelled(
+                    f"session {sess.sid} cancelled (worker "
+                    f"{w.worker_id} lost mid-cancel)"), status="cancelled")
+            elif (sess.status != "running" or sess.replayable) \
+                    and sess.replacements < self._replace_max:
+                sess.replacements += 1
+                self.metrics.bump("replacements")
+                sess.status = "pending"
+                sess.worker_id = None
+                not_before = now + self._backoff_s * (
+                    2 ** (sess.replacements - 1))
+                self._pending.append([not_before, sess])
+            else:
+                self.metrics.bump("worker_lost")
+                budget = "" if sess.status != "running" or sess.replayable \
+                    else " (in flight, not replayable)"
+                sess._finish(error=WorkerLost(
+                    f"session {sess.sid} lost with worker {w.worker_id} "
+                    f"({why}){budget or ' (re-placement budget exhausted)'}",
+                    worker_id=w.worker_id, fired_log=fired))
+        w.sessions = {}
+        # schedule the replacement, unless this slot's breaker is open
+        if w.worker_id in self._broken:
+            return
+        self._respawn_count[w.worker_id] = \
+            self._respawn_count.get(w.worker_id, 0) + 1
+        if self._respawn_count[w.worker_id] > self._respawn_max:
+            self._broken.add(w.worker_id)
+            self.metrics.bump("circuit_open")
+            self.metrics.set_liveness(w.worker_id, "broken")
+        else:
+            delay = max(self._backoff_s, 0.05) * (
+                2 ** (self._respawn_count[w.worker_id] - 1))
+            self._respawn_at[w.worker_id] = now + delay
+
+    def _maybe_respawn_locked(self, now: float):
+        for slot, due in list(self._respawn_at.items()):
+            if now < due or self._shutdown_started:
+                continue
+            del self._respawn_at[slot]
+            w = self._workers.get(slot)
+            if w is not None and w.state != "dead":
+                continue
+            self.metrics.bump("respawns")
+            self._spawn_locked(slot)
+
+    def _alive_workers(self) -> List[WorkerHandle]:
+        return [w for w in self._workers.values()
+                if w.state in ("starting", "healthy")]
+
+    def _maybe_shed_locked(self):
+        alive = self._alive_workers()
+        if self._n_workers <= 0 \
+                or len(alive) / self._n_workers >= self._shed_threshold:
+            return
+        if not alive and not self._respawn_at:
+            return  # fleet exhausted: dispatch fails pending WorkerLost
+        cap = max(1, len(alive)) * self._max_concurrent
+        while len(self._pending) > cap:
+            # lowest priority class first; latest arrival within a class
+            victim = min(self._pending,
+                         key=lambda e: (e[1].priority, -e[1].sid))
+            self._pending.remove(victim)
+            sess = victim[1]
+            self.metrics.bump("sheds")
+            sess._finish(error=AdmissionShed(
+                f"session {sess.sid} shed: {len(alive)}/{self._n_workers} "
+                f"workers alive (< serve_shed_threshold="
+                f"{self._shed_threshold:g})"), status="shed")
+
+    def _pick_worker_locked(self, sess: FrontDoorSession
+                            ) -> Optional[WorkerHandle]:
+        healthy = [w for w in self._workers.values()
+                   if w.state == "healthy" and w.conn is not None
+                   and len(w.sessions) < self._max_concurrent]
+        if not healthy:
+            return None
+        pin = self._pins.get(sess.tenant)
+        if pin is not None:
+            for w in healthy:
+                if w.worker_id == pin:
+                    return w
+            pinned = self._workers.get(pin)
+            if pinned is not None and pinned.state != "dead" \
+                    and pin not in self._broken:
+                return None  # pinned worker alive but full/starting: wait
+        w = min(healthy, key=lambda w: (len(w.sessions), w.worker_id))
+        self._pins[sess.tenant] = w.worker_id
+        return w
+
+    def _dispatch_locked(self, now: float):
+        if self._shutdown_started:
+            return
+        # fleet exhausted?  No alive worker and none ever coming back.
+        if not self._alive_workers() and not self._respawn_at:
+            for _nb, sess in self._pending:
+                self.metrics.bump("worker_lost")
+                sess._finish(error=WorkerLost(
+                    f"session {sess.sid}: no healthy workers and the "
+                    f"respawn circuit breaker is open"))
+            self._pending = []
+            return
+        still: List[list] = []
+        for entry in sorted(self._pending,
+                            key=lambda e: (-e[1].priority, e[1].sid)):
+            not_before, sess = entry
+            if sess._done.is_set():
+                continue
+            if now < not_before:
+                still.append(entry)
+                continue
+            w = self._pick_worker_locked(sess)
+            if w is None:
+                still.append(entry)
+                continue
+            try:
+                wire.send_msg(w.conn, {
+                    "op": "submit", "sid": sess.sid, "kind": sess.kind,
+                    "params": sess.params, "tenant": str(sess.tenant),
+                    "priority": sess.priority, "est_bytes": sess.est_bytes,
+                    "timeout_s": sess.timeout_s,
+                }, w.send_lock)
+            except OSError:
+                # worker dying under us: leave it pending, the monitor's
+                # loss protocol will re-route it
+                still.append(entry)
+                continue
+            w.sessions[sess.sid] = sess
+            sess.worker_id = w.worker_id
+            sess.status = "placed"
+        self._pending = still

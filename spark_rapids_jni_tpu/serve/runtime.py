@@ -10,7 +10,10 @@ that workload on the existing machinery rather than beside it:
   ``serve_max_concurrent`` slots (the wait is bracketed with
   :class:`~spark_rapids_jni_tpu.mem.rmm_spark.ThreadStateRegistry.
   blocked_section`, so the native deadlock scan counts queued tenants as
-  blocked), then proves its ESTIMATED footprint fits by charging it
+  blocked).  Waiters are granted in ``(priority desc, arrival asc)``
+  order — ``submit(priority=)`` is the SLA class, higher wins, equals
+  fall back to strict arrival — via :class:`_PrioritySlots`.  An
+  admitted query then proves its ESTIMATED footprint fits by charging it
   against the unified arena through the standard
   :func:`~spark_rapids_jni_tpu.mem.executor.run_with_retry` ladder: a
   can't-fit reservation parks in BUFN, spills idle tenants' handles via
@@ -20,9 +23,11 @@ that workload on the existing machinery rather than beside it:
   the actual residency.
 * **Isolation & fairness** — each session runs in its own worker thread
   under a per-tenant :class:`~spark_rapids_jni_tpu.mem.executor.
-  TaskContext`; the spill store ranks tenants by admission order
-  (earlier admitted = higher eviction priority), so a newcomer's
-  pressure evicts the newest tenants' batches first.  The
+  TaskContext`; the spill store ranks tenants by ``(priority class,
+  admission order)`` — a lower-priority tenant's batches are evicted
+  before any higher class's, and within a class earlier admitted =
+  higher eviction priority, so a newcomer's pressure evicts the
+  lowest-class, newest tenants' batches first.  The
   :class:`~spark_rapids_jni_tpu.plan.cache.PlanCache` is shared across
   tenants, with per-session pins (``session.pin_plan``) released on any
   exit path.
@@ -54,11 +59,19 @@ that workload on the existing machinery rather than beside it:
 Timeouts re-admit: a query killed by its own ``timeout_s`` backs off
 (``serve_backoff_ms``, doubled per attempt) and is re-admitted up to
 ``serve_max_readmissions`` times before ``QueryTimeout`` surfaces.
-External cancels never re-admit.
+The backoff sleep waits on the session's kill flag, so an external
+cancel arriving mid-backoff unwinds immediately instead of sleeping it
+out.  External cancels never re-admit.
+
+The multi-process front door (``serve/frontdoor.py``) runs one of these
+runtimes per executor worker process; ``shutdown()`` is idempotent — a
+second or racing call waits for the first and returns its result — so a
+worker's own drain and the supervisor's teardown can overlap safely.
 """
 
 from __future__ import annotations
 
+import heapq
 import inspect
 import itertools
 import threading
@@ -101,12 +114,63 @@ _MIN_GRANT = 1 << 16  # reservation split floor: 64 KiB
 _ADMIT_TICK_S = 0.05  # cancellation latency while queued
 
 
+class _PrioritySlots:
+    """``serve_max_concurrent`` admission slots granted by SLA class.
+
+    A bare semaphore serves strict arrival order; this serves waiters by
+    ``(priority desc, arrival seq asc)``: a waiter stays enqueued for its
+    whole wait, and a slot freeing up goes to the best-ranked waiter at
+    that moment — so a high-priority latecomer overtakes anything not
+    yet granted, but never preempts a holder.  The wait ticks every
+    ``_ADMIT_TICK_S`` to honor cancellation; the caller brackets it in
+    ``blocked_section`` so the deadlock scan still counts queued tenants
+    as blocked."""
+
+    def __init__(self, capacity: int):
+        self._capacity = int(capacity)
+        self._in_use = 0
+        self._cond = threading.Condition()
+        self._waiters: list = []  # heap of (-priority, arrival_seq)
+
+    def waiting(self) -> int:
+        """How many acquirers are currently enqueued (test introspection)."""
+        with self._cond:
+            return len(self._waiters)
+
+    def acquire(self, priority: int, arrival_seq: int, deadline: float,
+                cancel_check: Callable[[], None]) -> bool:
+        key = (-int(priority), int(arrival_seq))
+        with self._cond:
+            heapq.heappush(self._waiters, key)
+            try:
+                while True:
+                    cancel_check()
+                    if self._in_use < self._capacity \
+                            and self._waiters[0] == key:
+                        self._in_use += 1
+                        return True
+                    if time.monotonic() >= deadline:
+                        return False
+                    self._cond.wait(_ADMIT_TICK_S)
+            finally:
+                # every exit path — grant, timeout, cancel — dequeues,
+                # and wakes the rest in case the head just changed
+                self._waiters.remove(key)
+                heapq.heapify(self._waiters)
+                self._cond.notify_all()
+
+    def release(self):
+        with self._cond:
+            self._in_use = max(0, self._in_use - 1)
+            self._cond.notify_all()
+
+
 class AdmissionTicket:
     """One admission slot, held from admission until the session's
     unwind.  Exactly-once release discipline — graftlint GL011 flags
     acquisition sites without a matching release/close path."""
 
-    def __init__(self, slots: threading.Semaphore, session: "TenantSession"):
+    def __init__(self, slots: "_PrioritySlots", session: "TenantSession"):
         self._slots = slots
         self.session = session
         self._released = False
@@ -133,7 +197,8 @@ class TenantSession:
 
     def __init__(self, runtime: "ServeRuntime", session_id: int,
                  task_id: int, tenant, query_fn: Callable,
-                 est_bytes: int, timeout_s: Optional[float]):
+                 est_bytes: int, timeout_s: Optional[float],
+                 priority: int = 0):
         self._runtime = runtime
         self.session_id = session_id
         self.task_id = task_id
@@ -141,6 +206,7 @@ class TenantSession:
         self.query_fn = query_fn
         self.est_bytes = int(est_bytes or 0)
         self.timeout_s = timeout_s
+        self.priority = int(priority)
         self.pin_owner = ("serve", session_id)
         self.status = "queued"
         self.result_value = None
@@ -228,13 +294,15 @@ class ServeRuntime:
         if max_concurrent is None:
             max_concurrent = int(config.get("serve_max_concurrent"))
         self._max_concurrent = int(max_concurrent)
-        self._slots = threading.Semaphore(self._max_concurrent)
+        self._slots = _PrioritySlots(self._max_concurrent)
         self._task_id_base = int(task_id_base)
         self._ids = itertools.count(1)
         self._admit_seq = itertools.count(1)
         self._lock = threading.Lock()
         self._sessions: list = []
         self._shutdown = False
+        self._shutdown_done = threading.Event()
+        self._shutdown_result: Optional[bool] = None
         # arm the watchdog's cross-tenant stall breaker (no-op with no
         # adaptor installed; 0 disables)
         self._stall_ms = float(config.get("serve_stall_break_ms"))
@@ -245,19 +313,24 @@ class ServeRuntime:
 
     # -- public API -----------------------------------------------------
     def submit(self, query_fn: Callable, est_bytes: int = 0, tenant=None,
-               timeout_s: Optional[float] = None) -> TenantSession:
+               timeout_s: Optional[float] = None,
+               priority: int = 0) -> TenantSession:
         """Queue ``query_fn`` for admission and return its session.
 
         ``query_fn(ctx)`` (or ``query_fn(ctx, session)``) runs on a
         dedicated worker thread inside the session's ``TaskContext``;
         ``est_bytes`` is the footprint admission charges through the
         retry ladder; ``timeout_s`` kills-and-re-admits per the
-        ``serve_max_readmissions`` budget."""
+        ``serve_max_readmissions`` budget; ``priority`` is the SLA
+        class — higher classes overtake the admission queue and keep
+        spill-store residency longer, and the front door sheds lower
+        classes first under degradation."""
         if self._shutdown:
             raise ServeError("runtime is shut down")
         sid = next(self._ids)
         sess = TenantSession(self, sid, self._task_id_base + sid, tenant,
-                             query_fn, est_bytes, timeout_s)
+                             query_fn, est_bytes, timeout_s,
+                             priority=priority)
         with self._lock:
             self._sessions.append(sess)
         t = threading.Thread(target=self._run_session, args=(sess,),
@@ -285,8 +358,17 @@ class ServeRuntime:
 
     def shutdown(self, timeout_s: float = 10.0) -> bool:
         """Cancel every live session, drain the lane, disarm the stall
-        breaker.  Returns True when every worker unwound in time."""
-        self._shutdown = True
+        breaker.  Returns True when every worker unwound in time.
+
+        Idempotent: only the first call does the teardown; a second (or
+        racing) call waits for it and returns the first call's result
+        instead of re-walking closed sessions."""
+        with self._lock:
+            first = not self._shutdown
+            self._shutdown = True
+        if not first:
+            self._shutdown_done.wait(timeout_s)
+            return bool(self._shutdown_result)
         with self._lock:
             sessions = list(self._sessions)
         for s in sessions:
@@ -304,6 +386,8 @@ class ServeRuntime:
             if s._thread is not None:
                 s._thread.join(max(0.0, deadline - time.monotonic()) + 1.0)
                 ok = ok and not s._thread.is_alive()
+        self._shutdown_result = ok
+        self._shutdown_done.set()
         return ok
 
     # -- worker ---------------------------------------------------------
@@ -330,7 +414,11 @@ class ServeRuntime:
                     readmissions += 1
                     sess._rearm()
                     sess.status = "queued"
-                    time.sleep(backoff_s * (2 ** (readmissions - 1)))
+                    # the backoff waits on the FRESH kill flag: an
+                    # external cancel arriving mid-backoff unwinds on
+                    # the next _run_once's cancel check instead of
+                    # sleeping out the remaining backoff first
+                    sess._cancelled.wait(backoff_s * (2 ** (readmissions - 1)))
                     continue
                 if reason == "timeout":
                     sess.status = "timeout"
@@ -372,10 +460,14 @@ class ServeRuntime:
                 timer.start()
             with TaskContext(sess.task_id) as ctx:
                 if fw is not None:
-                    # fair eviction priority: earlier-admitted tenants
-                    # keep residency longer
+                    # eviction rank: SLA class dominates (a lower class
+                    # always evicts before a higher one), admission
+                    # order breaks ties — earlier-admitted tenants in
+                    # the same class keep residency longer
                     fw.store.set_task_priority(
-                        sess.task_id, -float(next(self._admit_seq)))
+                        sess.task_id,
+                        float(sess.priority) * 1e6
+                        - float(next(self._admit_seq)))
                 self._reserve(sess, ctx)
                 sess.status = "running"
 
@@ -414,19 +506,19 @@ class ServeRuntime:
         _admit_probe()  # chaos boundary: a kill while still queued
         timeout_s = float(config.get("serve_admit_timeout_s"))
         deadline = time.monotonic() + timeout_s
-        while True:
-            sess._check_cancelled()
-            # the queue wait is a HOST-side block: bracket it so the
-            # native deadlock scan counts queued tenants as blocked
-            with ThreadStateRegistry.blocked_section():
-                got = self._slots.acquire(timeout=_ADMIT_TICK_S)
-            if got:
-                sess.status = "admitted"
-                return AdmissionTicket(self._slots, sess)
-            if time.monotonic() >= deadline:
-                raise QueryTimeout(
-                    f"session {sess.session_id}: admission queue wait "
-                    f"exceeded {timeout_s:g}s")
+        # the queue wait is a HOST-side block: bracket it so the native
+        # deadlock scan counts queued tenants as blocked.  The session
+        # stays enqueued by (priority, arrival) for the whole wait —
+        # re-admissions keep their original arrival rank.
+        with ThreadStateRegistry.blocked_section():
+            got = self._slots.acquire(sess.priority, sess.session_id,
+                                      deadline, sess._check_cancelled)
+        if got:
+            sess.status = "admitted"
+            return AdmissionTicket(self._slots, sess)
+        raise QueryTimeout(
+            f"session {sess.session_id}: admission queue wait "
+            f"exceeded {timeout_s:g}s")
 
     def _reserve(self, sess: TenantSession, ctx: TaskContext):
         """Prove the estimated footprint fits NOW, through the full

@@ -1,0 +1,89 @@
+"""Length-prefixed JSON frames over a local stream socket.
+
+The front door's supervisor/worker protocol (serve/frontdoor.py ↔
+serve/worker.py) rides a Unix-domain socket per worker: each message is
+a little-endian ``u32`` byte length followed by that many bytes of
+UTF-8 JSON.  JSON (not pickle) on purpose — a crashed or compromised
+worker must not be able to make the supervisor execute anything, and
+every message stays greppable in a hexdump when debugging a dead fleet.
+
+Messages (``op`` discriminates):
+
+======== ============ ====================================================
+sender   op           payload
+======== ============ ====================================================
+worker   ``hello``    ``worker_id``, ``pid`` — sent once after connect
+super    ``ping``     ``t`` (echo token)
+worker   ``pong``     ``t``, ``stall_breaks`` (native stall-breaker
+                      epoch), ``live_sessions``, ``fired`` (injection
+                      trace so far)
+super    ``submit``   ``sid``, ``kind``, ``params``, ``tenant``,
+                      ``priority``, ``est_bytes``, ``timeout_s``
+worker   ``running``  ``sid`` — the session left the admission queue
+worker   ``result``   ``sid``, ``ok``, ``value`` | ``error``/``message``,
+                      ``status``
+super    ``cancel``   ``sid``
+super    ``shutdown`` —
+worker   ``bye``      ``clean``, ``residue``, ``store_len``,
+                      ``leftovers``, ``fired``
+======== ============ ====================================================
+
+``send_msg`` takes an optional lock so a worker's result watchers and
+its main loop can share one socket without interleaving frames.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from typing import Optional
+
+_HDR = struct.Struct("<I")
+# a frame is control-plane metadata, never bulk data; anything bigger is
+# a protocol bug or a corrupted length prefix
+MAX_FRAME = 16 << 20
+
+
+class WireError(ConnectionError):
+    """The peer closed mid-frame or sent an impossible length."""
+
+
+def send_msg(sock: socket.socket, obj: dict,
+             lock: Optional[threading.Lock] = None):
+    data = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    if len(data) > MAX_FRAME:
+        raise WireError(f"frame of {len(data)}B exceeds {MAX_FRAME}B")
+    frame = _HDR.pack(len(data)) + data
+    if lock is not None:
+        with lock:
+            sock.sendall(frame)
+    else:
+        sock.sendall(frame)
+
+
+def recv_msg(sock: socket.socket) -> dict:
+    """Read one frame; raises :class:`WireError` on EOF/garbage and lets
+    ``socket.timeout`` through so pollers can keep ticking."""
+    (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    if n > MAX_FRAME:
+        raise WireError(f"frame length {n} exceeds {MAX_FRAME}")
+    return json.loads(_recv_exact(sock, n).decode("utf-8"))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except socket.timeout:
+            if buf:
+                # mid-frame: keep reading or we'd desync the stream;
+                # only a timeout BETWEEN frames surfaces to the poller
+                continue
+            raise
+        if not chunk:
+            raise WireError("peer closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)

@@ -1,0 +1,333 @@
+"""Executor worker process: one ``ServeRuntime`` behind a socket.
+
+Spawned by :class:`~spark_rapids_jni_tpu.serve.frontdoor.FrontDoor` as
+``python -m spark_rapids_jni_tpu.serve.worker --socket ... --dir ...``.
+Each worker owns the full single-process stack — its own arena (device +
+host pools), spill store rooted under its private directory, plan cache,
+and ``ServeRuntime`` — so a crash or wedge takes down exactly one
+process's tenants and nothing shared.
+
+Submissions arrive as ``{"kind": name, "params": {...}}`` and are looked
+up in the worker-side query-kind registry (:func:`register_query_kind`)
+— the wire carries only JSON, never code.  Built-in kinds:
+
+* ``echo``   — returns ``params["value"]`` (protocol smoke test)
+* ``sleep``  — cooperative busy-wait for ``params["seconds"]``
+* ``spill_walk`` — builds a batch from ``params["seed"]``, walks it
+  device→host→disk and back through the spill tiers, returns a sha256
+  digest of the promoted bytes (the chaos scenario's workload: the
+  digest is a pure function of the seed, so survivors are comparable
+  bit-for-bit across worker kills)
+* ``q6_digest`` — the bench workload: ``steps`` q6 steps over
+  deterministic example batches, returns ``[digest, seconds]`` exactly
+  like ``bench.py --serve``'s in-process queries
+
+Fault injection: the supervisor exports its live schedule into this
+process via ``SPARK_RAPIDS_TPU_FAULT_CONFIG`` and points
+``SPARK_RAPIDS_TPU_FAULT_MIRROR`` at a per-worker append-only trace, so
+an injection survives even our own SIGKILL.  This module installs the
+process-level hooks for the ``worker_crash`` (kill -9 self) and
+``worker_stall`` (wedge: stop answering heartbeats, block the querying
+thread forever) kinds via :func:`faultinj.set_worker_fault_hooks`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import importlib
+import os
+import signal
+import socket
+import sys
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+_QUERY_KINDS: Dict[str, Callable] = {}
+
+_WEDGED = threading.Event()
+
+
+def register_query_kind(name: str, fn: Callable):
+    """Register ``fn(ctx, params, sess)`` under ``name`` for submissions."""
+    _QUERY_KINDS[name] = fn
+
+
+def _qk_echo(ctx, params, sess):
+    return params.get("value")
+
+
+def _qk_sleep(ctx, params, sess):
+    end = time.monotonic() + float(params.get("seconds", 0.1))
+    while time.monotonic() < end:
+        sess._check_cancelled()
+        time.sleep(0.01)
+    return "slept"
+
+
+def _qk_spill_walk(ctx, params, sess):
+    import numpy as np
+
+    from ..mem import spill as spill_mod
+
+    seed = int(params.get("seed", 0))
+    rows = int(params.get("rows", 8192))
+    src = (np.arange(rows, dtype=np.int64) * (seed + 5)) % 7919
+
+    def make():
+        import jax.numpy as jnp
+        return {"x": jnp.asarray(src)}
+
+    h = spill_mod.SpillableHandle(make(), ctx=ctx,
+                                  name=f"worker-walk-{seed}",
+                                  recompute=make)
+    # full tier walk: device→host→disk, then promote back and hash
+    h.spill()
+    h.spill_host()
+    out = np.asarray(h.get()["x"])
+    h.close()
+    dig = hashlib.sha256()
+    dig.update(str(out.dtype).encode())
+    dig.update(str(out.shape).encode())
+    dig.update(np.ascontiguousarray(out).tobytes())
+    return dig.hexdigest()
+
+
+_Q6_JIT: list = []
+
+
+def _qk_q6_digest(ctx, params, sess):
+    import jax
+    import numpy as np
+
+    import __graft_entry__ as ge
+    from .. import mem
+
+    rows = int(params.get("rows", 1 << 14))
+    stream = int(params.get("stream", 0))
+    query = int(params.get("query", 0))
+    steps = int(params.get("steps", 2))
+    if not _Q6_JIT:
+        _Q6_JIT.append(jax.jit(ge._q6_step))
+    jfn = _Q6_JIT[0]
+    t0 = time.perf_counter()
+    dig = hashlib.sha256()
+    for s in range(steps):
+        b = ge._example_batch(rows, seed=1000 * stream + 10 * query + s)
+        h = mem.SpillableHandle(
+            b, ctx=ctx, name=f"worker-q6-{stream}-{query}-{s}")
+        out = jax.block_until_ready(jfn(b))
+        for leaf in jax.tree_util.tree_leaves(out):
+            a = np.asarray(jax.device_get(leaf))
+            dig.update(str(a.dtype).encode())
+            dig.update(str(a.shape).encode())
+            dig.update(np.ascontiguousarray(a).tobytes())
+        h.close()
+    return [dig.hexdigest(), time.perf_counter() - t0]
+
+
+register_query_kind("echo", _qk_echo)
+register_query_kind("sleep", _qk_sleep)
+register_query_kind("spill_walk", _qk_spill_walk)
+register_query_kind("q6_digest", _qk_q6_digest)
+
+
+def _crash_hook(name: str):
+    # kill -9 semantics: no unwind, no atexit, no spill cleanup — the
+    # supervisor's reaper is the only recovery path
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def _stall_hook(name: str):
+    # wedge: the main loop stops answering pings (so the supervisor's
+    # heartbeat detector — not any in-process cleanup — must catch us),
+    # and the querying thread blocks forever
+    _WEDGED.set()
+    while True:
+        time.sleep(60.0)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--socket", required=True)
+    ap.add_argument("--worker-id", required=True, type=int)
+    ap.add_argument("--dir", required=True)
+    ap.add_argument("--pool-bytes", type=int, default=64 << 20)
+    ap.add_argument("--host-pool-bytes", type=int, default=16 << 20)
+    ap.add_argument("--max-concurrent", type=int, default=0)
+    ap.add_argument("--task-id-base", type=int, default=10_000)
+    ap.add_argument("--setup", default=None,
+                    help="module whose register_query_kinds(register) "
+                         "adds custom kinds before serving")
+    args = ap.parse_args(argv)
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    from .. import faultinj
+    faultinj.configure()  # env: the supervisor's exported schedule
+    faultinj.set_worker_fault_hooks(crash=_crash_hook, stall=_stall_hook)
+
+    from ..mem import spill as spill_mod
+    from ..mem.rmm_spark import RmmSpark
+    from . import wire
+    from .runtime import ServeRuntime
+
+    if args.setup:
+        importlib.import_module(args.setup).register_query_kinds(
+            register_query_kind)
+
+    spill_dir = os.path.join(args.dir, "spill")
+    os.makedirs(spill_dir, exist_ok=True)
+    adaptor = RmmSpark.set_event_handler(
+        args.pool_bytes, host_pool_bytes=args.host_pool_bytes, poll_ms=20.0)
+    fw = spill_mod.install(spill_dir=spill_dir)
+    runtime = ServeRuntime(
+        max_concurrent=args.max_concurrent or None,
+        task_id_base=args.task_id_base)
+
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.connect(args.socket)
+    sock.settimeout(0.05)  # poll tick: lets the wedge flag win the loop
+    send_lock = threading.Lock()
+    wire.send_msg(sock, {"op": "hello", "worker_id": args.worker_id,
+                         "pid": os.getpid()}, send_lock)
+
+    sessions: Dict[int, object] = {}
+    watchers: list = []
+    # lifecycle points unique to the process boundary: a submission was
+    # received (session not yet created) and a result is about to be
+    # sent (query done, result undelivered) — chaos lands worker_crash
+    # on both to prove the supervisor's re-place / WorkerLost split at
+    # each end of a session's life
+    recv_probe = faultinj.instrument(lambda: None, "worker_recv")
+    result_probe = faultinj.instrument(lambda: None, "worker_result")
+
+    def watch(sid: int, sess):
+        sess._done.wait()
+        try:
+            result_probe()  # chaos: crash with the result undelivered
+            if sess.error is None:
+                msg = {"op": "result", "sid": sid, "ok": True,
+                       "value": sess.result_value, "status": sess.status}
+            else:
+                msg = {"op": "result", "sid": sid, "ok": False,
+                       "status": sess.status,
+                       "error": type(sess.error).__name__,
+                       "message": str(sess.error)}
+        except BaseException as e:  # a non-crash kind fired at the probe
+            msg = {"op": "result", "sid": sid, "ok": False,
+                   "status": "failed", "error": type(e).__name__,
+                   "message": str(e)}
+        try:
+            wire.send_msg(sock, msg, send_lock)
+        except OSError:
+            pass  # supervisor gone; it will reap us
+
+    def handle_submit(msg: dict):
+        sid = int(msg["sid"])
+        kind = _QUERY_KINDS.get(msg.get("kind"))
+        if kind is None:
+            wire.send_msg(sock, {
+                "op": "result", "sid": sid, "ok": False, "status": "failed",
+                "error": "ServeError",
+                "message": f"unknown query kind {msg.get('kind')!r}",
+            }, send_lock)
+            return
+        params = msg.get("params") or {}
+        announced = threading.Event()
+
+        def query(ctx, sess):
+            if not announced.is_set():
+                announced.set()
+                try:
+                    wire.send_msg(sock, {"op": "running", "sid": sid},
+                                  send_lock)
+                except OSError:
+                    pass
+            return kind(ctx, params, sess)
+
+        try:
+            sess = runtime.submit(
+                query, est_bytes=int(msg.get("est_bytes") or 0),
+                tenant=msg.get("tenant"), timeout_s=msg.get("timeout_s"),
+                priority=int(msg.get("priority") or 0))
+        except BaseException as e:
+            wire.send_msg(sock, {
+                "op": "result", "sid": sid, "ok": False, "status": "failed",
+                "error": type(e).__name__, "message": str(e)}, send_lock)
+            return
+        sessions[sid] = sess
+        t = threading.Thread(target=watch, args=(sid, sess),
+                             name=f"worker-watch-{sid}", daemon=True)
+        watchers.append(t)
+        t.start()
+
+    # -- main loop -------------------------------------------------------
+    while True:
+        if _WEDGED.is_set():
+            # simulated interpreter wedge: stop answering everything;
+            # only the supervisor's SIGKILL ends this process
+            while True:
+                time.sleep(60.0)
+        try:
+            msg = wire.recv_msg(sock)
+        except socket.timeout:
+            continue
+        except (wire.WireError, OSError):
+            break  # supervisor died: exit; our spill dir dies with us
+        op = msg.get("op")
+        if op == "ping":
+            try:
+                wire.send_msg(sock, {
+                    "op": "pong", "t": msg.get("t"),
+                    "stall_breaks": RmmSpark.stall_break_count(),
+                    "live_sessions": sum(
+                        1 for s in sessions.values() if not s.done()),
+                    "fired": faultinj.fired_log(),
+                }, send_lock)
+            except OSError:
+                break
+        elif op == "submit":
+            try:
+                recv_probe()  # chaos: crash before the session exists
+            except BaseException as e:
+                wire.send_msg(sock, {
+                    "op": "result", "sid": int(msg["sid"]), "ok": False,
+                    "status": "failed", "error": type(e).__name__,
+                    "message": str(e)}, send_lock)
+                continue
+            handle_submit(msg)
+        elif op == "cancel":
+            sess = sessions.get(int(msg.get("sid", -1)))
+            if sess is not None and not sess.done():
+                runtime.cancel(sess)
+        elif op == "shutdown":
+            break
+
+    # -- graceful drain --------------------------------------------------
+    clean = runtime.shutdown()
+    for t in watchers:
+        t.join(timeout=5.0)
+    residue = [adaptor.total_allocated(), adaptor.host_total_allocated()]
+    store_len = len(fw.store)
+    leftovers = sorted(os.listdir(spill_dir)) if os.path.isdir(
+        spill_dir) else []
+    spill_mod.shutdown()
+    RmmSpark.clear_event_handler()
+    try:
+        wire.send_msg(sock, {
+            "op": "bye", "clean": bool(clean), "residue": residue,
+            "store_len": store_len, "leftovers": leftovers,
+            "fired": faultinj.fired_log(),
+        }, send_lock)
+    except OSError:
+        pass
+    sock.close()
+    return 0 if clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -218,3 +218,79 @@ def test_concurrent_configure_and_check_is_safe():
     for t in threads:
         t.join()
     assert errors == []
+
+
+def test_worker_kinds_raise_loudly_without_hooks():
+    """Outside a worker process (no hooks installed) the worker kinds
+    are loud exceptions, never silent no-ops."""
+    faultinj.configure({"faults": [
+        {"match": "a", "fault": "worker_crash", "count": 1},
+        {"match": "b", "fault": "worker_stall", "count": 1},
+    ]})
+    a = faultinj.instrument(lambda: "x", "a")
+    b = faultinj.instrument(lambda: "x", "b")
+    with pytest.raises(faultinj.WorkerCrash):
+        a()
+    with pytest.raises(faultinj.WorkerStalled):
+        b()
+    assert a() == "x" and b() == "x"
+
+
+def test_worker_hooks_intercept(monkeypatch):
+    calls = []
+    faultinj.set_worker_fault_hooks(crash=lambda name: calls.append(name))
+    try:
+        faultinj.configure({"faults": [
+            {"match": "*", "fault": "worker_crash", "count": 1}]})
+        f = faultinj.instrument(lambda: "x", "probe")
+        # a real hook never returns (SIGKILL); one that does falls back
+        # to the loud exception so a broken hook can't mask the fault
+        with pytest.raises(faultinj.WorkerCrash):
+            f()
+        assert calls == ["probe"]
+    finally:
+        faultinj.set_worker_fault_hooks()
+
+
+def test_current_config_round_trips():
+    cfg = {"seed": 7, "faults": [
+        {"match": "x*", "fault": "oom", "count": 2, "skip": 1}]}
+    faultinj.configure(cfg)
+    out = faultinj.current_config()
+    assert out["seed"] == 7
+    assert out["faults"] == cfg["faults"]
+    # exporting → configuring a child with it is the cross-process path
+    faultinj.configure(out)
+    assert faultinj.current_config()["faults"] == cfg["faults"]
+
+
+def test_record_external_merges_worker_trace():
+    faultinj.configure({})
+    faultinj.record_external(
+        [{"name": "serve_step", "match": "serve_step",
+          "fault": "worker_crash", "occurrence": 1}],
+        source="worker-0-1")
+    log = faultinj.fired_log()
+    assert len(log) == 1
+    assert log[0]["fault"] == "worker_crash"
+    assert log[0]["source"] == "worker-0-1"
+    assert sum(faultinj.fire_counts().values()) == 1
+
+
+def test_mirror_file_written_at_fire_time(tmp_path, monkeypatch):
+    """With SPARK_RAPIDS_TPU_FAULT_MIRROR set, every fire lands in the
+    append-only mirror BEFORE the raiser runs — the trace a supervisor
+    reads back after SIGKILLing the process."""
+    mirror = tmp_path / "fired.jsonl"
+    monkeypatch.setenv(faultinj.ENV_MIRROR, str(mirror))
+    # a fresh injector picks the env var up at construction
+    inj = faultinj._Injector()
+    inj.configure({"faults": [
+        {"match": "*", "fault": "exception", "count": 1}]})
+    with pytest.raises(faultinj.InjectedFault):
+        inj.check("probe")
+    lines = [json.loads(ln) for ln in
+             mirror.read_text().strip().splitlines()]
+    assert len(lines) == 1
+    assert lines[0]["name"] == "probe"
+    assert lines[0]["fault"] == "exception"

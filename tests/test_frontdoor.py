@@ -1,0 +1,229 @@
+"""Multi-process serving front door tests.
+
+The robustness core of the front-door PR: supervised executor worker
+processes behind a Unix-socket protocol — crash detection via
+heartbeats + waitpid, session re-placement through the bounded backoff
+ladder, the loud :class:`WorkerLost` contract for non-replayable
+victims, load shedding under lost capacity, and the fleet-wide
+zero-orphan shutdown report.
+
+Each test spawns real worker processes (each imports jax), so the
+fixtures keep fleets small and heartbeats fast.
+"""
+
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from spark_rapids_jni_tpu import config, faultinj
+from spark_rapids_jni_tpu.serve import (
+    AdmissionShed,
+    FrontDoor,
+    ServeError,
+    WorkerLost,
+    fleet_metrics,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fast_ladder():
+    config.set("serve_backoff_ms", 40.0)
+    yield
+    config.reset("serve_backoff_ms")
+    faultinj.configure(None)
+
+
+def _poll(pred, timeout=15.0, interval=0.02):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def _no_stragglers():
+    return _poll(lambda: not [t.name for t in threading.enumerate()
+                              if t.name.startswith("frontdoor-")],
+                 timeout=3.0)
+
+
+class TestHappyPath:
+    def test_echo_roundtrip_pinning_and_clean_shutdown(self):
+        fd = FrontDoor(workers=2, heartbeat_ms=80.0)
+        try:
+            sessions = [fd.submit("echo", {"value": f"v{i}"},
+                                  tenant=f"t{i % 2}") for i in range(6)]
+            assert [s.result(timeout=60) for s in sessions] == \
+                [f"v{i}" for i in range(6)]
+            # sticky pinning: every session of a tenant on ONE worker
+            for tenant in ("t0", "t1"):
+                workers = {s.worker_id for i, s in enumerate(sessions)
+                           if f"t{i % 2}" == tenant}
+                assert len(workers) == 1, (tenant, workers)
+        finally:
+            report = fd.shutdown()
+        assert report["clean"], report
+        assert report["orphan_spill_files"] == []
+        assert all(e["clean"] for e in report["workers"].values())
+        assert not os.path.exists(fd.fleet_dir)
+        # idempotent: the second call returns the first report
+        assert fd.shutdown() == report
+        with pytest.raises(ServeError):
+            fd.submit("echo", {"value": "late"}).result(timeout=1)
+        assert _no_stragglers()
+
+    def test_unknown_kind_fails_loudly(self):
+        fd = FrontDoor(workers=1, heartbeat_ms=80.0)
+        try:
+            with pytest.raises(ServeError, match="unknown query kind"):
+                fd.submit("no_such_kind", {}).result(timeout=60)
+        finally:
+            assert fd.shutdown()["clean"]
+
+
+class TestWorkerLoss:
+    def test_crash_replaces_replayable_session(self):
+        """A worker that SIGKILLs itself mid-query is detected, its
+        spill dir reaped, the session re-placed onto the respawned
+        worker, and the merged fired_log carries the worker's trace."""
+        faultinj.configure({"faults": [
+            {"match": "serve_step", "fault": "worker_crash", "count": 1},
+        ]})
+        fd = FrontDoor(workers=1, heartbeat_ms=80.0)
+        try:
+            s = fd.submit("spill_walk", {"seed": 3}, tenant="t0",
+                          replayable=True)
+            digest = s.result(timeout=90)
+            assert s.replacements >= 1
+            assert s.status == "done"
+            # determinism across the replacement: same seed, same digest
+            s2 = fd.submit("spill_walk", {"seed": 3}, tenant="t0")
+            assert s2.result(timeout=90) == digest
+        finally:
+            report = fd.shutdown()
+        assert report["clean"], report
+        assert report["fleet"]["crashes"] == 1
+        assert report["fleet"]["respawns"] == 1
+        fired = faultinj.fired_log()
+        assert any(e.get("fault") == "worker_crash"
+                   and str(e.get("source", "")).startswith("worker-")
+                   for e in fired)
+
+    def test_crash_fails_nonreplayable_with_worker_lost(self):
+        """A non-replayable session whose worker dies with the result
+        undelivered fails loudly with WorkerLost carrying the dead
+        worker's fired_log — never a silent re-run."""
+        faultinj.configure({"faults": [
+            {"match": "worker_result", "fault": "worker_crash",
+             "count": 1},
+        ]})
+        fd = FrontDoor(workers=1, heartbeat_ms=80.0)
+        try:
+            s = fd.submit("sleep", {"seconds": 0.2}, tenant="t0",
+                          replayable=False)
+            with pytest.raises(WorkerLost) as exc:
+                s.result(timeout=90)
+            assert exc.value.worker_id == 0
+            assert any(e.get("fault") == "worker_crash"
+                       for e in exc.value.fired_log)
+        finally:
+            report = fd.shutdown()
+        assert report["clean"], report
+        assert report["fleet"]["worker_lost"] == 1
+
+    def test_stall_detected_and_session_replaced(self):
+        """A wedged worker (stops answering heartbeats) is SIGKILLed by
+        the monitor and its session re-placed — the supervisor's
+        detector, not any in-process cleanup, ends the wedge."""
+        faultinj.configure({"faults": [
+            {"match": "serve_step", "fault": "worker_stall", "count": 1},
+        ]})
+        fd = FrontDoor(workers=1, heartbeat_ms=60.0)
+        try:
+            s = fd.submit("spill_walk", {"seed": 9}, tenant="t0")
+            assert s.result(timeout=90)
+            assert s.replacements >= 1
+        finally:
+            report = fd.shutdown()
+        assert report["clean"], report
+        assert report["fleet"]["stalls"] == 1
+
+
+class TestDegradation:
+    def test_shed_lowest_priority_when_capacity_lost(self):
+        """With one of two single-slot workers dead and its respawn
+        circuit open, pending admissions beyond the surviving capacity
+        are shed lowest-priority-first."""
+        fd = FrontDoor(workers=2, max_concurrent=1, respawn_max=0,
+                       shed_threshold=0.6, heartbeat_ms=60.0)
+        try:
+            assert _poll(lambda: sum(
+                1 for w in fd._workers.values()
+                if w.state == "healthy") == 2)
+            busy = [fd.submit("sleep", {"seconds": 3.0}, tenant=f"b{i}")
+                    for i in range(2)]
+            assert _poll(lambda: all(
+                s.worker_id is not None for s in busy), timeout=10.0)
+            hi = fd.submit("echo", {"value": "hi"}, tenant="b0",
+                           priority=5)
+            lo = fd.submit("echo", {"value": "lo"}, tenant="b1",
+                           priority=0)
+            with fd._lock:
+                pid = fd._workers[1].proc.pid
+            os.kill(pid, signal.SIGKILL)
+            with pytest.raises(AdmissionShed):
+                lo.result(timeout=30)
+            assert lo.status == "shed"
+            assert hi.result(timeout=30) == "hi"
+        finally:
+            report = fd.shutdown()
+        assert report["fleet"]["sheds"] >= 1
+        assert report["fleet"]["circuit_open"] == 1
+
+    def test_fleet_exhausted_fails_pending_with_worker_lost(self):
+        """All workers dead with the breaker open: pending sessions
+        fail with WorkerLost instead of hanging forever."""
+        fd = FrontDoor(workers=1, max_concurrent=1, respawn_max=0,
+                       heartbeat_ms=60.0)
+        try:
+            assert _poll(lambda: any(
+                w.state == "healthy" for w in fd._workers.values()))
+            hold = fd.submit("sleep", {"seconds": 5.0}, tenant="t0")
+            assert _poll(lambda: hold.worker_id is not None, timeout=10.0)
+            queued = fd.submit("echo", {"value": "q"}, tenant="t1")
+            with fd._lock:
+                pid = fd._workers[0].proc.pid
+            os.kill(pid, signal.SIGKILL)
+            with pytest.raises(WorkerLost):
+                queued.result(timeout=30)
+        finally:
+            fd.shutdown()
+
+
+class TestFleetMetrics:
+    def test_zeros_safe_surface(self):
+        snap = fleet_metrics()
+        for field in ("workers_spawned", "crashes", "stalls", "sheds",
+                      "respawns", "worker_lost", "circuit_open",
+                      "replacements"):
+            assert field in snap and snap[field] >= 0
+        from spark_rapids_jni_tpu.mem.rmm_spark import RmmSpark
+        assert RmmSpark.fleet_metrics() == fleet_metrics()
+        from spark_rapids_jni_tpu.profiler import fleet_summary
+        summary = fleet_summary()
+        assert summary["workers_spawned"] >= 0
+        assert "liveness" in summary
+
+    def test_counters_track_a_fleet(self):
+        fd = FrontDoor(workers=1, heartbeat_ms=80.0)
+        try:
+            fd.submit("echo", {"value": "x"}).result(timeout=60)
+        finally:
+            fd.shutdown()
+        snap = fleet_metrics()
+        assert snap["workers_spawned"] == 1
+        assert snap["liveness"] == {0: "shutdown"}

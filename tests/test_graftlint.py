@@ -816,6 +816,87 @@ class TestGL011:
 
 
 # ---------------------------------------------------------------------------
+# GL012 — front-door handle leak
+# ---------------------------------------------------------------------------
+
+
+class TestGL012:
+    def test_discarded_door_and_session_flagged(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            from spark_rapids_jni_tpu.serve import FrontDoor
+
+            def fire_and_forget(params):
+                fd = FrontDoor(workers=2)
+                fd.submit("echo", params)
+        """}, rules=["GL012"])
+        # worker processes never shut down AND the session is discarded
+        assert [f.rule for f in res.new] == ["GL012", "GL012"]
+
+    def test_unobserved_session_flagged(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            from spark_rapids_jni_tpu.serve import FrontDoor
+
+            def wave(params):
+                fd = FrontDoor()
+                try:
+                    s = fd.submit("echo", params)
+                finally:
+                    fd.shutdown()
+        """}, rules=["GL012"])
+        assert new_rules(res) == [("GL012", "mod.py")]
+
+    def test_discarded_worker_handle_flagged(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            from spark_rapids_jni_tpu.serve.frontdoor import WorkerHandle
+
+            def respawn(slot, gen, wdir, proc):
+                w = WorkerHandle(slot, gen, wdir, proc)
+        """}, rules=["GL012"])
+        assert new_rules(res) == [("GL012", "mod.py")]
+
+    def test_released_stored_and_unknown_receiver_clean(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            from spark_rapids_jni_tpu.serve import FrontDoor
+            from spark_rapids_jni_tpu.serve.frontdoor import WorkerHandle
+
+            def wave(params):
+                fd = FrontDoor()
+                try:
+                    s = fd.submit("echo", params)
+                    return s.result(timeout=30.0)
+                finally:
+                    fd.shutdown()
+
+            def cancelled(params):
+                fd = FrontDoor()
+                s = fd.submit("echo", params)
+                fd.cancel(s)          # session passed on: escapes
+                fd.shutdown()
+
+            def spawn(self, slot, gen, wdir, proc):
+                w = WorkerHandle(slot, gen, wdir, proc)
+                self._workers[slot] = w   # stored: the supervisor owns it
+
+            def killed(slot, gen, wdir, proc):
+                w = WorkerHandle(slot, gen, wdir, proc)
+                w.kill()
+
+            def other_pools(q, ex):
+                ex.submit(q)          # unknown receiver: not a front door
+        """}, rules=["GL012"])
+        assert res.new == []
+
+    def test_suppression_comment(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            from spark_rapids_jni_tpu.serve import FrontDoor
+
+            def leak():
+                FrontDoor()  # graftlint: disable=GL012
+        """}, rules=["GL012"])
+        assert res.new == [] and res.counts()["suppressed"] == 1
+
+
+# ---------------------------------------------------------------------------
 # baseline ratchet
 # ---------------------------------------------------------------------------
 
@@ -930,4 +1011,4 @@ class TestLiveTree:
         from tools.graftlint import rules as rules_mod
         ids = [r.id for r in rules_mod.all_rules()]
         assert ids == ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
-                       "GL007", "GL008", "GL009", "GL010", "GL011"]
+                       "GL007", "GL008", "GL009", "GL010", "GL011", "GL012"]

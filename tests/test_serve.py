@@ -364,3 +364,143 @@ class TestDrainLaneOverlap:
             assert arena.total_allocated() == 0
         finally:
             config.set("shuffle_capacity_bucket", old_bucket)
+
+
+class TestPriorityAdmission:
+    def test_higher_priority_overtakes_queue(self, arena):
+        """Two tenants queued behind a full runtime are granted in
+        (priority, arrival) order, not FIFO: the later, higher-priority
+        submission runs first."""
+        rt = ServeRuntime(max_concurrent=1)
+        try:
+            gate = threading.Event()
+            order = []
+            hold = rt.submit(lambda ctx: (gate.wait(15), "held")[1])
+            assert _poll(lambda: hold.status == "running")
+            lo = rt.submit(lambda ctx: order.append("lo"), priority=0)
+            assert _poll(lambda: rt._slots.waiting() == 1, timeout=2.0)
+            hi = rt.submit(lambda ctx: order.append("hi"), priority=5)
+            assert _poll(lambda: rt._slots.waiting() == 2, timeout=2.0)
+            gate.set()
+            hi.result(timeout=10)
+            lo.result(timeout=10)
+            assert order == ["hi", "lo"]
+        finally:
+            assert rt.shutdown()
+
+    def test_eviction_rank_prefers_low_priority(self, arena):
+        """While a session runs, its spill-store eviction rank is
+        dominated by its SLA class: a higher-priority tenant's handles
+        outrank (evict later than) a lower-priority one's."""
+        from spark_rapids_jni_tpu.mem import spill as spill_mod
+
+        fw = spill_mod.install()
+        rt = ServeRuntime()
+        try:
+            ranks = {}
+
+            def q(tag):
+                def body(ctx, sess):
+                    ranks[tag] = fw.store.task_priority(sess.task_id)
+                    return tag
+                return body
+
+            rt.submit(q("lo"), priority=0).result(timeout=10)
+            rt.submit(q("hi"), priority=3).result(timeout=10)
+            # class dominates: 3e6 minus any admission sequence beats 0e6
+            assert ranks["hi"] > ranks["lo"]
+            assert ranks["hi"] >= 3e6 - 1e6 / 2
+        finally:
+            assert rt.shutdown()
+            spill_mod.shutdown()
+
+
+class TestShutdownIdempotence:
+    def test_second_call_returns_first_result(self, arena):
+        rt = ServeRuntime()
+        assert rt.submit(lambda ctx: "x").result(timeout=10) == "x"
+        first = rt.shutdown()
+        second = rt.shutdown()
+        assert first is True and second is True
+
+    def test_racing_shutdowns_agree(self, arena):
+        rt = ServeRuntime()
+        rt.submit(lambda ctx: "x").result(timeout=10)
+        results = []
+        threads = [threading.Thread(
+            target=lambda: results.append(rt.shutdown()))
+            for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=15)
+        assert results == [True] * 4
+
+    def test_submit_after_shutdown_raises(self, arena):
+        from spark_rapids_jni_tpu.serve import ServeError
+
+        rt = ServeRuntime()
+        rt.shutdown()
+        with pytest.raises(ServeError):
+            rt.submit(lambda ctx: "late").result(timeout=1)
+
+
+class TestReadmissionBackoff:
+    def test_backoff_actually_waits(self, arena):
+        """The re-admission ladder really sleeps serve_backoff_ms
+        (doubling): with 200ms base and two readmissions the second
+        attempt cannot start before ~200ms after the first kill."""
+        config.set("serve_backoff_ms", 200.0)
+        rt = ServeRuntime()
+        try:
+            stamps = []
+
+            def q(ctx, sess):
+                stamps.append(time.monotonic())
+                end = time.monotonic() + (
+                    10.0 if sess.attempts == 1 else 0.0)
+                while time.monotonic() < end:
+                    sess._check_cancelled()
+                    time.sleep(0.01)
+                return "done"
+
+            s = rt.submit(q, timeout_s=0.15)
+            assert s.result(timeout=20) == "done"
+            assert len(stamps) == 2
+            # attempt 2 started >= backoff after attempt 1 STARTED
+            # (timeout fired ~0.15s in, then the 0.2s ladder wait)
+            assert stamps[1] - stamps[0] >= 0.15 + 0.2 - 0.02
+        finally:
+            assert rt.shutdown()
+            config.reset("serve_backoff_ms")
+
+    def test_cancel_during_backoff_unwinds_immediately(self, arena):
+        """A cancel landing while the session sleeps in the backoff
+        ladder must not wait the ladder out: with a 5s base the session
+        unwinds in well under a second."""
+        config.set("serve_backoff_ms", 5000.0)
+        rt = ServeRuntime()
+        try:
+            killed = threading.Event()
+
+            def q(ctx, sess):
+                killed.set()
+                end = time.monotonic() + 10.0
+                while time.monotonic() < end:
+                    sess._check_cancelled()
+                    time.sleep(0.01)
+                return "never"
+
+            s = rt.submit(q, timeout_s=0.1)
+            assert killed.wait(10)
+            # let the timeout fire and the backoff sleep begin
+            assert _poll(lambda: s.attempts >= 1 and killed.is_set())
+            time.sleep(0.3)
+            t0 = time.monotonic()
+            rt.cancel(s)
+            with pytest.raises((QueryCancelled, QueryTimeout)):
+                s.result(timeout=10)
+            assert time.monotonic() - t0 < 2.0  # not the 5s ladder
+        finally:
+            assert rt.shutdown()
+            config.reset("serve_backoff_ms")

@@ -3,13 +3,16 @@
 The premerge gate (ci/chaos.sh) that proves the fault-domain story
 end-to-end, the way ci/q95_floor.json proves perf: it sweeps every
 registered ``faultinj.FAULT_KINDS`` entry across every instrumented
-boundary of seven scenarios — a spill walk (device→host→disk→back), an
+boundary of eight scenarios — a spill walk (device→host→disk→back), an
 out-of-core skewed shuffle, the single-chip q95 pipeline, a global
 distributed sort across the 8-device mesh, a JNI host-boundary
-round-trip, a streaming morsel scan, and a multi-tenant serving wave
+round-trip, a streaming morsel scan, a multi-tenant serving wave
 (concurrent sessions through the ServeRuntime, killed and re-submitted
-mid-flight) — one fault per trial exhaustively, plus ``chaos_trials``
-seeded multi-fault trials per scenario.  Every trial must end with
+mid-flight), and a multi-process front-door wave (supervised executor
+workers SIGKILLed/wedged at every session lifecycle point, sessions
+re-placed or loudly failed) — one fault per trial exhaustively, plus
+``chaos_trials`` seeded multi-fault trials per scenario.  Every trial
+must end with
 
 * a result **bit-identical** to the scenario's fault-free baseline
   (sha256 over every output leaf's dtype/shape/bytes), and
@@ -60,6 +63,7 @@ import random
 import shutil
 import tempfile
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
@@ -536,10 +540,103 @@ class ServingScenario:
                 "extra": {"tenant_kills": kills}}
 
 
+class FrontdoorScenario:
+    """A wave of tenants through the multi-process :class:`FrontDoor`:
+    each tenant's ``spill_walk`` query runs inside an executor WORKER
+    process (its own arena, spill store, and ServeRuntime), so the
+    faults this scenario absorbs cross the process boundary — including
+    ``worker_crash`` (the worker SIGKILLs itself mid-query) and
+    ``worker_stall`` (it wedges and stops answering heartbeats).  The
+    supervisor must detect the loss, reap the dead worker's spill files,
+    re-place replayable sessions through the bounded backoff ladder, and
+    respawn the slot; a loudly-failed victim (``WorkerLost`` — tenant 0
+    is declared non-replayable) is re-submitted by the CLIENT, the
+    multi-process analogue of the serving scenario's fresh session.
+    Survivors must stay bit-identical (the ``spill_walk`` digest is a
+    pure function of the seed), and shutdown must report every worker
+    clean with zero orphan spill files fleet-wide."""
+
+    name = "frontdoor"
+    n_tenants = 3
+    seeds = (11, 12, 13)
+
+    def run(self) -> Dict:
+        from spark_rapids_jni_tpu.mem import RetryOOM
+        from spark_rapids_jni_tpu.serve import (AdmissionShed, FrontDoor,
+                                                QueryCancelled, WorkerLost)
+
+        results: List[Optional[str]] = [None] * self.n_tenants
+        kills = 0
+        config.set("serve_backoff_ms", 30.0)
+        fd = FrontDoor(workers=2, pool_bytes=2 * MB,
+                       host_pool_bytes=512 * KB, max_concurrent=2,
+                       heartbeat_ms=60.0, respawn_max=4)
+        try:
+            pending = list(range(self.n_tenants))
+            attempts = {i: 0 for i in pending}
+            while pending:
+                wave = [(i, fd.submit(
+                    "spill_walk", {"seed": self.seeds[i], "rows": 8 * KB},
+                    tenant=f"tenant-{i}", priority=i,
+                    replayable=(i != 0))) for i in pending]
+                pending = []
+                for i, sess in wave:
+                    try:
+                        results[i] = sess.result(timeout=60.0)
+                    except faultinj.FatalInjectedFault:
+                        raise  # whole-scenario replacement
+                    except (WorkerLost, AdmissionShed,
+                            faultinj.TaskCancelled, faultinj.InjectedFault,
+                            QueryCancelled, RetryOOM):
+                        # a victim the supervisor could NOT silently
+                        # re-place (non-replayable mid-flight, budget
+                        # out, shed) fails loudly; the client re-submits
+                        kills += 1
+                        attempts[i] += 1
+                        if attempts[i] >= _MAX_ATTEMPTS:
+                            raise ChaosError(
+                                f"frontdoor: tenant {i} not done after "
+                                f"{_MAX_ATTEMPTS} re-submissions")
+                        pending.append(i)
+        finally:
+            report = fd.shutdown()
+            config.reset("serve_backoff_ms")
+        # the shutdown contract: every surviving worker drained its
+        # arena and spill store (its bye says so), and no spill file
+        # outlived its worker anywhere under the fleet dir
+        unclean = {wid: e for wid, e in report["workers"].items()
+                   if not e.get("clean")}
+        if unclean:
+            raise ChaosError(f"frontdoor: unclean workers: {unclean}")
+        if report["orphan_spill_files"]:
+            raise ChaosError(f"frontdoor: orphan spill files: "
+                             f"{report['orphan_spill_files']}")
+        if os.path.exists(fd.fleet_dir):
+            raise ChaosError("frontdoor: fleet dir survived shutdown")
+        for _ in range(40):  # reader threads exit async after close
+            stragglers = [t.name for t in threading.enumerate()
+                          if t.name.startswith("frontdoor-")]
+            if not stragglers:
+                break
+            time.sleep(0.05)
+        if stragglers:
+            raise ChaosError(
+                f"frontdoor: live supervisor threads after shutdown: "
+                f"{stragglers}")
+        h = hashlib.sha256()
+        for r in results:  # position-stable: tenant i's digest at slot i
+            h.update((r or "<none>").encode())
+        return {"digest": h.hexdigest(),
+                "extra": {"tenant_kills": kills,
+                          "fleet": {k: v for k, v in
+                                    report["fleet"].items()
+                                    if k != "liveness"}}}
+
+
 SCENARIOS = {s.name: s for s in (SpillScenario(), ShuffleScenario(),
                                  Q95Scenario(), SortScenario(),
                                  StreamingScanScenario(), JniScenario(),
-                                 ServingScenario())}
+                                 ServingScenario(), FrontdoorScenario())}
 
 
 # ---------------------------------------------------------------------------
@@ -667,6 +764,27 @@ def single_fault_trials(fast: bool = False) -> List[Trial]:
         one("serving", "spill_io_read", "spill_io")
         one("serving", "host_corrupt_probe", "host_corrupt")
         one("serving", "spill_corrupt_file", "spill_corrupt", skip=1)
+
+    # frontdoor scenario: worker kills at every lifecycle point of the
+    # process boundary — submission received (worker_recv), queued
+    # (serve_admit), mid-query (serve_step), mid-spill-write, and result
+    # computed but undelivered (worker_result) — plus the wedge kind and
+    # the in-worker abort/recover set.  worker_crash / worker_stall fire
+    # ONLY here: these trials keep both kinds in the coverage check.
+    # Each worker process runs its own occurrence clock, so a count=1
+    # rule can fire once in EVERY initial worker; the supervisor
+    # re-exports counts minus fleet-wide fires to respawned workers,
+    # which is what makes crash trials converge instead of looping.
+    if not fast:
+        for match in ("worker_recv", "serve_admit", "serve_step",
+                      "spill_io_write", "worker_result"):
+            one("frontdoor", match, "worker_crash")
+        one("frontdoor", "serve_step", "worker_stall")
+        one("frontdoor", "serve_step", "task_cancel")
+        one("frontdoor", "serve_step", "exception")
+        one("frontdoor", "serve_step", "oom")
+        one("frontdoor", "spill_io_write", "spill_io")
+        one("frontdoor", "spill_corrupt_file", "spill_corrupt")
     return t
 
 
@@ -693,6 +811,10 @@ _MULTI_POOL = {
                 ("serve_step", "exception"),
                 ("spill_io_write", "spill_io"),
                 ("spill_corrupt_file", "spill_corrupt")],
+    "frontdoor": [("serve_step", "worker_crash"), ("serve_step", "oom"),
+                  ("serve_step", "task_cancel"),
+                  ("spill_io_write", "spill_io"),
+                  ("spill_corrupt_file", "spill_corrupt")],
 }
 
 

@@ -463,7 +463,7 @@ class GL004SpillHandleLeak(Rule):
 
 def _name_escapes(fn, assign_node, var: str,
                   close_methods: Set[str]) -> bool:
-    """Shared GL004/GL011 escape analysis: does ``var`` (bound by
+    """Shared GL004/GL011/GL012 escape analysis: does ``var`` (bound by
     ``assign_node``) ever get closed via ``close_methods``, returned,
     yielded, passed on, stored, aliased, or used as a context manager
     anywhere in ``fn``?"""
@@ -1227,13 +1227,120 @@ class GL011ServeSessionLeak(Rule):
                         "unobservable")
 
 
+# ---------------------------------------------------------------------------
+# GL012 — front-door handle leak
+# ---------------------------------------------------------------------------
+
+_FRONTDOOR_CLASSES = {"FrontDoor", "WorkerHandle"}
+_FRONTDOOR_RELEASE_METHODS = {"result", "cancel", "close", "shutdown",
+                              "release", "kill", "__exit__"}
+
+
+class GL012FrontDoorHandleLeak(Rule):
+    """A ``FrontDoor`` owns executor worker PROCESSES, a Unix-socket
+    listener, supervisor threads, and a fleet directory of per-worker
+    spill stores; a ``WorkerHandle`` owns one child process and its
+    socket.  One constructed and never shut down / killed strands live
+    OS processes past the wave that spawned them — the worst leak in
+    the tree, since child processes survive even interpreter exit.  And
+    a ``FrontDoor.submit()`` whose session is discarded is a tenant
+    nobody can result()/cancel() across the process boundary, so its
+    worker-side arena charge outlives every caller.  GL011's analysis
+    applied to the process-supervision layer: flags front-door-class
+    constructions and ``submit()`` results (on a variable bound to a
+    ``FrontDoor(...)`` in the same scope) that are discarded or never
+    released, returned, stored, passed on, or used as a context
+    manager."""
+
+    id = "GL012"
+
+    def check(self, pf: ParsedFile) -> Iterable[Finding]:
+        for node in ast.walk(pf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_fn(pf, node)
+
+    @staticmethod
+    def _ctor_name(call: ast.AST) -> Optional[str]:
+        if not isinstance(call, ast.Call):
+            return None
+        func = call.func
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else None)
+        return name if name in _FRONTDOOR_CLASSES else None
+
+    @staticmethod
+    def _is_door_submit(call: ast.AST, doors: Set[str]) -> bool:
+        return (isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)
+                and call.func.attr == "submit"
+                and isinstance(call.func.value, ast.Name)
+                and call.func.value.id in doors)
+
+    def _check_fn(self, pf, fn):
+        managed: Set[int] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    managed.add(id(item.context_expr))
+        body_nodes = list(_walk_scope(fn, into_functions=False))
+        doors = {node.targets[0].id for node in body_nodes
+                 if isinstance(node, ast.Assign)
+                 and len(node.targets) == 1
+                 and isinstance(node.targets[0], ast.Name)
+                 and self._ctor_name(node.value) == "FrontDoor"}
+        for node in body_nodes:
+            if not isinstance(node, ast.Expr):
+                continue
+            if id(node.value) in managed:
+                continue
+            name = self._ctor_name(node.value)
+            if name:
+                yield pf.finding(
+                    self.id, node,
+                    f"`{name}(...)` constructed and immediately "
+                    "discarded — its worker processes / socket can "
+                    "never be shut down")
+            elif self._is_door_submit(node.value, doors):
+                yield pf.finding(
+                    self.id, node,
+                    "`submit(...)` front-door session discarded — a "
+                    "fire-and-forget tenant nobody can result()/"
+                    "cancel() across the process boundary")
+        for node in body_nodes:
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            var = node.targets[0].id
+            name = self._ctor_name(node.value)
+            if name:
+                if not _name_escapes(fn, node, var,
+                                     _FRONTDOOR_RELEASE_METHODS):
+                    yield pf.finding(
+                        self.id, node,
+                        f"`{var} = {name}(...)` is never shut down, "
+                        "killed, closed, returned, stored, or used as "
+                        "a context manager in this scope — worker "
+                        "processes and the fleet dir leak")
+            elif self._is_door_submit(node.value, doors):
+                if not _name_escapes(fn, node, var,
+                                     _FRONTDOOR_RELEASE_METHODS):
+                    yield pf.finding(
+                        self.id, node,
+                        f"`{var} = ...submit(...)` front-door session "
+                        "is never result()-ed, cancelled, stored, or "
+                        "passed on — the tenant's worker-side unwind "
+                        "is unobservable")
+
+
 _ALL: List[Rule] = [GL001TracerLeak(), GL002HostSyncUnderJit(),
                     GL003RetraceHazard(), GL004SpillHandleLeak(),
                     GL005ConfigDrift(), GL006FaultKindDrift(),
                     GL007DonatedBufferReuse(), GL008JittedIOHandle(),
                     GL009LateMaterializationBreach(),
                     GL010ShardingConstraintDrift(),
-                    GL011ServeSessionLeak()]
+                    GL011ServeSessionLeak(),
+                    GL012FrontDoorHandleLeak()]
 
 
 def all_rules(only: Optional[Sequence[str]] = None) -> List[Rule]:
