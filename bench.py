@@ -708,6 +708,52 @@ def serve_main():
               file=sys.stderr, flush=True)
         return 1
 
+    # recovery sub-wave: the durable shuffle plane.  Wave A runs
+    # ``shuffle_digest`` queries under FRESH store keys, so every map
+    # shard executes and commits to the fleet-shared ShuffleStore
+    # (replayed_shards counts those map runs); wave B re-issues the SAME
+    # keys, so every exchange ADOPTS its committed map output instead of
+    # re-running it (adopted_shards), and recovery_ms is wave B's wall —
+    # what a replacement worker would pay to pick the work back up.
+    # Both waves must be digest-identical; the note's recovery fields
+    # ride the ci/q95_floor.json serve_recovery_floor ratchet.
+    rfd = FrontDoor(workers=1, pool_bytes=pool,
+                    host_pool_bytes=host_pool, max_concurrent=1)
+    n_rec = max(2, n_queries)
+
+    def rec_wave(tag):
+        t0 = time.perf_counter()
+        sess = {k: rfd.submit("shuffle_digest",
+                              {"seed": k, "rows_per_shard": 64,
+                               "store_key": f"bench-rec-{k}"},
+                              tenant=f"recovery-{tag}")
+                for k in range(n_rec)}
+        outs = {k: s.result(timeout=300.0) for k, s in sess.items()}
+        return outs, (time.perf_counter() - t0) * 1e3
+    try:
+        rec_a, replay_ms = rec_wave("a")
+        rec_b, recovery_ms = rec_wave("b")
+    except Exception as e:
+        print(f"# serve recovery wave failed: {e!r}", file=sys.stderr,
+              flush=True)
+        return 1
+    finally:
+        rfd.shutdown()
+    rec_drift = [k for k in rec_a
+                 if rec_a[k]["digest"] != rec_b[k]["digest"]]
+    if rec_drift:
+        print(f"# serve scenario: adopted results DIFFER from the "
+              f"original run for keys {sorted(rec_drift)}",
+              file=sys.stderr, flush=True)
+        return 1
+    replayed_shards = sum(int(r["map_runs"]) for r in rec_a.values())
+    adopted_shards = sum(int(r["adopted"]) for r in rec_b.values())
+    if adopted_shards < 1:
+        print("# serve scenario: recovery wave adopted no committed "
+              "shards — the durable store path is dead",
+              file=sys.stderr, flush=True)
+        return 1
+
     solo_lat = [dt * 1e3 for _, dt in solo.values()]
     conc_lat = [dt * 1e3 for _, dt in conc.values()]
     mp_lat = [dt * 1e3 for _, dt in mp.values()]
@@ -736,6 +782,11 @@ def serve_main():
             "mp_p50_ms": round(_pct(mp_lat, 0.5), 2),
             "mp_p99_ms": round(_pct(mp_lat, 0.99), 2),
             "mp_wall_s": round(mp_wall, 3),
+            "adopted_shards": adopted_shards,
+            "replayed_shards": replayed_shards,
+            "recovery_ms": round(recovery_ms, 2),
+            "recovery_vs": round(replay_ms / recovery_ms, 3)
+            if recovery_ms else 0.0,
         },
     }), flush=True)
     return 0

@@ -1,8 +1,12 @@
 #!/usr/bin/env bash
 # Chaos-campaign gate: deterministic fault sweep over
-# spill/shuffle/q95/sort/streaming_scan/jni/serving/frontdoor
-# (frontdoor = multi-process supervisor: executor workers SIGKILLed or
-# wedged at every session lifecycle point).
+# spill/shuffle/q95/sort/streaming_scan/jni/serving/frontdoor/
+# store_recovery (frontdoor = multi-process supervisor: executor
+# workers SIGKILLed or wedged at every session lifecycle point;
+# store_recovery = the durable shuffle plane: map outputs torn
+# mid-commit, corrupted post-commit, or orphaned by a SIGKILLed worker
+# must be adopted, quarantined, or lineage-rebuilt — and every revoked
+# zombie generation fence-rejected).
 #
 # Runs tools/chaos.py — every faultinj.FAULT_KINDS entry fired at every
 # instrumented boundary (one fault per trial, exhaustively) plus seeded
@@ -30,7 +34,8 @@ BENCH_FORCE_CPU=1 python -m tools.chaos --seed "${CHAOS_SEED}" \
 python - /tmp/chaos_report.json <<'EOF'
 import json, sys
 doc = json.load(open(sys.argv[1]))
-for scenario in ("sort", "streaming_scan", "jni", "serving", "frontdoor"):
+for scenario in ("sort", "streaming_scan", "jni", "serving", "frontdoor",
+                 "store_recovery"):
     trials = [t for t in doc["trials"]
               if t["label"].startswith(scenario + ":")]
     assert trials, f"chaos report has no {scenario!r} trials"

@@ -45,6 +45,12 @@ not shrink below the recorded floor.  The same note must also record
 ``mp_bit_identical`` true with ``mp_workers >= 2``: the multi-process
 front-door wave (supervised executor workers) replays the same query
 set across the process boundary and must match solo digest for digest.
+Since r11 the note additionally carries the durable-shuffle recovery
+evidence: ``adopted_shards >= 1`` and ``replayed_shards >= 1`` with
+``recovery_ms`` (a second wave over the same store keys must ADOPT the
+committed map outputs instead of re-running them, bit-identically), and
+``recovery_vs`` — the replay-wall / adopt-wall ratio — must not shrink
+below ``serve_recovery_floor``.
 """
 import json
 import os
@@ -78,6 +84,7 @@ def main(paths) -> int:
     ir_floor = floors["ir_vs_baseline_floor"]
     scan_floor = floors["scan_vs_baseline_floor"]
     serve_floor = floors["serve_p99_floor"]
+    recovery_floor = floors["serve_recovery_floor"]
     lines = _scan(paths)
     line = lines.get("q95_shape_throughput")
     enc_line = lines.get("q95_shape_encoded_throughput")
@@ -184,6 +191,22 @@ def main(paths) -> int:
         elif int(serve_note.get("mp_workers", 0)) < 2:
             errs.append("serve line's MP wave ran fewer than 2 executor "
                         f"workers (note={json.dumps(serve_note)})")
+        elif int(serve_note.get("adopted_shards", 0)) < 1:
+            errs.append("serve line's note.adopted_shards < 1: the "
+                        "recovery wave no longer adopts committed map "
+                        "outputs from the durable shuffle store "
+                        f"(note={json.dumps(serve_note)})")
+        elif (int(serve_note.get("replayed_shards", 0)) < 1
+                or "recovery_ms" not in serve_note):
+            errs.append("serve line's replayed_shards/recovery_ms "
+                        "missing: the capture no longer documents the "
+                        "adopt-vs-replay recovery cost "
+                        f"(note={json.dumps(serve_note)})")
+        elif serve_note.get("recovery_vs", 0.0) < recovery_floor:
+            errs.append(f"serve recovery_vs "
+                        f"{serve_note.get('recovery_vs')} (replay wall / "
+                        f"adopt wall) regressed below the recorded floor "
+                        f"{recovery_floor} (ci/q95_floor.json)")
         serve_vs = serve_line.get("vs_baseline", 0.0)
         if serve_vs < serve_floor:
             errs.append(f"serve vs_baseline {serve_vs} (solo p99 / "

@@ -269,6 +269,27 @@ _register("serve_shed_threshold", 0.5, float,
           "configured workers drops below this, the front door sheds "
           "lowest-priority pending admissions beyond the surviving "
           "capacity (AdmissionShed) instead of queueing unboundedly.")
+_register("shuffle_store_dir", "", str,
+          "Root of the persistent shuffle plane (shuffle/store.py): "
+          "committed map outputs and drained round chunks land here "
+          "(crash-safe tmp+fsync+rename commits, CRC-per-chunk "
+          "manifests) so a replacement worker ADOPTS a dead worker's "
+          "finished shards instead of lineage re-running them.  Empty "
+          "disables the durable tier everywhere except the front door, "
+          "which defaults its fleet to a store under its own fleet "
+          "dir.")
+_register("shuffle_store_retain", False, _parse_bool,
+          "Whether FrontDoor.shutdown() leaves the shuffle store's "
+          "committed entries on disk (for a later fleet to adopt) "
+          "instead of reaping them with the fleet dir.  The zero-orphan "
+          "shutdown report excludes the store subtree either way — "
+          "retained entries are intentional, not leaks.")
+_register("shuffle_store_max_attempts", 2, int,
+          "Committed attempts the store keeps per (key, shard): after "
+          "a successful commit, older attempts beyond this are pruned "
+          "(adoption always reads the highest committed attempt, so "
+          "extras only buy corruption fallback depth).  0 or negative "
+          "keeps everything.")
 
 
 def get(key: str):

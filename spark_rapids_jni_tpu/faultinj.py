@@ -55,7 +55,17 @@ boundary — instrumented jitted callables — since there is no CUPTI:
   it stops answering heartbeats (hooks installed by
   :func:`set_worker_fault_hooks`); in a process with no hooks installed
   they raise :class:`WorkerCrash` / :class:`WorkerStalled` so a stray
-  rule match in a test harness is loud instead of fatal.
+  rule match in a test harness is loud instead of fatal,
+  ``"store_commit"`` raises :class:`StoreCommitError` at the shuffle
+  store's pre-rename probe (name ``store_commit``) — the store responds
+  by TEARING the in-flight write (the manifest is dropped, the tmp dir
+  stays) so the commit never becomes visible, proving readers ignore
+  tmp-only entries from a mid-commit kill,
+  ``"store_corrupt"`` raises :class:`StoreCorruptionError` at the
+  store's post-commit probe (name ``store_corrupt_file``) — the store
+  converts it into real byte flips in a just-committed chunk file, so
+  adoption-time CRC verification, quarantine, and the lineage fallback
+  are proven against real on-disk damage.
 * ``dynamic: true`` re-reads the file when its mtime changes, matching
   the injector's ``dynamicReconfig`` thread without needing one.
 
@@ -252,6 +262,43 @@ def _raise_worker_stall(name: str):
     raise WorkerStalled(f"injected worker stall at {name} (no hook installed)")
 
 
+class StoreCommitError(OSError):
+    """The shuffle store's commit rename failed (kind ``"store_commit"``).
+
+    Raised at the store's pre-rename probe (name ``store_commit``),
+    the instant after the tmp entry is fully written and fsynced but
+    before the atomic rename makes it visible.  The store catches it,
+    tears the write (the manifest is removed so the tmp entry can never
+    be mistaken for committed), counts a ``commit_failures``, and
+    reports the put as failed — callers keep their in-memory copy and
+    the query is unaffected.  A ``worker_crash`` rule matched at the
+    same probe name is the SIGKILL variant: the tmp-only entry survives
+    on disk for the reaper/adoption paths to prove they ignore it."""
+
+
+class StoreCorruptionError(OSError):
+    """A committed shuffle-store entry was damaged (kind
+    ``"store_corrupt"``).
+
+    Raised two ways: by the injector at the store's post-commit probe
+    (name ``store_corrupt_file``), where the store converts it into real
+    byte flips in a chunk file it just committed; and by the store
+    itself when adoption-time verification finds a manifest missing,
+    unreadable, or a leaf failing its CRC32/length check.  The adoption
+    path responds by quarantining the entry (renamed out of the
+    committed namespace, counted) and falling back to the next-best
+    attempt or the lineage re-run — graceful degradation, never a wrong
+    answer."""
+
+
+def _raise_store_commit(name: str):
+    raise StoreCommitError(f"injected store commit fault at {name}")
+
+
+def _raise_store_corrupt(name: str):
+    raise StoreCorruptionError(f"injected store corruption at {name}")
+
+
 # The registry of injectable fault flavors: kind -> raiser.  graftlint's
 # GL006 keeps this in sync with every use site statically — a kind used
 # in a config dict but missing here would otherwise only fail when its
@@ -271,6 +318,8 @@ FAULT_KINDS = {
     "task_cancel": _raise_task_cancel,
     "worker_crash": _raise_worker_crash,
     "worker_stall": _raise_worker_stall,
+    "store_commit": _raise_store_commit,
+    "store_corrupt": _raise_store_corrupt,
 }
 
 

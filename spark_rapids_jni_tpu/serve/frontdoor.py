@@ -38,10 +38,22 @@ makes for production query fleets):
   worker can ever come back (all dead, circuits open) pending sessions
   fail with :class:`WorkerLost`.
 
+* **Durable shuffle plane** — unless disabled, a fleet-shared
+  :mod:`~spark_rapids_jni_tpu.shuffle.store` root lives under the fleet
+  dir; every worker generation commits its map outputs and drained
+  round chunks there with its gen as the fencing epoch.  At loss time
+  the supervisor REVOKES the dead gen (a zombie's late commit is
+  rejected at the rename) and reaps only its UNcommitted tmp entries —
+  committed shards survive for the replacement to ADOPT instead of
+  lineage re-running (``adopted_shards`` vs ``lineage_rebuilds``).
+  ``shuffle_store_retain`` keeps the store past ``shutdown()``.
+
 The chaos ``frontdoor`` scenario (tools/chaos.py) SIGKILLs workers at
 every session lifecycle point and asserts survivors' digests are
 bit-identical, victims re-placed or loudly failed, every worker arena
-drained, and zero orphan spill files fleet-wide.
+drained, and zero orphan spill files fleet-wide; the
+``store_recovery`` scenario does the same around the store's commit
+point and proves adoption, quarantine fallback, and the zombie fence.
 """
 
 from __future__ import annotations
@@ -60,6 +72,7 @@ import time
 from typing import Dict, List, Optional
 
 from .. import config, faultinj
+from ..shuffle import store as store_mod
 from . import wire
 from .runtime import QueryCancelled, QueryTimeout, ServeError
 
@@ -238,7 +251,9 @@ class FrontDoor:
                  heartbeat_ms: Optional[float] = None,
                  respawn_max: Optional[int] = None,
                  shed_threshold: Optional[float] = None,
-                 setup: Optional[str] = None):
+                 setup: Optional[str] = None,
+                 store: bool = True,
+                 store_dir: Optional[str] = None):
         global _last_metrics
         self._n_workers = int(workers if workers is not None
                               else config.get("serve_workers"))
@@ -258,6 +273,15 @@ class FrontDoor:
         self._backoff_s = float(config.get("serve_backoff_ms")) / 1000.0
         self._setup = setup
         self.fleet_dir = tempfile.mkdtemp(prefix="sptpu_frontdoor_")
+        # the durable shuffle plane: fleet-shared, survives any worker.
+        # store=False runs PR-10 style (pure lineage recovery) — the
+        # comparison arm for the store_recovery chaos scenario.
+        self.store_dir: Optional[str] = None
+        self._store: Optional[store_mod.ShuffleStore] = None
+        if store:
+            self.store_dir = os.path.abspath(
+                store_dir or os.path.join(self.fleet_dir, "shuffle-store"))
+            self._store = store_mod.ShuffleStore(self.store_dir)
         self.metrics = FleetMetrics()
         _last_metrics = self.metrics
         self._lock = threading.RLock()
@@ -413,15 +437,40 @@ class FrontDoor:
             report["clean"] = report["clean"] and entry["clean"]
         # zero-orphan-spill-files invariant, checked BEFORE the reap:
         # a gracefully drained worker leaves an empty spill dir, a
-        # killed one had its dir reaped at loss time
-        for root, _dirs, files in os.walk(self.fleet_dir):
+        # killed one had its dir reaped at loss time.  The durable
+        # store's subtree is EXCLUDED — its files are supposed to
+        # survive the workers, they are not spill residue.
+        for root, dirs, files in os.walk(self.fleet_dir):
+            if self.store_dir is not None:
+                dirs[:] = [d for d in dirs
+                           if os.path.join(root, d) != self.store_dir]
             for f in files:
                 if "spill" in root.split(os.sep)[-1:] or f.endswith(".spill"):
                     report["orphan_spill_files"].append(
                         os.path.join(root, f))
         report["clean"] = report["clean"] and not report["orphan_spill_files"]
         report["fleet"] = self.metrics.snapshot()
-        shutil.rmtree(self.fleet_dir, ignore_errors=True)
+        if self._store is not None:
+            report["store"] = self._store.snapshot()
+        retain = self.store_dir is not None \
+            and bool(config.get("shuffle_store_retain"))
+        if retain and self.store_dir.startswith(self.fleet_dir + os.sep):
+            # retain ONLY the store: reap every other fleet entry (the
+            # fleet dir itself must survive to hold the store)
+            for entry in os.listdir(self.fleet_dir):
+                p = os.path.join(self.fleet_dir, entry)
+                if p == self.store_dir:
+                    continue
+                if os.path.isdir(p):
+                    shutil.rmtree(p, ignore_errors=True)
+                else:
+                    with contextlib.suppress(OSError):
+                        os.unlink(p)
+        else:
+            # default: the store dies with the fleet dir.  An external
+            # ``store_dir=`` is outside the fleet dir and never reaped —
+            # the front door doesn't own it.
+            shutil.rmtree(self.fleet_dir, ignore_errors=True)
         self._shutdown_result = report
         self._shutdown_done.set()
         return report
@@ -481,6 +530,11 @@ class FrontDoor:
                "--host-pool-bytes", str(self._host_pool_bytes),
                "--max-concurrent", str(self._max_concurrent),
                "--task-id-base", str(10_000 + slot * 1_000)]
+        if self.store_dir is not None:
+            # the gen doubles as the store's fencing epoch: commits from
+            # this incarnation are keyed attempt-<gen> and revocable the
+            # moment the supervisor declares it lost
+            cmd += ["--store-dir", self.store_dir, "--epoch", str(gen)]
         if self._setup:
             cmd += ["--setup", self._setup]
         log = open(os.path.join(wdir, "worker.log"), "ab")
@@ -677,6 +731,14 @@ class FrontDoor:
         w.close()
         self._merge_fired(w)
         fired = list(w.fired)
+        # fence the dead generation FIRST — a zombie can outlive its
+        # SIGKILL verdict and must never commit late — then reap only
+        # its UNcommitted tmp remnants: the committed shards are exactly
+        # what the replacement adopts instead of re-running
+        if self._store is not None:
+            with contextlib.suppress(OSError):
+                self._store.revoke(w.gen)
+                self._store.reap_uncommitted(epoch=w.gen)
         # reap the dead worker's spill files (and its whole directory)
         shutil.rmtree(w.dir, ignore_errors=True)
         # triage its sessions: re-place what never ran (or is declared

@@ -198,7 +198,7 @@ class TenantSession:
     def __init__(self, runtime: "ServeRuntime", session_id: int,
                  task_id: int, tenant, query_fn: Callable,
                  est_bytes: int, timeout_s: Optional[float],
-                 priority: int = 0):
+                 priority: int = 0, store=None, epoch: int = 0):
         self._runtime = runtime
         self.session_id = session_id
         self.task_id = task_id
@@ -207,6 +207,11 @@ class TenantSession:
         self.est_bytes = int(est_bytes or 0)
         self.timeout_s = timeout_s
         self.priority = int(priority)
+        # the persistent shuffle store (and this process's fencing
+        # epoch) the runtime was built with: query kinds reach the
+        # durable tier via the session instead of a module global
+        self.store = store
+        self.epoch = int(epoch)
         self.pin_owner = ("serve", session_id)
         self.status = "queued"
         self.result_value = None
@@ -290,10 +295,16 @@ class ServeRuntime:
     ``cancel`` at any point, ``shutdown`` to drain everything."""
 
     def __init__(self, max_concurrent: Optional[int] = None,
-                 task_id_base: int = 10_000):
+                 task_id_base: int = 10_000,
+                 store=None, epoch: int = 0):
         if max_concurrent is None:
             max_concurrent = int(config.get("serve_max_concurrent"))
         self._max_concurrent = int(max_concurrent)
+        # the durable shuffle tier (shuffle/store.py), when the owner
+        # (an executor worker) installed one; ``epoch`` is its fencing
+        # stamp, plumbed to every session
+        self.store = store
+        self.epoch = int(epoch)
         self._slots = _PrioritySlots(self._max_concurrent)
         self._task_id_base = int(task_id_base)
         self._ids = itertools.count(1)
@@ -330,7 +341,8 @@ class ServeRuntime:
         sid = next(self._ids)
         sess = TenantSession(self, sid, self._task_id_base + sid, tenant,
                              query_fn, est_bytes, timeout_s,
-                             priority=priority)
+                             priority=priority, store=self.store,
+                             epoch=self.epoch)
         with self._lock:
             self._sessions.append(sess)
         t = threading.Thread(target=self._run_session, args=(sess,),

@@ -21,6 +21,11 @@ up in the worker-side query-kind registry (:func:`register_query_kind`)
 * ``q6_digest`` — the bench workload: ``steps`` q6 steps over
   deterministic example batches, returns ``[digest, seconds]`` exactly
   like ``bench.py --serve``'s in-process queries
+* ``shuffle_digest`` — a deterministic shuffle exchange keyed by
+  ``params["store_key"]`` through the persistent shuffle store
+  (``--store-dir``): returns the delivered rows' sha256 plus whether
+  the map ran or a prior attempt's committed shards were ADOPTED — the
+  store_recovery chaos scenario's workload
 
 Fault injection: the supervisor exports its live schedule into this
 process via ``SPARK_RAPIDS_TPU_FAULT_CONFIG`` and points
@@ -94,6 +99,58 @@ def _qk_spill_walk(ctx, params, sess):
     return dig.hexdigest()
 
 
+def _qk_shuffle_digest(ctx, params, sess):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..columnar import types as T
+    from ..columnar.column import Column, ColumnBatch
+    from ..parallel import data_mesh, shard_batch
+    from ..shuffle import ShuffleService, get_registry
+    from ..shuffle import store as store_mod
+
+    seed = int(params.get("seed", 0))
+    P = jax.device_count()
+    n = P * int(params.get("rows_per_shard", 64))
+    store_key = str(params.get("store_key") or f"shuffle-{seed}-{n}")
+    # pure function of the seed, so digests are comparable bit-for-bit
+    # across attempts, workers, and store-enabled vs store-disabled runs
+    vals = (np.arange(n, dtype=np.int64) * (2 * seed + 3)) % 7919
+    pid_np = ((np.arange(n, dtype=np.int64) + seed) % P).astype(np.int32)
+    mesh = data_mesh(P)
+    batch = shard_batch(ColumnBatch({
+        "v": Column(jnp.asarray(vals), jnp.ones((n,), jnp.bool_),
+                    T.INT64)}), mesh)
+    pid = jax.device_put(
+        jnp.asarray(pid_np),
+        jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("data")))
+
+    store = store_mod.get_store()
+    pre_committed = store is not None \
+        and store.has_committed(store_key, "map")
+    m0 = get_registry().metrics.snapshot()
+    res = ShuffleService(mesh).exchange(
+        batch, pid=pid, round_rows=16, ctx=ctx, store_key=store_key)
+    m1 = get_registry().metrics.snapshot()
+    adopted = int(m1["adopted_shards"] - m0["adopted_shards"])
+
+    dig = hashlib.sha256()
+    for leaf in (res.batch["v"].data, res.occupancy):
+        a = np.asarray(jax.device_get(leaf))
+        dig.update(str(a.dtype).encode())
+        dig.update(str(a.shape).encode())
+        dig.update(np.ascontiguousarray(a).tobytes())
+    return {
+        "digest": dig.hexdigest(),
+        "adopted": adopted,
+        "rebuilt": int(m1["lineage_rebuilds"] - m0["lineage_rebuilds"]),
+        # the acceptance metric: 0 when a prior attempt's committed map
+        # output was adopted instead of re-running the map
+        "map_runs": 0 if (pre_committed and adopted > 0) else 1,
+    }
+
+
 _Q6_JIT: list = []
 
 
@@ -130,6 +187,7 @@ def _qk_q6_digest(ctx, params, sess):
 register_query_kind("echo", _qk_echo)
 register_query_kind("sleep", _qk_sleep)
 register_query_kind("spill_walk", _qk_spill_walk)
+register_query_kind("shuffle_digest", _qk_shuffle_digest)
 register_query_kind("q6_digest", _qk_q6_digest)
 
 
@@ -157,6 +215,11 @@ def main(argv=None) -> int:
     ap.add_argument("--host-pool-bytes", type=int, default=16 << 20)
     ap.add_argument("--max-concurrent", type=int, default=0)
     ap.add_argument("--task-id-base", type=int, default=10_000)
+    ap.add_argument("--store-dir", default=None,
+                    help="fleet-shared persistent shuffle store root")
+    ap.add_argument("--epoch", type=int, default=0,
+                    help="this incarnation's store fencing epoch "
+                         "(the supervisor passes the worker generation)")
     ap.add_argument("--setup", default=None,
                     help="module whose register_query_kinds(register) "
                          "adds custom kinds before serving")
@@ -184,9 +247,14 @@ def main(argv=None) -> int:
     adaptor = RmmSpark.set_event_handler(
         args.pool_bytes, host_pool_bytes=args.host_pool_bytes, poll_ms=20.0)
     fw = spill_mod.install(spill_dir=spill_dir)
+    store = None
+    if args.store_dir:
+        from ..shuffle import store as shuffle_store
+        store = shuffle_store.install(args.store_dir, epoch=args.epoch)
     runtime = ServeRuntime(
         max_concurrent=args.max_concurrent or None,
-        task_id_base=args.task_id_base)
+        task_id_base=args.task_id_base,
+        store=store, epoch=args.epoch)
 
     sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     sock.connect(args.socket)

@@ -35,13 +35,23 @@ each module here is the TPU analogue of one of them:
   peak, out-of-range ids, the ``dropped == 0`` invariant), surfaced via
   ``profiler.shuffle_summary()`` and ``RmmSpark.shuffle_metrics()``.
 
+* **Persistent shuffle plane** — the external-shuffle-service role:
+  :mod:`.store` persists committed map outputs and drained round chunks
+  (crash-safe tmp→fsync→rename commits, CRC-per-chunk manifests, epoch
+  fencing against zombie writers) to a fleet-shared dir that survives
+  the worker, so a replacement ADOPTS a dead worker's finished shards
+  instead of lineage re-running them — ``adopted_shards`` vs
+  ``lineage_rebuilds`` in :class:`ShuffleMetrics` decompose the
+  recovery cost.
+
 Out-of-range partition ids raise under the ``shuffle_strict_pids`` config
 knob and are routed to the null partition (and counted) otherwise;
 ``shuffle_round_rows`` bounds per-round slot memory and
 ``shuffle_max_rounds`` caps the round count by raising capacity.
 """
 
-from .buffers import MorselBuffer, PartitionBuffer, RoundChunk
+from .buffers import MorselBuffer, PartitionBuffer, RoundChunk, \
+    store_recompute
 from .morsel import MorselSource
 from .planner import (
     HierarchicalPlan,
@@ -57,12 +67,18 @@ from .registry import (
     get_registry,
 )
 from .service import ShuffleError, ShuffleResult, ShuffleService
+from .store import ShuffleStore, get_store, install, shutdown_store
 
 __all__ = [
     "MorselBuffer",
     "MorselSource",
     "PartitionBuffer",
     "RoundChunk",
+    "ShuffleStore",
+    "get_store",
+    "install",
+    "shutdown_store",
+    "store_recompute",
     "HierarchicalPlan",
     "RoundPlan",
     "plan_hierarchical",

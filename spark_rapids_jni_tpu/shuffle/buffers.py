@@ -13,10 +13,45 @@ the map-side regroup) rather than job failure.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Callable, Optional
 
 from ..mem.executor import batch_nbytes, run_with_retry
 from ..mem.spill import SpillableHandle
+
+
+def store_recompute(adopt: Optional[Callable], rebuild: Callable,
+                    on_adopt: Optional[Callable] = None,
+                    on_rebuild: Optional[Callable] = None) -> Callable:
+    """The durable tier below disk: a ``recompute=`` closure that tries
+    store ADOPTION before the lineage re-run.
+
+    ``adopt`` asks the persistent shuffle store
+    (:mod:`spark_rapids_jni_tpu.shuffle.store`) for a committed,
+    CRC-verified copy of this buffer's tree; only when it answers None
+    (no store, no committed attempt, or every attempt quarantined as
+    corrupt) does the map/drain closure ``rebuild`` actually re-run.
+    A store FAILURE (as opposed to a miss) is swallowed deliberately —
+    the durable tier is an accelerator for recovery, never a new way to
+    lose a query — and falls through to lineage like a miss.
+    ``on_adopt``/``on_rebuild`` are the accounting hooks
+    (``ShuffleMetrics.record_adopted`` / ``record_lineage_rebuild``).
+    """
+    def _recompute():
+        tree = None
+        if adopt is not None:
+            try:
+                tree = adopt()
+            except Exception:
+                tree = None
+        if tree is not None:
+            if on_adopt is not None:
+                on_adopt()
+            return tree
+        if on_rebuild is not None:
+            on_rebuild()
+        return rebuild()
+
+    return _recompute
 
 
 class PartitionBuffer:

@@ -51,7 +51,7 @@ class ShuffleMetrics:
     FIELDS = (
         "shuffles", "rounds", "rows_moved", "bytes_moved",
         "spilled_bytes", "oob_rows", "dropped_rows", "io_failures",
-        "recovered_partitions",
+        "recovered_partitions", "adopted_shards", "lineage_rebuilds",
     )
 
     def __init__(self):
@@ -85,6 +85,22 @@ class ShuffleMetrics:
         exchange later fails for an unrelated reason."""
         with self._lock:
             self._c["recovered_partitions"] += 1
+
+    def record_adopted(self):
+        """One shard ADOPTED from the persistent store instead of
+        computed — either pre-map (a prior attempt's committed output
+        found at exchange start) or during lineage recovery (the store
+        answered before the rebuild closure ran)."""
+        with self._lock:
+            self._c["adopted_shards"] += 1
+
+    def record_lineage_rebuild(self):
+        """One shard actually RE-RUN through its lineage closure after
+        the store could not answer (no committed attempt, or every
+        attempt quarantined as corrupt) — the complement of
+        ``adopted_shards``; together they decompose recovery cost."""
+        with self._lock:
+            self._c["lineage_rebuilds"] += 1
 
     def snapshot(self) -> dict:
         with self._lock:

@@ -62,7 +62,9 @@ from ..mem.executor import run_with_retry
 from ..parallel.partition import regroup_order, spark_partition_id
 from ..parallel.shuffle import route_out_of_range
 from ..relational.gather import gather_batch
-from .buffers import MorselBuffer, PartitionBuffer, RoundChunk
+from . import store as store_mod
+from .buffers import MorselBuffer, PartitionBuffer, RoundChunk, \
+    store_recompute
 from .planner import RoundPlan, plan_rounds, plan_stream_capacity
 from .registry import ShuffleInfo, ShuffleRegistry, get_registry
 
@@ -354,6 +356,7 @@ class ShuffleService:
         ctx=None,
         round_rows: Optional[int] = None,
         strict: Optional[bool] = None,
+        store_key: Optional[str] = None,
     ) -> ShuffleResult:
         """Exchange ``batch`` rows so partition p's rows land on device p.
 
@@ -368,6 +371,15 @@ class ShuffleService:
         charges every partition buffer to the device arena, making the
         exchange a first-class out-of-core citizen; without it buffers are
         registered but uncharged.
+
+        ``store_key`` is the exchange's DURABLE logical identity in the
+        persistent shuffle plane (:mod:`.store`): a caller-stable string
+        (per-process shuffle ids don't survive a crash) under which the
+        committed map output and every drained round chunk are persisted
+        best-effort, and from which a retry of the same exchange — in
+        this process or a replacement worker — ADOPTS finished shards
+        instead of recomputing them.  None (or no installed store)
+        disables the durable tier for this exchange.
         """
         from .. import config
 
@@ -379,6 +391,7 @@ class ShuffleService:
         P = mesh.shape[axis]
         sid = self.registry.begin_shuffle()
         spill_base = _spill_snapshot()
+        store = store_mod.get_store() if store_key is not None else None
 
         # 0. encoded columns: the exchange moves CODES; each dictionary is
         # broadcast ONCE per shuffle (host-side reattach after reassembly)
@@ -413,7 +426,26 @@ class ShuffleService:
         else:
             step = _map_step_pid(mesh, axis)
             run_map = lambda: step(batch, pid)  # noqa: E731
-        regrouped, counts, oob = run_map()
+        # durable tier first: a prior attempt's COMMITTED map output (this
+        # process's earlier try, or a dead worker's — same key) is adopted
+        # instead of re-running the map; a store whose every attempt fails
+        # CRC verification has quarantined them all and falls through to
+        # the fresh run below, counted as a lineage rebuild.
+        adopted_map = None
+        if store is not None and store.has_committed(store_key, "map"):
+            adopted_map = store.adopt(store_key, "map")
+            if adopted_map is not None:
+                self.registry.metrics.record_adopted()
+            else:
+                self.registry.metrics.record_lineage_rebuild()
+        if adopted_map is not None:
+            regrouped, counts, oob = adopted_map
+        else:
+            regrouped, counts, oob = run_map()
+            if store is not None:
+                # best-effort durable commit: a torn/fenced/failed put
+                # returns False and the exchange proceeds from memory
+                store.put(store_key, "map", (regrouped, counts, oob))
         counts_np = np.asarray(jax.device_get(counts)).reshape(P, P)
         oob_total = int(np.asarray(jax.device_get(oob)).sum())
         if oob_total and strict:
@@ -430,9 +462,17 @@ class ShuffleService:
         _lineage = self._lineage_factory(sid, recovered)
 
         # 3. drain: multi-round all_to_all over spillable buffers
+        def _adopt_map2():
+            # lineage-time adoption: the stored shard carries the oob
+            # vector too; the buffer only holds (regrouped, counts)
+            t = store.adopt(store_key, "map")
+            return None if t is None else (t[0], t[1])
+
         map_buf = PartitionBuffer(
             (regrouped, counts), ctx=ctx, name=f"shuffle{sid}-map",
-            recompute=_lineage(lambda: run_map()[:2], "map output"))
+            recompute=_lineage(lambda: run_map()[:2], "map output",
+                               adopt=_adopt_map2 if store is not None
+                               else None))
         drain = _drain_step(mesh, axis, plan.capacity)
 
         def _redrive(rr):
@@ -481,9 +521,15 @@ class ShuffleService:
 
         try:
             for r, out, occ, got_n, residual in _rounds():
+                if store is not None:
+                    store.put(store_key, f"round-{r}", (out, occ))
                 chunk = PartitionBuffer(
                     (out, occ), ctx=ctx, name=f"shuffle{sid}-round{r}",
-                    recompute=_lineage(_redrive(r), f"round {r} chunk"))
+                    recompute=_lineage(
+                        _redrive(r), f"round {r} chunk",
+                        adopt=(lambda rr=r: store.adopt(
+                            store_key, f"round-{rr}"))
+                        if store is not None else None))
                 chunks.append(chunk)
                 received += got_n
                 bytes_moved += chunk.nbytes
@@ -542,6 +588,7 @@ class ShuffleService:
         ctx=None,
         round_rows: Optional[int] = None,
         strict: Optional[bool] = None,
+        store_key: Optional[str] = None,
     ) -> ShuffleResult:
         """Morsel-driven exchange: map and route ``morsels`` one at a
         time, draining earlier rounds while later morsels are still
@@ -572,6 +619,12 @@ class ShuffleService:
         round count via ``round_rows`` instead.  Encoded columns decode
         per morsel (codes-only streaming would need cross-morsel
         dictionary identity).
+
+        ``store_key`` persists every DRAINED round chunk to the
+        persistent shuffle plane (the stream's map output is morsel-
+        incremental, so the committed grain is the received round): a
+        retry of the same stream adopts already-drained rounds instead
+        of re-scattering and re-draining them.
         """
         from .. import config
 
@@ -581,6 +634,7 @@ class ShuffleService:
         P = mesh.shape[axis]
         sid = self.registry.begin_shuffle()
         spill_base = _spill_snapshot()
+        store = store_mod.get_store() if store_key is not None else None
         C = plan_stream_capacity(round_rows=round_rows)
         scatter = _scatter_step(mesh, axis, C)
         init = _chunk_init_step(mesh, axis, C)
@@ -653,21 +707,32 @@ class ShuffleService:
             nonlocal received, bytes_moved
             chunk = send_chunks[rr]
 
-            def round_step():
-                _io_probe()
-                tree, occv = chunk.get()
-                out, occ2, got = drain(tree, occv)
-                got_n = int(np.asarray(jax.device_get(got)).sum())
-                return out, occ2, got_n
+            # a prior attempt already drained (and committed) this round:
+            # adopt the received chunk instead of re-running the a2a
+            adopted = (store.adopt(store_key, f"recv-{rr}")
+                       if store is not None else None)
+            if adopted is not None:
+                out, occ2 = adopted
+                got_n = int(np.asarray(jax.device_get(occ2)).sum())
+                self.registry.metrics.record_adopted()
+            else:
+                def round_step():
+                    _io_probe()
+                    tree, occv = chunk.get()
+                    out, occ2, got = drain(tree, occv)
+                    got_n = int(np.asarray(jax.device_get(got)).sum())
+                    return out, occ2, got_n
 
-            for attempt in range(_IO_RETRIES + 1):
-                try:
-                    out, occ2, got_n = run_with_retry(round_step)
-                    break
-                except faultinj.ShuffleIOError:
-                    self.registry.metrics.record_io_failure()
-                    if attempt == _IO_RETRIES:
-                        raise
+                for attempt in range(_IO_RETRIES + 1):
+                    try:
+                        out, occ2, got_n = run_with_retry(round_step)
+                        break
+                    except faultinj.ShuffleIOError:
+                        self.registry.metrics.record_io_failure()
+                        if attempt == _IO_RETRIES:
+                            raise
+                if store is not None:
+                    store.put(store_key, f"recv-{rr}", (out, occ2))
 
             def redrive():
                 tree, occv = chunk.get()
@@ -676,7 +741,10 @@ class ShuffleService:
 
             buf = PartitionBuffer(
                 (out, occ2), ctx=ctx, name=f"shuffle{sid}-recv{rr}",
-                recompute=_lineage(redrive, f"round {rr} chunk"))
+                recompute=_lineage(
+                    redrive, f"round {rr} chunk",
+                    adopt=(lambda: store.adopt(store_key, f"recv-{rr}"))
+                    if store is not None else None))
             recv.append(buf)
             received += got_n
             bytes_moved += buf.nbytes
@@ -806,13 +874,25 @@ class ShuffleService:
 
     # -- internals ------------------------------------------------------
     def _lineage_factory(self, sid: int, recovered):
-        """The per-exchange lineage wrapper: every rebuild draws on the
-        shared ``shuffle_max_recoveries`` budget and is counted live."""
+        """The per-exchange lineage wrapper: every restore draws on the
+        shared ``shuffle_max_recoveries`` budget and is counted live.
+
+        ``adopt`` plugs the durable tier under the lineage closure via
+        :func:`~.buffers.store_recompute`: a committed, CRC-verified
+        store entry restores the buffer without re-running the closure;
+        only a store miss (or a fully-quarantined shard) re-runs it —
+        each outcome counted (``adopted_shards`` / ``lineage_rebuilds``)
+        on top of the live ``recovered_partitions``."""
         from .. import config
 
         max_recoveries = int(config.get("shuffle_max_recoveries"))
 
-        def _lineage(rebuild, what):
+        def _lineage(rebuild, what, adopt=None):
+            inner = store_recompute(
+                adopt, rebuild,
+                on_adopt=self.registry.metrics.record_adopted,
+                on_rebuild=self.registry.metrics.record_lineage_rebuild)
+
             def run():
                 if recovered[0] >= max_recoveries:
                     raise ShuffleError(
@@ -821,7 +901,7 @@ class ShuffleService:
                         f"{max_recoveries}; see shuffle_max_recoveries)")
                 recovered[0] += 1
                 self.registry.metrics.record_recovered()
-                return rebuild()
+                return inner()
             return run
         return _lineage
 

@@ -1,0 +1,566 @@
+"""Persistent shuffle plane: a durable map-output store with crash
+adoption and attempt fencing.
+
+PR 10's front door recovers from a dead worker by reaping its spill dir
+and lineage re-running every map shard it held — correct, but at fleet
+scale the dominant recovery cost is recomputing work that had already
+finished.  This module is the missing tier below disk: committed map
+outputs and drained round chunks written to a location that *survives
+the worker* (a fleet-shared ``shuffle_store_dir``), so a replacement
+worker ADOPTS finished shards instead of re-running them.
+
+Layout (separated metadata/payload, the Thallus shape)::
+
+    <root>/FENCE                                  fence state (floor + revoked)
+    <root>/<key>/shard-<name>/attempt-<epoch>/    one committed entry
+        manifest.json      skeleton + per-chunk (crc32, nbytes) + epoch
+        chunk-0000.npy     one npy payload per pytree leaf
+    <root>/<key>/shard-<name>/.tmp-e<E>-<pid>-<n>/  in-flight write
+    <root>/<key>/shard-<name>/.quarantine-*        corrupt entry, moved aside
+
+Commit protocol (crash-safe at every byte):
+
+1. write every chunk + the manifest into a dot-prefixed tmp dir, fsync
+   each file and the dir — nothing under a dot prefix is ever adoptable;
+2. check the FENCE: a superseded (zombie) worker's epoch is below the
+   stamped floor or in the revoked set, and its commit is REJECTED
+   here, pre-rename — a late commit from a worker the supervisor
+   already declared dead can never become visible;
+3. ``os.rename`` tmp → ``attempt-<epoch>`` — the single atomic commit
+   point.  A kill anywhere before it leaves only a tmp dir (reaped by
+   :meth:`reap_uncommitted`); a kill after it leaves a complete entry.
+
+Adoption reads the highest *committed* attempt, re-verifying every
+chunk against the manifest's CRC32/nbytes (the same ``_leaf_meta``
+checksum path the spill tiers use).  A torn or damaged entry — missing
+manifest, short chunk, CRC mismatch — is quarantined loudly, counted,
+and the next-best attempt (or the caller's lineage re-run) takes over:
+graceful degradation, never a wrong answer.
+
+Fault kinds (``tools/chaos.py`` proves both end-to-end):
+
+* ``store_commit`` fires at the pre-rename probe; the store tears the
+  write (drops the manifest, keeps the tmp) and reports failure.  A
+  ``worker_crash`` rule at the same probe is the SIGKILL-mid-commit
+  variant.
+* ``store_corrupt`` fires at the post-commit probe; the store flips
+  bytes in a chunk it just committed so adoption-time verification is
+  exercised against genuine on-disk damage.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import config, faultinj
+from ..columnar import types as T
+from ..columnar.column import (
+    Column,
+    ColumnBatch,
+    Decimal128Column,
+    ListColumn,
+    StringColumn,
+    StructColumn,
+)
+from ..mem.spill import _flip_file_bytes, _leaf_meta
+
+# probe names: "store_commit" fires immediately before the atomic
+# rename; "store_corrupt_file" immediately after a successful commit
+_commit_probe = faultinj.instrument(lambda: None, "store_commit")
+_corrupt_probe = faultinj.instrument(lambda: None, "store_corrupt_file")
+
+_FENCE = "FENCE"
+_MANIFEST = "manifest.json"
+
+
+# ---------------------------------------------------------------------------
+# pytree <-> (JSON skeleton, npy chunk list) codec
+# ---------------------------------------------------------------------------
+# The durable format is backend-neutral by construction (the RDataFrame
+# migration-study argument): a JSON skeleton describing the container
+# nesting plus flat npy payloads, no pickle anywhere — a corrupt file can
+# fail verification but can never execute.
+
+def _enc_type(t: T.SparkType) -> dict:
+    return {
+        "kind": t.kind.value,
+        "precision": t.precision,
+        "scale": t.scale,
+        "tz": t.tz,
+        "children": [_enc_type(c) for c in t.children],
+        "field_names": list(t.field_names),
+    }
+
+
+def _dec_type(d: dict) -> T.SparkType:
+    return T.SparkType(
+        T.Kind(d["kind"]),
+        precision=int(d.get("precision", 0)),
+        scale=int(d.get("scale", 0)),
+        children=tuple(_dec_type(c) for c in d.get("children", [])),
+        field_names=tuple(d.get("field_names", [])),
+        tz=d.get("tz", ""),
+    )
+
+
+def _encode(obj, leaves: List[np.ndarray]):
+    """Recursively encode ``obj`` into a JSON skeleton, appending array
+    payloads to ``leaves``.  Raises ``TypeError`` on anything outside
+    the supported closed set — ``put`` converts that into a failed
+    (skipped) persist, never a wrong entry."""
+    if isinstance(obj, (np.ndarray, jax.Array)):
+        leaves.append(np.asarray(jax.device_get(obj)))
+        return {"t": "leaf", "i": len(leaves) - 1}
+    if obj is None:
+        return {"t": "none"}
+    if isinstance(obj, (bool, int, float, str)):
+        return {"t": "scalar", "v": obj}
+    if isinstance(obj, np.generic):
+        return {"t": "scalar", "v": obj.item()}
+    if isinstance(obj, tuple):
+        return {"t": "tuple", "c": [_encode(x, leaves) for x in obj]}
+    if isinstance(obj, list):
+        return {"t": "list", "c": [_encode(x, leaves) for x in obj]}
+    if isinstance(obj, dict):
+        keys = list(obj.keys())
+        if not all(isinstance(k, str) for k in keys):
+            raise TypeError("store skeleton requires str dict keys")
+        return {"t": "dict", "k": keys,
+                "c": [_encode(obj[k], leaves) for k in keys]}
+    if isinstance(obj, ColumnBatch):
+        return {"t": "batch", "k": list(obj.names),
+                "c": [_encode(c, leaves) for c in obj.columns]}
+    if isinstance(obj, Column):
+        return {"t": "col", "dtype": _enc_type(obj.dtype),
+                "c": [_encode(obj.data, leaves),
+                      _encode(obj.validity, leaves)]}
+    if isinstance(obj, StringColumn):
+        return {"t": "strcol",
+                "c": [_encode(obj.chars, leaves),
+                      _encode(obj.lengths, leaves),
+                      _encode(obj.validity, leaves)]}
+    if isinstance(obj, Decimal128Column):
+        return {"t": "deccol", "dtype": _enc_type(obj.dtype),
+                "c": [_encode(obj.limbs, leaves),
+                      _encode(obj.validity, leaves)]}
+    if isinstance(obj, ListColumn):
+        return {"t": "listcol", "dtype": _enc_type(obj.dtype),
+                "c": [_encode(obj.offsets, leaves),
+                      _encode(obj.child, leaves),
+                      _encode(obj.validity, leaves)]}
+    if isinstance(obj, StructColumn):
+        return {"t": "structcol", "k": list(obj.field_names),
+                "dtype": _enc_type(obj.dtype),
+                "c": [_encode(c, leaves) for c in obj.children]
+                + [_encode(obj.validity, leaves)]}
+    raise TypeError(f"unsupported store tree node: {type(obj).__name__}")
+
+
+def _leaf_value(node: dict, leaves: List[np.ndarray]):
+    return jnp.asarray(leaves[node["i"]])
+
+
+def _decode(node: dict, leaves: List[np.ndarray]):
+    t = node["t"]
+    if t == "leaf":
+        return _leaf_value(node, leaves)
+    if t == "none":
+        return None
+    if t == "scalar":
+        return node["v"]
+    if t == "tuple":
+        return tuple(_decode(c, leaves) for c in node["c"])
+    if t == "list":
+        return [_decode(c, leaves) for c in node["c"]]
+    if t == "dict":
+        return {k: _decode(c, leaves)
+                for k, c in zip(node["k"], node["c"])}
+    if t == "batch":
+        return ColumnBatch({k: _decode(c, leaves)
+                            for k, c in zip(node["k"], node["c"])})
+    if t == "col":
+        data, valid = (_decode(c, leaves) for c in node["c"])
+        return Column(data, valid, _dec_type(node["dtype"]))
+    if t == "strcol":
+        chars, lengths, valid = (_decode(c, leaves) for c in node["c"])
+        return StringColumn(chars, lengths, valid)
+    if t == "deccol":
+        limbs, valid = (_decode(c, leaves) for c in node["c"])
+        return Decimal128Column(limbs, valid, _dec_type(node["dtype"]))
+    if t == "listcol":
+        offsets, child, valid = (_decode(c, leaves) for c in node["c"])
+        return ListColumn(offsets, child, valid, _dec_type(node["dtype"]))
+    if t == "structcol":
+        *kids, valid = (_decode(c, leaves) for c in node["c"])
+        return StructColumn(dict(zip(node["k"], kids)), valid,
+                            _dec_type(node["dtype"]))
+    raise faultinj.StoreCorruptionError(f"unknown skeleton node {t!r}")
+
+
+def _fsync_dir(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _safe(name: str) -> str:
+    return "".join(c if c.isalnum() or c in "-._" else "_" for c in name)
+
+
+class ShuffleStore:
+    """One process's handle onto the fleet-shared durable store.
+
+    ``epoch`` is this process's stamped attempt number (the front door
+    uses the worker generation); commits are keyed by it and fenced
+    against it.  All methods are safe under concurrent writers in other
+    processes — the commit point is a single ``os.rename``."""
+
+    COUNTERS = ("commits", "commit_failures", "fenced_commits",
+                "adoptions", "adoption_misses", "corrupt_quarantined",
+                "reaped_uncommitted", "pruned_attempts")
+
+    def __init__(self, root: str, epoch: int = 0,
+                 max_attempts: Optional[int] = None):
+        self.root = os.path.abspath(root)
+        self.epoch = int(epoch)
+        self._max_attempts = max_attempts
+        self._lock = threading.Lock()
+        self._tmp_seq = 0
+        self._counts = {k: 0 for k in self.COUNTERS}
+        os.makedirs(self.root, exist_ok=True)
+
+    # -- fencing ---------------------------------------------------------
+    # Two fence shapes, both checked pre-rename: a monotonic FLOOR
+    # (``stamp`` — fences every generation below it at once; a fleet
+    # restart stamps past its predecessor's gens) and a REVOKED set
+    # (``revoke`` — the supervisor's surgical fence at worker-loss time;
+    # a threshold alone can't fence gen 2's zombie while gen 1 is still
+    # alive and committing).  Only the supervisor writes fence state, so
+    # its read-modify-write needs no cross-process lock; workers only
+    # ever read it.
+
+    def _fence_state(self) -> dict:
+        try:
+            with open(os.path.join(self.root, _FENCE)) as f:
+                raw = f.read().strip()
+        except OSError:
+            return {"floor": 0, "revoked": []}
+        try:
+            st = json.loads(raw or "0")
+        except ValueError:
+            return {"floor": 0, "revoked": []}
+        if isinstance(st, int):  # legacy bare-int floor
+            return {"floor": st, "revoked": []}
+        if not isinstance(st, dict):
+            return {"floor": 0, "revoked": []}
+        return {"floor": int(st.get("floor", 0)),
+                "revoked": sorted(int(e) for e in st.get("revoked", []))}
+
+    def _write_fence(self, state: dict) -> None:
+        tmp = os.path.join(self.root, f".{_FENCE}-{os.getpid()}")
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.root, _FENCE))
+        _fsync_dir(self.root)
+
+    def fence(self) -> int:
+        """The stamped floor epoch (0 = none)."""
+        return self._fence_state()["floor"]
+
+    def fenced(self, epoch: int) -> bool:
+        """Would a commit at ``epoch`` be rejected right now?"""
+        st = self._fence_state()
+        return int(epoch) < st["floor"] or int(epoch) in st["revoked"]
+
+    def revoked(self) -> List[int]:
+        """Surgically fenced generations, ascending — the supervisor's
+        worker-loss verdicts (chaos asserts none of them can commit)."""
+        return self._fence_state()["revoked"]
+
+    def stamp(self, epoch: int) -> int:
+        """Raise the fence floor to ``epoch`` (monotonic; atomic
+        replace): every generation strictly below it is fenced."""
+        st = self._fence_state()
+        if int(epoch) <= st["floor"]:
+            return st["floor"]
+        st["floor"] = int(epoch)
+        self._write_fence(st)
+        return st["floor"]
+
+    def revoke(self, epoch: int) -> None:
+        """Fence exactly one generation: the supervisor revokes a
+        worker's epoch the moment it declares the worker lost, so a
+        zombie process that outlives its SIGKILL verdict can finish
+        writing tmp entries but can never commit them."""
+        st = self._fence_state()
+        if int(epoch) in st["revoked"]:
+            return
+        st["revoked"] = sorted(st["revoked"] + [int(epoch)])
+        self._write_fence(st)
+
+    # -- paths -----------------------------------------------------------
+    def _shard_dir(self, key: str, shard: str) -> str:
+        return os.path.join(self.root, _safe(key), f"shard-{_safe(shard)}")
+
+    def _committed(self, shard_dir: str) -> List[Tuple[int, str]]:
+        """Committed attempts, highest epoch first."""
+        try:
+            entries = os.listdir(shard_dir)
+        except OSError:
+            return []
+        out = []
+        for e in entries:
+            if not e.startswith("attempt-"):
+                continue
+            try:
+                out.append((int(e.split("-", 1)[1]),
+                            os.path.join(shard_dir, e)))
+            except ValueError:
+                continue
+        out.sort(reverse=True)
+        return out
+
+    # -- write path ------------------------------------------------------
+    def put(self, key: str, shard: str, tree) -> bool:
+        """Durably commit ``tree`` as this epoch's attempt for
+        ``(key, shard)``.  Returns False (never raises) when the write
+        is torn, fenced, or the tree is not storable — callers always
+        still hold the in-memory copy."""
+        shard_dir = self._shard_dir(key, shard)
+        final = os.path.join(shard_dir, f"attempt-{self.epoch:08d}")
+        if os.path.isdir(final):
+            return True
+        try:
+            leaves: List[np.ndarray] = []
+            skeleton = _encode(tree, leaves)
+        except TypeError:
+            with self._lock:
+                self._counts["commit_failures"] += 1
+            return False
+        os.makedirs(shard_dir, exist_ok=True)
+        with self._lock:
+            self._tmp_seq += 1
+            seq = self._tmp_seq
+        tmp = os.path.join(
+            shard_dir, f".tmp-e{self.epoch}-{os.getpid()}-{seq}")
+        manifest_path = os.path.join(tmp, _MANIFEST)
+        try:
+            os.makedirs(tmp)
+            metas = []
+            for i, arr in enumerate(leaves):
+                cpath = os.path.join(tmp, f"chunk-{i:04d}.npy")
+                with open(cpath, "wb") as f:
+                    np.save(f, arr, allow_pickle=False)
+                    f.flush()
+                    os.fsync(f.fileno())
+                metas.append(list(_leaf_meta(arr)))
+            with open(manifest_path, "w") as f:
+                json.dump({"skeleton": skeleton, "leaves": metas,
+                           "epoch": self.epoch, "key": key,
+                           "shard": shard}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            _fsync_dir(tmp)
+        except OSError:
+            shutil.rmtree(tmp, ignore_errors=True)
+            with self._lock:
+                self._counts["commit_failures"] += 1
+            return False
+        try:
+            # pre-rename boundary: a worker_crash rule here SIGKILLs with
+            # the tmp entry fully written but never committed
+            _commit_probe()
+        except faultinj.StoreCommitError:
+            # torn write: the manifest is dropped so the tmp remnant can
+            # never be mistaken for a complete entry; leave the chunks
+            # for reap_uncommitted to prove the reaper path
+            try:
+                os.unlink(manifest_path)
+            except OSError:
+                pass
+            with self._lock:
+                self._counts["commit_failures"] += 1
+            return False
+        if self.fenced(self.epoch):
+            # a zombie generation's late commit: rejected at the rename
+            shutil.rmtree(tmp, ignore_errors=True)
+            with self._lock:
+                self._counts["fenced_commits"] += 1
+            return False
+        try:
+            os.rename(tmp, final)
+        except OSError:
+            # lost a same-attempt race: the other writer's entry stands
+            shutil.rmtree(tmp, ignore_errors=True)
+            return os.path.isdir(final)
+        _fsync_dir(shard_dir)
+        with self._lock:
+            self._counts["commits"] += 1
+        try:
+            _corrupt_probe()
+        except faultinj.StoreCorruptionError:
+            # convert the injected fault into real on-disk damage in the
+            # entry we just committed — adoption's CRC pass must catch it
+            chunks = sorted(f for f in os.listdir(final)
+                            if f.startswith("chunk-"))
+            if chunks:
+                _flip_file_bytes(os.path.join(final, chunks[0]))
+        self._prune(shard_dir)
+        return True
+
+    def _prune(self, shard_dir: str) -> None:
+        keep = self._max_attempts
+        if keep is None:
+            keep = int(config.get("shuffle_store_max_attempts"))
+        if keep <= 0:
+            return
+        for _epoch, path in self._committed(shard_dir)[keep:]:
+            shutil.rmtree(path, ignore_errors=True)
+            with self._lock:
+                self._counts["pruned_attempts"] += 1
+
+    # -- read path -------------------------------------------------------
+    def has_committed(self, key: str, shard: str) -> bool:
+        return bool(self._committed(self._shard_dir(key, shard)))
+
+    def attempts(self, key: str, shard: str) -> List[int]:
+        return [e for e, _ in self._committed(self._shard_dir(key, shard))]
+
+    def adopt(self, key: str, shard: str):
+        """The highest committed, CRC-verified attempt for
+        ``(key, shard)`` as a live tree, or None.  Entries failing
+        verification are quarantined (renamed out of the committed
+        namespace) and the next-best attempt is tried."""
+        shard_dir = self._shard_dir(key, shard)
+        for _epoch, path in self._committed(shard_dir):
+            try:
+                tree = self._load_verified(path)
+            except (OSError, ValueError, KeyError, json.JSONDecodeError):
+                self._quarantine(path)
+                continue
+            with self._lock:
+                self._counts["adoptions"] += 1
+            return tree
+        with self._lock:
+            self._counts["adoption_misses"] += 1
+        return None
+
+    def _load_verified(self, path: str):
+        with open(os.path.join(path, _MANIFEST)) as f:
+            manifest = json.load(f)
+        metas = manifest["leaves"]
+        leaves = []
+        for i, (crc, nbytes) in enumerate(metas):
+            arr = np.load(os.path.join(path, f"chunk-{i:04d}.npy"),
+                          allow_pickle=False)
+            got_crc, got_nbytes = _leaf_meta(arr)
+            if got_crc != crc or got_nbytes != nbytes:
+                raise faultinj.StoreCorruptionError(
+                    f"store chunk {i} of {path} failed verification: "
+                    f"crc {got_crc:#x}!={crc:#x} or "
+                    f"nbytes {got_nbytes}!={nbytes}")
+            leaves.append(arr)
+        return _decode(manifest["skeleton"], leaves)
+
+    def _quarantine(self, path: str) -> None:
+        with self._lock:
+            self._counts["corrupt_quarantined"] += 1
+            self._tmp_seq += 1
+            seq = self._tmp_seq
+        dst = os.path.join(
+            os.path.dirname(path),
+            f".quarantine-{os.path.basename(path)}-{os.getpid()}-{seq}")
+        try:
+            os.rename(path, dst)
+        except OSError:
+            shutil.rmtree(path, ignore_errors=True)
+
+    # -- janitorial ------------------------------------------------------
+    def reap_uncommitted(self, epoch: Optional[int] = None) -> int:
+        """Remove in-flight tmp entries (a dead worker's mid-commit
+        remnants).  ``epoch`` limits the reap to one generation's tmp
+        dirs; None reaps every uncommitted entry.  Committed attempts
+        and quarantined entries are never touched."""
+        prefix = ".tmp-" if epoch is None else f".tmp-e{int(epoch)}-"
+        reaped = 0
+        try:
+            keys = os.listdir(self.root)
+        except OSError:
+            return 0
+        for key in keys:
+            kdir = os.path.join(self.root, key)
+            if not os.path.isdir(kdir):
+                continue
+            for shard in os.listdir(kdir):
+                sdir = os.path.join(kdir, shard)
+                if not os.path.isdir(sdir):
+                    continue
+                for e in os.listdir(sdir):
+                    if e.startswith(prefix):
+                        shutil.rmtree(os.path.join(sdir, e),
+                                      ignore_errors=True)
+                        reaped += 1
+        with self._lock:
+            self._counts["reaped_uncommitted"] += reaped
+        return reaped
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._counts)
+
+
+# ---------------------------------------------------------------------------
+# process-level store handle
+# ---------------------------------------------------------------------------
+# One store per process, installed explicitly (workers: from the
+# supervisor's --store-dir/--epoch) or lazily from the
+# ``shuffle_store_dir`` knob; the ShuffleService adopts through
+# whichever is live.
+
+_installed: Optional[ShuffleStore] = None
+_installed_lock = threading.Lock()
+
+
+def install(root: Optional[str] = None, epoch: int = 0) -> ShuffleStore:
+    """Install the process's store handle (replacing any previous one)."""
+    global _installed
+    root = root or str(config.get("shuffle_store_dir"))
+    if not root:
+        raise ValueError("no store root: pass root= or set the "
+                         "shuffle_store_dir knob")
+    with _installed_lock:
+        _installed = ShuffleStore(root, epoch=epoch)
+        return _installed
+
+
+def get_store() -> Optional[ShuffleStore]:
+    """The installed store, lazily created from ``shuffle_store_dir``
+    when the knob is set; None when no store is configured."""
+    global _installed
+    with _installed_lock:
+        if _installed is None:
+            root = str(config.get("shuffle_store_dir"))
+            if root:
+                _installed = ShuffleStore(root, epoch=0)
+        return _installed
+
+
+def shutdown_store() -> None:
+    """Drop the process's store handle (files are left for the owner —
+    the front door's shutdown decides retention via
+    ``shuffle_store_retain``)."""
+    global _installed
+    with _installed_lock:
+        _installed = None

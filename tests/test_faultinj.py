@@ -252,6 +252,44 @@ def test_worker_hooks_intercept(monkeypatch):
         faultinj.set_worker_fault_hooks()
 
 
+def test_store_kinds_registered_and_raise():
+    """store_commit / store_corrupt are first-class kinds: loud typed
+    exceptions at any probe, never silent no-ops."""
+    assert "store_commit" in faultinj.FAULT_KINDS
+    assert "store_corrupt" in faultinj.FAULT_KINDS
+    faultinj.configure({"faults": [
+        {"match": "store_commit", "fault": "store_commit", "count": 1},
+        {"match": "store_corrupt_file", "fault": "store_corrupt",
+         "count": 1},
+    ]})
+    commit = faultinj.instrument(lambda: "ok", "store_commit")
+    corrupt = faultinj.instrument(lambda: "ok", "store_corrupt_file")
+    with pytest.raises(faultinj.StoreCommitError):
+        commit()
+    with pytest.raises(faultinj.StoreCorruptionError):
+        corrupt()
+    assert commit() == "ok" and corrupt() == "ok"
+    assert sorted(e["fault"] for e in faultinj.fired_log()) == \
+        ["store_commit", "store_corrupt"]
+
+
+def test_store_kinds_export_cross_process():
+    # the supervisor exports its live schedule to spawned workers via
+    # current_config; the store kinds must survive that round trip with
+    # their occurrence clock (skip/count) intact like every other kind
+    cfg = {"faults": [{"match": "store_*", "fault": "store_commit",
+                       "count": 1, "skip": 1}]}
+    faultinj.configure(cfg)
+    exported = faultinj.current_config()
+    assert exported["faults"] == cfg["faults"]
+    faultinj.configure(exported)
+    f = faultinj.instrument(lambda: 1, "store_commit")
+    assert f() == 1  # skip consumes the first crossing
+    with pytest.raises(faultinj.StoreCommitError):
+        f()
+    assert f() == 1  # count exhausted
+
+
 def test_current_config_round_trips():
     cfg = {"seed": 7, "faults": [
         {"match": "x*", "fault": "oom", "count": 2, "skip": 1}]}

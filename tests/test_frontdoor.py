@@ -204,6 +204,112 @@ class TestDegradation:
             fd.shutdown()
 
 
+class TestStorePlane:
+    def test_crash_recovery_adopts_committed_shards(self):
+        """The tentpole invariant: a worker SIGKILLed after committing
+        its map output is re-placed onto a respawn that ADOPTS the
+        committed shard (map_runs == 0) with a bit-identical digest;
+        the same crash with the store disabled re-runs the map."""
+        # query 1 commits, query 2's first step crashes the worker
+        schedule = {"faults": [
+            {"match": "serve_step", "fault": "worker_crash",
+             "skip": 1, "count": 1}]}
+        faultinj.configure(schedule)
+        fd = FrontDoor(workers=1, heartbeat_ms=80.0)
+        try:
+            r1 = fd.submit("shuffle_digest",
+                           {"seed": 3, "store_key": "sd-3"},
+                           tenant="t0").result(timeout=120)
+            assert r1["map_runs"] == 1 and r1["adopted"] == 0
+            s2 = fd.submit("shuffle_digest",
+                           {"seed": 3, "store_key": "sd-3"}, tenant="t0")
+            r2 = s2.result(timeout=120)
+            assert s2.replacements >= 1
+            assert r2["digest"] == r1["digest"]  # bit-identical recovery
+            assert r2["adopted"] >= 1 and r2["map_runs"] == 0
+        finally:
+            report = fd.shutdown()
+        assert report["clean"], report
+        assert report["fleet"]["crashes"] == 1
+        assert "store" in report
+        assert not os.path.exists(fd.fleet_dir)
+
+        # the comparison arm: store disabled, same crash — the map MUST
+        # re-run (map_runs 1 > the store run's 0), same digest
+        faultinj.configure(schedule)
+        fd2 = FrontDoor(workers=1, heartbeat_ms=80.0, store=False)
+        try:
+            p1 = fd2.submit("shuffle_digest",
+                            {"seed": 3, "store_key": "sd-3"},
+                            tenant="t0").result(timeout=120)
+            s2 = fd2.submit("shuffle_digest",
+                            {"seed": 3, "store_key": "sd-3"}, tenant="t0")
+            p2 = s2.result(timeout=120)
+            assert s2.replacements >= 1
+            assert p2["digest"] == r1["digest"]
+            assert p2["map_runs"] == 1 and p2["adopted"] == 0
+            assert p1["map_runs"] == 1
+        finally:
+            report2 = fd2.shutdown()
+        assert report2["clean"], report2
+        assert "store" not in report2
+
+    def test_zombie_generation_is_fenced(self):
+        """A dead generation's epoch is revoked at loss time: a zombie
+        that outlives its SIGKILL verdict can write tmp entries but its
+        commit is rejected at the rename — never adoptable."""
+        import jax.numpy as jnp
+
+        from spark_rapids_jni_tpu.shuffle.store import ShuffleStore
+
+        faultinj.configure({"faults": [
+            {"match": "serve_step", "fault": "worker_crash", "count": 1}]})
+        fd = FrontDoor(workers=1, heartbeat_ms=80.0)
+        try:
+            s = fd.submit("spill_walk", {"seed": 5}, tenant="t0")
+            assert s.result(timeout=90)
+            assert s.replacements >= 1  # gen 1 died and was revoked
+            zombie = ShuffleStore(fd.store_dir, epoch=1)
+            assert zombie.fenced(1)
+            assert not zombie.put("zq", "map", {"x": jnp.arange(4)})
+            assert zombie.snapshot()["fenced_commits"] == 1
+            # nothing committed, nothing adoptable, by any reader
+            reader = ShuffleStore(fd.store_dir)
+            assert not reader.has_committed("zq", "map")
+            assert reader.adopt("zq", "map") is None
+            # the respawned generation (gen 2) is NOT fenced
+            assert not zombie.fenced(2)
+        finally:
+            report = fd.shutdown()
+        assert report["clean"], report
+
+    def test_retain_knob_keeps_store_past_shutdown(self):
+        """shuffle_store_retain=True: shutdown reaps the fleet but
+        leaves the committed store for the next fleet to adopt from."""
+        import shutil
+
+        from spark_rapids_jni_tpu.shuffle.store import ShuffleStore
+
+        fd = FrontDoor(workers=1, heartbeat_ms=80.0)
+        config.set("shuffle_store_retain", True)
+        try:
+            r = fd.submit("shuffle_digest",
+                          {"seed": 7, "store_key": "keep-7"},
+                          tenant="t0").result(timeout=120)
+            assert r["map_runs"] == 1
+        finally:
+            report = fd.shutdown()
+            config.reset("shuffle_store_retain")
+        try:
+            assert report["clean"], report
+            assert os.path.isdir(fd.store_dir)
+            assert ShuffleStore(fd.store_dir).has_committed("keep-7", "map")
+            # everything else in the fleet dir was still reaped
+            assert os.listdir(fd.fleet_dir) == ["shuffle-store"]
+        finally:
+            shutil.rmtree(fd.fleet_dir, ignore_errors=True)
+
+
 class TestFleetMetrics:
     def test_zeros_safe_surface(self):
         snap = fleet_metrics()

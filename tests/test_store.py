@@ -1,0 +1,246 @@
+"""Persistent shuffle store: crash-safe commits, highest-attempt
+adoption, epoch fencing (floor + revocation), corruption quarantine
+with fallback, tmp reaping, attempt pruning, and the adoption-first
+lineage combinator."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import config, faultinj
+from spark_rapids_jni_tpu.columnar import types as T
+from spark_rapids_jni_tpu.columnar.column import Column, ColumnBatch
+from spark_rapids_jni_tpu.mem.spill import _flip_file_bytes
+from spark_rapids_jni_tpu.shuffle import store as store_mod
+from spark_rapids_jni_tpu.shuffle.buffers import store_recompute
+from spark_rapids_jni_tpu.shuffle.store import ShuffleStore
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    faultinj.configure(None)
+    store_mod.shutdown_store()
+
+
+def _batch(seed: int, n: int = 32) -> ColumnBatch:
+    vals = (np.arange(n, dtype=np.int64) * (seed + 7)) % 9973
+    return ColumnBatch({
+        "v": Column(jnp.asarray(vals), jnp.ones((n,), jnp.bool_), T.INT64)})
+
+
+def _tree(seed: int):
+    # one of each skeleton container plus a batch: the codec's closed set
+    return (_batch(seed), {"counts": jnp.arange(8, dtype=jnp.int32),
+                           "tag": f"t{seed}", "none": None},
+            [seed, float(seed) / 2, True])
+
+
+def _leaves_equal(a, b) -> bool:
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(jax.device_get(x)),
+                       np.asarray(jax.device_get(y)))
+        for x, y in zip(la, lb))
+
+
+class TestCommitAdopt:
+    def test_round_trip_bit_exact(self, tmp_path):
+        st = ShuffleStore(str(tmp_path), epoch=1)
+        tree = _tree(3)
+        assert st.put("q1", "map", tree)
+        assert st.has_committed("q1", "map")
+        got = st.adopt("q1", "map")
+        assert got is not None and _leaves_equal(tree, got)
+        # scalars and structure survive, not just array payloads
+        assert got[1]["tag"] == "t3" and got[1]["none"] is None
+        assert got[2] == [3, 1.5, True]
+        assert st.snapshot()["commits"] == 1
+        assert st.snapshot()["adoptions"] == 1
+
+    def test_same_epoch_put_is_idempotent(self, tmp_path):
+        st = ShuffleStore(str(tmp_path), epoch=1)
+        assert st.put("q", "map", _tree(1))
+        assert st.put("q", "map", _tree(1))  # already committed: no-op
+        assert st.snapshot()["commits"] == 1
+
+    def test_adoption_prefers_highest_attempt(self, tmp_path):
+        ShuffleStore(str(tmp_path), epoch=1).put("q", "map", _tree(1))
+        ShuffleStore(str(tmp_path), epoch=4).put("q", "map", _tree(4))
+        st = ShuffleStore(str(tmp_path), epoch=0, max_attempts=0)
+        assert st.attempts("q", "map") == [4, 1]
+        assert _leaves_equal(st.adopt("q", "map"), _tree(4))
+
+    def test_miss_returns_none(self, tmp_path):
+        st = ShuffleStore(str(tmp_path))
+        assert st.adopt("nope", "map") is None
+        assert not st.has_committed("nope", "map")
+        assert st.snapshot()["adoption_misses"] == 1
+
+    def test_unstorable_tree_fails_softly(self, tmp_path):
+        st = ShuffleStore(str(tmp_path), epoch=1)
+        assert not st.put("q", "map", object())
+        assert st.snapshot()["commit_failures"] == 1
+        assert not st.has_committed("q", "map")
+
+
+class TestCrashSafety:
+    def test_injected_commit_fault_tears_the_write(self, tmp_path):
+        st = ShuffleStore(str(tmp_path), epoch=2)
+        faultinj.configure({"faults": [
+            {"match": "store_commit", "fault": "store_commit", "count": 1}]})
+        assert not st.put("q", "map", _tree(1))
+        # nothing committed, nothing adoptable: only a tmp remnant
+        assert not st.has_committed("q", "map")
+        assert st.adopt("q", "map") is None
+        assert st.snapshot()["commit_failures"] == 1
+        # the reaper clears exactly the torn remnant, by epoch
+        assert st.reap_uncommitted(epoch=2) >= 1
+        assert st.reap_uncommitted(epoch=2) == 0
+        # and the retry (fault exhausted) commits cleanly
+        assert st.put("q", "map", _tree(1))
+        assert _leaves_equal(st.adopt("q", "map"), _tree(1))
+
+    def test_injected_corruption_is_caught_by_crc(self, tmp_path):
+        st = ShuffleStore(str(tmp_path), epoch=1)
+        faultinj.configure({"faults": [
+            {"match": "store_corrupt_file", "fault": "store_corrupt",
+             "count": 1}]})
+        # the put "succeeds" — the damage is post-commit, like a bad disk
+        assert st.put("q", "map", _tree(1))
+        faultinj.configure(None)
+        # adoption's verification quarantines it; no wrong answer
+        assert st.adopt("q", "map") is None
+        assert st.snapshot()["corrupt_quarantined"] == 1
+        assert not st.has_committed("q", "map")
+
+    def test_corrupt_attempt_falls_back_to_older(self, tmp_path):
+        ShuffleStore(str(tmp_path), epoch=1).put("q", "map", _tree(1))
+        ShuffleStore(str(tmp_path), epoch=2).put("q", "map", _tree(2))
+        st = ShuffleStore(str(tmp_path), max_attempts=0)
+        # flip bytes in the NEWEST attempt's payload
+        newest = os.path.join(str(tmp_path), "q", "shard-map",
+                              "attempt-00000002")
+        chunk = sorted(f for f in os.listdir(newest)
+                       if f.startswith("chunk-"))[0]
+        _flip_file_bytes(os.path.join(newest, chunk))
+        got = st.adopt("q", "map")
+        # the damaged attempt was quarantined and the older one adopted
+        assert _leaves_equal(got, _tree(1))
+        assert st.snapshot()["corrupt_quarantined"] == 1
+        assert st.attempts("q", "map") == [1]
+        left = os.listdir(os.path.join(str(tmp_path), "q", "shard-map"))
+        assert any(e.startswith(".quarantine-") for e in left)
+
+
+class TestFencing:
+    def test_floor_stamp_fences_older_generations(self, tmp_path):
+        st = ShuffleStore(str(tmp_path), epoch=2)
+        st.stamp(5)
+        assert st.fence() == 5
+        assert st.fenced(2) and not st.fenced(5)
+        assert not st.put("q", "map", _tree(1))
+        assert st.snapshot()["fenced_commits"] == 1
+        assert not st.has_committed("q", "map")
+
+    def test_stamp_is_monotonic(self, tmp_path):
+        st = ShuffleStore(str(tmp_path))
+        assert st.stamp(5) == 5
+        assert st.stamp(3) == 5
+
+    def test_revoke_fences_exactly_one_generation(self, tmp_path):
+        zombie = ShuffleStore(str(tmp_path), epoch=2)
+        live = ShuffleStore(str(tmp_path), epoch=1)
+        zombie.revoke(2)
+        # the zombie's late commit can never become visible...
+        assert not zombie.put("q", "map", _tree(2))
+        assert zombie.snapshot()["fenced_commits"] == 1
+        assert not zombie.has_committed("q", "map")
+        # ...while a LIVE lower generation still commits (a floor
+        # threshold could not express this)
+        assert live.put("q", "map", _tree(1))
+        assert _leaves_equal(live.adopt("q", "map"), _tree(1))
+
+
+class TestJanitorial:
+    def test_prune_keeps_newest_attempts(self, tmp_path):
+        for e in (1, 2, 3):
+            ShuffleStore(str(tmp_path), epoch=e,
+                         max_attempts=2).put("q", "map", _tree(e))
+        st = ShuffleStore(str(tmp_path), max_attempts=0)
+        assert st.attempts("q", "map") == [3, 2]
+
+    def test_max_attempts_knob_drives_prune(self, tmp_path):
+        old = config.get("shuffle_store_max_attempts")
+        config.set("shuffle_store_max_attempts", 1)
+        try:
+            for e in (1, 2):
+                ShuffleStore(str(tmp_path), epoch=e).put(
+                    "q", "map", _tree(e))
+            st = ShuffleStore(str(tmp_path), max_attempts=0)
+            assert st.attempts("q", "map") == [2]
+        finally:
+            config.set("shuffle_store_max_attempts", old)
+
+    def test_reap_all_epochs(self, tmp_path):
+        st = ShuffleStore(str(tmp_path), epoch=1)
+        faultinj.configure({"faults": [
+            {"match": "store_commit", "fault": "store_commit",
+             "count": 2}]})
+        assert not st.put("q", "a", _tree(1))
+        assert not st.put("q", "b", _tree(2))
+        faultinj.configure(None)
+        assert st.reap_uncommitted() == 2
+        assert st.snapshot()["reaped_uncommitted"] == 2
+
+
+class TestProcessHandle:
+    def test_install_requires_a_root(self):
+        old = config.get("shuffle_store_dir")
+        config.set("shuffle_store_dir", "")
+        try:
+            with pytest.raises(ValueError):
+                store_mod.install()
+        finally:
+            config.set("shuffle_store_dir", old)
+
+    def test_get_store_lazily_reads_the_knob(self, tmp_path):
+        old = config.get("shuffle_store_dir")
+        store_mod.shutdown_store()
+        config.set("shuffle_store_dir", str(tmp_path))
+        try:
+            st = store_mod.get_store()
+            assert st is not None and st.root == str(tmp_path)
+            assert store_mod.get_store() is st
+        finally:
+            config.set("shuffle_store_dir", old)
+            store_mod.shutdown_store()
+
+
+class TestStoreRecompute:
+    def test_adopts_before_rebuilding(self):
+        events = []
+        fn = store_recompute(lambda: "from-store", lambda: "rebuilt",
+                             on_adopt=lambda: events.append("adopt"),
+                             on_rebuild=lambda: events.append("rebuild"))
+        assert fn() == "from-store"
+        assert events == ["adopt"]
+
+    def test_miss_and_failure_fall_through_to_lineage(self):
+        events = []
+
+        def boom():
+            raise OSError("store offline")
+
+        fn = store_recompute(boom, lambda: "rebuilt",
+                             on_rebuild=lambda: events.append("rebuild"))
+        # a store FAILURE is swallowed: the durable tier may accelerate
+        # recovery but must never become a new way to lose a query
+        assert fn() == "rebuilt"
+        fn2 = store_recompute(lambda: None, lambda: "rebuilt")
+        assert fn2() == "rebuilt"
+        assert events == ["rebuild"]

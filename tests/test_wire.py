@@ -1,0 +1,100 @@
+"""Wire protocol: framing, the 16MB frame cap, and the mid-frame
+timeout desync guard (serve/wire.py)."""
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from spark_rapids_jni_tpu.serve import wire
+
+
+@pytest.fixture
+def pair():
+    a, b = socket.socketpair(socket.AF_UNIX, socket.SOCK_STREAM)
+    yield a, b
+    a.close()
+    b.close()
+
+
+class TestFraming:
+    def test_round_trip(self, pair):
+        a, b = pair
+        lock = threading.Lock()
+        wire.send_msg(a, {"op": "ping", "t": 1.5}, lock)
+        wire.send_msg(a, {"op": "submit", "params": {"k": [1, 2]}})
+        assert wire.recv_msg(b) == {"op": "ping", "t": 1.5}
+        assert wire.recv_msg(b) == {"op": "submit", "params": {"k": [1, 2]}}
+
+    def test_peer_closed_mid_frame(self, pair):
+        a, b = pair
+        # header promises 100 bytes; only 10 arrive before the close
+        a.sendall(struct.pack("<I", 100) + b"x" * 10)
+        a.close()
+        with pytest.raises(wire.WireError, match="mid-frame"):
+            wire.recv_msg(b)
+
+    def test_eof_before_any_frame(self, pair):
+        a, b = pair
+        a.close()
+        with pytest.raises(wire.WireError):
+            wire.recv_msg(b)
+
+
+class TestFrameCap:
+    def test_oversized_send_rejected_before_writing(self, pair):
+        a, _b = pair
+        big = {"op": "result", "value": "v" * (wire.MAX_FRAME + 1)}
+        with pytest.raises(wire.WireError, match="exceeds"):
+            wire.send_msg(a, big)
+
+    def test_oversized_length_prefix_rejected(self, pair):
+        a, b = pair
+        # a corrupted (or hostile) length prefix must be refused before
+        # any allocation-sized read, not honored
+        a.sendall(struct.pack("<I", wire.MAX_FRAME + 1))
+        with pytest.raises(wire.WireError, match="exceeds"):
+            wire.recv_msg(b)
+
+    def test_max_sized_frame_passes(self, pair):
+        a, b = pair
+        # just under the cap round-trips: the cap is a guard, not a tax
+        msg = {"v": "x" * (1 << 16)}
+        wire.send_msg(a, msg)
+        assert wire.recv_msg(b) == msg
+
+
+class TestMidFrameTimeout:
+    def test_desync_guard_keeps_reading_mid_frame(self, pair):
+        """A poll-timeout socket that times out MID-frame must keep
+        reading — surfacing the timeout there would desync the stream
+        (the next recv would parse payload bytes as a header)."""
+        a, b = pair
+        b.settimeout(0.05)
+        payload = b'{"op":"pong","t":9}'
+
+        def slow_send():
+            a.sendall(struct.pack("<I", len(payload)) + payload[:5])
+            time.sleep(0.25)  # several poll ticks mid-frame
+            a.sendall(payload[5:])
+
+        t = threading.Thread(target=slow_send)
+        t.start()
+        try:
+            # no socket.timeout surfaces despite the mid-frame stall...
+            assert wire.recv_msg(b) == {"op": "pong", "t": 9}
+        finally:
+            t.join()
+        # ...and the stream is still in sync for the next frame
+        wire.send_msg(a, {"op": "ping"})
+        assert wire.recv_msg(b) == {"op": "ping"}
+
+    def test_timeout_between_frames_surfaces(self, pair):
+        _a, b = pair
+        b.settimeout(0.05)
+        # BETWEEN frames the timeout must reach the poller so the worker
+        # loop can keep ticking (checking the wedge flag, etc.)
+        with pytest.raises(socket.timeout):
+            wire.recv_msg(b)

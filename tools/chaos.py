@@ -3,16 +3,20 @@
 The premerge gate (ci/chaos.sh) that proves the fault-domain story
 end-to-end, the way ci/q95_floor.json proves perf: it sweeps every
 registered ``faultinj.FAULT_KINDS`` entry across every instrumented
-boundary of eight scenarios — a spill walk (device→host→disk→back), an
+boundary of nine scenarios — a spill walk (device→host→disk→back), an
 out-of-core skewed shuffle, the single-chip q95 pipeline, a global
 distributed sort across the 8-device mesh, a JNI host-boundary
 round-trip, a streaming morsel scan, a multi-tenant serving wave
 (concurrent sessions through the ServeRuntime, killed and re-submitted
-mid-flight), and a multi-process front-door wave (supervised executor
+mid-flight), a multi-process front-door wave (supervised executor
 workers SIGKILLed/wedged at every session lifecycle point, sessions
-re-placed or loudly failed) — one fault per trial exhaustively, plus
-``chaos_trials`` seeded multi-fault trials per scenario.  Every trial
-must end with
+re-placed or loudly failed), and a durable-shuffle-plane wave
+(store_recovery: map outputs committed to the fleet-shared
+ShuffleStore, then torn mid-commit, corrupted post-commit, or orphaned
+by a SIGKILLed worker — the replacement must ADOPT committed shards,
+quarantine damage, and fence every revoked generation) — one fault per
+trial exhaustively, plus ``chaos_trials`` seeded multi-fault trials per
+scenario.  Every trial must end with
 
 * a result **bit-identical** to the scenario's fault-free baseline
   (sha256 over every output leaf's dtype/shape/bytes), and
@@ -633,10 +637,140 @@ class FrontdoorScenario:
                                     if k != "liveness"}}}
 
 
+class StoreRecoveryScenario:
+    """The durable shuffle plane under fire: ``shuffle_digest`` queries
+    through a store-enabled :class:`FrontDoor` commit their map outputs
+    to the fleet-shared :class:`ShuffleStore` in wave 0, then wave 1
+    re-issues the SAME store keys — so a replacement worker (after
+    ``worker_crash``), the same worker after a torn commit
+    (``store_commit``), or adoption-time CRC verification after
+    post-commit damage (``store_corrupt``) must all converge on the
+    identical answer: adopt the committed shard, or quarantine it and
+    lineage-rebuild — never a wrong result, never a hang.  Before
+    shutdown the scenario also probes the fence: every generation the
+    supervisor revoked at worker-loss time must be unable to commit
+    (a zombie's late write can never become adoptable).  The digest
+    hashes only the per-slot result digests (position-stable), not the
+    adoption counters — WHICH recovery path served a slot may differ
+    between the faulted run and the baseline; the answer may not."""
+
+    name = "store_recovery"
+    n_queries = 2
+    seeds = (21, 22)
+
+    def run(self) -> Dict:
+        from spark_rapids_jni_tpu.mem import RetryOOM
+        from spark_rapids_jni_tpu.serve import (AdmissionShed, FrontDoor,
+                                                QueryCancelled, WorkerLost)
+        from spark_rapids_jni_tpu.shuffle import store as store_mod
+
+        digests: List[Optional[str]] = [None] * (2 * self.n_queries)
+        kills = adopted = rebuilt = 0
+        config.set("serve_backoff_ms", 30.0)
+        fd = FrontDoor(workers=1, pool_bytes=2 * MB,
+                       host_pool_bytes=512 * KB, max_concurrent=1,
+                       heartbeat_ms=60.0, respawn_max=4)
+        try:
+            for wave in (0, 1):
+                pending = list(range(self.n_queries))
+                attempts = {i: 0 for i in pending}
+                while pending:
+                    wv = [(i, fd.submit(
+                        "shuffle_digest",
+                        {"seed": self.seeds[i], "rows_per_shard": 64,
+                         "store_key": f"chaos-store-{self.seeds[i]}"},
+                        tenant=f"tenant-{i}")) for i in pending]
+                    pending = []
+                    for i, sess in wv:
+                        try:
+                            out = sess.result(timeout=60.0)
+                            digests[wave * self.n_queries + i] = \
+                                out["digest"]
+                            adopted += int(out["adopted"])
+                            rebuilt += int(out["rebuilt"])
+                        except faultinj.FatalInjectedFault:
+                            raise  # whole-scenario replacement
+                        except (WorkerLost, AdmissionShed,
+                                faultinj.TaskCancelled,
+                                faultinj.InjectedFault, QueryCancelled,
+                                RetryOOM):
+                            kills += 1
+                            attempts[i] += 1
+                            if attempts[i] >= _MAX_ATTEMPTS:
+                                raise ChaosError(
+                                    f"store_recovery: tenant {i} not "
+                                    f"done after {_MAX_ATTEMPTS} "
+                                    f"re-submissions")
+                            pending.append(i)
+            # the fence probe, while the store dir still exists: every
+            # generation the supervisor revoked must be commit-rejected.
+            # The probe put runs in the SUPERVISOR process and crosses
+            # the store probes like any commit, so the trial's own rules
+            # may fire here too — any raise at a probe happens BEFORE
+            # the rename, which prevents the commit just as surely as
+            # the fence does, so it counts as rejected
+            if fd.store_dir and os.path.isdir(fd.store_dir):
+                reader = store_mod.ShuffleStore(fd.store_dir,
+                                                max_attempts=0)
+                for g in reader.revoked():
+                    zombie = store_mod.ShuffleStore(fd.store_dir,
+                                                    epoch=g,
+                                                    max_attempts=0)
+                    try:
+                        committed = zombie.put("chaos-fence-probe",
+                                               "zombie",
+                                               {"x": jnp.arange(4)})
+                    except faultinj.FatalInjectedFault:
+                        raise  # whole-scenario replacement
+                    except Exception:
+                        committed = False  # aborted pre-rename
+                    if committed:
+                        raise ChaosError(
+                            f"store_recovery: revoked gen {g} committed "
+                            f"past its fence")
+                    if reader.has_committed("chaos-fence-probe",
+                                            "zombie"):
+                        raise ChaosError(
+                            f"store_recovery: revoked gen {g}'s entry "
+                            f"became adoptable")
+        finally:
+            report = fd.shutdown()
+            config.reset("serve_backoff_ms")
+        unclean = {wid: e for wid, e in report["workers"].items()
+                   if not e.get("clean")}
+        if unclean:
+            raise ChaosError(
+                f"store_recovery: unclean workers: {unclean}")
+        if report["orphan_spill_files"]:
+            raise ChaosError(f"store_recovery: orphan spill files: "
+                             f"{report['orphan_spill_files']}")
+        if os.path.exists(fd.fleet_dir):
+            raise ChaosError(
+                "store_recovery: fleet dir survived shutdown "
+                "(shuffle_store_retain is off)")
+        for i in range(self.n_queries):
+            if digests[i] != digests[self.n_queries + i]:
+                raise ChaosError(
+                    f"store_recovery: tenant {i}'s adopted/rebuilt "
+                    f"answer drifted from its wave-0 original")
+        h = hashlib.sha256()
+        for d in digests:
+            h.update((d or "<none>").encode())
+        return {"digest": h.hexdigest(),
+                "extra": {"tenant_kills": kills,
+                          "adopted_shards": adopted,
+                          "lineage_rebuilds": rebuilt,
+                          "recovered_partitions": adopted + rebuilt,
+                          "fleet": {k: v for k, v in
+                                    report["fleet"].items()
+                                    if k != "liveness"}}}
+
+
 SCENARIOS = {s.name: s for s in (SpillScenario(), ShuffleScenario(),
                                  Q95Scenario(), SortScenario(),
                                  StreamingScanScenario(), JniScenario(),
-                                 ServingScenario(), FrontdoorScenario())}
+                                 ServingScenario(), FrontdoorScenario(),
+                                 StoreRecoveryScenario())}
 
 
 # ---------------------------------------------------------------------------
@@ -785,6 +919,25 @@ def single_fault_trials(fast: bool = False) -> List[Trial]:
         one("frontdoor", "serve_step", "oom")
         one("frontdoor", "spill_io_write", "spill_io")
         one("frontdoor", "spill_corrupt_file", "spill_corrupt")
+
+    # store_recovery scenario: the durable shuffle plane.  store_commit /
+    # store_corrupt fire ONLY here and in the store tests — these trials
+    # keep both kinds in the coverage check.  The torn write loses the
+    # durable copy (lineage covers, soft failure); worker_crash at the
+    # commit probe is the SIGKILL-mid-commit variant (the supervisor
+    # reaps the tmp remnant and revokes the gen); the crash at the
+    # serve seam (skip=2 → wave 1's first query, maps already
+    # committed) proves the replacement ADOPTS instead of re-running;
+    # the corruption trial proves adoption's CRC pass quarantines the
+    # damaged entry and falls back to lineage — bit-identical all ways.
+    if not fast:
+        one("store_recovery", "store_commit", "store_commit")
+        one("store_recovery", "store_commit", "worker_crash",
+            expect_recovered=True)
+        one("store_recovery", "serve_step", "worker_crash", skip=2,
+            expect_recovered=True)
+        one("store_recovery", "store_corrupt_file", "store_corrupt",
+            expect_recovered=True)
     return t
 
 
@@ -815,6 +968,10 @@ _MULTI_POOL = {
                   ("serve_step", "task_cancel"),
                   ("spill_io_write", "spill_io"),
                   ("spill_corrupt_file", "spill_corrupt")],
+    "store_recovery": [("serve_step", "worker_crash"),
+                       ("store_commit", "store_commit"),
+                       ("store_corrupt_file", "store_corrupt"),
+                       ("serve_step", "oom")],
 }
 
 
