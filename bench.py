@@ -708,6 +708,45 @@ def serve_main():
               file=sys.stderr, flush=True)
         return 1
 
+    # TCP sub-wave: the SAME query set a third time, now over the
+    # multi-host transport — two workers placed on two named hosts
+    # dialing the supervisor's TCP listener (both local here, but
+    # crossing the same framed/CRC'd/deadlined wire a remote peer
+    # would).  The digests must STILL match solo bit-for-bit: the
+    # transport may add latency, never drift.
+    tcp_workers = 2
+    tfd = FrontDoor(workers=tcp_workers, pool_bytes=pool,
+                    host_pool_bytes=host_pool, max_concurrent=n_streams,
+                    transport="tcp", hosts="hostA,hostB")
+    tcp_t0 = time.perf_counter()
+    try:
+        tcp_sessions = {
+            (i, k): tfd.submit(
+                "q6_digest",
+                {"rows": n_rows, "stream": i, "query": k, "steps": steps},
+                tenant=f"stream-{i}", est_bytes=batch_bytes)
+            for i in range(n_streams) for k in range(n_queries)}
+        tcp = {key: s.result(timeout=300.0)
+               for key, s in tcp_sessions.items()}
+        tcp_wall = time.perf_counter() - tcp_t0
+    except Exception as e:
+        print(f"# serve TCP wave failed: {e!r}", file=sys.stderr,
+              flush=True)
+        return 1
+    finally:
+        tcp_report = tfd.shutdown()
+    tcp_drift = [key for key in solo if solo[key][0] != tcp[key][0]]
+    if tcp_drift:
+        print(f"# serve scenario: TCP results DIFFER from solo for "
+              f"{sorted(tcp_drift)}", file=sys.stderr, flush=True)
+        return 1
+    if not tcp_report["clean"] or tcp_report["transport"] != "tcp":
+        print(f"# serve scenario: TCP fleet shutdown unclean or not tcp: "
+              f"transport={tcp_report['transport']} "
+              f"workers={tcp_report['workers']}",
+              file=sys.stderr, flush=True)
+        return 1
+
     # recovery sub-wave: the durable shuffle plane.  Wave A runs
     # ``shuffle_digest`` queries under FRESH store keys, so every map
     # shard executes and commits to the fleet-shared ShuffleStore
@@ -782,6 +821,9 @@ def serve_main():
             "mp_p50_ms": round(_pct(mp_lat, 0.5), 2),
             "mp_p99_ms": round(_pct(mp_lat, 0.99), 2),
             "mp_wall_s": round(mp_wall, 3),
+            "tcp_workers": tcp_workers,
+            "tcp_bit_identical": True,
+            "tcp_wall_s": round(tcp_wall, 3),
             "adopted_shards": adopted_shards,
             "replayed_shards": replayed_shards,
             "recovery_ms": round(recovery_ms, 2),

@@ -19,7 +19,9 @@ BENCH_FORCE_CPU=1 BENCH_SCAN_ROWS=32768 python bench.py --scan \
 # note carries solo vs concurrent p50/p99 (the serve_p99_floor ratchet).
 # The same run then replays the query set through the multi-process
 # FrontDoor (>=2 supervised executor workers) — note.mp_bit_identical
-# must be true with mp_workers >= 2 or the gate fails
+# must be true with mp_workers >= 2 or the gate fails — and once more
+# over the multi-host TCP transport (two workers on two named hosts) —
+# note.tcp_bit_identical must be true with tcp_workers >= 2
 BENCH_FORCE_CPU=1 BENCH_SERVE_ROWS=16384 python bench.py --serve \
   | tee /tmp/bench_smoke_serve.out
 # the q95 lines must be self-explaining (per-stage note + engines; cache +

@@ -1,12 +1,15 @@
 #!/usr/bin/env bash
 # Chaos-campaign gate: deterministic fault sweep over
 # spill/shuffle/q95/sort/streaming_scan/jni/serving/frontdoor/
-# store_recovery (frontdoor = multi-process supervisor: executor
-# workers SIGKILLed or wedged at every session lifecycle point;
-# store_recovery = the durable shuffle plane: map outputs torn
+# store_recovery/multihost (frontdoor = multi-process supervisor:
+# executor workers SIGKILLed or wedged at every session lifecycle
+# point; store_recovery = the durable shuffle plane: map outputs torn
 # mid-commit, corrupted post-commit, or orphaned by a SIGKILLed worker
 # must be adopted, quarantined, or lineage-rebuilt — and every revoked
-# zombie generation fence-rejected).
+# zombie generation fence-rejected; multihost = a two-host TCP fleet:
+# net_drop/net_stall/net_torn landed at the transport probes on both
+# sides must resolve via reconnect+reattach, and a partitioned worker
+# must self-fence with zero zombie-committed shards).
 #
 # Runs tools/chaos.py — every faultinj.FAULT_KINDS entry fired at every
 # instrumented boundary (one fault per trial, exhaustively) plus seeded
@@ -35,7 +38,7 @@ python - /tmp/chaos_report.json <<'EOF'
 import json, sys
 doc = json.load(open(sys.argv[1]))
 for scenario in ("sort", "streaming_scan", "jni", "serving", "frontdoor",
-                 "store_recovery"):
+                 "store_recovery", "multihost"):
     trials = [t for t in doc["trials"]
               if t["label"].startswith(scenario + ":")]
     assert trials, f"chaos report has no {scenario!r} trials"

@@ -45,6 +45,11 @@ not shrink below the recorded floor.  The same note must also record
 ``mp_bit_identical`` true with ``mp_workers >= 2``: the multi-process
 front-door wave (supervised executor workers) replays the same query
 set across the process boundary and must match solo digest for digest.
+Since r12 it must also record ``tcp_bit_identical`` true with
+``tcp_workers >= 2``: the same query set over the multi-host TCP
+transport (two workers on two named hosts dialing the supervisor's
+listener) must match solo digest for digest — the wire may add latency,
+never drift.
 Since r11 the note additionally carries the durable-shuffle recovery
 evidence: ``adopted_shards >= 1`` and ``replayed_shards >= 1`` with
 ``recovery_ms`` (a second wave over the same store keys must ADOPT the
@@ -190,6 +195,14 @@ def main(paths) -> int:
                         f"(note={json.dumps(serve_note)})")
         elif int(serve_note.get("mp_workers", 0)) < 2:
             errs.append("serve line's MP wave ran fewer than 2 executor "
+                        f"workers (note={json.dumps(serve_note)})")
+        elif serve_note.get("tcp_bit_identical") is not True:
+            errs.append("serve line's note.tcp_bit_identical is not true: "
+                        "the multi-host TCP wave no longer proves it "
+                        "matched the solo pass "
+                        f"(note={json.dumps(serve_note)})")
+        elif int(serve_note.get("tcp_workers", 0)) < 2:
+            errs.append("serve line's TCP wave ran fewer than 2 executor "
                         f"workers (note={json.dumps(serve_note)})")
         elif int(serve_note.get("adopted_shards", 0)) < 1:
             errs.append("serve line's note.adopted_shards < 1: the "

@@ -269,6 +269,35 @@ _register("serve_shed_threshold", 0.5, float,
           "configured workers drops below this, the front door sheds "
           "lowest-priority pending admissions beyond the surviving "
           "capacity (AdmissionShed) instead of queueing unboundedly.")
+_register("serve_transport", "unix", str,
+          "Fleet transport the front door serves workers over: 'unix' "
+          "(one Unix-domain socket under the private fleet dir — the "
+          "single-box default) or 'tcp' (workers dial the supervisor's "
+          "127.0.0.1 listener; the multi-host placement path).  Both "
+          "ride the same framed protocol with CRC32 trailers and "
+          "frame deadlines (serve/wire.py).")
+_register("serve_hosts", "", str,
+          "Comma-separated logical host names for worker placement "
+          "(e.g. 'hostA,hostB'): worker slots are distributed "
+          "round-robin across hosts and the shutdown report records "
+          "each worker's host.  More than one host forces the tcp "
+          "transport (a Unix socket cannot span boxes).  Empty = one "
+          "implicit local host.")
+_register("serve_partition_grace_ms", 1500.0, float,
+          "Split-brain budget: a worker that cannot reach the "
+          "supervisor for this long SELF-FENCES — it revokes its own "
+          "store epoch (shuffle/store.py revoke()) so a "
+          "partitioned-but-alive worker can never zombie-commit, then "
+          "drains and exits.  The supervisor mirrors the same window "
+          "before declaring a silent connection a partition and "
+          "re-placing the worker's sessions.")
+_register("serve_reconnect_max", 4, int,
+          "Bounded reconnect ladder: how many times a worker retries "
+          "dialing the supervisor (exponential backoff, capped by "
+          "serve_partition_grace_ms) after losing its CONNECTION "
+          "before treating the link as a partition.  A successful "
+          "re-dial re-attaches the same incarnation via its resume "
+          "token — live sessions survive, nothing is re-run.")
 _register("shuffle_store_dir", "", str,
           "Root of the persistent shuffle plane (shuffle/store.py): "
           "committed map outputs and drained round chunks land here "

@@ -65,7 +65,15 @@ boundary — instrumented jitted callables — since there is no CUPTI:
   store's post-commit probe (name ``store_corrupt_file``) — the store
   converts it into real byte flips in a just-committed chunk file, so
   adoption-time CRC verification, quarantine, and the lineage fallback
-  are proven against real on-disk damage.
+  are proven against real on-disk damage,
+  ``"net_drop"`` / ``"net_stall"`` / ``"net_torn"`` are NETWORK-level
+  kinds for the fleet transport (``serve/wire.py``): raised at a
+  transport's ``net_send_<role>``/``net_recv_<role>`` probes (role
+  ``sup`` or ``wk``, so chaos can target either side of the link), the
+  transport converts each into its real wire damage — a closed socket,
+  a stall past the frame deadline then a close, or a half-written frame
+  the peer's CRC/desync machinery must reject — and the reconnect
+  ladder with resume-token reattach is the recovery path on every one.
 * ``dynamic: true`` re-reads the file when its mtime changes, matching
   the injector's ``dynamicReconfig`` thread without needing one.
 
@@ -299,6 +307,45 @@ def _raise_store_corrupt(name: str):
     raise StoreCorruptionError(f"injected store corruption at {name}")
 
 
+class NetDropError(ConnectionError):
+    """The link dropped (kind ``"net_drop"``).
+
+    Raised at a transport's ``net_send_<role>``/``net_recv_<role>``
+    probe (serve/wire.py); the transport converts it into a real closed
+    socket — the peer sees EOF, this side sees ``WireError`` — and the
+    reconnect supervision (worker-side ladder, supervisor-side
+    resume-token reattach) is the only recovery path."""
+
+
+class NetStallError(OSError):
+    """The link stalled (kind ``"net_stall"``).
+
+    The transport sleeps past its frame deadline (so heartbeat and
+    deadline detectors genuinely fire, nothing is mocked), then drops
+    the connection exactly like ``net_drop``."""
+
+
+class NetTornError(ConnectionError):
+    """A frame tore on the wire (kind ``"net_torn"``).
+
+    On send the transport writes the header plus HALF the payload and
+    closes — the peer's mid-frame/CRC desync machinery must detect the
+    damage rather than parse garbage; on recv the already-read frame is
+    discarded and the link closed (``WireDesync``)."""
+
+
+def _raise_net_drop(name: str):
+    raise NetDropError(f"injected link drop at {name}")
+
+
+def _raise_net_stall(name: str):
+    raise NetStallError(f"injected link stall at {name}")
+
+
+def _raise_net_torn(name: str):
+    raise NetTornError(f"injected torn frame at {name}")
+
+
 # The registry of injectable fault flavors: kind -> raiser.  graftlint's
 # GL006 keeps this in sync with every use site statically — a kind used
 # in a config dict but missing here would otherwise only fail when its
@@ -320,6 +367,9 @@ FAULT_KINDS = {
     "worker_stall": _raise_worker_stall,
     "store_commit": _raise_store_commit,
     "store_corrupt": _raise_store_corrupt,
+    "net_drop": _raise_net_drop,
+    "net_stall": _raise_net_stall,
+    "net_torn": _raise_net_torn,
 }
 
 

@@ -1,10 +1,13 @@
 """Multi-tenant serving runtime over the unified memory arena.
 
 See :mod:`~spark_rapids_jni_tpu.serve.runtime` for the in-process
-admission / run / cancel lifecycle and the kill-safety contract, and
+admission / run / cancel lifecycle and the kill-safety contract,
 :mod:`~spark_rapids_jni_tpu.serve.frontdoor` for the multi-process
 front door that supervises executor worker processes (crash detection,
-session re-placement, load-shedding degradation).
+session re-placement, load-shedding degradation, reconnect supervision
+with partition-safe self-fencing), and
+:mod:`~spark_rapids_jni_tpu.serve.wire` for the framed fleet transport
+(Unix + TCP, CRC32 trailers, deadlines, network fault domains).
 """
 
 from .frontdoor import (
@@ -22,6 +25,13 @@ from .runtime import (
     ServeRuntime,
     TenantSession,
 )
+from .wire import (
+    TcpTransport,
+    Transport,
+    UnixTransport,
+    WireDesync,
+    WireError,
+)
 
 __all__ = [
     "AdmissionShed",
@@ -32,7 +42,12 @@ __all__ = [
     "QueryTimeout",
     "ServeError",
     "ServeRuntime",
+    "TcpTransport",
     "TenantSession",
+    "Transport",
+    "UnixTransport",
+    "WireDesync",
+    "WireError",
     "WorkerLost",
     "fleet_metrics",
 ]

@@ -8,9 +8,29 @@ processes"; the same isolation argument "Accelerating Presto with GPUs"
 makes for production query fleets):
 
 * **Supervisor** (:class:`FrontDoor`) — listens on a Unix-domain socket
-  under a private fleet directory and spawns ``serve_workers`` executor
-  processes (``python -m spark_rapids_jni_tpu.serve.worker``), each
-  hosting its OWN ``ServeRuntime``, arena, spill store, and plan cache.
+  under a private fleet directory (or a ``127.0.0.1`` TCP port with
+  ``serve_transport=tcp`` — the multi-host placement path) and spawns
+  ``serve_workers`` executor processes
+  (``python -m spark_rapids_jni_tpu.serve.worker``), each hosting its
+  OWN ``ServeRuntime``, arena, spill store, and plan cache.
+* **Placement** — worker slots are distributed round-robin across the
+  ``serve_hosts`` logical hosts (more than one host forces tcp); each
+  worker's host rides its handle and the shutdown report, so chaos can
+  prove both hosts served.
+* **Connection supervision ≠ process supervision** — a lost
+  *connection* (``net_drop``/``net_stall``/``net_torn``, or any real
+  link failure) does NOT kill the worker: the slot enters
+  ``reconnecting`` and the worker's bounded ladder
+  (``serve_reconnect_max`` re-dials) re-attaches the same incarnation
+  via its resume token — live sessions survive, queued results flush,
+  nothing re-runs.  Only a lost *worker* (crash/wedge, or a connection
+  silent past ``serve_partition_grace_ms``) triggers the loss protocol.
+* **Partition-safe split-brain** — a worker that cannot reach the
+  supervisor past ``serve_partition_grace_ms`` SELF-FENCES: it revokes
+  its own store epoch (PR-11 ``revoke()``), writes a
+  ``self-fenced.json`` sentinel, drains, and exits — so a
+  partitioned-but-alive worker can never zombie-commit, whichever side
+  notices the partition first.
 * **Pinning** — a tenant's sessions stick to one worker (least-loaded on
   first sight, re-pinned only when the pinned worker is gone), so its
   spill-store residency and plan-cache pins stay process-local.
@@ -107,7 +127,8 @@ class FleetMetrics:
     ``profiler.fleet_summary()``."""
 
     FIELDS = ("workers_spawned", "respawns", "crashes", "stalls",
-              "replacements", "worker_lost", "sheds", "circuit_open")
+              "replacements", "worker_lost", "sheds", "circuit_open",
+              "reconnects", "partitions_detected", "self_fenced_workers")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -210,16 +231,20 @@ class WorkerHandle:
     GL012 flags constructions with no release on some exit path."""
 
     def __init__(self, worker_id: int, gen: int, wdir: str,
-                 proc: subprocess.Popen):
+                 proc: subprocess.Popen, host: str = "local",
+                 token: str = ""):
         self.worker_id = int(worker_id)
         self.gen = int(gen)
         self.dir = wdir
         self.proc = proc
-        self.conn: Optional[socket.socket] = None
-        self.send_lock = threading.Lock()
-        self.state = "starting"  # starting | healthy | dead
+        self.host = host
+        self.token = token  # incarnation identity for hello reattach
+        self.link: Optional[wire.Transport] = None
+        self.state = "starting"  # starting | healthy | reconnecting | dead
         self.spawned_at = time.monotonic()
         self.last_pong = time.monotonic()
+        self.conn_lost_at = 0.0
+        self.ever_connected = False
         self.stall_breaks = 0
         self.stall_suspect = 0
         self.results_since_pong = 0
@@ -233,10 +258,9 @@ class WorkerHandle:
             self.proc.kill()
 
     def close(self):
-        conn, self.conn = self.conn, None
-        if conn is not None:
-            with contextlib.suppress(OSError):
-                conn.close()
+        link, self.link = self.link, None
+        if link is not None:
+            link.close()
 
 
 class FrontDoor:
@@ -253,10 +277,37 @@ class FrontDoor:
                  shed_threshold: Optional[float] = None,
                  setup: Optional[str] = None,
                  store: bool = True,
-                 store_dir: Optional[str] = None):
+                 store_dir: Optional[str] = None,
+                 transport: Optional[str] = None,
+                 hosts=None,
+                 partition_grace_ms: Optional[float] = None,
+                 reconnect_max: Optional[int] = None):
         global _last_metrics
         self._n_workers = int(workers if workers is not None
                               else config.get("serve_workers"))
+        hosts_raw = hosts if hosts is not None else config.get("serve_hosts")
+        if isinstance(hosts_raw, str):
+            host_list = [h.strip() for h in hosts_raw.split(",")
+                         if h.strip()]
+        else:
+            host_list = [str(h) for h in hosts_raw]
+        self._hosts: List[str] = host_list or ["local"]
+        self._transport = str(transport if transport is not None
+                              else config.get("serve_transport"))
+        if len(self._hosts) > 1 and self._transport == "unix":
+            # a Unix socket can't span boxes: multi-host placement
+            # implies the TCP transport
+            self._transport = "tcp"
+        if self._transport not in ("unix", "tcp"):
+            raise ServeError(
+                f"serve_transport must be 'unix' or 'tcp', "
+                f"got {self._transport!r}")
+        self._grace_s = float(
+            partition_grace_ms if partition_grace_ms is not None
+            else config.get("serve_partition_grace_ms")) / 1000.0
+        self._reconnect_max = int(
+            reconnect_max if reconnect_max is not None
+            else config.get("serve_reconnect_max"))
         self._pool_bytes = int(pool_bytes)
         self._host_pool_bytes = int(host_pool_bytes)
         self._max_concurrent = int(
@@ -299,10 +350,11 @@ class FrontDoor:
         self._shutdown_done = threading.Event()
         self._shutdown_result: Optional[dict] = None
 
-        self._sock_path = os.path.join(self.fleet_dir, "frontdoor.sock")
-        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self._listener.bind(self._sock_path)
-        self._listener.listen(self._n_workers * 2)
+        self._self_fenced: List[dict] = []
+        where = os.path.join(self.fleet_dir, "frontdoor.sock") \
+            if self._transport == "unix" else "127.0.0.1:0"
+        self._listener, self._sock_addr = wire.listen(
+            self._transport, where, backlog=self._n_workers * 2)
         self._listener.settimeout(0.2)
 
         with self._lock:
@@ -354,10 +406,9 @@ class FrontDoor:
                     status="cancelled")
                 return
             w = self._workers.get(sess.worker_id)
-            if w is not None and w.conn is not None and w.state == "healthy":
+            if w is not None and w.link is not None and w.state == "healthy":
                 with contextlib.suppress(OSError):
-                    wire.send_msg(w.conn, {"op": "cancel", "sid": sess.sid},
-                                  w.send_lock)
+                    w.link.send({"op": "cancel", "sid": sess.sid})
 
     def sessions(self) -> List[FrontDoorSession]:
         with self._lock:
@@ -397,9 +448,9 @@ class FrontDoor:
                 f"session {sess.sid} cancelled: front door shutdown",
                 reason="shutdown"), status="cancelled")
         for w in workers:
-            if w.state != "dead" and w.conn is not None:
+            if w.state != "dead" and w.link is not None:
                 with contextlib.suppress(OSError):
-                    wire.send_msg(w.conn, {"op": "shutdown"}, w.send_lock)
+                    w.link.send({"op": "shutdown"})
         deadline = time.monotonic() + timeout_s
         for w in workers:
             entry: dict
@@ -433,6 +484,7 @@ class FrontDoor:
                     f"session {sess.sid} cancelled: front door shutdown",
                     reason="shutdown"), status="cancelled")
             w.sessions = {}
+            entry["host"] = w.host
             report["workers"][w.worker_id] = entry
             report["clean"] = report["clean"] and entry["clean"]
         # zero-orphan-spill-files invariant, checked BEFORE the reap:
@@ -450,6 +502,9 @@ class FrontDoor:
                         os.path.join(root, f))
         report["clean"] = report["clean"] and not report["orphan_spill_files"]
         report["fleet"] = self.metrics.snapshot()
+        report["transport"] = self._transport
+        report["hosts"] = list(self._hosts)
+        report["self_fenced"] = list(self._self_fenced)
         if self._store is not None:
             report["store"] = self._store.snapshot()
         retain = self.store_dir is not None \
@@ -522,19 +577,28 @@ class FrontDoor:
             # let a stale inherited env re-arm faults in the child
             env.pop(faultinj.ENV_CONFIG, None)
         env[faultinj.ENV_MIRROR] = os.path.join(wdir, "fired.jsonl")
+        host = self._hosts[slot % len(self._hosts)]
+        token = f"{slot}-{gen}-{os.urandom(8).hex()}"
         cmd = [sys.executable, "-m", "spark_rapids_jni_tpu.serve.worker",
-               "--socket", self._sock_path,
+               "--socket", self._sock_addr,
+               "--transport", self._transport,
                "--worker-id", str(slot),
                "--dir", wdir,
+               "--host", host,
+               "--resume-token", token,
+               "--partition-grace-ms", str(self._grace_s * 1000.0),
+               "--reconnect-max", str(self._reconnect_max),
                "--pool-bytes", str(self._pool_bytes),
                "--host-pool-bytes", str(self._host_pool_bytes),
                "--max-concurrent", str(self._max_concurrent),
                "--task-id-base", str(10_000 + slot * 1_000)]
+        # the gen doubles as the store's fencing epoch AND the hello's
+        # fence_epoch: commits from this incarnation are keyed
+        # attempt-<gen> and revocable the moment the supervisor declares
+        # it lost, and an attach claiming any other epoch is refused
+        cmd += ["--epoch", str(gen)]
         if self.store_dir is not None:
-            # the gen doubles as the store's fencing epoch: commits from
-            # this incarnation are keyed attempt-<gen> and revocable the
-            # moment the supervisor declares it lost
-            cmd += ["--store-dir", self.store_dir, "--epoch", str(gen)]
+            cmd += ["--store-dir", self.store_dir]
         if self._setup:
             cmd += ["--setup", self._setup]
         log = open(os.path.join(wdir, "worker.log"), "ab")
@@ -544,7 +608,7 @@ class FrontDoor:
                 stderr=subprocess.STDOUT, start_new_session=True)
         finally:
             log.close()
-        w = WorkerHandle(slot, gen, wdir, proc)
+        w = WorkerHandle(slot, gen, wdir, proc, host=host, token=token)
         self._workers[slot] = w
         self.metrics.bump("workers_spawned")
         self.metrics.set_liveness(slot, "starting")
@@ -559,42 +623,76 @@ class FrontDoor:
                 continue
             except OSError:
                 return
+            link = wire.wrap(conn, self._transport, role="sup")
             try:
-                conn.settimeout(5.0)
-                hello = wire.recv_msg(conn)
+                link.settimeout(5.0)
+                hello = link.recv()
                 slot = int(hello.get("worker_id", -1))
                 pid = hello.get("pid")
+                token = hello.get("resume_token", "")
+                epoch = int(hello.get("fence_epoch", -1))
             except (wire.WireError, socket.timeout, OSError, ValueError):
-                with contextlib.suppress(OSError):
-                    conn.close()
+                link.close()
                 continue
             with self._lock:
                 w = self._workers.get(slot)
-                if w is None or w.state == "dead" or w.proc.pid != pid:
-                    # stale incarnation raced its own SIGKILL: drop it
-                    with contextlib.suppress(OSError):
-                        conn.close()
+                if w is None or w.state == "dead" or w.proc.pid != pid \
+                        or w.token != token or w.gen != epoch:
+                    # a stale incarnation raced its own SIGKILL, or the
+                    # resume token / fence epoch doesn't match the slot's
+                    # live generation: drop it — only the incarnation we
+                    # spawned may attach to these sessions
+                    link.close()
                     continue
-                conn.settimeout(None)
-                w.conn = conn
+                if w.ever_connected:
+                    # the same incarnation re-dialled after a link loss:
+                    # resume-token reattach, sessions stay live
+                    self.metrics.bump("reconnects")
+                w.ever_connected = True
+                link.settimeout(0.2)  # reader poll tick (supersession)
+                old, w.link = w.link, link
+                if old is not None:
+                    old.close()
                 w.state = "healthy"
                 w.last_pong = time.monotonic()
                 self.metrics.set_liveness(slot, "healthy")
+                # at-least-once re-delivery: a submit in flight when the
+                # old link died (or whose "running" ack died) was lost
+                # with it — re-send every placed-but-unacked session; the
+                # worker dedups by sid, so a duplicate is a re-ack, never
+                # a second run
+                for sess in list(w.sessions.values()):
+                    if sess.status == "placed" and not sess._done.is_set():
+                        try:
+                            link.send({
+                                "op": "submit", "sid": sess.sid,
+                                "kind": sess.kind, "params": sess.params,
+                                "tenant": str(sess.tenant),
+                                "priority": sess.priority,
+                                "est_bytes": sess.est_bytes,
+                                "timeout_s": sess.timeout_s,
+                            })
+                        except OSError:
+                            break  # link died again: next reattach retries
                 threading.Thread(
-                    target=self._reader, args=(w,),
+                    target=self._reader, args=(w, link),
                     name=f"frontdoor-reader-{slot}-{w.gen}",
                     daemon=True).start()
             self._wake.set()
 
-    def _reader(self, w: WorkerHandle):
+    def _reader(self, w: WorkerHandle, link: wire.Transport):
         while True:
-            conn = w.conn
-            if conn is None:
-                return
+            if w.link is not link:
+                return  # superseded by a reattached connection
             try:
-                msg = wire.recv_msg(conn)
+                msg = link.recv()
+            except socket.timeout:
+                continue
             except (wire.WireError, OSError, ValueError):
-                return  # EOF/kill: the monitor's waitpid handles the rest
+                # the CONNECTION died — not necessarily the worker: hand
+                # the slot to reconnect supervision, not the loss protocol
+                self._on_conn_lost(w, link)
+                return
             op = msg.get("op")
             if op == "pong":
                 self._on_pong(w, msg)
@@ -609,6 +707,22 @@ class FrontDoor:
                 w.bye = msg
                 w.fired = list(msg.get("fired") or [])
                 w.last_pong = time.monotonic()
+
+    def _on_conn_lost(self, w: WorkerHandle, link: wire.Transport):
+        """Connection supervision: the link died but the process may be
+        fine.  Park the slot in ``reconnecting`` — sessions stay placed,
+        the worker's ladder re-dials, and only the monitor's partition
+        window (``serve_partition_grace_ms``) escalates to the loss
+        protocol."""
+        link.close()
+        with self._lock:
+            if w.link is link:
+                w.link = None
+                if w.state == "healthy":
+                    w.state = "reconnecting"
+                    w.conn_lost_at = time.monotonic()
+                    self.metrics.set_liveness(w.worker_id, "reconnecting")
+        self._wake.set()
 
     def _on_pong(self, w: WorkerHandle, msg: dict):
         with self._lock:
@@ -678,9 +792,10 @@ class FrontDoor:
                             now)
                         continue
                     if w.state == "healthy":
-                        with contextlib.suppress(OSError):
-                            wire.send_msg(w.conn, {"op": "ping", "t": now},
-                                          w.send_lock)
+                        link = w.link
+                        if link is not None:
+                            with contextlib.suppress(OSError):
+                                link.send({"op": "ping", "t": now})
                         if now - w.last_pong > self._hb_s * _MISS_BUDGET:
                             w.kill()
                             self._on_worker_lost_locked(
@@ -692,6 +807,17 @@ class FrontDoor:
                                 w, "stall epoch climbing without progress",
                                 "stalls", now)
                             continue
+                    elif w.state == "reconnecting":
+                        # connection supervision: wait out the worker's
+                        # reconnect ladder; a link silent past the
+                        # partition grace IS a partition — the worker
+                        # self-fences on its side, we re-place on ours
+                        if now - w.conn_lost_at > \
+                                self._grace_s + self._hb_s * _MISS_BUDGET:
+                            w.kill()
+                            self._on_worker_lost_locked(
+                                w, "connection lost past the partition "
+                                "grace", "partitions_detected", now)
                     elif now - w.spawned_at > _STARTUP_GRACE_S:
                         w.kill()
                         self._on_worker_lost_locked(
@@ -731,6 +857,18 @@ class FrontDoor:
         w.close()
         self._merge_fired(w)
         fired = list(w.fired)
+        # a self-fence sentinel means the worker saw the partition from
+        # its side and already revoked its own epoch before exiting —
+        # count it (the supervisor's revoke below is then a no-op)
+        sentinel = None
+        with contextlib.suppress(OSError, ValueError):
+            with open(os.path.join(w.dir, "self-fenced.json")) as f:
+                sentinel = json.load(f)
+        if sentinel is not None:
+            self.metrics.bump("self_fenced_workers")
+            self._self_fenced.append(sentinel)
+            if kind != "partitions_detected":
+                self.metrics.bump("partitions_detected")
         # fence the dead generation FIRST — a zombie can outlive its
         # SIGKILL verdict and must never commit late — then reap only
         # its UNcommitted tmp remnants: the committed shards are exactly
@@ -820,7 +958,7 @@ class FrontDoor:
     def _pick_worker_locked(self, sess: FrontDoorSession
                             ) -> Optional[WorkerHandle]:
         healthy = [w for w in self._workers.values()
-                   if w.state == "healthy" and w.conn is not None
+                   if w.state == "healthy" and w.link is not None
                    and len(w.sessions) < self._max_concurrent]
         if not healthy:
             return None
@@ -863,12 +1001,12 @@ class FrontDoor:
                 still.append(entry)
                 continue
             try:
-                wire.send_msg(w.conn, {
+                w.link.send({
                     "op": "submit", "sid": sess.sid, "kind": sess.kind,
                     "params": sess.params, "tenant": str(sess.tenant),
                     "priority": sess.priority, "est_bytes": sess.est_bytes,
                     "timeout_s": sess.timeout_s,
-                }, w.send_lock)
+                })
             except OSError:
                 # worker dying under us: leave it pending, the monitor's
                 # loss protocol will re-route it

@@ -7,6 +7,27 @@ host pools), spill store rooted under its private directory, plan cache,
 and ``ServeRuntime`` — so a crash or wedge takes down exactly one
 process's tenants and nothing shared.
 
+The worker serves BOTH fleet transports (``--transport unix|tcp``,
+serve/wire.py): it dials the supervisor, opens with the idempotent
+``hello`` carrying ``(worker_id, fence_epoch, resume_token)``, and
+treats connection loss as recoverable — a bounded reconnect ladder
+(``--reconnect-max`` attempts, exponential backoff, capped by the
+partition grace) re-dials and re-hellos; the same resume token
+re-attaches this incarnation to its live sessions supervisor-side, and
+results that could not be delivered while the link was down are queued
+and flushed after reattach, so a dropped link costs zero sessions.
+
+Split-brain safety: a worker that cannot reach the supervisor past
+``--partition-grace-ms`` must assume it has been declared dead on the
+other side of the partition.  It SELF-FENCES — revokes its own store
+epoch through the PR-11 ``revoke()`` path so none of its in-flight
+commits can ever be adopted (zero zombie commits), writes a
+``self-fenced.json`` sentinel the supervisor reads at loss time, then
+drains and exits.  Independently the main loop re-validates its fence
+epoch against the store every ~0.5s: if the supervisor revoked this
+generation (it believes we are lost) the worker stops serving and
+exits rather than compute results nobody will adopt.
+
 Submissions arrive as ``{"kind": name, "params": {...}}`` and are looked
 up in the worker-side query-kind registry (:func:`register_query_kind`)
 — the wire carries only JSON, never code.  Built-in kinds:
@@ -33,21 +54,25 @@ process via ``SPARK_RAPIDS_TPU_FAULT_CONFIG`` and points
 an injection survives even our own SIGKILL.  This module installs the
 process-level hooks for the ``worker_crash`` (kill -9 self) and
 ``worker_stall`` (wedge: stop answering heartbeats, block the querying
-thread forever) kinds via :func:`faultinj.set_worker_fault_hooks`.
+thread forever) kinds via :func:`faultinj.set_worker_fault_hooks`; the
+``net_drop``/``net_stall``/``net_torn`` kinds fire inside the transport
+itself at the ``net_send_wk``/``net_recv_wk`` probes.
 """
 
 from __future__ import annotations
 
 import argparse
+import contextlib
 import hashlib
 import importlib
+import json
 import os
 import signal
 import socket
 import sys
 import threading
 import time
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, List, Optional
 
 _QUERY_KINDS: Dict[str, Callable] = {}
 
@@ -206,11 +231,139 @@ def _stall_hook(name: str):
         time.sleep(60.0)
 
 
+class _SupervisorLink:
+    """The worker's side of the supervised connection: one live
+    transport, the idempotent hello, the bounded reconnect ladder, and
+    the queue of frames that must survive a link outage (``running`` /
+    ``result`` — the supervisor deduplicates by sid, so a flush after
+    reattach is at-least-once delivery with exactly-once effect)."""
+
+    def __init__(self, wire_mod, kind: str, address: str, worker_id: int,
+                 epoch: int, token: str, grace_s: float,
+                 reconnect_max: int):
+        self._wire = wire_mod
+        self.kind = kind
+        self.address = address
+        self.worker_id = int(worker_id)
+        self.epoch = int(epoch)
+        self.token = str(token)
+        self.grace_s = float(grace_s)
+        self.reconnect_max = int(reconnect_max)
+        self._lock = threading.Lock()
+        self._t = None
+        self._unsent: List[dict] = []
+        self.last_contact = time.monotonic()
+        self.reconnects = 0
+
+    def down(self) -> bool:
+        with self._lock:
+            return self._t is None
+
+    def connect(self):
+        """Dial + idempotent hello.  Raises on failure (the ladder in
+        :meth:`reconnect` is the retry policy)."""
+        t = self._wire.connect(self.kind, self.address, role="wk",
+                               timeout_s=2.0)
+        try:
+            t.hello(self.worker_id, os.getpid(), self.epoch, self.token)
+        except (self._wire.WireError, OSError):
+            t.close()
+            raise
+        t.settimeout(0.05)  # poll tick: lets the wedge flag win the loop
+        with self._lock:
+            old, self._t = self._t, t
+        if old is not None:
+            old.close()
+        self.last_contact = time.monotonic()
+
+    def reconnect(self) -> bool:
+        """The bounded ladder: up to ``reconnect_max`` re-dials with
+        exponential backoff, never outlasting the partition grace.
+        True = reattached (queued frames flushed); False = partitioned."""
+        start = time.monotonic()
+        for attempt in range(self.reconnect_max):
+            if time.monotonic() - start > self.grace_s:
+                return False
+            try:
+                self.connect()
+            except (self._wire.WireError, OSError):
+                time.sleep(min(0.03 * (2 ** attempt),
+                               max(0.05, self.grace_s / 4.0)))
+                continue
+            self.reconnects += 1
+            self.flush_unsent()
+            return True
+        return False
+
+    def _drop(self, t):
+        with self._lock:
+            if self._t is t:
+                self._t = None
+        t.close()
+
+    def send(self, msg: dict, queue_on_fail: bool = False) -> bool:
+        with self._lock:
+            t = self._t
+            if t is None:
+                if queue_on_fail:
+                    self._unsent.append(msg)
+                return False
+        try:
+            t.send(msg)
+            return True
+        except (self._wire.WireError, OSError):
+            self._drop(t)
+            if queue_on_fail:
+                with self._lock:
+                    self._unsent.append(msg)
+            return False
+
+    def flush_unsent(self):
+        with self._lock:
+            pending, self._unsent = self._unsent, []
+        for i, msg in enumerate(pending):
+            if not self.send(msg):
+                with self._lock:
+                    self._unsent = pending[i:] + self._unsent
+                return
+
+    def recv(self) -> dict:
+        """One frame from the supervisor; ``socket.timeout`` at a frame
+        boundary passes through for the poll loop, anything else drops
+        the link (the main loop's ladder takes over)."""
+        with self._lock:
+            t = self._t
+        if t is None:
+            raise self._wire.WireError("link down")
+        try:
+            msg = t.recv()
+        except socket.timeout:
+            raise
+        except (self._wire.WireError, OSError, ValueError):
+            self._drop(t)
+            raise self._wire.WireError("link lost")
+        self.last_contact = time.monotonic()
+        return msg
+
+    def close(self):
+        with self._lock:
+            t, self._t = self._t, None
+        if t is not None:
+            t.close()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--socket", required=True)
+    ap.add_argument("--socket", required=True,
+                    help="supervisor address: Unix path, or host:port "
+                         "for --transport tcp")
+    ap.add_argument("--transport", default="unix",
+                    choices=("unix", "tcp"))
     ap.add_argument("--worker-id", required=True, type=int)
     ap.add_argument("--dir", required=True)
+    ap.add_argument("--host", default="",
+                    help="logical placement host (informational: echoed "
+                         "in hello and the self-fence sentinel)")
     ap.add_argument("--pool-bytes", type=int, default=64 << 20)
     ap.add_argument("--host-pool-bytes", type=int, default=16 << 20)
     ap.add_argument("--max-concurrent", type=int, default=0)
@@ -220,6 +373,11 @@ def main(argv=None) -> int:
     ap.add_argument("--epoch", type=int, default=0,
                     help="this incarnation's store fencing epoch "
                          "(the supervisor passes the worker generation)")
+    ap.add_argument("--resume-token", default="",
+                    help="incarnation identity echoed in every hello so "
+                         "a reconnect reattaches instead of replacing")
+    ap.add_argument("--partition-grace-ms", type=float, default=1500.0)
+    ap.add_argument("--reconnect-max", type=int, default=4)
     ap.add_argument("--setup", default=None,
                     help="module whose register_query_kinds(register) "
                          "adds custom kinds before serving")
@@ -256,12 +414,41 @@ def main(argv=None) -> int:
         task_id_base=args.task_id_base,
         store=store, epoch=args.epoch)
 
-    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-    sock.connect(args.socket)
-    sock.settimeout(0.05)  # poll tick: lets the wedge flag win the loop
-    send_lock = threading.Lock()
-    wire.send_msg(sock, {"op": "hello", "worker_id": args.worker_id,
-                         "pid": os.getpid()}, send_lock)
+    link = _SupervisorLink(
+        wire, args.transport, args.socket, args.worker_id, args.epoch,
+        args.resume_token, grace_s=args.partition_grace_ms / 1000.0,
+        reconnect_max=args.reconnect_max)
+
+    def self_fence(reason: str):
+        # safety first: revoke our OWN epoch so any commit still in
+        # flight on a query thread is rejected at the store's rename —
+        # a partitioned-but-alive worker must never zombie-commit
+        if store is not None:
+            with contextlib.suppress(OSError):
+                store.revoke(args.epoch)
+        info = {"worker_id": args.worker_id, "pid": os.getpid(),
+                "epoch": args.epoch, "host": args.host,
+                "reason": reason, "reconnects": link.reconnects}
+        if store is not None:
+            with contextlib.suppress(OSError):
+                info["fenced_commits"] = \
+                    store.snapshot().get("fenced_commits", 0)
+        tmp = os.path.join(args.dir, "self-fenced.json.tmp")
+        try:
+            with open(tmp, "w") as f:
+                json.dump(info, f)
+            os.replace(tmp, os.path.join(args.dir, "self-fenced.json"))
+        except OSError:
+            pass
+
+    partitioned = False
+    revoked_out = False
+    try:
+        link.connect()
+    except (wire.WireError, OSError):
+        if not link.reconnect():
+            self_fence("could not reach the supervisor at startup")
+            partitioned = True
 
     sessions: Dict[int, object] = {}
     watchers: list = []
@@ -289,20 +476,30 @@ def main(argv=None) -> int:
             msg = {"op": "result", "sid": sid, "ok": False,
                    "status": "failed", "error": type(e).__name__,
                    "message": str(e)}
-        try:
-            wire.send_msg(sock, msg, send_lock)
-        except OSError:
-            pass  # supervisor gone; it will reap us
+        # queue on a downed link: the result is flushed after reattach
+        # (the supervisor's sid dedup makes a re-send a no-op)
+        link.send(msg, queue_on_fail=True)
 
     def handle_submit(msg: dict):
         sid = int(msg["sid"])
+        if sid in sessions:
+            # duplicate delivery: after a reattach the supervisor
+            # re-sends every submit it never saw acked — either the
+            # original submit or our "running" ack died with the old
+            # link.  The session already exists; re-ack instead of
+            # running the query twice (the result, if already computed,
+            # sits in the pending queue and flushes on its own)
+            if not sessions[sid].done():
+                link.send({"op": "running", "sid": sid},
+                          queue_on_fail=True)
+            return
         kind = _QUERY_KINDS.get(msg.get("kind"))
         if kind is None:
-            wire.send_msg(sock, {
+            link.send({
                 "op": "result", "sid": sid, "ok": False, "status": "failed",
                 "error": "ServeError",
                 "message": f"unknown query kind {msg.get('kind')!r}",
-            }, send_lock)
+            }, queue_on_fail=True)
             return
         params = msg.get("params") or {}
         announced = threading.Event()
@@ -310,11 +507,8 @@ def main(argv=None) -> int:
         def query(ctx, sess):
             if not announced.is_set():
                 announced.set()
-                try:
-                    wire.send_msg(sock, {"op": "running", "sid": sid},
-                                  send_lock)
-                except OSError:
-                    pass
+                link.send({"op": "running", "sid": sid},
+                          queue_on_fail=True)
             return kind(ctx, params, sess)
 
         try:
@@ -323,9 +517,10 @@ def main(argv=None) -> int:
                 tenant=msg.get("tenant"), timeout_s=msg.get("timeout_s"),
                 priority=int(msg.get("priority") or 0))
         except BaseException as e:
-            wire.send_msg(sock, {
+            link.send({
                 "op": "result", "sid": sid, "ok": False, "status": "failed",
-                "error": type(e).__name__, "message": str(e)}, send_lock)
+                "error": type(e).__name__, "message": str(e)},
+                queue_on_fail=True)
             return
         sessions[sid] = sess
         t = threading.Thread(target=watch, args=(sid, sess),
@@ -334,38 +529,56 @@ def main(argv=None) -> int:
         t.start()
 
     # -- main loop -------------------------------------------------------
-    while True:
+    last_fence_check = time.monotonic()
+    while not partitioned:
         if _WEDGED.is_set():
             # simulated interpreter wedge: stop answering everything;
             # only the supervisor's SIGKILL ends this process
             while True:
                 time.sleep(60.0)
+        now = time.monotonic()
+        # periodic fence re-validation: if the supervisor revoked this
+        # generation it has declared us lost — stop serving rather than
+        # compute results nobody will adopt
+        if store is not None and now - last_fence_check >= 0.5:
+            last_fence_check = now
+            fenced = False
+            with contextlib.suppress(OSError):
+                fenced = store.fenced(args.epoch)
+            if fenced:
+                revoked_out = True
+                break
+        if link.down():
+            if link.reconnect():
+                continue
+            self_fence("supervisor unreachable past the partition grace")
+            partitioned = True
+            break
         try:
-            msg = wire.recv_msg(sock)
+            msg = link.recv()
         except socket.timeout:
             continue
         except (wire.WireError, OSError):
-            break  # supervisor died: exit; our spill dir dies with us
+            continue  # loop top runs the reconnect ladder
         op = msg.get("op")
         if op == "ping":
-            try:
-                wire.send_msg(sock, {
-                    "op": "pong", "t": msg.get("t"),
-                    "stall_breaks": RmmSpark.stall_break_count(),
-                    "live_sessions": sum(
-                        1 for s in sessions.values() if not s.done()),
-                    "fired": faultinj.fired_log(),
-                }, send_lock)
-            except OSError:
-                break
+            link.send({
+                "op": "pong", "t": msg.get("t"),
+                "stall_breaks": RmmSpark.stall_break_count(),
+                "live_sessions": sum(
+                    1 for s in sessions.values() if not s.done()),
+                "fence_epoch": args.epoch,
+                "reconnects": link.reconnects,
+                "fired": faultinj.fired_log(),
+            })
         elif op == "submit":
             try:
                 recv_probe()  # chaos: crash before the session exists
             except BaseException as e:
-                wire.send_msg(sock, {
+                link.send({
                     "op": "result", "sid": int(msg["sid"]), "ok": False,
                     "status": "failed", "error": type(e).__name__,
-                    "message": str(e)}, send_lock)
+                    "message": str(e)}, queue_on_fail=True)
                 continue
             handle_submit(msg)
         elif op == "cancel":
@@ -385,15 +598,16 @@ def main(argv=None) -> int:
         spill_dir) else []
     spill_mod.shutdown()
     RmmSpark.clear_event_handler()
-    try:
-        wire.send_msg(sock, {
-            "op": "bye", "clean": bool(clean), "residue": residue,
-            "store_len": store_len, "leftovers": leftovers,
-            "fired": faultinj.fired_log(),
-        }, send_lock)
-    except OSError:
-        pass
-    sock.close()
+    link.send({
+        "op": "bye", "clean": bool(clean), "residue": residue,
+        "store_len": store_len, "leftovers": leftovers,
+        "fired": faultinj.fired_log(),
+    })
+    link.close()
+    if partitioned:
+        return 3  # self-fenced: the sentinel tells the supervisor why
+    if revoked_out:
+        return 4  # fenced by the supervisor: our gen is already revoked
     return 0 if clean else 1
 
 

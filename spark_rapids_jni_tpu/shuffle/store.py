@@ -300,10 +300,15 @@ class ShuffleStore:
         return st["floor"]
 
     def revoke(self, epoch: int) -> None:
-        """Fence exactly one generation: the supervisor revokes a
-        worker's epoch the moment it declares the worker lost, so a
-        zombie process that outlives its SIGKILL verdict can finish
-        writing tmp entries but can never commit them."""
+        """Fence exactly one generation.  Two callers, same contract:
+        the supervisor revokes a worker's epoch the moment it declares
+        the worker lost, so a zombie process that outlives its SIGKILL
+        verdict can finish writing tmp entries but can never commit
+        them; and a partitioned worker revokes its OWN epoch when the
+        supervisor has been unreachable past ``serve_partition_grace_ms``
+        (serve/worker.py self-fence) — whichever side of a network
+        partition acts first, commits from the cut-off generation are
+        rejected at the rename, so split-brain can never zombie-commit."""
         st = self._fence_state()
         if int(epoch) in st["revoked"]:
             return

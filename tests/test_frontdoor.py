@@ -310,12 +310,112 @@ class TestStorePlane:
             shutil.rmtree(fd.fleet_dir, ignore_errors=True)
 
 
+class TestMultiHostTransport:
+    def test_tcp_two_host_fleet_round_trip(self):
+        """Two workers placed round-robin on two named hosts over TCP:
+        the fleet behaves exactly like the single-box Unix default."""
+        fd = FrontDoor(workers=2, heartbeat_ms=80.0, transport="tcp",
+                       hosts="hostA,hostB")
+        try:
+            sessions = [fd.submit("echo", {"value": f"v{i}"},
+                                  tenant=f"t{i % 2}") for i in range(4)]
+            assert [s.result(timeout=60) for s in sessions] == \
+                [f"v{i}" for i in range(4)]
+        finally:
+            report = fd.shutdown()
+        assert report["clean"], report
+        assert report["transport"] == "tcp"
+        assert report["hosts"] == ["hostA", "hostB"]
+        hosts = {e["host"] for e in report["workers"].values()}
+        assert hosts == {"hostA", "hostB"}  # both hosts got a slot
+        assert report["fleet"]["self_fenced_workers"] == 0
+
+    def test_multi_host_list_forces_tcp(self):
+        """>1 host cannot ride a Unix socket; the front door promotes
+        the transport instead of silently colocating everything."""
+        fd = FrontDoor(workers=1, heartbeat_ms=80.0,
+                       hosts=["h0", "h1"])
+        try:
+            assert fd._transport == "tcp"
+            assert fd.submit("echo", {"value": "m"}).result(timeout=60) \
+                == "m"
+        finally:
+            assert fd.shutdown()["clean"]
+
+    def test_reconnect_reattaches_without_session_loss(self):
+        """The connection-supervision contract: an injected link drop on
+        the supervisor's send is NOT a worker loss.  The worker re-dials,
+        the idempotent hello re-attaches the same incarnation, the
+        in-flight session completes exactly once — zero replacements,
+        zero crashes, one reconnect."""
+        faultinj.configure({"faults": [
+            {"match": "net_send_sup", "fault": "net_drop", "count": 1}]})
+        # generous grace: on a starved box a slow re-dial must stay a
+        # reconnect, not cross into the partition/self-fence path
+        fd = FrontDoor(workers=1, heartbeat_ms=80.0,
+                       partition_grace_ms=8000.0)
+        try:
+            s = fd.submit("sleep", {"seconds": 1.0}, tenant="t0",
+                          replayable=True)
+            assert s.result(timeout=90) == "slept"
+            assert s.replacements == 0  # link loss != worker loss
+        finally:
+            report = fd.shutdown()
+        assert report["clean"], report
+        assert report["fleet"]["reconnects"] >= 1
+        assert report["fleet"]["crashes"] == 0
+        assert report["fleet"]["respawns"] == 0
+        assert report["fleet"]["partitions_detected"] == 0
+        fired = faultinj.fired_log()
+        assert any(e.get("fault") == "net_drop" for e in fired)
+
+    def test_partitioned_worker_self_fences_and_is_quarantined(self):
+        """Split-brain: a worker that cannot reach the supervisor past
+        the partition grace revokes its OWN store epoch (self-fence),
+        writes the sentinel, and exits; the supervisor counts it and
+        re-places the session.  Post-revocation commits from that
+        generation are rejected at the rename — zero zombie shards."""
+        import jax.numpy as jnp
+
+        from spark_rapids_jni_tpu.shuffle.store import ShuffleStore
+
+        # skip=2 spares hello+first pong; count=4 = 1 live send + 3
+        # ladder hellos, so the rule is fully consumed by the first
+        # incarnation and the respawn inherits a quiet network
+        faultinj.configure({"faults": [
+            {"match": "net_send_wk", "fault": "net_drop",
+             "skip": 2, "count": 4}]})
+        fd = FrontDoor(workers=1, heartbeat_ms=80.0,
+                       partition_grace_ms=700.0, reconnect_max=3)
+        try:
+            s = fd.submit("sleep", {"seconds": 2.0}, tenant="t0",
+                          replayable=True)
+            assert s.result(timeout=120) == "slept"
+            assert s.replacements >= 1
+            revoked = fd._store.revoked()
+            assert 1 in revoked  # the fenced generation's epoch
+            zombie = ShuffleStore(fd.store_dir, epoch=1)
+            assert not zombie.put("zp", "map", {"x": jnp.arange(4)})
+            reader = ShuffleStore(fd.store_dir)
+            assert not reader.has_committed("zp", "map")
+        finally:
+            report = fd.shutdown()
+        assert report["clean"], report
+        assert report["fleet"]["self_fenced_workers"] >= 1
+        assert report["fleet"]["partitions_detected"] >= 1
+        assert report["self_fenced"], report
+        entry = report["self_fenced"][0]
+        assert entry["worker_id"] == 0 and entry["epoch"] == 1
+        assert entry["fenced_commits"] == 0  # nothing slipped through
+
+
 class TestFleetMetrics:
     def test_zeros_safe_surface(self):
         snap = fleet_metrics()
         for field in ("workers_spawned", "crashes", "stalls", "sheds",
                       "respawns", "worker_lost", "circuit_open",
-                      "replacements"):
+                      "replacements", "reconnects", "partitions_detected",
+                      "self_fenced_workers"):
             assert field in snap and snap[field] >= 0
         from spark_rapids_jni_tpu.mem.rmm_spark import RmmSpark
         assert RmmSpark.fleet_metrics() == fleet_metrics()

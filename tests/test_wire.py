@@ -1,14 +1,24 @@
-"""Wire protocol: framing, the 16MB frame cap, and the mid-frame
-timeout desync guard (serve/wire.py)."""
+"""Wire protocol: framing + CRC32 trailers, the 16MB frame cap, the
+mid-frame timeout desync guard, frame deadlines, and Unix/TCP transport
+parity (serve/wire.py)."""
 
 import socket
 import struct
 import threading
 import time
+import zlib
 
 import pytest
 
+from spark_rapids_jni_tpu import faultinj
 from spark_rapids_jni_tpu.serve import wire
+
+
+def _raw_frame(payload: bytes) -> bytes:
+    """Hand-build a frame the way the wire does: length prefix, payload,
+    CRC32 trailer."""
+    return (struct.pack("<I", len(payload)) + payload
+            + struct.pack("<I", zlib.crc32(payload)))
 
 
 @pytest.fixture
@@ -17,6 +27,26 @@ def pair():
     yield a, b
     a.close()
     b.close()
+
+
+@pytest.fixture(params=["unix", "tcp"])
+def tpair(request):
+    """A connected (supervisor, worker) Transport pair over each kind —
+    every framing property must hold identically on both."""
+    kind = request.param
+    if kind == "unix":
+        sa, sb = socket.socketpair(socket.AF_UNIX, socket.SOCK_STREAM)
+        sup = wire.wrap(sa, "unix", role="sup")
+        wk = wire.wrap(sb, "unix", role="wk")
+    else:
+        lst, addr = wire.listen("tcp", "127.0.0.1:0")
+        wk = wire.connect("tcp", addr, role="wk")
+        conn, _ = lst.accept()
+        sup = wire.wrap(conn, "tcp", role="sup")
+        lst.close()
+    yield sup, wk
+    sup.close()
+    wk.close()
 
 
 class TestFraming:
@@ -41,6 +71,31 @@ class TestFraming:
         a.close()
         with pytest.raises(wire.WireError):
             wire.recv_msg(b)
+
+
+class TestCrcTrailer:
+    def test_corrupted_payload_rejected(self, pair):
+        a, b = pair
+        payload = b'{"op":"pong","t":1}'
+        frame = bytearray(_raw_frame(payload))
+        frame[6] ^= 0x40  # flip one payload bit; trailer now disagrees
+        a.sendall(bytes(frame))
+        with pytest.raises(wire.WireDesync, match="CRC"):
+            wire.recv_msg(b)
+
+    def test_corrupted_trailer_rejected(self, pair):
+        a, b = pair
+        payload = b'{"op":"pong","t":1}'
+        a.sendall(struct.pack("<I", len(payload)) + payload
+                  + struct.pack("<I", zlib.crc32(payload) ^ 1))
+        with pytest.raises(wire.WireDesync, match="CRC"):
+            wire.recv_msg(b)
+
+    def test_desync_is_a_wire_error(self):
+        # callers that catch WireError for "link is dead" must also see
+        # desyncs — both end the connection
+        assert issubclass(wire.WireDesync, wire.WireError)
+        assert issubclass(wire.WireError, ConnectionError)
 
 
 class TestFrameCap:
@@ -73,12 +128,12 @@ class TestMidFrameTimeout:
         (the next recv would parse payload bytes as a header)."""
         a, b = pair
         b.settimeout(0.05)
-        payload = b'{"op":"pong","t":9}'
+        frame = _raw_frame(b'{"op":"pong","t":9}')
 
         def slow_send():
-            a.sendall(struct.pack("<I", len(payload)) + payload[:5])
+            a.sendall(frame[:9])
             time.sleep(0.25)  # several poll ticks mid-frame
-            a.sendall(payload[5:])
+            a.sendall(frame[9:])
 
         t = threading.Thread(target=slow_send)
         t.start()
@@ -98,3 +153,137 @@ class TestMidFrameTimeout:
         # loop can keep ticking (checking the wedge flag, etc.)
         with pytest.raises(socket.timeout):
             wire.recv_msg(b)
+
+    def test_mid_frame_stall_past_deadline_is_desync(self, pair):
+        """Patience ends: a frame still incomplete after ``deadline_s``
+        can never be re-synchronized — the recv must say so instead of
+        spinning forever on a wedged peer."""
+        a, b = pair
+        b.settimeout(0.05)
+        a.sendall(struct.pack("<I", 64) + b"y" * 8)  # then silence
+        t0 = time.monotonic()
+        with pytest.raises(wire.WireDesync, match="incomplete"):
+            wire.recv_msg(b, deadline_s=0.3)
+        assert time.monotonic() - t0 < 3.0  # bounded, not FRAME_DEADLINE_S
+
+
+class TestTransportParity:
+    """Every framing property must hold identically over Unix-domain
+    sockets and TCP — the multi-host fleet gets the same guarantees as
+    the single-box default."""
+
+    def test_round_trip_and_hello(self, tpair):
+        sup, wk = tpair
+        wk.hello(3, 1234, fence_epoch=7, resume_token="3-7-ab")
+        sup.settimeout(2.0)
+        h = sup.recv()
+        assert h == {"op": "hello", "worker_id": 3, "pid": 1234,
+                     "fence_epoch": 7, "resume_token": "3-7-ab"}
+        sup.send({"op": "ping", "t": 0.5})
+        wk.settimeout(2.0)
+        assert wk.recv() == {"op": "ping", "t": 0.5}
+
+    def test_frame_cap_enforced(self, tpair):
+        sup, _wk = tpair
+        with pytest.raises(wire.WireError, match="exceeds"):
+            sup.send({"v": "x" * (wire.MAX_FRAME + 1)})
+
+    def test_crc_trailer_reject(self, tpair):
+        sup, wk = tpair
+        payload = b'{"op":"pong","t":2}'
+        frame = bytearray(_raw_frame(payload))
+        frame[-1] ^= 0xFF  # corrupt the trailer on the wire
+        wk.sock.sendall(bytes(frame))
+        sup.settimeout(2.0)
+        with pytest.raises(wire.WireDesync, match="CRC"):
+            sup.recv()
+        assert sup.closed  # desync closes the link
+
+    def test_torn_frame_detected(self, tpair):
+        sup, wk = tpair
+        frame = _raw_frame(b'{"op":"result","sid":"s1"}')
+        wk.sock.sendall(frame[: len(frame) // 2])
+        wk.sock.close()
+        sup.settimeout(0.05)
+        with pytest.raises(wire.WireError, match="mid-frame"):
+            sup.recv()
+        assert sup.closed
+
+    def test_deadline_expiry_mid_frame(self, tpair):
+        sup, wk = tpair
+        sup.frame_deadline_s = 0.3
+        sup.settimeout(0.05)
+        wk.sock.sendall(struct.pack("<I", 128) + b"z" * 16)  # stalls here
+        with pytest.raises(wire.WireDesync, match="incomplete"):
+            sup.recv()
+        assert sup.closed
+
+    def test_boundary_timeout_keeps_link_open(self, tpair):
+        sup, _wk = tpair
+        sup.settimeout(0.05)
+        with pytest.raises(socket.timeout):
+            sup.recv()
+        assert not sup.closed  # idle tick, not damage
+
+
+class TestInjectedNetworkFaults:
+    """The faultinj net kinds convert into real wire damage at the
+    transport probes — one per kind, on the side chaos targets."""
+
+    def test_net_drop_on_send_kills_link(self, tpair):
+        sup, _wk = tpair
+        cfg = {"faults": [{"match": "net_send_sup", "fault": "net_drop",
+                           "count": 1}]}
+        with faultinj.scope(cfg):
+            with pytest.raises(wire.WireError, match="drop"):
+                sup.send({"op": "ping", "t": 1.0})
+        assert sup.closed
+
+    def test_net_torn_on_send_detected_by_peer(self, tpair):
+        sup, wk = tpair
+        wk.frame_deadline_s = 0.3
+        wk.settimeout(0.05)
+        cfg = {"faults": [{"match": "net_send_sup", "fault": "net_torn",
+                           "count": 1}]}
+        with faultinj.scope(cfg):
+            with pytest.raises(wire.WireError, match="torn"):
+                sup.send({"op": "submit", "sid": "s1", "kind": "echo"})
+        # the half-frame made it onto the wire; the peer's desync
+        # machinery — not trust — rejects it
+        with pytest.raises(wire.WireError):
+            wk.recv()
+        assert wk.closed
+
+    def test_net_stall_on_recv_is_bounded(self, tpair):
+        sup, wk = tpair
+        wk.stall_s = 0.1
+        sup.send({"op": "ping", "t": 2.0})
+        wk.settimeout(2.0)
+        cfg = {"faults": [{"match": "net_recv_wk", "fault": "net_stall",
+                           "count": 1}]}
+        t0 = time.monotonic()
+        with faultinj.scope(cfg):
+            with pytest.raises(wire.WireError, match="stall"):
+                wk.recv()
+        assert 0.1 <= time.monotonic() - t0 < 2.0
+        assert wk.closed
+
+    def test_kinds_are_registered(self):
+        for kind in ("net_drop", "net_stall", "net_torn"):
+            assert kind in faultinj.FAULT_KINDS
+
+
+class TestListenConnect:
+    def test_tcp_port_zero_reports_bound_port(self):
+        lst, addr = wire.listen("tcp", "127.0.0.1:0")
+        try:
+            host, _, port = addr.rpartition(":")
+            assert host == "127.0.0.1" and int(port) > 0
+        finally:
+            lst.close()
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown transport"):
+            wire.listen("carrier-pigeon", "/nowhere")
+        with pytest.raises(ValueError, match="unknown transport"):
+            wire.wrap(None, "quic", role="sup")
