@@ -747,6 +747,68 @@ def serve_main():
               file=sys.stderr, flush=True)
         return 1
 
+    # data-plane sub-wave: columnar RESULT batches (the ``arrow_batch``
+    # kind) instead of scalar digests.  The payload crosses the worker
+    # boundary as one Arrow IPC stream on the zero-copy data plane —
+    # memfd + SCM_RIGHTS on the unix fleet, binary chunk frames on tcp —
+    # while only a small JSON descriptor rides the control wire.  The
+    # solo arm builds the SAME batches in-process; both fleet arms must
+    # produce byte-identical ``batch_digest`` values (NaN payloads,
+    # -0.0, dictionary codes and RLE runs all survive the hop), and the
+    # note's serve_wire fields ride the ci/q95_floor.json
+    # serve_wire_floor ratchet: the descriptor JSON must stay >=10x
+    # smaller than the payload bytes it keeps off the JSON wire.
+    from spark_rapids_jni_tpu.serve import data_plane as dp_mod
+    from spark_rapids_jni_tpu.serve.worker import make_result_batch
+    dp_rows = int(os.environ.get("BENCH_SERVE_DP_ROWS", str(1 << 12)))
+    n_dp = max(4, n_queries)
+    dp_solo = {k: dp_mod.batch_digest(make_result_batch(dp_rows, k))
+               for k in range(n_dp)}
+
+    def dp_wave(transport, plane, hosts=None):
+        door = FrontDoor(workers=2, pool_bytes=pool,
+                         host_pool_bytes=host_pool, max_concurrent=n_dp,
+                         transport=transport, hosts=hosts,
+                         data_plane_mode=plane)
+        t0 = time.perf_counter()
+        lat = []
+        try:
+            sess = [(time.perf_counter(),
+                     door.submit("arrow_batch",
+                                 {"rows": dp_rows, "seed": k},
+                                 tenant=f"dp-{k}"))
+                    for k in range(n_dp)]
+            digs = {}
+            for k, (ts, s) in enumerate(sess):
+                digs[k] = dp_mod.batch_digest(s.result(timeout=300.0))
+                lat.append((time.perf_counter() - ts) * 1e3)
+        finally:
+            rep = door.shutdown()
+        return digs, lat, rep, time.perf_counter() - t0
+    try:
+        shm_digs, shm_lat, shm_rep, shm_wall = dp_wave("unix", "shm")
+        frm_digs, frm_lat, frm_rep, frm_wall = dp_wave(
+            "tcp", "frames", hosts="hostA,hostB")
+    except Exception as e:
+        print(f"# serve data-plane wave failed: {e!r}", file=sys.stderr,
+              flush=True)
+        return 1
+    for tag, digs in (("shm", shm_digs), ("frames", frm_digs)):
+        dp_drift = [k for k in dp_solo if digs.get(k) != dp_solo[k]]
+        if dp_drift:
+            print(f"# serve scenario: {tag} data-plane batches DIFFER "
+                  f"from solo for {sorted(dp_drift)}",
+                  file=sys.stderr, flush=True)
+            return 1
+    dpi = shm_rep["data_plane"]
+    dpf = frm_rep["data_plane"]
+    if (dpi["plane"] != "shm" or dpf["plane"] != "frames"
+            or dpi["batches"] < n_dp or dpf["batches"] < n_dp
+            or dpi["errors"] or dpf["errors"]):
+        print(f"# serve scenario: data plane did not carry the batches: "
+              f"shm={dpi} frames={dpf}", file=sys.stderr, flush=True)
+        return 1
+
     # recovery sub-wave: the durable shuffle plane.  Wave A runs
     # ``shuffle_digest`` queries under FRESH store keys, so every map
     # shard executes and commits to the fleet-shared ShuffleStore
@@ -824,6 +886,21 @@ def serve_main():
             "tcp_workers": tcp_workers,
             "tcp_bit_identical": True,
             "tcp_wall_s": round(tcp_wall, 3),
+            "serve_wire": {
+                "plane": dpi["plane"],
+                "batches": int(dpi["batches"]),
+                "shm_bytes": int(dpi["payload_bytes"]),
+                "json_bytes": int(dpi["json_bytes"]),
+                "reduction": round(
+                    dpi["payload_bytes"] / max(1, dpi["json_bytes"]), 1),
+                "frames_reduction": round(
+                    dpf["payload_bytes"] / max(1, dpf["json_bytes"]), 1),
+                "bit_identical": True,
+                "p50_ms": round(_pct(shm_lat, 0.5), 2),
+                "p99_ms": round(_pct(shm_lat, 0.99), 2),
+                "shm_wall_s": round(shm_wall, 3),
+                "frames_wall_s": round(frm_wall, 3),
+            },
             "adopted_shards": adopted_shards,
             "replayed_shards": replayed_shards,
             "recovery_ms": round(recovery_ms, 2),

@@ -9,7 +9,11 @@
 # zombie generation fence-rejected; multihost = a two-host TCP fleet:
 # net_drop/net_stall/net_torn landed at the transport probes on both
 # sides must resolve via reconnect+reattach, and a partitioned worker
-# must self-fence with zero zombie-committed shards).
+# must self-fence with zero zombie-committed shards; dataplane = the
+# zero-copy columnar result path: Arrow IPC segments torn after their
+# CRC stamps, announced under a dead fence generation, or orphaned by a
+# worker crashed with a segment in flight must be detected by the
+# supervisor's epoch-then-CRC verify and re-placed bit-identically).
 #
 # Runs tools/chaos.py — every faultinj.FAULT_KINDS entry fired at every
 # instrumented boundary (one fault per trial, exhaustively) plus seeded
@@ -38,7 +42,7 @@ python - /tmp/chaos_report.json <<'EOF'
 import json, sys
 doc = json.load(open(sys.argv[1]))
 for scenario in ("sort", "streaming_scan", "jni", "serving", "frontdoor",
-                 "store_recovery", "multihost"):
+                 "store_recovery", "multihost", "dataplane"):
     trials = [t for t in doc["trials"]
               if t["label"].startswith(scenario + ":")]
     assert trials, f"chaos report has no {scenario!r} trials"

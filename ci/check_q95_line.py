@@ -56,6 +56,13 @@ evidence: ``adopted_shards >= 1`` and ``replayed_shards >= 1`` with
 committed map outputs instead of re-running them, bit-identically), and
 ``recovery_vs`` — the replay-wall / adopt-wall ratio — must not shrink
 below ``serve_recovery_floor``.
+Since r13 the note also carries the zero-copy data-plane evidence in
+``serve_wire``: columnar result batches must have crossed the worker
+boundary as Arrow IPC payloads (``plane`` shm on the unix fleet, with a
+``frames`` arm over tcp), bit-identically to the solo in-process
+batches, and the payload-bytes / descriptor-JSON-bytes ``reduction``
+(both arms) must not shrink below ``serve_wire_floor`` — the proof that
+result payloads stay OFF the JSON control wire.
 """
 import json
 import os
@@ -90,6 +97,7 @@ def main(paths) -> int:
     scan_floor = floors["scan_vs_baseline_floor"]
     serve_floor = floors["serve_p99_floor"]
     recovery_floor = floors["serve_recovery_floor"]
+    wire_floor = floors["serve_wire_floor"]
     lines = _scan(paths)
     line = lines.get("q95_shape_throughput")
     enc_line = lines.get("q95_shape_encoded_throughput")
@@ -220,6 +228,26 @@ def main(paths) -> int:
                         f"{serve_note.get('recovery_vs')} (replay wall / "
                         f"adopt wall) regressed below the recorded floor "
                         f"{recovery_floor} (ci/q95_floor.json)")
+        else:
+            sw = serve_note.get("serve_wire")
+            if (not isinstance(sw, dict)
+                    or sw.get("bit_identical") is not True):
+                errs.append("serve line's note.serve_wire missing or not "
+                            "bit-identical: the zero-copy data-plane wave "
+                            "fell out of the smoke (bench.py serve_main) "
+                            f"(note={json.dumps(serve_note)})")
+            elif sw.get("plane") != "shm" or int(sw.get("batches", 0)) < 1:
+                errs.append("serve_wire did not carry batches over shm on "
+                            "the unix fleet: result payloads are back on "
+                            f"the JSON wire (serve_wire={json.dumps(sw)})")
+            elif min(float(sw.get("reduction", 0.0)),
+                     float(sw.get("frames_reduction", 0.0))) < wire_floor:
+                errs.append(f"serve_wire payload/descriptor reduction "
+                            f"{sw.get('reduction')} (shm) / "
+                            f"{sw.get('frames_reduction')} (frames) fell "
+                            f"below the recorded floor {wire_floor} "
+                            f"(ci/q95_floor.json): payload bytes are "
+                            f"leaking back onto the JSON control wire")
         serve_vs = serve_line.get("vs_baseline", 0.0)
         if serve_vs < serve_floor:
             errs.append(f"serve vs_baseline {serve_vs} (solo p99 / "
@@ -234,6 +262,7 @@ def main(paths) -> int:
           f"IR {ir_vs} >= floor {ir_floor}; q9 row present; "
           f"scan {scan_vs} >= floor {scan_floor}; "
           f"serve {serve_vs} >= floor {serve_floor}; "
+          f"wire reduction >= floor {wire_floor}; "
           f"engines {json.dumps((note or {}).get('engines'))})")
     if vs >= 2 * floor and floor > 0:
         print(f"check_q95_line: note — vs_baseline is >=2x the floor; "

@@ -64,8 +64,12 @@ def pack_bitmask(valid: np.ndarray) -> bytes:
 
 
 def _string_array_to_column(arr: pa.Array, pad_to_multiple: int = 8) -> StringColumn:
+    # binary shares the string buffer layout (offsets + data); the data
+    # plane ships string payloads as binary so arbitrary bytes round-trip
     if pa.types.is_large_string(arr.type):
         arr = arr.cast(pa.string())
+    elif pa.types.is_large_binary(arr.type):
+        arr = arr.cast(pa.binary())
     n = len(arr)
     buffers = arr.buffers()
     valid = unpack_bitmask(buffers[0], arr.offset, n)
@@ -273,3 +277,212 @@ def to_arrow(batch: ColumnBatch) -> pa.Table:
     return pa.table(
         {name: _column_to_array(batch[name]) for name in batch.names}
     )
+
+
+# ---------------------------------------------------------------------------
+# Data-plane IPC codec: ColumnBatch <-> Arrow IPC stream bytes.
+#
+# The serving data plane (serve/data_plane.py) ships result batches as a
+# single Arrow IPC stream through shared memory or binary wire frames.
+# Unlike ``to_arrow``/``from_arrow`` this codec must be BIT-EXACT under a
+# round trip — the MP/TCP bench digests are compared against solo — so it
+# never leans on Arrow-level nulls for the primary buffers:
+#
+#   * every column ships all-valid, with a companion ``<name>;v`` bool
+#     field carrying the row validity (Arrow null slots have unspecified
+#     data bytes; a companion field keeps borrowed null-row codes and
+#     NaN/-0.0 payloads untouched),
+#   * DictionaryColumn crosses as a pa.DictionaryArray — u32 codes cast
+#     to int32 indices plus the dictionary values, never materialized
+#     (string dictionaries go as binary so exact bytes survive),
+#   * RunLengthColumn crosses as a pa.RunEndEncodedArray (run ends =
+#     cumsum of run lengths), runs never expanded,
+#   * anything else (lists, structs) falls back to the materialized
+#     ``to_arrow`` representation with Arrow nulls.
+#
+# Field-level metadata (``sptpu.enc``) records which branch each field
+# took; the schema fingerprint covers it, so a descriptor/schema mismatch
+# is detected before any buffer is interpreted.
+
+_ENC_META = b"sptpu.enc"
+_VKIND_META = b"sptpu.vkind"
+_VALIDITY_SUFFIX = ";v"
+
+
+def schema_fingerprint(schema: pa.Schema) -> str:
+    """Stable hex fingerprint of an IPC schema (fields + metadata)."""
+    import hashlib
+
+    return hashlib.sha256(schema.serialize().to_pybytes()).hexdigest()[:16]
+
+
+def _np(x) -> np.ndarray:
+    return np.asarray(jax.device_get(x))
+
+
+def _plain_values_array(data: np.ndarray, dtype: T.SparkType) -> pa.Array:
+    """All-valid fixed-width values -> typed Arrow array (no mask)."""
+    if dtype.kind is T.Kind.DATE:
+        return pa.array(data, type=pa.date32())
+    if dtype.kind is T.Kind.TIMESTAMP:
+        return pa.array(data, type=pa.timestamp("us", tz=dtype.tz or None))
+    return pa.array(data)
+
+
+def _values_array_to_column(arr: pa.Array, vkind: str):
+    """Inverse of the dictionary/RLE values export (all-valid arrays)."""
+    if vkind == "string":
+        return _string_array_to_column(arr)
+    return array_to_column(arr)  # plain numeric / decimal128
+
+
+def _export_column(name: str, col):
+    """One column -> [(pa.field, pa.Array), ...] (main + companion)."""
+    from .column import ListColumn, StructColumn
+    from .encoded import DictionaryColumn, RunLengthColumn
+
+    def companion(valid: np.ndarray):
+        f = pa.field(f"{name}{_VALIDITY_SUFFIX}", pa.bool_(),
+                     metadata={_ENC_META: b"validity"})
+        return f, pa.array(valid.astype(np.bool_))
+
+    if isinstance(col, DictionaryColumn):
+        valid = _np(col.validity)
+        codes = _np(col.codes).astype(np.int32)
+        d = col.dictionary
+        if isinstance(d, StringColumn):
+            chars, lens = _np(d.chars), _np(d.lengths)
+            values = pa.array(
+                [bytes(chars[i, : lens[i]]) for i in range(len(lens))],
+                type=pa.binary())
+            vkind = "string"
+        elif isinstance(d, Decimal128Column):
+            values = _column_to_array(d)
+            vkind = "decimal"
+        else:
+            values = _plain_values_array(_np(d.data), d.dtype)
+            vkind = "plain"
+        arr = pa.DictionaryArray.from_arrays(
+            pa.array(codes, type=pa.int32()), values)
+        f = pa.field(name, arr.type, metadata={
+            _ENC_META: b"dict", _VKIND_META: vkind.encode()})
+        return [(f, arr), companion(valid)]
+    if isinstance(col, RunLengthColumn):
+        valid = _np(col.validity)
+        lengths = _np(col.run_lengths).astype(np.int64)
+        if lengths.size == 0 and valid.size:
+            # unrepresentable as REE (n rows, zero runs) — ship decoded
+            return _export_column(name, col.decode())
+        run_ends = np.cumsum(lengths)
+        values = _plain_values_array(_np(col.run_values), col.dtype)
+        arr = pa.RunEndEncodedArray.from_arrays(
+            pa.array(run_ends, type=pa.int64()), values)
+        f = pa.field(name, arr.type, metadata={_ENC_META: b"rle"})
+        return [(f, arr), companion(valid)]
+    if isinstance(col, StringColumn):
+        valid = _np(col.validity)
+        chars, lens = _np(col.chars), _np(col.lengths)
+        arr = pa.array(
+            [bytes(chars[i, : lens[i]]) for i in range(len(lens))],
+            type=pa.binary())
+        f = pa.field(name, arr.type, metadata={_ENC_META: b"string"})
+        return [(f, arr), companion(valid)]
+    if isinstance(col, Decimal128Column):
+        valid = _np(col.validity)
+        # null-row limb bytes are unspecified; ship 0 there (the
+        # companion validity restores the null flags bit-exactly)
+        vals = [v if v is not None else 0 for v in col.to_unscaled_pylist()]
+        import decimal as _d
+
+        ctx = _d.Context(prec=40)
+        arr = pa.array(
+            [_d.Decimal(v).scaleb(-col.scale, ctx) for v in vals],
+            type=pa.decimal128(col.precision, col.scale))
+        f = pa.field(name, arr.type, metadata={_ENC_META: b"decimal"})
+        return [(f, arr), companion(valid)]
+    if isinstance(col, Column):
+        valid = _np(col.validity)
+        arr = _plain_values_array(_np(col.data), col.dtype)
+        f = pa.field(name, arr.type, metadata={_ENC_META: b"plain"})
+        return [(f, arr), companion(valid)]
+    if isinstance(col, (ListColumn, StructColumn)):
+        arr = _column_to_array(col)  # Arrow nulls; no companion
+        f = pa.field(name, arr.type, metadata={_ENC_META: b"arrow"})
+        return [(f, arr)]
+    raise TypeError(f"cannot export {type(col).__name__} on the data plane")
+
+
+def batch_to_ipc(batch: ColumnBatch):
+    """ColumnBatch -> (pa.Buffer of one IPC stream, schema fingerprint).
+
+    Encoded columns cross as codes + dictionary / runs — never
+    materialized.  The buffer satisfies the buffer protocol (zero-copy
+    into memfd writes / CRC scans)."""
+    fields, arrays = [], []
+    for name in batch.names:
+        if name.endswith(_VALIDITY_SUFFIX):
+            raise ValueError(
+                f"column name {name!r} collides with the data plane's "
+                f"validity-companion suffix {_VALIDITY_SUFFIX!r}")
+        for f, a in _export_column(name, batch[name]):
+            fields.append(f)
+            arrays.append(a)
+    table = pa.Table.from_arrays(arrays, schema=pa.schema(fields))
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, table.schema) as writer:
+        writer.write_table(table)
+    buf = sink.getvalue()
+    return buf, schema_fingerprint(table.schema)
+
+
+def ipc_to_batch(buf, expect_fingerprint: Optional[str] = None) -> ColumnBatch:
+    """One IPC stream (bytes-like) -> ColumnBatch, bit-exact inverse of
+    ``batch_to_ipc``.  ``expect_fingerprint`` cross-checks the embedded
+    schema against the wire descriptor before buffers are interpreted."""
+    from .encoded import RunLengthColumn, dictionary_from_arrays
+
+    with pa.ipc.open_stream(pa.py_buffer(buf)) as reader:
+        table = reader.read_all()
+    schema = table.schema
+    if (expect_fingerprint is not None
+            and schema_fingerprint(schema) != expect_fingerprint):
+        raise ValueError(
+            f"IPC schema fingerprint {schema_fingerprint(schema)} does not "
+            f"match descriptor {expect_fingerprint}")
+    arrays = {}
+    for i, f in enumerate(schema):
+        chunked = table.column(i)
+        arrays[f.name] = (f, chunked.chunk(0) if chunked.num_chunks == 1
+                          else chunked.combine_chunks())
+    out = {}
+    for name, (f, arr) in arrays.items():
+        meta = f.metadata or {}
+        enc = (meta.get(_ENC_META) or b"arrow").decode()
+        if enc == "validity":
+            continue
+        comp = arrays.get(f"{name}{_VALIDITY_SUFFIX}")
+        valid = (jnp.asarray(np.asarray(comp[1]).astype(np.bool_))
+                 if comp is not None else None)
+        if enc == "dict":
+            vkind = (meta.get(_VKIND_META) or b"plain").decode()
+            codes = np.asarray(arr.indices).astype(np.uint32)
+            values = _values_array_to_column(arr.dictionary, vkind)
+            out[name] = dictionary_from_arrays(codes, valid, values)
+        elif enc == "rle":
+            run_ends = np.asarray(arr.run_ends).astype(np.int64)
+            lengths = np.diff(np.concatenate([[0], run_ends])).astype(np.int32)
+            vals = array_to_column(arr.values)
+            out[name] = RunLengthColumn(
+                vals.data, jnp.asarray(lengths), valid, vals.dtype)
+        elif enc == "string":
+            s = _string_array_to_column(arr)
+            out[name] = StringColumn(s.chars, s.lengths, valid)
+        elif enc == "decimal":
+            d = _decimal_array_to_column(arr)
+            out[name] = Decimal128Column(d.limbs, valid, d.dtype)
+        elif enc == "plain":
+            c = array_to_column(arr)
+            out[name] = Column(c.data, valid, c.dtype)
+        else:  # "arrow" fallback — validity rides Arrow nulls
+            out[name] = array_to_column(arr)
+    return ColumnBatch(out)

@@ -319,6 +319,23 @@ _register("shuffle_store_max_attempts", 2, int,
           "(adoption always reads the highest committed attempt, so "
           "extras only buy corruption fallback depth).  0 or negative "
           "keeps everything.")
+_register("serve_data_plane", "auto", str,
+          "How result BATCHES cross the supervisor<->worker boundary "
+          "(serve/data_plane.py).  Control messages always stay on the "
+          "framed JSON wire; this knob only routes columnar payloads: "
+          "'shm' ships Arrow IPC bytes in a memfd segment passed by fd "
+          "(SCM_RIGHTS, Unix transport only), 'frames' chunks the same "
+          "IPC bytes into binary data frames on the existing socket "
+          "(works over TCP), 'json' inlines a base64 payload in the "
+          "result message (debug fallback; raises DataPlaneOverflow "
+          "above the 16MB control-frame cap), and 'auto' picks shm on "
+          "the unix transport and frames on tcp.")
+_register("serve_segment_bytes", 1 << 20, int,
+          "Chunk granularity of the zero-copy data plane: payloads are "
+          "CRC32-stamped per chunk of this many bytes (torn-segment "
+          "detection resolution) and the frames plane caps each binary "
+          "data frame at this size so control messages interleave "
+          "instead of queueing behind a monolithic payload frame.")
 
 
 def get(key: str):

@@ -346,6 +346,35 @@ def _raise_net_torn(name: str):
     raise NetTornError(f"injected torn frame at {name}")
 
 
+class ShmTornError(OSError):
+    """A data-plane payload tore after its CRC stamp (kind ``"shm_torn"``).
+
+    Raised at the worker's ``data_write_wk`` probe (serve/worker.py);
+    the worker converts it into REAL damage — bytes flipped inside the
+    already-CRC-stamped shared-memory segment (or in-flight chunk on the
+    frames/json planes) — so the supervisor's per-chunk CRC verification
+    must catch the corruption and re-place the session, never decode
+    garbage into a batch."""
+
+
+class ShmStaleError(OSError):
+    """A prior generation's segment name resurfaced (kind ``"shm_stale"``).
+
+    Raised at the worker's ``data_descriptor_wk`` probe; the worker
+    stamps the outgoing descriptor with the PREVIOUS fence epoch's
+    segment name, modelling a crashed incarnation's segment being
+    re-announced.  The supervisor's epoch check (descriptor epoch must
+    equal the worker's current generation) must reject it."""
+
+
+def _raise_shm_torn(name: str):
+    raise ShmTornError(f"injected torn shared-memory payload at {name}")
+
+
+def _raise_shm_stale(name: str):
+    raise ShmStaleError(f"injected stale segment descriptor at {name}")
+
+
 # The registry of injectable fault flavors: kind -> raiser.  graftlint's
 # GL006 keeps this in sync with every use site statically — a kind used
 # in a config dict but missing here would otherwise only fail when its
@@ -370,6 +399,8 @@ FAULT_KINDS = {
     "net_drop": _raise_net_drop,
     "net_stall": _raise_net_stall,
     "net_torn": _raise_net_torn,
+    "shm_torn": _raise_shm_torn,
+    "shm_stale": _raise_shm_stale,
 }
 
 

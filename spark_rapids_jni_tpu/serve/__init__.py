@@ -7,9 +7,17 @@ front door that supervises executor worker processes (crash detection,
 session re-placement, load-shedding degradation, reconnect supervision
 with partition-safe self-fencing), and
 :mod:`~spark_rapids_jni_tpu.serve.wire` for the framed fleet transport
-(Unix + TCP, CRC32 trailers, deadlines, network fault domains).
+(Unix + TCP, CRC32 trailers, deadlines, network fault domains), and
+:mod:`~spark_rapids_jni_tpu.serve.data_plane` for the zero-copy
+columnar data plane (Arrow IPC result batches over memfd + SCM_RIGHTS
+or binary chunk frames, epoch- and CRC-verified).
 """
 
+from .data_plane import (
+    DataPlaneCorruption,
+    DataPlaneOverflow,
+    DataPlaneStale,
+)
 from .frontdoor import (
     AdmissionShed,
     FrontDoor,
@@ -36,6 +44,9 @@ from .wire import (
 __all__ = [
     "AdmissionShed",
     "AdmissionTicket",
+    "DataPlaneCorruption",
+    "DataPlaneOverflow",
+    "DataPlaneStale",
     "FrontDoor",
     "FrontDoorSession",
     "QueryCancelled",

@@ -1,0 +1,285 @@
+"""Zero-copy columnar data plane for the serving fleet.
+
+The framed JSON wire (serve/wire.py) is a fine CONTROL plane —
+hello/ping/submit/cancel are small — but a wall for data: every result
+batch would cross as base64-in-JSON under a 16MB frame cap.  This module
+splits the boundary the way Thallus splits RDMA columnar transport:
+control messages stay on the CRC-trailered JSON wire, result payloads
+cross as one Arrow IPC stream (``columnar/arrow.py`` ``batch_to_ipc`` —
+dictionary codes and RLE runs ship encoded, never materialized) over one
+of three planes:
+
+``shm``
+    The worker writes the IPC bytes into a ``memfd`` segment, seals it,
+    and passes the fd over the Unix socket with SCM_RIGHTS.  The
+    supervisor maps it read-only; payload bytes never touch the JSON
+    serializer or the socket buffer.
+``frames``
+    The same IPC bytes chunked into binary data frames on the existing
+    socket (MSB-flagged length prefix, per-frame CRC) — the TCP /
+    multi-host fallback that still bypasses JSON.
+``json``
+    Debug fallback: base64 payload inlined in the result message.
+    Raises :class:`DataPlaneOverflow` (a ``WireDesync``) when the frame
+    would exceed the control-plane cap — loud, never truncated.
+
+Either way the result message carries a JSON *descriptor* — segment
+name, fence epoch, size, schema fingerprint, per-chunk CRC32s — and the
+supervisor verifies epoch (stale-generation rejection) and every chunk
+CRC (torn-payload rejection) before a single buffer is interpreted.
+
+Segment lifecycle: create (worker memfd, name stamped with the worker's
+fence epoch) -> stamp (chunk CRCs into the descriptor) -> map
+(supervisor, read-only) -> reap (unmapped after decode; stashed fds are
+closed with the transport when a worker is lost, exactly like spill
+dirs).
+"""
+
+from __future__ import annotations
+
+import base64
+import mmap
+import os
+import zlib
+from typing import List, Optional
+
+from .. import config
+from . import wire
+
+MB = 1 << 20
+
+
+class DataPlaneOverflow(wire.WireDesync):
+    """A ``serve_data_plane=json`` payload would exceed the control-plane
+    frame cap — refused loudly instead of truncated silently."""
+
+
+class DataPlaneCorruption(RuntimeError):
+    """A payload chunk failed its descriptor CRC (torn segment/frame)."""
+
+
+class DataPlaneStale(RuntimeError):
+    """A descriptor announced a segment from a dead fence epoch."""
+
+
+PLANES = ("shm", "frames", "json")
+
+
+def resolve_plane(setting: Optional[str] = None,
+                  transport_kind: str = "unix") -> str:
+    """Resolve the ``serve_data_plane`` knob against a transport kind."""
+    setting = setting or config.get("serve_data_plane")
+    if setting == "auto":
+        return "shm" if transport_kind == "unix" else "frames"
+    if setting not in PLANES:
+        raise ValueError(
+            f"serve_data_plane={setting!r}; expected auto|shm|frames|json")
+    if setting == "shm" and transport_kind != "unix":
+        raise ValueError(
+            "serve_data_plane=shm needs SCM_RIGHTS fd-passing; the "
+            f"{transport_kind!r} transport cannot carry fds — use "
+            "'frames' (or 'auto') for multi-host fleets")
+    return setting
+
+
+def segment_name(worker_id: int, epoch: int, seq: int) -> str:
+    """Fence-epoch-stamped segment name: a replacement incarnation can
+    never alias a dead generation's segment."""
+    return f"seg-w{worker_id}-g{epoch}-{seq}"
+
+
+def chunk_crcs(payload, chunk_bytes: int) -> List[int]:
+    """Per-chunk CRC32 stamps over a bytes-like payload."""
+    view = memoryview(payload)
+    return [zlib.crc32(view[off: off + chunk_bytes])
+            for off in range(0, len(view), chunk_bytes)] or [zlib.crc32(b"")]
+
+
+def build_descriptor(plane: str, seg: str, size: int, schema_fp: str,
+                     chunk_bytes: int, crcs: List[int], epoch: int) -> dict:
+    """The JSON side of a data-plane result: everything the supervisor
+    needs to verify and decode the payload, and nothing payload-sized."""
+    return {
+        "v": 1,
+        "plane": plane,
+        "seg": seg,
+        "size": int(size),
+        "offset": 0,
+        "schema_fp": schema_fp,
+        "chunk_bytes": int(chunk_bytes),
+        "crcs": [int(c) for c in crcs],
+        "epoch": int(epoch),
+    }
+
+
+def verify_chunks(payload, desc: dict) -> None:
+    """Re-CRC every chunk against the descriptor stamps.
+
+    Raises :class:`DataPlaneCorruption` naming the first torn chunk —
+    the caller must treat the whole payload as garbage (re-place the
+    session), never decode past a bad stamp."""
+    view = memoryview(payload)
+    if len(view) != int(desc["size"]):
+        raise DataPlaneCorruption(
+            f"segment {desc.get('seg')}: payload is {len(view)} bytes, "
+            f"descriptor says {desc['size']}")
+    got = chunk_crcs(view, int(desc["chunk_bytes"]))
+    want = [int(c) for c in desc["crcs"]]
+    if len(got) != len(want):
+        raise DataPlaneCorruption(
+            f"segment {desc.get('seg')}: {len(got)} chunks vs "
+            f"{len(want)} descriptor stamps")
+    for i, (g, w) in enumerate(zip(got, want)):
+        if g != w:
+            raise DataPlaneCorruption(
+                f"segment {desc.get('seg')}: chunk {i} CRC "
+                f"{g:#010x} != stamped {w:#010x} (torn payload)")
+
+
+def verify_epoch(desc: dict, expect_epoch: int) -> None:
+    """Reject descriptors from any generation but the live one."""
+    got = int(desc.get("epoch", -1))
+    if got != int(expect_epoch):
+        raise DataPlaneStale(
+            f"segment {desc.get('seg')}: descriptor epoch {got} != "
+            f"worker generation {expect_epoch} (stale segment reuse)")
+
+
+# ---- shm plane (memfd + SCM_RIGHTS) ---------------------------------------
+
+def make_segment(name: str, payload) -> int:
+    """Write a payload into a fresh memfd; returns the fd (unsealed —
+    the caller seals via :func:`seal_segment` after its CRC-vs-damage
+    window closes)."""
+    fd = os.memfd_create(name, os.MFD_CLOEXEC)
+    view = memoryview(payload)
+    os.truncate(fd, len(view))
+    off = 0
+    while off < len(view):
+        off += os.pwrite(fd, view[off:], off)
+    return fd
+
+
+def seal_segment(fd: int) -> None:
+    """Best-effort F_SEAL_* so the mapped segment can never change or
+    shrink under the supervisor's read-only mapping."""
+    try:
+        import fcntl
+
+        fcntl.fcntl(fd, fcntl.F_ADD_SEALS,
+                    fcntl.F_SEAL_SHRINK | fcntl.F_SEAL_GROW
+                    | fcntl.F_SEAL_WRITE)
+    except (ImportError, AttributeError, OSError):
+        pass
+
+
+def read_segment(fd: int, desc: dict) -> bytes:
+    """Map a received segment read-only, copy out the payload bytes,
+    and verify the copy.  The mapping is dropped BEFORE verification:
+    a raised :class:`DataPlaneCorruption` pins its frame locals in the
+    traceback, and a memoryview over a live mmap there would make the
+    map unclosable (``BufferError: cannot close exported pointers``).
+    The caller still owns (and must close) the fd."""
+    size = int(desc["size"])
+    if size == 0:
+        verify_chunks(b"", desc)
+        return b""
+    m = mmap.mmap(fd, size, prot=mmap.PROT_READ)
+    try:
+        data = m[:]
+    finally:
+        m.close()
+    verify_chunks(data, desc)
+    return data
+
+
+# ---- json plane ------------------------------------------------------------
+
+def encode_json_payload(payload, cap: Optional[int] = None) -> str:
+    """Base64 for the debug ``json`` plane.  Refuses — loudly, as a
+    :class:`DataPlaneOverflow` — any payload whose encoding would push
+    the result message over the control-frame cap (minus descriptor
+    headroom): the JSON wire truncates nothing, ever."""
+    if cap is None:
+        cap = wire.MAX_FRAME - 4096
+    s = base64.b64encode(bytes(payload)).decode("ascii")
+    if len(s) > cap:
+        raise DataPlaneOverflow(
+            f"serve_data_plane=json cannot carry a {len(memoryview(payload))}B "
+            f"payload ({len(s)}B base64) under the {cap}B control-frame "
+            f"budget — use the shm or frames plane")
+    return s
+
+
+def decode_json_payload(s: str) -> bytes:
+    return base64.b64decode(s.encode("ascii"))
+
+
+# ---- batch plumbing --------------------------------------------------------
+
+def is_batch(value) -> bool:
+    """Does this result value ride the data plane?"""
+    from ..columnar.column import ColumnBatch
+
+    return isinstance(value, ColumnBatch)
+
+
+def batch_digest(batch) -> str:
+    """Canonical transport-invariant digest of a batch's VALUES.
+
+    Materializes encoded columns and normalizes every slot the codec is
+    allowed to leave unspecified (data bytes under null rows, string pad
+    width), so solo / shm / frames / json arms of the bench can be
+    compared bit-for-bit.  Live float slots hash by raw bit pattern —
+    NaN payloads and -0.0 must survive the hop.
+    """
+    import hashlib
+
+    import jax
+    import numpy as np
+
+    from ..columnar.column import (Column, Decimal128Column, ListColumn,
+                                   StringColumn, StructColumn)
+    from ..columnar.encoded import materialize_column
+
+    h = hashlib.sha256()
+
+    def _host(x):
+        return np.asarray(jax.device_get(x))
+
+    def eat_col(col):
+        col = materialize_column(col)
+        valid = _host(col.validity).astype(bool)
+        h.update(valid.astype(np.uint8).tobytes())
+        if isinstance(col, StringColumn):
+            chars, lens = _host(col.chars), _host(col.lengths)
+            for i in range(len(lens)):
+                if valid[i]:
+                    h.update(lens[i].tobytes())
+                    h.update(chars[i, : lens[i]].tobytes())
+                else:
+                    h.update(b"\xff")
+        elif isinstance(col, Decimal128Column):
+            limbs = _host(col.limbs) * valid[:, None]
+            h.update(str(col.dtype).encode())
+            h.update(limbs.tobytes())
+        elif isinstance(col, ListColumn):
+            offs = _host(col.offsets)
+            h.update(offs.tobytes())
+            eat_col(col.child)
+        elif isinstance(col, StructColumn):
+            for fname, child in zip(col.field_names, col.children):
+                h.update(fname.encode())
+                eat_col(child)
+        elif isinstance(col, Column):
+            data = _host(col.data)
+            h.update(str(col.dtype).encode())
+            zero = np.zeros((), dtype=data.dtype)
+            h.update(np.where(valid, data, zero).tobytes())
+        else:
+            raise TypeError(f"cannot digest {type(col).__name__}")
+
+    for name in batch.names:
+        h.update(name.encode())
+        eat_col(batch[name])
+    return h.hexdigest()

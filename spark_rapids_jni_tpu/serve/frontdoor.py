@@ -58,6 +58,19 @@ makes for production query fleets):
   worker can ever come back (all dead, circuits open) pending sessions
   fail with :class:`WorkerLost`.
 
+* **Zero-copy data plane** — result BATCHES never cross as JSON: the
+  worker ships one Arrow IPC stream per result (encoded columns stay
+  encoded) over the ``serve_data_plane`` plane — a sealed memfd
+  fd-passed with the result descriptor (``shm``, Unix transport),
+  binary chunk frames ahead of it (``frames``, the TCP path), or a
+  loud-capped inline fallback (``json``).  The supervisor verifies the
+  descriptor's fence EPOCH against the worker's live generation (stale
+  segment reuse is rejected) and every per-chunk CRC32 (a torn payload
+  is rejected), then maps/decodes read-only.  A damaged transfer is not
+  a failed query: the session re-queues under a FRESH sid (the worker
+  dedups by sid) through the same bounded ladder.  Stashed fds and
+  chunk stashes are reaped at worker loss exactly like spill dirs.
+
 * **Durable shuffle plane** — unless disabled, a fleet-shared
   :mod:`~spark_rapids_jni_tpu.shuffle.store` root lives under the fleet
   dir; every worker generation commits its map outputs and drained
@@ -93,7 +106,7 @@ from typing import Dict, List, Optional
 
 from .. import config, faultinj
 from ..shuffle import store as store_mod
-from . import wire
+from . import data_plane, wire
 from .runtime import QueryCancelled, QueryTimeout, ServeError
 
 _MISS_BUDGET = 3.5       # heartbeat periods of silence before SIGKILL
@@ -128,7 +141,9 @@ class FleetMetrics:
 
     FIELDS = ("workers_spawned", "respawns", "crashes", "stalls",
               "replacements", "worker_lost", "sheds", "circuit_open",
-              "reconnects", "partitions_detected", "self_fenced_workers")
+              "reconnects", "partitions_detected", "self_fenced_workers",
+              "data_batches", "data_payload_bytes", "data_json_bytes",
+              "data_plane_errors")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -184,6 +199,9 @@ class FrontDoorSession:
         self.status = "pending"
         self.worker_id: Optional[int] = None
         self.replacements = 0
+        # data-plane transfer retries (torn/stale payloads) — separate
+        # budget from worker-loss replacements, same bound
+        self.data_retries = 0
         self.result_value = None
         self.error: Optional[BaseException] = None
         self._cancel_requested = False
@@ -252,6 +270,10 @@ class WorkerHandle:
         self.merged = False
         self.bye: Optional[dict] = None
         self.sessions: Dict[int, FrontDoorSession] = {}
+        # frames-plane reassembly: sid -> [(seq, chunk bytes)] — chunks
+        # arrive (in stream order) BEFORE their result descriptor;
+        # reaped with the worker like everything else it owned
+        self.data_stash: Dict[int, list] = {}
 
     def kill(self):
         with contextlib.suppress(OSError):
@@ -281,7 +303,9 @@ class FrontDoor:
                  transport: Optional[str] = None,
                  hosts=None,
                  partition_grace_ms: Optional[float] = None,
-                 reconnect_max: Optional[int] = None):
+                 reconnect_max: Optional[int] = None,
+                 data_plane_mode: Optional[str] = None,
+                 segment_bytes: Optional[int] = None):
         global _last_metrics
         self._n_workers = int(workers if workers is not None
                               else config.get("serve_workers"))
@@ -302,6 +326,15 @@ class FrontDoor:
             raise ServeError(
                 f"serve_transport must be 'unix' or 'tcp', "
                 f"got {self._transport!r}")
+        try:
+            self._data_plane = data_plane.resolve_plane(
+                data_plane_mode if data_plane_mode is not None
+                else config.get("serve_data_plane"), self._transport)
+        except ValueError as e:
+            raise ServeError(str(e)) from None
+        self._segment_bytes = max(1, int(
+            segment_bytes if segment_bytes is not None
+            else config.get("serve_segment_bytes")))
         self._grace_s = float(
             partition_grace_ms if partition_grace_ms is not None
             else config.get("serve_partition_grace_ms")) / 1000.0
@@ -465,6 +498,13 @@ class FrontDoor:
                         w.proc.wait(5.0)
                     entry = {"state": "wedged", "clean": False}
                 else:
+                    # the bye races the exit: the worker writes it and
+                    # dies, and the frame can still sit in the socket
+                    # buffer when waitpid returns — give the reader a
+                    # bounded beat to drain it before classifying
+                    grace = time.monotonic() + 2.0
+                    while w.bye is None and time.monotonic() < grace:
+                        time.sleep(0.01)
                     bye = w.bye or {}
                     residue = bye.get("residue") or [0, 0]
                     entry = {
@@ -503,6 +543,15 @@ class FrontDoor:
         report["clean"] = report["clean"] and not report["orphan_spill_files"]
         report["fleet"] = self.metrics.snapshot()
         report["transport"] = self._transport
+        fleet = report["fleet"]
+        report["data_plane"] = {
+            "plane": self._data_plane,
+            "segment_bytes": self._segment_bytes,
+            "batches": fleet["data_batches"],
+            "payload_bytes": fleet["data_payload_bytes"],
+            "json_bytes": fleet["data_json_bytes"],
+            "errors": fleet["data_plane_errors"],
+        }
         report["hosts"] = list(self._hosts)
         report["self_fenced"] = list(self._self_fenced)
         if self._store is not None:
@@ -591,7 +640,9 @@ class FrontDoor:
                "--pool-bytes", str(self._pool_bytes),
                "--host-pool-bytes", str(self._host_pool_bytes),
                "--max-concurrent", str(self._max_concurrent),
-               "--task-id-base", str(10_000 + slot * 1_000)]
+               "--task-id-base", str(10_000 + slot * 1_000),
+               "--data-plane", self._data_plane,
+               "--segment-bytes", str(self._segment_bytes)]
         # the gen doubles as the store's fencing epoch AND the hello's
         # fence_epoch: commits from this incarnation are keyed
         # attempt-<gen> and revocable the moment the supervisor declares
@@ -693,6 +744,13 @@ class FrontDoor:
                 # the slot to reconnect supervision, not the loss protocol
                 self._on_conn_lost(w, link)
                 return
+            if isinstance(msg, wire.DataChunk):
+                # frames plane: stash the chunk for its descriptor —
+                # stream ordering guarantees it lands before the result
+                with self._lock:
+                    w.data_stash.setdefault(msg.sid, []).append(
+                        (msg.seq, msg.payload))
+                continue
             op = msg.get("op")
             if op == "pong":
                 self._on_pong(w, msg)
@@ -756,22 +814,112 @@ class FrontDoor:
         if err in ("RetryOOM", "CpuRetryOOM", "SplitAndRetryOOM"):
             from ..mem import RetryOOM
             return RetryOOM(text)
+        if err == "DataPlaneOverflow":
+            return data_plane.DataPlaneOverflow(text)
         return ServeError(f"{err}: {text}")
 
-    def _on_result(self, w: WorkerHandle, msg: dict):
+    def _decode_data_result(self, w: WorkerHandle, desc: dict,
+                            chunks: Optional[list], fds: List[int]):
+        """Verify (epoch, then per-chunk CRCs) and decode one data-plane
+        payload into a ColumnBatch.  Raises
+        :class:`~.data_plane.DataPlaneStale` /
+        :class:`~.data_plane.DataPlaneCorruption` — the TRANSFER failed,
+        not the query; the caller re-queues under a fresh sid."""
+        from ..columnar import arrow as arrow_mod
+
+        # epoch before bytes: a stale generation's segment must be
+        # rejected before anything in it is interpreted
+        data_plane.verify_epoch(desc, w.gen)
+        plane = desc.get("plane")
+        if plane == "shm":
+            if not fds:
+                raise wire.WireError(
+                    f"shm descriptor for segment {desc.get('seg')} "
+                    f"arrived without its fd")
+            payload = data_plane.read_segment(fds[0], desc)
+        elif plane == "frames":
+            parts = sorted(chunks or [], key=lambda e: e[0])
+            payload = b"".join(p for _seq, p in parts)
+            data_plane.verify_chunks(payload, desc)
+        elif plane == "json":
+            payload = data_plane.decode_json_payload(
+                desc.get("inline") or "")
+            data_plane.verify_chunks(payload, desc)
+        else:
+            raise wire.WireError(f"unknown data plane {plane!r} in "
+                                 f"result descriptor")
+        return arrow_mod.ipc_to_batch(
+            payload, expect_fingerprint=desc.get("schema_fp"))
+
+    def _requeue_data_damaged(self, sess: FrontDoorSession, w: WorkerHandle,
+                              exc: BaseException):
+        """A data-plane transfer was damaged (torn payload, stale
+        segment, fd gone missing): the query succeeded worker-side, only
+        the hop failed.  Re-run it under a FRESH sid — the worker dedups
+        by sid, so re-submitting the old one would be swallowed — within
+        the same bounded budget; non-replayable queries fail loudly."""
+        self.metrics.bump("data_plane_errors")
         with self._lock:
-            sess = w.sessions.pop(int(msg.get("sid", -1)), None)
+            sess.data_retries += 1
+            if not sess.replayable or sess.data_retries > self._replace_max:
+                sess._finish(error=exc, status="failed")
+                return
+            sess.sid = next(self._sids)
+            sess.status = "pending"
+            sess.worker_id = None
+            self._pending.append(
+                [time.monotonic() + self._backoff_s
+                 * (2 ** (sess.data_retries - 1)), sess])
+            self._dispatch_locked(time.monotonic())
+        self._wake.set()
+
+    def _on_result(self, w: WorkerHandle, msg: dict):
+        sid = int(msg.get("sid", -1))
+        desc = msg.get("data")
+        with self._lock:
+            sess = w.sessions.pop(sid, None)
             w.results_since_pong += 1
             w.stall_suspect = 0
-        if sess is None:
-            return
-        if msg.get("ok"):
-            sess._finish(value=msg.get("value"), status="done")
-        else:
-            status = msg.get("status") or "failed"
-            sess._finish(error=self._rebuild_error(msg),
-                         status=status if status in
-                         ("cancelled", "timeout", "failed") else "failed")
+            chunks = w.data_stash.pop(sid, None)
+        # the fd rides the descriptor frame: claim it even for a
+        # deduplicated re-delivery, or the stash misaligns for the next
+        # descriptor on this connection
+        fds: List[int] = []
+        if desc is not None and desc.get("plane") == "shm":
+            link = w.link
+            if link is not None:
+                with contextlib.suppress(wire.WireError):
+                    fds = link.take_fds(int(desc.get("fds", 1)))
+        try:
+            if sess is None:
+                return
+            if msg.get("ok"):
+                if desc is not None:
+                    try:
+                        value = self._decode_data_result(w, desc, chunks,
+                                                         fds)
+                    except (data_plane.DataPlaneStale,
+                            data_plane.DataPlaneCorruption,
+                            wire.WireError, ValueError, OSError) as e:
+                        self._requeue_data_damaged(sess, w, e)
+                        return
+                    self.metrics.bump("data_batches")
+                    self.metrics.bump("data_payload_bytes",
+                                      int(desc.get("size") or 0))
+                    self.metrics.bump("data_json_bytes", len(json.dumps(
+                        msg, separators=(",", ":"))))
+                    sess._finish(value=value, status="done")
+                else:
+                    sess._finish(value=msg.get("value"), status="done")
+            else:
+                status = msg.get("status") or "failed"
+                sess._finish(error=self._rebuild_error(msg),
+                             status=status if status in
+                             ("cancelled", "timeout", "failed") else "failed")
+        finally:
+            for fd in fds:
+                with contextlib.suppress(OSError):
+                    os.close(fd)
         self._wake.set()
 
     # -- monitor loop ---------------------------------------------------
@@ -906,6 +1054,11 @@ class FrontDoor:
                     f"({why}){budget or ' (re-placement budget exhausted)'}",
                     worker_id=w.worker_id, fired_log=fired))
         w.sessions = {}
+        # reap the data plane with the worker: partial chunk stashes die
+        # here, and any unclaimed segment fds were closed with the
+        # transport in w.close() above — a crash with a segment
+        # outstanding leaks nothing
+        w.data_stash = {}
         # schedule the replacement, unless this slot's breaker is open
         if w.worker_id in self._broken:
             return
@@ -978,8 +1131,13 @@ class FrontDoor:
     def _dispatch_locked(self, now: float):
         if self._shutdown_started:
             return
-        # fleet exhausted?  No alive worker and none ever coming back.
-        if not self._alive_workers() and not self._respawn_at:
+        # fleet exhausted?  No alive worker and none ever coming back —
+        # a slot in "reconnecting" is a live worker behind a downed
+        # LINK (its ladder or the partition grace decides its fate),
+        # never grounds for failing pending sessions
+        if not self._alive_workers() and not self._respawn_at \
+                and not any(w.state == "reconnecting"
+                            for w in self._workers.values()):
             for _nb, sess in self._pending:
                 self.metrics.bump("worker_lost")
                 sess._finish(error=WorkerLost(

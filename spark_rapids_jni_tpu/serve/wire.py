@@ -62,28 +62,58 @@ worker   ``bye``      ``clean``, ``residue``, ``store_len``,
 
 ``send_msg`` takes an optional lock so a worker's result watchers and
 its main loop can share one socket without interleaving frames.
+
+Data plane (PR 13): the JSON frames above are the CONTROL plane.  Bulk
+result payloads cross either out-of-band (memfd + SCM_RIGHTS on
+:class:`UnixTransport` — see serve/data_plane.py) or as binary DATA
+frames on this same socket: the length prefix's MSB
+(:data:`DATA_FLAG` — safe because ``MAX_FRAME`` < 2^31) marks a frame
+whose body is ``<u32 sid, u32 seq>`` + raw payload chunk, CRC-trailered
+like every other frame but never JSON-parsed.  Control frames keep the
+16MB cap; data frames are bounded by ``MAX_DATA_FRAME`` and chunked at
+the ``serve_segment_bytes`` knob so control messages interleave instead
+of queueing behind a payload.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import socket
 import struct
 import threading
 import time
 import zlib
-from typing import Optional, Tuple
+from typing import List, NamedTuple, Optional, Tuple
 
 from .. import faultinj
 
 _HDR = struct.Struct("<I")
 _CRC = struct.Struct("<I")
-# a frame is control-plane metadata, never bulk data; anything bigger is
-# a protocol bug or a corrupted length prefix
+# data-frame body header: (sid, seq) routes a payload chunk to its
+# session's reassembly stash without touching JSON
+_DHDR = struct.Struct("<II")
+# a CONTROL frame is metadata, never bulk data; anything bigger is a
+# protocol bug or a corrupted length prefix.  DATA frames (flagged by
+# the MSB of the length prefix) carry payload chunks and get their own,
+# larger cap.
 MAX_FRAME = 16 << 20
+MAX_DATA_FRAME = 64 << 20
+# the length prefix's MSB marks a binary data frame — MAX_FRAME and
+# MAX_DATA_FRAME both fit well under 2^31, so the bit is free
+DATA_FLAG = 0x8000_0000
 # how long one frame may stay incomplete once its first byte arrived
 # before the stream is declared desynced
 FRAME_DEADLINE_S = 5.0
+
+
+class DataChunk(NamedTuple):
+    """One binary data-plane chunk, reassembled per ``sid`` by the
+    receiver; ``seq`` orders chunks within a payload."""
+
+    sid: int
+    seq: int
+    payload: bytes
 
 
 class WireError(ConnectionError):
@@ -116,6 +146,15 @@ def _frame(obj: dict) -> bytes:
     return _HDR.pack(len(data)) + data + _CRC.pack(zlib.crc32(data))
 
 
+def _data_frame(sid: int, seq: int, payload) -> bytes:
+    body = _DHDR.pack(sid, seq) + bytes(payload)
+    if len(body) > MAX_DATA_FRAME:
+        raise WireError(
+            f"data frame of {len(body)}B exceeds {MAX_DATA_FRAME}B")
+    return (_HDR.pack(DATA_FLAG | len(body)) + body
+            + _CRC.pack(zlib.crc32(body)))
+
+
 def send_msg(sock: socket.socket, obj: dict,
              lock: Optional[threading.Lock] = None):
     frame = _frame(obj)
@@ -128,16 +167,50 @@ def send_msg(sock: socket.socket, obj: dict,
 
 def recv_msg(sock: socket.socket,
              deadline_s: Optional[float] = FRAME_DEADLINE_S) -> dict:
-    """Read one frame; raises :class:`WireError` on EOF/garbage, a
+    """Read one CONTROL frame; raises :class:`WireError` on EOF/garbage
+    (including an unexpected data frame — control-only contexts), a
     :class:`WireDesync` when a frame stays incomplete past
     ``deadline_s`` or fails its CRC trailer, and lets ``socket.timeout``
     through ONLY at a frame boundary so pollers can keep ticking."""
+    got = recv_any(sock, deadline_s=deadline_s)
+    if isinstance(got, DataChunk):
+        raise WireError(
+            f"unexpected data frame (sid={got.sid} seq={got.seq}) on a "
+            f"control-only stream")
+    return got
+
+
+def recv_any(sock: socket.socket,
+             deadline_s: Optional[float] = FRAME_DEADLINE_S,
+             recv=None):
+    """Read one frame of either plane: a ``dict`` for JSON control
+    frames, a :class:`DataChunk` for binary data frames.  ``recv``
+    overrides the raw read callable (the Unix transport threads its
+    fd-stashing ``recv_fds`` reader through here)."""
     hdr = _recv_exact(sock, _HDR.size, deadline_s=deadline_s,
-                      boundary=True)
+                      boundary=True, recv=recv)
     (n,) = _HDR.unpack(hdr)
+    if n & DATA_FLAG:
+        n &= ~DATA_FLAG
+        if n > MAX_DATA_FRAME:
+            raise WireError(f"data frame length {n} exceeds "
+                            f"{MAX_DATA_FRAME}")
+        if n < _DHDR.size:
+            raise WireError(f"data frame length {n} below header size")
+        body = _recv_exact(sock, n + _CRC.size, deadline_s=deadline_s,
+                           recv=recv)
+        data, trailer = body[:n], body[n:]
+        (crc,) = _CRC.unpack(trailer)
+        if crc != zlib.crc32(data):
+            raise WireDesync(
+                f"data frame CRC mismatch ({crc:#010x} != "
+                f"{zlib.crc32(data):#010x}): torn or corrupted chunk")
+        sid, seq = _DHDR.unpack_from(data)
+        return DataChunk(sid, seq, data[_DHDR.size:])
     if n > MAX_FRAME:
         raise WireError(f"frame length {n} exceeds {MAX_FRAME}")
-    body = _recv_exact(sock, n + _CRC.size, deadline_s=deadline_s)
+    body = _recv_exact(sock, n + _CRC.size, deadline_s=deadline_s,
+                       recv=recv)
     data, trailer = body[:n], body[n:]
     (crc,) = _CRC.unpack(trailer)
     if crc != zlib.crc32(data):
@@ -149,7 +222,7 @@ def recv_msg(sock: socket.socket,
 
 def _recv_exact(sock: socket.socket, n: int, *,
                 deadline_s: Optional[float] = None,
-                boundary: bool = False) -> bytes:
+                boundary: bool = False, recv=None) -> bytes:
     """Read exactly ``n`` bytes.  A timeout with ZERO bytes read at a
     frame ``boundary`` is idle and re-raised for the poller; a timeout
     mid-frame keeps reading only until ``deadline_s`` has elapsed since
@@ -159,7 +232,10 @@ def _recv_exact(sock: socket.socket, n: int, *,
     started: Optional[float] = None
     while len(buf) < n:
         try:
-            chunk = _retry_eintr(sock.recv, n - len(buf))
+            if recv is not None:
+                chunk = recv(n - len(buf))
+            else:
+                chunk = _retry_eintr(sock.recv, n - len(buf))
         except socket.timeout:
             if boundary and not buf:
                 raise  # idle between frames: retryable
@@ -196,6 +272,7 @@ class Transport:
     supervisor's sends independently of the worker's."""
 
     kind = "stream"
+    supports_fds = False
 
     def __init__(self, sock: socket.socket, role: str = "peer",
                  frame_deadline_s: float = FRAME_DEADLINE_S,
@@ -206,6 +283,7 @@ class Transport:
         self.stall_s = float(stall_s)
         self._send_lock = threading.Lock()
         self._closed = False
+        self._fd_stash: List[int] = []
         self._probe_send = faultinj.instrument(
             lambda: None, f"net_send_{role}")
         self._probe_recv = faultinj.instrument(
@@ -223,19 +301,26 @@ class Transport:
             self.sock.close()
         except OSError:
             pass
+        # reap stashed fds nobody claimed (worker lost mid-transfer):
+        # the segment dies with its last fd, like a spill dir rmtree
+        stash, self._fd_stash = self._fd_stash, []
+        for fd in stash:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
 
     @property
     def closed(self) -> bool:
         return self._closed
 
     # -- framed I/O with injected network faults ------------------------
-    def send(self, obj: dict):
-        """Send one frame under the write deadline.  An injected
-        network fault (or a send blocked past the socket timeout) kills
-        the link: the socket closes and :class:`WireError` surfaces —
-        a partial frame may be on the wire, so no retry on this
-        connection is possible."""
-        frame = _frame(obj)
+    def _send_frame(self, frame: bytes, fds: Optional[List[int]] = None):
+        """One locked, probed, deadline'd frame write (both planes).
+        An injected network fault (or a send blocked past the socket
+        timeout) kills the link: the socket closes and
+        :class:`WireError` surfaces — a partial frame may be on the
+        wire, so no retry on this connection is possible."""
         with self._send_lock:
             try:
                 self._probe_send()
@@ -259,7 +344,13 @@ class Transport:
                 self.close()
                 raise WireError(f"injected torn frame on send: {e}") from e
             try:
-                _retry_eintr(self.sock.sendall, frame)
+                if fds:
+                    sent = _retry_eintr(
+                        socket.send_fds, self.sock, [frame], fds)
+                    if sent < len(frame):
+                        _retry_eintr(self.sock.sendall, frame[sent:])
+                else:
+                    _retry_eintr(self.sock.sendall, frame)
             except socket.timeout:
                 self.close()
                 raise WireDesync(
@@ -269,13 +360,47 @@ class Transport:
                 self.close()
                 raise
 
-    def recv(self) -> dict:
-        """Receive one frame.  ``socket.timeout`` surfaces only at a
-        frame boundary (idle poll tick); any wire damage — including an
-        injected fault on this received frame — closes the link and
+    def send(self, obj: dict):
+        """Send one control frame (see :meth:`_send_frame`)."""
+        self._send_frame(_frame(obj))
+
+    def send_data(self, sid: int, seq: int, payload):
+        """Send one binary data-plane chunk.  Each chunk is its own
+        frame under the send lock, so control messages interleave
+        between chunks instead of queueing behind the payload."""
+        self._send_frame(_data_frame(sid, seq, payload))
+
+    def send_with_fds(self, obj: dict, fds: List[int]):
+        """Send a control frame with fds attached via SCM_RIGHTS (shm
+        descriptors travel WITH their segment fd, atomically)."""
+        if fds and not self.supports_fds:
+            raise WireError(
+                f"{self.kind!r} transport cannot carry fds "
+                f"(SCM_RIGHTS is Unix-domain only)")
+        self._send_frame(_frame(obj), fds=fds)
+
+    def take_fds(self, k: int) -> List[int]:
+        """Claim ``k`` fds received ahead of (or with) the current
+        control frame, in arrival order."""
+        if len(self._fd_stash) < k:
+            raise WireError(
+                f"descriptor claims {k} fd(s) but only "
+                f"{len(self._fd_stash)} arrived on this connection")
+        out, self._fd_stash = self._fd_stash[:k], self._fd_stash[k:]
+        return out
+
+    def _recv_chunk(self, n: int) -> bytes:
+        return _retry_eintr(self.sock.recv, n)
+
+    def recv(self):
+        """Receive one frame of either plane: a ``dict`` (control) or a
+        :class:`DataChunk` (data).  ``socket.timeout`` surfaces only at
+        a frame boundary (idle poll tick); any wire damage — including
+        an injected fault on this received frame — closes the link and
         raises :class:`WireError`."""
         try:
-            msg = recv_msg(self.sock, deadline_s=self.frame_deadline_s)
+            msg = recv_any(self.sock, deadline_s=self.frame_deadline_s,
+                           recv=self._recv_chunk)
         except socket.timeout:
             raise
         except (WireError, OSError, ValueError):
@@ -304,6 +429,18 @@ class Transport:
 
 class UnixTransport(Transport):
     kind = "unix"
+    supports_fds = True
+
+    # ancillary-data budget per recvmsg: a result descriptor carries one
+    # segment fd; 32 leaves slack for pipelined results on one tick
+    _MAX_FDS = 32
+
+    def _recv_chunk(self, n: int) -> bytes:
+        data, fds, _flags, _addr = _retry_eintr(
+            socket.recv_fds, self.sock, n, self._MAX_FDS)
+        if fds:
+            self._fd_stash.extend(fds)
+        return data
 
 
 class TcpTransport(Transport):

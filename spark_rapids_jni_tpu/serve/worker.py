@@ -47,6 +47,23 @@ up in the worker-side query-kind registry (:func:`register_query_kind`)
   (``--store-dir``): returns the delivered rows' sha256 plus whether
   the map ran or a prior attempt's committed shards were ADOPTED — the
   store_recovery chaos scenario's workload
+* ``arrow_batch`` — returns an actual :class:`ColumnBatch`
+  (:func:`make_result_batch`: dictionary strings, RLE ints, floats with
+  NaN/-0.0 payloads — a pure function of ``(rows, seed)``), which is
+  exactly the kind of result that rides the zero-copy DATA plane
+  instead of the JSON wire (the bench/chaos data-plane workload)
+
+Data plane: a query whose result is a ``ColumnBatch`` does not cross as
+JSON.  The watcher serializes it once with ``arrow.batch_to_ipc``
+(encoded columns stay encoded) and ships it per ``--data-plane``: a
+sealed memfd segment fd-passed with the result descriptor (``shm``),
+binary chunk frames ahead of the descriptor (``frames``), or an inline
+base64 fallback (``json`` — refused loudly past the control-frame cap).
+The descriptor stamps this incarnation's fence epoch and per-chunk
+CRC32s; the ``data_write_wk`` / ``data_descriptor_wk`` probes let chaos
+tear stamped payload bytes (``shm_torn``) or resurrect a prior
+generation's segment name (``shm_stale``) so the supervisor's
+verification paths are exercised against real damage.
 
 Fault injection: the supervisor exports its live schedule into this
 process via ``SPARK_RAPIDS_TPU_FAULT_CONFIG`` and points
@@ -209,11 +226,64 @@ def _qk_q6_digest(ctx, params, sess):
     return [dig.hexdigest(), time.perf_counter() - t0]
 
 
+def make_result_batch(rows: int, seed: int):
+    """Deterministic columnar result payload for the data-plane waves.
+
+    A pure function of ``(rows, seed)`` so the solo / MP-shm / TCP-frames
+    bench arms and every chaos retry are comparable bit-for-bit.  Exercises
+    exactly what the zero-copy hop must preserve: dictionary-encoded
+    strings (codes + dictionary, null rows borrowing a live code), an
+    RLE-encoded int column, and float payload edge cases (NaN, -0.0)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..columnar import types as T
+    from ..columnar.column import Column, ColumnBatch, StringColumn
+    from ..columnar.encoded import encode_column, encode_rle
+
+    n = int(rows)
+    seed = int(seed)
+    idx = np.arange(n, dtype=np.int64)
+    v = (idx * (2 * seed + 3)) % 104729
+    f = idx.astype(np.float64) * 0.5 - n / 4.0
+    f[idx % 97 == 0] = np.nan
+    f[idx % 89 == 0] = -0.0
+    fv = (idx + seed) % 13 != 0
+    tags = [t.encode() for t in
+            ("alpha", "beta", "gamma", "delta-longer", "épsilon")]
+    w = -(-max(len(t) for t in tags) // 8) * 8
+    tmpl = np.zeros((len(tags), w), np.uint8)
+    tlens = np.zeros((len(tags),), np.int32)
+    for i, t in enumerate(tags):
+        tmpl[i, : len(t)] = np.frombuffer(t, np.uint8)
+        tlens[i] = len(t)
+    tagidx = ((idx * (seed + 1)) % len(tags)).astype(np.int64)
+    sv = (idx + 2 * seed) % 11 != 0
+    chars = tmpl[tagidx] * sv[:, None].astype(np.uint8)
+    lens = (tlens[tagidx] * sv).astype(np.int32)
+    base = (np.arange(n // 8 + 1, dtype=np.int64) * (seed + 1)) % 5
+    r = np.repeat(base, 8)[:n].astype(np.int32)
+    rv = (idx + seed) % 17 != 0
+    return ColumnBatch({
+        "v": Column(jnp.asarray(v), jnp.ones((n,), jnp.bool_), T.INT64),
+        "f": Column(jnp.asarray(f), jnp.asarray(fv), T.FLOAT64),
+        "tag": encode_column(StringColumn(
+            jnp.asarray(chars), jnp.asarray(lens), jnp.asarray(sv))),
+        "r": encode_rle(Column(jnp.asarray(r), jnp.asarray(rv), T.INT32)),
+    })
+
+
+def _qk_arrow_batch(ctx, params, sess):
+    return make_result_batch(int(params.get("rows", 1 << 13)),
+                             int(params.get("seed", 0)))
+
+
 register_query_kind("echo", _qk_echo)
 register_query_kind("sleep", _qk_sleep)
 register_query_kind("spill_walk", _qk_spill_walk)
 register_query_kind("shuffle_digest", _qk_shuffle_digest)
 register_query_kind("q6_digest", _qk_q6_digest)
+register_query_kind("arrow_batch", _qk_arrow_batch)
 
 
 def _crash_hook(name: str):
@@ -251,7 +321,10 @@ class _SupervisorLink:
         self.reconnect_max = int(reconnect_max)
         self._lock = threading.Lock()
         self._t = None
-        self._unsent: List[dict] = []
+        # queued delivery jobs: (msg, fds, chunks) — plain control
+        # messages queue as (msg, None, None); data-plane results keep
+        # their segment fd / chunk list alive across the outage
+        self._unsent: List[tuple] = []
         self.last_contact = time.monotonic()
         self.reconnects = 0
 
@@ -302,27 +375,50 @@ class _SupervisorLink:
         t.close()
 
     def send(self, msg: dict, queue_on_fail: bool = False) -> bool:
+        return self.send_payload(msg, None, None,
+                                 queue_on_fail=queue_on_fail)
+
+    def send_payload(self, msg: dict, fds: Optional[List[int]],
+                     chunks: Optional[List[bytes]],
+                     queue_on_fail: bool = False) -> bool:
+        """Deliver one message plus its data-plane payload: chunk frames
+        go FIRST (stream ordering means they are stashed supervisor-side
+        before the descriptor arrives), an fd rides the descriptor frame
+        itself via SCM_RIGHTS.  On success the worker's fd copy closes —
+        the receiver holds the segment now.  A failed delivery requeues
+        the whole job; the supervisor's sid dedup makes the eventual
+        re-send at-least-once with exactly-once effect."""
         with self._lock:
             t = self._t
             if t is None:
                 if queue_on_fail:
-                    self._unsent.append(msg)
+                    self._unsent.append((msg, fds, chunks))
                 return False
         try:
-            t.send(msg)
-            return True
+            if chunks:
+                sid = int(msg["sid"])
+                for seq, c in enumerate(chunks):
+                    t.send_data(sid, seq, c)
+            if fds:
+                t.send_with_fds(msg, fds)
+            else:
+                t.send(msg)
         except (self._wire.WireError, OSError):
             self._drop(t)
             if queue_on_fail:
                 with self._lock:
-                    self._unsent.append(msg)
+                    self._unsent.append((msg, fds, chunks))
             return False
+        for fd in fds or ():
+            with contextlib.suppress(OSError):
+                os.close(fd)
+        return True
 
     def flush_unsent(self):
         with self._lock:
             pending, self._unsent = self._unsent, []
-        for i, msg in enumerate(pending):
-            if not self.send(msg):
+        for i, job in enumerate(pending):
+            if not self.send_payload(*job):
                 with self._lock:
                     self._unsent = pending[i:] + self._unsent
                 return
@@ -378,6 +474,14 @@ def main(argv=None) -> int:
                          "a reconnect reattaches instead of replacing")
     ap.add_argument("--partition-grace-ms", type=float, default=1500.0)
     ap.add_argument("--reconnect-max", type=int, default=4)
+    ap.add_argument("--data-plane", default="auto",
+                    choices=("auto", "shm", "frames", "json"),
+                    help="how ColumnBatch results cross back: memfd + "
+                         "SCM_RIGHTS, binary chunk frames, or inline "
+                         "base64 (resolved against --transport)")
+    ap.add_argument("--segment-bytes", type=int, default=1 << 20,
+                    help="data-plane chunk granularity (CRC stamp / "
+                         "data-frame size; the serve_segment_bytes knob)")
     ap.add_argument("--setup", default=None,
                     help="module whose register_query_kinds(register) "
                          "adds custom kinds before serving")
@@ -393,8 +497,11 @@ def main(argv=None) -> int:
 
     from ..mem import spill as spill_mod
     from ..mem.rmm_spark import RmmSpark
+    from . import data_plane as dp
     from . import wire
     from .runtime import ServeRuntime
+
+    plane = dp.resolve_plane(args.data_plane, args.transport)
 
     if args.setup:
         importlib.import_module(args.setup).register_query_kinds(
@@ -459,14 +566,73 @@ def main(argv=None) -> int:
     # each end of a session's life
     recv_probe = faultinj.instrument(lambda: None, "worker_recv")
     result_probe = faultinj.instrument(lambda: None, "worker_result")
+    # data-plane fault points: after the CRC stamp (shm_torn tears real
+    # payload bytes the stamps no longer cover) and at descriptor build
+    # (shm_stale resurrects the previous generation's segment name)
+    data_write_probe = faultinj.instrument(lambda: None, "data_write_wk")
+    data_desc_probe = faultinj.instrument(lambda: None,
+                                          "data_descriptor_wk")
+    seg_seq = iter(range(1 << 62))
+
+    def encode_batch_result(sid: int, batch):
+        """ColumnBatch -> (descriptor fields, fds, chunk frames) on the
+        resolved plane.  Payload bytes never enter the JSON message
+        except on the loud-capped ``json`` fallback."""
+        from ..columnar import arrow as arrow_mod
+
+        payload, fp = arrow_mod.batch_to_ipc(batch)
+        view = memoryview(payload)
+        chunk_bytes = max(1, int(args.segment_bytes))
+        crcs = dp.chunk_crcs(view, chunk_bytes)
+        torn_at: Optional[int] = None
+        try:
+            data_write_probe()
+        except faultinj.ShmTornError:
+            # real damage, injected after the stamps: flip a byte in the
+            # middle of the payload the CRCs claim to cover
+            torn_at = len(view) // 2 if len(view) else None
+        name = dp.segment_name(args.worker_id, args.epoch, next(seg_seq))
+        desc = dp.build_descriptor(plane, name, len(view), fp,
+                                   chunk_bytes, crcs, args.epoch)
+        try:
+            data_desc_probe()
+        except faultinj.ShmStaleError:
+            stale = max(0, args.epoch - 1)
+            desc["epoch"] = stale
+            desc["seg"] = dp.segment_name(args.worker_id, stale, 0)
+        if plane == "shm":
+            fd = dp.make_segment(name, view)
+            if torn_at is not None:
+                b = os.pread(fd, 1, torn_at)
+                os.pwrite(fd, bytes([b[0] ^ 0xFF]), torn_at)
+            dp.seal_segment(fd)
+            desc["fds"] = 1
+            return desc, [fd], None
+        raw = bytearray(view)
+        if torn_at is not None:
+            raw[torn_at] ^= 0xFF
+        if plane == "frames":
+            chunks = [bytes(raw[o: o + chunk_bytes])
+                      for o in range(0, len(raw), chunk_bytes)]
+            return desc, None, chunks
+        # raises DataPlaneOverflow past the control-frame cap: the json
+        # fallback fails loudly, it never truncates
+        desc["inline"] = dp.encode_json_payload(raw)
+        return desc, None, None
 
     def watch(sid: int, sess):
         sess._done.wait()
+        fds = chunks = None
         try:
             result_probe()  # chaos: crash with the result undelivered
             if sess.error is None:
                 msg = {"op": "result", "sid": sid, "ok": True,
-                       "value": sess.result_value, "status": sess.status}
+                       "status": sess.status}
+                if dp.is_batch(sess.result_value):
+                    msg["data"], fds, chunks = encode_batch_result(
+                        sid, sess.result_value)
+                else:
+                    msg["value"] = sess.result_value
             else:
                 msg = {"op": "result", "sid": sid, "ok": False,
                        "status": sess.status,
@@ -478,7 +644,7 @@ def main(argv=None) -> int:
                    "message": str(e)}
         # queue on a downed link: the result is flushed after reattach
         # (the supervisor's sid dedup makes a re-send a no-op)
-        link.send(msg, queue_on_fail=True)
+        link.send_payload(msg, fds, chunks, queue_on_fail=True)
 
     def handle_submit(msg: dict):
         sid = int(msg["sid"])

@@ -287,3 +287,118 @@ class TestListenConnect:
             wire.listen("carrier-pigeon", "/nowhere")
         with pytest.raises(ValueError, match="unknown transport"):
             wire.wrap(None, "quic", role="sup")
+
+
+class TestDataFrames:
+    """The binary data plane sharing the control socket: MSB-flagged
+    frames with their own cap and CRC, interleaving with control
+    messages, and SCM_RIGHTS fd-passing on the Unix transport."""
+
+    def test_data_frame_round_trip(self, tpair):
+        sup, wk = tpair
+        payload = bytes(range(256)) * 7
+        wk.send_data(9, 0, payload)
+        sup.settimeout(2.0)
+        chunk = sup.recv()
+        assert isinstance(chunk, wire.DataChunk)
+        assert (chunk.sid, chunk.seq, chunk.payload) == (9, 0, payload)
+
+    def test_control_and_data_interleave_in_order(self, tpair):
+        sup, wk = tpair
+        wk.send_data(3, 0, b"part-a")
+        wk.send({"op": "running", "sid": 3})
+        wk.send_data(3, 1, b"part-b")
+        wk.send({"op": "result", "sid": 3})
+        sup.settimeout(2.0)
+        got = [sup.recv() for _ in range(4)]
+        assert got[0] == wire.DataChunk(3, 0, b"part-a")
+        assert got[1] == {"op": "running", "sid": 3}
+        assert got[2] == wire.DataChunk(3, 1, b"part-b")
+        assert got[3] == {"op": "result", "sid": 3}
+
+    def test_data_frame_crc_reject(self, tpair):
+        sup, wk = tpair
+        frame = bytearray(wire._data_frame(1, 0, b"payload-bytes"))
+        frame[-7] ^= 0xFF  # tear a payload byte after the CRC stamp
+        wk.sock.sendall(bytes(frame))
+        sup.settimeout(2.0)
+        with pytest.raises(wire.WireDesync, match="CRC"):
+            sup.recv()
+        assert sup.closed
+
+    def test_data_cap_is_larger_than_control_cap(self, tpair):
+        sup, wk = tpair
+        assert wire.MAX_DATA_FRAME > wire.MAX_FRAME
+        big = b"z" * (wire.MAX_FRAME + 1024)  # over the CONTROL cap
+        got = []
+        sup.settimeout(10.0)
+        rx = threading.Thread(target=lambda: got.append(sup.recv()))
+        rx.start()  # drain concurrently: the frame outgrows the socket
+        try:        # buffer, so an unread send would deadlock
+            wk.send_data(1, 0, big)
+        finally:
+            rx.join(timeout=15.0)
+        assert got and got[0].payload == big
+
+    def test_oversized_data_length_prefix_rejected(self, tpair):
+        sup, wk = tpair
+        wk.sock.sendall(struct.pack(
+            "<I", wire.DATA_FLAG | (wire.MAX_DATA_FRAME + 1)))
+        sup.settimeout(2.0)
+        with pytest.raises(wire.WireError, match="exceeds"):
+            sup.recv()
+
+    def test_oversized_data_send_rejected_before_writing(self, tpair):
+        _sup, wk = tpair
+        with pytest.raises(wire.WireError, match="exceeds"):
+            wk.send_data(1, 0, b"z" * (wire.MAX_DATA_FRAME + 1))
+
+    def test_recv_msg_is_control_only(self, pair):
+        a, b = pair
+        a.sendall(wire._data_frame(1, 0, b"chunk"))
+        with pytest.raises(wire.WireError, match="control-only"):
+            wire.recv_msg(b)
+
+    def test_fd_passing_unix_only(self):
+        sa, sb = socket.socketpair(socket.AF_UNIX, socket.SOCK_STREAM)
+        sup = wire.wrap(sa, "unix", role="sup")
+        wk = wire.wrap(sb, "unix", role="wk")
+        try:
+            import os
+            r, w = os.pipe()
+            os.write(w, b"via-scm-rights")
+            os.close(w)
+            wk.send_with_fds({"op": "result", "sid": 1, "fds": 1}, [r])
+            os.close(r)  # sender's copy; the dup travels in-flight
+            sup.settimeout(2.0)
+            msg = sup.recv()
+            assert msg["op"] == "result"
+            (rfd,) = sup.take_fds(1)
+            try:
+                assert os.read(rfd, 64) == b"via-scm-rights"
+            finally:
+                os.close(rfd)
+            # claiming more fds than arrived is a protocol error
+            with pytest.raises(wire.WireError, match="fd"):
+                sup.take_fds(1)
+        finally:
+            sup.close()
+            wk.close()
+
+    def test_fds_refused_on_tcp(self):
+        lst, addr = wire.listen("tcp", "127.0.0.1:0")
+        wk = wire.connect("tcp", addr, role="wk")
+        conn, _ = lst.accept()
+        sup = wire.wrap(conn, "tcp", role="sup")
+        lst.close()
+        try:
+            assert not wk.supports_fds
+            with pytest.raises(wire.WireError, match="SCM_RIGHTS"):
+                wk.send_with_fds({"op": "result"}, [0])
+        finally:
+            sup.close()
+            wk.close()
+
+    def test_shm_fault_kinds_are_registered(self):
+        for kind in ("shm_torn", "shm_stale"):
+            assert kind in faultinj.FAULT_KINDS

@@ -3,7 +3,7 @@
 The premerge gate (ci/chaos.sh) that proves the fault-domain story
 end-to-end, the way ci/q95_floor.json proves perf: it sweeps every
 registered ``faultinj.FAULT_KINDS`` entry across every instrumented
-boundary of ten scenarios — a spill walk (device→host→disk→back), an
+boundary of eleven scenarios — a spill walk (device→host→disk→back), an
 out-of-core skewed shuffle, the single-chip q95 pipeline, a global
 distributed sort across the 8-device mesh, a JNI host-boundary
 round-trip, a streaming morsel scan, a multi-tenant serving wave
@@ -18,9 +18,12 @@ quarantine damage, and fence every revoked generation), and a
 multi-host TCP fleet wave (multihost: network faults — dropped, stalled
 and torn links — landed at the transport probes on both sides of both
 directions, resolved by reconnect+reattach where a partition must end
-in self-fencing with zero zombie commits) — one fault per trial
-exhaustively, plus ``chaos_trials`` seeded multi-fault trials per
-scenario.  Every trial must end with
+in self-fencing with zero zombie commits), and a zero-copy data-plane
+wave (dataplane: result batches crossing the worker boundary as Arrow
+IPC segments, torn after their CRC stamps or announced under a dead
+fence generation — the supervisor's epoch-then-CRC verify must detect
+and re-place, bit-identically) — one fault per trial exhaustively,
+plus ``chaos_trials`` seeded multi-fault trials per scenario.  Every trial must end with
 
 * a result **bit-identical** to the scenario's fault-free baseline
   (sha256 over every output leaf's dtype/shape/bytes), and
@@ -906,12 +909,111 @@ class MultihostScenario:
                                     if k != "liveness"}}}
 
 
+class DataPlaneScenario:
+    """The zero-copy columnar data plane under fire: ``arrow_batch``
+    tenants return RESULT BATCHES that cross the worker boundary as
+    Arrow IPC payloads in memfd segments (SCM_RIGHTS fd-passing on the
+    unix fleet) while the control wire carries only a JSON descriptor.
+    ``shm_torn`` flips payload bytes in the mapped segment AFTER the
+    descriptor's chunk CRCs were stamped; ``shm_stale`` rewrites the
+    descriptor to a dead fence generation's segment name; and
+    ``worker_crash`` at the result seam kills the worker with a segment
+    in flight (descriptor undelivered, fd unreaped).  The supervisor
+    must verify epoch-then-CRC before interpreting a single buffer,
+    count the damage (``data_plane_errors``), re-place the session
+    under a fresh sid, and converge on a batch whose canonical
+    ``batch_digest`` — NaN payloads, -0.0, dictionary codes, RLE runs —
+    is bit-identical to the fault-free baseline.  Damage detections are
+    surfaced as ``recovered_partitions`` so torn/stale trials can
+    assert the verify path actually fired, not merely that the wave
+    survived."""
+
+    name = "dataplane"
+    n_tenants = 3
+    seeds = (41, 42, 43)
+    rows = 2048
+
+    def run(self) -> Dict:
+        from spark_rapids_jni_tpu.mem import RetryOOM
+        from spark_rapids_jni_tpu.serve import (AdmissionShed, FrontDoor,
+                                                QueryCancelled, WorkerLost)
+        from spark_rapids_jni_tpu.serve import data_plane as dp
+
+        results: List[Optional[str]] = [None] * self.n_tenants
+        kills = 0
+        config.set("serve_backoff_ms", 30.0)
+        fd = FrontDoor(workers=2, pool_bytes=2 * MB,
+                       host_pool_bytes=512 * KB, max_concurrent=2,
+                       heartbeat_ms=60.0, respawn_max=4,
+                       data_plane_mode="shm")
+        try:
+            pending = list(range(self.n_tenants))
+            attempts = {i: 0 for i in pending}
+            while pending:
+                wave = [(i, fd.submit(
+                    "arrow_batch",
+                    {"rows": self.rows, "seed": self.seeds[i]},
+                    tenant=f"tenant-{i}")) for i in pending]
+                pending = []
+                for i, sess in wave:
+                    try:
+                        results[i] = dp.batch_digest(
+                            sess.result(timeout=60.0))
+                    except faultinj.FatalInjectedFault:
+                        raise  # whole-scenario replacement
+                    except (WorkerLost, AdmissionShed,
+                            faultinj.TaskCancelled, faultinj.InjectedFault,
+                            QueryCancelled, RetryOOM):
+                        kills += 1
+                        attempts[i] += 1
+                        if attempts[i] >= _MAX_ATTEMPTS:
+                            raise ChaosError(
+                                f"dataplane: tenant {i} not done after "
+                                f"{_MAX_ATTEMPTS} re-submissions")
+                        pending.append(i)
+        finally:
+            report = fd.shutdown()
+            config.reset("serve_backoff_ms")
+        unclean = {wid: e for wid, e in report["workers"].items()
+                   if not e.get("clean")}
+        if unclean:
+            raise ChaosError(f"dataplane: unclean workers: {unclean}")
+        if report["orphan_spill_files"]:
+            raise ChaosError(f"dataplane: orphan spill files: "
+                             f"{report['orphan_spill_files']}")
+        if os.path.exists(fd.fleet_dir):
+            raise ChaosError("dataplane: fleet dir survived shutdown")
+        dp_info = report["data_plane"]
+        if dp_info["plane"] != "shm":
+            raise ChaosError(
+                f"dataplane: fleet rode plane {dp_info['plane']!r}, "
+                f"not shm")
+        if dp_info["batches"] < self.n_tenants:
+            raise ChaosError(
+                f"dataplane: only {dp_info['batches']} batches crossed "
+                f"the data plane for {self.n_tenants} tenants — results "
+                f"leaked back onto the JSON wire")
+        h = hashlib.sha256()
+        for r in results:  # position-stable: tenant i's digest at slot i
+            h.update((r or "<none>").encode())
+        return {"digest": h.hexdigest(),
+                "extra": {"tenant_kills": kills,
+                          "data_batches": dp_info["batches"],
+                          "data_payload_bytes": dp_info["payload_bytes"],
+                          "data_plane_errors": dp_info["errors"],
+                          "recovered_partitions": dp_info["errors"],
+                          "fleet": {k: v for k, v in
+                                    report["fleet"].items()
+                                    if k != "liveness"}}}
+
+
 SCENARIOS = {s.name: s for s in (SpillScenario(), ShuffleScenario(),
                                  Q95Scenario(), SortScenario(),
                                  StreamingScanScenario(), JniScenario(),
                                  ServingScenario(), FrontdoorScenario(),
                                  StoreRecoveryScenario(),
-                                 MultihostScenario())}
+                                 MultihostScenario(),
+                                 DataPlaneScenario())}
 
 
 # ---------------------------------------------------------------------------
@@ -1084,6 +1186,28 @@ def single_fault_trials(fast: bool = False) -> List[Trial]:
         one("store_recovery", "store_corrupt_file", "store_corrupt",
             expect_recovered=True)
 
+    # dataplane scenario: the zero-copy result path.  shm_torn /
+    # shm_stale fire ONLY here and in the data-plane tests — these
+    # trials keep both kinds in the coverage check.  The torn trial
+    # flips segment bytes AFTER the CRC stamps (the supervisor's chunk
+    # verify must catch it and re-place under a fresh sid); the stale
+    # trial rewrites the descriptor to a dead generation (the epoch
+    # verify must reject BEFORE any CRC work); worker_crash at the
+    # result seam kills the worker with a segment in flight — the fd
+    # must be reaped with the transport, never decoded.  Torn/stale
+    # trials assert expect_recovered: the damage counter proves the
+    # verify path fired, not merely that the wave survived.
+    if not fast:
+        one("dataplane", "data_write_wk", "shm_torn",
+            expect_recovered=True)
+        one("dataplane", "data_write_wk", "shm_torn", skip=1,
+            expect_recovered=True)
+        one("dataplane", "data_descriptor_wk", "shm_stale",
+            expect_recovered=True)
+        one("dataplane", "worker_result", "worker_crash")
+        one("dataplane", "serve_step", "worker_crash")
+        one("dataplane", "serve_step", "exception")
+
     # multihost scenario: the three network kinds fired at the worker
     # side of both directions, link drops at the supervisor side of
     # both, and the partition trial.  net_drop / net_stall / net_torn
@@ -1146,6 +1270,10 @@ _MULTI_POOL = {
                   ("net_send_sup", "net_drop"),
                   ("net_recv_sup", "net_stall"),
                   ("serve_step", "worker_crash")],
+    "dataplane": [("data_write_wk", "shm_torn"),
+                  ("data_descriptor_wk", "shm_stale"),
+                  ("worker_result", "worker_crash"),
+                  ("serve_step", "oom")],
 }
 
 
