@@ -35,6 +35,12 @@ out-of-core ShuffleService under a capped device arena; its JSON line adds
 whole-plan compiler (spark_rapids_jni_tpu/plan/); each row's ``note``
 carries the plan-cache outcome and the adaptive decisions, and the q95 IR
 row's ``vs_baseline`` rides its own only-shrinks floor (ci/q95_floor.json).
+
+``python bench.py --multidevice`` runs the pallas engine tier over an
+8-device mesh (virtual on the CPU fallback): an ICI shuffle and a
+streaming scan on the fused partition scatter, plus q95 with both
+relational engine knobs pinned to pallas — every row parity-asserted
+against its lax/default-engine twin before the rate is reported.
 """
 
 import json
@@ -1183,6 +1189,267 @@ def scan_main():
 
 
 # --------------------------------------------------------------------------
+# multidevice scenario (--multidevice): pallas engines across the mesh
+# --------------------------------------------------------------------------
+
+def multidevice_main():
+    """The pallas engine tier across a real device mesh: 8 devices
+    (virtual on the CPU fallback, physical on hardware), the fused radix
+    partition scatter driving a genuine ICI shuffle.  Three rows:
+
+    * ``multidevice_shuffle_throughput`` — a multi-round
+      ``exchange_stream`` over the mesh with ``shuffle_scatter_engine``
+      pinned to pallas, bit-identical (k/v/occupancy, shard for shard)
+      to the same stream on the lax engine, which is also the
+      ``vs_baseline`` denominator;
+    * ``multidevice_scan_stream_throughput`` — the morsel-driven
+      Parquet scan→shuffle pipeline on the pallas scatter, delivered
+      row set identical to the lax run;
+    * ``multidevice_q95_throughput`` — the q95 shape executed with BOTH
+      relational engine knobs (``groupby_engine``, ``join_engine``)
+      pinned to the pallas tier, group-digest-identical to the
+      scatter/hash engines.
+
+    Every row asserts its parity BEFORE reporting a rate — drift fails
+    the child outright, the parent gets no metric line, and
+    ci/check_q95_line.py fails on the missing row.  Off-accelerator the
+    pallas kernels run in interpret mode (same numerics, interpreter
+    speed), so vs_baseline documents the interpreter tax on CPU and
+    only means a win on hardware (PALLAS_MEMO.md decision rule)."""
+    if os.environ.get("BENCH_FORCE_CPU"):
+        # the scenario needs a multi-device mesh; on CPU fallback carve 8
+        # virtual devices (must land before jax initializes)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+
+    import tempfile
+
+    import jax
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        platform = jax.devices()[0].platform
+    except Exception as e:  # backend init failure → parent falls back
+        print(f"# backend init failed: {e}", file=sys.stderr, flush=True)
+        return 17
+
+    import jax.numpy as jnp
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from spark_rapids_jni_tpu import config
+    from spark_rapids_jni_tpu.columnar import types as T
+    from spark_rapids_jni_tpu.columnar.column import Column, ColumnBatch
+    from spark_rapids_jni_tpu.parallel import data_mesh, shard_batch
+    from spark_rapids_jni_tpu.shuffle import (
+        MorselSource,
+        ShuffleRegistry,
+        ShuffleService,
+    )
+
+    P = len(jax.devices())
+    if P < 2:
+        print(f"# multidevice scenario needs >=2 devices, found {P}",
+              file=sys.stderr, flush=True)
+        return 1
+    mesh = data_mesh(P)
+    failures = []
+
+    def emit(row):
+        print(json.dumps(row), flush=True)
+
+    # -- row 1: the ICI shuffle.  One in-memory stream, exchanged twice:
+    # lax scatter (baseline) then the fused pallas scatter, asserted
+    # bit-identical shard for shard before the rate is reported.
+    per_dev = int(os.environ.get("BENCH_MD_ROWS", str(1 << 11)))
+    n_rows = P * per_dev
+    rng = np.random.default_rng(31)
+    ones = jnp.ones((n_rows,), jnp.bool_)
+    batch = shard_batch(ColumnBatch({
+        "k": Column(jnp.asarray(rng.integers(0, 1 << 20, n_rows)), ones,
+                    T.INT64),
+        "v": Column(jnp.asarray(np.arange(n_rows, dtype=np.int64)), ones,
+                    T.INT64)}), mesh)
+    config.set("shuffle_capacity_bucket", 64)
+    morsel_rows = int(os.environ.get("BENCH_MD_MORSEL_ROWS", "512"))
+    round_rows = int(os.environ.get("BENCH_MD_ROUND_ROWS", "128"))
+
+    def stream_once(engine):
+        config.set("shuffle_scatter_engine", engine)
+        svc = ShuffleService(mesh, registry=ShuffleRegistry())
+        src = MorselSource.from_batch(batch, mesh, morsel_rows=morsel_rows)
+        t0 = time.perf_counter()
+        res = svc.exchange_stream(list(src), key_names=["k"],
+                                  round_rows=round_rows)
+        jax.block_until_ready(res.batch["k"].data)
+        dt = time.perf_counter() - t0
+        arrs = tuple(np.asarray(jax.device_get(x))
+                     for x in (res.batch["k"].data, res.batch["v"].data,
+                               res.occupancy))
+        return res, arrs, dt
+
+    try:
+        r_lax, a_lax, dt_lax = stream_once("lax")
+        r_pls, a_pls, dt_pls = stream_once("pallas")
+        if r_lax.rounds != r_pls.rounds or r_lax.capacity != r_pls.capacity:
+            failures.append("shuffle: round/capacity plans diverged "
+                            f"({r_lax.rounds}/{r_lax.capacity} vs "
+                            f"{r_pls.rounds}/{r_pls.capacity})")
+        if r_pls.rows_moved != n_rows:
+            failures.append(f"shuffle accounting: {r_pls.rows_moved} "
+                            f"!= {n_rows}")
+        if r_pls.rounds < 1:
+            failures.append("shuffle never went through an ICI round")
+        for a, b, nm in zip(a_lax, a_pls, ("k", "v", "occupancy")):
+            if not np.array_equal(a, b):
+                failures.append(f"shuffle: pallas {nm} shard bytes != lax")
+    except Exception as e:
+        failures.append(repr(e))
+    if failures:
+        print(f"# multidevice shuffle failed: {failures}", file=sys.stderr,
+              flush=True)
+        return 1
+    mrows = n_rows / dt_pls / 1e6
+    emit({
+        "metric": "multidevice_shuffle_throughput",
+        "value": round(mrows, 3),
+        "unit": "Mrows/s",
+        "vs_baseline": round(dt_lax / dt_pls, 4),
+        "platform": platform,
+        "rows": n_rows,
+        "devices": P,
+        "shuffle_rounds": r_pls.rounds,
+        "shuffle_capacity": r_pls.capacity,
+        "note": {"scatter_engine": "pallas", "parity": "ok",
+                 "lax_mrows": round(n_rows / dt_lax / 1e6, 3)},
+    })
+
+    # -- row 2: the streaming scan pipeline (Parquet decode overlapping
+    # round drains) on the pallas scatter.  The two engines may
+    # interleave morsels differently against the decoder, so the parity
+    # check compares the delivered ROW SET (occupancy-masked, lexsorted)
+    # — per-shard bit-identity on a fixed morsel list is row 1's job.
+    work_dir = tempfile.mkdtemp(prefix="bench_md_")
+    path = os.path.join(work_dir, "scan.parquet")
+    pq.write_table(pa.table({"k": np.asarray(rng.integers(
+        0, 1 << 20, n_rows)).astype(np.int64),
+        "v": np.arange(n_rows, dtype=np.int64)}), path,
+        row_group_size=max(n_rows // 4, 1))
+
+    def rowset(res):
+        occ = np.asarray(jax.device_get(res.occupancy))
+        ks = np.asarray(jax.device_get(res.batch["k"].data))[occ]
+        vs = np.asarray(jax.device_get(res.batch["v"].data))[occ]
+        order = np.lexsort((vs, ks))
+        return ks[order], vs[order]
+
+    def scan_once(engine):
+        config.set("shuffle_scatter_engine", engine)
+        svc = ShuffleService(mesh, registry=ShuffleRegistry())
+        t0 = time.perf_counter()
+        src = MorselSource.from_parquet(path, mesh)
+        res = svc.exchange_stream(src, key_names=["k"],
+                                  round_rows=round_rows)
+        jax.block_until_ready(res.batch["k"].data)
+        return res, time.perf_counter() - t0
+
+    try:
+        s_lax, sdt_lax = scan_once("lax")
+        s_pls, sdt_pls = scan_once("pallas")
+        lk, lv = rowset(s_lax)
+        pk, pv = rowset(s_pls)
+        if not (np.array_equal(lk, pk) and np.array_equal(lv, pv)):
+            failures.append("scan: pallas delivered rows != lax")
+        if s_pls.rows_moved != n_rows:
+            failures.append(f"scan accounting: {s_pls.rows_moved} "
+                            f"!= {n_rows}")
+    except Exception as e:
+        failures.append(repr(e))
+    finally:
+        import shutil
+
+        shutil.rmtree(work_dir, ignore_errors=True)
+    if failures:
+        print(f"# multidevice scan failed: {failures}", file=sys.stderr,
+              flush=True)
+        return 1
+    smrows = n_rows / sdt_pls / 1e6
+    emit({
+        "metric": "multidevice_scan_stream_throughput",
+        "value": round(smrows, 3),
+        "unit": "Mrows/s",
+        "vs_baseline": round(sdt_lax / sdt_pls, 4),
+        "platform": platform,
+        "rows": n_rows,
+        "devices": P,
+        "shuffle_rounds": s_pls.rounds,
+        "note": {"scatter_engine": "pallas", "parity": "ok",
+                 "morsels": s_pls.morsels,
+                 "lax_mrows": round(n_rows / sdt_lax / 1e6, 3)},
+    })
+
+    # -- row 3: the q95 shape with BOTH relational engine knobs pinned
+    # to the pallas tier, against the default scatter/hash engines on
+    # the same batches.  The group digest (seg → (orders, net)) must
+    # match exactly — the acceptance bar the engine-parity tests hold
+    # per kernel, here end to end through the full query.
+    import __graft_entry__ as ge
+
+    nq = int(os.environ.get("BENCH_MD_Q95_ROWS", str(1 << 13)))
+    V = 3
+    q95in = [ge._q95_batches(nq, seed=41 + k) for k in range(V)]
+
+    def groups(res, ng):
+        n_g = int(ng)
+        k = np.asarray(jax.device_get(res["seg"].data))
+        kv = np.asarray(jax.device_get(res["seg"].validity))
+        o = np.asarray(jax.device_get(res["orders"].data))
+        net = np.asarray(jax.device_get(res["net"].data))
+        return {int(k[i]) if kv[i] else None: (int(o[i]), float(net[i]))
+                for i in range(n_g)}
+
+    def q95_once(gb_engine, join_engine):
+        config.set("groupby_engine", gb_engine)
+        config.set("join_engine", join_engine)
+        step = jax.jit(lambda f, a, b: ge._q95_step(f, a, b))
+        digests = [groups(*jax.device_get(step(*args))) for args in q95in]
+        mr = _bench_one(step, q95in[0], nq, reps=2, variants=q95in)
+        return digests, mr
+
+    try:
+        base_digests, base_mr = q95_once("scatter", "hash")
+        pls_digests, pls_mr = q95_once("pallas", "pallas")
+        if base_digests != pls_digests:
+            failures.append("q95: pallas group digests != scatter/hash")
+    except Exception as e:
+        failures.append(repr(e))
+    finally:
+        config.reset()
+    if failures:
+        print(f"# multidevice q95 failed: {failures}", file=sys.stderr,
+              flush=True)
+        return 1
+    emit({
+        "metric": "multidevice_q95_throughput",
+        "value": round(pls_mr, 3),
+        "unit": "Mrows/s",
+        "vs_baseline": round(pls_mr / base_mr, 4),
+        "platform": platform,
+        "rows": nq,
+        "devices": P,
+        "note": {"digest_match": True,
+                 "engines": {"groupby": "pallas", "join": "pallas"},
+                 "baseline_engines": {"groupby": "scatter", "join": "hash"},
+                 "baseline_mrows": round(base_mr, 3)},
+    })
+    return 0
+
+
+# --------------------------------------------------------------------------
 # plan scenario (--plan): q6/q95/q9 through the whole-plan IR compiler
 # --------------------------------------------------------------------------
 
@@ -1822,6 +2089,137 @@ def micro_main():
         skipped.append("<remaining suite>")
         return finish()
 
+    # pallas device-kernel A/B rows (r14): the fused slot-table build /
+    # probe and the radix partition scatter against the lax formulations
+    # they mirror, on IDENTICAL inputs.  Parity is asserted IN-ROW on
+    # the warm variant (any drift turns the row into an error line), and
+    # vs_baseline is pallas/lax throughput.  Off-accelerator the kernels
+    # run in interpret mode, so the ratio documents the interpreter tax,
+    # not a win — the PALLAS_MEMO.md decision rule keeps 'auto' on the
+    # lax tier until a hardware round measures these rows faster.
+    from spark_rapids_jni_tpu.ops import pallas_kernels as _PK
+    from spark_rapids_jni_tpu.relational import hashtable as _HT
+
+    def _tree_eq(a, b):
+        la = jax.tree_util.tree_leaves(jax.device_get(a))
+        lb = jax.tree_util.tree_leaves(jax.device_get(b))
+        return len(la) == len(lb) and all(
+            np.array_equal(np.asarray(x), np.asarray(y))
+            for x, y in zip(la, lb))
+
+    def run_pallas_ab(name, lax_fn, pallas_fn, variants, n_ab, reps=10):
+        if only and name != only:
+            return
+        if over():
+            skipped.append(name)
+            return
+        print(f"# measuring {name} (pallas A/B)", file=sys.stderr,
+              flush=True)
+        try:
+            if not _tree_eq(lax_fn(*variants[0]), pallas_fn(*variants[0])):
+                raise AssertionError("pallas output != lax output "
+                                     "(bit-identity contract broken)")
+            lax_m = _bench_one(lax_fn, variants[0], n_ab, reps,
+                               variants=variants)
+            pls_m = _bench_one(pallas_fn, variants[0], n_ab, reps,
+                               variants=variants)
+            row = {"metric": name,
+                   "vs_baseline": round(pls_m / lax_m, 6),
+                   "note": {"parity": "ok",
+                            "lax_mrows": round(lax_m, 3),
+                            "backend": jax.default_backend()}}
+            if pls_m < 0.1:
+                row.update(value=round(pls_m * 1e3, 3), unit="Krows/s")
+            else:
+                row.update(value=round(pls_m, 3), unit="Mrows/s")
+            results.append(row)
+        except Exception as e:  # pragma: no cover - diagnostic path
+            results.append({"metric": name,
+                            "error": f"{type(e).__name__}: {e}"})
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+        print(json.dumps(results[-1]), flush=True)
+
+    pallas_rows = ("slot_build_pallas", "slot_probe_pallas",
+                   "partition_scatter_pallas")
+    n_sl, s_sl, rounds_sl = 1 << 11, 1 << 12, 24
+    sl_vars = [] if not want(*pallas_rows) else [
+        (jnp.asarray(rng.integers(0, 1 << 20, n_sl).astype(np.uint32)),
+         jnp.ones((n_sl,), jnp.bool_))
+        for _ in range(V)
+    ]
+    run_pallas_ab(
+        "slot_build_pallas",
+        jax.jit(lambda w, lv: _HT.build_slot_table(
+            [w], lv, s_sl, max_rounds=rounds_sl, engine="lax")),
+        jax.jit(lambda w, lv: _HT.build_slot_table(
+            [w], lv, s_sl, max_rounds=rounds_sl, engine="pallas")),
+        sl_vars, n_sl)
+
+    pr_vars = []
+    if want("slot_probe_pallas"):
+        for bw, lv in sl_vars:
+            owner, _, _ = jax.jit(lambda w, l: _HT.build_slot_table(
+                [w], l, s_sl, max_rounds=rounds_sl))(bw, lv)
+            # probe keys half hit, half miss (shifted domain)
+            pw = jnp.asarray(rng.integers(0, 1 << 21,
+                                          n_sl).astype(np.uint32))
+            pr_vars.append((owner, bw, pw, lv))
+    run_pallas_ab(
+        "slot_probe_pallas",
+        jax.jit(lambda ow, bw, pw, lv: _HT.probe_slot_table(
+            ow, [bw], [pw], lv, max_rounds=64, engine="lax")),
+        jax.jit(lambda ow, bw, pw, lv: _HT.probe_slot_table(
+            ow, [bw], [pw], lv, max_rounds=64, engine="pallas")),
+        pr_vars, n_sl)
+
+    # the shuffle map step's fused scatter: one morsel routed into the
+    # per-partition round window of the send chunks, null-partition rows
+    # (pid == P) dropped, exactly as shuffle/service.py's lax body does
+    p_sc, c_sc, m_sc, r_sc = 8, 256, 1 << 11, 1
+
+    def _scatter_lax(ck, cv, occv, mk, mv, cnts, base):
+        ends = jnp.cumsum(cnts)
+        offs = ends - cnts
+        i = jnp.arange(m_sc, dtype=jnp.int32)
+        d = jnp.searchsorted(ends, i, side="right").astype(jnp.int32)
+        d_c = jnp.minimum(d, p_sc - 1)
+        k = jnp.take(base, d_c) + (i - jnp.take(offs, d_c))
+        in_round = (d < p_sc) & (k >= r_sc * c_sc) & (k < (r_sc + 1) * c_sc)
+        t = jnp.where(in_round, d_c * c_sc + (k - r_sc * c_sc),
+                      p_sc * c_sc)
+        return (ck.at[t].set(mk, mode="drop"),
+                cv.at[t].set(mv, mode="drop"),
+                occv.at[t].set(True, mode="drop"))
+
+    def _scatter_pallas(ck, cv, occv, mk, mv, cnts, base):
+        (nk, nv), no = _PK.partition_scatter(
+            [ck, cv], occv, [mk, mv], cnts, base, jnp.int32(r_sc),
+            p_sc, c_sc)
+        return nk, nv, no
+
+    sc_vars = []
+    if want("partition_scatter_pallas"):
+        for _ in range(V):
+            parts = rng.integers(0, p_sc + 1, m_sc)  # P == null partition
+            cnts = jnp.asarray(np.bincount(np.minimum(parts, p_sc - 1),
+                                           minlength=p_sc), jnp.int32)
+            sc_vars.append((
+                jnp.zeros((p_sc * c_sc,), jnp.int64),
+                jnp.zeros((p_sc * c_sc,), jnp.float32),
+                jnp.zeros((p_sc * c_sc,), jnp.bool_),
+                jnp.asarray(rng.integers(0, 1 << 30, m_sc), jnp.int64),
+                jnp.asarray(rng.random(m_sc), jnp.float32),
+                cnts,
+                jnp.asarray(rng.integers(0, 3 * c_sc, p_sc), jnp.int32)))
+    run_pallas_ab("partition_scatter_pallas", jax.jit(_scatter_lax),
+                  jax.jit(_scatter_pallas), sc_vars, m_sc)
+
+    if over():
+        skipped.append("<remaining suite>")
+        return finish()
+
     # decimal128 multiply (the DecimalUtils hot op; 128-bit limb math)
     from spark_rapids_jni_tpu.columnar.column import Decimal128Column
     from spark_rapids_jni_tpu.ops import decimal as dec
@@ -1978,6 +2376,8 @@ def main():
         sys.exit(plan_main())
     if mode == "--child-scan":
         sys.exit(scan_main())
+    if mode == "--child-multidevice":
+        sys.exit(multidevice_main())
     if mode == "--probe":
         sys.exit(_probe_main())
 
@@ -1987,12 +2387,15 @@ def main():
     run_shuffle = mode == "--shuffle"
     run_plan = mode == "--plan"
     run_scan = mode == "--scan"
+    run_multidevice = mode == "--multidevice"
     child_mode = ("--child-micro" if run_micro
                   else "--child-spill" if run_spill
                   else "--child-serve" if run_serve
                   else "--child-shuffle" if run_shuffle
                   else "--child-plan" if run_plan
-                  else "--child-scan" if run_scan else "--child")
+                  else "--child-scan" if run_scan
+                  else "--child-multidevice" if run_multidevice
+                  else "--child")
     t0 = time.monotonic()
 
     def left():
@@ -2036,6 +2439,7 @@ def main():
                   else "shuffle_skew_outofcore" if run_shuffle
                   else "q6_ir_throughput" if run_plan
                   else "scan_stream_throughput" if run_scan
+                  else "multidevice_shuffle_throughput" if run_multidevice
                   else "q6_pipeline_throughput")
         print(json.dumps({
             "metric": metric,

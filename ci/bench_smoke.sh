@@ -24,14 +24,30 @@ BENCH_FORCE_CPU=1 BENCH_SCAN_ROWS=32768 python bench.py --scan \
 # note.tcp_bit_identical must be true with tcp_workers >= 2
 BENCH_FORCE_CPU=1 BENCH_SERVE_ROWS=16384 python bench.py --serve \
   | tee /tmp/bench_smoke_serve.out
+# pallas device-kernel A/B rows: each asserts its pallas kernel
+# bit-identical to the lax twin IN-ROW before measuring (interpret mode
+# on CPU); BENCH_MICRO_ONLY runs just the requested entry per child
+: > /tmp/bench_smoke_pallas.out
+for row in slot_build_pallas slot_probe_pallas partition_scatter_pallas; do
+  BENCH_FORCE_CPU=1 BENCH_MICRO_ONLY="$row" python bench.py --micro \
+    | tee -a /tmp/bench_smoke_pallas.out
+done
+# multidevice scenario: the fused pallas scatter driving a real ICI
+# shuffle over 8 (virtual) devices, the streaming scan on the same
+# engine, and q95 with both relational engine knobs pinned to pallas —
+# every row parity-asserted before its rate is reported
+BENCH_FORCE_CPU=1 python bench.py --multidevice \
+  | tee /tmp/bench_smoke_multidevice.out
 # the q95 lines must be self-explaining (per-stage note + engines; cache +
 # decisions on the IR rows) and their vs_baseline must not regress below
 # the recorded floors — ratchets in the same only-shrinks spirit as
 # graftlint's baseline (ci/q95_floor.json); a missing q9 IR row,
-# streaming-scan row, or serving row fails too
+# streaming-scan row, serving row, pallas A/B row, or multidevice row
+# fails too
 python ci/check_q95_line.py /tmp/bench_smoke_q6.out \
   /tmp/bench_smoke_plan.out /tmp/bench_smoke_scan.out \
-  /tmp/bench_smoke_serve.out
+  /tmp/bench_smoke_serve.out /tmp/bench_smoke_pallas.out \
+  /tmp/bench_smoke_multidevice.out
 # spill scenario: device arena capped below q6's working set; the emitted
 # line carries spill-bytes counters so BENCH_*.json tracks spill overhead
 BENCH_FORCE_CPU=1 BENCH_SPILL_ROWS=65536 python bench.py --spill

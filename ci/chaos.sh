@@ -49,5 +49,16 @@ for scenario in ("sort", "streaming_scan", "jni", "serving", "frontdoor",
     bad = [t["label"] for t in trials if not t.get("ok")]
     assert not bad, f"{scenario!r} trials failed: {bad}"
     print(f"chaos gate: {len(trials)} {scenario} trial(s) ok")
+# the pallas engine tier must stay under fire: q95 and streaming_scan
+# each need trials with the engine knobs pinned (+pallas labels), whose
+# digests were checked against the default-engine fault-free baseline
+for scenario in ("q95", "streaming_scan"):
+    pinned = [t for t in doc["trials"]
+              if t["label"].startswith(scenario + ":")
+              and "+pallas]" in t["label"]]
+    assert pinned, f"chaos report has no pallas-pinned {scenario!r} trials"
+    bad = [t["label"] for t in pinned if not t.get("ok")]
+    assert not bad, f"pallas-pinned {scenario!r} trials failed: {bad}"
+    print(f"chaos gate: {len(pinned)} pallas-pinned {scenario} trial(s) ok")
 EOF
 echo "== chaos campaign OK (report: /tmp/chaos_report.json) =="

@@ -63,6 +63,25 @@ boundary as Arrow IPC payloads (``plane`` shm on the unix fleet, with a
 batches, and the payload-bytes / descriptor-JSON-bytes ``reduction``
 (both arms) must not shrink below ``serve_wire_floor`` — the proof that
 result payloads stay OFF the JSON control wire.
+
+Since r14 the pallas device-kernel rows get the same treatment:
+
+* the three micro A/B rows (``slot_build_pallas``,
+  ``slot_probe_pallas``, ``partition_scatter_pallas`` — bench.py
+  micro_main) must exist, their ``note.parity`` must be ``ok`` (the
+  row asserted bit-identical pallas/lax outputs before measuring), and
+  their ``vs_baseline`` (pallas/lax throughput) rides
+  ``pallas_vs_lax_floor`` — set far below 1 because CPU CI runs the
+  kernels in interpret mode; the hardware bar is PALLAS_MEMO.md's
+  delete-or-measure rule, enforced on TPU rounds, not here;
+* the ``bench.py --multidevice`` rows: ``multidevice_shuffle_throughput``
+  must exist with ``devices >= 8`` and ``shuffle_rounds >= 1`` (the ICI
+  evidence) and parity ``ok`` (bit-identical shards against the lax
+  scatter); ``multidevice_scan_stream_throughput`` must exist with
+  parity ``ok``; both ride ``multidevice_vs_lax_floor``;
+  ``multidevice_q95_throughput`` must exist with ``note.digest_match``
+  true and BOTH engine knobs recorded as pallas, riding
+  ``multidevice_q95_floor``.
 """
 import json
 import os
@@ -98,6 +117,9 @@ def main(paths) -> int:
     serve_floor = floors["serve_p99_floor"]
     recovery_floor = floors["serve_recovery_floor"]
     wire_floor = floors["serve_wire_floor"]
+    pallas_floor = floors["pallas_vs_lax_floor"]
+    md_floor = floors["multidevice_vs_lax_floor"]
+    md_q95_floor = floors["multidevice_q95_floor"]
     lines = _scan(paths)
     line = lines.get("q95_shape_throughput")
     enc_line = lines.get("q95_shape_encoded_throughput")
@@ -253,6 +275,93 @@ def main(paths) -> int:
             errs.append(f"serve vs_baseline {serve_vs} (solo p99 / "
                         f"concurrent p99) regressed below the recorded "
                         f"floor {serve_floor} (ci/q95_floor.json)")
+    # pallas device-kernel micro A/B rows: presence + in-row parity +
+    # the (interpret-mode) pallas/lax ratio ratchet
+    for name in ("slot_build_pallas", "slot_probe_pallas",
+                 "partition_scatter_pallas"):
+        p_line = lines.get(name)
+        if p_line is None:
+            errs.append(f"no {name} line: the pallas A/B micro row fell "
+                        "out of the smoke (bench.py micro_main)")
+            continue
+        p_note = p_line.get("note")
+        if not isinstance(p_note, dict) or p_note.get("parity") != "ok":
+            errs.append(f"{name} line's note.parity is not 'ok': the row "
+                        "no longer proves the pallas kernel bit-identical "
+                        f"to its lax twin (note={json.dumps(p_note)})")
+        p_vs = p_line.get("vs_baseline", 0.0)
+        if p_vs < pallas_floor:
+            errs.append(f"{name} vs_baseline {p_vs} (pallas/lax) regressed "
+                        f"below the recorded floor {pallas_floor} "
+                        f"(ci/q95_floor.json)")
+    # multidevice rows: the ICI shuffle + streaming scan on the pallas
+    # scatter, and q95 with both engine knobs pinned to the pallas tier
+    md_line = lines.get("multidevice_shuffle_throughput")
+    if md_line is None:
+        errs.append("no multidevice_shuffle_throughput line: the ICI "
+                    "shuffle row fell out of the smoke "
+                    "(bench.py multidevice_main)")
+    else:
+        md_note = md_line.get("note")
+        if not isinstance(md_note, dict) or md_note.get("parity") != "ok":
+            errs.append("multidevice shuffle line's note.parity is not "
+                        "'ok': the pallas scatter no longer proves itself "
+                        "bit-identical shard for shard "
+                        f"(note={json.dumps(md_note)})")
+        if int(md_line.get("devices", 0)) < 8:
+            errs.append("multidevice shuffle line ran on fewer than 8 "
+                        f"devices (line={json.dumps(md_line)})")
+        if int(md_line.get("shuffle_rounds", 0)) < 1:
+            errs.append("multidevice shuffle line shows no ICI round "
+                        f"(line={json.dumps(md_line)})")
+        if md_line.get("vs_baseline", 0.0) < md_floor:
+            errs.append(f"multidevice shuffle vs_baseline "
+                        f"{md_line.get('vs_baseline')} regressed below "
+                        f"the recorded floor {md_floor} "
+                        f"(ci/q95_floor.json)")
+    md_scan = lines.get("multidevice_scan_stream_throughput")
+    if md_scan is None:
+        errs.append("no multidevice_scan_stream_throughput line: the "
+                    "multidevice streaming-scan row fell out of the "
+                    "smoke (bench.py multidevice_main)")
+    else:
+        ms_note = md_scan.get("note")
+        if not isinstance(ms_note, dict) or ms_note.get("parity") != "ok":
+            errs.append("multidevice scan line's note.parity is not "
+                        "'ok': the pallas scatter no longer proves the "
+                        "delivered row set identical to lax "
+                        f"(note={json.dumps(ms_note)})")
+        if md_scan.get("vs_baseline", 0.0) < md_floor:
+            errs.append(f"multidevice scan vs_baseline "
+                        f"{md_scan.get('vs_baseline')} regressed below "
+                        f"the recorded floor {md_floor} "
+                        f"(ci/q95_floor.json)")
+    md_q95 = lines.get("multidevice_q95_throughput")
+    if md_q95 is None:
+        errs.append("no multidevice_q95_throughput line: the "
+                    "pallas-pinned q95 row fell out of the smoke "
+                    "(bench.py multidevice_main)")
+    else:
+        mq_note = md_q95.get("note")
+        eng = (mq_note or {}).get("engines") \
+            if isinstance(mq_note, dict) else None
+        if (not isinstance(mq_note, dict)
+                or mq_note.get("digest_match") is not True):
+            errs.append("multidevice q95 line's note.digest_match is not "
+                        "true: the pallas-pinned query no longer proves "
+                        "itself digest-identical to the scatter/hash "
+                        f"engines (note={json.dumps(mq_note)})")
+        elif (not isinstance(eng, dict)
+                or eng.get("groupby") != "pallas"
+                or eng.get("join") != "pallas"):
+            errs.append("multidevice q95 line no longer records BOTH "
+                        "engine knobs pinned to pallas "
+                        f"(note={json.dumps(mq_note)})")
+        if md_q95.get("vs_baseline", 0.0) < md_q95_floor:
+            errs.append(f"multidevice q95 vs_baseline "
+                        f"{md_q95.get('vs_baseline')} regressed below "
+                        f"the recorded floor {md_q95_floor} "
+                        f"(ci/q95_floor.json)")
     if errs:
         for e in errs:
             print("check_q95_line:", e)
@@ -263,6 +372,10 @@ def main(paths) -> int:
           f"scan {scan_vs} >= floor {scan_floor}; "
           f"serve {serve_vs} >= floor {serve_floor}; "
           f"wire reduction >= floor {wire_floor}; "
+          f"pallas A/B rows parity ok >= floor {pallas_floor}; "
+          f"multidevice rows ok (devices "
+          f"{(md_line or {}).get('devices')}, rounds "
+          f"{(md_line or {}).get('shuffle_rounds')}); "
           f"engines {json.dumps((note or {}).get('engines'))})")
     if vs >= 2 * floor and floor > 0:
         print(f"check_q95_line: note — vs_baseline is >=2x the floor; "

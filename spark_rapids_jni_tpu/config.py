@@ -123,6 +123,16 @@ _register("shuffle_stream", False, _parse_bool,
           "materializing the whole scan before round 1 drains.  The "
           "streaming path is bit-identical on delivered rows; off = "
           "always materialize.")
+_register("shuffle_scatter_engine", "auto", str,
+          "Morsel->round-chunk scatter engine for the streaming shuffle "
+          "map step (shuffle/service.py _scatter_step): 'lax' (XLA "
+          "searchsorted + per-column scatters with the row->slot map "
+          "rematerialized between programs), 'pallas' (ONE fused kernel "
+          "computing pid, per-partition cumulative offsets, and every "
+          "column's chunk scatter with the map resident in VMEM — "
+          "interpret mode off-accelerator, bit-identical chunks), or "
+          "'auto' (lax everywhere until a hardware round measures the "
+          "kernel faster — PALLAS_MEMO.md's delete-or-measure rule).")
 _register("shuffle_capacity_dcn", 0, int,
           "Override for the per-(sender, destination-host) slot capacity "
           "of hop one (DCN) in hierarchical exchanges "

@@ -89,6 +89,44 @@ def choose_groupby_engine(counts=None,
     return None
 
 
+def bound_build_rounds(rows: int, num_slots: int) -> int:
+    """Slot-table build round bound from the OBSERVED load factor.
+
+    The historical bound was ``min(S, 128)`` — a table-size constant
+    that lets a pathological probe chain run two orders past what a
+    healthy table ever needs.  With adaptive on, the bound follows the
+    load factor ``rows / S`` instead (expected chain length for linear
+    probing grows like ``1 / (1 - load)``; the constants are generous so
+    a healthy table never hits it).  Overshooting is impossible to get
+    wrong: a truncated build reports ``overflow`` and the caller's
+    ``lax.cond`` sort fallback produces the same bits.  Adaptive off
+    keeps the historical constant.
+    """
+    cap = min(int(num_slots), 128)
+    if not _enabled():
+        return cap
+    load = min(float(rows) / float(max(int(num_slots), 1)), 0.99)
+    return max(1, min(cap, 16 + int(32.0 / max(1.0 - load, 1.0 / 32.0))))
+
+
+def bound_probe_rounds(owner, n_build: int):
+    """Probe-side round bound for :func:`relational.hashtable.
+    probe_slot_table`, shared with the build that produced ``owner``.
+
+    With adaptive on this is the table's exact
+    :func:`~spark_rapids_jni_tpu.relational.hashtable.chain_bound` —
+    longest occupied run + 1, computed from the built table itself, so
+    the walk is result-identical to the full-table bound while a
+    clustered table cannot cost ``S`` rounds per probe.  Adaptive off
+    returns ``None`` (the historical full-table bound).
+    """
+    if not _enabled():
+        return None
+    from ..relational.hashtable import chain_bound
+
+    return chain_bound(owner, n_build)
+
+
 def choose_exchange_capacity(counts=None, metrics: Optional[dict] = None,
                              partitions: int = 8):
     """Per-exchange round capacity via the skew planner.

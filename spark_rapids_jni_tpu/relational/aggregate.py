@@ -230,9 +230,9 @@ def _resolve_groupby_engine(engine):
         engine = _config.get("groupby_engine")
     if engine == "auto":
         return "scatter" if jax.default_backend() == "cpu" else "sort"
-    if engine not in ("sort", "scatter"):
+    if engine not in ("sort", "scatter", "pallas"):
         raise ValueError(f"unknown groupby engine {engine!r} "
-                         "(use 'auto', 'sort', or 'scatter')")
+                         "(use 'auto', 'sort', 'scatter', or 'pallas')")
     return engine
 
 
@@ -258,8 +258,11 @@ def group_by(
     sort to the back as one trailing pseudo-run that the group count and
     end positions simply never reach.
 
-    ``engine``: ``'sort' | 'scatter' | 'auto'`` (default: the
-    ``groupby_engine`` knob).  The scatter engine's slot table holds
+    ``engine``: ``'sort' | 'scatter' | 'pallas' | 'auto'`` (default: the
+    ``groupby_engine`` knob; ``'pallas'`` is the scatter engine with the
+    slot table built by the fused VMEM kernel, bit-identical and
+    interpret-mode-safe off-accelerator).  The scatter engine's slot
+    table holds
     ``num_slots`` distinct keys (power of two, default 4096, clamped to
     2n); data with more distinct keys falls back to the sort engine at
     runtime inside the same jitted program, so the hint only costs
@@ -275,8 +278,13 @@ def group_by(
     no group order.  Implies the sort engine: with no sort left to skip,
     the scatter engine has nothing to offer.
     """
-    if not assume_grouped and _resolve_groupby_engine(engine) == "scatter":
-        return _group_by_hash(batch, key_names, aggs, row_valid, num_slots)
+    eng = _resolve_groupby_engine(engine)
+    if not assume_grouped and eng in ("scatter", "pallas"):
+        # 'pallas' is the scatter engine with the slot table built by the
+        # fused VMEM kernel (ops.pallas_kernels) — bit-identical product,
+        # so everything downstream of the table is shared
+        return _group_by_hash(batch, key_names, aggs, row_valid, num_slots,
+                              "pallas" if eng == "pallas" else "lax")
     return _group_by_sortscan(batch, key_names, aggs, row_valid,
                               assume_grouped)
 
@@ -505,15 +513,19 @@ def _group_by_sortscan(batch, key_names, aggs, row_valid, assume_grouped):
 _DEFAULT_GROUP_SLOTS = 4096
 
 
-def _group_by_hash(batch, key_names, aggs, row_valid, num_slots):
+def _group_by_hash(batch, key_names, aggs, row_valid, num_slots,
+                   table_engine: str = "lax"):
     """The scatter engine: slot-table key mapping + segment reductions.
 
     Same contract, semantics, and group order as the sort engine — the
     only rounding difference is float sums/means (scatter-add order vs
     segmented-scan order; Spark itself is order-nondeterministic there).
     Slot-table overflow falls back to the sort engine via ``lax.cond``.
+    ``table_engine`` picks the slot-table implementation (``'lax'`` or
+    the fused ``'pallas'`` kernel — bit-identical either way).
     """
     from . import hashtable as H
+    from ..plan import adaptive as _adaptive
 
     n = batch.num_rows
     batch = _materialize_agg_values(batch, aggs)
@@ -525,9 +537,11 @@ def _group_by_hash(batch, key_names, aggs, row_valid, num_slots):
                     else int(num_slots))
     S = min(S, H.next_pow2(2 * n))
     # a spuriously long probe chain only costs a fallback to the sort
-    # engine, so the round bound stays far below the table size
+    # engine, so the round bound stays far below the table size — the
+    # adaptive layer tightens it further from the observed load factor
     owner, slot, overflow = H.build_slot_table(
-        karr, row_live, S, max_rounds=min(S, 128))
+        karr, row_live, S, max_rounds=_adaptive.bound_build_rounds(n, S),
+        engine=table_engine)
 
     def scat(_):
         return _scatter_groups(batch, key_names, aggs, karr, row_live,

@@ -65,7 +65,8 @@ def fold_hash(words):
     return h ^ (h >> jnp.uint32(16))
 
 
-def build_slot_table(words, live, num_slots: int, max_rounds=None):
+def build_slot_table(words, live, num_slots: int, max_rounds=None,
+                     engine: str = "lax"):
     """Insert rows keyed by ``words`` into an open-addressed slot table.
 
     ``words``: uint32[n] arrays (radix key words, :mod:`keys`);
@@ -83,7 +84,15 @@ def build_slot_table(words, live, num_slots: int, max_rounds=None):
       within ``max_rounds`` (more distinct keys than slots, or a probe
       chain past the round bound); the table is then NOT a complete
       key map and callers must fall back.
+
+    ``engine='pallas'`` runs the fused VMEM-resident kernel
+    (:func:`ops.pallas_kernels.slot_table_build`) — bit-identical
+    product, interpret mode off-accelerator.
     """
+    if engine == "pallas":
+        from ..ops.pallas_kernels import slot_table_build
+
+        return slot_table_build(words, live, num_slots, max_rounds)
     n = words[0].shape[0]
     S = int(num_slots)
     if S & (S - 1):
@@ -119,7 +128,8 @@ def build_slot_table(words, live, num_slots: int, max_rounds=None):
     return owner, slot, jnp.any(active)
 
 
-def probe_slot_table(owner, build_words, probe_words, live):
+def probe_slot_table(owner, build_words, probe_words, live, max_rounds=None,
+                     engine: str = "lax"):
     """Look probe rows' keys up in a built slot table.
 
     ``owner``: int32[S] from :func:`build_slot_table` (sentinel = number
@@ -127,19 +137,35 @@ def probe_slot_table(owner, build_words, probe_words, live):
     word sequences for the build and probe sides; ``live``: bool[m]
     probe rows to look up.
 
+    ``max_rounds`` bounds the chain walk; ``None`` keeps the historical
+    full-table bound ``S``.  Any bound that covers the table's longest
+    occupied run (:func:`chain_bound` computes the exact one) yields
+    identical results — the bound only gates termination, so callers can
+    stop a pathological chain from walking the whole table.
+
     Returns ``(found, slot)``: bool[m] and int32[m] (slot is ``S`` for
     misses and dead rows).
+
+    ``engine='pallas'`` runs the fused VMEM-resident chain walk
+    (:func:`ops.pallas_kernels.slot_table_probe`) — bit-identical.
     """
+    if engine == "pallas":
+        from ..ops.pallas_kernels import slot_table_probe
+
+        return slot_table_probe(owner, build_words, probe_words, live,
+                                max_rounds)
     S = owner.shape[0]
     n = build_words[0].shape[0]
     sentinel = jnp.int32(n)
     imask = jnp.int32(S - 1)
     cand0 = (fold_hash(probe_words) & jnp.uint32(S - 1)).astype(jnp.int32)
     m = probe_words[0].shape[0]
+    if max_rounds is None:
+        max_rounds = S
 
     def cond(state):
         rnd, _cand, _slot, _found, active = state
-        return (rnd < S) & jnp.any(active)
+        return (rnd < max_rounds) & jnp.any(active)
 
     def body(state):
         rnd, cand, slot, found, active = state
@@ -161,3 +187,27 @@ def probe_slot_table(owner, build_words, probe_words, live):
              jnp.zeros((m,), jnp.bool_), live.astype(jnp.bool_))
     _, _, slot, found, _ = jax.lax.while_loop(cond, body, state)
     return found, slot
+
+
+def chain_bound(owner, n_build: int):
+    """Exact probe-round bound for a built table: longest circular run
+    of occupied slots, plus the empty slot that ends the walk.
+
+    A probe walks occupied slots until a match or the first empty slot,
+    so no chain — hit or miss — can be longer than the longest occupied
+    run + 1.  Using this as ``probe_slot_table(max_rounds=...)`` is
+    therefore result-identical to the full-table bound while keeping a
+    pathological (clustered) table from costing ``S`` rounds per probe.
+    Returns a traced int32 in ``[1, S]`` (``S`` when the table has no
+    empty slot).
+    """
+    S = owner.shape[0]
+    occ = owner != jnp.int32(n_build)
+    # unroll the circle once so a run wrapping the table boundary is
+    # seen contiguously; cap at S (a full table has no terminating slot)
+    occ2 = jnp.concatenate([occ, occ])
+    idx = jnp.arange(2 * S, dtype=jnp.int32)
+    last_empty = jax.lax.cummax(jnp.where(occ2, jnp.int32(-1), idx))
+    run = jnp.where(occ2, idx - last_empty, 0)
+    longest = jnp.minimum(jnp.max(run), jnp.int32(S))
+    return jnp.clip(longest + 1, 1, S)

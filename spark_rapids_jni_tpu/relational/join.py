@@ -62,18 +62,20 @@ def _resolve_join_engine(engine):
         engine = _config.get("join_engine")
     if engine == "auto":
         return "hash" if jax.default_backend() == "cpu" else "sort"
-    if engine not in ("sort", "hash"):
+    if engine not in ("sort", "hash", "pallas"):
         raise ValueError(f"unknown join engine {engine!r} "
-                         "(use 'auto', 'sort', or 'hash')")
+                         "(use 'auto', 'sort', 'hash', or 'pallas')")
     return engine
 
 
-def _hash_build(rkeys, nr):
+def _hash_build(rkeys, nr, table_engine: str = "lax"):
     """Hash-engine build product over the build side's radix words.
 
     Returns the flat tuple ``(owner, rslot, rperm, counts_slot,
     off_slot, *rkeys)`` — the same shape :func:`hash_join` accepts as a
-    ``prebuilt`` when ``engine='hash'``:
+    ``prebuilt`` when ``engine='hash'`` (the ``'pallas'`` engine builds
+    a bit-identical tuple through the fused kernel, so the two tags are
+    interchangeable on the probe side):
 
     * ``owner`` int32[S] — slot table (S = 2x the build rows rounded up
       to a power of two: load factor <= 1/2, so insertion always
@@ -91,7 +93,7 @@ def _hash_build(rkeys, nr):
     S = H.next_pow2(2 * nr)
     iota_r = jnp.arange(nr, dtype=jnp.int32)
     owner, rslot, _ = H.build_slot_table(
-        rkeys, jnp.ones((nr,), jnp.bool_), S)
+        rkeys, jnp.ones((nr,), jnp.bool_), S, engine=table_engine)
     counts_slot = jax.ops.segment_sum(
         jnp.ones((nr,), jnp.int32), rslot, num_segments=S + 1)
     off_slot = jnp.cumsum(counts_slot) - counts_slot
@@ -171,8 +173,11 @@ def hash_join(
     dead left rows produce no output (not even for left/anti joins, where
     Spark WOULD keep a live null-keyed row).
 
-    ``engine``: ``'sort' | 'hash' | 'auto'`` (default: the
-    ``join_engine`` knob).  Both engines produce bit-identical live
+    ``engine``: ``'sort' | 'hash' | 'pallas' | 'auto'`` (default: the
+    ``join_engine`` knob; ``'pallas'`` is the hash engine with the slot
+    table built and probed by the fused VMEM kernels in
+    :mod:`ops.pallas_kernels` — interpret mode off-accelerator, same
+    bits).  All engines produce bit-identical live
     rows; see the module docstring for when each wins.
 
     ``prebuilt`` skips the build: either a raw build product tuple —
@@ -271,9 +276,11 @@ def hash_join(
     # a probe row's matches are rperm[lo .. lo+counts), enumerated in
     # original right-row order.
     rkeys = None
-    if engine == "hash":
+    if engine in ("hash", "pallas"):
         from . import hashtable as H
+        from ..plan import adaptive as _adaptive
 
+        table_engine = "pallas" if engine == "pallas" else "lax"
         if prebuilt is not None:
             owner, rslot, rperm = prebuilt[0], prebuilt[1], prebuilt[2]
             counts_slot, off_slot = prebuilt[3], prebuilt[4]
@@ -281,10 +288,13 @@ def hash_join(
         else:
             rkeys = K.batch_radix_keys(rcols, equality=True,
                                        nulls_first=False)
-            built = _hash_build(rkeys, nr)
+            built = _hash_build(rkeys, nr, table_engine)
             owner, rslot, rperm, counts_slot, off_slot = built[:5]
         probe_live = ~l_null & l_live
-        found, lslot = H.probe_slot_table(owner, rkeys, lkeys, probe_live)
+        found, lslot = H.probe_slot_table(
+            owner, rkeys, lkeys, probe_live,
+            max_rounds=_adaptive.bound_probe_rounds(owner, nr),
+            engine=table_engine)
         counts = jnp.where(found, jnp.take(counts_slot, lslot),
                            jnp.int32(0))
         lo = jnp.take(off_slot, lslot)
@@ -343,7 +353,7 @@ def hash_join(
     if how == "full":
         r_live = (jnp.ones((nr,), jnp.bool_) if right_valid is None
                   else right_valid.astype(jnp.bool_))
-        if engine == "hash":
+        if engine in ("hash", "pallas"):
             # a right row is matched iff some live non-null probe row
             # FOUND its slot: scatter-OR the probe hits over the slot
             # table, then read each build row's slot back.  (Misses and
@@ -620,8 +630,9 @@ def spillable_build_table(right: ColumnBatch, right_on: Sequence[str],
         # pinned engine, else the knob at (re)build time
         eng = _resolve_join_engine(engine)
         rkeys = K.batch_radix_keys(rcols, equality=True, nulls_first=False)
-        if eng == "hash":
-            return eng, _hash_build(rkeys, nr)
+        if eng in ("hash", "pallas"):
+            return eng, _hash_build(rkeys, nr,
+                                    "pallas" if eng == "pallas" else "lax")
         iota_r = jnp.arange(nr, dtype=jnp.int32)
         return eng, tuple(jax.lax.sort(
             tuple(rkeys) + (iota_r,), num_keys=len(rkeys), is_stable=True))

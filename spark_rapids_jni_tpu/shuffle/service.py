@@ -50,7 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec
 
-from .. import faultinj
+from .. import config, faultinj
 from ..columnar.column import ColumnBatch
 from ..columnar.encoded import (
     DictionaryColumn,
@@ -230,8 +230,25 @@ def _chunk_init_step(mesh, axis_name, capacity):
     return jax.jit(step)
 
 
+def _resolve_scatter_engine(engine=None):
+    """``engine=None`` reads the ``shuffle_scatter_engine`` knob.
+
+    ``auto`` is ``lax`` on every platform for now: per PALLAS_MEMO's
+    delete-or-measure rule the fused kernel stays opt-in until a real
+    hardware round records it faster than the XLA formulation.
+    """
+    if engine is None:
+        engine = config.get("shuffle_scatter_engine")
+    if engine == "auto":
+        return "lax"
+    if engine not in ("lax", "pallas"):
+        raise ValueError(f"unknown shuffle scatter engine {engine!r} "
+                         "(use 'auto', 'lax', or 'pallas')")
+    return engine
+
+
 @lru_cache(maxsize=None)
-def _scatter_step(mesh, axis_name, capacity):
+def _scatter_step(mesh, axis_name, capacity, engine="lax"):
     """Scatter one mapped morsel into round ``r``'s send chunk.
 
     Bucket ``(s, d)``'s rows occupy GLOBAL slots ``base[s,d] ..
@@ -244,6 +261,10 @@ def _scatter_step(mesh, axis_name, capacity):
     chunk's lineage rebuild can safely re-apply every recorded
     contribution.  The round index and base matrix are traced, so one
     compiled program serves the whole stream.
+
+    ``engine='pallas'`` routes the per-device body through the fused
+    radix partition scatter kernel (:func:`ops.pallas_kernels.
+    partition_scatter`) — same ``t`` map, bit-identical chunks.
     """
     P = mesh.shape[axis_name]
     C = capacity
@@ -258,6 +279,15 @@ def _scatter_step(mesh, axis_name, capacity):
         s = jax.lax.axis_index(axis_name)
         cnts = m_counts.reshape(-1)[:P]
         my_base = base[s]
+        if engine == "pallas":
+            from ..ops.pallas_kernels import partition_scatter
+
+            ch_leaves, treedef = jax.tree_util.tree_flatten(chunk)
+            mo_leaves = jax.tree_util.tree_flatten(morsel)[0]
+            new_leaves, new_occ = partition_scatter(
+                ch_leaves, occv, mo_leaves, cnts.astype(jnp.int32),
+                my_base.astype(jnp.int32), r, P, C)
+            return jax.tree_util.tree_unflatten(treedef, new_leaves), new_occ
         M = morsel.num_rows
         ends = jnp.cumsum(cnts)
         offs = ends - cnts
@@ -636,7 +666,7 @@ class ShuffleService:
         spill_base = _spill_snapshot()
         store = store_mod.get_store() if store_key is not None else None
         C = plan_stream_capacity(round_rows=round_rows)
-        scatter = _scatter_step(mesh, axis, C)
+        scatter = _scatter_step(mesh, axis, C, _resolve_scatter_engine())
         init = _chunk_init_step(mesh, axis, C)
         drain = _stream_drain_step(mesh, axis, C)
         recovered = [0]

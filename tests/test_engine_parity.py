@@ -175,6 +175,29 @@ class TestJoinEngineParity:
         _, cs, _, ch = both_engines(left, right, ["k"], ["k"], "inner", 16)
         assert int(cs) == int(ch) and int(cs) > 16
 
+    @pytest.mark.parametrize("skew", SKEWS)
+    def test_pallas_engine_all_hows(self, skew):
+        """Three-way agreement: the pallas engine (fused VMEM slot-table
+        build + probe) against BOTH lax formulations, every join type."""
+        left, right = make_sides(120, 48, skew)
+        for how in HOWS:
+            rs, cs, rh, ch = both_engines(left, right, ["k"], ["k"], how,
+                                          6000)
+            rp, cp = hash_join(left, right, ["k"], ["k"], how,
+                               capacity=6000, engine="pallas")
+            assert_batches_match(f"pallas/{skew}/{how}/sort", rs, rp, cs, cp)
+            assert_batches_match(f"pallas/{skew}/{how}/hash", rh, rp, ch, cp)
+
+    def test_pallas_engine_knob_dispatch(self):
+        left, right = make_sides(100, 40, "uniform", seed=23)
+        rh, ch = hash_join(left, right, ["k"], ["k"], "inner",
+                           capacity=3000, engine="hash")
+        config.set("join_engine", "pallas")
+        rp, cp = hash_join(left, right, ["k"], ["k"], "inner",
+                           capacity=3000)
+        config.reset()
+        assert_batches_match("pallas/knob", rh, rp, ch, cp)
+
     def test_hash_engine_single_trace_under_jit(self):
         traces = {"n": 0}
 
@@ -214,6 +237,27 @@ class TestSpillableBuildTableEngine:
             assert tbl.engine == "hash"
             assert tbl.rebuilds == 1
             assert_batches_match("spillable-rebuild", rs, rh, cs, ch)
+        finally:
+            tbl.close()
+
+    def test_rebuild_honors_pallas_knob(self):
+        """Same contract for the pallas tier: a dropped table rebuilds
+        under join_engine='pallas' and the probe follows the handle."""
+        left, right = make_sides(100, 32, "uniform", seed=9)
+        config.set("join_engine", "hash")
+        tbl = spillable_build_table(right, ["k"])
+        try:
+            assert tbl.engine == "hash"
+            rh, ch = hash_join(left, right, ["k"], ["k"], "inner",
+                               capacity=2000, prebuilt=tbl)
+            config.set("join_engine", "pallas")
+            tbl.spill()
+            assert tbl.tier == "dropped"
+            rp, cp = hash_join(left, right, ["k"], ["k"], "inner",
+                               capacity=2000, prebuilt=tbl)
+            assert tbl.engine == "pallas"
+            assert tbl.rebuilds == 1
+            assert_batches_match("spillable-pallas", rh, rp, ch, cp)
         finally:
             tbl.close()
 
@@ -259,6 +303,18 @@ class TestGroupByEngineParity:
         ra, na, rb, nb = both_groupby(batch, ["k"], ALL_AGGS)
         assert_batches_match(f"gb/{skew}", ra, rb, na, nb,
                              approx=FLOAT_APPROX)
+
+    @pytest.mark.parametrize("skew", SKEWS)
+    def test_pallas_rows(self, skew):
+        """pallas x sort x scatter three-way agreement; scatter and
+        pallas share everything downstream of the slot table, so those
+        two must agree to the last padding bit, no approx."""
+        batch = make_groupby_batch(500, skew)
+        ra, na, rb, nb = both_groupby(batch, ["k"], ALL_AGGS)
+        rp, np_ = group_by(batch, ["k"], ALL_AGGS, engine="pallas")
+        assert_batches_match(f"gbp/{skew}/sort", ra, rp, na, np_,
+                             approx=FLOAT_APPROX)
+        assert_batches_match(f"gbp/{skew}/scatter", rb, rp, nb, np_)
 
     def test_float_keys_normalized(self):
         # -0.0 and 0.0 one group; every NaN one group; nulls one group
@@ -360,15 +416,20 @@ class TestQ95PlansAgree:
         res0, ng0 = jax.jit(ge._q95_step)(fact, dim1, dim2)
         g0 = self._groups(res0, ng0)
         plans = {"auto": g0}
-        for knob in ("sort", "scatter"):
+        for knob in ("sort", "scatter", "pallas"):
             config.set("groupby_engine", knob)
+            if knob == "pallas":
+                # the acceptance bar: the WHOLE query runs with both
+                # engine knobs pinned to the pallas tier
+                config.set("join_engine", "pallas")
             try:
                 res, ng = jax.jit(
                     lambda f, a, b: ge._q95_step(f, a, b))(fact, dim1, dim2)
                 plans[knob] = self._groups(res, ng)
             finally:
                 config.reset()
-        assert plans["auto"] == plans["sort"] == plans["scatter"]
+        assert (plans["auto"] == plans["sort"] == plans["scatter"]
+                == plans["pallas"])
         # numpy ground truth: q95's dim joins hit unique keys, so the
         # whole query reduces to a seg-keyed count/sum over the fact rows
         seg = np.asarray(fact["seg"].data)

@@ -897,6 +897,71 @@ class TestGL012:
 
 
 # ---------------------------------------------------------------------------
+# GL013 — pallas_call without interpret threading
+# ---------------------------------------------------------------------------
+
+
+class TestGL013:
+    def test_missing_interpret_flagged(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            from jax.experimental import pallas as pl
+
+            def call(x):
+                return pl.pallas_call(_kern, out_shape=x)(x)
+        """}, rules=["GL013"])
+        assert new_rules(res) == [("GL013", "mod.py")]
+
+    def test_constant_false_and_none_flagged(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            from jax.experimental import pallas as pl
+
+            def pinned(x):
+                return pl.pallas_call(_kern, out_shape=x,
+                                      interpret=False)(x)
+
+            def looks_threaded(x):
+                return pl.pallas_call(_kern, out_shape=x,
+                                      interpret=None)(x)
+        """}, rules=["GL013"])
+        assert [f.rule for f in res.new] == ["GL013", "GL013"]
+
+    def test_threaded_and_resolved_clean(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import jax.experimental.pallas as pl
+
+            def threaded(x, interpret):
+                return pl.pallas_call(_kern, out_shape=x,
+                                      interpret=interpret)(x)
+
+            def resolved(x, interpret=None):
+                return pl.pallas_call(_kern, out_shape=x,
+                                      interpret=_auto_interpret(interpret))(x)
+
+            def debug_harness(x):
+                # explicit True: an interpret-everywhere test harness
+                return pl.pallas_call(_kern, out_shape=x,
+                                      interpret=True)(x)
+
+            def forwarded(x, **kw):
+                # **kwargs may carry interpret; opaque to the AST
+                return pl.pallas_call(_kern, out_shape=x, **kw)(x)
+
+            def other_pallas(x, pl2):
+                return pl2.pallas_call(_kern)(x)  # unknown receiver
+        """}, rules=["GL013"])
+        assert res.new == []
+
+    def test_suppression_comment(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            from jax.experimental import pallas as pl
+
+            def call(x):
+                return pl.pallas_call(_kern, out_shape=x)(x)  # graftlint: disable=GL013
+        """}, rules=["GL013"])
+        assert res.new == [] and res.counts()["suppressed"] == 1
+
+
+# ---------------------------------------------------------------------------
 # baseline ratchet
 # ---------------------------------------------------------------------------
 
@@ -1011,4 +1076,5 @@ class TestLiveTree:
         from tools.graftlint import rules as rules_mod
         ids = [r.id for r in rules_mod.all_rules()]
         assert ids == ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
-                       "GL007", "GL008", "GL009", "GL010", "GL011", "GL012"]
+                       "GL007", "GL008", "GL009", "GL010", "GL011", "GL012",
+                       "GL013"]

@@ -1,0 +1,429 @@
+"""Parity suite for the Pallas relational kernels.
+
+Every kernel in ops/pallas_kernels.py that backs an engine knob must be
+BIT-IDENTICAL to the lax formulation it twins — same owner/slot/overflow
+for the slot-table build, same found/slot for the probe, same
+chunk/occupancy for the radix partition scatter — across key skews,
+float key edge cases (-0.0/NaN words), nulls, empty inputs, truncated
+round bounds, and the overflow -> sort fallback.  All of it runs under
+Pallas interpret mode on the CPU CI platform (GL013 enforces the
+threading); the engines may only diverge in speed, never in bits.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_rapids_jni_tpu import config
+from spark_rapids_jni_tpu.columnar import types as T
+from spark_rapids_jni_tpu.columnar.column import Column, ColumnBatch
+from spark_rapids_jni_tpu.ops import pallas_kernels as PK
+from spark_rapids_jni_tpu.relational import AggSpec, group_by, hash_join
+from spark_rapids_jni_tpu.relational import hashtable as H
+from spark_rapids_jni_tpu.relational import keys as K
+
+P8 = 8
+
+
+@pytest.fixture(autouse=True)
+def _reset_config():
+    yield
+    config.reset()
+
+
+def _skew_keys(skew, n, rng):
+    """int key vectors the slot table sees in production."""
+    if skew == "alldistinct":
+        return rng.permutation(n).astype(np.int64)
+    if skew == "allequal":
+        return np.full(n, 7, np.int64)
+    # zipf: heavy head, long tail — mixed chain lengths in one table
+    z = rng.zipf(1.3, size=n).astype(np.int64)
+    return np.clip(z, 0, 1 << 20)
+
+
+def _words(keys_i64, live=None):
+    """uint32 key words via the production lowering (single int64 col)."""
+    a = jnp.asarray(np.asarray(keys_i64, np.int64))
+    v = (jnp.ones((a.shape[0],), jnp.bool_) if live is None
+         else jnp.asarray(live, jnp.bool_))
+    col = Column(a, v, T.INT64)
+    return K.batch_radix_keys([col], equality=True, nulls_first=True), v
+
+
+def _build_both(words, live, S, max_rounds=None):
+    lax_out = H.build_slot_table(words, live, S, max_rounds=max_rounds,
+                                 engine="lax")
+    pls_out = H.build_slot_table(words, live, S, max_rounds=max_rounds,
+                                 engine="pallas")
+    return lax_out, pls_out
+
+
+def _assert_build_identical(lax_out, pls_out):
+    for a, b, nm in zip(lax_out, pls_out, ("owner", "slot", "overflow")):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), nm
+
+
+SKEWS = ("zipf", "allequal", "alldistinct")
+
+
+class TestSlotBuildParity:
+    @pytest.mark.parametrize("skew", SKEWS)
+    def test_skews(self, skew, rng):
+        words, live = _words(_skew_keys(skew, 2000, rng))
+        lax_out, pls_out = _build_both(words, live, 4096)
+        _assert_build_identical(lax_out, pls_out)
+        assert not bool(lax_out[2])  # healthy table, no overflow
+
+    @pytest.mark.parametrize("skew", SKEWS)
+    def test_dead_rows_excluded(self, skew, rng):
+        keys = _skew_keys(skew, 500, rng)
+        live = rng.random(500) < 0.7
+        words, lv = _words(keys, live)
+        lax_out, pls_out = _build_both(words, lv, 1024)
+        _assert_build_identical(lax_out, pls_out)
+        # dead rows never placed: their slot is the S sentinel
+        assert (np.asarray(lax_out[1])[~live] == 1024).all()
+
+    def test_empty_input(self):
+        words, live = _words(np.zeros(0, np.int64))
+        lax_out, pls_out = _build_both(words, live, 64)
+        _assert_build_identical(lax_out, pls_out)
+        assert (np.asarray(lax_out[0]) == 0).all()  # sentinel n == 0
+
+    def test_overflow_reported_identically(self, rng):
+        # 64 distinct keys cannot fit an 8-slot table: both engines must
+        # report overflow AND agree on the partial placement bits
+        words, live = _words(rng.permutation(64).astype(np.int64))
+        lax_out, pls_out = _build_both(words, live, 8)
+        _assert_build_identical(lax_out, pls_out)
+        assert bool(lax_out[2]) and bool(pls_out[2])
+
+    @pytest.mark.parametrize("mr", [1, 4, 64])
+    def test_truncated_max_rounds(self, mr, rng):
+        words, live = _words(_skew_keys("zipf", 1000, rng))
+        lax_out, pls_out = _build_both(words, live, 256, max_rounds=mr)
+        _assert_build_identical(lax_out, pls_out)
+
+    def test_multiword_keys(self, rng):
+        # composite (int64, float64) key: 2 null flags + 2 + 2 words
+        n = 600
+        k1 = jnp.asarray(rng.integers(0, 50, n), jnp.int64)
+        k2 = jnp.asarray(rng.integers(0, 7, n).astype(np.float64))
+        ones = jnp.ones((n,), jnp.bool_)
+        words = K.batch_radix_keys(
+            [Column(k1, ones, T.INT64), Column(k2, ones, T.FLOAT64)],
+            equality=True, nulls_first=True)
+        lax_out, pls_out = _build_both(words, ones, 1024)
+        _assert_build_identical(lax_out, pls_out)
+
+    def test_oversize_table_falls_back_to_lax(self, rng):
+        # past the VMEM byte budget the pallas path must bow out to the
+        # lax build rather than emit an unlowerable kernel
+        S = PK._SLOT_TABLE_MAX_BYTES  # S*(8+4W) > budget for any W
+        S = 1 << (int(S).bit_length())
+        words, live = _words(_skew_keys("zipf", 100, rng))
+        lax_out, pls_out = _build_both(words, live, S)
+        _assert_build_identical(lax_out, pls_out)
+
+
+class TestSlotProbeParity:
+    def _built(self, rng, skew="zipf", n=1500, S=4096):
+        keys = _skew_keys(skew, n, rng)
+        words, live = _words(keys)
+        owner, slot, ovf = H.build_slot_table(words, live, S)
+        assert not bool(ovf)
+        return keys, words, owner
+
+    @pytest.mark.parametrize("skew", SKEWS)
+    def test_hit_and_miss_probes(self, skew, rng):
+        keys, bwords, owner = self._built(rng, skew)
+        # half present keys, half guaranteed misses (outside key range)
+        probe = np.concatenate([keys[:400], np.arange(2 << 20, (2 << 20) + 400)])
+        pwords, plive = _words(probe)
+        lax_out = H.probe_slot_table(owner, bwords, pwords, plive,
+                                     engine="lax")
+        pls_out = H.probe_slot_table(owner, bwords, pwords, plive,
+                                     engine="pallas")
+        for a, b, nm in zip(lax_out, pls_out, ("found", "slot")):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), nm
+        assert np.asarray(lax_out[0])[:400].all()
+        assert not np.asarray(lax_out[0])[400:].any()
+
+    def test_dead_probe_rows_never_found(self, rng):
+        keys, bwords, owner = self._built(rng)
+        plive = rng.random(len(keys)) < 0.5
+        pwords, lv = _words(keys, plive)
+        lax_out = H.probe_slot_table(owner, bwords, pwords, lv, engine="lax")
+        pls_out = H.probe_slot_table(owner, bwords, pwords, lv,
+                                     engine="pallas")
+        for a, b in zip(lax_out, pls_out):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+        assert not np.asarray(lax_out[0])[~plive].any()
+
+    def test_chain_bound_rounds_result_identical(self, rng):
+        keys, bwords, owner = self._built(rng)
+        pwords, plive = _words(keys)
+        nb = len(keys)
+        full = H.probe_slot_table(owner, bwords, pwords, plive,
+                                  engine="pallas")
+        bounded = H.probe_slot_table(owner, bwords, pwords, plive,
+                                     max_rounds=H.chain_bound(owner, nb),
+                                     engine="pallas")
+        for a, b in zip(full, bounded):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_empty_probe_side(self, rng):
+        _, bwords, owner = self._built(rng)
+        pwords, plive = _words(np.zeros(0, np.int64))
+        for eng in ("lax", "pallas"):
+            found, slot = H.probe_slot_table(owner, bwords, pwords, plive,
+                                             engine=eng)
+            assert found.shape == (0,) and slot.shape == (0,)
+
+
+class TestFloatKeyWords:
+    """-0.0/0.0 normalize to ONE key in the equality domain, NaNs
+    canonicalize to one NaN, and null rows form one group — through both
+    engines, bit-for-bit."""
+
+    def _col(self, vals, valid=None):
+        a = jnp.asarray(np.asarray(vals, np.float64))
+        v = (jnp.ones((a.shape[0],), jnp.bool_) if valid is None
+             else jnp.asarray(valid, jnp.bool_))
+        return Column(a, v, T.FLOAT64)
+
+    def test_negzero_nan_null_words(self):
+        vals = [-0.0, 0.0, np.nan, -np.nan, 1.5, -1.5, np.inf, -np.inf,
+                0.0, np.nan]
+        valid = [True] * 8 + [False, False]
+        col = self._col(vals, valid)
+        words = K.batch_radix_keys([col], equality=True, nulls_first=True)
+        live = jnp.asarray([True] * 10)
+        lax_out, pls_out = _build_both(words, live, 64)
+        _assert_build_identical(lax_out, pls_out)
+        slot = np.asarray(lax_out[1])
+        assert slot[0] == slot[1]  # -0.0 and 0.0: one group
+        assert slot[2] == slot[3]  # both NaN bit patterns: one group
+        assert slot[8] == slot[9]  # null rows: one group
+        assert len({slot[0], slot[2], slot[4], slot[8]}) == 4
+
+    def test_float_probe_parity(self, rng):
+        build = self._col([-0.0, np.nan, 2.5, -2.5, np.inf])
+        probe = self._col([0.0, -np.nan, 2.5, 7.0, np.inf])
+        bwords = K.batch_radix_keys([build], equality=True, nulls_first=True)
+        pwords = K.batch_radix_keys([probe], equality=True, nulls_first=True)
+        blive = jnp.ones((5,), jnp.bool_)
+        owner, _, ovf = H.build_slot_table(bwords, blive, 16)
+        assert not bool(ovf)
+        outs = [H.probe_slot_table(owner, bwords, pwords, blive, engine=e)
+                for e in ("lax", "pallas")]
+        for a, b in zip(*outs):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+        found = np.asarray(outs[0][0])
+        assert found[:3].all()  # 0.0 hits -0.0, -NaN hits NaN, 2.5 exact
+        assert not found[3] and found[4]
+
+
+class TestEngineDispatch:
+    def _batch(self, keys, vals):
+        n = len(keys)
+        ones = jnp.ones((n,), jnp.bool_)
+        return ColumnBatch({
+            "k": Column(jnp.asarray(np.asarray(keys, np.int64)), ones,
+                        T.INT64),
+            "v": Column(jnp.asarray(np.asarray(vals, np.float64)), ones,
+                        T.FLOAT64)})
+
+    def test_group_by_pallas_engine_and_knob(self, rng):
+        keys = _skew_keys("zipf", 1200, rng)
+        vals = rng.random(1200)
+        b = self._batch(keys, vals)
+        aggs = [AggSpec("sum", "v", "s"), AggSpec("count", None, "c")]
+        rs, gs = group_by(b, ["k"], aggs, engine="scatter")
+        rp, gp = group_by(b, ["k"], aggs, engine="pallas")
+        assert int(gs) == int(gp)
+        for name in rs.names:
+            assert np.array_equal(np.asarray(rs[name].data),
+                                  np.asarray(rp[name].data)), name
+            assert np.array_equal(np.asarray(rs[name].validity),
+                                  np.asarray(rp[name].validity)), name
+        config.set("groupby_engine", "pallas")
+        rk, gk = group_by(b, ["k"], aggs)
+        assert int(gk) == int(gp)
+        for name in rp.names:
+            assert np.array_equal(np.asarray(rp[name].data),
+                                  np.asarray(rk[name].data)), name
+
+    def test_group_by_overflow_falls_back_in_trace(self, rng):
+        # more distinct keys than slots: the lax.cond sort fallback fires
+        # inside the SAME jitted program for both table engines
+        keys = rng.permutation(256).astype(np.int64)
+        b = self._batch(keys, np.ones(256))
+        aggs = [AggSpec("sum", "v", "s")]
+        rs, gs = group_by(b, ["k"], aggs, engine="scatter", num_slots=16)
+        rp, gp = group_by(b, ["k"], aggs, engine="pallas", num_slots=16)
+        assert int(gs) == int(gp) == 256
+        for name in rs.names:
+            assert np.array_equal(np.asarray(rs[name].data),
+                                  np.asarray(rp[name].data)), name
+
+    @pytest.mark.parametrize("how", ["inner", "left", "full", "semi",
+                                     "anti"])
+    def test_hash_join_pallas_engine(self, how, rng):
+        lk = rng.integers(0, 40, 300)
+        rk = rng.integers(20, 60, 200)
+        left = self._batch(lk, rng.random(300))
+        right = ColumnBatch({
+            "k": Column(jnp.asarray(np.asarray(rk, np.int64)),
+                        jnp.ones((200,), jnp.bool_), T.INT64),
+            "w": Column(jnp.asarray(rng.random(200)),
+                        jnp.ones((200,), jnp.bool_), T.FLOAT64)})
+        bh, ch = hash_join(left, right, ["k"], ["k"], how=how,
+                           engine="hash")
+        bp, cp = hash_join(left, right, ["k"], ["k"], how=how,
+                           engine="pallas")
+        assert int(ch) == int(cp)
+        assert bh.num_rows == bp.num_rows
+        for name in bh.names:
+            assert np.array_equal(np.asarray(bh[name].data),
+                                  np.asarray(bp[name].data)), name
+            assert np.array_equal(np.asarray(bh[name].validity),
+                                  np.asarray(bp[name].validity)), name
+
+    def test_unknown_engines_rejected(self):
+        b = self._batch([1, 2], [0.5, 0.5])
+        with pytest.raises(ValueError):
+            group_by(b, ["k"], [AggSpec("count", None, "c")],
+                     engine="mosaic")
+        from spark_rapids_jni_tpu.shuffle.service import \
+            _resolve_scatter_engine
+        with pytest.raises(ValueError):
+            _resolve_scatter_engine("mosaic")
+        assert _resolve_scatter_engine("auto") == "lax"
+        config.set("shuffle_scatter_engine", "pallas")
+        assert _resolve_scatter_engine() == "pallas"
+
+
+class TestSingleTrace:
+    def test_build_probe_compile_once_per_shape(self, rng):
+        words, live = _words(_skew_keys("zipf", 1000, rng))
+        owner, _, _ = H.build_slot_table(words, live, 1024, engine="pallas")
+        before_b = PK._slot_build_call._cache_size()
+        before_p = PK._slot_probe_call._cache_size()
+        for seed in (1, 2, 3):
+            w2, l2 = _words(_skew_keys("zipf", 1000,
+                                       np.random.default_rng(seed)))
+            H.build_slot_table(w2, l2, 1024, engine="pallas")
+            H.probe_slot_table(owner, words, w2, l2, engine="pallas")
+        assert PK._slot_build_call._cache_size() - before_b <= 1
+        assert PK._slot_probe_call._cache_size() - before_p <= 1
+
+
+class TestChainBound:
+    def _brute(self, occ):
+        """longest circular occupied run + 1, by walking."""
+        S = len(occ)
+        if not occ.any():
+            return 1
+        if occ.all():
+            return S
+        best = 0
+        run = 0
+        for i in range(2 * S):
+            if occ[i % S]:
+                run += 1
+                best = max(best, run)
+            else:
+                run = 0
+        return min(best + 1, S)
+
+    @pytest.mark.parametrize("fill", [0.0, 0.3, 0.7, 0.95, 1.0])
+    def test_matches_brute_force(self, fill, rng):
+        S, n = 64, 1000
+        occ = rng.random(S) < fill
+        owner = np.where(occ, rng.integers(0, n, S), n).astype(np.int32)
+        got = int(H.chain_bound(jnp.asarray(owner), n))
+        assert got == self._brute(occ)
+        assert 1 <= got <= S
+
+    def test_wraparound_run(self):
+        owner = np.array([5, 7, 1 << 20, 1 << 20, 1 << 20, 3, 9, 2],
+                        np.int32)
+        # occupied: slots 0,1,5,6,7 -> circular run 5..1 has length 5
+        got = int(H.chain_bound(jnp.asarray(owner), 1 << 20))
+        assert got == 6
+
+
+class TestPartitionScatter:
+    def _lax_ref(self, chunk, occv, morsel, cnts, base, r, P, C):
+        M = morsel[0].shape[0]
+        ends = jnp.cumsum(cnts)
+        offs = ends - cnts
+        i = jnp.arange(M, dtype=jnp.int32)
+        d = jnp.searchsorted(ends, i, side="right").astype(jnp.int32)
+        d_c = jnp.minimum(d, P - 1)
+        k = jnp.take(base, d_c) + (i - jnp.take(offs, d_c))
+        in_round = (d < P) & (k >= r * C) & (k < (r + 1) * C)
+        t = jnp.where(in_round, d_c * C + (k - r * C), P * C)
+        new_chunk = tuple(acc.at[t].set(x, mode="drop")
+                          for acc, x in zip(chunk, morsel))
+        return new_chunk, occv.at[t].set(True, mode="drop")
+
+    @pytest.mark.parametrize("rnd", [0, 1, 3])
+    def test_parity_with_lax_formulation(self, rnd, rng):
+        P, C, M = 8, 16, 96
+        parts = rng.integers(0, P + 1, M)  # P == null-partition rows
+        cnts = jnp.asarray(np.bincount(np.minimum(parts, P - 1),
+                                       minlength=P), jnp.int32)
+        base = jnp.asarray(rng.integers(0, 24, P), jnp.int32)
+        occ = jnp.zeros((P * C,), jnp.bool_)
+        chunk = (jnp.zeros((P * C,), jnp.int64),
+                 jnp.zeros((P * C,), jnp.float32))
+        morsel = (jnp.asarray(rng.integers(0, 1 << 30, M), jnp.int64),
+                  jnp.asarray(rng.random(M), jnp.float32))
+        r = jnp.int32(rnd)
+        ref_c, ref_o = self._lax_ref(chunk, occ, morsel, cnts, base, r,
+                                     P, C)
+        got_c, got_o = PK.partition_scatter(list(chunk), occ, list(morsel),
+                                            cnts, base, r, P, C)
+        assert np.array_equal(np.asarray(ref_o), np.asarray(got_o))
+        for a, b in zip(ref_c, got_c):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_exchange_stream_engines_bit_identical(self, eight_devices):
+        from spark_rapids_jni_tpu.parallel import data_mesh, shard_batch
+        from spark_rapids_jni_tpu.shuffle import (MorselSource,
+                                                  ShuffleRegistry,
+                                                  ShuffleService)
+
+        mesh = data_mesh(P8)
+        n = P8 * 256
+        rng = np.random.default_rng(11)
+        ones = jnp.ones((n,), jnp.bool_)
+        batch = shard_batch(ColumnBatch({
+            "k": Column(jnp.asarray(rng.integers(0, 1 << 20, n)), ones,
+                        T.INT64),
+            "v": Column(jnp.asarray(np.arange(n, dtype=np.int64)), ones,
+                        T.INT64)}), mesh)
+
+        def run(engine):
+            config.set("shuffle_capacity_bucket", 16)
+            config.set("shuffle_scatter_engine", engine)
+            svc = ShuffleService(mesh, registry=ShuffleRegistry())
+            src = MorselSource.from_batch(batch, mesh, morsel_rows=64)
+            res = svc.exchange_stream(list(src), key_names=["k"],
+                                      round_rows=16)
+            return res, tuple(
+                np.asarray(jax.device_get(x))
+                for x in (res.batch["k"].data, res.batch["v"].data,
+                          res.occupancy))
+
+        r_lax, o_lax = run("lax")
+        r_pls, o_pls = run("pallas")
+        assert r_lax.rounds == r_pls.rounds >= 2
+        assert r_lax.capacity == r_pls.capacity
+        assert r_lax.rows_moved == r_pls.rows_moved == n
+        for a, b, nm in zip(o_lax, o_pls, ("k", "v", "occ")):
+            assert np.array_equal(a, b), nm

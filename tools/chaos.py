@@ -23,7 +23,12 @@ wave (dataplane: result batches crossing the worker boundary as Arrow
 IPC segments, torn after their CRC stamps or announced under a dead
 fence generation — the supervisor's epoch-then-CRC verify must detect
 and re-place, bit-identically) — one fault per trial exhaustively,
-plus ``chaos_trials`` seeded multi-fault trials per scenario.  Every trial must end with
+plus ``chaos_trials`` seeded multi-fault trials per scenario.  The q95
+and streaming_scan matrices additionally repeat their seam trials with
+the engine knobs pinned to the pallas device-kernel tier (``+pallas``
+labels — groupby/join slot-table kernels, fused shuffle scatter): the
+digest check against the default-engine baseline makes each of those a
+bit-identity proof for the fused kernels under fire.  Every trial must end with
 
 * a result **bit-identical** to the scenario's fault-free baseline
   (sha256 over every output leaf's dtype/shape/bytes), and
@@ -1032,6 +1037,18 @@ class Trial:
     # the self-fence path (revoked its own epoch and exited), not merely
     # that the wave survived
     expect_self_fenced: bool = False
+    # engine knobs pinned for the trial (r14: the pallas device-kernel
+    # tier under fire).  The digest is still compared against the
+    # scenario's DEFAULT-engine fault-free baseline, so a pinned trial
+    # asserts engine bit-identity and fault recovery in one check.
+    engines: Optional[Dict[str, str]] = None
+
+
+# the pallas tier pins: both relational knobs for the compute-shaped
+# q95, plus the fused shuffle scatter for the streaming pipeline
+_PALLAS_Q95 = {"groupby_engine": "pallas", "join_engine": "pallas"}
+_PALLAS_STREAM = {"groupby_engine": "pallas", "join_engine": "pallas",
+                  "shuffle_scatter_engine": "pallas"}
 
 
 def single_fault_trials(fast: bool = False) -> List[Trial]:
@@ -1041,14 +1058,16 @@ def single_fault_trials(fast: bool = False) -> List[Trial]:
     occurrences (second file written, second round drained)."""
     t: List[Trial] = []
 
-    def one(scenario, match, kind, skip=0, count=1, expect_recovered=False):
+    def one(scenario, match, kind, skip=0, count=1, expect_recovered=False,
+            engines=None):
         rule = {"match": match, "fault": kind, "count": count}
         if skip:
             rule["skip"] = skip
-        label = f"{scenario}:{match}[{kind}"
-        label += f"+skip{skip}]" if skip else "]"
-        t.append(Trial(scenario, [rule], label,
-                       expect_recovered=expect_recovered))
+        tag = kind + (f"+skip{skip}" if skip else "")
+        if engines:
+            tag += "+pallas"
+        t.append(Trial(scenario, [rule], f"{scenario}:{match}[{tag}]",
+                       expect_recovered=expect_recovered, engines=engines))
 
     # spill scenario: step seam + the full disk boundary set
     for kind in ("exception", "oom", "fatal"):
@@ -1078,10 +1097,14 @@ def single_fault_trials(fast: bool = False) -> List[Trial]:
         one("shuffle", "spill_io_read", "spill_io", expect_recovered=True)
         one("shuffle", "spill_io_write", "spill_io")
 
-    # q95 scenario: the compute seam
+    # q95 scenario: the compute seam — each kind once on the default
+    # engines and once with both relational knobs pinned to the pallas
+    # tier (the fused slot-table kernels must replay bit-identical to
+    # the default-engine baseline through aborts and retries)
     if not fast:
         for kind in ("exception", "oom", "fatal"):
             one("q95", "chaos_q95_step", kind)
+            one("q95", "chaos_q95_step", kind, engines=_PALLAS_Q95)
 
     # streaming scan: every fault kind lands mid-morsel (the decode
     # seam), on the early-drain transport, and on a half-received round
@@ -1103,6 +1126,15 @@ def single_fault_trials(fast: bool = False) -> List[Trial]:
         skip=40, expect_recovered=True)
     one("streaming_scan", "host_corrupt_probe", "host_corrupt",
         skip=8, expect_recovered=True)
+    # the pallas tier under fire: the fused scatter (plus both
+    # relational knobs) pinned while faults land on the same seams.
+    # The digest check runs against the default-engine baseline, so
+    # every one of these doubles as a bit-identity assertion.  The
+    # occurrence-pinned corruption variants stay on the default engines
+    # (their skip counts encode the default demotion order); the pallas
+    # ones fire on first crossings, which are engine-independent.
+    one("streaming_scan", "chaos_stream_morsel", "exception",
+        engines=_PALLAS_STREAM)
     if not fast:
         one("streaming_scan", "chaos_stream_morsel", "exception", skip=2)
         one("streaming_scan", "shuffle_io_round", "oom")
@@ -1113,6 +1145,15 @@ def single_fault_trials(fast: bool = False) -> List[Trial]:
         one("streaming_scan", "spill_io_write", "spill_io")
         one("streaming_scan", "spill_io_read", "spill_io",
             expect_recovered=True)
+        for kind in ("oom", "fatal"):
+            one("streaming_scan", "chaos_stream_morsel", kind,
+                engines=_PALLAS_STREAM)
+        one("streaming_scan", "shuffle_io_round", "shuffle_io",
+            engines=_PALLAS_STREAM)
+        one("streaming_scan", "spill_corrupt_file", "spill_corrupt",
+            engines=_PALLAS_STREAM)
+        one("streaming_scan", "host_corrupt_probe", "host_corrupt",
+            engines=_PALLAS_STREAM)
 
     # sort scenario: the distributed-sort seam (pre-plan and post-sort)
     if not fast:
@@ -1314,6 +1355,25 @@ def multi_fault_trials(seed: int, per_scenario: int) -> List[Trial]:
 # the campaign
 # ---------------------------------------------------------------------------
 
+@contextlib.contextmanager
+def _pinned_engines(engines: Optional[Dict[str, str]]):
+    """Pin engine knobs for one trial, restoring the previous values on
+    the way out.  Pinned trials are still digest-compared against the
+    scenario's DEFAULT-engine fault-free baseline, so the comparison
+    doubles as the engine bit-identity assertion under fire."""
+    if not engines:
+        yield
+        return
+    saved = {k: config.get(k) for k in engines}
+    try:
+        for k, v in engines.items():
+            config.set(k, v)
+        yield
+    finally:
+        for k, v in saved.items():
+            config.set(k, v)
+
+
 def _run_with_replacement(scenario) -> Dict:
     """Run a scenario to completion under the active fault schedule:
     recoverable kinds resolve inside run(); exception/fatal abort the
@@ -1358,8 +1418,11 @@ def run_campaign(fast: bool = False, seed: int = 0,
     for trial in matrix:
         sc = SCENARIOS[trial.scenario]
         rec = {"label": trial.label, "rules": trial.rules}
+        if trial.engines:
+            rec["engines"] = trial.engines
         try:
-            with faultinj.scope({"seed": seed, "faults": trial.rules}):
+            with _pinned_engines(trial.engines), \
+                    faultinj.scope({"seed": seed, "faults": trial.rules}):
                 out = _run_with_replacement(sc)
                 fired = faultinj.fired_log()
             rec["attempts"] = out["attempts"]

@@ -1,4 +1,4 @@
-"""The ten graftlint rules.  Each encodes a bug this repo shipped or is
+"""The graftlint rules.  Each encodes a bug this repo shipped or is
 structurally exposed to; see tools/graftlint/README.md for the full
 rationale with the motivating incident per rule."""
 
@@ -1333,6 +1333,58 @@ class GL012FrontDoorHandleLeak(Rule):
                         "is unobservable")
 
 
+# ---------------------------------------------------------------------------
+# GL013 — pallas_call without interpret threading
+# ---------------------------------------------------------------------------
+
+
+class GL013PallasInterpretDrift(Rule):
+    """Every production Pallas kernel in this tree runs on the CPU CI
+    platform ONLY because its ``pl.pallas_call`` resolves ``interpret``
+    through ``ops.pallas_kernels._auto_interpret`` (True off-accelerator,
+    False on TPU).  A ``pallas_call`` with no ``interpret`` kwarg
+    compiles for the Mosaic backend unconditionally and aborts the whole
+    CPU test suite at trace time; ``interpret=False`` pins the same
+    fate; ``interpret=None`` silently means False — the worst of the
+    three, since it LOOKS threaded.  Flags every ``pallas_call`` whose
+    ``interpret`` keyword is missing or a ``False``/``None`` constant.
+    ``interpret=True`` (a test or debug harness that wants interpret
+    everywhere), a threaded name (``interpret=interpret``) and a
+    resolving call (``interpret=_auto_interpret(interpret)``) all
+    pass."""
+
+    id = "GL013"
+
+    def check(self, pf: ParsedFile) -> Iterable[Finding]:
+        aliases = module_aliases(pf.tree)
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = resolve(node.func, aliases)
+            if dotted is None or not dotted.endswith(".pallas_call"):
+                continue
+            if dotted.split(".", 1)[0] != "jax":
+                continue
+            kw = next((k for k in node.keywords if k.arg == "interpret"),
+                      None)
+            if kw is None:
+                if any(k.arg is None for k in node.keywords):
+                    continue  # **kwargs may carry it; can't see inside
+                yield pf.finding(
+                    self.id, node,
+                    "`pallas_call` without an `interpret` kwarg compiles "
+                    "for the accelerator backend unconditionally — thread "
+                    "`interpret=_auto_interpret(interpret)` so the kernel "
+                    "runs on the CPU CI platform")
+            elif (isinstance(kw.value, ast.Constant)
+                    and kw.value.value in (False, None)):
+                yield pf.finding(
+                    self.id, kw.value,
+                    f"`interpret={kw.value.value}` pins the accelerator "
+                    "backend — resolve it through `_auto_interpret` (or "
+                    "thread the caller's kwarg) instead of a constant")
+
+
 _ALL: List[Rule] = [GL001TracerLeak(), GL002HostSyncUnderJit(),
                     GL003RetraceHazard(), GL004SpillHandleLeak(),
                     GL005ConfigDrift(), GL006FaultKindDrift(),
@@ -1340,7 +1392,8 @@ _ALL: List[Rule] = [GL001TracerLeak(), GL002HostSyncUnderJit(),
                     GL009LateMaterializationBreach(),
                     GL010ShardingConstraintDrift(),
                     GL011ServeSessionLeak(),
-                    GL012FrontDoorHandleLeak()]
+                    GL012FrontDoorHandleLeak(),
+                    GL013PallasInterpretDrift()]
 
 
 def all_rules(only: Optional[Sequence[str]] = None) -> List[Rule]:
