@@ -41,6 +41,14 @@ row's ``vs_baseline`` rides its own only-shrinks floor (ci/q95_floor.json).
 streaming scan on the fused partition scatter, plus q95 with both
 relational engine knobs pinned to pallas — every row parity-asserted
 against its lax/default-engine twin before the rate is reported.
+
+``python bench.py --compress`` runs the encoded q95-shape exchange twice
+through the same ShuffleService — ``shuffle_compress=off`` then ``pack``
+— asserting bit-identical delivered rows; its ``vs_baseline`` is the
+wire-byte ratio bytes_moved_off / bytes_moved_pack (only-shrinks floor
+``shuffle_compress_floor`` in ci/q95_floor.json), and a second
+``spill_codec_roundtrip`` micro row round-trips representative spill
+payloads through the mem/codec frames.
 """
 
 import json
@@ -1026,6 +1034,170 @@ def shuffle_main():
         "shuffle_spilled_bytes": snap["spilled_bytes"],
         "shuffle_dropped_rows": snap["dropped_rows"],
         "shuffle_io_failures": snap["io_failures"],
+    }), flush=True)
+    return 0
+
+
+# --------------------------------------------------------------------------
+# compress scenario (--compress): packed wire rounds + codec'd spill frames
+# --------------------------------------------------------------------------
+
+def compress_main():
+    """Compressed-execution evidence, both seams in one child.
+
+    The q95-shaped exchange batch (narrow-range int64 keys, int32
+    quantities, bool flags, f32 prices — the shapes the pack planner is
+    built for) runs twice through the same ShuffleService:
+    ``shuffle_compress=off`` then ``pack``, delivered rows compared
+    column for column.  ``vs_baseline`` is the wire-byte ratio
+    bytes_moved_off / bytes_moved_pack (only-shrinks
+    ``shuffle_compress_floor`` in ci/q95_floor.json) — an HONEST ratio,
+    since ``bytes_moved`` already reflects the packed grid.  The second
+    row round-trips representative spill payloads through the mem/codec
+    frames (``pack`` on narrow ints/bools, ``block`` on repetitive
+    bytes), asserting bit-exact decode before reporting the rate."""
+    if os.environ.get("BENCH_FORCE_CPU"):
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+
+    import jax
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        platform = jax.devices()[0].platform
+    except Exception as e:  # backend init failure → parent falls back
+        print(f"# backend init failed: {e}", file=sys.stderr, flush=True)
+        return 17
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spark_rapids_jni_tpu import config
+    from spark_rapids_jni_tpu.columnar import types as T
+    from spark_rapids_jni_tpu.columnar.column import Column, ColumnBatch
+    from spark_rapids_jni_tpu.columnar.encoded import materialize_batch
+    from spark_rapids_jni_tpu.mem import codec as spill_codec
+    from spark_rapids_jni_tpu.parallel import data_mesh, shard_batch
+    from spark_rapids_jni_tpu.shuffle import ShuffleService, get_registry
+
+    P = len(jax.devices())
+    mesh = data_mesh(P)
+    n_rows = int(os.environ.get("BENCH_COMPRESS_ROWS", str(1 << 15)))
+    n_rows -= n_rows % P
+    rng = np.random.default_rng(23)
+
+    def col(a, t):
+        a = np.asarray(a)
+        return Column(jnp.asarray(a), jnp.ones((len(a),), jnp.bool_), t)
+
+    batch = shard_batch(ColumnBatch({
+        "k": col(rng.integers(0, 1000, n_rows).astype(np.int64), T.INT64),
+        "qty": col(rng.integers(-50, 50, n_rows).astype(np.int32),
+                   T.INT32),
+        "flag": col(rng.integers(0, 2, n_rows).astype(bool), T.BOOLEAN),
+        "price": col(rng.standard_normal(n_rows).astype(np.float32),
+                     T.FLOAT32)}), mesh)
+    svc = ShuffleService(mesh)
+    reg = get_registry()
+    reg.reset()
+
+    def digest(res):
+        b = materialize_batch(res.batch)
+        occ = np.asarray(jax.device_get(res.occupancy))
+        return [np.asarray(jax.device_get(b[n].data))[occ]
+                for n in b.names]
+
+    def run_mode(mode):
+        config.set("shuffle_compress", mode)
+        try:
+            svc.exchange(batch, key_names=("k",))  # warm the jit cache
+            t0 = time.perf_counter()
+            res = svc.exchange(batch, key_names=("k",))
+            jax.block_until_ready(res.occupancy)
+            return res, time.perf_counter() - t0
+        finally:
+            config.reset("shuffle_compress")
+
+    failures = []
+    try:
+        r_off, _dt_off = run_mode("off")
+        r_pack, dt_pack = run_mode("pack")
+        bit_identical = all(
+            a.dtype == b.dtype and a.shape == b.shape and bool((a == b).all())
+            for a, b in zip(digest(r_off), digest(r_pack)))
+        if not bit_identical:
+            failures.append("packed exchange diverged from the raw wire")
+        if r_pack.rows_moved != n_rows or r_off.rows_moved != n_rows:
+            failures.append("rows_moved lost rows "
+                            f"(off={r_off.rows_moved} "
+                            f"pack={r_pack.rows_moved})")
+        if r_pack.compressed_bytes_saved <= 0:
+            failures.append("pack mode saved no wire bytes")
+    except Exception as e:
+        failures.append(repr(e))
+    if failures:
+        print(f"# compress scenario failed: {failures}", file=sys.stderr,
+              flush=True)
+        return 1
+    ratio = r_off.bytes_moved / max(r_pack.bytes_moved, 1)
+    print(json.dumps({
+        "metric": "shuffle_compressed_throughput",
+        "value": round(n_rows / dt_pack / 1e6, 2),
+        "unit": "Mrows/s",
+        "vs_baseline": round(ratio, 2),
+        "platform": platform,
+        "rows": n_rows,
+        "devices": P,
+        "note": {
+            "mode": "pack",
+            "bytes_moved": int(r_pack.bytes_moved),
+            "bytes_moved_off": int(r_off.bytes_moved),
+            "bytes_saved": int(r_pack.compressed_bytes_saved),
+            "ratio": round(ratio, 2),
+            "bit_identical": bit_identical,
+        },
+    }), flush=True)
+
+    # spill-codec micro: the two frame codecs on the payload shapes the
+    # disk tier actually sees (narrow-range ints + bools → pack;
+    # repetitive bytes → block), bit-exact decode asserted in-row
+    payloads = [
+        ("pack", rng.integers(0, 4096, 1 << 16).astype(np.int64)),
+        ("pack", rng.integers(0, 2, 1 << 16).astype(bool)),
+        ("block", np.repeat(
+            rng.integers(0, 8, 1 << 10), 64).astype(np.int64)),
+    ]
+    orig_bytes = stored_bytes = 0
+    roundtrip_ok = True
+    t0 = time.perf_counter()
+    for codec, arr in payloads:
+        frame = spill_codec.encode_block(arr, codec)
+        back = spill_codec.decode_block(frame)
+        roundtrip_ok &= (back.dtype == arr.dtype
+                         and bool(np.array_equal(back, arr)))
+        orig_bytes += arr.nbytes
+        stored_bytes += frame.nbytes
+    dt_codec = time.perf_counter() - t0
+    if not roundtrip_ok:
+        print("# compress scenario failed: codec round-trip diverged",
+              file=sys.stderr, flush=True)
+        return 1
+    codec_ratio = orig_bytes / max(stored_bytes, 1)
+    print(json.dumps({
+        "metric": "spill_codec_roundtrip",
+        "value": round(orig_bytes / dt_codec / 1e6, 2),
+        "unit": "MB/s",
+        "vs_baseline": round(codec_ratio, 2),
+        "platform": platform,
+        "note": {
+            "orig_bytes": int(orig_bytes),
+            "compressed_bytes": int(stored_bytes),
+            "codec_ratio": round(codec_ratio, 2),
+            "bit_identical": roundtrip_ok,
+        },
     }), flush=True)
     return 0
 
@@ -2376,6 +2548,8 @@ def main():
         sys.exit(plan_main())
     if mode == "--child-scan":
         sys.exit(scan_main())
+    if mode == "--child-compress":
+        sys.exit(compress_main())
     if mode == "--child-multidevice":
         sys.exit(multidevice_main())
     if mode == "--probe":
@@ -2387,6 +2561,7 @@ def main():
     run_shuffle = mode == "--shuffle"
     run_plan = mode == "--plan"
     run_scan = mode == "--scan"
+    run_compress = mode == "--compress"
     run_multidevice = mode == "--multidevice"
     child_mode = ("--child-micro" if run_micro
                   else "--child-spill" if run_spill
@@ -2394,6 +2569,7 @@ def main():
                   else "--child-shuffle" if run_shuffle
                   else "--child-plan" if run_plan
                   else "--child-scan" if run_scan
+                  else "--child-compress" if run_compress
                   else "--child-multidevice" if run_multidevice
                   else "--child")
     t0 = time.monotonic()
@@ -2439,6 +2615,7 @@ def main():
                   else "shuffle_skew_outofcore" if run_shuffle
                   else "q6_ir_throughput" if run_plan
                   else "scan_stream_throughput" if run_scan
+                  else "shuffle_compressed_throughput" if run_compress
                   else "multidevice_shuffle_throughput" if run_multidevice
                   else "q6_pipeline_throughput")
         print(json.dumps({

@@ -38,6 +38,12 @@ done
 # every row parity-asserted before its rate is reported
 BENCH_FORCE_CPU=1 python bench.py --multidevice \
   | tee /tmp/bench_smoke_multidevice.out
+# compressed-execution scenario: the encoded q95-shape exchange with
+# shuffle_compress=pack vs off (bit-identical rows asserted in-child;
+# vs_baseline = wire-byte ratio, floor shuffle_compress_floor) plus the
+# spill-codec frame round-trip micro row
+BENCH_FORCE_CPU=1 BENCH_COMPRESS_ROWS=32768 python bench.py --compress \
+  | tee /tmp/bench_smoke_compress.out
 # the q95 lines must be self-explaining (per-stage note + engines; cache +
 # decisions on the IR rows) and their vs_baseline must not regress below
 # the recorded floors — ratchets in the same only-shrinks spirit as
@@ -47,7 +53,7 @@ BENCH_FORCE_CPU=1 python bench.py --multidevice \
 python ci/check_q95_line.py /tmp/bench_smoke_q6.out \
   /tmp/bench_smoke_plan.out /tmp/bench_smoke_scan.out \
   /tmp/bench_smoke_serve.out /tmp/bench_smoke_pallas.out \
-  /tmp/bench_smoke_multidevice.out
+  /tmp/bench_smoke_multidevice.out /tmp/bench_smoke_compress.out
 # spill scenario: device arena capped below q6's working set; the emitted
 # line carries spill-bytes counters so BENCH_*.json tracks spill overhead
 BENCH_FORCE_CPU=1 BENCH_SPILL_ROWS=65536 python bench.py --spill

@@ -82,6 +82,16 @@ Since r14 the pallas device-kernel rows get the same treatment:
   ``multidevice_q95_throughput`` must exist with ``note.digest_match``
   true and BOTH engine knobs recorded as pallas, riding
   ``multidevice_q95_floor``.
+
+Since r15 the compressed-execution rows (``bench.py --compress``) get
+the same treatment: ``shuffle_compressed_throughput`` must exist, its
+``note.bit_identical`` must be true (the packed exchange delivered the
+same rows as the raw wire) with ``note.bytes_saved > 0``, and its
+``vs_baseline`` — the wire-byte ratio bytes_moved_off /
+bytes_moved_pack — rides ``shuffle_compress_floor`` (1.5, the PR 15
+acceptance bar); ``spill_codec_roundtrip`` must exist with
+``note.bit_identical`` true and ``note.codec_ratio > 1`` (the frames
+actually shrank the payloads they decoded bit-exactly).
 """
 import json
 import os
@@ -120,6 +130,7 @@ def main(paths) -> int:
     pallas_floor = floors["pallas_vs_lax_floor"]
     md_floor = floors["multidevice_vs_lax_floor"]
     md_q95_floor = floors["multidevice_q95_floor"]
+    compress_floor = floors["shuffle_compress_floor"]
     lines = _scan(paths)
     line = lines.get("q95_shape_throughput")
     enc_line = lines.get("q95_shape_encoded_throughput")
@@ -362,6 +373,49 @@ def main(paths) -> int:
                         f"{md_q95.get('vs_baseline')} regressed below "
                         f"the recorded floor {md_q95_floor} "
                         f"(ci/q95_floor.json)")
+    # compressed-execution rows: packed wire must keep bit-parity while
+    # shrinking the all_to_all bytes, and the spill frames must decode
+    # bit-exactly while shrinking the payloads
+    cp_line = lines.get("shuffle_compressed_throughput")
+    if cp_line is None:
+        errs.append("no shuffle_compressed_throughput line: the "
+                    "compressed-shuffle row fell out of the smoke "
+                    "(bench.py compress_main)")
+    else:
+        cp_note = cp_line.get("note")
+        if (not isinstance(cp_note, dict)
+                or cp_note.get("bit_identical") is not True):
+            errs.append("compressed-shuffle line's note.bit_identical is "
+                        "not true: the packed exchange no longer proves "
+                        "it delivered the raw wire's rows "
+                        f"(note={json.dumps(cp_note)})")
+        elif int(cp_note.get("bytes_saved", 0)) <= 0:
+            errs.append("compressed-shuffle line's note.bytes_saved <= 0: "
+                        "the pack plan shipped the raw grid "
+                        f"(note={json.dumps(cp_note)})")
+        if cp_line.get("vs_baseline", 0.0) < compress_floor:
+            errs.append(f"compressed-shuffle vs_baseline "
+                        f"{cp_line.get('vs_baseline')} (wire-byte ratio "
+                        f"off/pack) fell below the recorded floor "
+                        f"{compress_floor} (ci/q95_floor.json): the wire "
+                        f"win the pack step exists for is gone")
+    sc_line = lines.get("spill_codec_roundtrip")
+    if sc_line is None:
+        errs.append("no spill_codec_roundtrip line: the spill-codec "
+                    "micro row fell out of the smoke "
+                    "(bench.py compress_main)")
+    else:
+        sc_note = sc_line.get("note")
+        if (not isinstance(sc_note, dict)
+                or sc_note.get("bit_identical") is not True):
+            errs.append("spill-codec line's note.bit_identical is not "
+                        "true: the frames no longer decode bit-exactly "
+                        f"(note={json.dumps(sc_note)})")
+        elif (float(sc_note.get("codec_ratio", 0.0)) <= 1.0
+                or int(sc_note.get("compressed_bytes", 0)) <= 0):
+            errs.append("spill-codec line's note.codec_ratio <= 1: the "
+                        "frames no longer shrink the payloads "
+                        f"(note={json.dumps(sc_note)})")
     if errs:
         for e in errs:
             print("check_q95_line:", e)
@@ -376,6 +430,9 @@ def main(paths) -> int:
           f"multidevice rows ok (devices "
           f"{(md_line or {}).get('devices')}, rounds "
           f"{(md_line or {}).get('shuffle_rounds')}); "
+          f"compress {(cp_line or {}).get('vs_baseline')} >= floor "
+          f"{compress_floor} (codec ratio "
+          f"{((sc_line or {}).get('note') or {}).get('codec_ratio')}); "
           f"engines {json.dumps((note or {}).get('engines'))})")
     if vs >= 2 * floor and floor > 0:
         print(f"check_q95_line: note — vs_baseline is >=2x the floor; "

@@ -4,11 +4,15 @@ from .column import Column, ColumnBatch, Decimal128Column, StringColumn
 # encoded extends column.AnyColumn in place; import it BEFORE binding
 # AnyColumn here so every downstream importer sees the extended tuple
 from .encoded import (
+    BitPackedColumn,
     DictionaryColumn,
+    FrameOfReferenceColumn,
     RunLengthColumn,
     decode_batch,
     encode_batch,
+    encode_bitpacked,
     encode_column,
+    encode_for,
     encode_rle,
     is_encoded,
     materialize_batch,
@@ -25,11 +29,15 @@ __all__ = [
     "Decimal128Column",
     "StringColumn",
     "BucketedStringColumn",
+    "BitPackedColumn",
     "DictionaryColumn",
+    "FrameOfReferenceColumn",
     "RunLengthColumn",
     "encode_batch",
     "decode_batch",
+    "encode_bitpacked",
     "encode_column",
+    "encode_for",
     "encode_rle",
     "is_encoded",
     "materialize_batch",

@@ -339,7 +339,12 @@ def _values_array_to_column(arr: pa.Array, vkind: str):
 def _export_column(name: str, col):
     """One column -> [(pa.field, pa.Array), ...] (main + companion)."""
     from .column import ListColumn, StructColumn
-    from .encoded import DictionaryColumn, RunLengthColumn
+    from .encoded import PACKED_COLUMNS, DictionaryColumn, RunLengthColumn
+
+    if isinstance(col, PACKED_COLUMNS):
+        # lane streams have no Arrow representation; the wire crossing is
+        # a host boundary anyway, and the receiver re-packs on ingest
+        col = col.decode()
 
     def companion(valid: np.ndarray):
         f = pa.field(f"{name}{_VALIDITY_SUFFIX}", pa.bool_(),

@@ -30,6 +30,18 @@ shuffle round, and u32 code comparisons instead of padded-string compares.
   low-cardinality int columns; validity stays row-level so masks compose.
   Gather decodes RLE (runs do not survive permutation), so RLE columns
   never flow deep into join/shuffle internals.
+* :class:`BitPackedColumn` packs narrow-range ints to
+  ``ceil(log2(range+1))``-bit residuals against one host-static
+  ``reference`` minimum, laid out in u32 lanes (:func:`pack_bits`).
+  ``width``/``reference`` ride the pytree aux like ``dict_token``, so
+  program families specialize on the bit layout at trace time.  Gather
+  stays packed (extract residuals, repack); keys lower to value words by
+  reference+residual arithmetic (relational/keys.py), so packed keys
+  join/group against plain int columns bit-identically.
+* :class:`FrameOfReferenceColumn` subtracts a per-block minimum
+  (``refs[nblocks]``) before bit-packing, absorbing drift in clustered
+  keys (timestamps, ids) that a single global reference cannot.  Blocks
+  do not survive permutation, so gather decodes FoR — the RLE rule.
 
 Late materialization contract: ``decode()`` / ``materialize_*`` are the
 ONLY sanctioned materialization points; graftlint GL009 flags decode
@@ -213,14 +225,228 @@ class RunLengthColumn:
                 f"runs={self.num_runs})")
 
 
+# ---- bit-pack lane math (device side) --------------------------------------
+
+def _pack_mask(width: int) -> np.uint32:
+    # numpy scalar: module-level/jit-free callers must not mint device
+    # arrays (GL001), and inside a trace it folds to a constant
+    return np.uint32((1 << width) - 1) if width < 32 else np.uint32(0xFFFFFFFF)
+
+
+def pack_bits(words, width: int):
+    """uint32[n] residuals -> uint32[ceil(n*width/32)] lanes, in-trace.
+
+    Word ``i`` occupies bits ``[i*width, (i+1)*width)`` little-endian —
+    the exact layout of ``mem.codec.np_pack_bits``, so host and device
+    packed streams are interchangeable.  ``width`` is trace-static.
+    """
+    width = int(width)
+    if not 1 <= width <= 32:
+        raise ValueError(f"pack width must be in [1, 32], got {width}")
+    n = words.shape[0]
+    if width == 32:
+        return words.astype(jnp.uint32)
+    nlanes = max(1, (n * width + 31) // 32)
+    if n == 0:
+        return jnp.zeros((nlanes,), jnp.uint32)
+    pos = jnp.arange(n, dtype=jnp.uint32) * np.uint32(width)
+    lane = (pos >> 5).astype(jnp.int32)
+    off = pos & np.uint32(31)
+    w = words.astype(jnp.uint32) & _pack_mask(width)
+    lanes = jnp.zeros((nlanes,), jnp.uint32)
+    # contributions within one lane occupy disjoint bit ranges, so the
+    # scatter-adds compose like ORs; the straddling high part goes to
+    # lane+1 (mode="drop" discards the last word's nonexistent spill)
+    lanes = lanes.at[lane].add(w << off, mode="drop")
+    straddle = off + np.uint32(width) > np.uint32(32)
+    # clamp the shift where there is no straddle: off=0 would shift by 32
+    hi_shift = jnp.where(straddle, np.uint32(32) - off, np.uint32(31))
+    hi = jnp.where(straddle, w >> hi_shift, np.uint32(0))
+    return lanes.at[lane + 1].add(hi, mode="drop")
+
+
+def unpack_bits(lanes, width: int, n: int):
+    """Inverse of :func:`pack_bits`: lanes -> uint32[n] residuals."""
+    width = int(width)
+    if not 1 <= width <= 32:
+        raise ValueError(f"pack width must be in [1, 32], got {width}")
+    if width == 32:
+        return lanes[:n].astype(jnp.uint32)
+    if n == 0:
+        return jnp.zeros((0,), jnp.uint32)
+    nlanes = lanes.shape[0]
+    pos = jnp.arange(n, dtype=jnp.uint32) * np.uint32(width)
+    lane = (pos >> 5).astype(jnp.int32)
+    off = pos & np.uint32(31)
+    lo = lanes[lane] >> off
+    straddle = off + np.uint32(width) > np.uint32(32)
+    hi_shift = jnp.where(straddle, np.uint32(32) - off, np.uint32(31))
+    hi = jnp.where(
+        straddle, lanes[jnp.clip(lane + 1, 0, nlanes - 1)] << hi_shift,
+        np.uint32(0))
+    return (lo | hi) & _pack_mask(width)
+
+
+def pack_bits_rows(words, width: int):
+    """Per-row pack for 2-D ``[P, n]`` buffers (one packed stream per
+    partition row, so an ``all_to_all`` can still split axis 0)."""
+    import functools
+
+    return jax.vmap(functools.partial(pack_bits, width=width))(words)
+
+
+def unpack_bits_rows(lanes, width: int, n: int):
+    """Inverse of :func:`pack_bits_rows` for ``[P, nlanes]`` buffers."""
+    import functools
+
+    return jax.vmap(
+        functools.partial(unpack_bits, width=width, n=n))(lanes)
+
+
+# widths the shuffle wire packer rounds up to: a handful of buckets keeps
+# the (plan-keyed) compiled drain program cache small while giving up at
+# most 3 bits of the theoretical packing
+_PACK_WIDTH_BUCKETS = (1, 2, 4, 8, 12, 16, 20, 24, 28, 32)
+
+
+def choose_pack_width(lo: int, hi: int):
+    """Bucketed static lane width for values observed in ``[lo, hi]``
+    (after frame-of-reference subtraction of ``lo``), or None when the
+    range needs more than 32 bits.  Shared by the shuffle wire packer and
+    the adaptive planner's pack decisions — both must agree on the width
+    a given observed range lowers to, or the plan cache thrashes."""
+    rng = int(hi) - int(lo)
+    if rng < 0 or rng >= 1 << 32:
+        return None
+    w = max(1, rng.bit_length())
+    for b in _PACK_WIDTH_BUCKETS:
+        if w <= b:
+            return b
+    return None
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class BitPackedColumn:
+    """Bit-packed int column: ``width``-bit residuals against one
+    host-static ``reference`` minimum, in u32 lanes.
+
+    ``reference``/``width`` are static aux (like ``dict_token``): the
+    packed layout is part of the program family.  Nulls pack a zero
+    residual — like the dictionary's borrowed null codes, only valid
+    rows must round-trip.
+    """
+
+    lanes: jax.Array      # uint32 [ceil(n*width/32)]
+    validity: jax.Array   # bool [n]
+    reference: int        # host-static min over valid rows
+    width: int            # 1..32 bits per residual
+    dtype: T.SparkType
+
+    def tree_flatten(self):
+        return (self.lanes, self.validity), (
+            self.reference, self.width, self.dtype)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        lanes, validity = children
+        return cls(lanes, validity, aux[0], aux[1], aux[2])
+
+    @property
+    def num_rows(self) -> int:
+        return self.validity.shape[0]
+
+    def residuals(self) -> jax.Array:
+        """uint32[n] packed residuals (in-trace extraction, not a
+        materialization — value = reference + residual)."""
+        return unpack_bits(self.lanes, self.width, self.num_rows)
+
+    def decode(self) -> Column:
+        """Materialize the plain column (the late-materialization point)."""
+        vals = self.residuals().astype(jnp.int64) + self.reference
+        return Column(vals.astype(self.dtype.jnp_dtype), self.validity,
+                      self.dtype)
+
+    def to_pylist(self) -> list:
+        return self.decode().to_pylist()
+
+    def __repr__(self):
+        return (f"BitPackedColumn({self.dtype!r}, n={self.num_rows}, "
+                f"width={self.width}, ref={self.reference})")
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class FrameOfReferenceColumn:
+    """Frame-of-reference column: per-block minima ``refs[nblocks]``
+    subtracted, residuals bit-packed at a global ``width``.
+
+    ``width``/``block`` are static aux; the block minima stay a device
+    child so clustered keys (timestamps, monotone ids) pack narrow even
+    when the global range is wide.
+    """
+
+    refs: jax.Array       # int64 [ceil(n/block)] per-block minima
+    lanes: jax.Array      # uint32 [ceil(n*width/32)]
+    validity: jax.Array   # bool [n]
+    width: int            # 1..32 bits per residual
+    block: int            # rows per reference block
+    dtype: T.SparkType
+
+    def tree_flatten(self):
+        return (self.refs, self.lanes, self.validity), (
+            self.width, self.block, self.dtype)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        refs, lanes, validity = children
+        return cls(refs, lanes, validity, aux[0], aux[1], aux[2])
+
+    @property
+    def num_rows(self) -> int:
+        return self.validity.shape[0]
+
+    @property
+    def num_blocks(self) -> int:
+        return self.refs.shape[0]
+
+    def residuals(self) -> jax.Array:
+        return unpack_bits(self.lanes, self.width, self.num_rows)
+
+    def values64(self) -> jax.Array:
+        """int64[n] decoded values (in-trace reference+residual
+        arithmetic — the key-lowering entry point)."""
+        n = self.num_rows
+        blk = jnp.arange(n, dtype=jnp.int32) // np.int32(max(self.block, 1))
+        return self.refs[blk] + self.residuals().astype(jnp.int64)
+
+    def decode(self) -> Column:
+        """Materialize the plain column (the late-materialization point)."""
+        return Column(self.values64().astype(self.dtype.jnp_dtype),
+                      self.validity, self.dtype)
+
+    def to_pylist(self) -> list:
+        return self.decode().to_pylist()
+
+    def __repr__(self):
+        return (f"FrameOfReferenceColumn({self.dtype!r}, n={self.num_rows}, "
+                f"width={self.width}, block={self.block}, "
+                f"blocks={self.num_blocks})")
+
+
 # encoded columns join the AnyColumn family (column.py marks the tuple
 # "extended below"; columnar/__init__ imports this module right after
 # column, so every downstream `from columnar.column import AnyColumn`
 # binds the extended tuple)
 _column_mod.AnyColumn = _column_mod.AnyColumn + (
-    DictionaryColumn, RunLengthColumn)
+    DictionaryColumn, RunLengthColumn, BitPackedColumn,
+    FrameOfReferenceColumn)
 
-ENCODED_COLUMNS = (DictionaryColumn, RunLengthColumn)
+ENCODED_COLUMNS = (DictionaryColumn, RunLengthColumn, BitPackedColumn,
+                   FrameOfReferenceColumn)
+
+# the packed pair: trace-static width metadata, u32 lane storage
+PACKED_COLUMNS = (BitPackedColumn, FrameOfReferenceColumn)
 
 
 def is_encoded(col) -> bool:
@@ -317,19 +543,118 @@ def encode_rle(col) -> RunLengthColumn:
                            col.validity, col.dtype)
 
 
+_PACKABLE_KINDS = (T.Kind.INT8, T.Kind.INT16, T.Kind.INT32, T.Kind.INT64,
+                   T.Kind.DATE, T.Kind.TIMESTAMP)
+
+
+def _pack_stats(col):
+    """(data int64, valid, ref, range) over VALID rows (host side)."""
+    data = _host(col.data).astype(np.int64)
+    valid = _host(col.validity).astype(bool)
+    if valid.any():
+        ref = int(data[valid].min())
+        rng = int(data[valid].max()) - ref
+    else:
+        ref, rng = 0, 0
+    return data, valid, ref, rng
+
+
+def encode_bitpacked(col):
+    """Bit-pack an int column (host-side; ingest-time op).
+
+    The reference is the minimum over VALID rows; null rows pack a zero
+    residual (the dictionary's borrowed-null rule — only valid rows must
+    round-trip).  Ranges that need more than 32 residual bits return the
+    column unchanged: the lossless fallback.
+    """
+    if isinstance(col, BitPackedColumn):
+        return col
+    if is_encoded(col):
+        col = col.decode()
+    if not isinstance(col, Column) or col.dtype.kind not in _PACKABLE_KINDS:
+        return col
+    data, valid, ref, rng = _pack_stats(col)
+    if rng >= 1 << 32:
+        return col
+    width = max(1, rng.bit_length())
+    res = np.where(valid, data - ref, 0).astype(np.uint64).astype(np.uint32)
+    return BitPackedColumn(pack_bits(jnp.asarray(res), width), col.validity,
+                           ref, width, col.dtype)
+
+
+def encode_for(col, block: int = 1024):
+    """Frame-of-reference encode an int column (host-side; ingest-time op).
+
+    Per-``block`` minima absorb drift, so clustered wide-range keys
+    (timestamps, monotone ids) still pack narrow; the residual width is
+    global (trace-static).  Any block whose residual range exceeds 32
+    bits returns the column unchanged (lossless fallback).
+    """
+    if isinstance(col, FrameOfReferenceColumn):
+        return col
+    if is_encoded(col):
+        col = col.decode()
+    if not isinstance(col, Column) or col.dtype.kind not in _PACKABLE_KINDS:
+        return col
+    block = max(int(block), 1)
+    data, valid, _, _ = _pack_stats(col)
+    n = data.shape[0]
+    nblocks = max(1, -(-n // block))
+    pad = nblocks * block - n
+    d2 = np.pad(data, (0, pad)).reshape(nblocks, block)
+    v2 = np.pad(valid, (0, pad)).reshape(nblocks, block)
+    # per-block min over valid rows; dead blocks reference 0
+    big = np.where(v2, d2, np.iinfo(np.int64).max)
+    refs = np.where(v2.any(axis=1), big.min(axis=1), 0)
+    res2 = np.where(v2, d2 - refs[:, None], 0)
+    rng = int(res2.max()) if n else 0
+    if rng >= 1 << 32:
+        return col
+    width = max(1, rng.bit_length())
+    res = res2.reshape(-1)[:n].astype(np.uint64).astype(np.uint32)
+    return FrameOfReferenceColumn(jnp.asarray(refs),
+                                  pack_bits(jnp.asarray(res), width),
+                                  col.validity, width, block, col.dtype)
+
+
+def gather_bitpacked(col: BitPackedColumn, idx, valid=None):
+    """Row gather that STAYS packed: extract residuals, take, repack.
+
+    The global reference survives any permutation (unlike FoR blocks),
+    so compaction/join outputs keep the packed form — the gather-side
+    half of late materialization.
+    """
+    res = col.residuals()
+    v = col.validity[idx]
+    if valid is not None:
+        v = v & valid
+    return dataclasses.replace(col, lanes=pack_bits(res[idx], col.width),
+                               validity=v)
+
+
 def encode_batch(batch: ColumnBatch, dictionary: Optional[Sequence[str]] = None,
-                 rle: Sequence[str] = (), max_card_frac: float = 0.5
+                 rle: Sequence[str] = (), max_card_frac: float = 0.5,
+                 bitpack: Sequence[str] = (), frame_of_reference: Sequence[str] = ()
                  ) -> ColumnBatch:
     """Encode a batch's columns (host boundary).
 
     ``dictionary=None`` auto-picks: every string column, plus fixed-width
     columns whose distinct-value count is below ``max_card_frac`` of the
-    rows.  ``rle`` names columns to run-length-encode instead.
+    rows.  ``rle`` names columns to run-length-encode instead;
+    ``bitpack`` / ``frame_of_reference`` name int columns for the packed
+    encodings (explicit, like ``rle`` — the adaptive planner picks them
+    from observed key ranges, see plan/adaptive.py).
     """
     out = {}
     for name, col in zip(batch.names, batch.columns):
         if name in rle:
             out[name] = encode_rle(col)
+            continue
+        if name in bitpack:
+            out[name] = encode_bitpacked(col)
+            continue
+        if name in frame_of_reference:
+            out[name] = encode_for(col)
             continue
         if dictionary is not None:
             out[name] = encode_column(col) if name in dictionary else col

@@ -346,6 +346,22 @@ _register("serve_segment_bytes", 1 << 20, int,
           "detection resolution) and the frames plane caps each binary "
           "data frame at this size so control messages interleave "
           "instead of queueing behind a monolithic payload frame.")
+_register("shuffle_compress", "auto", str,
+          "Pack columnar leaves before the all_to_all collective "
+          "(shuffle/service.py): 'pack' bit-packs bool/dictionary-code "
+          "leaves and frame-of-reference-packs int leaves into u32 lane "
+          "words per round chunk (unpacked at the sanctioned reassembly "
+          "seam), 'auto' packs only the cheap always-wins leaves "
+          "(codes + bools), 'off' ships plain words.  Saved bytes are "
+          "visible per-exchange as ShuffleMetrics.compressed_bytes_saved.")
+_register("spill_codec", "off", str,
+          "Codec for the spill framework's disk tier and the persistent "
+          "shuffle store (mem/spill.py, shuffle/store.py): 'pack' "
+          "frame-of-reference bit-packs eligible int leaves, 'block' runs "
+          "a byte-wise RLE block codec over any leaf, 'off' writes raw "
+          "npy.  CRCs are recorded over the STORED (compressed) bytes; "
+          "a damaged frame fails loudly into the same quarantine + "
+          "lineage-rebuild path as raw-leaf corruption.")
 
 
 def get(key: str):

@@ -70,6 +70,7 @@ import contextlib
 import itertools
 import os
 import shutil
+import struct
 import tempfile
 import threading
 import time
@@ -79,6 +80,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from .. import faultinj
+from . import codec as _codec
 from .rmm_spark import CpuRetryOOM, CpuSplitAndRetryOOM, RmmSpark
 
 # monotonic use-clock for LRU ordering (itertools.count is atomic under
@@ -143,6 +145,34 @@ def _flip_file_bytes(path: str, n: int = 8) -> None:
         f.write(bytes(b ^ 0xFF for b in tail))
 
 
+def _flip_file_head_bytes(path: str, n: int = 8) -> None:
+    """XOR the first ``n`` bytes of the npy PAYLOAD region of ``path``.
+
+    Under a spill codec the payload starts with the codec frame header
+    (magic / dtype / shape), so this models the damage shape
+    ``decode_block`` must reject loudly — complementing the data-region
+    tail damage of :func:`_flip_file_bytes` that only a checksum catches.
+    """
+    with open(path, "r+b") as f:
+        head = f.read(12)
+        if len(head) < 12 or head[:6] != b"\x93NUMPY":
+            start = 0  # not an npy container: damage the very front
+        elif head[6] >= 2:
+            (hlen,) = struct.unpack_from("<I", head, 8)
+            start = 12 + hlen
+        else:
+            (hlen,) = struct.unpack_from("<H", head, 8)
+            start = 10 + hlen
+        f.seek(0, os.SEEK_END)
+        n = min(n, max(f.tell() - start, 0))
+        if n <= 0:
+            return
+        f.seek(start)
+        chunk = f.read(n)
+        f.seek(start)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+
+
 def _leaf_meta(arr: np.ndarray) -> Tuple[int, int]:
     """(crc32, nbytes) of a host leaf, computed from the in-memory array
     — the authoritative content — before it is entrusted to disk."""
@@ -167,6 +197,8 @@ class SpillMetrics:
         "disk_write_failures",
         "corrupt_reads",       # read-backs that failed verification/load
         "lineage_rebuilds",    # recoveries via a handle's recompute= hook
+        "precompress_bytes",   # original bytes of codec'd disk writes
+        "compressed_bytes",    # stored bytes of those writes (post-codec)
     )
 
     def __init__(self):
@@ -208,9 +240,22 @@ class SpillMetrics:
             for b in self._bucket(task_id):
                 b["lineage_rebuilds"] += 1
 
+    def record_compressed(self, orig_bytes: int, stored_bytes: int,
+                          task_id: Optional[int] = None):
+        with self._lock:
+            for b in self._bucket(task_id):
+                b["precompress_bytes"] += int(orig_bytes)
+                b["compressed_bytes"] += int(stored_bytes)
+
     def snapshot(self) -> Dict[str, int]:
         with self._lock:
-            return dict(self._global)
+            out = dict(self._global)
+        # derived: how much smaller codec'd disk writes were than their
+        # original leaves (1.0 when the codec never engaged)
+        out["codec_ratio"] = (
+            out["precompress_bytes"] / out["compressed_bytes"]
+            if out["compressed_bytes"] else 1.0)
+        return out
 
     def task_snapshot(self, task_id: int) -> Dict[str, int]:
         with self._lock:
@@ -445,8 +490,13 @@ class SpillableHandle:
         if fw is None:
             return 0  # no framework: no disk tier
         checksum = bool(config.get("spill_checksum"))
+        codec = str(config.get("spill_codec") or "off").lower()
+        if codec not in ("off", "pack", "block"):
+            raise ValueError(
+                f"spill_codec must be off/pack/block, got {codec!r}")
         paths: List[str] = []
-        meta: List[Tuple[int, int]] = []
+        meta: List[tuple] = []
+        stored_total = 0
         try:
             for i, arr in enumerate(self._host):
                 p = os.path.join(fw.spill_dir, f"{self.name}-{i}.npy")
@@ -456,18 +506,37 @@ class SpillableHandle:
                 # the original CRC and read-back verification catches it
                 # (re-hashing here would launder the damage)
                 if self._host_meta is not None:
-                    meta.append(self._host_meta[i])
+                    orig = self._host_meta[i]
                 else:
-                    meta.append(_leaf_meta(arr) if checksum
-                                else (0, int(arr.nbytes)))
-                _write_leaf(p, arr)
+                    orig = (_leaf_meta(arr) if checksum
+                            else (0, int(arr.nbytes)))
+                if codec == "off":
+                    meta.append(orig)
+                    _write_leaf(p, arr)
+                else:
+                    # codec'd leaf: the file holds a self-describing u8
+                    # frame; the STORED crc covers the compressed bytes
+                    # (torn frames are caught before decode even runs),
+                    # the original crc still guards the decoded leaf
+                    payload = _codec.encode_block(arr, codec)
+                    stored_crc, stored_nbytes = _leaf_meta(payload)
+                    stored_total += stored_nbytes
+                    meta.append((orig[0], orig[1],
+                                 _codec.codec_name(payload),
+                                 stored_crc, stored_nbytes))
+                    _write_leaf(p, payload)
                 paths.append(p)
                 try:
                     _corrupt_probe()
                 except faultinj.SpillCorruptionError:
                     # injected corruption becomes REAL damage in the file
-                    # just written; detection is read-back's job
+                    # just written; detection is read-back's job.  With a
+                    # codec the tail flip lands mid-payload and the head
+                    # flip lands in the codec frame header, so BOTH the
+                    # stored-crc and the loud-decode defenses see fire.
                     _flip_file_bytes(p)
+                    if codec != "off":
+                        _flip_file_head_bytes(p)
         except (faultinj.SpillIOError, OSError):
             # graceful degradation: the batch STAYS in the host tier —
             # a broken spill disk must cost capacity, not data
@@ -478,8 +547,13 @@ class SpillableHandle:
             return 0
         nbytes = int(sum(a.nbytes for a in self._host))
         self._disk = paths
-        self._disk_meta = (meta if checksum or self._host_meta is not None
-                           else None)
+        # codec'd metas are load-bearing (the read path must know to
+        # decode), so they are always kept; raw metas keep the legacy
+        # rule of only surviving when a checksum backs them
+        self._disk_meta = (meta if codec != "off" or checksum
+                           or self._host_meta is not None else None)
+        if codec != "off":
+            fw.metrics.record_compressed(nbytes, stored_total, self.task_id)
         self._host = None
         self._host_meta = None
         freed = self._host_charged
@@ -609,7 +683,32 @@ class SpillableHandle:
         meta = self._disk_meta or [None] * len(self._disk)
         for p, m in zip(self._disk, meta):
             arr = _read_leaf(p)
-            if m is not None:
+            if m is not None and len(m) == 5:
+                # codec'd leaf: verify the STORED bytes first (a torn or
+                # flipped frame never reaches the decoder), then decode
+                # (any header damage that slipped a zero-crc store fails
+                # loudly as CodecError), then verify the decoded leaf
+                # against its demotion-time record
+                crc, nbytes, cname, stored_crc, stored_nbytes = m
+                got_crc, got_nbytes = _leaf_meta(arr)
+                if got_nbytes != stored_nbytes or got_crc != stored_crc:
+                    raise faultinj.SpillCorruptionError(
+                        f"stored-payload checksum mismatch reading {p} "
+                        f"({cname}): wrote {stored_nbytes}B "
+                        f"crc={stored_crc:#010x}, read {got_nbytes}B "
+                        f"crc={got_crc:#010x}")
+                try:
+                    arr = _codec.decode_block(arr)
+                except _codec.CodecError as e:
+                    raise faultinj.SpillCorruptionError(
+                        f"corrupt {cname} frame reading {p}: {e}") from e
+                got_crc, got_nbytes = _leaf_meta(arr)
+                if got_nbytes != nbytes or (crc and got_crc != crc):
+                    raise faultinj.SpillCorruptionError(
+                        f"decoded-leaf checksum mismatch reading {p}: "
+                        f"wrote {nbytes}B crc={crc:#010x}, decoded "
+                        f"{got_nbytes}B crc={got_crc:#010x}")
+            elif m is not None:
                 crc, nbytes = m
                 got_crc, got_nbytes = _leaf_meta(arr)
                 if got_nbytes != nbytes or got_crc != crc:

@@ -17,7 +17,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..columnar.column import ColumnBatch
-from ..columnar.encoded import DictionaryColumn, RunLengthColumn
+from ..columnar.encoded import DictionaryColumn, PACKED_COLUMNS, RunLengthColumn
 from ..relational.aggregate import AggSpec, group_by
 from .partition import spark_partition_id
 from .shuffle import exchange, plan_capacity
@@ -43,7 +43,10 @@ def shard_batch(batch: ColumnBatch, mesh: Mesh, axis_name: str = "data") -> Colu
     replicated = NamedSharding(mesh, PartitionSpec())
     cols = {}
     for name, col in zip(batch.names, batch.columns):
-        if isinstance(col, RunLengthColumn):
+        if isinstance(col, (RunLengthColumn,) + PACKED_COLUMNS):
+            # run/lane leaves have no per-row decomposition (lane i mixes
+            # rows across shard boundaries), so local encodings decode at
+            # the sharding boundary, same as RLE
             col = col.decode()
         if isinstance(col, DictionaryColumn) and col.dictionary is not None:
             cols[name] = dataclasses.replace(

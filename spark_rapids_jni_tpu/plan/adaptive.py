@@ -155,6 +155,34 @@ def choose_exchange_capacity(counts=None, metrics: Optional[dict] = None,
     return None
 
 
+def choose_shuffle_compress(key_range=None,
+                            metrics: Optional[dict] = None) -> Optional[str]:
+    """Wire-compression mode for an Exchange, or ``None`` to defer to
+    the ``shuffle_compress`` knob.
+
+    With an observed ``(lo, hi)`` key range the decision is the same
+    width math the wire packer itself applies
+    (:func:`~spark_rapids_jni_tpu.columnar.encoded.choose_pack_width`):
+    a bucketed width strictly narrower than the native 64-bit key words
+    means the pack step wins, and full-range keys mean it would ship
+    raw-width lanes — pick ``'off'`` up front and skip the pack trace.
+    With only a ``ShuffleMetrics`` snapshot, a positive
+    ``compressed_bytes_saved`` (earlier exchanges in this process
+    already packed profitably) keeps ``'pack'`` on.  Adaptive off, or
+    no signal, defers to the knob."""
+    if not _enabled():
+        return None
+    if key_range is not None:
+        from ..columnar.encoded import choose_pack_width
+
+        lo, hi = key_range
+        w = choose_pack_width(min(int(lo), 0), max(int(hi), 0))
+        return "pack" if w is not None and w < 64 else "off"
+    if metrics and int(metrics.get("compressed_bytes_saved", 0)) > 0:
+        return "pack"
+    return None
+
+
 def plan_decisions(plan: ir.PlanNode, inputs: dict,
                    stats: Optional[dict] = None) -> dict:
     """Walk ``plan`` and record every adaptive decision the compiler
@@ -183,10 +211,17 @@ def plan_decisions(plan: ir.PlanNode, inputs: dict,
             rp = choose_exchange_capacity(
                 counts=stats.get("counts"), metrics=stats.get("shuffle"),
                 partitions=node.partitions)
-            if rp is not None:
-                decisions[f"exchange{xi}:{node.key}"] = {
-                    "capacity": rp.capacity, "rounds": rp.rounds,
-                    "skew_ratio": round(rp.skew_ratio, 3)}
+            compress = choose_shuffle_compress(
+                key_range=stats.get("key_range"),
+                metrics=stats.get("shuffle"))
+            if rp is not None or compress is not None:
+                d = {}
+                if rp is not None:
+                    d.update(capacity=rp.capacity, rounds=rp.rounds,
+                             skew_ratio=round(rp.skew_ratio, 3))
+                if compress is not None:
+                    d["compress"] = compress
+                decisions[f"exchange{xi}:{node.key}"] = d
             xi += 1
         elif isinstance(node, ir.Aggregate):
             hint = choose_groupby_engine(counts=stats.get("counts"),

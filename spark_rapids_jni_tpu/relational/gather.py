@@ -13,18 +13,29 @@ import dataclasses
 import jax.numpy as jnp
 
 from ..columnar.column import Column, ColumnBatch, Decimal128Column, StringColumn
-from ..columnar.encoded import DictionaryColumn, RunLengthColumn
+from ..columnar.encoded import (
+    BitPackedColumn,
+    DictionaryColumn,
+    FrameOfReferenceColumn,
+    RunLengthColumn,
+    gather_bitpacked,
+)
 
 
 def gather_column(col, idx, valid=None):
     """Take rows ``idx`` (int32[m], clipped); rows where ``valid`` is False
     become nulls (used for padded filter/join outputs)."""
-    if isinstance(col, RunLengthColumn):
-        # runs do not survive an arbitrary permutation: decode here (a
-        # sanctioned materialization point) so RLE never flows deeper
+    if isinstance(col, (RunLengthColumn, FrameOfReferenceColumn)):
+        # runs / FoR blocks do not survive an arbitrary permutation:
+        # decode here (a sanctioned materialization point) so neither
+        # flows deeper
         col = col.decode()
     n = col.num_rows
     idx = jnp.clip(idx, 0, max(n - 1, 0))
+    if isinstance(col, BitPackedColumn):
+        # the global reference DOES survive permutation: extract
+        # residuals, take, repack — the output stays packed
+        return gather_bitpacked(col, idx, valid)
     if isinstance(col, DictionaryColumn):
         # gather CODES; the dictionary (and its token) ride along, so the
         # output stays encoded through compaction and join materialization

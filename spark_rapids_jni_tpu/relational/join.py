@@ -41,7 +41,9 @@ import jax.numpy as jnp
 
 from ..columnar.column import Column, ColumnBatch, Decimal128Column, StringColumn
 from ..columnar.encoded import (
+    BitPackedColumn,
     DictionaryColumn,
+    FrameOfReferenceColumn,
     RunLengthColumn,
     align_encoded_key_columns,
 )
@@ -120,9 +122,15 @@ def _one_null_row_like(batch: ColumnBatch) -> ColumnBatch:
             out[name] = _dc.replace(col, codes=jnp.zeros((1,), jnp.uint32),
                                     validity=invalid)
             continue
-        if isinstance(col, RunLengthColumn):
+        if isinstance(col, (RunLengthColumn, FrameOfReferenceColumn)):
             out[name] = Column(
                 jnp.zeros((1,), col.dtype.jnp_dtype), invalid, col.dtype)
+            continue
+        if isinstance(col, BitPackedColumn):
+            # keep the packed form (reference/width are program-family
+            # aux): one null row = one zero residual lane
+            out[name] = _dc.replace(col, lanes=jnp.zeros((1,), jnp.uint32),
+                                    validity=invalid)
             continue
         if isinstance(col, StringColumn):
             out[name] = StringColumn(
@@ -452,9 +460,13 @@ def join_dense_or_hash(
     lcol, rcol = left[left_on], right[right_on]
     eligible = (how == "inner" and domain > 0
                 and not isinstance(lcol, (StringColumn, Decimal128Column,
-                                          DictionaryColumn, RunLengthColumn))
+                                          DictionaryColumn, RunLengthColumn,
+                                          BitPackedColumn,
+                                          FrameOfReferenceColumn))
                 and not isinstance(rcol, (StringColumn, Decimal128Column,
-                                          DictionaryColumn, RunLengthColumn))
+                                          DictionaryColumn, RunLengthColumn,
+                                          BitPackedColumn,
+                                          FrameOfReferenceColumn))
                 and jnp.issubdtype(lcol.data.dtype, jnp.integer)
                 and jnp.issubdtype(rcol.data.dtype, jnp.integer)
                 and right.num_rows > 0)
@@ -537,6 +549,14 @@ def _merge_parts(lpart: ColumnBatch, rpart: ColumnBatch,
 
 
 def _concat_col(a, b):
+    if isinstance(a, (BitPackedColumn, FrameOfReferenceColumn)) or \
+            isinstance(b, (BitPackedColumn, FrameOfReferenceColumn)):
+        # packed lane streams are not concatenable unless the first ends
+        # lane-aligned (n*width % 32 == 0) AND the static aux matches —
+        # concat is an output boundary, so materialize like mixed dicts
+        from ..columnar.encoded import materialize_column
+
+        a, b = materialize_column(a), materialize_column(b)
     if isinstance(a, DictionaryColumn) or isinstance(b, DictionaryColumn):
         import dataclasses as _dc
 

@@ -34,7 +34,12 @@ import numpy as np
 
 from ..columnar import types as T
 from ..columnar.column import Column, Decimal128Column, StringColumn
-from ..columnar.encoded import DictionaryColumn, RunLengthColumn
+from ..columnar.encoded import (
+    BitPackedColumn,
+    DictionaryColumn,
+    FrameOfReferenceColumn,
+    RunLengthColumn,
+)
 
 # numpy, not jnp: module scope must not mint device arrays (GL001)
 _SIGN32 = np.uint32(0x80000000)
@@ -98,6 +103,15 @@ def column_radix_keys(col, *, equality: bool = False) -> list:
         values = Column(col.run_values,
                         jnp.ones((col.num_runs,), jnp.bool_), col.dtype)
         return [w[run] for w in column_radix_keys(values, equality=equality)]
+    if isinstance(col, BitPackedColumn):
+        # reference+residual arithmetic, not a decode: the packed column
+        # lowers straight to VALUE words, so it groups/joins against
+        # plain int columns (and differently-referenced packed ones)
+        # bit-identically
+        vals = col.residuals().astype(jnp.int64) + col.reference
+        return _int_value_words(vals, col.dtype)
+    if isinstance(col, FrameOfReferenceColumn):
+        return _int_value_words(col.values64(), col.dtype)
     if isinstance(col, StringColumn):
         chars, L = col.chars, col.max_len
         nwords = max(1, -(-L // 4))
@@ -134,6 +148,18 @@ def column_radix_keys(col, *, equality: bool = False) -> list:
     if kind is T.Kind.FLOAT64:
         return list(_split64(_f64_total_order(d, normalize_zero=equality)))
     raise NotImplementedError(f"radix keys for {col.dtype!r}")
+
+
+def _int_value_words(vals64, dtype) -> list:
+    """int64[n] decoded values -> the kind's order-preserving words
+    (shared by the packed-column lowerings)."""
+    kind = dtype.kind
+    if kind in (T.Kind.INT8, T.Kind.INT16, T.Kind.INT32, T.Kind.DATE):
+        return [vals64.astype(jnp.int32).astype(jnp.uint32) ^ _SIGN32]
+    if kind in (T.Kind.INT64, T.Kind.TIMESTAMP):
+        u = vals64.astype(jnp.uint64) ^ (jnp.uint64(1) << jnp.uint64(63))
+        return list(_split64(u))
+    raise NotImplementedError(f"packed radix keys for {dtype!r}")
 
 
 def null_flag(col, nulls_first: bool) -> jax.Array:

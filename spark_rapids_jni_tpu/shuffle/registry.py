@@ -37,6 +37,9 @@ class ShuffleInfo:
     rounds_overlapped: int = 0     # rounds drained before end-of-stream
     decode_ms: float = 0.0         # cumulative morsel decode+map time
     drain_ms: float = 0.0          # cumulative round drain time
+    compressed_bytes_saved: int = 0  # wire bytes the pack plan saved
+    #   (bytes_moved already reflects the packed size; this is the delta
+    #   vs the raw grid the same rounds would have shipped)
 
 
 class ShuffleMetrics:
@@ -52,6 +55,7 @@ class ShuffleMetrics:
         "shuffles", "rounds", "rows_moved", "bytes_moved",
         "spilled_bytes", "oob_rows", "dropped_rows", "io_failures",
         "recovered_partitions", "adopted_shards", "lineage_rebuilds",
+        "compressed_bytes_saved",
     )
 
     def __init__(self):
@@ -67,6 +71,7 @@ class ShuffleMetrics:
             self._c["bytes_moved"] += info.bytes_moved
             self._c["spilled_bytes"] += info.spilled_bytes
             self._c["oob_rows"] += info.oob_rows
+            self._c["compressed_bytes_saved"] += info.compressed_bytes_saved
             self._max_skew = max(self._max_skew, info.skew_ratio)
 
     def record_dropped(self, n: int):

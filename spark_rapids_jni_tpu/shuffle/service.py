@@ -53,10 +53,14 @@ from jax.sharding import PartitionSpec
 from .. import config, faultinj
 from ..columnar.column import ColumnBatch
 from ..columnar.encoded import (
+    PACKED_COLUMNS,
     DictionaryColumn,
     RunLengthColumn,
+    choose_pack_width,
     detach_dictionaries,
+    pack_bits_rows,
     reattach_dictionaries,
+    unpack_bits_rows,
 )
 from ..mem.executor import run_with_retry
 from ..parallel.partition import regroup_order, spark_partition_id
@@ -123,6 +127,7 @@ class ShuffleResult:
     rounds_overlapped: int = 0      # rounds drained before end-of-stream
     decode_ms: float = 0.0          # cumulative morsel decode+map time
     drain_ms: float = 0.0           # cumulative round drain time
+    compressed_bytes_saved: int = 0  # wire bytes the pack plan saved
 
 
 def _map_local(b: ColumnBatch, pid, P: int):
@@ -166,19 +171,143 @@ def _map_step_pid(mesh, axis_name):
     return jax.jit(step)
 
 
+# -- compressed wire (shuffle_compress) --------------------------------------
+#
+# The pack plan is one spec per flattened leaf of the mapped batch:
+# None (ship raw), ("bit", w, dtype, -1) for bool leaves, or
+# ("for", w, dtype, ref_idx) for integer leaves — frame-of-reference
+# subtract a TRACED int64 reference, then bit-pack the residual words at
+# a bucketed trace-static width.  The plan tuple keys the compiled drain
+# program; the references ride as operands, so two exchanges with the
+# same shape but different key ranges share one program.  Packed chunks
+# stay packed through the PartitionBuffer tier (bytes_moved, spill and
+# the durable store all see lane words); :func:`_unpack_chunk_tree` is
+# the single sanctioned decode seam at reassembly.
+
+def _pack_plan(batch: ColumnBatch, dicts, mode: str):
+    """(plan, refs) for ``batch``'s flattened leaves, or (None, None).
+
+    ``mode='pack'`` packs every eligible 1-D leaf (bools at width 1,
+    integer leaves at their observed bucketed range width); ``'auto'``
+    packs only the always-wins leaves of a dictionary-carrying exchange
+    (validity bools + detached code words) so plain exchanges keep their
+    exact legacy wire shape."""
+    if mode == "off":
+        return None, None
+    code_ids = set()
+    if mode == "auto":
+        if not dicts:
+            return None, None
+        for name, col in zip(batch.names, batch.columns):
+            if name in dicts and isinstance(col, DictionaryColumn):
+                code_ids.add(id(col.codes))
+    plan = []
+    refs = []
+    for leaf in jax.tree_util.tree_leaves(batch):
+        sp = None
+        if getattr(leaf, "ndim", None) == 1 and leaf.size:
+            if leaf.dtype == jnp.bool_:
+                sp = ("bit", 1, "bool", -1)
+            elif jnp.issubdtype(leaf.dtype, jnp.integer) and (
+                    mode == "pack" or id(leaf) in code_ids):
+                # range over ALL rows (null/padding slots gather real
+                # in-range values, so the observed range bounds every
+                # word a drain round can ever pack); widen to cover 0 so
+                # zero-initialized dead slots stay representable
+                lo = min(int(jax.device_get(leaf.min())), 0)
+                hi = max(int(jax.device_get(leaf.max())), 0)
+                w = choose_pack_width(lo, hi)
+                if w is not None and w < 8 * leaf.dtype.itemsize:
+                    sp = ("for", w, jnp.dtype(leaf.dtype).name, len(refs))
+                    refs.append(lo)
+        plan.append(sp)
+    if not any(plan):
+        return None, None
+    return tuple(plan), refs
+
+
+def _bool_plan(batch: ColumnBatch):
+    """The streaming pack plan: validity bools only — a stream's value
+    ranges are unknowable before its last morsel, but width-1 bool
+    packing is data-independent and always wins."""
+    plan = tuple(
+        ("bit", 1, "bool", -1)
+        if getattr(leaf, "ndim", None) == 1 and leaf.size
+        and leaf.dtype == jnp.bool_ else None
+        for leaf in jax.tree_util.tree_leaves(batch))
+    return plan if any(plan) else None
+
+
+def _plan_saved_bytes(plan, P: int, capacity: int) -> int:
+    """Static wire bytes one packed round chunk saves vs the raw grid
+    (the occupancy mask always packs at width 1 alongside the plan)."""
+    if plan is None:
+        return 0
+    rows = P * P * capacity
+
+    def lanes_nbytes(w):
+        return P * P * ((capacity * w + 31) // 32) * 4
+
+    saved = rows - lanes_nbytes(1)  # the bool occupancy mask
+    for sp in plan:
+        if sp is not None:
+            _, w, dts, _ = sp
+            saved += rows * jnp.dtype(dts).itemsize - lanes_nbytes(w)
+    return max(int(saved), 0)
+
+
+def _occ_rows(occ) -> int:
+    """Received-row count of a round chunk's occupancy, packed or not."""
+    a = np.asarray(jax.device_get(occ))
+    if a.dtype == np.bool_:
+        return int(a.sum())
+    return int(np.unpackbits(np.ascontiguousarray(a).view(np.uint8)).sum())
+
+
+def _unpack_chunk_tree(out, occ, plan, treedef, capacity: int, refs):
+    """THE sanctioned wire-unpack seam (graftlint GL014): lane words that
+    crossed the all_to_all (and sat packed in the chunk buffers) become
+    the reassembled batch + occupancy here, immediately before the
+    per-device concat — nowhere earlier."""
+    if plan is None:
+        return out, occ
+    leaves = []
+    for leaf, sp in zip(out, plan):
+        if sp is None:
+            leaves.append(leaf)
+            continue
+        kind, w, dts, ref_idx = sp
+        words = unpack_bits_rows(leaf, w, capacity).reshape(-1)
+        if dts == "bool":
+            leaves.append(words.astype(jnp.bool_))
+        elif kind == "bit":
+            leaves.append(words.astype(jnp.dtype(dts)))
+        else:
+            leaves.append((words.astype(jnp.int64)
+                           + jnp.int64(refs[ref_idx])).astype(jnp.dtype(dts)))
+    occv = unpack_bits_rows(occ, 1, capacity).reshape(-1).astype(jnp.bool_)
+    return jax.tree_util.tree_unflatten(treedef, leaves), occv
+
+
 @lru_cache(maxsize=None)
-def _drain_step(mesh, axis_name, capacity):
+def _drain_step(mesh, axis_name, capacity, plan=None):
     """One compiled program serves every round: the round index is a
     traced replicated scalar, so round r selects slots [r*C, (r+1)*C) of
-    each bucket without retracing."""
+    each bucket without retracing.  With a pack ``plan`` the planned
+    leaves cross the all_to_all as bit-packed u32 lanes (references are
+    traced operands) and the chunk STAYS packed until
+    :func:`_unpack_chunk_tree`."""
     P = mesh.shape[axis_name]
     C = capacity
     spec = PartitionSpec(axis_name)
+    in_specs = (spec, spec, PartitionSpec())
+    if plan is not None:
+        in_specs = in_specs + (PartitionSpec(),)
 
     @partial(jax.shard_map, mesh=mesh,
-             in_specs=(spec, spec, PartitionSpec()),
+             in_specs=in_specs,
              out_specs=(spec, spec, spec, spec), check_vma=False)
-    def step(b: ColumnBatch, counts2d, r):
+    def step(b: ColumnBatch, counts2d, r, *refs_args):
         counts = counts2d.reshape(-1)[:P]
         R = b.num_rows
         offsets = jnp.cumsum(counts) - counts
@@ -196,13 +325,36 @@ def _drain_step(mesh, axis_name, capacity):
                 grid, axis_name, split_axis=0, concat_axis=0)
             return out.reshape((P * C,) + x.shape[1:])
 
-        out = jax.tree_util.tree_map(a2a, send)
-        occ = a2a(slot_occ)
-        got = occ.sum(dtype=jnp.int32)
         residual = jnp.maximum(counts - (r + 1) * C, 0).sum(dtype=jnp.int32)
+        if plan is None:
+            out = jax.tree_util.tree_map(a2a, send)
+            occ = a2a(slot_occ)
+            got = occ.sum(dtype=jnp.int32)
+            return out, occ, got[None], residual[None]
+        refs = refs_args[0]
+        out = tuple(
+            _pack_leaf_a2a(leaf, sp, refs, axis_name, P, C)
+            if sp is not None else a2a(leaf)
+            for leaf, sp in zip(jax.tree_util.tree_flatten(send)[0], plan))
+        occ = _pack_leaf_a2a(slot_occ, ("bit", 1, "bool", -1), refs,
+                             axis_name, P, C)
+        got = jax.lax.population_count(occ).sum(dtype=jnp.int32)
         return out, occ, got[None], residual[None]
 
     return jax.jit(step)
+
+
+def _pack_leaf_a2a(leaf, sp, refs, axis_name, P, C):
+    """Pack one planned leaf into per-partition lane rows and send them
+    through the collective (each row's lanes stay with its destination,
+    so ``all_to_all`` still splits axis 0)."""
+    kind, w, _dts, ref_idx = sp
+    if kind == "bit":
+        words = leaf.astype(jnp.uint32)
+    else:
+        words = (leaf.astype(jnp.int64) - refs[ref_idx]).astype(jnp.uint32)
+    lanes = pack_bits_rows(words.reshape(P, C), w)
+    return jax.lax.all_to_all(lanes, axis_name, split_axis=0, concat_axis=0)
 
 
 # traces of the streaming drain program, bumped INSIDE the traced body
@@ -306,11 +458,14 @@ def _scatter_step(mesh, axis_name, capacity, engine="lax"):
 
 
 @lru_cache(maxsize=None)
-def _stream_drain_step(mesh, axis_name, capacity):
+def _stream_drain_step(mesh, axis_name, capacity, plan=None):
     """Drain ONE streaming round: the chunk is already destination-major
     packed by the scatter, so this is just the static all_to_all plus
     the received-row count — and the single program every round of every
     stream at this capacity reuses (``_STREAM_DRAIN_TRACES`` proves it).
+    With a pack ``plan`` (bool leaves only — see :func:`_bool_plan`) the
+    planned leaves cross as width-1 lanes and stay packed until
+    :func:`_unpack_chunk_tree`.
     """
     P = mesh.shape[axis_name]
     C = capacity
@@ -327,9 +482,18 @@ def _stream_drain_step(mesh, axis_name, capacity):
                 grid, axis_name, split_axis=0, concat_axis=0)
             return out.reshape((P * C,) + x.shape[1:])
 
-        out = jax.tree_util.tree_map(a2a, chunk)
-        occ = a2a(slot_occ)
-        got = occ.sum(dtype=jnp.int32)
+        if plan is None:
+            out = jax.tree_util.tree_map(a2a, chunk)
+            occ = a2a(slot_occ)
+            got = occ.sum(dtype=jnp.int32)
+            return out, occ, got[None]
+        out = tuple(
+            _pack_leaf_a2a(leaf, sp, None, axis_name, P, C)
+            if sp is not None else a2a(leaf)
+            for leaf, sp in zip(jax.tree_util.tree_flatten(chunk)[0], plan))
+        occ = _pack_leaf_a2a(slot_occ, ("bit", 1, "bool", -1), None,
+                             axis_name, P, C)
+        got = jax.lax.population_count(occ).sum(dtype=jnp.int32)
         return out, occ, got[None]
 
     return jax.jit(step)
@@ -428,10 +592,15 @@ class ShuffleService:
         # so plan_rounds capacity math and every all_to_all see the u32
         # code width, not the value width.  RLE decodes here: runs do not
         # survive the destination-major regroup, and their [r]-shaped
-        # leaves cannot ride the row-sharded specs.
-        if any(isinstance(c, RunLengthColumn) for c in batch.columns):
+        # leaves cannot ride the row-sharded specs.  Bit-packed/FoR
+        # columns decode too (lane leaves have no per-row sharding); the
+        # wire packer below re-compresses them per round chunk.
+        if any(isinstance(c, (RunLengthColumn,) + PACKED_COLUMNS)
+               for c in batch.columns):
             batch = ColumnBatch({
-                name: c.decode() if isinstance(c, RunLengthColumn) else c
+                name: (c.decode()
+                       if isinstance(c, (RunLengthColumn,) + PACKED_COLUMNS)
+                       else c)
                 for name, c in zip(batch.names, batch.columns)})
         dicts = {}
         if any(isinstance(c, DictionaryColumn) for c in batch.columns):
@@ -486,6 +655,21 @@ class ShuffleService:
         # 2. plan: static (rounds, capacity) from the exact counts
         plan = plan_rounds(counts_np, round_rows=round_rows)
 
+        # 2b. wire plan: which leaves cross the collective bit-packed
+        compress = str(config.get("shuffle_compress") or "auto").lower()
+        if compress not in ("auto", "off", "pack"):
+            raise ValueError(f"shuffle_compress must be auto/off/pack, "
+                             f"got {compress!r}")
+        wire_plan, wire_refs = _pack_plan(regrouped, dicts, compress)
+        wire_treedef = jax.tree_util.tree_structure(regrouped)
+        refs_arr = (jnp.asarray(wire_refs or [0], jnp.int64)
+                    if wire_plan is not None else None)
+        saved_per_chunk = _plan_saved_bytes(wire_plan, P, plan.capacity)
+        # packed chunks commit under a distinct shard name so a raw run
+        # never adopts lane words (and vice versa) — the mismatch is a
+        # clean adoption miss, not a mis-shaped tree
+        round_tag = "roundp" if wire_plan is not None else "round"
+
         # lineage: each buffer's recompute= re-runs only the shards that
         # produced it, metered against the per-exchange recovery budget
         recovered = [0]
@@ -503,7 +687,7 @@ class ShuffleService:
             recompute=_lineage(lambda: run_map()[:2], "map output",
                                adopt=_adopt_map2 if store is not None
                                else None))
-        drain = _drain_step(mesh, axis, plan.capacity)
+        drain = _drain_step(mesh, axis, plan.capacity, wire_plan)
 
         def _redrive(rr):
             # round rr's partitions depend only on the map buffer and
@@ -511,13 +695,17 @@ class ShuffleService:
             # (which may itself recover the map buffer first)
             def rebuild():
                 tree, cnts = map_buf.get()
-                out_r, occ_r, _, _ = drain(tree, cnts, jnp.int32(rr))
+                args = (tree, cnts, jnp.int32(rr))
+                if refs_arr is not None:
+                    args = args + (refs_arr,)
+                out_r, occ_r, _, _ = drain(*args)
                 return out_r, occ_r
             return rebuild
 
         chunks = []
         received = 0
         bytes_moved = 0
+        compressed_saved = 0
         residual = -1
         lane = get_drain_lane()
         overlapped = 0
@@ -529,7 +717,8 @@ class ShuffleService:
             nonlocal overlapped
             if lane is None or plan.rounds <= 1:
                 for r in range(plan.rounds):
-                    yield (r, *self._run_round(drain, map_buf, r))
+                    yield (r, *self._run_round(drain, map_buf, r,
+                                               refs_arr))
                 return
             owner = getattr(ctx, "task_id", None)
             pending = []
@@ -537,7 +726,8 @@ class ShuffleService:
                 for r in range(plan.rounds):
                     pending.append((r, lane.submit(
                         owner,
-                        lambda rr=r: self._run_round(drain, map_buf, rr))))
+                        lambda rr=r: self._run_round(drain, map_buf, rr,
+                                                     refs_arr))))
                     if len(pending) == 2:
                         rr, fut = pending.pop(0)
                         overlapped += 1
@@ -552,17 +742,18 @@ class ShuffleService:
         try:
             for r, out, occ, got_n, residual in _rounds():
                 if store is not None:
-                    store.put(store_key, f"round-{r}", (out, occ))
+                    store.put(store_key, f"{round_tag}-{r}", (out, occ))
                 chunk = PartitionBuffer(
                     (out, occ), ctx=ctx, name=f"shuffle{sid}-round{r}",
                     recompute=_lineage(
                         _redrive(r), f"round {r} chunk",
                         adopt=(lambda rr=r: store.adopt(
-                            store_key, f"round-{rr}"))
+                            store_key, f"{round_tag}-{rr}"))
                         if store is not None else None))
                 chunks.append(chunk)
                 received += got_n
                 bytes_moved += chunk.nbytes
+                compressed_saved += saved_per_chunk
 
             # 4. account + reassemble
             sent = int(counts_np.sum())
@@ -573,9 +764,14 @@ class ShuffleService:
                     f"shuffle {sid}: lossless invariant violated "
                     f"(sent={sent} received={received} residual={residual})")
             if plan.rounds == 1:
-                final_batch, final_occ = chunks[0].get()
+                final_batch, final_occ = _unpack_chunk_tree(
+                    *chunks[0].get(), wire_plan, wire_treedef,
+                    plan.capacity, wire_refs)
             else:
-                parts = [c.get() for c in chunks]
+                parts = [
+                    _unpack_chunk_tree(*c.get(), wire_plan, wire_treedef,
+                                       plan.capacity, wire_refs)
+                    for c in chunks]
                 concat = _concat_step(mesh, axis, len(parts))
                 final_batch, final_occ = concat(*parts)
         finally:
@@ -601,7 +797,8 @@ class ShuffleService:
             shuffle_id=sid, rounds=plan.rounds, capacity=plan.capacity,
             rows_moved=received, bytes_moved=bytes_moved,
             spilled_bytes=spilled, skew_ratio=plan.skew_ratio,
-            oob_rows=oob_total, recovered_partitions=recovered[0])
+            oob_rows=oob_total, recovered_partitions=recovered[0],
+            compressed_bytes_saved=compressed_saved)
         self.registry.record(info)
         return ShuffleResult(
             batch=final_batch, occupancy=final_occ, shuffle_id=sid,
@@ -609,7 +806,8 @@ class ShuffleService:
             bytes_moved=bytes_moved, spilled_bytes=spilled,
             skew_ratio=plan.skew_ratio, oob_rows=oob_total,
             recovered_partitions=recovered[0],
-            rounds_overlapped=overlapped)
+            rounds_overlapped=overlapped,
+            compressed_bytes_saved=compressed_saved)
 
     def exchange_stream(
         self,
@@ -668,7 +866,19 @@ class ShuffleService:
         C = plan_stream_capacity(round_rows=round_rows)
         scatter = _scatter_step(mesh, axis, C, _resolve_scatter_engine())
         init = _chunk_init_step(mesh, axis, C)
-        drain = _stream_drain_step(mesh, axis, C)
+        # the wire plan needs the stream's leaf structure — the drain
+        # program is built at the first morsel (always before any round
+        # drains).  Streams pack bool leaves only: value ranges are
+        # unknowable before the last morsel (see _bool_plan).
+        compress = str(config.get("shuffle_compress") or "auto").lower()
+        if compress not in ("auto", "off", "pack"):
+            raise ValueError(f"shuffle_compress must be auto/off/pack, "
+                             f"got {compress!r}")
+        drain = None
+        wire_plan = None
+        wire_treedef = None
+        saved_per_chunk = 0
+        recv_tag = "recv"
         recovered = [0]
         _lineage = self._lineage_factory(sid, recovered)
 
@@ -676,11 +886,10 @@ class ShuffleService:
             def run():
                 item = replay()
                 b, aux = item if isinstance(item, tuple) else (item, None)
-                if any(isinstance(c, (RunLengthColumn, DictionaryColumn))
-                       for c in b.columns):
+                enc = (RunLengthColumn, DictionaryColumn) + PACKED_COLUMNS
+                if any(isinstance(c, enc) for c in b.columns):
                     b = ColumnBatch({
-                        n: (c.decode() if isinstance(
-                            c, (RunLengthColumn, DictionaryColumn)) else c)
+                        n: (c.decode() if isinstance(c, enc) else c)
                         for n, c in zip(b.names, b.columns)})
                 if key_names is not None:
                     step = _map_step_keys(mesh, axis, tuple(key_names),
@@ -701,6 +910,7 @@ class ShuffleService:
         oob_total = 0
         received = 0
         bytes_moved = 0
+        compressed_saved = 0
         next_drain = 0
         n_morsels = 0
         rounds_overlapped = 0
@@ -734,16 +944,16 @@ class ShuffleService:
             contribs[rr] = []
 
         def _drain_round(rr):
-            nonlocal received, bytes_moved
+            nonlocal received, bytes_moved, compressed_saved
             chunk = send_chunks[rr]
 
             # a prior attempt already drained (and committed) this round:
             # adopt the received chunk instead of re-running the a2a
-            adopted = (store.adopt(store_key, f"recv-{rr}")
+            adopted = (store.adopt(store_key, f"{recv_tag}-{rr}")
                        if store is not None else None)
             if adopted is not None:
                 out, occ2 = adopted
-                got_n = int(np.asarray(jax.device_get(occ2)).sum())
+                got_n = _occ_rows(occ2)
                 self.registry.metrics.record_adopted()
             else:
                 def round_step():
@@ -762,7 +972,7 @@ class ShuffleService:
                         if attempt == _IO_RETRIES:
                             raise
                 if store is not None:
-                    store.put(store_key, f"recv-{rr}", (out, occ2))
+                    store.put(store_key, f"{recv_tag}-{rr}", (out, occ2))
 
             def redrive():
                 tree, occv = chunk.get()
@@ -773,11 +983,13 @@ class ShuffleService:
                 (out, occ2), ctx=ctx, name=f"shuffle{sid}-recv{rr}",
                 recompute=_lineage(
                     redrive, f"round {rr} chunk",
-                    adopt=(lambda: store.adopt(store_key, f"recv-{rr}"))
+                    adopt=(lambda: store.adopt(store_key,
+                                               f"{recv_tag}-{rr}"))
                     if store is not None else None))
             recv.append(buf)
             received += got_n
             bytes_moved += buf.nbytes
+            compressed_saved += saved_per_chunk
 
         try:
             for item in morsels:
@@ -796,6 +1008,14 @@ class ShuffleService:
                         f"ids (strict mode; ids must lie in [0, {P}])")
                 if first_map[0] is None:
                     first_map[0] = run_map_m
+                    if compress == "pack":
+                        wire_plan = _bool_plan(regrouped)
+                        wire_treedef = jax.tree_util.tree_structure(
+                            regrouped)
+                        saved_per_chunk = _plan_saved_bytes(wire_plan, P, C)
+                        if wire_plan is not None:
+                            recv_tag = "recvp"
+                    drain = _stream_drain_step(mesh, axis, C, wire_plan)
                 base = cum.copy()
                 cum = cum + counts_np
                 m_idx = n_morsels
@@ -861,9 +1081,13 @@ class ShuffleService:
                     f"(sent={sent} received={received} "
                     f"rounds={rounds})")
             if len(recv) == 1:
-                final_batch, final_occ = recv[0].get()
+                final_batch, final_occ = _unpack_chunk_tree(
+                    *recv[0].get(), wire_plan, wire_treedef, C, None)
             else:
-                parts = [b.get() for b in recv]
+                parts = [
+                    _unpack_chunk_tree(*b.get(), wire_plan, wire_treedef,
+                                       C, None)
+                    for b in recv]
                 concat = _concat_step(mesh, axis, len(parts))
                 final_batch, final_occ = concat(*parts)
         finally:
@@ -886,7 +1110,8 @@ class ShuffleService:
             oob_rows=oob_total, recovered_partitions=recovered[0],
             streamed=True, morsels=n_morsels,
             rounds_overlapped=rounds_overlapped,
-            decode_ms=decode_ms, drain_ms=drain_ms)
+            decode_ms=decode_ms, drain_ms=drain_ms,
+            compressed_bytes_saved=compressed_saved)
         self.registry.record(info)
         return ShuffleResult(
             batch=final_batch, occupancy=final_occ, shuffle_id=sid,
@@ -895,7 +1120,8 @@ class ShuffleService:
             skew_ratio=plan.skew_ratio, oob_rows=oob_total,
             recovered_partitions=recovered[0], streamed=True,
             morsels=n_morsels, rounds_overlapped=rounds_overlapped,
-            decode_ms=decode_ms, drain_ms=drain_ms)
+            decode_ms=decode_ms, drain_ms=drain_ms,
+            compressed_bytes_saved=compressed_saved)
 
     def plan(self, counts, round_rows: Optional[int] = None) -> RoundPlan:
         """Expose the planner on the service for callers that fetched
@@ -935,7 +1161,8 @@ class ShuffleService:
             return run
         return _lineage
 
-    def _run_round(self, drain, map_buf: PartitionBuffer, r: int):
+    def _run_round(self, drain, map_buf: PartitionBuffer, r: int,
+                   refs=None):
         """One retryable round: arena pressure runs the spill ladder
         (RetryOOM → cross-task eviction → retry), transport faults are
         re-driven a bounded number of times from the intact buffers."""
@@ -943,7 +1170,10 @@ class ShuffleService:
         def round_step():
             _io_probe()
             tree, cnts = map_buf.get()
-            out, occ, got, residual = drain(tree, cnts, jnp.int32(r))
+            args = (tree, cnts, jnp.int32(r))
+            if refs is not None:
+                args = args + (refs,)
+            out, occ, got, residual = drain(*args)
             # fetching the scalars forces the round to execute HERE, so
             # real device OOMs surface inside the retry ladder
             got_n = int(np.asarray(jax.device_get(got)).sum())

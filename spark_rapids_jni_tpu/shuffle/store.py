@@ -70,7 +70,8 @@ from ..columnar.column import (
     StringColumn,
     StructColumn,
 )
-from ..mem.spill import _flip_file_bytes, _leaf_meta
+from ..mem import codec as _codec
+from ..mem.spill import _flip_file_bytes, _flip_file_head_bytes, _leaf_meta
 
 # probe names: "store_commit" fires immediately before the atomic
 # rename; "store_corrupt_file" immediately after a successful commit
@@ -363,14 +364,27 @@ class ShuffleStore:
         manifest_path = os.path.join(tmp, _MANIFEST)
         try:
             os.makedirs(tmp)
+            codec = str(config.get("spill_codec") or "off").lower()
             metas = []
             for i, arr in enumerate(leaves):
                 cpath = os.path.join(tmp, f"chunk-{i:04d}.npy")
+                if codec == "off":
+                    payload = arr
+                    meta = list(_leaf_meta(arr))
+                else:
+                    # codec'd chunk: the manifest meta grows to
+                    # [orig_crc, orig_nbytes, codec, stored_crc,
+                    # stored_nbytes] so any later fleet can adopt the
+                    # entry without knowing this run's knob setting
+                    payload = _codec.encode_block(arr, codec)
+                    meta = (list(_leaf_meta(arr))
+                            + [_codec.codec_name(payload)]
+                            + list(_leaf_meta(payload)))
                 with open(cpath, "wb") as f:
-                    np.save(f, arr, allow_pickle=False)
+                    np.save(f, payload, allow_pickle=False)
                     f.flush()
                     os.fsync(f.fileno())
-                metas.append(list(_leaf_meta(arr)))
+                metas.append(meta)
             with open(manifest_path, "w") as f:
                 json.dump({"skeleton": skeleton, "leaves": metas,
                            "epoch": self.epoch, "key": key,
@@ -422,6 +436,10 @@ class ShuffleStore:
                             if f.startswith("chunk-"))
             if chunks:
                 _flip_file_bytes(os.path.join(final, chunks[0]))
+                if str(config.get("spill_codec") or "off").lower() != "off":
+                    # also damage the codec frame header so the loud
+                    # decode-failure defense is exercised, not just CRC
+                    _flip_file_head_bytes(os.path.join(final, chunks[0]))
         self._prune(shard_dir)
         return True
 
@@ -467,15 +485,40 @@ class ShuffleStore:
             manifest = json.load(f)
         metas = manifest["leaves"]
         leaves = []
-        for i, (crc, nbytes) in enumerate(metas):
+        for i, meta in enumerate(metas):
             arr = np.load(os.path.join(path, f"chunk-{i:04d}.npy"),
                           allow_pickle=False)
             got_crc, got_nbytes = _leaf_meta(arr)
-            if got_crc != crc or got_nbytes != nbytes:
-                raise faultinj.StoreCorruptionError(
-                    f"store chunk {i} of {path} failed verification: "
-                    f"crc {got_crc:#x}!={crc:#x} or "
-                    f"nbytes {got_nbytes}!={nbytes}")
+            if len(meta) == 5:
+                # codec'd chunk (self-describing meta — works across
+                # runs/knob settings): verify the stored frame bytes,
+                # decode loudly, then verify the decoded leaf
+                crc, nbytes, cname, stored_crc, stored_nbytes = meta
+                if got_crc != stored_crc or got_nbytes != stored_nbytes:
+                    raise faultinj.StoreCorruptionError(
+                        f"store chunk {i} of {path} ({cname}) failed "
+                        f"stored-payload verification: crc "
+                        f"{got_crc:#x}!={stored_crc:#x} or nbytes "
+                        f"{got_nbytes}!={stored_nbytes}")
+                try:
+                    arr = _codec.decode_block(arr)
+                except _codec.CodecError as e:
+                    raise faultinj.StoreCorruptionError(
+                        f"store chunk {i} of {path}: corrupt {cname} "
+                        f"frame: {e}") from e
+                got_crc, got_nbytes = _leaf_meta(arr)
+                if got_nbytes != nbytes or (crc and got_crc != crc):
+                    raise faultinj.StoreCorruptionError(
+                        f"store chunk {i} of {path} failed decoded-leaf "
+                        f"verification: crc {got_crc:#x}!={crc:#x} or "
+                        f"nbytes {got_nbytes}!={nbytes}")
+            else:
+                crc, nbytes = meta
+                if got_crc != crc or got_nbytes != nbytes:
+                    raise faultinj.StoreCorruptionError(
+                        f"store chunk {i} of {path} failed verification: "
+                        f"crc {got_crc:#x}!={crc:#x} or "
+                        f"nbytes {got_nbytes}!={nbytes}")
             leaves.append(arr)
         return _decode(manifest["skeleton"], leaves)
 

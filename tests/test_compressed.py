@@ -1,0 +1,498 @@
+"""Compressed execution parity suite (columnar/encoded.py packed
+encodings, shuffle/service.py compressed rounds, mem/codec.py spill
+frames).
+
+The correctness contract is BIT-PARITY with the uncompressed path at
+every seam:
+
+* ``pack_bits``/``unpack_bits`` round-trip every width 1..32 including
+  full-range u32, and the device layout is interchangeable with the
+  host codec's ``np_pack_bits`` (same little-endian lane format);
+* ``encode_bitpacked``/``encode_for`` decode bit-exactly over valid
+  rows (negative ints, nulls, clustered wide-range keys), fall back to
+  the plain column when the range needs more than 32 residual bits,
+  and ``gather_bitpacked`` keeps gather outputs packed;
+* joins and group-bys fed packed key columns match the decoded plan on
+  both engines (keys.py lowers residual+reference in-trace);
+* the ShuffleService exchange under ``shuffle_compress=pack`` delivers
+  the same rows as the raw wire while moving fewer bytes (and ``auto``
+  packs dictionary codes/bools but leaves the plain-int wire exactly
+  as the legacy program), for both ``exchange`` and
+  ``exchange_stream``;
+* spill frames (``encode_block``/``decode_block``) round-trip
+  bit-exactly, the stored-bytes CRC detects disk damage BEFORE the
+  decoder runs (no damage laundering), and the three-tier spill walk
+  under ``spill_codec=pack`` shrinks the disk bytes while reading back
+  exactly.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_jni_tpu import config, faultinj
+from spark_rapids_jni_tpu.columnar import types as T
+from spark_rapids_jni_tpu.columnar.column import Column, ColumnBatch
+from spark_rapids_jni_tpu.columnar.encoded import (
+    BitPackedColumn,
+    FrameOfReferenceColumn,
+    choose_pack_width,
+    encode_bitpacked,
+    encode_column,
+    encode_for,
+    gather_bitpacked,
+    is_encoded,
+    materialize_batch,
+    pack_bits,
+    pack_bits_rows,
+    unpack_bits,
+    unpack_bits_rows,
+)
+from spark_rapids_jni_tpu.mem import SpillableHandle
+from spark_rapids_jni_tpu.mem import codec as codec_mod
+from spark_rapids_jni_tpu.mem import spill as spill_mod
+from spark_rapids_jni_tpu.relational import AggSpec, group_by, hash_join
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    yield
+    config.reset()
+    faultinj.configure({})
+
+
+def col(vals, t, valid=None):
+    vals = np.asarray(vals)
+    v = np.ones(len(vals), bool) if valid is None else np.asarray(valid, bool)
+    return Column(jnp.asarray(vals), jnp.asarray(v), t)
+
+
+def col_i64(vals, valid=None):
+    return col(np.asarray(vals, np.int64), T.INT64, valid)
+
+
+def col_i32(vals, valid=None):
+    return col(np.asarray(vals, np.int32), T.INT32, valid)
+
+
+# ---------------------------------------------------------------------------
+# lane-level pack/unpack
+# ---------------------------------------------------------------------------
+
+class TestPackBits:
+    @pytest.mark.parametrize("width", list(range(1, 33)))
+    def test_round_trip_every_width(self, width):
+        rng = np.random.default_rng(width)
+        # 97 rows: the last lane is partial and words straddle lane
+        # boundaries at every non-power-of-two width
+        n = 97
+        hi = (1 << width) - 1
+        words = rng.integers(0, hi + 1 if width < 32 else 1 << 32, n,
+                             dtype=np.uint64).astype(np.uint32)
+        lanes = pack_bits(jnp.asarray(words), width)
+        assert lanes.dtype == jnp.uint32
+        assert lanes.shape[0] == max(1, (n * width + 31) // 32)
+        got = np.asarray(unpack_bits(lanes, width, n))
+        assert np.array_equal(got, words)
+
+    def test_full_range_u32_values(self):
+        words = np.array([0, 1, 0xFFFFFFFF, 0x80000000, 0x7FFFFFFF],
+                         np.uint32)
+        lanes = pack_bits(jnp.asarray(words), 32)
+        assert np.array_equal(np.asarray(unpack_bits(lanes, 32, 5)), words)
+
+    @pytest.mark.parametrize("width", (1, 7, 12, 20, 31))
+    def test_host_device_layouts_interchange(self, width):
+        """The device packer emits the exact lane format of the host
+        codec's np_pack_bits — streams cross the boundary either way."""
+        rng = np.random.default_rng(width + 100)
+        n = 130
+        words = rng.integers(0, 1 << width, n, dtype=np.uint64).astype(
+            np.uint32)
+        dev = np.asarray(pack_bits(jnp.asarray(words), width))
+        host = codec_mod.np_pack_bits(words, width)
+        assert np.array_equal(dev[:host.shape[0]], host)
+        # device-packed -> host-unpacked and vice versa
+        assert np.array_equal(codec_mod.np_unpack_bits(dev, width, n), words)
+        got = np.asarray(unpack_bits(jnp.asarray(host), width, n))
+        assert np.array_equal(got, words)
+
+    def test_empty_and_bad_width(self):
+        assert np.asarray(unpack_bits(
+            pack_bits(jnp.zeros((0,), jnp.uint32), 5), 5, 0)).shape == (0,)
+        with pytest.raises(ValueError, match="width"):
+            pack_bits(jnp.zeros((4,), jnp.uint32), 0)
+        with pytest.raises(ValueError, match="width"):
+            unpack_bits(jnp.zeros((4,), jnp.uint32), 33, 4)
+
+    def test_rows_variant_packs_per_partition(self):
+        rng = np.random.default_rng(9)
+        words = rng.integers(0, 1 << 11, (4, 50), dtype=np.uint64).astype(
+            np.uint32)
+        lanes = pack_bits_rows(jnp.asarray(words), 11)
+        assert lanes.shape[0] == 4
+        got = np.asarray(unpack_bits_rows(lanes, 11, 50))
+        assert np.array_equal(got, words)
+        # each row independently matches the 1-D packer
+        for p in range(4):
+            one = np.asarray(pack_bits(jnp.asarray(words[p]), 11))
+            assert np.array_equal(np.asarray(lanes[p]), one)
+
+
+# ---------------------------------------------------------------------------
+# packed column encodings
+# ---------------------------------------------------------------------------
+
+class TestPackedEncodings:
+    def test_bitpacked_negatives_and_nulls(self):
+        rng = np.random.default_rng(3)
+        vals = rng.integers(-500, 40, 257)
+        valid = rng.random(257) > 0.2
+        c = col_i64(vals, valid)
+        enc = encode_bitpacked(c)
+        assert isinstance(enc, BitPackedColumn) and is_encoded(enc)
+        assert enc.reference == int(vals[valid].min())
+        assert enc.width == choose_pack_width(
+            vals[valid].min(), vals[valid].max()) or enc.width <= 32
+        dec = enc.decode()
+        gv = np.asarray(dec.validity)
+        assert np.array_equal(gv, valid)
+        assert np.array_equal(np.asarray(dec.data)[valid], vals[valid])
+        assert enc.to_pylist() == c.to_pylist()
+
+    def test_for_clustered_wide_range_packs_narrow(self):
+        """Per-block minima absorb cluster drift: a key family whose
+        GLOBAL range needs 31 bits packs in a few residual bits."""
+        rng = np.random.default_rng(5)
+        base = np.repeat(np.arange(8, dtype=np.int64) * (1 << 28), 128)
+        vals = base + rng.integers(0, 1 << 6, base.shape[0])
+        c = col_i64(vals)
+        enc = encode_for(c, block=128)
+        assert isinstance(enc, FrameOfReferenceColumn)
+        assert enc.num_blocks == 8
+        assert enc.width <= 6 + 1
+        # the plain bitpack of the same column needs the global range
+        flat = encode_bitpacked(c)
+        assert flat.width > enc.width
+        assert np.array_equal(np.asarray(enc.values64()), vals)
+        assert enc.to_pylist() == c.to_pylist()
+
+    def test_wide_range_falls_back_to_plain(self):
+        c = col_i64([0, 1 << 40])
+        assert encode_bitpacked(c) is c
+        f = encode_for(col_i64([0, 1 << 40]), block=1024)
+        assert isinstance(f, Column)  # both rows in one block: fallback
+        assert choose_pack_width(0, 1 << 40) is None
+
+    def test_gather_stays_packed_and_matches_take(self):
+        rng = np.random.default_rng(7)
+        vals = rng.integers(-10, 90, 200)
+        c = col_i64(vals, rng.random(200) > 0.1)
+        enc = encode_bitpacked(c)
+        idx = jnp.asarray(rng.integers(0, 200, 64))
+        out = gather_bitpacked(enc, idx)
+        assert isinstance(out, BitPackedColumn)
+        assert out.width == enc.width and out.reference == enc.reference
+        want = np.asarray(c.data)[np.asarray(idx)]
+        wantv = np.asarray(c.validity)[np.asarray(idx)]
+        dec = out.decode()
+        assert np.array_equal(np.asarray(dec.validity), wantv)
+        assert np.array_equal(np.asarray(dec.data)[wantv], want[wantv])
+
+    def test_choose_pack_width_buckets(self):
+        assert choose_pack_width(0, 1) == 1
+        assert choose_pack_width(0, 3) == 2
+        assert choose_pack_width(-50, 50) == 8      # range 100 -> 7 -> 8
+        assert choose_pack_width(0, 1000) == 12     # 10 bits -> 12 bucket
+        assert choose_pack_width(0, (1 << 32) - 1) == 32
+        assert choose_pack_width(0, 1 << 32) is None
+        assert choose_pack_width(5, 4) is None      # inverted range
+
+
+# ---------------------------------------------------------------------------
+# relational operators on packed keys (late materialization in keys.py)
+# ---------------------------------------------------------------------------
+
+def _pl(batch, count):
+    n = int(count)
+    return {c: batch[c].to_pylist()[:n] for c in batch.names}
+
+
+class TestRelationalPackedKeys:
+    @pytest.mark.parametrize("how", ("inner", "left", "full", "anti"))
+    def test_join_parity_bitpacked_keys(self, how):
+        rng = np.random.default_rng(11)
+        lk, rk = rng.integers(0, 40, 150), rng.integers(20, 60, 50)
+        left = ColumnBatch({"k": col_i64(lk),
+                            "lv": col_i32(rng.integers(0, 99, 150))})
+        right = ColumnBatch({"k": col_i64(rk),
+                             "rv": col_i32(rng.integers(0, 99, 50))})
+        eleft = ColumnBatch({"k": encode_bitpacked(left["k"]),
+                             "lv": left["lv"]})
+        eright = ColumnBatch({"k": encode_for(right["k"], block=16),
+                              "rv": right["rv"]})
+        rd, cd = hash_join(left, right, ["k"], ["k"], how, capacity=2048)
+        re_, ce = hash_join(eleft, eright, ["k"], ["k"], how, capacity=2048)
+        assert _pl(materialize_batch(rd), cd) == _pl(
+            materialize_batch(re_), ce)
+
+    @pytest.mark.parametrize("engine", ("sort", "scatter"))
+    def test_groupby_parity_packed_keys(self, engine):
+        rng = np.random.default_rng(13)
+        n = 300
+        batch = ColumnBatch({
+            "k": col_i64(rng.integers(-8, 8, n), rng.random(n) > 0.1),
+            "v": col_i32(rng.integers(-100, 100, n))})
+        aggs = [AggSpec("count", None, "c"), AggSpec("sum", "v", "s"),
+                AggSpec("min", "v", "mn"), AggSpec("max", "v", "mx")]
+        enc = ColumnBatch({"k": encode_bitpacked(batch["k"]),
+                           "v": batch["v"]})
+        rd, nd = group_by(batch, ["k"], aggs, engine=engine)
+        re_, ne = group_by(enc, ["k"], aggs, engine=engine)
+        assert _pl(materialize_batch(rd), nd) == _pl(
+            materialize_batch(re_), ne)
+
+
+# ---------------------------------------------------------------------------
+# compressed shuffle rounds (8 virtual devices)
+# ---------------------------------------------------------------------------
+
+P8 = 8
+
+
+def _digest(res):
+    b = materialize_batch(res.batch)
+    occ = np.asarray(jax.device_get(res.occupancy))
+    return [np.asarray(jax.device_get(b[n].data))[occ] for n in b.names]
+
+
+def _assert_same(a_cols, b_cols):
+    for a, b in zip(a_cols, b_cols):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        assert np.array_equal(a, b)
+
+
+class TestShuffleCompress:
+    def _mixed_batch(self, mesh, n, seed=0):
+        from spark_rapids_jni_tpu.parallel import shard_batch
+        rng = np.random.default_rng(seed)
+        return shard_batch(ColumnBatch({
+            "k": col_i64(rng.integers(0, 1000, n)),
+            "q": col_i32(rng.integers(-50, 50, n)),
+            "flag": col(rng.integers(0, 2, n).astype(bool), T.BOOLEAN),
+            "price": col(rng.standard_normal(n).astype(np.float32),
+                         T.FLOAT32),
+        }), mesh)
+
+    def test_exchange_pack_bit_parity_fewer_bytes(self, eight_devices):
+        from spark_rapids_jni_tpu.parallel import data_mesh
+        from spark_rapids_jni_tpu.shuffle import (
+            ShuffleRegistry, ShuffleService)
+        mesh = data_mesh(P8)
+        n = P8 * 256
+        batch = self._mixed_batch(mesh, n)
+        svc = ShuffleService(mesh, registry=ShuffleRegistry())
+        config.set("shuffle_compress", "off")
+        r_off = svc.exchange(batch, key_names=("k",))
+        config.set("shuffle_compress", "pack")
+        r_pack = svc.exchange(batch, key_names=("k",))
+        _assert_same(_digest(r_off), _digest(r_pack))
+        assert r_pack.rows_moved == r_off.rows_moved == n
+        # 12-bit keys + 8-bit quantities + 1-bit flags beat the 1.5x bar
+        assert r_pack.bytes_moved * 1.5 <= r_off.bytes_moved
+        assert r_pack.compressed_bytes_saved > 0
+        assert r_off.compressed_bytes_saved == 0
+        snap = svc.registry.metrics.snapshot()
+        assert snap["compressed_bytes_saved"] >= \
+            r_pack.compressed_bytes_saved
+
+    def test_auto_packs_dict_codes_and_bools(self, eight_devices):
+        from spark_rapids_jni_tpu.parallel import data_mesh, shard_batch
+        from spark_rapids_jni_tpu.shuffle import (
+            ShuffleRegistry, ShuffleService)
+        mesh = data_mesh(P8)
+        n = P8 * 256
+        rng = np.random.default_rng(1)
+        db = shard_batch(ColumnBatch({
+            "k": col_i64(rng.integers(0, 500, n)),
+            "s": encode_column(col_i64(rng.integers(0, 4, n))),
+            "flag": col(rng.integers(0, 2, n).astype(bool), T.BOOLEAN),
+        }), mesh)
+        svc = ShuffleService(mesh, registry=ShuffleRegistry())
+        config.set("shuffle_compress", "off")
+        a_off = svc.exchange(db, key_names=("k",))
+        config.set("shuffle_compress", "auto")
+        a_auto = svc.exchange(db, key_names=("k",))
+        _assert_same(_digest(a_off), _digest(a_auto))
+        assert a_auto.compressed_bytes_saved > 0
+        assert a_auto.bytes_moved < a_off.bytes_moved
+
+    def test_plain_auto_keeps_legacy_wire(self, eight_devices):
+        """auto on a plain fixed-width batch is byte-for-byte the legacy
+        program: no pack plan, no saved bytes, same wire size."""
+        from spark_rapids_jni_tpu.parallel import data_mesh
+        from spark_rapids_jni_tpu.shuffle import (
+            ShuffleRegistry, ShuffleService)
+        mesh = data_mesh(P8)
+        n = P8 * 128
+        batch = self._mixed_batch(mesh, n, seed=2)
+        svc = ShuffleService(mesh, registry=ShuffleRegistry())
+        config.set("shuffle_compress", "off")
+        r_off = svc.exchange(batch, key_names=("k",))
+        config.set("shuffle_compress", "auto")
+        r_auto = svc.exchange(batch, key_names=("k",))
+        assert r_auto.compressed_bytes_saved == 0
+        assert r_auto.bytes_moved == r_off.bytes_moved
+        _assert_same(_digest(r_off), _digest(r_auto))
+
+    def test_stream_pack_parity(self, eight_devices):
+        from spark_rapids_jni_tpu.parallel import data_mesh, shard_batch
+        from spark_rapids_jni_tpu.shuffle import (
+            ShuffleRegistry, ShuffleService)
+        mesh = data_mesh(P8)
+        n = P8 * 256
+        rng = np.random.default_rng(3)
+        k = rng.integers(0, 700, n)
+        q = rng.integers(-30, 30, n)
+        flag = rng.integers(0, 2, n).astype(bool)
+
+        def morsels():
+            for i in range(4):
+                lo, hi = i * n // 4, (i + 1) * n // 4
+                yield shard_batch(ColumnBatch({
+                    "k": col_i64(k[lo:hi]),
+                    "q": col_i32(q[lo:hi]),
+                    "flag": col(flag[lo:hi], T.BOOLEAN),
+                }), mesh)
+
+        svc = ShuffleService(mesh, registry=ShuffleRegistry())
+        config.set("shuffle_compress", "off")
+        s_off = svc.exchange_stream(morsels(), key_names=("k",))
+        config.set("shuffle_compress", "pack")
+        s_pack = svc.exchange_stream(morsels(), key_names=("k",))
+        _assert_same(_digest(s_off), _digest(s_pack))
+        assert s_pack.rows_moved == n
+        assert s_pack.compressed_bytes_saved > 0
+        assert s_pack.bytes_moved < s_off.bytes_moved
+
+
+# ---------------------------------------------------------------------------
+# spill codec frames and the codec'd tier walk
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def framework(tmp_path):
+    fw = spill_mod.install(spill_dir=str(tmp_path / "spill"))
+    yield fw
+    spill_mod.shutdown()
+
+
+class TestSpillCodecFrames:
+    def test_pack_frame_round_trip(self):
+        rng = np.random.default_rng(17)
+        arr = rng.integers(0, 4096, 10000).astype(np.int64)
+        payload = codec_mod.encode_block(arr, "pack")
+        assert codec_mod.codec_name(payload) == "pack"
+        assert payload.nbytes < arr.nbytes
+        got = codec_mod.decode_block(payload)
+        assert got.dtype == arr.dtype and np.array_equal(got, arr)
+
+    def test_block_frame_round_trip(self):
+        arr = np.repeat(np.arange(8, dtype=np.int64), 512)
+        payload = codec_mod.encode_block(arr, "block")
+        assert codec_mod.codec_name(payload) == "block"
+        assert payload.nbytes < arr.nbytes
+        got = codec_mod.decode_block(payload)
+        assert np.array_equal(got, arr)
+
+    def test_incompressible_stays_lossless(self):
+        """Full-entropy floats gain nothing — the frame still decodes
+        bit-exactly (raw body fallback inside the codec)."""
+        rng = np.random.default_rng(19)
+        arr = rng.standard_normal(4096)
+        for codec in ("raw", "pack", "block"):
+            got = codec_mod.decode_block(codec_mod.encode_block(arr, codec))
+            assert np.array_equal(got.view(np.uint8), arr.view(np.uint8))
+
+    def test_garbage_rejected_loudly(self):
+        junk = np.frombuffer(b"not a SRCK frame at all" * 4, np.uint8)
+        with pytest.raises(codec_mod.CodecError):
+            codec_mod.decode_block(junk.copy())
+
+    def test_invalid_knob_rejected(self, framework):
+        config.set("spill_codec", "bogus")
+        h = SpillableHandle({"x": jnp.arange(64, dtype=jnp.int32)},
+                            name="bad")
+        h.spill()
+        with pytest.raises(ValueError, match="spill_codec"):
+            h.spill_host()
+        h.close()
+
+
+class TestSpillCodecTierWalk:
+    @pytest.mark.parametrize("codec", ("pack", "block"))
+    def test_three_tier_round_trip_shrinks_disk(self, framework, codec):
+        config.set("spill_codec", codec)
+        rng = np.random.default_rng(23)
+        tree = {"k": jnp.asarray(
+                    np.repeat(rng.integers(0, 16, 512), 16).astype(np.int64)),
+                "v": jnp.asarray(rng.integers(0, 200, 4096).astype(np.int64))}
+        want = {n: np.asarray(a) for n, a in tree.items()}
+        h = SpillableHandle(tree, name=f"codec-{codec}")
+        h.spill()
+        h.spill_host()
+        assert h.tier == "disk"
+        got = h.get()
+        for n, a in want.items():
+            assert np.array_equal(np.asarray(got[n]), a)
+        m = framework.metrics.snapshot()
+        assert m["compressed_bytes"] > 0
+        assert m["precompress_bytes"] > m["compressed_bytes"]
+        assert m["codec_ratio"] > 1.0
+        h.close()
+
+    def test_disk_damage_detected_before_decode(self, framework):
+        """The STORED-bytes CRC fires before decode_block ever runs: a
+        flipped frame raises SpillCorruptionError, never a laundered
+        decode or a CodecError."""
+        config.set("spill_codec", "pack")
+        faultinj.configure({"faults": [
+            {"match": "spill_corrupt_file", "fault": "spill_corrupt",
+             "count": 1}]})
+        h = SpillableHandle(
+            {"x": jnp.arange(4096, dtype=jnp.int64)}, name="dmg")
+        h.spill()
+        h.spill_host()
+        with pytest.raises(faultinj.SpillCorruptionError):
+            h.get()
+        h.close()
+
+    def test_damage_recovers_via_lineage(self, framework):
+        config.set("spill_codec", "pack")
+        make = lambda: {"x": jnp.asarray(
+            np.random.default_rng(29).integers(0, 50, 4096))}
+        want = np.asarray(make()["x"])
+        faultinj.configure({"faults": [
+            {"match": "spill_corrupt_file", "fault": "spill_corrupt",
+             "count": 1}]})
+        h = SpillableHandle(make(), name="heal", recompute=make)
+        h.spill()
+        h.spill_host()
+        got = h.get()  # detect -> discard -> rebuild from lineage
+        assert np.array_equal(np.asarray(got["x"]), want)
+        h.close()
+
+    def test_codec_off_keeps_raw_disk_bytes(self, framework):
+        config.set("spill_codec", "off")
+        h = SpillableHandle({"x": jnp.arange(1024, dtype=jnp.int64)},
+                            name="raw")
+        h.spill()
+        h.spill_host()
+        got = h.get()
+        assert np.array_equal(np.asarray(got["x"]), np.arange(1024))
+        m = framework.metrics.snapshot()
+        assert m["compressed_bytes"] == m["precompress_bytes"]
+        assert m["codec_ratio"] == 1.0
+        h.close()

@@ -962,6 +962,81 @@ class TestGL013:
 
 
 # ---------------------------------------------------------------------------
+# GL014 — decode-at-wrong-seam (unpack outside the sanctioned seams)
+# ---------------------------------------------------------------------------
+
+
+class TestGL014:
+    def test_unpack_and_materialize_off_seam_flagged(self, tmp_path):
+        res = lint(tmp_path, {"shuffle/service.py": """
+            from ..columnar.encoded import unpack_bits_rows
+
+            def _drain_round(self, chunk, capacity):
+                # widening mid-round: the store/spill path downstream
+                # pays full-width bytes
+                rows = unpack_bits_rows(chunk, 12, capacity)
+                col = self.pending.materialize()
+                return rows, col
+        """}, rules=["GL014"])
+        assert new_rules(res) == [("GL014", "shuffle/service.py"),
+                                  ("GL014", "shuffle/service.py")]
+        assert "sanctioned" in res.new[0].message
+
+    def test_spill_py_scoped_and_module_scope_flagged(self, tmp_path):
+        res = lint(tmp_path, {"mem/spill.py": """
+            from ..columnar.encoded import unpack_bits
+
+            _EAGER = unpack_bits(_LANES, 8, 64)
+        """}, rules=["GL014"])
+        assert new_rules(res) == [("GL014", "mem/spill.py")]
+
+    def test_sanctioned_seams_and_struct_unpack_clean(self, tmp_path):
+        res = lint(tmp_path, {
+            "spark_rapids_jni_tpu/shuffle/service.py": """
+                import struct
+                from ..columnar.encoded import unpack_bits_rows
+
+                def _unpack_chunk_tree(out, occ, plan, capacity):
+                    def _leaf(leaf, w):
+                        # nested helper inherits the seam's sanction
+                        return unpack_bits_rows(leaf, w, capacity)
+                    return _leaf(out, 12), unpack_bits_rows(occ, 1, capacity)
+
+                def _read_header(self, head):
+                    # attribute unpack: header parsing, not payload widening
+                    (hlen,) = struct.unpack_from("<I", head, 8)
+                    return hlen
+            """,
+            "spark_rapids_jni_tpu/mem/spill.py": """
+                from .codec import np_unpack_bits
+
+                def _read_disk_verified_locked(self, path, meta):
+                    return np_unpack_bits(self._load(path), 8, 64)
+            """}, rules=["GL014"])
+        assert res.new == []
+
+    def test_out_of_scope_files_clean(self, tmp_path):
+        res = lint(tmp_path, {
+            # encoded.py and friends are GL009's jurisdiction, not GL014's
+            "spark_rapids_jni_tpu/columnar/encoded.py": """
+                def decode_all(lanes, w, n):
+                    return unpack_bits(lanes, w, n)
+            """,
+            "tests/test_shuffle_x.py": """
+                def test_roundtrip():
+                    assert unpack_bits_rows(x, 4, 8) is not None
+            """}, rules=["GL014"])
+        assert res.new == []
+
+    def test_suppressed(self, tmp_path):
+        res = lint(tmp_path, {"shuffle/debug.py": """
+            def dump(chunk, capacity):
+                return unpack_bits_rows(chunk, 4, capacity)  # graftlint: disable=GL014
+        """}, rules=["GL014"])
+        assert res.new == [] and res.counts()["suppressed"] == 1
+
+
+# ---------------------------------------------------------------------------
 # baseline ratchet
 # ---------------------------------------------------------------------------
 
@@ -1077,4 +1152,4 @@ class TestLiveTree:
         ids = [r.id for r in rules_mod.all_rules()]
         assert ids == ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
                        "GL007", "GL008", "GL009", "GL010", "GL011", "GL012",
-                       "GL013"]
+                       "GL013", "GL014"]

@@ -968,7 +968,13 @@ class DataPlaneScenario:
                         raise  # whole-scenario replacement
                     except (WorkerLost, AdmissionShed,
                             faultinj.TaskCancelled, faultinj.InjectedFault,
-                            QueryCancelled, RetryOOM):
+                            QueryCancelled, RetryOOM,
+                            # a session whose damaged-transfer budget
+                            # (serve_max_readmissions) ran out fails
+                            # loudly with the data-plane error — absorb
+                            # it into THIS loop's bounded re-submission,
+                            # like any other killed session
+                            dp.DataPlaneCorruption, dp.DataPlaneStale):
                         kills += 1
                         attempts[i] += 1
                         if attempts[i] >= _MAX_ATTEMPTS:
@@ -1065,7 +1071,9 @@ def single_fault_trials(fast: bool = False) -> List[Trial]:
             rule["skip"] = skip
         tag = kind + (f"+skip{skip}" if skip else "")
         if engines:
-            tag += "+pallas"
+            vals = sorted(set(engines.values()))
+            tag += "+" + ("pallas" if vals == ["pallas"]
+                          else "+".join(vals))
         t.append(Trial(scenario, [rule], f"{scenario}:{match}[{tag}]",
                        expect_recovered=expect_recovered, engines=engines))
 
@@ -1083,6 +1091,20 @@ def single_fault_trials(fast: bool = False) -> List[Trial]:
     # cascade) detects them and lineage rebuilds — recovery INSIDE run()
     one("spill", "host_corrupt_probe", "host_corrupt")
     one("spill", "host_corrupt_probe", "host_corrupt", skip=1)
+    # r15: the codec'd spill tiers under fire — the same corruption
+    # trials with the stored bytes riding the pack / block codecs.
+    # Corruption now lands in a COMPRESSED frame (the probe flips the
+    # frame header too), so the stored-CRC → decode → leaf-CRC verify
+    # chain must catch it and lineage-rebuild; the digest check against
+    # the DEFAULT-knob (codec off) baseline makes every trial a
+    # bit-identity proof for the codec round trip as well.  host_corrupt
+    # additionally proves damage laundering stays impossible: host-tier
+    # flips encoded INTO a valid frame still fail the decoded-leaf CRC.
+    for codec in ("pack", "block"):
+        one("spill", "spill_corrupt_file", "spill_corrupt",
+            engines={"spill_codec": codec})
+        one("spill", "host_corrupt_probe", "host_corrupt",
+            engines={"spill_codec": codec})
 
     # shuffle scenario: transport seam, step seam, and spilled-buffer
     # damage that must recover via map lineage
@@ -1090,6 +1112,14 @@ def single_fault_trials(fast: bool = False) -> List[Trial]:
     one("shuffle", "shuffle_io_round", "oom")
     one("shuffle", "spill_corrupt_file", "spill_corrupt",
         expect_recovered=True)
+    # r15: the compressed wire under fire — the same spilled-buffer
+    # damage with every round chunk crossing the all_to_all bit-packed
+    # (shuffle_compress=pack).  The chunk spills AS lane words and the
+    # lineage redrive re-packs; the digest check against the
+    # DEFAULT-knob baseline proves the packed exchange is bit-identical
+    # through corruption recovery.
+    one("shuffle", "spill_corrupt_file", "spill_corrupt",
+        expect_recovered=True, engines={"shuffle_compress": "pack"})
     if not fast:
         one("shuffle", "shuffle_io_round", "shuffle_io", skip=1)
         one("shuffle", "chaos_shuffle_step", "exception")
@@ -1226,6 +1256,16 @@ def single_fault_trials(fast: bool = False) -> List[Trial]:
             expect_recovered=True)
         one("store_recovery", "store_corrupt_file", "store_corrupt",
             expect_recovered=True)
+        # r15: the codec'd durable plane — commits ride the pack codec
+        # (spill_codec exported to the worker processes through the env
+        # layer), post-commit damage lands in compressed frames, and
+        # adoption's stored-CRC → decode → leaf-CRC chain must
+        # quarantine and lineage-rebuild to the codec-off baseline's
+        # exact digest
+        one("store_recovery", "store_corrupt_file", "store_corrupt",
+            expect_recovered=True, engines={"spill_codec": "pack"})
+        one("store_recovery", "serve_step", "worker_crash", skip=2,
+            expect_recovered=True, engines={"spill_codec": "pack"})
 
     # dataplane scenario: the zero-copy result path.  shm_torn /
     # shm_stale fire ONLY here and in the data-plane tests — these
@@ -1360,18 +1400,32 @@ def _pinned_engines(engines: Optional[Dict[str, str]]):
     """Pin engine knobs for one trial, restoring the previous values on
     the way out.  Pinned trials are still digest-compared against the
     scenario's DEFAULT-engine fault-free baseline, so the comparison
-    doubles as the engine bit-identity assertion under fire."""
+    doubles as the engine bit-identity assertion under fire.
+
+    Each pin is ALSO exported as its ``SPARK_RAPIDS_TPU_<KEY>`` env var:
+    the frontdoor-family scenarios (frontdoor / store_recovery /
+    multihost / dataplane) spawn worker PROCESSES inside the trial, and
+    those read knobs through the config env layer — without the export a
+    codec pin would apply only to the supervisor."""
     if not engines:
         yield
         return
     saved = {k: config.get(k) for k in engines}
+    env_names = {k: "SPARK_RAPIDS_TPU_" + k.upper() for k in engines}
+    saved_env = {ev: os.environ.get(ev) for ev in env_names.values()}
     try:
         for k, v in engines.items():
             config.set(k, v)
+            os.environ[env_names[k]] = str(v)
         yield
     finally:
         for k, v in saved.items():
             config.set(k, v)
+        for ev, v in saved_env.items():
+            if v is None:
+                os.environ.pop(ev, None)
+            else:
+                os.environ[ev] = v
 
 
 def _run_with_replacement(scenario) -> Dict:

@@ -1385,6 +1385,80 @@ class GL013PallasInterpretDrift(Rule):
                     "thread the caller's kwarg) instead of a constant")
 
 
+# ---------------------------------------------------------------------------
+# GL014 — decode-at-wrong-seam: compressed wire/spill payloads unpacked
+# outside the sanctioned decode points
+# ---------------------------------------------------------------------------
+
+# The compressed-execution contract: a packed shuffle chunk stays lane
+# words from the sender's pack step through the round store, adoption,
+# and spill, and is widened exactly once — at reassembly, inside
+# shuffle/service.py's `_unpack_chunk_tree`.  A codec'd spill payload
+# stays frame bytes on disk and is widened exactly once — after the
+# stored-CRC check, inside mem/spill.py's `_read_disk_verified_locked`.
+_GL014_SANCTIONED_FNS = frozenset({
+    "_unpack_chunk_tree",          # shuffle/service.py reassembly seam
+    "_read_disk_verified_locked",  # mem/spill.py verified-read seam
+})
+
+
+class GL014DecodeAtWrongSeam(Rule):
+    """An ``unpack_*(...)`` call or a zero-arg ``.materialize()`` inside
+    the shuffle plane (``shuffle/``) or the spill framework
+    (``mem/spill.py``) outside the sanctioned seams widens a compressed
+    payload at the WRONG point: the bytes ship/persist full-width while
+    ``compressed_bytes_saved`` / ``codec_ratio`` still claim the packed
+    plan ran — and a decode that drifts ahead of the stored-CRC check
+    turns a detectable corrupt frame into silently wrong values.  The
+    GL009 analysis applied to the r15 compressed data plane: decode at
+    reassembly (``_unpack_chunk_tree``) or after disk verification
+    (``_read_disk_verified_locked``), nowhere else.  ``struct.unpack``
+    attribute calls and the seams' own nested helpers are clean."""
+
+    id = "GL014"
+
+    @staticmethod
+    def _in_scope(relpath: str) -> bool:
+        return ("shuffle" in relpath.split("/")[:-1]
+                or relpath.endswith("mem/spill.py"))
+
+    def check(self, pf: ParsedFile) -> Iterable[Finding]:
+        if pf.is_test_file or not self._in_scope(pf.relpath):
+            return
+        yield from self._scan(pf, pf.tree, sanctioned=False)
+
+    def _scan(self, pf, node, sanctioned: bool):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._scan(
+                    pf, child,
+                    sanctioned or child.name in _GL014_SANCTIONED_FNS)
+                continue
+            if not sanctioned and isinstance(child, ast.Call):
+                func = child.func
+                if (isinstance(func, ast.Name)
+                        and func.id.startswith("unpack_")):
+                    yield pf.finding(
+                        self.id, child,
+                        f"`{func.id}(...)` outside the sanctioned decode "
+                        "seams widens a packed payload mid-plane — the "
+                        "wire/spill path downstream pays full-width bytes "
+                        "while the compression metrics still claim the "
+                        "packed plan ran; decode at reassembly "
+                        "(_unpack_chunk_tree) or after the stored-CRC "
+                        "check (_read_disk_verified_locked)")
+                elif (isinstance(func, ast.Attribute)
+                        and func.attr == "materialize"
+                        and not child.args and not child.keywords):
+                    yield pf.finding(
+                        self.id, child,
+                        "zero-arg `.materialize()` inside the compressed "
+                        "data plane decodes an encoded column at the wrong "
+                        "seam — keep chunks packed through store/spill and "
+                        "widen only at the sanctioned decode points")
+            yield from self._scan(pf, child, sanctioned)
+
+
 _ALL: List[Rule] = [GL001TracerLeak(), GL002HostSyncUnderJit(),
                     GL003RetraceHazard(), GL004SpillHandleLeak(),
                     GL005ConfigDrift(), GL006FaultKindDrift(),
@@ -1393,7 +1467,8 @@ _ALL: List[Rule] = [GL001TracerLeak(), GL002HostSyncUnderJit(),
                     GL010ShardingConstraintDrift(),
                     GL011ServeSessionLeak(),
                     GL012FrontDoorHandleLeak(),
-                    GL013PallasInterpretDrift()]
+                    GL013PallasInterpretDrift(),
+                    GL014DecodeAtWrongSeam()]
 
 
 def all_rules(only: Optional[Sequence[str]] = None) -> List[Rule]:
