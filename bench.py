@@ -49,6 +49,13 @@ wire-byte ratio bytes_moved_off / bytes_moved_pack (only-shrinks floor
 ``shuffle_compress_floor`` in ci/q95_floor.json), and a second
 ``spill_codec_roundtrip`` micro row round-trips representative spill
 payloads through the mem/codec frames.
+
+``python bench.py --cache`` replays a zipf-skewed q6/q95/q9-shaped
+trace through a 2-worker FrontDoor with the fleet result cache on:
+repeats must be served from sealed cached Arrow segments bit-identically
+with zero compute, the hit rate must clear 0.5, and ``vs_baseline`` is
+p99_miss / p99_hit (only-shrinks floor ``result_cache_floor`` in
+ci/q95_floor.json).
 """
 
 import json
@@ -920,6 +927,146 @@ def serve_main():
             "recovery_ms": round(recovery_ms, 2),
             "recovery_vs": round(replay_ms / recovery_ms, 3)
             if recovery_ms else 0.0,
+        },
+    }), flush=True)
+    return 0
+
+
+# --------------------------------------------------------------------------
+# result-cache scenario (--cache): replayed traffic served with zero compute
+# --------------------------------------------------------------------------
+
+def cache_main():
+    """Replayed heavy-traffic trace through a 2-worker FrontDoor with the
+    fleet result cache on: a zipf-skewed repeat stream over a small
+    universe of q6/q95/q9-shaped ``arrow_batch`` queries, every submit
+    declaring its input's content snapshot id.  The first occurrence of
+    each distinct query computes live in a worker and its encoded Arrow
+    IPC segment is inserted; every repeat must be served straight from
+    the supervisor's sealed cache — before admission, with zero worker
+    dispatch — and re-verified under a fresh descriptor (fence epoch,
+    snapshot id, chunk CRCs) exactly like a live result.  Every result,
+    hit or miss, must match the solo in-process ``batch_digest`` bit for
+    bit, and the child fails outright when the replayed trace's hit rate
+    drops to 0.5 or below.  ``vs_baseline`` is p99_miss / p99_hit — the
+    latency a cache hit removes — riding the ci/q95_floor.json
+    ``result_cache_floor`` ratchet."""
+    import jax
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        platform = jax.devices()[0].platform
+    except Exception as e:  # backend init failure → parent falls back
+        print(f"# backend init failed: {e}", file=sys.stderr, flush=True)
+        return 17
+
+    import random
+
+    from spark_rapids_jni_tpu.serve import FrontDoor
+    from spark_rapids_jni_tpu.serve import data_plane as dp_mod
+    from spark_rapids_jni_tpu.serve import result_cache as rc_mod
+    from spark_rapids_jni_tpu.serve.worker import make_result_batch
+
+    n_submits = int(os.environ.get("BENCH_CACHE_SUBMITS", "96"))
+    per_shape = int(os.environ.get("BENCH_CACHE_UNIVERSE", "4"))
+    zipf_s = 1.2
+    # the three trace shapes: q6-sized scans, the wide q95 join shape,
+    # and the small adaptive q9 — distinct row counts so hits span
+    # segment sizes, seeds disjoint per (shape, id)
+    shapes = (("q6", int(os.environ.get("BENCH_CACHE_Q6_ROWS", "2048"))),
+              ("q95", int(os.environ.get("BENCH_CACHE_Q95_ROWS", "4096"))),
+              ("q9", int(os.environ.get("BENCH_CACHE_Q9_ROWS", "1024"))))
+    universe = [(shape, rows, 100 * si + qi)
+                for si, (shape, rows) in enumerate(shapes)
+                for qi in range(per_shape)]
+    # zipf-skewed replay: rank r drawn with weight 1/(r+1)^s — the
+    # repeated-query head dominates, the tail keeps inserting
+    rng = random.Random(int(os.environ.get("BENCH_CACHE_SEED", "7")))
+    weights = [1.0 / (r + 1) ** zipf_s for r in range(len(universe))]
+    trace = rng.choices(universe, weights=weights, k=n_submits)
+    for q in universe:  # every distinct query appears at least once
+        if q not in trace:
+            trace[rng.randrange(len(trace))] = q
+
+    solo = {q: dp_mod.batch_digest(make_result_batch(q[1], q[2]))
+            for q in set(trace)}
+    snaps = {q: rc_mod.snapshot_for_obj(
+        {"shape": q[0], "rows": q[1], "seed": q[2], "gen": 0})
+        for q in set(trace)}
+
+    def _pct(vals, q):
+        vals = sorted(vals)
+        return vals[min(len(vals) - 1, int(round(q * (len(vals) - 1))))]
+
+    fd = FrontDoor(workers=2, max_concurrent=4)
+    hit_lat, miss_lat, drift = [], [], []
+    rows_served = 0
+    t0 = time.perf_counter()
+    try:
+        for shape, rows, seed in trace:
+            q = (shape, rows, seed)
+            qt0 = time.perf_counter()
+            sess = fd.submit("arrow_batch", {"rows": rows, "seed": seed},
+                             tenant=f"trace-{shape}", snapshot=snaps[q])
+            batch = sess.result(timeout=300.0)
+            lat_ms = (time.perf_counter() - qt0) * 1e3
+            (hit_lat if sess.served_from_cache else miss_lat).append(lat_ms)
+            rows_served += rows
+            if dp_mod.batch_digest(batch) != solo[q]:
+                drift.append(q)
+        wall = time.perf_counter() - t0
+    except Exception as e:
+        print(f"# cache scenario failed: {e!r}", file=sys.stderr,
+              flush=True)
+        return 1
+    finally:
+        report = fd.shutdown()
+    if drift:
+        print(f"# cache scenario: served results DIFFER from solo for "
+              f"{sorted(set(drift))}", file=sys.stderr, flush=True)
+        return 1
+    if not report["clean"]:
+        print(f"# cache scenario: fleet shutdown unclean: "
+              f"{report['workers']}", file=sys.stderr, flush=True)
+        return 1
+    rc_info = report["result_cache"]
+    hit_rate = len(hit_lat) / max(1, n_submits)
+    if hit_rate <= 0.5:
+        print(f"# cache scenario: hit rate {hit_rate:.2f} <= 0.5 over "
+              f"{n_submits} replayed submits ({len(miss_lat)} misses) — "
+              f"the cache is not serving the repeat traffic",
+              file=sys.stderr, flush=True)
+        return 1
+    if rc_info["stale_rejected"] or rc_info["corrupt_quarantined"]:
+        print(f"# cache scenario: fault-free replay rejected serves: "
+              f"{rc_info}", file=sys.stderr, flush=True)
+        return 1
+    p99_hit = _pct(hit_lat, 0.99)
+    p99_miss = _pct(miss_lat, 0.99)
+    print(json.dumps({
+        "metric": "result_cache_replay_throughput",
+        "value": round(rows_served / wall / 1e6, 3),
+        "unit": "Mrows/s",
+        "vs_baseline": round(p99_miss / p99_hit, 3) if p99_hit else 0.0,
+        "platform": platform,
+        "rows": rows_served,
+        "note": {
+            "submits": n_submits,
+            "universe": len(universe),
+            "zipf_s": zipf_s,
+            "shapes": [s for s, _ in shapes],
+            "workers": 2,
+            "hits": len(hit_lat),
+            "misses": len(miss_lat),
+            "hit_rate": round(hit_rate, 3),
+            "bit_identical": True,
+            "p50_hit_ms": round(_pct(hit_lat, 0.5), 2),
+            "p99_hit_ms": round(p99_hit, 2),
+            "p50_miss_ms": round(_pct(miss_lat, 0.5), 2),
+            "p99_miss_ms": round(p99_miss, 2),
+            "hit_bytes_served": int(rc_info["hit_bytes_served"]),
+            "cache_inserts": int(rc_info["inserts"]),
         },
     }), flush=True)
     return 0
@@ -2552,6 +2699,8 @@ def main():
         sys.exit(compress_main())
     if mode == "--child-multidevice":
         sys.exit(multidevice_main())
+    if mode == "--child-cache":
+        sys.exit(cache_main())
     if mode == "--probe":
         sys.exit(_probe_main())
 
@@ -2563,6 +2712,7 @@ def main():
     run_scan = mode == "--scan"
     run_compress = mode == "--compress"
     run_multidevice = mode == "--multidevice"
+    run_cache = mode == "--cache"
     child_mode = ("--child-micro" if run_micro
                   else "--child-spill" if run_spill
                   else "--child-serve" if run_serve
@@ -2571,6 +2721,7 @@ def main():
                   else "--child-scan" if run_scan
                   else "--child-compress" if run_compress
                   else "--child-multidevice" if run_multidevice
+                  else "--child-cache" if run_cache
                   else "--child")
     t0 = time.monotonic()
 
@@ -2617,6 +2768,7 @@ def main():
                   else "scan_stream_throughput" if run_scan
                   else "shuffle_compressed_throughput" if run_compress
                   else "multidevice_shuffle_throughput" if run_multidevice
+                  else "result_cache_replay_throughput" if run_cache
                   else "q6_pipeline_throughput")
         print(json.dumps({
             "metric": metric,

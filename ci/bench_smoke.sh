@@ -44,16 +44,24 @@ BENCH_FORCE_CPU=1 python bench.py --multidevice \
 # spill-codec frame round-trip micro row
 BENCH_FORCE_CPU=1 BENCH_COMPRESS_ROWS=32768 python bench.py --compress \
   | tee /tmp/bench_smoke_compress.out
+# result-cache scenario: a zipf-skewed q6/q95/q9-shaped replay trace
+# through a 2-worker FrontDoor with the fleet result cache on — repeats
+# served from sealed cached Arrow segments bit-identically with zero
+# compute; note.hit_rate must clear 0.5 and vs_baseline (p99_miss /
+# p99_hit) rides result_cache_floor
+BENCH_FORCE_CPU=1 python bench.py --cache \
+  | tee /tmp/bench_smoke_cache.out
 # the q95 lines must be self-explaining (per-stage note + engines; cache +
 # decisions on the IR rows) and their vs_baseline must not regress below
 # the recorded floors — ratchets in the same only-shrinks spirit as
 # graftlint's baseline (ci/q95_floor.json); a missing q9 IR row,
-# streaming-scan row, serving row, pallas A/B row, or multidevice row
-# fails too
+# streaming-scan row, serving row, pallas A/B row, multidevice row, or
+# result-cache row fails too
 python ci/check_q95_line.py /tmp/bench_smoke_q6.out \
   /tmp/bench_smoke_plan.out /tmp/bench_smoke_scan.out \
   /tmp/bench_smoke_serve.out /tmp/bench_smoke_pallas.out \
-  /tmp/bench_smoke_multidevice.out /tmp/bench_smoke_compress.out
+  /tmp/bench_smoke_multidevice.out /tmp/bench_smoke_compress.out \
+  /tmp/bench_smoke_cache.out
 # spill scenario: device arena capped below q6's working set; the emitted
 # line carries spill-bytes counters so BENCH_*.json tracks spill overhead
 BENCH_FORCE_CPU=1 BENCH_SPILL_ROWS=65536 python bench.py --spill

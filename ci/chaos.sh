@@ -13,7 +13,12 @@
 # zero-copy columnar result path: Arrow IPC segments torn after their
 # CRC stamps, announced under a dead fence generation, or orphaned by a
 # worker crashed with a segment in flight must be detected by the
-# supervisor's epoch-then-CRC verify and re-placed bit-identically).
+# supervisor's epoch-then-CRC verify and re-placed bit-identically;
+# result_cache = the fleet result cache: replayed snapshot-pinned
+# queries served from sealed cached segments, with cache_stale rewound
+# snapshot ids rejected by the descriptor verify, cache_corrupt
+# post-seal byte flips quarantined-and-recomputed bit-identically, and
+# a mutated input NEVER served a stale snapshot).
 #
 # Runs tools/chaos.py — every faultinj.FAULT_KINDS entry fired at every
 # instrumented boundary (one fault per trial, exhaustively) plus seeded
@@ -42,7 +47,8 @@ python - /tmp/chaos_report.json <<'EOF'
 import json, sys
 doc = json.load(open(sys.argv[1]))
 for scenario in ("sort", "streaming_scan", "jni", "serving", "frontdoor",
-                 "store_recovery", "multihost", "dataplane"):
+                 "store_recovery", "multihost", "dataplane",
+                 "result_cache"):
     trials = [t for t in doc["trials"]
               if t["label"].startswith(scenario + ":")]
     assert trials, f"chaos report has no {scenario!r} trials"

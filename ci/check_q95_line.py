@@ -92,6 +92,16 @@ bytes_moved_pack — rides ``shuffle_compress_floor`` (1.5, the PR 15
 acceptance bar); ``spill_codec_roundtrip`` must exist with
 ``note.bit_identical`` true and ``note.codec_ratio > 1`` (the frames
 actually shrank the payloads they decoded bit-exactly).
+
+Since r16 the result-cache row (``bench.py --cache``) gets the same
+treatment: ``result_cache_replay_throughput`` must exist, its
+``note.bit_identical`` must be true (every served result — cache hit or
+live miss — digest-matched the solo in-process batch), its
+``note.hit_rate`` must exceed 0.5 (the zipf-skewed replay trace was
+actually served from the fleet result cache, not recomputed) with
+``hit_bytes_served > 0``, and its ``vs_baseline`` — p99_miss_ms /
+p99_hit_ms — rides ``result_cache_floor`` (1.5): cache hits must keep
+beating recomputation at p99 or the row fails.
 """
 import json
 import os
@@ -399,6 +409,37 @@ def main(paths) -> int:
                         f"off/pack) fell below the recorded floor "
                         f"{compress_floor} (ci/q95_floor.json): the wire "
                         f"win the pack step exists for is gone")
+    # result-cache row: the replayed trace must exist, must have been
+    # served bit-identically (hit or miss), and must actually HIT — a
+    # hit rate at or below 0.5 means the repeat traffic recomputed
+    cache_floor = floors["result_cache_floor"]
+    rc_line = lines.get("result_cache_replay_throughput")
+    if rc_line is None:
+        errs.append("no result_cache_replay_throughput line: the "
+                    "result-cache replay row fell out of the smoke "
+                    "(bench.py cache_main)")
+    else:
+        rc_note = rc_line.get("note")
+        if (not isinstance(rc_note, dict)
+                or rc_note.get("bit_identical") is not True):
+            errs.append("result-cache line's note.bit_identical is not "
+                        "true: served results no longer prove themselves "
+                        "byte-equal to the solo in-process batches "
+                        f"(note={json.dumps(rc_note)})")
+        elif float(rc_note.get("hit_rate", 0.0)) <= 0.5:
+            errs.append("result-cache line's note.hit_rate <= 0.5: the "
+                        "replayed trace is recomputing instead of serving "
+                        f"from cache (note={json.dumps(rc_note)})")
+        elif int(rc_note.get("hit_bytes_served", 0)) <= 0:
+            errs.append("result-cache line's note.hit_bytes_served <= 0: "
+                        "no cached segment bytes were actually served "
+                        f"(note={json.dumps(rc_note)})")
+        if rc_line.get("vs_baseline", 0.0) < cache_floor:
+            errs.append(f"result-cache vs_baseline "
+                        f"{rc_line.get('vs_baseline')} (p99_miss / "
+                        f"p99_hit) fell below the recorded floor "
+                        f"{cache_floor} (ci/q95_floor.json): cache hits "
+                        f"no longer beat recomputation at p99")
     sc_line = lines.get("spill_codec_roundtrip")
     if sc_line is None:
         errs.append("no spill_codec_roundtrip line: the spill-codec "
@@ -433,6 +474,9 @@ def main(paths) -> int:
           f"compress {(cp_line or {}).get('vs_baseline')} >= floor "
           f"{compress_floor} (codec ratio "
           f"{((sc_line or {}).get('note') or {}).get('codec_ratio')}); "
+          f"result-cache {(rc_line or {}).get('vs_baseline')} >= floor "
+          f"{cache_floor} (hit rate "
+          f"{((rc_line or {}).get('note') or {}).get('hit_rate')}); "
           f"engines {json.dumps((note or {}).get('engines'))})")
     if vs >= 2 * floor and floor > 0:
         print(f"check_q95_line: note — vs_baseline is >=2x the floor; "

@@ -362,6 +362,27 @@ _register("spill_codec", "off", str,
           "npy.  CRCs are recorded over the STORED (compressed) bytes; "
           "a damaged frame fails loudly into the same quarantine + "
           "lineage-rebuild path as raw-leaf corruption.")
+_register("result_cache", True, _parse_bool,
+          "Fleet-wide result cache at the FrontDoor supervisor "
+          "(serve/result_cache.py): submits that carry an input "
+          "snapshot id are keyed (query signature, snapshot id, "
+          "config-knob fingerprint) and repeat hits are served from the "
+          "sealed Arrow IPC segment with zero compute and zero "
+          "admission — bypassed entirely when off.  Submits WITHOUT a "
+          "snapshot id are never cached regardless of this knob (no "
+          "snapshot id, no caching, never a guess).")
+_register("result_cache_bytes", 64 << 20, int,
+          "Host-resident byte budget of the result cache.  Over budget, "
+          "least-recently-served entries demote host->disk through the "
+          "spill framework's checksummed paths before anything is "
+          "dropped; 0 or negative disables the host bound (entries "
+          "still honor per-tenant quotas).")
+_register("result_cache_tenant_quota", 16 << 20, int,
+          "Per-tenant byte quota of the result cache (host + disk "
+          "tiers): inserts are charged to the submitting tenant, and a "
+          "tenant over quota drops its own least-recently-served "
+          "entries first — one dashboard's storm can never evict the "
+          "whole fleet's cache.  0 or negative means unlimited.")
 
 
 def get(key: str):

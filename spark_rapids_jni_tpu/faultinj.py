@@ -73,7 +73,17 @@ boundary — instrumented jitted callables — since there is no CUPTI:
   transport converts each into its real wire damage — a closed socket,
   a stall past the frame deadline then a close, or a half-written frame
   the peer's CRC/desync machinery must reject — and the reconnect
-  ladder with resume-token reattach is the recovery path on every one.
+  ladder with resume-token reattach is the recovery path on every one,
+  ``"cache_stale"`` raises :class:`CacheStaleError` at the result
+  cache's ``cache_serve``/``cache_insert`` probes
+  (serve/result_cache.py) — the cache rewinds the snapshot id on the
+  served descriptor (or the stored entry), and the serve path's snapshot
+  check must reject the entry and recompute live rather than ever serve
+  a mutated input's stale result,
+  ``"cache_corrupt"`` raises :class:`CacheCorruptError` at the same
+  probes — the cache flips REAL bytes in the stored segment after its
+  insert-time chunk CRCs were stamped, and serve-time CRC verification
+  must quarantine the entry and recompute live, never decode damage.
 * ``dynamic: true`` re-reads the file when its mtime changes, matching
   the injector's ``dynamicReconfig`` thread without needing one.
 
@@ -375,6 +385,38 @@ def _raise_shm_stale(name: str):
     raise ShmStaleError(f"injected stale segment descriptor at {name}")
 
 
+class CacheStaleError(OSError):
+    """A result-cache descriptor carries a rewound snapshot id (kind
+    ``"cache_stale"``).
+
+    Raised at the front door's ``cache_serve``/``cache_insert`` probes
+    (serve/result_cache.py); the cache converts it into a descriptor (or
+    stored entry) whose snapshot id has been REWOUND to a prior
+    generation, modelling an input that mutated after the entry was
+    sealed.  The serve path's snapshot check (descriptor snapshot must
+    equal the requested snapshot id) must reject it and fall through to
+    a live recompute — a stale snapshot is never served."""
+
+
+class CacheCorruptError(OSError):
+    """A cached result segment was damaged after sealing (kind
+    ``"cache_corrupt"``).
+
+    Raised at the front door's ``cache_serve``/``cache_insert`` probes;
+    the cache converts it into REAL byte flips in the stored segment
+    bytes — after the insert-time chunk CRCs were stamped — so the serve
+    path's per-chunk CRC verification must catch the damage, quarantine
+    the entry, and recompute live rather than decode garbage."""
+
+
+def _raise_cache_stale(name: str):
+    raise CacheStaleError(f"injected stale result-cache snapshot at {name}")
+
+
+def _raise_cache_corrupt(name: str):
+    raise CacheCorruptError(f"injected result-cache corruption at {name}")
+
+
 # The registry of injectable fault flavors: kind -> raiser.  graftlint's
 # GL006 keeps this in sync with every use site statically — a kind used
 # in a config dict but missing here would otherwise only fail when its
@@ -401,6 +443,8 @@ FAULT_KINDS = {
     "net_torn": _raise_net_torn,
     "shm_torn": _raise_shm_torn,
     "shm_stale": _raise_shm_stale,
+    "cache_stale": _raise_cache_stale,
+    "cache_corrupt": _raise_cache_corrupt,
 }
 
 

@@ -342,6 +342,106 @@ class SpillableHandle:
         if ctx is not None and hasattr(ctx, "_adopt"):
             ctx._adopt(self)
 
+    @classmethod
+    def from_host_leaves(cls, leaves: List[np.ndarray],
+                         name: Optional[str] = None) -> "SpillableHandle":
+        """Construct a handle that starts HOST-resident — no device
+        tier, no ``TaskContext`` charge — from already-materialized
+        numpy leaves (the result cache's sealed segment bytes, or any
+        other host-native blob that wants spill-framework tiering).
+
+        The handle registers with the installed framework like any
+        other: demotion-time CRCs are recorded per ``spill_checksum``,
+        the host arena is charged (cascading straight to disk when the
+        bounded tier refuses), and ``spill_host_to_fit`` walks it in
+        the same unified LRU as every spilled batch.
+        """
+        from .. import config
+
+        h = cls(None, ctx=None, name=name)
+        arrs = [np.ascontiguousarray(a) for a in leaves]
+        nbytes = int(sum(a.nbytes for a in arrs))
+        with h._lock:
+            import jax
+
+            h._host = arrs
+            h._leaf_index = list(range(len(arrs)))
+            h._shardings = [None] * len(arrs)
+            h._treedef = jax.tree_util.tree_structure(list(range(len(arrs))))
+            if bool(config.get("spill_checksum")):
+                h._host_meta = [_leaf_meta(a) for a in arrs]
+            fw = h._fw
+            if fw is not None:
+                h._pins += 1
+                try:
+                    verdict = fw._charge_host(nbytes)
+                finally:
+                    h._pins -= 1
+                if verdict == "charged":
+                    h._host_charged = nbytes
+                elif verdict == "full":
+                    h._spill_host_locked()
+        return h
+
+    def read_host(self) -> List[np.ndarray]:
+        """The host-format leaves WITHOUT device promotion, verified by
+        whichever lower tier holds them.
+
+        Host-resident leaves are checked against their demotion-time
+        CRCs; disk-resident leaves go through the checksummed (and
+        codec-aware) read-back and then promote disk→host so the next
+        read is cheap — but the handle never leaves the host tier, so
+        serving a cached blob does not consume device arena.  Damage
+        raises the spill corruption errors (no lineage here: blob
+        callers quarantine instead of rebuilding); a device-resident
+        handle raises ``ValueError`` — use :meth:`get` for trees.
+        """
+        with self._lock:
+            if self._closed:
+                raise ValueError(f"{self.name} is closed")
+            self._last_use = _next_use()
+            if self._tree is not None:
+                raise ValueError(
+                    f"{self.name}: read_host on a device-resident handle")
+            if self._host is not None:
+                self._verify_host_locked(self._host)
+                return list(self._host)
+            if self._disk is None:
+                raise ValueError(f"{self.name} holds no data")
+            fw = self._fw
+            try:
+                host = self._read_disk_verified_locked()
+            except (faultinj.SpillCorruptionError, OSError, ValueError):
+                if fw is not None:
+                    fw.metrics.corrupt_read(self.task_id)
+                raise
+            nbytes = int(sum(a.nbytes for a in host))
+            if fw is not None:
+                self._pins += 1
+                try:
+                    verdict = fw._charge_host(nbytes)
+                finally:
+                    self._pins -= 1
+                if verdict == "full":
+                    # bounded host tier refuses residency: hand back the
+                    # verified copy, leave the entry on disk
+                    return host
+                if verdict == "charged":
+                    self._host_charged = nbytes
+                fw.metrics.record("disk_to_host", nbytes, self.task_id)
+            self._host = host
+            # host-tier integrity metadata inherits the disk record's
+            # ORIGINAL (decoded-leaf) crc/nbytes — but only when every
+            # leaf kept a real CRC (a codec'd write without
+            # spill_checksum records crc 0, which must not verify)
+            metas = [(m[0], m[1]) for m in (self._disk_meta or [])
+                     if m is not None and m[0]]
+            self._host_meta = (metas if self._disk_meta is not None
+                               and len(metas) == len(self._disk_meta)
+                               else None)
+            self._remove_disk_files_locked()
+            return list(host)
+
     # -- introspection --------------------------------------------------
     @property
     def tier(self) -> str:

@@ -101,6 +101,33 @@ class PlanCache:
         with self._lock:
             return key in self._pins
 
+    def invalidate_snapshot(self, snapshot_id) -> int:
+        """Drop every cached plan whose key embeds ``snapshot_id``.
+
+        Plan signatures may carry Scan snapshot ids (plan/ir.py): a
+        long-lived serving process that learns an input mutated can
+        drop the dead generation's compiled plans instead of waiting
+        for LRU churn.  The result cache's
+        ``ResultCache.invalidate_snapshot`` routes through here so one
+        call retires BOTH caches' entries for the old contents.
+        Pinned plans are dropped too — a mutated input makes them
+        unservable regardless of in-flight interest.
+        """
+        def embeds(obj) -> bool:
+            if obj == snapshot_id:
+                return True
+            if isinstance(obj, tuple):
+                return any(embeds(v) for v in obj)
+            return False
+
+        with self._lock:
+            victims = [k for k in self._entries if embeds(k)]
+            for k in victims:
+                del self._entries[k]
+                self._pins.pop(k, None)
+                self.evictions += 1
+            return len(victims)
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._entries)

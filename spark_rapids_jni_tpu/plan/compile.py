@@ -82,8 +82,13 @@ def _schema_fingerprint(inputs: dict) -> tuple:
 def _config_fingerprint() -> tuple:
     """Every registered knob's resolved value — a flip of ANY knob is a
     plan-cache miss (knobs select engines and fusion shapes, so a stale
-    hit could replay the wrong physical plan)."""
-    return tuple((k, repr(config.get(k))) for k in sorted(config.describe()))
+    hit could replay the wrong physical plan).  Delegates to the result
+    cache's :func:`~spark_rapids_jni_tpu.serve.result_cache.
+    knob_fingerprint` so the plan cache and the fleet-wide result cache
+    agree on one fingerprint discipline."""
+    from ..serve.result_cache import knob_fingerprint
+
+    return knob_fingerprint()
 
 
 def _freeze(obj):
@@ -98,6 +103,37 @@ def plan_cache_key(plan: ir.PlanNode, inputs: dict,
                    decisions: Optional[dict] = None) -> tuple:
     return (plan.signature(), _schema_fingerprint(inputs),
             _config_fingerprint(), _freeze(decisions or {}))
+
+
+def result_key(plan: ir.PlanNode, inputs: dict) -> Optional[tuple]:
+    """The fleet result cache's three-component key for ``plan`` over
+    ``inputs`` — ``(bound plan signature, snapshot ids, knob
+    fingerprint)`` — or ``None`` when ANY scan's input contents are
+    unproven.
+
+    Snapshot ids come from the bound source (``MorselSource.
+    snapshot_id``) or from a snapshot already carried by the Scan node
+    itself (:func:`~spark_rapids_jni_tpu.plan.ir.bind_snapshots`);
+    nothing is ever hashed implicitly here.  Unlike
+    :func:`plan_cache_key` this key pins input CONTENTS, not input
+    schemas: the plan cache reuses a compiled program across data, the
+    result cache may only reuse the finished bytes of the exact data.
+    """
+    snaps = {}
+    for name in ir.scan_names(plan):
+        src = inputs.get(name)
+        sid = getattr(src, "snapshot_id", None)
+        if sid is not None:
+            snaps[name] = sid
+    bound = ir.bind_snapshots(plan, snaps)
+    ids = []
+    for node in bound.walk():
+        if isinstance(node, ir.Scan):
+            if node.snapshot is None:
+                return None  # no snapshot id, no caching, never a guess
+            ids.append((node.name, node.snapshot))
+    return (bound.signature(), tuple(sorted(set(ids))),
+            _config_fingerprint())
 
 
 # ---------------------------------------------------------------------------

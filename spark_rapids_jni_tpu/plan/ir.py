@@ -52,9 +52,21 @@ def _tup(v):
 
 @dataclass(frozen=True)
 class Scan(PlanNode):
-    """Read one named input batch (the leaf; bindings come at execute)."""
+    """Read one named input batch (the leaf; bindings come at execute).
+
+    ``snapshot`` (optional, hashable) is the CONTENT snapshot id of the
+    bound input — a content hash for in-memory batches, a
+    path+mtime+size fingerprint for file readers (see
+    :mod:`~spark_rapids_jni_tpu.serve.result_cache`).  It participates
+    in :meth:`PlanNode.signature`, so two plans over the same shape but
+    different input *contents* have different identities — the exactness
+    the fleet-wide result cache keys on.  ``None`` means "contents
+    unknown": such a plan still compiles and runs, but result caching
+    refuses it (no snapshot id, no caching, never a guess).
+    """
 
     name: str
+    snapshot: object = None
 
 
 @dataclass(frozen=True)
@@ -195,3 +207,30 @@ def scan_names(plan: PlanNode) -> tuple:
         if isinstance(node, Scan) and node.name not in seen:
             seen.append(node.name)
     return tuple(seen)
+
+
+def bind_snapshots(plan: PlanNode, snapshots: dict) -> PlanNode:
+    """Rebuild ``plan`` with each :class:`Scan` carrying the snapshot id
+    from ``snapshots`` (scan name -> snapshot id).
+
+    Nodes are frozen, so the tree is rebuilt bottom-up with
+    ``dataclasses.replace``; scans absent from ``snapshots`` keep their
+    existing ``snapshot`` (usually ``None``).  The rebound plan's
+    :meth:`PlanNode.signature` then pins the exact input contents —
+    the form the result cache keys on.
+    """
+    if isinstance(plan, Scan):
+        if plan.name in snapshots:
+            return dataclasses.replace(plan, snapshot=snapshots[plan.name])
+        return plan
+    kwargs = {}
+    changed = False
+    for f in dataclasses.fields(plan):
+        v = getattr(plan, f.name)
+        if isinstance(v, PlanNode):
+            nv = bind_snapshots(v, snapshots)
+            changed = changed or nv is not v
+            kwargs[f.name] = nv
+    if not changed:
+        return plan
+    return dataclasses.replace(plan, **kwargs)

@@ -96,10 +96,17 @@ def chunk_crcs(payload, chunk_bytes: int) -> List[int]:
 
 
 def build_descriptor(plane: str, seg: str, size: int, schema_fp: str,
-                     chunk_bytes: int, crcs: List[int], epoch: int) -> dict:
+                     chunk_bytes: int, crcs: List[int], epoch: int,
+                     snapshot=None) -> dict:
     """The JSON side of a data-plane result: everything the supervisor
-    needs to verify and decode the payload, and nothing payload-sized."""
-    return {
+    needs to verify and decode the payload, and nothing payload-sized.
+
+    ``snapshot`` (optional) stamps the input snapshot id the result was
+    computed FROM — carried by workers when the submit declared one,
+    and by the result cache's fresh hit descriptors; verified against
+    the requester's snapshot by :func:`verify_snapshot` so a rewound
+    entry can never serve a mutated input."""
+    desc = {
         "v": 1,
         "plane": plane,
         "seg": seg,
@@ -110,6 +117,9 @@ def build_descriptor(plane: str, seg: str, size: int, schema_fp: str,
         "crcs": [int(c) for c in crcs],
         "epoch": int(epoch),
     }
+    if snapshot is not None:
+        desc["snapshot"] = snapshot
+    return desc
 
 
 def verify_chunks(payload, desc: dict) -> None:
@@ -143,6 +153,24 @@ def verify_epoch(desc: dict, expect_epoch: int) -> None:
         raise DataPlaneStale(
             f"segment {desc.get('seg')}: descriptor epoch {got} != "
             f"worker generation {expect_epoch} (stale segment reuse)")
+
+
+def verify_snapshot(desc: dict, expect_snapshot) -> None:
+    """Reject a descriptor computed from any input contents but the
+    requested ones — the result cache's exactness fence.
+
+    ``expect_snapshot`` None means the requester declared no snapshot
+    (nothing was cached, nothing to check).  A descriptor MISSING a
+    snapshot while one is expected is stale by definition: provenance
+    cannot be proven, so the result is recomputed."""
+    if expect_snapshot is None:
+        return
+    got = desc.get("snapshot")
+    if got != expect_snapshot:
+        raise DataPlaneStale(
+            f"segment {desc.get('seg')}: descriptor snapshot {got!r} != "
+            f"requested snapshot {expect_snapshot!r} (rewound/mutated "
+            f"input — refusing stale serve)")
 
 
 # ---- shm plane (memfd + SCM_RIGHTS) ---------------------------------------

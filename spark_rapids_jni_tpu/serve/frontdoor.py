@@ -107,6 +107,7 @@ from typing import Dict, List, Optional
 from .. import config, faultinj
 from ..shuffle import store as store_mod
 from . import data_plane, wire
+from . import result_cache as result_cache_mod
 from .runtime import QueryCancelled, QueryTimeout, ServeError
 
 _MISS_BUDGET = 3.5       # heartbeat periods of silence before SIGKILL
@@ -143,7 +144,7 @@ class FleetMetrics:
               "replacements", "worker_lost", "sheds", "circuit_open",
               "reconnects", "partitions_detected", "self_fenced_workers",
               "data_batches", "data_payload_bytes", "data_json_bytes",
-              "data_plane_errors")
+              "data_plane_errors", "cache_hits", "hit_bytes_served")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -186,7 +187,7 @@ class FrontDoorSession:
     def __init__(self, door: "FrontDoor", sid: int, kind: str,
                  params: Optional[dict], tenant, priority: int,
                  est_bytes: int, timeout_s: Optional[float],
-                 replayable: bool):
+                 replayable: bool, snapshot=None):
         self._door = door
         self.sid = sid
         self.kind = kind
@@ -196,6 +197,12 @@ class FrontDoorSession:
         self.est_bytes = int(est_bytes or 0)
         self.timeout_s = timeout_s
         self.replayable = bool(replayable)
+        # input snapshot id the client declared (None = contents
+        # unproven: the result cache never touches this session) plus
+        # the submit-time three-component cache key
+        self.snapshot = snapshot
+        self.cache_key: Optional[tuple] = None
+        self.served_from_cache = False
         self.status = "pending"
         self.worker_id: Optional[int] = None
         self.replacements = 0
@@ -368,6 +375,12 @@ class FrontDoor:
             self._store = store_mod.ShuffleStore(self.store_dir)
         self.metrics = FleetMetrics()
         _last_metrics = self.metrics
+        # the fleet-wide result cache: supervisor-resident, so an entry
+        # one worker computed serves every worker's tenants and
+        # survives any worker loss (serve/result_cache.py)
+        self.result_cache = result_cache_mod.ResultCache()
+        self._cache_gen = 0  # supervisor epoch stamped on hit descriptors
+        self._cache_seq = itertools.count(1)
         self._lock = threading.RLock()
         self._sids = itertools.count(1)
         self._gens = itertools.count(1)
@@ -404,18 +417,30 @@ class FrontDoor:
     def submit(self, kind: str, params: Optional[dict] = None, tenant=None,
                priority: int = 0, est_bytes: int = 0,
                timeout_s: Optional[float] = None,
-               replayable: bool = True) -> FrontDoorSession:
+               replayable: bool = True, snapshot=None) -> FrontDoorSession:
         """Queue a query of registered worker-side ``kind`` and return
         its session.  ``params`` must be JSON-serializable; everything
         else matches ``ServeRuntime.submit`` plus ``replayable`` (see
-        :class:`FrontDoorSession`)."""
+        :class:`FrontDoorSession`) and ``snapshot`` — the input's
+        content snapshot id (see serve/result_cache.py).  With a
+        snapshot declared, a repeat of the same ``(kind, params)``
+        under the same knobs is served straight from the fleet result
+        cache: the session finishes here, BEFORE admission — no shed
+        check, no worker dispatch, no ticket, no compute."""
         if self._shutdown_started:
             raise ServeError("front door is shut down")
         sid = next(self._sids)
         sess = FrontDoorSession(
             self, sid, kind, params,
             tenant if tenant is not None else f"tenant-{sid}",
-            priority, est_bytes, timeout_s, replayable)
+            priority, est_bytes, timeout_s, replayable, snapshot=snapshot)
+        if snapshot is not None and self.result_cache.enabled():
+            sig = result_cache_mod.query_signature(kind, params)
+            fp = result_cache_mod.knob_fingerprint()
+            sess.cache_key = (sig, snapshot, fp)
+            view = self.result_cache.serve(sig, snapshot, fp)
+            if view is not None and self._serve_cache_hit(sess, view):
+                return sess
         now = time.monotonic()
         with self._lock:
             self._pending.append([now, sess])
@@ -554,6 +579,10 @@ class FrontDoor:
         }
         report["hosts"] = list(self._hosts)
         report["self_fenced"] = list(self._self_fenced)
+        report["result_cache"] = self.result_cache.metrics()
+        # entries ride spill handles: close them so arena charges and
+        # demoted disk files release before the fleet dir reap
+        self.result_cache.clear()
         if self._store is not None:
             report["store"] = self._store.snapshot()
         retain = self.store_dir is not None \
@@ -821,8 +850,9 @@ class FrontDoor:
     def _decode_data_result(self, w: WorkerHandle, desc: dict,
                             chunks: Optional[list], fds: List[int]):
         """Verify (epoch, then per-chunk CRCs) and decode one data-plane
-        payload into a ColumnBatch.  Raises
-        :class:`~.data_plane.DataPlaneStale` /
+        payload into ``(ColumnBatch, verified payload bytes)`` — the
+        bytes feed the result cache in their already-encoded form.
+        Raises :class:`~.data_plane.DataPlaneStale` /
         :class:`~.data_plane.DataPlaneCorruption` — the TRANSFER failed,
         not the query; the caller re-queues under a fresh sid."""
         from ..columnar import arrow as arrow_mod
@@ -849,7 +879,52 @@ class FrontDoor:
             raise wire.WireError(f"unknown data plane {plane!r} in "
                                  f"result descriptor")
         return arrow_mod.ipc_to_batch(
-            payload, expect_fingerprint=desc.get("schema_fp"))
+            payload, expect_fingerprint=desc.get("schema_fp")), payload
+
+    def _serve_cache_hit(self, sess: FrontDoorSession,
+                         view) -> bool:
+        """Serve a cached result under a FRESH descriptor, verified
+        exactly like a live result: the stored bytes go into a new
+        sealed memfd, the descriptor carries the insert-time chunk CRCs
+        and the entry's snapshot id, and epoch → snapshot → CRC →
+        schema-fingerprint checks all run before the session finishes.
+        Returns False on any rejection (stale snapshot, damage) — the
+        caller falls through to a live dispatch, so a bad entry costs a
+        recompute, never a wrong answer."""
+        from ..columnar import arrow as arrow_mod
+
+        name = data_plane.segment_name(
+            "cache", self._cache_gen, next(self._cache_seq))
+        desc = data_plane.build_descriptor(
+            "shm", name, view.size, view.schema_fp, view.chunk_bytes,
+            view.crcs, self._cache_gen, snapshot=view.snapshot)
+        fd = data_plane.make_segment(name, view.payload)
+        try:
+            data_plane.seal_segment(fd)
+            data_plane.verify_epoch(desc, self._cache_gen)
+            # the exactness fence: the descriptor's snapshot must equal
+            # the snapshot THIS submit declared — a rewound entry is
+            # rejected here, a stale snapshot is never served
+            data_plane.verify_snapshot(desc, sess.snapshot)
+            payload = data_plane.read_segment(fd, desc)
+            value = arrow_mod.ipc_to_batch(
+                payload, expect_fingerprint=desc.get("schema_fp"))
+        except data_plane.DataPlaneStale:
+            self.result_cache.record_stale(view.key)
+            return False
+        except (data_plane.DataPlaneCorruption, wire.WireError,
+                ValueError, OSError):
+            self.result_cache.quarantine(view.key)
+            return False
+        finally:
+            with contextlib.suppress(OSError):
+                os.close(fd)
+        self.metrics.bump("cache_hits")
+        self.metrics.bump("hit_bytes_served", view.size)
+        self.result_cache.record_hit(view.size)
+        sess.served_from_cache = True
+        sess._finish(value=value, status="done")
+        return True
 
     def _requeue_data_damaged(self, sess: FrontDoorSession, w: WorkerHandle,
                               exc: BaseException):
@@ -896,8 +971,8 @@ class FrontDoor:
             if msg.get("ok"):
                 if desc is not None:
                     try:
-                        value = self._decode_data_result(w, desc, chunks,
-                                                         fds)
+                        value, payload = self._decode_data_result(
+                            w, desc, chunks, fds)
                     except (data_plane.DataPlaneStale,
                             data_plane.DataPlaneCorruption,
                             wire.WireError, ValueError, OSError) as e:
@@ -908,6 +983,16 @@ class FrontDoor:
                                       int(desc.get("size") or 0))
                     self.metrics.bump("data_json_bytes", len(json.dumps(
                         msg, separators=(",", ":"))))
+                    # result-cache insert: only with the submit-time key
+                    # AND a worker echo matching the declared snapshot —
+                    # provenance proven, never a guess
+                    if (sess.cache_key is not None
+                            and desc.get("snapshot") == sess.snapshot):
+                        sig, snap, fp = sess.cache_key
+                        self.result_cache.insert(
+                            sig, snap, fp, payload,
+                            desc.get("schema_fp"), tenant=sess.tenant,
+                            chunk_bytes=self._segment_bytes)
                     sess._finish(value=value, status="done")
                 else:
                     sess._finish(value=msg.get("value"), status="done")
@@ -1164,6 +1249,7 @@ class FrontDoor:
                     "params": sess.params, "tenant": str(sess.tenant),
                     "priority": sess.priority, "est_bytes": sess.est_bytes,
                     "timeout_s": sess.timeout_s,
+                    "snapshot": sess.snapshot,
                 })
             except OSError:
                 # worker dying under us: leave it pending, the monitor's

@@ -1,0 +1,491 @@
+"""Fleet-wide result cache: repeated queries served from sealed Arrow
+segments with zero compute.
+
+The PR-13 data plane already delivers every result as a sealed,
+CRC-stamped, epoch-fenced Arrow IPC segment.  This module keeps those
+finished payloads at the FrontDoor SUPERVISOR — above admission
+control, above the plan cache, above the workers — keyed
+
+    (query signature, input snapshot id, config-knob fingerprint)
+
+so a repeat of the same query over the same input contents under the
+same knobs is served straight back from the cached bytes: no admission
+ticket, no worker round-trip, no trace, no compute.  The memfd the
+supervisor re-seals is process-portable, so a result one worker
+computed for one tenant serves every other worker's tenants
+("fleet-wide": the cache outlives the worker that produced the entry).
+
+Exactness is the contract, in key order:
+
+* **query signature** — the canonical identity of WHAT was asked: a
+  plan's :meth:`~spark_rapids_jni_tpu.plan.ir.PlanNode.signature`, or
+  for front-door kinds the frozen ``(kind, params)`` pair
+  (:func:`query_signature`).  A different projection, filter literal or
+  row count is a different signature, hence a guaranteed miss.
+* **input snapshot id** — the identity of the input CONTENTS: a content
+  hash for in-memory batches (:func:`snapshot_for_batch`, reusing the
+  data plane's canonical transport-invariant digest), a
+  path+mtime+size fingerprint for Parquet files
+  (:func:`snapshot_for_path`), a canonical-freeze hash for
+  deterministic generator parameters (:func:`snapshot_for_obj`).
+  Sources that cannot prove their contents carry ``None`` — and a
+  ``None`` snapshot NEVER caches: no snapshot id, no caching, never a
+  guess.  One mutated row is a new snapshot id is a guaranteed miss.
+* **config-knob fingerprint** — :func:`knob_fingerprint` over the whole
+  registry, the same fingerprint discipline the plan cache uses: any
+  knob flip is a miss by construction, not by invalidation logic.
+
+Capacity rides the spill framework: each entry's bytes live in a
+host-resident :class:`~spark_rapids_jni_tpu.mem.spill.SpillableHandle`
+(:meth:`~spark_rapids_jni_tpu.mem.spill.SpillableHandle.from_host_leaves`),
+so the fleet's unified LRU sees cache entries as just another spillable
+— over the ``result_cache_bytes`` host budget the least-recently-served
+entries demote host→disk through the existing checksummed spill paths,
+and only then drop.  ``result_cache_tenant_quota`` charges every insert
+to its submitting tenant and evicts that tenant's own LRU entries
+first, so one dashboard's storm cannot evict the whole fleet's cache.
+
+Fault domains (tools/chaos.py, kinds ``cache_stale``/``cache_corrupt``
+at the ``cache_serve``/``cache_insert`` probes): a rewound snapshot id
+on a served descriptor is rejected by the snapshot check and the query
+recomputes live; a post-seal byte flip in a stored segment is caught by
+the insert-time chunk CRCs (or the spill tier's own checksums), the
+entry is quarantined, and the query recomputes live.  Damage and
+staleness are detected, never served.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import config, faultinj
+
+# probe points for the chaos campaign: fired on every cache serve
+# attempt / every insert (see module docstring for the fault kinds)
+_serve_probe = faultinj.instrument(lambda: None, "cache_serve")
+_insert_probe = faultinj.instrument(lambda: None, "cache_insert")
+
+# marker appended to a snapshot id by an injected cache_stale fault —
+# models a descriptor whose snapshot was rewound to a prior generation
+_REWOUND = "!rewound"
+
+
+# ---------------------------------------------------------------------------
+# snapshot ids: the content identity of an input
+# ---------------------------------------------------------------------------
+
+def snapshot_for_batch(batch) -> str:
+    """Content snapshot id of an in-memory ``ColumnBatch``: the data
+    plane's canonical transport-invariant digest, so the id is stable
+    across shardings/placements and changes on any one-row mutation."""
+    from .data_plane import batch_digest
+
+    return "mem:" + batch_digest(batch)
+
+
+def snapshot_for_path(path: str) -> str:
+    """Snapshot id of a file input: path + mtime_ns + size fingerprint.
+    Any rewrite of the file (even same-size) bumps mtime and therefore
+    the id; a missing file raises rather than guessing."""
+    import os
+
+    st = os.stat(path)
+    h = hashlib.sha256()
+    h.update(os.path.abspath(path).encode())
+    h.update(f":{st.st_mtime_ns}:{st.st_size}".encode())
+    return "file:" + h.hexdigest()[:24]
+
+
+def snapshot_for_obj(obj) -> str:
+    """Snapshot id of a deterministic in-memory input SPEC (e.g. the
+    ``(rows, seed)`` of a generated batch): canonical-freeze + sha256.
+    Only valid when the spec fully determines the input bit-for-bit."""
+    h = hashlib.sha256(repr(_freeze(obj)).encode())
+    return "obj:" + h.hexdigest()[:24]
+
+
+def _freeze(obj):
+    if isinstance(obj, dict):
+        return tuple(sorted((k, _freeze(v)) for k, v in obj.items()))
+    if isinstance(obj, (list, tuple)):
+        return tuple(_freeze(v) for v in obj)
+    if isinstance(obj, (set, frozenset)):
+        return tuple(sorted(_freeze(v) for v in obj))
+    return obj
+
+
+def query_signature(kind: str, params: Optional[dict]) -> tuple:
+    """Canonical identity of a front-door submit: the query kind plus
+    its frozen params (order-insensitive dicts, lists≡tuples)."""
+    return ("query", str(kind), _freeze(params or {}))
+
+
+def knob_fingerprint() -> tuple:
+    """Fingerprint of EVERY registered config knob's current value —
+    the same discipline as the plan cache's config fingerprint (and its
+    single source of truth): any knob flip anywhere is a cache miss by
+    construction."""
+    return tuple((k, repr(config.get(k)))
+                 for k in sorted(config.describe()))
+
+
+# ---------------------------------------------------------------------------
+# entries
+# ---------------------------------------------------------------------------
+
+class CacheEntry:
+    """One sealed result: the encoded Arrow IPC bytes plus everything a
+    fresh descriptor needs (insert-time chunk CRCs, schema fingerprint,
+    snapshot id).  The bytes live in a host-resident spill handle so
+    tiering/verification ride the spill framework."""
+
+    __slots__ = ("key", "tenant", "size", "schema_fp", "snapshot",
+                 "chunk_bytes", "crcs", "handle", "_data")
+
+    def __init__(self, key, tenant, payload: bytes, schema_fp: str,
+                 snapshot, chunk_bytes: int, crcs: List[int]):
+        from ..mem import spill as spill_mod
+
+        self.key = key
+        self.tenant = tenant
+        self.size = len(payload)
+        self.schema_fp = schema_fp
+        self.snapshot = snapshot
+        self.chunk_bytes = int(chunk_bytes)
+        self.crcs = list(crcs)
+        arr = np.frombuffer(bytes(payload), dtype=np.uint8).copy()
+        self._data = arr  # kept so an injected corrupt can flip REAL bytes
+        self.handle = spill_mod.SpillableHandle.from_host_leaves(
+            [arr], name=f"rescache-{hashlib.sha256(repr(key).encode()).hexdigest()[:12]}")
+
+    @property
+    def tier(self) -> str:
+        return self.handle.tier
+
+    def read(self) -> bytes:
+        """The stored payload, verified by whichever spill tier holds
+        it (host CRCs / checksummed disk read-back).  Raises the spill
+        framework's corruption errors on damage — the caller
+        quarantines, never serves."""
+        leaves = self.handle.read_host()
+        return b"".join(np.ascontiguousarray(a).tobytes() for a in leaves)
+
+    def flip_stored_byte(self) -> None:
+        """Convert an injected ``cache_corrupt`` into REAL damage: XOR
+        one byte of the stored segment, after the insert-time CRCs were
+        stamped — exactly the shape serve-time verification must catch."""
+        if self.tier == "host" and self._data.size:
+            self._data[self._data.size // 2] ^= 0xFF
+        else:
+            # disk-resident: damage the spill file through the same
+            # helper the spill chaos trials use
+            disk = getattr(self.handle, "_disk", None)
+            if disk:
+                from ..mem.spill import _flip_file_bytes
+
+                _flip_file_bytes(disk[0])
+
+    def close(self) -> None:
+        self.handle.close()
+
+
+class ServedView:
+    """What :meth:`ResultCache.serve` hands the front door: the verified
+    stored bytes plus the descriptor ingredients.  ``snapshot`` is the
+    id the DESCRIPTOR will carry — normally the entry's, rewound by an
+    injected ``cache_stale`` so the front door's snapshot check fires."""
+
+    __slots__ = ("key", "payload", "size", "schema_fp", "snapshot",
+                 "chunk_bytes", "crcs")
+
+    def __init__(self, entry: CacheEntry, payload: bytes, snapshot):
+        self.key = entry.key
+        self.payload = payload
+        self.size = entry.size
+        self.schema_fp = entry.schema_fp
+        self.snapshot = snapshot
+        self.chunk_bytes = entry.chunk_bytes
+        self.crcs = list(entry.crcs)
+
+
+# ---------------------------------------------------------------------------
+# the cache
+# ---------------------------------------------------------------------------
+
+class ResultCache:
+    """LRU result cache with per-tenant quotas and spill-unified tiers.
+
+    ``serve``/``insert`` REQUIRE all three key components (graftlint
+    GL015 enforces this statically at every call site): a ``None``
+    snapshot short-circuits both to a no-op, so nothing is ever cached
+    or served on a guess.
+    """
+
+    def __init__(self, max_bytes: Optional[int] = None,
+                 tenant_quota: Optional[int] = None):
+        self._max_bytes = max_bytes
+        self._tenant_quota = tenant_quota
+        self._lock = threading.RLock()
+        self._entries: "OrderedDict[tuple, CacheEntry]" = OrderedDict()
+        self._tenant_bytes: Dict[object, int] = {}
+        self.hits = 0
+        self.misses = 0
+        self.inserts = 0
+        self.hit_bytes_served = 0
+        self.stale_rejected = 0
+        self.corrupt_quarantined = 0
+        self.quota_evictions = 0
+        self.demotions = 0
+        self.drops = 0
+
+    # -- knobs (re-read live, like PlanCache._capacity) -----------------
+    def _host_budget(self) -> int:
+        if self._max_bytes is not None:
+            return int(self._max_bytes)
+        return int(config.get("result_cache_bytes"))
+
+    def _quota(self) -> int:
+        if self._tenant_quota is not None:
+            return int(self._tenant_quota)
+        return int(config.get("result_cache_tenant_quota"))
+
+    @staticmethod
+    def enabled() -> bool:
+        return bool(config.get("result_cache"))
+
+    # -- core ------------------------------------------------------------
+    def serve(self, signature, snapshot, knob_fp) -> Optional[ServedView]:
+        """Look up ``(signature, snapshot, knob_fp)`` and return the
+        stored bytes as a :class:`ServedView`, or ``None`` on a miss.
+
+        The stored tier verifies on read (host CRCs / checksummed disk
+        read-back); damage quarantines the entry and reports a miss —
+        the caller recomputes live.  The front door then re-verifies
+        the served bytes under a fresh descriptor exactly like a live
+        result (epoch, snapshot, chunk CRCs, schema fingerprint).
+        """
+        if snapshot is None or not self.enabled():
+            return None
+        key = (signature, snapshot, knob_fp)
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+        served_snapshot = entry.snapshot
+        try:
+            _serve_probe()
+        except faultinj.CacheStaleError:
+            served_snapshot = str(entry.snapshot) + _REWOUND
+        except faultinj.CacheCorruptError:
+            entry.flip_stored_byte()
+        try:
+            payload = entry.read()
+        except (faultinj.SpillCorruptionError, faultinj.HostCorruptionError,
+                OSError, ValueError):
+            self.quarantine(key)
+            with self._lock:
+                self.misses += 1
+            return None
+        return ServedView(entry, payload, served_snapshot)
+
+    def insert(self, signature, snapshot, knob_fp, payload,
+               schema_fp: str, tenant=None,
+               chunk_bytes: Optional[int] = None) -> bool:
+        """Store a finished result's encoded bytes under the full
+        three-component key; returns whether the entry was admitted.
+
+        Chunk CRCs are stamped HERE, from the verified live payload,
+        and served back verbatim — a byte that flips while cached can
+        never re-derive a matching CRC.  Inserts are quota-charged to
+        ``tenant`` and may evict (that tenant's own LRU entries first,
+        then the host budget's coldest via spill demotion).
+        """
+        if snapshot is None or not self.enabled():
+            return False
+        from . import data_plane as dp
+
+        key = (signature, snapshot, knob_fp)
+        payload = bytes(payload)
+        if chunk_bytes is None:
+            chunk_bytes = int(config.get("serve_segment_bytes"))
+        chunk_bytes = max(1, int(chunk_bytes))
+        crcs = dp.chunk_crcs(memoryview(payload), chunk_bytes)
+        entry = CacheEntry(key, tenant, payload, schema_fp, snapshot,
+                           chunk_bytes, crcs)
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._forget_locked(old)
+            self._entries[key] = entry
+            self._tenant_bytes[tenant] = (
+                self._tenant_bytes.get(tenant, 0) + entry.size)
+            self.inserts += 1
+            self._enforce_quota_locked(tenant)
+            self._enforce_host_budget_locked()
+            admitted = key in self._entries
+        try:
+            _insert_probe()
+        except faultinj.CacheStaleError:
+            # model an insert that recorded a prior generation's
+            # snapshot: the stored id rewinds, so the next serve's
+            # descriptor check must reject it
+            entry.snapshot = str(entry.snapshot) + _REWOUND
+        except faultinj.CacheCorruptError:
+            entry.flip_stored_byte()
+        return admitted
+
+    # -- invalidation / quarantine --------------------------------------
+    def quarantine(self, key) -> None:
+        """Drop a damaged entry (serve-time CRC/verify failure): the
+        slot is freed and the query recomputes live."""
+        with self._lock:
+            entry = self._entries.pop(key, None)
+            if entry is not None:
+                self._forget_locked(entry)
+                self.corrupt_quarantined += 1
+
+    def record_stale(self, key) -> None:
+        """Count a serve rejected by the snapshot check (the descriptor
+        carried a rewound id).  The entry itself is kept — a genuinely
+        mutated input arrives under a NEW snapshot id and simply never
+        matches this key."""
+        with self._lock:
+            self.stale_rejected += 1
+
+    def invalidate_snapshot(self, snapshot_id) -> int:
+        """Drop every entry keyed on ``snapshot_id`` (an input known to
+        have mutated) and the plan cache's compiled plans bound to it.
+        Returns the number of result entries dropped."""
+        from ..plan import cache as plan_cache_mod
+
+        with self._lock:
+            victims = [k for k in self._entries if k[1] == snapshot_id]
+            for k in victims:
+                self._forget_locked(self._entries.pop(k))
+                self.drops += 1
+        plan_cache_mod.get_plan_cache().invalidate_snapshot(snapshot_id)
+        return len(victims)
+
+    # -- eviction ---------------------------------------------------------
+    def _forget_locked(self, entry: CacheEntry) -> None:
+        t = entry.tenant
+        self._tenant_bytes[t] = max(
+            0, self._tenant_bytes.get(t, 0) - entry.size)
+        if not self._tenant_bytes.get(t):
+            self._tenant_bytes.pop(t, None)
+        entry.close()
+
+    def _enforce_quota_locked(self, tenant) -> None:
+        quota = self._quota()
+        if quota <= 0:
+            return
+        while self._tenant_bytes.get(tenant, 0) > quota:
+            victim_key = next(
+                (k for k, e in self._entries.items() if e.tenant == tenant),
+                None)
+            if victim_key is None:
+                break
+            self._forget_locked(self._entries.pop(victim_key))
+            self.quota_evictions += 1
+
+    def _enforce_host_budget_locked(self) -> None:
+        budget = self._host_budget()
+        if budget <= 0:
+            return
+        # demote least-recently-served host entries to disk first (the
+        # spill framework's checksummed paths), dropping only entries
+        # that cannot demote (no framework / disk refused)
+        for key in list(self._entries):
+            if self._host_bytes_locked() <= budget:
+                return
+            entry = self._entries[key]
+            if entry.tier != "host":
+                continue
+            if entry.handle.spill_host() > 0 or entry.tier == "disk":
+                self.demotions += 1
+            else:
+                self._forget_locked(self._entries.pop(key))
+                self.drops += 1
+
+    def _host_bytes_locked(self) -> int:
+        return sum(e.size for e in self._entries.values()
+                   if e.tier == "host")
+
+    # -- introspection ----------------------------------------------------
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def tenant_bytes(self, tenant) -> int:
+        with self._lock:
+            return self._tenant_bytes.get(tenant, 0)
+
+    def keys(self) -> List[tuple]:
+        with self._lock:
+            return list(self._entries)
+
+    def tiers(self) -> Dict[str, int]:
+        with self._lock:
+            out: Dict[str, int] = {}
+            for e in self._entries.values():
+                out[e.tier] = out.get(e.tier, 0) + 1
+            return out
+
+    def metrics(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "hits": self.hits,
+                "misses": self.misses,
+                "inserts": self.inserts,
+                "hit_bytes_served": self.hit_bytes_served,
+                "stale_rejected": self.stale_rejected,
+                "corrupt_quarantined": self.corrupt_quarantined,
+                "quota_evictions": self.quota_evictions,
+                "demotions": self.demotions,
+                "drops": self.drops,
+                "host_bytes": self._host_bytes_locked(),
+                "tenants": len(self._tenant_bytes),
+            }
+
+    def record_hit(self, nbytes: int) -> None:
+        with self._lock:
+            self.hits += 1
+            self.hit_bytes_served += int(nbytes)
+
+    def clear(self) -> None:
+        with self._lock:
+            for entry in self._entries.values():
+                entry.close()
+            self._entries.clear()
+            self._tenant_bytes.clear()
+
+
+# ---------------------------------------------------------------------------
+# process-global accessor (the plan-level API; each FrontDoor supervisor
+# owns its own instance for fleet serving)
+# ---------------------------------------------------------------------------
+
+_cache = ResultCache()
+
+
+def get_result_cache() -> ResultCache:
+    return _cache
+
+
+def result_cache_metrics() -> dict:
+    return _cache.metrics()
+
+
+def reset_result_cache() -> None:
+    """Drop every cached result AND zero the counters (test isolation)."""
+    global _cache
+    _cache.clear()
+    _cache = ResultCache()

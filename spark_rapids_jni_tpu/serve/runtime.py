@@ -113,6 +113,19 @@ _step_probe = faultinj.instrument(lambda: None, "serve_step")
 _MIN_GRANT = 1 << 16  # reservation split floor: 64 KiB
 _ADMIT_TICK_S = 0.05  # cancellation latency while queued
 
+# Fleet-visible count of admission tickets ever granted.  The result
+# cache's bypass proof reads this: a cache hit must finish a session
+# with ZERO new tickets issued (tests/test_result_cache.py asserts the
+# delta), because hits are served before admission is even consulted.
+_tickets_issued = 0
+_tickets_lock = threading.Lock()
+
+
+def admission_tickets_issued() -> int:
+    """Process-wide total of :class:`AdmissionTicket` grants."""
+    with _tickets_lock:
+        return _tickets_issued
+
 
 class _PrioritySlots:
     """``serve_max_concurrent`` admission slots granted by SLA class.
@@ -175,6 +188,9 @@ class AdmissionTicket:
         self.session = session
         self._released = False
         self._lock = threading.Lock()
+        global _tickets_issued
+        with _tickets_lock:
+            _tickets_issued += 1
 
     def release(self):
         with self._lock:

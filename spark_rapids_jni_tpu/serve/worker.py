@@ -573,6 +573,9 @@ def main(argv=None) -> int:
     data_desc_probe = faultinj.instrument(lambda: None,
                                           "data_descriptor_wk")
     seg_seq = iter(range(1 << 62))
+    # sid -> input snapshot id declared by the submit (result-cache key
+    # material, echoed back on the result descriptor)
+    sid_snapshots: Dict[int, object] = {}
 
     def encode_batch_result(sid: int, batch):
         """ColumnBatch -> (descriptor fields, fds, chunk frames) on the
@@ -592,8 +595,12 @@ def main(argv=None) -> int:
             # middle of the payload the CRCs claim to cover
             torn_at = len(view) // 2 if len(view) else None
         name = dp.segment_name(args.worker_id, args.epoch, next(seg_seq))
+        # echo the submit's input snapshot id on the descriptor: the
+        # supervisor's result cache inserts ONLY when the echo matches
+        # what the client declared (provenance proven end to end)
         desc = dp.build_descriptor(plane, name, len(view), fp,
-                                   chunk_bytes, crcs, args.epoch)
+                                   chunk_bytes, crcs, args.epoch,
+                                   snapshot=sid_snapshots.pop(sid, None))
         try:
             data_desc_probe()
         except faultinj.ShmStaleError:
@@ -668,6 +675,8 @@ def main(argv=None) -> int:
             }, queue_on_fail=True)
             return
         params = msg.get("params") or {}
+        if msg.get("snapshot") is not None:
+            sid_snapshots[sid] = msg["snapshot"]
         announced = threading.Event()
 
         def query(ctx, sess):

@@ -93,7 +93,8 @@ class MorselSource:
     """
 
     def __init__(self, replays: List[Callable], morsel_rows: int,
-                 rows: int, mesh=None, axis_name: str = "data"):
+                 rows: int, mesh=None, axis_name: str = "data",
+                 snapshot_id: Optional[str] = None):
         self._replays = list(replays)
         self.morsel_rows = int(morsel_rows)
         self.rows = int(rows)
@@ -101,6 +102,12 @@ class MorselSource:
         # compiler build the ShuffleService without a side channel
         self.mesh = mesh
         self.axis_name = axis_name
+        # content snapshot id of the SOURCE: a content hash for
+        # in-memory batches (from_batch), a path+mtime+size fingerprint
+        # for Parquet files (from_parquet).  None for hand-rolled
+        # sources — which the result cache refuses to key on (no
+        # snapshot id, no caching, never a guess).
+        self.snapshot_id = snapshot_id
 
     def __iter__(self):
         return iter(self._replays)
@@ -145,8 +152,11 @@ class MorselSource:
         def make(j):
             return lambda: sl(padded, valid, jnp.int32(j))
 
+        from ..serve.result_cache import snapshot_for_batch
+
         return cls([make(j) for j in range(k)], morsel_rows,
-                   batch.num_rows, mesh=mesh, axis_name=axis_name)
+                   batch.num_rows, mesh=mesh, axis_name=axis_name,
+                   snapshot_id=snapshot_for_batch(batch))
 
     @classmethod
     def from_parquet(cls, path, mesh, axis_name: str = "data",
@@ -193,5 +203,8 @@ class MorselSource:
             for lo in range(0, max(rg_rows, 1), gm):
                 n = min(gm, rg_rows - lo) if rg_rows else 0
                 replays.append(make(read, lo, max(n, 0)))
+        from ..serve.result_cache import snapshot_for_path
+
         return cls(replays, morsel_rows, total, mesh=mesh,
-                   axis_name=axis_name)
+                   axis_name=axis_name,
+                   snapshot_id=snapshot_for_path(path))

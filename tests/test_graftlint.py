@@ -1037,6 +1037,63 @@ class TestGL014:
 
 
 # ---------------------------------------------------------------------------
+# GL015 — result-cache key drift (serve/insert missing a key component)
+# ---------------------------------------------------------------------------
+
+
+class TestGL015:
+    def test_missing_components_flagged(self, tmp_path):
+        res = lint(tmp_path, {"serve/door.py": """
+            from .result_cache import ResultCache, get_result_cache
+
+            def bad_sites(sig, snap, fp, payload):
+                cache = ResultCache()
+                cache.serve(sig, snap)                 # no knob_fp
+                cache.insert(sig, payload)             # no snapshot/knob_fp
+                get_result_cache().serve(sig)          # ctor-expr receiver
+        """}, rules=["GL015"])
+        assert new_rules(res) == [("GL015", "serve/door.py")] * 3
+
+    def test_self_attribute_receiver_flagged(self, tmp_path):
+        res = lint(tmp_path, {"serve/door.py": """
+            from .result_cache import ResultCache
+
+            class Door:
+                def __init__(self):
+                    self.result_cache = ResultCache()
+
+                def lookup(self, sig, snap):
+                    return self.result_cache.serve(sig, snapshot=snap)
+        """}, rules=["GL015"])
+        assert new_rules(res) == [("GL015", "serve/door.py")]
+
+    def test_full_triple_positional_and_kwargs_clean(self, tmp_path):
+        res = lint(tmp_path, {"serve/door.py": """
+            from .result_cache import ResultCache, get_result_cache
+
+            def good_sites(sig, snap, fp, payload, key):
+                cache = ResultCache()
+                cache.serve(sig, snap, fp)
+                cache.insert(sig, snap, fp, payload, schema_fp="x")
+                get_result_cache().serve(sig, snapshot=snap, knob_fp=fp)
+                cache.serve(*key)          # splat may carry the triple
+                other = object()
+                other.serve(sig)           # not provably a ResultCache
+        """}, rules=["GL015"])
+        assert res.new == []
+
+    def test_suppressed(self, tmp_path):
+        res = lint(tmp_path, {"serve/door.py": """
+            from .result_cache import ResultCache
+
+            def probe(sig, snap):
+                cache = ResultCache()
+                cache.serve(sig, snap)  # graftlint: disable=GL015
+        """}, rules=["GL015"])
+        assert res.new == [] and res.counts()["suppressed"] == 1
+
+
+# ---------------------------------------------------------------------------
 # baseline ratchet
 # ---------------------------------------------------------------------------
 
@@ -1152,4 +1209,4 @@ class TestLiveTree:
         ids = [r.id for r in rules_mod.all_rules()]
         assert ids == ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
                        "GL007", "GL008", "GL009", "GL010", "GL011", "GL012",
-                       "GL013", "GL014"]
+                       "GL013", "GL014", "GL015"]

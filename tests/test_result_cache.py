@@ -1,0 +1,423 @@
+"""Fleet result cache (r16, ``serve/result_cache.py``): snapshot ids,
+the three-component key, tiered capacity, and the stale/corrupt
+detection paths.
+
+The contract under test everywhere: a cached answer is served ONLY
+when signature, input snapshot id, and knob fingerprint all match —
+and a served hit is bit-identical with zero compute (no admission
+ticket, no worker transfer).  Detection of a stale or damaged entry
+always resolves to a recompute, never a wrong answer.
+"""
+
+import os
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from spark_rapids_jni_tpu import config, faultinj
+from spark_rapids_jni_tpu.columnar import Column, ColumnBatch
+from spark_rapids_jni_tpu.columnar import types as T
+from spark_rapids_jni_tpu.plan import compile as plan_compile
+from spark_rapids_jni_tpu.plan import ir
+from spark_rapids_jni_tpu.serve import FrontDoor
+from spark_rapids_jni_tpu.serve import data_plane as dp
+from spark_rapids_jni_tpu.serve import result_cache as rc
+from spark_rapids_jni_tpu.serve import runtime as rt
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    config.set("serve_backoff_ms", 40.0)
+    yield
+    config.reset("serve_backoff_ms")
+    faultinj.configure(None)
+
+
+def _batch(vals):
+    return ColumnBatch({"x": Column.from_pylist(list(vals), T.INT64)})
+
+
+def _payload(n, seed=0):
+    return bytes((seed + i) % 256 for i in range(n))
+
+
+def _cache_triple(tag="t"):
+    """A ready-to-use (signature, snapshot, knob_fp) key triple."""
+    return (rc.query_signature("arrow_batch", {"rows": 64, "tag": tag}),
+            rc.snapshot_for_obj({"tag": tag, "gen": 0}),
+            rc.knob_fingerprint())
+
+
+class TestSnapshotIds:
+    def test_batch_content_hash_stable_and_mutation_sensitive(self):
+        vals = list(range(32))
+        s1 = rc.snapshot_for_batch(_batch(vals))
+        s2 = rc.snapshot_for_batch(_batch(list(vals)))
+        assert s1 == s2 and s1.startswith("mem:")
+        mutated = list(vals)
+        mutated[17] += 1  # one-row mutation must change the id
+        assert rc.snapshot_for_batch(_batch(mutated)) != s1
+
+    def test_path_snapshot_tracks_rewrites(self, tmp_path):
+        p = tmp_path / "input.parquet"
+        p.write_bytes(b"a" * 128)
+        s1 = rc.snapshot_for_path(str(p))
+        assert s1 == rc.snapshot_for_path(str(p))
+        assert s1.startswith("file:")
+        # same-size rewrite: mtime_ns moves, so the id must move
+        p.write_bytes(b"b" * 128)
+        os.utime(p, ns=(time.time_ns(), time.time_ns() + 1))
+        assert rc.snapshot_for_path(str(p)) != s1
+        with pytest.raises(OSError):
+            rc.snapshot_for_path(str(tmp_path / "missing"))
+
+    def test_obj_snapshot_canonical(self):
+        a = rc.snapshot_for_obj({"rows": 64, "seed": 3})
+        b = rc.snapshot_for_obj({"seed": 3, "rows": 64})
+        assert a == b  # dict order is canonicalized
+        assert rc.snapshot_for_obj({"rows": 64, "seed": 4}) != a
+
+
+class TestResultKey:
+    def test_no_snapshot_id_no_caching_never_a_guess(self):
+        plan = ir.Scan("t")
+        assert plan_compile.result_key(plan, {"t": object()}) is None
+        src = SimpleNamespace(snapshot_id="mem:abc")
+        key = plan_compile.result_key(plan, {"t": src})
+        assert key is not None
+        # every scan must be pinned: one unproven input poisons the key
+        two = ir.Union((ir.Scan("t"), ir.Scan("u"))) \
+            if hasattr(ir, "Union") else None
+        if two is not None:
+            assert plan_compile.result_key(
+                two, {"t": src, "u": object()}) is None
+
+    def test_key_moves_with_each_component(self):
+        plan = ir.Scan("t")
+        src = SimpleNamespace(snapshot_id="mem:abc")
+        base = plan_compile.result_key(plan, {"t": src})
+        moved = plan_compile.result_key(
+            plan, {"t": SimpleNamespace(snapshot_id="mem:abd")})
+        assert moved != base  # snapshot component
+        config.set("shuffle_round_rows", 1 << 12)
+        try:
+            flipped = plan_compile.result_key(plan, {"t": src})
+        finally:
+            config.reset("shuffle_round_rows")
+        assert flipped != base  # knob-fingerprint component
+        other = plan_compile.result_key(ir.Scan("u"), {"u": src})
+        assert other != base  # signature component
+
+    def test_plan_cache_key_stays_content_blind(self):
+        # the plan cache reuses compiled programs ACROSS contents: its
+        # key must not move when only the snapshot does
+        plan = ir.Scan("t")
+        b = _batch(range(16))
+        k1 = plan_compile.plan_cache_key(plan, {"t": b})
+        k2 = plan_compile.plan_cache_key(ir.Scan("t"), {"t": b})
+        assert k1 == k2
+
+
+class TestCacheCore:
+    def test_miss_insert_hit_roundtrip(self):
+        cache = rc.ResultCache(max_bytes=1 << 20)
+        sig, snap, fp = _cache_triple()
+        assert cache.serve(sig, snap, fp) is None
+        payload = _payload(4096, seed=9)
+        assert cache.insert(sig, snap, fp, payload, schema_fp="fp0",
+                            tenant="a", chunk_bytes=1024)
+        view = cache.serve(sig, snap, fp)
+        assert view is not None
+        assert bytes(view.payload) == payload  # bit-identical bytes
+        assert view.snapshot == snap
+        assert view.crcs == list(
+            dp.chunk_crcs(memoryview(payload), 1024))
+        cache.record_hit(view.size)
+        m = cache.metrics()
+        assert (m["hits"], m["misses"], m["inserts"]) == (1, 1, 1)
+        assert m["hit_bytes_served"] == len(payload)
+        cache.clear()
+
+    def test_any_component_mismatch_is_a_miss(self):
+        cache = rc.ResultCache(max_bytes=1 << 20)
+        sig, snap, fp = _cache_triple()
+        cache.insert(sig, snap, fp, _payload(256), schema_fp="fp0")
+        assert cache.serve(sig, snap + "!new", fp) is None
+        assert cache.serve(rc.query_signature("arrow_batch",
+                                              {"rows": 65}),
+                           snap, fp) is None
+        config.set("shuffle_round_rows", 1 << 12)
+        try:
+            assert cache.serve(sig, snap, rc.knob_fingerprint()) is None
+        finally:
+            config.reset("shuffle_round_rows")
+        assert cache.serve(sig, None, fp) is None  # never a guess
+        cache.clear()
+
+    def test_disabled_knob_bypasses_both_directions(self):
+        cache = rc.ResultCache(max_bytes=1 << 20)
+        sig, snap, fp = _cache_triple()
+        config.set("result_cache", False)
+        try:
+            assert not cache.insert(sig, snap, fp, _payload(64),
+                                    schema_fp="fp0")
+            assert cache.serve(sig, snap, fp) is None
+            assert len(cache) == 0
+        finally:
+            config.reset("result_cache")
+
+    def test_tenant_quota_evicts_own_lru_only(self):
+        cache = rc.ResultCache(max_bytes=1 << 20, tenant_quota=2048)
+        fp = rc.knob_fingerprint()
+        keys = {}
+        for i in range(3):  # 3 x 1KiB for tenant a: quota holds 2
+            sig = rc.query_signature("arrow_batch", {"i": i})
+            snap = rc.snapshot_for_obj({"i": i})
+            keys[i] = (sig, snap)
+            cache.insert(sig, snap, fp, _payload(1024, seed=i),
+                         schema_fp="fp0", tenant="a")
+        bsig = rc.query_signature("arrow_batch", {"i": 99})
+        bsnap = rc.snapshot_for_obj({"i": 99})
+        cache.insert(bsig, bsnap, fp, _payload(1024, seed=99),
+                     schema_fp="fp0", tenant="b")
+        # tenant a's OLDEST entry paid; a's newest and b's survive
+        assert cache.serve(*keys[0], fp) is None
+        assert cache.serve(*keys[2], fp) is not None
+        assert cache.serve(bsig, bsnap, fp) is not None
+        assert cache.metrics()["quota_evictions"] >= 1
+        assert cache.tenant_bytes("a") <= 2048
+        assert cache.tenant_bytes("b") == 1024
+        cache.clear()
+
+    def test_host_budget_demotes_before_dropping(self, tmp_path):
+        # the disk tier exists only under an installed spill framework;
+        # without one the budget can only DROP (graceful degradation)
+        from spark_rapids_jni_tpu.mem import spill as spill_mod
+
+        spill_mod.install(spill_dir=str(tmp_path / "spill"))
+        try:
+            cache = rc.ResultCache(max_bytes=8192, tenant_quota=0)
+            fp = rc.knob_fingerprint()
+            triples = []
+            for i in range(3):  # 3 x 4KiB against an 8KiB host budget
+                sig = rc.query_signature("arrow_batch", {"i": i})
+                snap = rc.snapshot_for_obj({"i": i})
+                triples.append((sig, snap))
+                cache.insert(sig, snap, fp, _payload(4096, seed=i),
+                             schema_fp="fp0", tenant="a")
+            m = cache.metrics()
+            assert m["demotions"] >= 1 and m["drops"] == 0
+            assert cache.tiers().get("disk", 0) >= 1
+            assert m["host_bytes"] <= 8192
+            # a demoted entry still serves its exact bytes (checksummed
+            # disk read-back through the spill framework)
+            for i, (sig, snap) in enumerate(triples):
+                view = cache.serve(sig, snap, fp)
+                assert view is not None
+                assert bytes(view.payload) == _payload(4096, seed=i)
+            cache.clear()
+        finally:
+            spill_mod.shutdown()
+
+    def test_no_framework_budget_drops_loudly_counted(self):
+        # no spill framework installed: over-budget entries cannot
+        # demote, so the cache drops its coldest and counts it
+        cache = rc.ResultCache(max_bytes=8192, tenant_quota=0)
+        fp = rc.knob_fingerprint()
+        for i in range(3):
+            cache.insert(rc.query_signature("arrow_batch", {"i": i}),
+                         rc.snapshot_for_obj({"i": i}), fp,
+                         _payload(4096, seed=i), schema_fp="fp0")
+        m = cache.metrics()
+        assert m["drops"] >= 1
+        assert m["host_bytes"] <= 8192
+        cache.clear()
+
+    def test_invalidate_snapshot_drops_all_entries_for_it(self):
+        cache = rc.ResultCache(max_bytes=1 << 20)
+        fp = rc.knob_fingerprint()
+        snap = rc.snapshot_for_obj({"shared": True})
+        for i in range(2):
+            cache.insert(rc.query_signature("arrow_batch", {"i": i}),
+                         snap, fp, _payload(128), schema_fp="fp0")
+        other = rc.snapshot_for_obj({"shared": False})
+        cache.insert(rc.query_signature("arrow_batch", {"i": 9}),
+                     other, fp, _payload(128), schema_fp="fp0")
+        assert cache.invalidate_snapshot(snap) == 2
+        assert len(cache) == 1
+        assert cache.serve(rc.query_signature("arrow_batch", {"i": 9}),
+                           other, fp) is not None
+        cache.clear()
+
+
+class TestFaultPaths:
+    """The injected `cache_stale` / `cache_corrupt` kinds, converted to
+    real damage at the `cache_serve` / `cache_insert` probes — serve
+    verification must catch every shape."""
+
+    def test_stale_at_serve_surfaces_rewound_snapshot(self):
+        cache = rc.ResultCache(max_bytes=1 << 20)
+        sig, snap, fp = _cache_triple()
+        cache.insert(sig, snap, fp, _payload(512), schema_fp="fp0")
+        faultinj.configure({"faults": [
+            {"match": "cache_serve", "fault": "cache_stale", "count": 1},
+        ]})
+        view = cache.serve(sig, snap, fp)
+        # the view's snapshot no longer equals the submit's expected
+        # one — exactly what the front door's verify_snapshot rejects
+        assert view is not None and view.snapshot != snap
+        cache.record_stale(view.key)
+        assert cache.metrics()["stale_rejected"] == 1
+        # the entry itself is kept: a genuinely mutated input arrives
+        # under a NEW id and simply never matches this key
+        clean = cache.serve(sig, snap, fp)
+        assert clean is not None and clean.snapshot == snap
+        cache.clear()
+
+    def test_stale_at_insert_rewinds_the_stored_id(self):
+        cache = rc.ResultCache(max_bytes=1 << 20)
+        sig, snap, fp = _cache_triple()
+        faultinj.configure({"faults": [
+            {"match": "cache_insert", "fault": "cache_stale", "count": 1},
+        ]})
+        cache.insert(sig, snap, fp, _payload(512), schema_fp="fp0")
+        faultinj.configure(None)
+        view = cache.serve(sig, snap, fp)
+        assert view is not None and view.snapshot != snap
+        cache.clear()
+
+    def _assert_corrupt_detected(self, cache, sig, snap, fp, payload):
+        view = cache.serve(sig, snap, fp)
+        if view is None:
+            # the stored tier itself refused the bytes (checksummed
+            # read-back) and the entry was quarantined in serve()
+            pass
+        else:
+            # host-tier damage: the bytes came back but can never
+            # re-derive the insert-time chunk CRCs — the front door's
+            # per-chunk verify catches it and quarantines
+            got = list(dp.chunk_crcs(memoryview(view.payload),
+                                     view.chunk_bytes))
+            assert got != view.crcs
+            assert bytes(view.payload) != payload
+            cache.quarantine(view.key)
+        assert cache.metrics()["corrupt_quarantined"] == 1
+        assert cache.serve(sig, snap, fp) is None  # slot freed
+
+    def test_corrupt_at_serve_quarantined(self):
+        cache = rc.ResultCache(max_bytes=1 << 20)
+        sig, snap, fp = _cache_triple()
+        payload = _payload(2048, seed=5)
+        cache.insert(sig, snap, fp, payload, schema_fp="fp0",
+                     chunk_bytes=512)
+        faultinj.configure({"faults": [
+            {"match": "cache_serve", "fault": "cache_corrupt",
+             "count": 1},
+        ]})
+        self._assert_corrupt_detected(cache, sig, snap, fp, payload)
+        cache.clear()
+
+    def test_corrupt_at_insert_detected_on_first_serve(self):
+        cache = rc.ResultCache(max_bytes=1 << 20)
+        sig, snap, fp = _cache_triple()
+        payload = _payload(2048, seed=6)
+        faultinj.configure({"faults": [
+            {"match": "cache_insert", "fault": "cache_corrupt",
+             "count": 1},
+        ]})
+        cache.insert(sig, snap, fp, payload, schema_fp="fp0",
+                     chunk_bytes=512)
+        faultinj.configure(None)
+        self._assert_corrupt_detected(cache, sig, snap, fp, payload)
+        cache.clear()
+
+
+class TestFrontDoorE2E:
+    def test_hit_bit_identical_with_zero_compute(self):
+        fd = FrontDoor(workers=2, heartbeat_ms=80.0)
+        try:
+            snap = rc.snapshot_for_obj({"case": "e2e", "gen": 0})
+            params = {"rows": 256, "seed": 5}
+            warm = fd.submit("arrow_batch", params, tenant="a",
+                             snapshot=snap)
+            digest = dp.batch_digest(warm.result(timeout=90))
+            assert not warm.served_from_cache
+            before = fd.metrics.snapshot()
+            tick0 = rt.admission_tickets_issued()
+            # repeat — from ANOTHER tenant, pinned to the other worker:
+            # the cache is supervisor-side and fleet-wide
+            hit = fd.submit("arrow_batch", params, tenant="b",
+                            snapshot=snap)
+            assert dp.batch_digest(hit.result(timeout=90)) == digest
+            assert hit.served_from_cache
+            after = fd.metrics.snapshot()
+            # zero compute: no admission ticket, no data-plane transfer
+            assert rt.admission_tickets_issued() == tick0
+            assert after["data_batches"] == before["data_batches"]
+            assert after["cache_hits"] == before["cache_hits"] + 1
+            assert after["hit_bytes_served"] > before["hit_bytes_served"]
+            # a mutated input is a NEW snapshot id: never a hit
+            moved = fd.submit("arrow_batch", params, tenant="b",
+                              snapshot=rc.snapshot_for_obj(
+                                  {"case": "e2e", "gen": 1}))
+            assert dp.batch_digest(moved.result(timeout=90)) == digest
+            assert not moved.served_from_cache
+            # no snapshot id, no caching: repeats recompute every time
+            for _ in range(2):
+                bare = fd.submit("arrow_batch", params, tenant="a")
+                bare.result(timeout=90)
+                assert not bare.served_from_cache
+        finally:
+            report = fd.shutdown()
+        assert report["clean"], report
+        m = report["result_cache"]
+        assert m["hits"] == 1 and m["inserts"] >= 2
+        assert m["hit_bytes_served"] > 0
+
+    def test_stale_and_corrupt_entries_recompute_not_served(self):
+        fd = FrontDoor(workers=1, heartbeat_ms=80.0)
+        try:
+            snap = rc.snapshot_for_obj({"case": "faulted", "gen": 0})
+            params = {"rows": 128, "seed": 11}
+            warm = fd.submit("arrow_batch", params, tenant="a",
+                             snapshot=snap)
+            digest = dp.batch_digest(warm.result(timeout=90))
+            fired = set()
+            # 1) the cached entry goes stale right at serve time: the
+            # snapshot fence rejects it and the query recomputes
+            faultinj.configure({"faults": [
+                {"match": "cache_serve", "fault": "cache_stale",
+                 "count": 1},
+            ]})
+            s = fd.submit("arrow_batch", params, tenant="a",
+                          snapshot=snap)
+            assert dp.batch_digest(s.result(timeout=90)) == digest
+            assert not s.served_from_cache
+            fired |= {e.get("fault") for e in faultinj.fired_log()}
+            # 2) real payload damage while cached: chunk CRCs catch it,
+            # the entry is quarantined, the query recomputes + reinserts
+            faultinj.configure({"faults": [
+                {"match": "cache_serve", "fault": "cache_corrupt",
+                 "count": 1},
+            ]})
+            c = fd.submit("arrow_batch", params, tenant="a",
+                          snapshot=snap)
+            assert dp.batch_digest(c.result(timeout=90)) == digest
+            assert not c.served_from_cache
+            fired |= {e.get("fault") for e in faultinj.fired_log()}
+            # 3) fault cleared: the reinserted entry serves a clean hit
+            # (configure resets the fired trace, hence the captures)
+            faultinj.configure(None)
+            h = fd.submit("arrow_batch", params, tenant="a",
+                          snapshot=snap)
+            assert dp.batch_digest(h.result(timeout=90)) == digest
+            assert h.served_from_cache
+        finally:
+            report = fd.shutdown()
+        assert report["clean"], report
+        m = report["result_cache"]
+        assert m["stale_rejected"] >= 1
+        assert m["corrupt_quarantined"] >= 1
+        assert m["hits"] >= 1
+        assert {"cache_stale", "cache_corrupt"} <= fired

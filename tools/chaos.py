@@ -3,7 +3,7 @@
 The premerge gate (ci/chaos.sh) that proves the fault-domain story
 end-to-end, the way ci/q95_floor.json proves perf: it sweeps every
 registered ``faultinj.FAULT_KINDS`` entry across every instrumented
-boundary of eleven scenarios — a spill walk (device→host→disk→back), an
+boundary of twelve scenarios — a spill walk (device→host→disk→back), an
 out-of-core skewed shuffle, the single-chip q95 pipeline, a global
 distributed sort across the 8-device mesh, a JNI host-boundary
 round-trip, a streaming morsel scan, a multi-tenant serving wave
@@ -22,7 +22,12 @@ in self-fencing with zero zombie commits), and a zero-copy data-plane
 wave (dataplane: result batches crossing the worker boundary as Arrow
 IPC segments, torn after their CRC stamps or announced under a dead
 fence generation — the supervisor's epoch-then-CRC verify must detect
-and re-place, bit-identically) — one fault per trial exhaustively,
+and re-place, bit-identically), and a fleet result-cache wave
+(result_cache: replayed snapshot-pinned queries served from sealed
+cached segments with zero compute — stale rewound snapshot ids
+rejected by the descriptor verify, post-seal byte flips
+quarantined-and-recomputed, and a mutated input NEVER served a stale
+snapshot) — one fault per trial exhaustively,
 plus ``chaos_trials`` seeded multi-fault trials per scenario.  The q95
 and streaming_scan matrices additionally repeat their seam trials with
 the engine knobs pinned to the pallas device-kernel tier (``+pallas``
@@ -1018,13 +1023,145 @@ class DataPlaneScenario:
                                     if k != "liveness"}}}
 
 
+class ResultCacheScenario:
+    """The fleet result cache under fire: three tenants replay the same
+    ``arrow_batch`` queries with content snapshot ids declared, so the
+    warm wave computes live and every replay wave should be served from
+    the supervisor's sealed cache segments — BEFORE admission, with
+    zero worker dispatch.  ``cache_stale`` rewinds the snapshot id a
+    serve (or insert) records, and ``cache_corrupt`` flips a stored
+    byte post-seal: the front door's live-grade verification (fence
+    epoch, snapshot id, chunk CRCs, schema fingerprint) must reject the
+    damaged serve, quarantine or stale-count it, and recompute —
+    bit-identical to the fault-free baseline.  The final wave MUTATES
+    every tenant's input (new snapshot ids): those submissions must all
+    miss — a cache that serves even one stale snapshot to a mutated
+    input fails the scenario outright, faults or no faults.  Stale
+    rejections + quarantines surface as ``recovered_partitions`` so the
+    cache trials can assert the verify path actually fired."""
+
+    name = "result_cache"
+    n_tenants = 3
+    seeds = (61, 62, 63)
+    rows = 1024
+    replays = 3
+
+    def run(self) -> Dict:
+        from spark_rapids_jni_tpu.mem import RetryOOM
+        from spark_rapids_jni_tpu.serve import (AdmissionShed, FrontDoor,
+                                                QueryCancelled, WorkerLost)
+        from spark_rapids_jni_tpu.serve import data_plane as dp
+        from spark_rapids_jni_tpu.serve import result_cache as rcache
+
+        kills = 0
+        config.set("serve_backoff_ms", 30.0)
+        fd = FrontDoor(workers=2, pool_bytes=2 * MB,
+                       host_pool_bytes=512 * KB, max_concurrent=2,
+                       heartbeat_ms=60.0, respawn_max=4,
+                       data_plane_mode="shm")
+        try:
+            def snap(i: int, gen: int) -> str:
+                return rcache.snapshot_for_obj(
+                    {"scenario": self.name, "tenant": i,
+                     "seed": self.seeds[i], "gen": gen})
+
+            def wave(gen: int, forbid_hits: bool = False):
+                nonlocal kills
+                digests: List[Optional[str]] = [None] * self.n_tenants
+                pending = list(range(self.n_tenants))
+                attempts = {i: 0 for i in pending}
+                while pending:
+                    subs = [(i, fd.submit(
+                        "arrow_batch",
+                        {"rows": self.rows, "seed": self.seeds[i]},
+                        tenant=f"tenant-{i}", snapshot=snap(i, gen)))
+                        for i in pending]
+                    pending = []
+                    for i, sess in subs:
+                        if forbid_hits and sess.served_from_cache:
+                            raise ChaosError(
+                                f"result_cache: tenant {i} was served a "
+                                f"CACHED result for a MUTATED input "
+                                f"(snapshot {snap(i, gen)!r}) — stale "
+                                f"serve, the one unforgivable outcome")
+                        try:
+                            digests[i] = dp.batch_digest(
+                                sess.result(timeout=60.0))
+                        except faultinj.FatalInjectedFault:
+                            raise  # whole-scenario replacement
+                        except (WorkerLost, AdmissionShed,
+                                faultinj.TaskCancelled,
+                                faultinj.InjectedFault, QueryCancelled,
+                                RetryOOM, dp.DataPlaneCorruption,
+                                dp.DataPlaneStale):
+                            kills += 1
+                            attempts[i] += 1
+                            if attempts[i] >= _MAX_ATTEMPTS:
+                                raise ChaosError(
+                                    f"result_cache: tenant {i} not done "
+                                    f"after {_MAX_ATTEMPTS} re-submissions")
+                            pending.append(i)
+                return digests
+
+            warm = wave(gen=0)
+            for r in range(self.replays):
+                replay = wave(gen=0)
+                if replay != warm:
+                    raise ChaosError(
+                        f"result_cache: replay wave {r} digests differ "
+                        f"from the warm wave — cached bytes are not "
+                        f"bit-identical ({replay} != {warm})")
+            # every tenant's input mutates: fresh snapshot ids, so the
+            # gen-0 entries must be unreachable — zero hits, recompute
+            mutated = wave(gen=1, forbid_hits=True)
+            if mutated != warm:  # same params → same values, recomputed
+                raise ChaosError(
+                    f"result_cache: mutated-input recompute differs "
+                    f"({mutated} != {warm})")
+        finally:
+            report = fd.shutdown()
+            config.reset("serve_backoff_ms")
+        unclean = {wid: e for wid, e in report["workers"].items()
+                   if not e.get("clean")}
+        if unclean:
+            raise ChaosError(f"result_cache: unclean workers: {unclean}")
+        if report["orphan_spill_files"]:
+            raise ChaosError(f"result_cache: orphan spill files: "
+                             f"{report['orphan_spill_files']}")
+        if os.path.exists(fd.fleet_dir):
+            raise ChaosError("result_cache: fleet dir survived shutdown")
+        rc_info = report["result_cache"]
+        if rc_info["hits"] < 1:
+            raise ChaosError(
+                f"result_cache: {self.replays} replay waves produced "
+                f"{rc_info['hits']} cache hits — the cache never served")
+        detections = (rc_info["stale_rejected"]
+                      + rc_info["corrupt_quarantined"])
+        h = hashlib.sha256()
+        for r in warm:  # position-stable: tenant i's digest at slot i
+            h.update((r or "<none>").encode())
+        return {"digest": h.hexdigest(),
+                "extra": {"tenant_kills": kills,
+                          "cache_hits": rc_info["hits"],
+                          "cache_inserts": rc_info["inserts"],
+                          "hit_bytes_served": rc_info["hit_bytes_served"],
+                          "stale_rejected": rc_info["stale_rejected"],
+                          "corrupt_quarantined":
+                              rc_info["corrupt_quarantined"],
+                          "recovered_partitions": detections,
+                          "fleet": {k: v for k, v in
+                                    report["fleet"].items()
+                                    if k != "liveness"}}}
+
+
 SCENARIOS = {s.name: s for s in (SpillScenario(), ShuffleScenario(),
                                  Q95Scenario(), SortScenario(),
                                  StreamingScanScenario(), JniScenario(),
                                  ServingScenario(), FrontdoorScenario(),
                                  StoreRecoveryScenario(),
                                  MultihostScenario(),
-                                 DataPlaneScenario())}
+                                 DataPlaneScenario(),
+                                 ResultCacheScenario())}
 
 
 # ---------------------------------------------------------------------------
@@ -1289,6 +1426,34 @@ def single_fault_trials(fast: bool = False) -> List[Trial]:
         one("dataplane", "serve_step", "worker_crash")
         one("dataplane", "serve_step", "exception")
 
+    # result_cache scenario: the fleet result cache's serve/insert
+    # seams.  cache_stale / cache_corrupt fire ONLY here and in the
+    # result-cache tests — these trials keep both kinds in the coverage
+    # check.  A stale serve rewinds the descriptor's snapshot id (the
+    # front door's snapshot verify must reject BEFORE decode and
+    # recompute live); a stale insert stores the rewound id (the NEXT
+    # replay's serve is rejected the same way); corruption flips a
+    # stored byte post-seal at either seam (the served chunk CRCs can
+    # never match — quarantine-and-recompute).  All four assert
+    # expect_recovered: the stale/quarantine counters prove the verify
+    # path fired, not merely that the replays survived.  The scenario's
+    # own mutated-input wave asserts zero hits after mutation on EVERY
+    # trial, faulted or not.
+    if not fast:
+        one("result_cache", "cache_serve", "cache_stale",
+            expect_recovered=True)
+        one("result_cache", "cache_serve", "cache_corrupt",
+            expect_recovered=True)
+        one("result_cache", "cache_insert", "cache_stale",
+            expect_recovered=True)
+        one("result_cache", "cache_insert", "cache_corrupt",
+            expect_recovered=True)
+        one("result_cache", "cache_serve", "cache_stale", skip=1,
+            expect_recovered=True)
+        one("result_cache", "serve_step", "worker_crash")
+        one("result_cache", "worker_result", "worker_crash")
+        one("result_cache", "serve_step", "oom")
+
     # multihost scenario: the three network kinds fired at the worker
     # side of both directions, link drops at the supervisor side of
     # both, and the partition trial.  net_drop / net_stall / net_torn
@@ -1355,6 +1520,12 @@ _MULTI_POOL = {
                   ("data_descriptor_wk", "shm_stale"),
                   ("worker_result", "worker_crash"),
                   ("serve_step", "oom")],
+    "result_cache": [("cache_serve", "cache_stale"),
+                     ("cache_serve", "cache_corrupt"),
+                     ("cache_insert", "cache_stale"),
+                     ("cache_insert", "cache_corrupt"),
+                     ("serve_step", "worker_crash"),
+                     ("serve_step", "oom")],
 }
 
 

@@ -1459,6 +1459,98 @@ class GL014DecodeAtWrongSeam(Rule):
             yield from self._scan(pf, child, sanctioned)
 
 
+# ---------------------------------------------------------------------------
+# GL015 — result-cache key drift
+# ---------------------------------------------------------------------------
+
+# how a receiver is PROVABLY the fleet result cache: constructed, or
+# fetched from the module-level accessor
+_GL015_CACHE_SOURCES = frozenset({"ResultCache", "get_result_cache"})
+# the three key components every serve/insert must carry, in the
+# positional order serve/result_cache.py declares them
+_GL015_KEY_PARAMS = ("signature", "snapshot", "knob_fp")
+
+
+class GL015ResultCacheKeyDrift(Rule):
+    """The fleet result cache (serve/result_cache.py) keys every entry
+    on the FULL triple ``(IR/query signature, input snapshot id, config
+    knob fingerprint)`` — drop any one component and the cache serves
+    across a boundary it must not: a different query under the same
+    snapshot, a mutated input under the same signature, or a knob flip
+    that changed the answer.  The runtime guards only the snapshot
+    (``None`` short-circuits); a call site that hardcodes or omits a
+    component type-checks fine and corrupts results silently on the
+    first collision.  So the contract is enforced statically: any
+    ``.serve(...)`` / ``.insert(...)`` on a receiver provably bound to
+    ``ResultCache(...)`` or ``get_result_cache()`` — a local name, a
+    ``self.``-attribute, or the construction itself — must cover all
+    three key components, positionally (the methods declare them first,
+    in registry order) or by keyword.  A ``*args``/``**kwargs`` splat
+    is accepted: the components may flow through, and proving otherwise
+    is beyond a linter's jurisdiction."""
+
+    id = "GL015"
+
+    @staticmethod
+    def _recv_path(node: ast.AST) -> Optional[str]:
+        """Dotted path of a Name / nested-Attribute receiver
+        (``cache``, ``self.result_cache``), else None."""
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+
+    @staticmethod
+    def _is_cache_expr(node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        func = node.func
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else None)
+        return name in _GL015_CACHE_SOURCES
+
+    @classmethod
+    def _missing_components(cls, call: ast.Call) -> List[str]:
+        if any(isinstance(a, ast.Starred) for a in call.args) or \
+                any(kw.arg is None for kw in call.keywords):
+            return []  # splats may carry the rest — can't prove drift
+        covered = set(_GL015_KEY_PARAMS[:len(call.args)])
+        covered.update(kw.arg for kw in call.keywords
+                       if kw.arg in _GL015_KEY_PARAMS)
+        return [p for p in _GL015_KEY_PARAMS if p not in covered]
+
+    def check(self, pf: ParsedFile) -> Iterable[Finding]:
+        receivers: Set[str] = set()
+        for node in ast.walk(pf.tree):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and self._is_cache_expr(node.value)):
+                path = self._recv_path(node.targets[0])
+                if path:
+                    receivers.add(path)
+        for node in ast.walk(pf.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("serve", "insert")):
+                continue
+            recv = node.func.value
+            if not (self._is_cache_expr(recv)
+                    or (self._recv_path(recv) or "") in receivers):
+                continue
+            missing = self._missing_components(node)
+            if missing:
+                yield pf.finding(
+                    self.id, node,
+                    f"result-cache `.{node.func.attr}(...)` is missing "
+                    f"key component(s) {missing} — every serve/insert "
+                    "must carry the full (signature, snapshot, knob_fp) "
+                    "triple or the cache serves across a query/input/"
+                    "config boundary it must never cross")
+
+
 _ALL: List[Rule] = [GL001TracerLeak(), GL002HostSyncUnderJit(),
                     GL003RetraceHazard(), GL004SpillHandleLeak(),
                     GL005ConfigDrift(), GL006FaultKindDrift(),
@@ -1468,7 +1560,8 @@ _ALL: List[Rule] = [GL001TracerLeak(), GL002HostSyncUnderJit(),
                     GL011ServeSessionLeak(),
                     GL012FrontDoorHandleLeak(),
                     GL013PallasInterpretDrift(),
-                    GL014DecodeAtWrongSeam()]
+                    GL014DecodeAtWrongSeam(),
+                    GL015ResultCacheKeyDrift()]
 
 
 def all_rules(only: Optional[Sequence[str]] = None) -> List[Rule]:
