@@ -56,6 +56,14 @@ repeats must be served from sealed cached Arrow segments bit-identically
 with zero compute, the hit rate must clear 0.5, and ``vs_baseline`` is
 p99_miss / p99_hit (only-shrinks floor ``result_cache_floor`` in
 ci/q95_floor.json).
+
+``python bench.py --elastic`` runs the elastic-fleet scenario: a
+skewed-tenant trace (one spill-heavy hog + a stream of one-shot light
+tenants) replayed under ``placement=load`` vs ``placement=round_robin``
+— ``vs_baseline`` is p99_rr / p99_load over the light latencies
+(only-shrinks floor ``placement_p99_floor``) — plus a queue-driven
+autoscale phase whose ``note`` carries ``scale_up_ms``/``scale_down_ms``
+and must show >=1 scale-up and >=1 drained retirement.
 """
 
 import json
@@ -1067,6 +1075,240 @@ def cache_main():
             "p99_miss_ms": round(p99_miss, 2),
             "hit_bytes_served": int(rc_info["hit_bytes_served"]),
             "cache_inserts": int(rc_info["inserts"]),
+        },
+    }), flush=True)
+    return 0
+
+
+def elastic_main():
+    """Elastic-fleet scenario (--elastic): skewed-tenant placement A/B
+    plus autoscale reaction latency.
+
+    Phase A replays the same skewed trace twice through a 2-worker
+    FrontDoor: one "hog" tenant keeps a spill-heavy query permanently
+    in flight on its pinned worker (below capacity, so that worker
+    stays a placement candidate), while a stream of one-shot light
+    tenants each place a fresh session.  Under ``placement=round_robin``
+    the rotation colocates roughly half the light tenants with the hog,
+    where they contend on the worker's arena/spill tiers; under
+    ``placement=load`` the pong-fed load score steers them to the idle
+    worker.  ``vs_baseline`` is p99_round_robin / p99_load over the
+    light-tenant latencies — the tail latency load-aware placement
+    removes — riding the only-shrinks ``placement_p99_floor`` in
+    ci/q95_floor.json, and the child fails outright if load placement's
+    p99 exceeds round-robin's.
+
+    Phase B starts a 1-worker fleet with the queue-driven autoscaler on
+    aggressive thresholds, bursts it with slow queries, and measures
+    ``scale_up_ms`` (burst → first scale-up spawned) and
+    ``scale_down_ms`` (backlog drained → first idle worker retired
+    through the drain→fence→reap ladder).  At least one scale-up and
+    one drained retirement (``fenced_commits == 0``) are mandatory."""
+    import jax
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        platform = jax.devices()[0].platform
+    except Exception as e:  # backend init failure → parent falls back
+        print(f"# backend init failed: {e}", file=sys.stderr, flush=True)
+        return 17
+
+    import threading
+
+    from spark_rapids_jni_tpu import config
+    from spark_rapids_jni_tpu.serve import FrontDoor
+
+    n_lights = int(os.environ.get("BENCH_ELASTIC_LIGHTS", "14"))
+    hog_rows = int(os.environ.get("BENCH_ELASTIC_HOG_ROWS", str(96 << 10)))
+    light_rows = int(os.environ.get("BENCH_ELASTIC_LIGHT_ROWS",
+                                    str(24 << 10)))
+
+    def _pct(vals, q):
+        vals = sorted(vals)
+        return vals[min(len(vals) - 1, int(round(q * (len(vals) - 1))))]
+
+    def _placement_arm(mode):
+        """One arm of the A/B: hog saturates its pinned worker's spill
+        tiers while one-shot light tenants place fresh sessions; returns
+        (light latencies ms, colocated count, hog worker id, wall s)."""
+        fd = FrontDoor(workers=2, max_concurrent=3, placement=mode,
+                       pool_bytes=1 << 20, host_pool_bytes=256 << 10,
+                       heartbeat_ms=150.0)
+        stop = threading.Event()
+        hog_err = []
+
+        def _hog():
+            # double-buffered: two walks in flight at all times, so the
+            # hog's worker never momentarily reads 0 sessions (a gap
+            # would let load placement tie-break a light onto it) yet
+            # stays below max_concurrent — a candidate in both modes
+            seed = 0
+            inflight = []
+            try:
+                while not stop.is_set():
+                    while len(inflight) < 2:
+                        seed += 1
+                        inflight.append(fd.submit(
+                            "spill_walk",
+                            {"seed": seed, "rows": hog_rows},
+                            tenant="hog-1"))
+                    inflight.pop(0).result(timeout=120.0)
+                for s in inflight:
+                    s.result(timeout=120.0)
+            except Exception as e:
+                hog_err.append(e)
+
+        t = threading.Thread(target=_hog, name="bench-elastic-hog",
+                             daemon=True)
+        lat_ms, colo = [], 0
+        try:
+            t.start()
+            # wait for the hog's pin so light placements see its load
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                with fd._lock:
+                    hog_wid = fd._pins.get("hog-1")
+                if hog_wid is not None:
+                    break
+                time.sleep(0.01)
+            else:
+                raise RuntimeError("hog tenant never placed")
+            # untimed warmups: fill every open slot on both workers so
+            # each compiles the light shape before latencies count
+            warm = [fd.submit("spill_walk",
+                              {"seed": 900 + i, "rows": light_rows},
+                              tenant=f"warm-{mode}-{i}")
+                    for i in range(4)]
+            for s in warm:
+                s.result(timeout=120.0)
+            wall0 = time.perf_counter()
+            for i in range(n_lights):
+                qt0 = time.perf_counter()
+                s = fd.submit("spill_walk",
+                              {"seed": 1000 + i, "rows": light_rows},
+                              tenant=f"lt-{mode}-{i}")
+                s.result(timeout=120.0)
+                lat_ms.append((time.perf_counter() - qt0) * 1e3)
+                if s.worker_id == hog_wid:
+                    colo += 1
+            wall = time.perf_counter() - wall0
+        finally:
+            stop.set()
+            t.join(timeout=120.0)
+            report = fd.shutdown()
+        if hog_err:
+            raise RuntimeError(f"hog tenant failed: {hog_err[0]!r}")
+        if not report["clean"]:
+            raise RuntimeError(
+                f"placement arm {mode!r} shutdown unclean: "
+                f"{report['workers']}")
+        return lat_ms, colo, hog_wid, wall
+
+    try:
+        lat_load, colo_load, _, wall_load = _placement_arm("load")
+        lat_rr, colo_rr, _, wall_rr = _placement_arm("round_robin")
+    except Exception as e:
+        print(f"# elastic placement A/B failed: {e!r}", file=sys.stderr,
+              flush=True)
+        return 1
+    p99_load = _pct(lat_load, 0.99)
+    p99_rr = _pct(lat_rr, 0.99)
+    if p99_load > p99_rr:
+        print(f"# elastic scenario: load placement p99 {p99_load:.1f}ms "
+              f"EXCEEDS round-robin p99 {p99_rr:.1f}ms — load-aware "
+              f"placement is not avoiding the hog's worker "
+              f"(colocated load={colo_load} rr={colo_rr})",
+              file=sys.stderr, flush=True)
+        return 1
+
+    # --- phase B: autoscale reaction latency -----------------------------
+    config.set("serve_autoscale_high_water", 1)
+    config.set("serve_autoscale_low_water", 0)
+    config.set("serve_autoscale_min", 1)
+    config.set("serve_autoscale_max", 3)
+    config.set("serve_autoscale_hold_ms", 100.0)
+    config.set("serve_autoscale_idle_ms", 300.0)
+    config.set("serve_autoscale_drain_ms", 4000.0)
+    scale_up_ms = scale_down_ms = -1.0
+    try:
+        fd = FrontDoor(workers=1, max_concurrent=1, heartbeat_ms=60.0,
+                       autoscale=True)
+        try:
+            burst0 = time.perf_counter()
+            sessions = [fd.submit("sleep", {"seconds": 0.4},
+                                  tenant=f"burst-{i}") for i in range(6)]
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                if fd.metrics.snapshot()["scale_ups"] >= 1:
+                    scale_up_ms = (time.perf_counter() - burst0) * 1e3
+                    break
+                time.sleep(0.01)
+            for s in sessions:
+                s.result(timeout=120.0)
+            drain0 = time.perf_counter()
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                if fd.metrics.snapshot()["scale_downs"] >= 1:
+                    scale_down_ms = (time.perf_counter() - drain0) * 1e3
+                    break
+                time.sleep(0.01)
+            snap = fd.metrics.snapshot()
+        finally:
+            report = fd.shutdown()
+    except Exception as e:
+        print(f"# elastic autoscale phase failed: {e!r}", file=sys.stderr,
+              flush=True)
+        return 1
+    finally:
+        config.reset("serve_autoscale_high_water")
+        config.reset("serve_autoscale_low_water")
+        config.reset("serve_autoscale_min")
+        config.reset("serve_autoscale_max")
+        config.reset("serve_autoscale_hold_ms")
+        config.reset("serve_autoscale_idle_ms")
+        config.reset("serve_autoscale_drain_ms")
+    if snap["scale_ups"] < 1 or scale_up_ms < 0:
+        print(f"# elastic scenario: burst never scaled the fleet up "
+              f"(scale_ups={snap['scale_ups']})", file=sys.stderr,
+              flush=True)
+        return 1
+    if snap["scale_downs"] < 1 or scale_down_ms < 0:
+        print(f"# elastic scenario: idle fleet never scaled down "
+              f"(scale_downs={snap['scale_downs']})", file=sys.stderr,
+              flush=True)
+        return 1
+    bad_retired = [e for e in report["retired"]
+                   if e["drained"] and e["fenced_commits"]]
+    if bad_retired or not any(e["drained"] for e in report["retired"]):
+        print(f"# elastic scenario: retirement ladder broken: "
+              f"{report['retired']}", file=sys.stderr, flush=True)
+        return 1
+
+    print(json.dumps({
+        "metric": "elastic_placement_throughput",
+        "value": round(2 * n_lights / (wall_load + wall_rr), 3),
+        "unit": "q/s",
+        "vs_baseline": round(p99_rr / p99_load, 3) if p99_load else 0.0,
+        "platform": platform,
+        "rows": 2 * n_lights * light_rows,
+        "note": {
+            "lights": n_lights,
+            "workers": 2,
+            "hog_rows": hog_rows,
+            "light_rows": light_rows,
+            "p50_load_ms": round(_pct(lat_load, 0.5), 2),
+            "p99_load_ms": round(p99_load, 2),
+            "p50_rr_ms": round(_pct(lat_rr, 0.5), 2),
+            "p99_rr_ms": round(p99_rr, 2),
+            "colocated_load": colo_load,
+            "colocated_rr": colo_rr,
+            "scaled_up": int(snap["scale_ups"]),
+            "scaled_down": int(snap["scale_downs"]),
+            "scale_up_ms": round(scale_up_ms, 1),
+            "scale_down_ms": round(scale_down_ms, 1),
+            "retired_drained": sum(1 for e in report["retired"]
+                                   if e["drained"]),
         },
     }), flush=True)
     return 0
@@ -2701,6 +2943,8 @@ def main():
         sys.exit(multidevice_main())
     if mode == "--child-cache":
         sys.exit(cache_main())
+    if mode == "--child-elastic":
+        sys.exit(elastic_main())
     if mode == "--probe":
         sys.exit(_probe_main())
 
@@ -2713,6 +2957,7 @@ def main():
     run_compress = mode == "--compress"
     run_multidevice = mode == "--multidevice"
     run_cache = mode == "--cache"
+    run_elastic = mode == "--elastic"
     child_mode = ("--child-micro" if run_micro
                   else "--child-spill" if run_spill
                   else "--child-serve" if run_serve
@@ -2722,6 +2967,7 @@ def main():
                   else "--child-compress" if run_compress
                   else "--child-multidevice" if run_multidevice
                   else "--child-cache" if run_cache
+                  else "--child-elastic" if run_elastic
                   else "--child")
     t0 = time.monotonic()
 
@@ -2769,6 +3015,7 @@ def main():
                   else "shuffle_compressed_throughput" if run_compress
                   else "multidevice_shuffle_throughput" if run_multidevice
                   else "result_cache_replay_throughput" if run_cache
+                  else "elastic_placement_throughput" if run_elastic
                   else "q6_pipeline_throughput")
         print(json.dumps({
             "metric": metric,

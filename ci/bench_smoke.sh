@@ -51,17 +51,24 @@ BENCH_FORCE_CPU=1 BENCH_COMPRESS_ROWS=32768 python bench.py --compress \
 # p99_hit) rides result_cache_floor
 BENCH_FORCE_CPU=1 python bench.py --cache \
   | tee /tmp/bench_smoke_cache.out
+# elastic-fleet scenario: the skewed-tenant trace under placement=load
+# vs round_robin (vs_baseline = p99_rr / p99_load over the light
+# tenants, floor placement_p99_floor) plus the queue-driven autoscale
+# phase — note.scaled_up/scaled_down must both be >= 1 with the
+# scale_up_ms/scale_down_ms reaction latencies recorded
+BENCH_FORCE_CPU=1 python bench.py --elastic \
+  | tee /tmp/bench_smoke_elastic.out
 # the q95 lines must be self-explaining (per-stage note + engines; cache +
 # decisions on the IR rows) and their vs_baseline must not regress below
 # the recorded floors — ratchets in the same only-shrinks spirit as
 # graftlint's baseline (ci/q95_floor.json); a missing q9 IR row,
-# streaming-scan row, serving row, pallas A/B row, multidevice row, or
-# result-cache row fails too
+# streaming-scan row, serving row, pallas A/B row, multidevice row,
+# result-cache row, or elastic row fails too
 python ci/check_q95_line.py /tmp/bench_smoke_q6.out \
   /tmp/bench_smoke_plan.out /tmp/bench_smoke_scan.out \
   /tmp/bench_smoke_serve.out /tmp/bench_smoke_pallas.out \
   /tmp/bench_smoke_multidevice.out /tmp/bench_smoke_compress.out \
-  /tmp/bench_smoke_cache.out
+  /tmp/bench_smoke_cache.out /tmp/bench_smoke_elastic.out
 # spill scenario: device arena capped below q6's working set; the emitted
 # line carries spill-bytes counters so BENCH_*.json tracks spill overhead
 BENCH_FORCE_CPU=1 BENCH_SPILL_ROWS=65536 python bench.py --spill

@@ -18,7 +18,12 @@
 # queries served from sealed cached segments, with cache_stale rewound
 # snapshot ids rejected by the descriptor verify, cache_corrupt
 # post-seal byte flips quarantined-and-recomputed bit-identically, and
-# a mutated input NEVER served a stale snapshot).
+# a mutated input NEVER served a stale snapshot; elastic = the
+# autoscaling front door: a worker SIGKILLed mid-wave while the
+# autoscaler is still adding capacity, launches failed at the launcher
+# boundary, and drains wedged past the deadline must all converge to
+# bit-identical digests with >=1 scale-up, >=1 retirement, and zero
+# fenced commits on every drained generation).
 #
 # Runs tools/chaos.py — every faultinj.FAULT_KINDS entry fired at every
 # instrumented boundary (one fault per trial, exhaustively) plus seeded
@@ -48,7 +53,7 @@ import json, sys
 doc = json.load(open(sys.argv[1]))
 for scenario in ("sort", "streaming_scan", "jni", "serving", "frontdoor",
                  "store_recovery", "multihost", "dataplane",
-                 "result_cache"):
+                 "result_cache", "elastic"):
     trials = [t for t in doc["trials"]
               if t["label"].startswith(scenario + ":")]
     assert trials, f"chaos report has no {scenario!r} trials"

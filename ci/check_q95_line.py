@@ -102,6 +102,14 @@ actually served from the fleet result cache, not recomputed) with
 ``hit_bytes_served > 0``, and its ``vs_baseline`` — p99_miss_ms /
 p99_hit_ms — rides ``result_cache_floor`` (1.5): cache hits must keep
 beating recomputation at p99 or the row fails.
+
+Since r17 the elastic-fleet row (``bench.py --elastic``) gets the same
+treatment: ``elastic_placement_throughput`` must exist, its
+``vs_baseline`` — p99_round_robin / p99_load over the skewed-tenant
+trace's light latencies — rides ``placement_p99_floor`` (1.0: load-aware
+placement must keep beating round-robin at p99), and its ``note`` must
+prove the autoscale loop alive: ``scaled_up >= 1``, ``scaled_down >= 1``
+and non-negative ``scale_up_ms``/``scale_down_ms`` reaction latencies.
 """
 import json
 import os
@@ -457,6 +465,44 @@ def main(paths) -> int:
             errs.append("spill-codec line's note.codec_ratio <= 1: the "
                         "frames no longer shrink the payloads "
                         f"(note={json.dumps(sc_note)})")
+    # elastic row: load-aware placement must keep beating round-robin at
+    # p99 on the skewed-tenant trace, and the autoscale phase must have
+    # actually grown AND retired capacity with its reaction latencies
+    # recorded — a missing scale event means the queue-driven loop died
+    elastic_floor = floors["placement_p99_floor"]
+    el_line = lines.get("elastic_placement_throughput")
+    if el_line is None:
+        errs.append("no elastic_placement_throughput line: the "
+                    "elastic-fleet row fell out of the smoke "
+                    "(bench.py elastic_main)")
+    else:
+        el_note = el_line.get("note")
+        if (not isinstance(el_note, dict)
+                or "p99_load_ms" not in el_note
+                or "p99_rr_ms" not in el_note):
+            errs.append("elastic line's note lacks the placement A/B "
+                        "p99 fields (p99_load_ms/p99_rr_ms): the "
+                        "comparison no longer explains itself "
+                        f"(note={json.dumps(el_note)})")
+        elif int(el_note.get("scaled_up", 0)) < 1:
+            errs.append("elastic line's note.scaled_up < 1: the burst "
+                        "never grew the fleet — queue-driven scale-up "
+                        f"is dead (note={json.dumps(el_note)})")
+        elif int(el_note.get("scaled_down", 0)) < 1:
+            errs.append("elastic line's note.scaled_down < 1: the idle "
+                        "fleet never drained a worker back out "
+                        f"(note={json.dumps(el_note)})")
+        elif (float(el_note.get("scale_up_ms", -1.0)) < 0
+                or float(el_note.get("scale_down_ms", -1.0)) < 0):
+            errs.append("elastic line's autoscale reaction latencies "
+                        "(scale_up_ms/scale_down_ms) are missing or "
+                        f"negative (note={json.dumps(el_note)})")
+        if el_line.get("vs_baseline", 0.0) < elastic_floor:
+            errs.append(f"elastic vs_baseline "
+                        f"{el_line.get('vs_baseline')} (p99_rr / "
+                        f"p99_load) fell below the recorded floor "
+                        f"{elastic_floor} (ci/q95_floor.json): load "
+                        f"placement no longer beats round-robin at p99")
     if errs:
         for e in errs:
             print("check_q95_line:", e)
@@ -477,6 +523,10 @@ def main(paths) -> int:
           f"result-cache {(rc_line or {}).get('vs_baseline')} >= floor "
           f"{cache_floor} (hit rate "
           f"{((rc_line or {}).get('note') or {}).get('hit_rate')}); "
+          f"elastic {(el_line or {}).get('vs_baseline')} >= floor "
+          f"{elastic_floor} (scale up/down "
+          f"{((el_line or {}).get('note') or {}).get('scale_up_ms')}/"
+          f"{((el_line or {}).get('note') or {}).get('scale_down_ms')}ms); "
           f"engines {json.dumps((note or {}).get('engines'))})")
     if vs >= 2 * floor and floor > 0:
         print(f"check_q95_line: note — vs_baseline is >=2x the floor; "

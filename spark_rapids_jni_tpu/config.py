@@ -383,6 +383,73 @@ _register("result_cache_tenant_quota", 16 << 20, int,
           "tenant over quota drops its own least-recently-served "
           "entries first — one dashboard's storm can never evict the "
           "whole fleet's cache.  0 or negative means unlimited.")
+_register("serve_launcher", "local", str,
+          "How worker processes come to exist (serve/launcher.py): "
+          "'local' forks the worker argv on this box (today's spawn, "
+          "verbatim); any other value is an agent/ssh-style command "
+          "template (shlex-split, worker argv spliced at '{argv}' or "
+          "appended) run per launch — the argv, resume token, and fence "
+          "epoch are identical either way, so fencing and reattach work "
+          "unmodified for remote workers.")
+_register("serve_placement", "load", str,
+          "Dispatch/placement policy of the front door (serve/"
+          "elastic.py): 'load' scores workers by effective depth "
+          "(placed sessions + pong queue depth), arena pressure, and "
+          "stall suspicion, and spreads new incarnations across hosts "
+          "fewest-live-slots-first; 'round_robin' keeps the legacy "
+          "rotation — the comparison arm for bench.py --elastic.")
+_register("serve_autoscale", False, _parse_bool,
+          "Queue-driven autoscaling of the worker fleet (serve/"
+          "elastic.py): admission-queue depth above the high-water mark "
+          "for a full hold dwell spawns a worker; a slack queue with an "
+          "idle worker retires one through the drain -> self-fence -> "
+          "reap ladder.  Off = fixed capacity, today's behavior.")
+_register("serve_autoscale_high_water", 4, int,
+          "Admission-queue depth ABOVE which the autoscaler counts "
+          "pressure; depth must stay above it for serve_autoscale_"
+          "hold_ms before a worker is added.")
+_register("serve_autoscale_low_water", 0, int,
+          "Admission-queue depth AT OR BELOW which the autoscaler "
+          "considers retiring an idle worker (drain ladder, never a "
+          "kill).")
+_register("serve_autoscale_min", 0, int,
+          "Floor of the autoscaled fleet; 0 means the configured "
+          "serve_workers is the floor (the fleet never shrinks below "
+          "its starting size).")
+_register("serve_autoscale_max", 8, int,
+          "Ceiling of the autoscaled fleet: scale-ups stop here no "
+          "matter the queue depth.")
+_register("serve_autoscale_hold_ms", 250.0, float,
+          "Debounce dwell for scale decisions: queue depth must hold "
+          "above the high-water mark this long before a spawn, and "
+          "consecutive scale actions are spaced by at least this much "
+          "(up) / the idle dwell (down).")
+_register("serve_autoscale_idle_ms", 1000.0, float,
+          "How long a worker must sit with zero placed sessions and a "
+          "zero pong queue depth before it is a retirement candidate.")
+_register("serve_autoscale_drain_ms", 5000.0, float,
+          "Drain deadline for a retiring worker: past it the drain is "
+          "declared stuck and the supervisor escalates to the ordinary "
+          "loss protocol (kill, fence, reap, re-place) — the "
+          "drain_stuck fault kind proves this ladder.")
+_register("serve_tenant_quota_bytes", 0, int,
+          "Per-tenant admission byte quota at the front door: every "
+          "submit is charged its est_bytes at admission, and a tenant "
+          "over quota is rejected loudly with QuotaExceeded (counted "
+          "in the shutdown report).  0 or negative means unlimited.")
+_register("serve_tenant_quota_s", 0.0, float,
+          "Per-tenant wall-clock quota at the front door: completed "
+          "sessions charge their submit-to-finish seconds, and a "
+          "tenant over quota has further submits rejected with "
+          "QuotaExceeded.  0 or negative means unlimited.")
+_register("serve_plan_warm", 4, int,
+          "Warm plan-cache sharing on worker spawn: the supervisor "
+          "records the last completed (kind, params) per TENANT CLASS "
+          "(the tenant id up to its trailing -suffix) and ships up to "
+          "this many entries to every new worker, which pre-traces "
+          "them off the critical path so a fresh generation doesn't "
+          "pay first-query compile for warm tenant classes.  0 "
+          "disables the warm hand-off.")
 
 
 def get(key: str):

@@ -83,7 +83,16 @@ boundary — instrumented jitted callables — since there is no CUPTI:
   ``"cache_corrupt"`` raises :class:`CacheCorruptError` at the same
   probes — the cache flips REAL bytes in the stored segment after its
   insert-time chunk CRCs were stamped, and serve-time CRC verification
-  must quarantine the entry and recompute live, never decode damage.
+  must quarantine the entry and recompute live, never decode damage,
+  ``"scale_up_fail"`` raises :class:`ScaleUpFailError` at the elastic
+  fleet's ``launcher_spawn`` probe (serve/launcher.py) — a worker
+  launch that dies at the launcher boundary, which the supervisor must
+  absorb through the respawn ladder instead of stranding queued work,
+  ``"drain_stuck"`` raises :class:`DrainStuckError` at the worker's
+  ``worker_drain`` probe (serve/worker.py) — a retiring worker that
+  acknowledges the drain order but never finishes it, forcing the
+  supervisor's drain deadline to escalate to a hard kill while the
+  retired generation still ends fenced with zero zombie commits.
 * ``dynamic: true`` re-reads the file when its mtime changes, matching
   the injector's ``dynamicReconfig`` thread without needing one.
 
@@ -417,6 +426,37 @@ def _raise_cache_corrupt(name: str):
     raise CacheCorruptError(f"injected result-cache corruption at {name}")
 
 
+class ScaleUpFailError(OSError):
+    """A worker launch failed at the launcher boundary (kind
+    ``"scale_up_fail"``).
+
+    Raised at the launcher's ``launcher_spawn`` probe
+    (serve/launcher.py) — the supervisor must treat a failed launch like
+    any other capacity loss: count it, keep the slot on the respawn
+    ladder with backoff, and never leave queued sessions stranded on a
+    worker that was never born.  Subclasses :class:`OSError` because a
+    real agent/ssh launch fails with exactly that surface."""
+
+
+class DrainStuckError(OSError):
+    """A retiring worker wedged inside its drain ladder (kind
+    ``"drain_stuck"``).
+
+    Raised at the worker's ``worker_drain`` probe (serve/worker.py) —
+    the worker acknowledges the drain order but never completes it, so
+    the supervisor's drain deadline must escalate to a hard kill and the
+    retired generation must still end fenced with zero zombie
+    commits."""
+
+
+def _raise_scale_up_fail(name: str):
+    raise ScaleUpFailError(f"injected worker launch failure at {name}")
+
+
+def _raise_drain_stuck(name: str):
+    raise DrainStuckError(f"injected stuck drain at {name}")
+
+
 # The registry of injectable fault flavors: kind -> raiser.  graftlint's
 # GL006 keeps this in sync with every use site statically — a kind used
 # in a config dict but missing here would otherwise only fail when its
@@ -445,6 +485,8 @@ FAULT_KINDS = {
     "shm_stale": _raise_shm_stale,
     "cache_stale": _raise_cache_stale,
     "cache_corrupt": _raise_cache_corrupt,
+    "scale_up_fail": _raise_scale_up_fail,
+    "drain_stuck": _raise_drain_stuck,
 }
 
 

@@ -10,7 +10,11 @@ with partition-safe self-fencing), and
 (Unix + TCP, CRC32 trailers, deadlines, network fault domains), and
 :mod:`~spark_rapids_jni_tpu.serve.data_plane` for the zero-copy
 columnar data plane (Arrow IPC result batches over memfd + SCM_RIGHTS
-or binary chunk frames, epoch- and CRC-verified).
+or binary chunk frames, epoch- and CRC-verified), and
+:mod:`~spark_rapids_jni_tpu.serve.launcher` /
+:mod:`~spark_rapids_jni_tpu.serve.elastic` for the elastic fleet
+control plane (pluggable local/remote worker launchers, load-aware
+placement scoring, and queue-driven autoscaling).
 """
 
 from .data_plane import (
@@ -18,12 +22,23 @@ from .data_plane import (
     DataPlaneOverflow,
     DataPlaneStale,
 )
+from .elastic import (
+    AutoScaler,
+    Placement,
+)
 from .frontdoor import (
     AdmissionShed,
     FrontDoor,
     FrontDoorSession,
+    QuotaExceeded,
     WorkerLost,
     fleet_metrics,
+)
+from .launcher import (
+    LaunchedWorker,
+    Launcher,
+    LocalLauncher,
+    RemoteLauncher,
 )
 from .runtime import (
     AdmissionTicket,
@@ -44,13 +59,20 @@ from .wire import (
 __all__ = [
     "AdmissionShed",
     "AdmissionTicket",
+    "AutoScaler",
     "DataPlaneCorruption",
     "DataPlaneOverflow",
     "DataPlaneStale",
     "FrontDoor",
     "FrontDoorSession",
+    "LaunchedWorker",
+    "Launcher",
+    "LocalLauncher",
+    "Placement",
     "QueryCancelled",
     "QueryTimeout",
+    "QuotaExceeded",
+    "RemoteLauncher",
     "ServeError",
     "ServeRuntime",
     "TcpTransport",

@@ -107,7 +107,9 @@ from typing import Dict, List, Optional
 from .. import config, faultinj
 from ..shuffle import store as store_mod
 from . import data_plane, wire
+from . import elastic as elastic_mod
 from . import result_cache as result_cache_mod
+from .launcher import launcher_from_config
 from .runtime import QueryCancelled, QueryTimeout, ServeError
 
 _MISS_BUDGET = 3.5       # heartbeat periods of silence before SIGKILL
@@ -135,6 +137,19 @@ class AdmissionShed(ServeError):
     lowest priority class beyond the surviving capacity."""
 
 
+class QuotaExceeded(ServeError):
+    """Per-tenant admission quota exhausted (``serve_tenant_quota_bytes``
+    / ``serve_tenant_quota_s``): the tenant's charged bytes or completed
+    wall-seconds are over budget, and this submit is rejected LOUDLY at
+    admission — never queued, never silently degraded.  Rejections are
+    counted per tenant in the ``shutdown()`` report."""
+
+    def __init__(self, message: str, tenant=None, resource: str = ""):
+        super().__init__(message)
+        self.tenant = tenant
+        self.resource = resource
+
+
 class FleetMetrics:
     """Fleet-level counters + per-worker liveness, scraped via
     :func:`fleet_metrics` → ``RmmSpark.fleet_metrics()`` →
@@ -144,7 +159,9 @@ class FleetMetrics:
               "replacements", "worker_lost", "sheds", "circuit_open",
               "reconnects", "partitions_detected", "self_fenced_workers",
               "data_batches", "data_payload_bytes", "data_json_bytes",
-              "data_plane_errors", "cache_hits", "hit_bytes_served")
+              "data_plane_errors", "cache_hits", "hit_bytes_served",
+              "scale_ups", "scale_downs", "scale_up_failures",
+              "quota_rejections", "plan_warm_shipped")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -213,6 +230,7 @@ class FrontDoorSession:
         self.error: Optional[BaseException] = None
         self._cancel_requested = False
         self._done = threading.Event()
+        self.submitted_at = time.monotonic()
 
     def done(self) -> bool:
         return self._done.is_set()
@@ -246,6 +264,10 @@ class FrontDoorSession:
         else:
             self.status = "done"
         self._done.set()
+        door = self._door
+        if door is not None:
+            with contextlib.suppress(Exception):
+                door._note_session_done(self)
 
 
 class WorkerHandle:
@@ -256,11 +278,12 @@ class WorkerHandle:
     GL012 flags constructions with no release on some exit path."""
 
     def __init__(self, worker_id: int, gen: int, wdir: str,
-                 proc: subprocess.Popen, host: str = "local",
-                 token: str = ""):
+                 proc, host: str = "local", token: str = ""):
         self.worker_id = int(worker_id)
         self.gen = int(gen)
         self.dir = wdir
+        # a launcher.LaunchedWorker (or any Popen-compatible handle):
+        # pid/poll/wait/kill, plus owns_pid for the hello validation
         self.proc = proc
         self.host = host
         self.token = token  # incarnation identity for hello reattach
@@ -273,6 +296,13 @@ class WorkerHandle:
         self.stall_breaks = 0
         self.stall_suspect = 0
         self.results_since_pong = 0
+        # load signals from the last pong (placement scoring inputs)
+        self.queue_depth = 0
+        self.arena_bytes = 0
+        self.pool_bytes = 0
+        # autoscale retirement ladder state
+        self.retiring = False
+        self.drain_deadline = 0.0
         self.fired: List[dict] = []
         self.merged = False
         self.bye: Optional[dict] = None
@@ -312,7 +342,12 @@ class FrontDoor:
                  partition_grace_ms: Optional[float] = None,
                  reconnect_max: Optional[int] = None,
                  data_plane_mode: Optional[str] = None,
-                 segment_bytes: Optional[int] = None):
+                 segment_bytes: Optional[int] = None,
+                 launcher=None,
+                 placement: Optional[str] = None,
+                 autoscale: Optional[bool] = None,
+                 tenant_quota_bytes: Optional[int] = None,
+                 tenant_quota_s: Optional[float] = None):
         global _last_metrics
         self._n_workers = int(workers if workers is not None
                               else config.get("serve_workers"))
@@ -363,6 +398,36 @@ class FrontDoor:
         self._replace_max = int(config.get("serve_max_readmissions"))
         self._backoff_s = float(config.get("serve_backoff_ms")) / 1000.0
         self._setup = setup
+        # the elastic control plane: how workers come to exist
+        # (serve/launcher.py), where they and their sessions go
+        # (serve/elastic.py), and whether capacity follows the queue
+        try:
+            self._launcher = launcher_from_config(launcher)
+            self._placement = elastic_mod.Placement(
+                self._hosts, mode=placement)
+        except ValueError as e:
+            raise ServeError(str(e)) from None
+        autoscale_on = bool(autoscale if autoscale is not None
+                            else config.get("serve_autoscale"))
+        self._autoscaler: Optional[elastic_mod.AutoScaler] = \
+            elastic_mod.AutoScaler(self._n_workers) if autoscale_on else None
+        self._drain_s = float(config.get("serve_autoscale_drain_ms")) \
+            / 1000.0
+        self._extra_slots = itertools.count(self._n_workers)
+        self._retired: List[dict] = []
+        # PR-9 policy remainder: per-tenant quotas charged at admission
+        # + warm plan-cache sharing keyed per tenant class
+        self._quota_bytes = int(
+            tenant_quota_bytes if tenant_quota_bytes is not None
+            else config.get("serve_tenant_quota_bytes"))
+        self._quota_s = float(
+            tenant_quota_s if tenant_quota_s is not None
+            else config.get("serve_tenant_quota_s"))
+        self._tenant_bytes: Dict[str, int] = {}
+        self._tenant_seconds: Dict[str, float] = {}
+        self._quota_rejected: Dict[str, int] = {}
+        self._plan_warm_max = int(config.get("serve_plan_warm"))
+        self._plan_warmth: Dict[str, dict] = {}
         self.fleet_dir = tempfile.mkdtemp(prefix="sptpu_frontdoor_")
         # the durable shuffle plane: fleet-shared, survives any worker.
         # store=False runs PR-10 style (pure lineage recovery) — the
@@ -443,11 +508,59 @@ class FrontDoor:
                 return sess
         now = time.monotonic()
         with self._lock:
+            self._charge_admission_locked(sess)
             self._pending.append([now, sess])
             self._maybe_shed_locked()
             self._dispatch_locked(now)
         self._wake.set()
         return sess
+
+    def _charge_admission_locked(self, sess: FrontDoorSession):
+        """PR-9 policy remainder: per-tenant quotas, charged at
+        admission.  Bytes are charged UP FRONT from the declared
+        ``est_bytes``; wall-seconds accrue as sessions complete.  A
+        tenant over either budget is rejected loudly — the shed ladder
+        never sees the submit, the counters land in the report."""
+        if self._quota_bytes <= 0 and self._quota_s <= 0:
+            return
+        t = str(sess.tenant)
+        used_b = self._tenant_bytes.get(t, 0)
+        used_s = self._tenant_seconds.get(t, 0.0)
+        if self._quota_bytes > 0 \
+                and used_b + sess.est_bytes > self._quota_bytes:
+            self.metrics.bump("quota_rejections")
+            self._quota_rejected[t] = self._quota_rejected.get(t, 0) + 1
+            raise QuotaExceeded(
+                f"tenant {t} over byte quota: {used_b} charged + "
+                f"{sess.est_bytes} requested > serve_tenant_quota_bytes="
+                f"{self._quota_bytes}", tenant=t, resource="bytes")
+        if self._quota_s > 0 and used_s >= self._quota_s:
+            self.metrics.bump("quota_rejections")
+            self._quota_rejected[t] = self._quota_rejected.get(t, 0) + 1
+            raise QuotaExceeded(
+                f"tenant {t} over time quota: {used_s:.3f}s used >= "
+                f"serve_tenant_quota_s={self._quota_s:g}", tenant=t,
+                resource="seconds")
+        self._tenant_bytes[t] = used_b + sess.est_bytes
+
+    def _note_session_done(self, sess: FrontDoorSession):
+        """Completion bookkeeping: charge the tenant's wall-clock and
+        record the (kind, params) as the tenant class's warm plan-cache
+        entry for future spawns.  Cache hits charge nothing — they cost
+        no compute and ran no plan."""
+        if sess.served_from_cache or sess.status != "done":
+            return
+        t = str(sess.tenant)
+        dt = max(0.0, time.monotonic() - sess.submitted_at)
+        with self._lock:
+            self._tenant_seconds[t] = \
+                self._tenant_seconds.get(t, 0.0) + dt
+            if self._plan_warm_max > 0:
+                cls = self._tenant_class(t)
+                # re-insert to keep newest-class-last ordering
+                self._plan_warmth.pop(cls, None)
+                self._plan_warmth[cls] = {
+                    "kind": sess.kind, "params": sess.params}
 
     def cancel(self, sess: FrontDoorSession):
         """Cancel wherever the session is: pending (finished here),
@@ -579,6 +692,21 @@ class FrontDoor:
         }
         report["hosts"] = list(self._hosts)
         report["self_fenced"] = list(self._self_fenced)
+        report["retired"] = list(self._retired)
+        if self._autoscaler is not None:
+            self._autoscaler.stop()
+            report["autoscale"] = self._autoscaler.snapshot()
+        report["launcher"] = getattr(self._launcher, "name", "local")
+        self._launcher.close()
+        report["placement"] = self._placement.mode
+        report["quota"] = {
+            "quota_bytes": self._quota_bytes,
+            "quota_s": self._quota_s,
+            "tenant_bytes": dict(self._tenant_bytes),
+            "tenant_seconds": {t: round(s, 6) for t, s
+                               in self._tenant_seconds.items()},
+            "rejections": dict(self._quota_rejected),
+        }
         report["result_cache"] = self.result_cache.metrics()
         # entries ride spill handles: close them so arena charges and
         # demoted disk files release before the fleet dir reap
@@ -636,7 +764,7 @@ class FrontDoor:
             return None
         return {"seed": cfg.get("seed", 0), "faults": out}
 
-    def _spawn_locked(self, slot: int) -> WorkerHandle:
+    def _spawn_locked(self, slot: int) -> Optional[WorkerHandle]:
         gen = next(self._gens)
         wdir = os.path.join(self.fleet_dir, f"worker-{slot}-{gen}")
         os.makedirs(wdir, exist_ok=True)
@@ -655,7 +783,7 @@ class FrontDoor:
             # let a stale inherited env re-arm faults in the child
             env.pop(faultinj.ENV_CONFIG, None)
         env[faultinj.ENV_MIRROR] = os.path.join(wdir, "fired.jsonl")
-        host = self._hosts[slot % len(self._hosts)]
+        host = self._placement.host_for_slot(slot, self._workers.values())
         token = f"{slot}-{gen}-{os.urandom(8).hex()}"
         cmd = [sys.executable, "-m", "spark_rapids_jni_tpu.serve.worker",
                "--socket", self._sock_addr,
@@ -681,18 +809,57 @@ class FrontDoor:
             cmd += ["--store-dir", self.store_dir]
         if self._setup:
             cmd += ["--setup", self._setup]
-        log = open(os.path.join(wdir, "worker.log"), "ab")
+        warm = self._warm_entries()
+        if warm:
+            warm_path = os.path.join(wdir, "warm.json")
+            with open(warm_path, "w") as f:
+                json.dump(warm, f)
+            cmd += ["--warm", warm_path]
+            self.metrics.bump("plan_warm_shipped", len(warm))
+        # the launcher owns HOW the argv becomes a process (local fork
+        # or an agent/ssh template); a launch that dies at the boundary
+        # (real, or the scale_up_fail kind at launcher_spawn) is a
+        # capacity loss, not a crash: count it and keep the slot on the
+        # respawn ladder instead of stranding queued sessions
         try:
-            proc = subprocess.Popen(
-                cmd, cwd=pkg_root, env=env, stdout=log,
-                stderr=subprocess.STDOUT, start_new_session=True)
-        finally:
-            log.close()
+            proc = self._launcher.launch(
+                cmd, cwd=pkg_root, env=env,
+                log_path=os.path.join(wdir, "worker.log"))
+        except (faultinj.ScaleUpFailError, OSError):
+            self.metrics.bump("scale_up_failures")
+            self.metrics.set_liveness(slot, "spawn-failed")
+            shutil.rmtree(wdir, ignore_errors=True)
+            self._respawn_count[slot] = \
+                self._respawn_count.get(slot, 0) + 1
+            if self._respawn_count[slot] > self._respawn_max:
+                self._broken.add(slot)
+                self.metrics.bump("circuit_open")
+                self.metrics.set_liveness(slot, "broken")
+            else:
+                delay = max(self._backoff_s, 0.05) * (
+                    2 ** (self._respawn_count[slot] - 1))
+                self._respawn_at[slot] = time.monotonic() + delay
+            return None
         w = WorkerHandle(slot, gen, wdir, proc, host=host, token=token)
+        w.pool_bytes = self._pool_bytes
         self._workers[slot] = w
         self.metrics.bump("workers_spawned")
         self.metrics.set_liveness(slot, "starting")
         return w
+
+    def _tenant_class(self, tenant) -> str:
+        text = str(tenant)
+        head, sep, _tail = text.rpartition("-")
+        return head if sep else text
+
+    def _warm_entries(self) -> List[dict]:
+        """The warm plan-cache hand-off for a new worker: the last
+        completed (kind, params) per tenant class, newest classes
+        first, capped at ``serve_plan_warm`` entries."""
+        if self._plan_warm_max <= 0:
+            return []
+        out = list(self._plan_warmth.values())
+        return out[-self._plan_warm_max:]
 
     # -- accept/reader threads ------------------------------------------
     def _accept_loop(self):
@@ -716,7 +883,15 @@ class FrontDoor:
                 continue
             with self._lock:
                 w = self._workers.get(slot)
-                if w is None or w.state == "dead" or w.proc.pid != pid \
+                # pid identity routes through the launch handle: local
+                # workers must present the forked child's pid; remote
+                # ones have their first hello's pid adopted (the token +
+                # epoch prove the incarnation) and held ever after
+                owns = getattr(w.proc, "owns_pid", None) \
+                    if w is not None else None
+                pid_ok = owns(pid) if owns is not None \
+                    else (w is not None and w.proc.pid == pid)
+                if w is None or w.state == "dead" or not pid_ok \
                         or w.token != token or w.gen != epoch:
                     # a stale incarnation raced its own SIGKILL, or the
                     # resume token / fence epoch doesn't match the slot's
@@ -815,6 +990,11 @@ class FrontDoor:
         with self._lock:
             w.last_pong = time.monotonic()
             w.fired = list(msg.get("fired") or [])
+            # load signals for the placement scorer: the worker's own
+            # admission-queue depth and arena residency ride every pong
+            w.queue_depth = int(msg.get("queue_depth") or 0)
+            w.arena_bytes = int(msg.get("arena_bytes") or 0)
+            w.pool_bytes = int(msg.get("pool_bytes") or w.pool_bytes or 0)
             epoch = int(msg.get("stall_breaks") or 0)
             live = int(msg.get("live_sessions") or 0)
             # the native stall-breaker epoch backs the wedge detector: an
@@ -1020,9 +1200,23 @@ class FrontDoor:
                     if w.state == "dead":
                         continue
                     if w.proc.poll() is not None:
+                        if w.retiring and w.bye is not None:
+                            # the drain ladder completed: the worker
+                            # drained, self-fenced its generation, said
+                            # bye, and exited — reap, don't respawn
+                            self._on_worker_retired_locked(w)
+                        else:
+                            self._on_worker_lost_locked(
+                                w, f"exited rc={w.proc.returncode}",
+                                "crashes", now)
+                        continue
+                    if w.retiring and now > w.drain_deadline:
+                        # drain stuck (the drain_stuck kind, or a real
+                        # wedge): escalate to the ordinary loss protocol
+                        w.kill()
                         self._on_worker_lost_locked(
-                            w, f"exited rc={w.proc.returncode}", "crashes",
-                            now)
+                            w, "drain stuck past serve_autoscale_drain_ms",
+                            "stalls", now)
                         continue
                     if w.state == "healthy":
                         link = w.link
@@ -1056,6 +1250,7 @@ class FrontDoor:
                         self._on_worker_lost_locked(
                             w, "never connected", "crashes", now)
                 self._maybe_respawn_locked(now)
+                self._autoscale_tick_locked(now)
                 self._maybe_shed_locked()
                 self._dispatch_locked(now)
 
@@ -1144,6 +1339,19 @@ class FrontDoor:
         # transport in w.close() above — a crash with a segment
         # outstanding leaks nothing
         w.data_stash = {}
+        # a retiring worker that died (stuck drain escalated, or a crash
+        # mid-drain) still retires: the generation is fenced above, its
+        # sessions were re-placed above — record it and DON'T respawn,
+        # the autoscaler shrank the fleet on purpose
+        if w.retiring:
+            self.metrics.bump("scale_downs")
+            self._retired.append({
+                "worker_id": w.worker_id, "gen": w.gen, "host": w.host,
+                "clean": False, "fenced_commits": 0, "drained": False,
+            })
+            self._workers.pop(w.worker_id, None)
+            self._respawn_at.pop(w.worker_id, None)
+            return
         # schedule the replacement, unless this slot's breaker is open
         if w.worker_id in self._broken:
             return
@@ -1169,6 +1377,81 @@ class FrontDoor:
             self.metrics.bump("respawns")
             self._spawn_locked(slot)
 
+    # -- elastic control loop -------------------------------------------
+    def _autoscale_tick_locked(self, now: float):
+        if self._autoscaler is None or self._shutdown_started:
+            return
+        decision = self._autoscaler.decide(
+            now, len(self._pending), list(self._workers.values()))
+        if decision is None:
+            return
+        action, target = decision
+        if action == "up":
+            slot = next(self._extra_slots)
+            self._respawn_count.setdefault(slot, 0)
+            self.metrics.bump("scale_ups")
+            self._spawn_locked(slot)
+        elif action == "down" and target is not None:
+            self._retire_locked(target, now)
+
+    def _retire_locked(self, w: WorkerHandle, now: float):
+        """Start the retirement ladder: drain order now, the worker
+        drains and self-fences its generation, the monitor reaps its
+        bye — or the drain deadline escalates to the loss protocol."""
+        if w.retiring or w.state != "healthy" or w.link is None:
+            return
+        w.retiring = True
+        w.drain_deadline = now + self._drain_s
+        self.metrics.set_liveness(w.worker_id, "draining")
+        # un-pin its tenants: new submits re-pin onto surviving workers
+        # through the ordinary placement path (queued-session migration)
+        self._pins = {t: s for t, s in self._pins.items()
+                      if s != w.worker_id}
+        with contextlib.suppress(OSError):
+            w.link.send({"op": "drain"})
+
+    def _on_worker_retired_locked(self, w: WorkerHandle):
+        """A retiring worker completed its drain -> self-fence -> exit
+        ladder: reap it, shrink the fleet, never respawn it."""
+        w.state = "dead"
+        self.metrics.set_liveness(w.worker_id, "retired")
+        self._merge_fired(w)
+        bye = w.bye or {}
+        # the worker already revoked its OWN epoch before the bye; the
+        # supervisor-side revoke + tmp reap is the idempotent backstop
+        if self._store is not None:
+            with contextlib.suppress(OSError):
+                self._store.revoke(w.gen)
+                self._store.reap_uncommitted(epoch=w.gen)
+        shutil.rmtree(w.dir, ignore_errors=True)
+        # a drained worker has no sessions; any straggler that raced the
+        # bye migrates through the ordinary re-placement ladder
+        now = time.monotonic()
+        for sess in list(w.sessions.values()):
+            if sess._done.is_set():
+                continue
+            sess.replacements += 1
+            self.metrics.bump("replacements")
+            sess.status = "pending"
+            sess.worker_id = None
+            self._pending.append([now, sess])
+        w.sessions = {}
+        w.data_stash = {}
+        w.close()
+        w.kill()
+        with contextlib.suppress(Exception):
+            w.proc.wait(2.0)
+        self.metrics.bump("scale_downs")
+        self._retired.append({
+            "worker_id": w.worker_id, "gen": w.gen, "host": w.host,
+            "clean": bool(bye.get("clean")),
+            "fenced_commits": int(bye.get("fenced_commits") or 0),
+            "drained": True,
+        })
+        self._workers.pop(w.worker_id, None)
+        self._respawn_at.pop(w.worker_id, None)
+        self._wake.set()
+
     def _alive_workers(self) -> List[WorkerHandle]:
         return [w for w in self._workers.values()
                 if w.state in ("starting", "healthy")]
@@ -1181,6 +1464,13 @@ class FrontDoor:
         if not alive and not self._respawn_at:
             return  # fleet exhausted: dispatch fails pending WorkerLost
         cap = max(1, len(alive)) * self._max_concurrent
+        if self._autoscaler is not None:
+            # elastic fleets prefer GROWING over shedding: while the
+            # autoscaler has headroom, hold the backlog up to what a
+            # max-size fleet could absorb — shed is the valve of last
+            # resort once even that capacity is oversubscribed
+            cap = max(cap,
+                      self._autoscaler.max_workers * self._max_concurrent)
         while len(self._pending) > cap:
             # lowest priority class first; latest arrival within a class
             victim = min(self._pending,
@@ -1197,6 +1487,7 @@ class FrontDoor:
                             ) -> Optional[WorkerHandle]:
         healthy = [w for w in self._workers.values()
                    if w.state == "healthy" and w.link is not None
+                   and not w.retiring
                    and len(w.sessions) < self._max_concurrent]
         if not healthy:
             return None
@@ -1207,9 +1498,11 @@ class FrontDoor:
                     return w
             pinned = self._workers.get(pin)
             if pinned is not None and pinned.state != "dead" \
-                    and pin not in self._broken:
+                    and not pinned.retiring and pin not in self._broken:
                 return None  # pinned worker alive but full/starting: wait
-        w = min(healthy, key=lambda w: (len(w.sessions), w.worker_id))
+        w = self._placement.pick(healthy)
+        if w is None:
+            return None
         self._pins[sess.tenant] = w.worker_id
         return w
 

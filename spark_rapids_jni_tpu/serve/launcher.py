@@ -1,0 +1,210 @@
+"""How a worker process comes to exist — separated from supervision.
+
+The front door (serve/frontdoor.py) supervises worker *incarnations*:
+it builds one argv per generation (``--socket host:port --worker-id
+--resume-token --epoch --store-dir ...``), waits for the hello that
+proves the right incarnation attached, heartbeats it, and runs the loss
+protocol when it dies.  None of that cares HOW the process came to
+exist — only that something ran the argv and the resulting process
+dialled back.  This module owns that "something":
+
+* :class:`LocalLauncher` — today's behavior, verbatim: ``fork``/exec of
+  the argv on this box (``subprocess.Popen`` with the worker log, the
+  fault-config env, and its own session group).
+* :class:`RemoteLauncher` — an agent/ssh-style command template.  The
+  template is a list of strings run locally (``ssh host --``, a
+  container runner, a test shim); the worker argv is spliced where the
+  ``{argv}`` placeholder sits (or appended when there is none), and the
+  agent is expected to exec the worker somewhere with the fleet
+  address reachable.  Because the argv is byte-identical to the local
+  spawn's, PR-11 fencing (``--epoch``) and PR-12 resume
+  (``--resume-token`` reattach, self-fence on partition) work
+  unmodified — a remote worker is just a worker whose pid the
+  supervisor learns from the hello instead of from ``fork``.
+
+Both return a :class:`LaunchedWorker`: a ``Popen``-compatible surface
+(``pid`` / ``poll`` / ``wait`` / ``kill``) plus the one contract the
+supervisor's hello validation actually needs — :meth:`~LaunchedWorker.
+owns_pid`.  Locally the worker IS the child, so the hello's pid must
+equal the child's.  Remotely the child is the *agent* and the worker's
+pid is only knowable from its hello — the handle ADOPTS the first pid
+the hello presents (the resume token + fence epoch already prove the
+incarnation) and every later reattach must present the same one, so a
+stale incarnation still can't steal a slot.
+
+Every launch crosses the ``launcher_spawn`` fault probe: the chaos
+``scale_up_fail`` kind lands here (:class:`~..faultinj.
+ScaleUpFailError`), proving the supervisor absorbs a failed launch
+through the respawn ladder instead of stranding queued sessions.
+
+graftlint GL016 flags Launcher/AutoScaler constructions and
+``.launch()`` results that can't reach a release (``stop`` / ``drain``
+/ ``reap`` / ``close`` / ``kill``) on some path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import shlex
+import subprocess
+from typing import List, Optional
+
+from .. import config, faultinj
+
+# every launch crosses this probe; the scale_up_fail chaos kind fires
+# here and surfaces as ScaleUpFailError out of Launcher.launch()
+_launch_probe = faultinj.instrument(lambda: None, "launcher_spawn")
+
+
+class LaunchedWorker:
+    """Handle for one launched worker: the local child process (the
+    worker itself, or the agent that carried it somewhere else) plus
+    the pid-identity contract the hello validation checks.
+
+    ``close()``/``kill()`` release the child — graftlint GL016 flags
+    ``.launch()`` results with no release on some exit path."""
+
+    def __init__(self, proc: subprocess.Popen, remote: bool = False):
+        self.proc = proc
+        self.remote = bool(remote)
+        # remote: the worker pid adopted from its first hello (the
+        # agent's local pid proves nothing about the worker)
+        self._adopted_pid: Optional[int] = None
+
+    @property
+    def pid(self) -> int:
+        if self.remote and self._adopted_pid is not None:
+            return self._adopted_pid
+        return self.proc.pid
+
+    @property
+    def returncode(self):
+        return self.proc.returncode
+
+    def owns_pid(self, pid) -> bool:
+        """Does a hello claiming ``pid`` belong to this launch?  Local:
+        the worker is the child, the pids must match.  Remote: adopt the
+        first hello's pid (token + epoch already authenticated the
+        incarnation), then hold every reattach to it."""
+        try:
+            pid = int(pid)
+        except (TypeError, ValueError):
+            return False
+        if not self.remote:
+            return pid == self.proc.pid
+        if self._adopted_pid is None:
+            self._adopted_pid = pid
+            return True
+        return pid == self._adopted_pid
+
+    def poll(self):
+        return self.proc.poll()
+
+    def wait(self, timeout: Optional[float] = None):
+        return self.proc.wait(timeout)
+
+    def kill(self):
+        with contextlib.suppress(OSError):
+            self.proc.kill()
+
+    def close(self):
+        self.kill()
+
+
+class Launcher:
+    """The 'how a worker comes to exist' strategy.  ``launch()`` must
+    run the supervisor-built argv somewhere the fleet address is
+    reachable and return a :class:`LaunchedWorker`; everything after
+    the hello (heartbeats, fencing, loss) is the supervisor's."""
+
+    name = "base"
+
+    def launch(self, argv: List[str], *, cwd: str, env: dict,
+               log_path: str) -> LaunchedWorker:
+        raise NotImplementedError
+
+    def close(self):
+        """Release any launcher-held resources (agent pools etc.)."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class LocalLauncher(Launcher):
+    """Today's spawn, unchanged: exec the worker argv on this box."""
+
+    name = "local"
+
+    def launch(self, argv: List[str], *, cwd: str, env: dict,
+               log_path: str) -> LaunchedWorker:
+        _launch_probe()
+        log = open(log_path, "ab")
+        try:
+            proc = subprocess.Popen(
+                argv, cwd=cwd, env=env, stdout=log,
+                stderr=subprocess.STDOUT, start_new_session=True)
+        finally:
+            log.close()
+        return LaunchedWorker(proc, remote=False)
+
+
+class RemoteLauncher(Launcher):
+    """Agent/ssh-style launch: run ``template`` locally with the worker
+    argv spliced at the ``{argv}`` placeholder (appended when absent).
+    The agent inherits the spawn env, so a same-box agent (the test
+    shim, a container runner) passes the fault-config/mirror env
+    through; a real ssh template is responsible for its own env
+    forwarding.  ``kill()`` kills the *agent* — a worker that outlives
+    its agent is exactly the partitioned-worker case the PR-12
+    self-fence ladder already covers."""
+
+    name = "remote"
+
+    def __init__(self, template):
+        if isinstance(template, str):
+            template = shlex.split(template)
+        self.template = [str(t) for t in template]
+        if not self.template:
+            raise ValueError("RemoteLauncher needs a non-empty command "
+                             "template")
+
+    def _command(self, argv: List[str]) -> List[str]:
+        if "{argv}" in self.template:
+            out: List[str] = []
+            for part in self.template:
+                if part == "{argv}":
+                    out.extend(argv)
+                else:
+                    out.append(part)
+            return out
+        return self.template + list(argv)
+
+    def launch(self, argv: List[str], *, cwd: str, env: dict,
+               log_path: str) -> LaunchedWorker:
+        _launch_probe()
+        log = open(log_path, "ab")
+        try:
+            proc = subprocess.Popen(
+                self._command(argv), cwd=cwd, env=env, stdout=log,
+                stderr=subprocess.STDOUT, start_new_session=True)
+        finally:
+            log.close()
+        return LaunchedWorker(proc, remote=True)
+
+
+def launcher_from_config(spec=None) -> Launcher:
+    """Resolve the ``serve_launcher`` knob (or an explicit ``spec``):
+    ``"local"`` → :class:`LocalLauncher`; anything else is a shell-style
+    command template → :class:`RemoteLauncher`."""
+    if spec is None:
+        spec = config.get("serve_launcher")
+    if isinstance(spec, Launcher):
+        return spec
+    text = str(spec).strip()
+    if not text or text == "local":
+        return LocalLauncher()
+    return RemoteLauncher(text)

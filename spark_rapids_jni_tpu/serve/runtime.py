@@ -384,6 +384,13 @@ class ServeRuntime:
         with self._lock:
             return list(self._sessions)
 
+    def queue_depth(self) -> int:
+        """How many admissions are waiting on a slot right now — the
+        load signal the executor worker rides on every pong so the
+        supervisor's placement scorer and autoscaler see queue pressure
+        without a separate metrics channel."""
+        return self._slots.waiting()
+
     def shutdown(self, timeout_s: float = 10.0) -> bool:
         """Cancel every live session, drain the lane, disarm the stall
         breaker.  Returns True when every worker unwound in time.

@@ -47,17 +47,24 @@ worker   ``hello``    ``worker_id``, ``pid``, ``fence_epoch``,
                       ``resume_token`` — sent after every (re)connect
 super    ``ping``     ``t`` (echo token)
 worker   ``pong``     ``t``, ``stall_breaks`` (native stall-breaker
-                      epoch), ``live_sessions``, ``fence_epoch``,
-                      ``fired`` (injection trace so far)
+                      epoch), ``live_sessions``, ``queue_depth`` /
+                      ``arena_bytes`` / ``pool_bytes`` (load signals for
+                      the elastic placement scorer — serve/elastic.py),
+                      ``warmed``, ``fence_epoch``, ``fired`` (injection
+                      trace so far)
 super    ``submit``   ``sid``, ``kind``, ``params``, ``tenant``,
                       ``priority``, ``est_bytes``, ``timeout_s``
 worker   ``running``  ``sid`` — the session left the admission queue
 worker   ``result``   ``sid``, ``ok``, ``value`` | ``error``/``message``,
                       ``status``
 super    ``cancel``   ``sid``
+super    ``drain``    — retirement order: finish placed sessions,
+                      accept nothing new, self-fence the generation,
+                      ``bye`` with ``retired=True``, exit
 super    ``shutdown`` —
 worker   ``bye``      ``clean``, ``residue``, ``store_len``,
-                      ``leftovers``, ``fired``
+                      ``leftovers``, ``retired``, ``fenced_commits``,
+                      ``warmed``, ``fired``
 ======== ============ ====================================================
 
 ``send_msg`` takes an optional lock so a worker's result watchers and

@@ -485,6 +485,12 @@ def main(argv=None) -> int:
     ap.add_argument("--setup", default=None,
                     help="module whose register_query_kinds(register) "
                          "adds custom kinds before serving")
+    ap.add_argument("--warm", default=None,
+                    help="JSON file of [{kind, params}] entries the "
+                         "supervisor recorded per tenant class: "
+                         "pre-traced off the critical path after "
+                         "connect, so a fresh generation skips "
+                         "first-query compile for warm classes")
     args = ap.parse_args(argv)
 
     if os.environ.get("BENCH_FORCE_CPU"):
@@ -559,6 +565,39 @@ def main(argv=None) -> int:
 
     sessions: Dict[int, object] = {}
     watchers: list = []
+    warmed = [0]
+    if args.warm and not partitioned:
+        # warm plan-cache hand-off: run the supervisor-recorded (kind,
+        # params) per tenant class through the runtime in a background
+        # thread — jit traces land in this process's plan cache without
+        # delaying the hello or blocking the serve loop
+        try:
+            with open(args.warm) as f:
+                warm_entries = json.load(f)
+        except (OSError, ValueError):
+            warm_entries = []
+
+        def run_warm():
+            for e in warm_entries:
+                kind = _QUERY_KINDS.get(e.get("kind"))
+                if kind is None:
+                    continue
+                params = e.get("params") or {}
+
+                def query(ctx, sess, k=kind, p=params):
+                    return k(ctx, p, sess)
+
+                try:
+                    s = runtime.submit(query, est_bytes=0,
+                                       tenant="__warm__", timeout_s=20.0)
+                    s.result(timeout=30.0)
+                    warmed[0] += 1
+                except BaseException:
+                    return  # warmth is best-effort, never load-bearing
+
+        if warm_entries:
+            threading.Thread(target=run_warm, name="worker-warm",
+                             daemon=True).start()
     # lifecycle points unique to the process boundary: a submission was
     # received (session not yet created) and a result is about to be
     # sent (query done, result undelivered) — chaos lands worker_crash
@@ -572,6 +611,10 @@ def main(argv=None) -> int:
     data_write_probe = faultinj.instrument(lambda: None, "data_write_wk")
     data_desc_probe = faultinj.instrument(lambda: None,
                                           "data_descriptor_wk")
+    # the retirement ladder's fault point: drain_stuck fires here — the
+    # order is acknowledged but never completed, and the supervisor's
+    # drain deadline must escalate to the ordinary loss protocol
+    drain_probe = faultinj.instrument(lambda: None, "worker_drain")
     seg_seq = iter(range(1 << 62))
     # sid -> input snapshot id declared by the submit (result-cache key
     # material, echoed back on the result descriptor)
@@ -705,7 +748,15 @@ def main(argv=None) -> int:
 
     # -- main loop -------------------------------------------------------
     last_fence_check = time.monotonic()
+    draining = False
+    retired = False
     while not partitioned:
+        if draining and all(s.done() for s in sessions.values()):
+            # drained: every placed session finished and no new work is
+            # accepted — fall through to the retire exit (self-fence the
+            # generation, bye, exit clean)
+            retired = True
+            break
         if _WEDGED.is_set():
             # simulated interpreter wedge: stop answering everything;
             # only the supervisor's SIGKILL ends this process
@@ -742,10 +793,27 @@ def main(argv=None) -> int:
                 "stall_breaks": RmmSpark.stall_break_count(),
                 "live_sessions": sum(
                     1 for s in sessions.values() if not s.done()),
+                # load signals for the supervisor's placement scorer:
+                # admission-queue depth and arena residency ride every
+                # pong (cheap decision channel, no payload bytes)
+                "queue_depth": runtime.queue_depth(),
+                "arena_bytes": int(adaptor.total_allocated()),
+                "pool_bytes": int(args.pool_bytes),
+                "warmed": warmed[0],
                 "fence_epoch": args.epoch,
                 "reconnects": link.reconnects,
                 "fired": faultinj.fired_log(),
             })
+        elif op == "drain":
+            # retirement order from the autoscaler: finish placed
+            # sessions, accept nothing new, self-fence, exit
+            try:
+                drain_probe()
+                draining = True
+            except faultinj.DrainStuckError:
+                # acknowledged but never completed: the supervisor's
+                # drain deadline is the recovery path
+                pass
         elif op == "submit":
             try:
                 recv_probe()  # chaos: crash before the session exists
@@ -767,6 +835,16 @@ def main(argv=None) -> int:
     clean = runtime.shutdown()
     for t in watchers:
         t.join(timeout=5.0)
+    fenced_commits = 0
+    if retired and store is not None:
+        # the retired generation fences ITSELF before the bye: any
+        # straggler commit from this incarnation is rejected at the
+        # store's rename, so a retired worker can never zombie-commit —
+        # the supervisor asserts fenced_commits == 0 (nothing was ever
+        # rejected, because nothing was in flight after the drain)
+        with contextlib.suppress(OSError):
+            store.revoke(args.epoch)
+            fenced_commits = store.snapshot().get("fenced_commits", 0)
     residue = [adaptor.total_allocated(), adaptor.host_total_allocated()]
     store_len = len(fw.store)
     leftovers = sorted(os.listdir(spill_dir)) if os.path.isdir(
@@ -776,6 +854,8 @@ def main(argv=None) -> int:
     link.send({
         "op": "bye", "clean": bool(clean), "residue": residue,
         "store_len": store_len, "leftovers": leftovers,
+        "retired": bool(retired), "fenced_commits": int(fenced_commits),
+        "warmed": warmed[0],
         "fired": faultinj.fired_log(),
     })
     link.close()

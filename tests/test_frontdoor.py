@@ -13,6 +13,7 @@ fixtures keep fleets small and heartbeats fast.
 
 import os
 import signal
+import tempfile
 import threading
 import time
 
@@ -29,14 +30,27 @@ from spark_rapids_jni_tpu.serve import (
 
 
 @pytest.fixture(autouse=True)
-def _fast_ladder():
+def _fast_ladder(tmp_path, monkeypatch):
+    # deterministic per-test fleet dirs: every mkdtemp (the fleet dir,
+    # its sockets, stores, worker dirs) lands under THIS test's tmp_path
+    # instead of a shared /tmp — two tests (or a retried flake) can
+    # never contend on leftover directories, and pytest reaps them
+    monkeypatch.setattr(tempfile, "tempdir", str(tmp_path))
     config.set("serve_backoff_ms", 40.0)
     yield
     config.reset("serve_backoff_ms")
     faultinj.configure(None)
+    # bounded straggler drain: frontdoor threads from THIS test must
+    # wind down before the next test builds a fleet, or a slow reader
+    # from a dead fleet aliases into the next test's thread checks
+    _poll(lambda: not [t.name for t in threading.enumerate()
+                       if t.name.startswith("frontdoor-")], timeout=5.0)
 
 
 def _poll(pred, timeout=15.0, interval=0.02):
+    """Bounded condition wait — the deflake primitive: every wait in
+    this file polls a predicate with a deadline instead of sleeping a
+    guessed duration, so a slow box waits longer, never flakes."""
     end = time.monotonic() + timeout
     while time.monotonic() < end:
         if pred():
@@ -48,7 +62,7 @@ def _poll(pred, timeout=15.0, interval=0.02):
 def _no_stragglers():
     return _poll(lambda: not [t.name for t in threading.enumerate()
                               if t.name.startswith("frontdoor-")],
-                 timeout=3.0)
+                 timeout=5.0)
 
 
 class TestHappyPath:

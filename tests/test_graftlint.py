@@ -1094,6 +1094,84 @@ class TestGL015:
 
 
 # ---------------------------------------------------------------------------
+# GL016 — launcher / autoscaler handle leak
+# ---------------------------------------------------------------------------
+
+
+class TestGL016:
+    def test_discarded_launcher_and_handle_flagged(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            from spark_rapids_jni_tpu.serve import LocalLauncher
+
+            def spawn_and_forget(argv, wdir):
+                ln = LocalLauncher()
+                ln.launch(argv, wdir)
+        """}, rules=["GL016"])
+        # spawn channel never closed AND the worker handle is discarded
+        assert [f.rule for f in res.new] == ["GL016", "GL016"]
+
+    def test_unreaped_launch_result_flagged(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            from spark_rapids_jni_tpu.serve import RemoteLauncher
+
+            def fire(argv, wdir, template):
+                ln = RemoteLauncher(template)
+                try:
+                    lw = ln.launch(argv, wdir)
+                finally:
+                    ln.close()
+        """}, rules=["GL016"])
+        assert new_rules(res) == [("GL016", "mod.py")]
+
+    def test_discarded_autoscaler_flagged(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            from spark_rapids_jni_tpu.serve import AutoScaler
+
+            def size_once():
+                scaler = AutoScaler(min_workers=1, max_workers=4)
+        """}, rules=["GL016"])
+        assert new_rules(res) == [("GL016", "mod.py")]
+
+    def test_released_stored_and_unknown_receiver_clean(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            from spark_rapids_jni_tpu.serve import (AutoScaler,
+                                                    LocalLauncher,
+                                                    RemoteLauncher)
+
+            def reaped(argv, wdir):
+                ln = LocalLauncher()
+                try:
+                    lw = ln.launch(argv, wdir)
+                    return lw.wait(timeout=30.0)
+                finally:
+                    ln.close()
+
+            def killed(argv, wdir):
+                with RemoteLauncher("agent {argv}") as ln:
+                    lw = ln.launch(argv, wdir)
+                    lw.kill()
+
+            def stored(self, argv, wdir):
+                self._launcher = LocalLauncher()   # supervisor owns it
+                scaler = AutoScaler(min_workers=1, max_workers=4)
+                scaler.stop()
+
+            def other_pools(q, ex):
+                ex.launch(q)          # unknown receiver: not a launcher
+        """}, rules=["GL016"])
+        assert res.new == []
+
+    def test_suppression_comment(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            from spark_rapids_jni_tpu.serve import LocalLauncher
+
+            def leak():
+                LocalLauncher()  # graftlint: disable=GL016
+        """}, rules=["GL016"])
+        assert res.new == [] and res.counts()["suppressed"] == 1
+
+
+# ---------------------------------------------------------------------------
 # baseline ratchet
 # ---------------------------------------------------------------------------
 
@@ -1209,4 +1287,4 @@ class TestLiveTree:
         ids = [r.id for r in rules_mod.all_rules()]
         assert ids == ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
                        "GL007", "GL008", "GL009", "GL010", "GL011", "GL012",
-                       "GL013", "GL014", "GL015"]
+                       "GL013", "GL014", "GL015", "GL016"]

@@ -3,7 +3,7 @@
 The premerge gate (ci/chaos.sh) that proves the fault-domain story
 end-to-end, the way ci/q95_floor.json proves perf: it sweeps every
 registered ``faultinj.FAULT_KINDS`` entry across every instrumented
-boundary of twelve scenarios — a spill walk (device→host→disk→back), an
+boundary of thirteen scenarios — a spill walk (device→host→disk→back), an
 out-of-core skewed shuffle, the single-chip q95 pipeline, a global
 distributed sort across the 8-device mesh, a JNI host-boundary
 round-trip, a streaming morsel scan, a multi-tenant serving wave
@@ -27,7 +27,13 @@ and re-place, bit-identically), and a fleet result-cache wave
 cached segments with zero compute — stale rewound snapshot ids
 rejected by the descriptor verify, post-seal byte flips
 quarantined-and-recomputed, and a mutated input NEVER served a stale
-snapshot) — one fault per trial exhaustively,
+snapshot), and an elastic-fleet wave (elastic: a queue-pressured wave
+through an autoscaling front door — a worker is SIGKILLed mid-wave
+while the autoscaler is still adding capacity, launches are failed at
+the launcher boundary (``scale_up_fail``), drains are wedged past the
+deadline (``drain_stuck``), and the fleet must still converge: ≥1
+scale-up, ≥1 retire, every drained generation fenced with zero zombie
+commits, bit-identical digests) — one fault per trial exhaustively,
 plus ``chaos_trials`` seeded multi-fault trials per scenario.  The q95
 and streaming_scan matrices additionally repeat their seam trials with
 the engine knobs pinned to the pallas device-kernel tier (``+pallas``
@@ -1154,6 +1160,162 @@ class ResultCacheScenario:
                                     if k != "liveness"}}}
 
 
+class ElasticScenario:
+    """The elastic control plane under fire: a queue-pressured wave of
+    tenants through a ONE-worker front door with autoscaling on, so the
+    fleet must GROW to drain the backlog and SHRINK (drain → self-fence
+    → reap) once it empties.  Mid-wave, the scenario SIGKILLs the first
+    worker that placed a session — the multi-process analogue of losing
+    a host while the autoscaler is still adding capacity — so loss
+    re-placement, the respawn ladder, and scale-up all run concurrently.
+    ``scale_up_fail`` (launcher boundary) and ``drain_stuck`` (wedged
+    retirement) fire ONLY here: these trials keep both kinds in the
+    coverage check.  Every trial must end with bit-identical digests
+    (``spill_walk`` is a pure function of the seed, wherever and on
+    however many workers it runs), ≥1 scale-up, ≥1 retirement, zero
+    ``fenced_commits`` on every DRAINED generation (a clean drain
+    revokes its own epoch before any zombie commit can happen), zero
+    orphan spill files, and a converged shutdown."""
+
+    name = "elastic"
+    n_tenants = 4
+    seeds = (71, 72, 73, 74)
+
+    def run(self) -> Dict:
+        import signal as _signal
+
+        from spark_rapids_jni_tpu.mem import RetryOOM
+        from spark_rapids_jni_tpu.serve import (AdmissionShed, FrontDoor,
+                                                QueryCancelled, WorkerLost)
+
+        results: List[Optional[str]] = [None] * self.n_tenants
+        kills = 0
+        config.set("serve_backoff_ms", 30.0)
+        config.set("serve_autoscale_high_water", 1)
+        config.set("serve_autoscale_hold_ms", 80.0)
+        config.set("serve_autoscale_idle_ms", 250.0)
+        config.set("serve_autoscale_drain_ms", 1200.0)
+        config.set("serve_autoscale_max", 3)
+        fd = FrontDoor(workers=1, pool_bytes=2 * MB,
+                       host_pool_bytes=512 * KB, max_concurrent=1,
+                       heartbeat_ms=60.0, respawn_max=4, autoscale=True)
+        try:
+            host_killed = False
+            pending = list(range(self.n_tenants))
+            attempts = {i: 0 for i in pending}
+            while pending:
+                wave = [(i, fd.submit(
+                    "spill_walk", {"seed": self.seeds[i], "rows": 8 * KB},
+                    tenant=f"tenant-{i}", priority=i,
+                    replayable=True)) for i in pending]
+                pending = []
+                if not host_killed:
+                    # the mid-wave host loss: SIGKILL the first worker
+                    # that placed a session, while the backlog is still
+                    # pressuring the autoscaler upward
+                    deadline = time.monotonic() + 20.0
+                    victim = None
+                    while victim is None and time.monotonic() < deadline:
+                        placed = [s for _, s in wave
+                                  if s.worker_id is not None]
+                        if placed:
+                            with fd._lock:
+                                w = fd._workers.get(placed[0].worker_id)
+                                victim = w.proc.pid if w is not None \
+                                    else None
+                        if victim is None:
+                            time.sleep(0.02)
+                    if victim is not None:
+                        with contextlib.suppress(OSError):
+                            os.kill(victim, _signal.SIGKILL)
+                        host_killed = True
+                for i, sess in wave:
+                    try:
+                        results[i] = sess.result(timeout=90.0)
+                    except faultinj.FatalInjectedFault:
+                        raise  # whole-scenario replacement
+                    except (WorkerLost, AdmissionShed,
+                            faultinj.TaskCancelled, faultinj.InjectedFault,
+                            QueryCancelled, RetryOOM):
+                        kills += 1
+                        attempts[i] += 1
+                        if attempts[i] >= _MAX_ATTEMPTS:
+                            raise ChaosError(
+                                f"elastic: tenant {i} not done after "
+                                f"{_MAX_ATTEMPTS} re-submissions")
+                        pending.append(i)
+            # convergence: the drained queue must retire capacity back
+            # DOWN TO the base fleet before shutdown — and the fleet
+            # must be quiescent (every survivor healthy, nothing mid-
+            # hello, no respawn pending, no drain in flight), so the
+            # shutdown bye accounting below is race-free
+            deadline = time.monotonic() + 40.0
+            while time.monotonic() < deadline:
+                with fd._lock:
+                    ws = list(fd._workers.values())
+                    quiet = (not fd._pending and not fd._respawn_at
+                             and all(w.state == "healthy"
+                                     and not w.retiring for w in ws)
+                             and len(ws) <= fd._autoscaler.min_workers)
+                if quiet and fd.metrics.snapshot()["scale_downs"] >= 1:
+                    break
+                time.sleep(0.05)
+        finally:
+            report = fd.shutdown()
+            for knob in ("serve_backoff_ms", "serve_autoscale_high_water",
+                         "serve_autoscale_hold_ms",
+                         "serve_autoscale_idle_ms",
+                         "serve_autoscale_drain_ms",
+                         "serve_autoscale_max"):
+                config.reset(knob)
+        fleet = report["fleet"]
+        if fleet["scale_ups"] < 1:
+            raise ChaosError(
+                f"elastic: the backlog never scaled the fleet up "
+                f"(scale_ups={fleet['scale_ups']})")
+        if fleet["scale_downs"] < 1:
+            raise ChaosError(
+                f"elastic: the drained fleet never retired capacity "
+                f"(scale_downs={fleet['scale_downs']})")
+        # the no-zombie-commit invariant: a generation that completed
+        # the drain ladder revoked its OWN epoch, so its store counted
+        # zero fenced commit attempts
+        for e in report["retired"]:
+            if e["drained"] and e["fenced_commits"]:
+                raise ChaosError(
+                    f"elastic: drained generation attempted "
+                    f"{e['fenced_commits']} fenced commits: {e}")
+        unclean = {wid: e for wid, e in report["workers"].items()
+                   if not e.get("clean")}
+        if unclean:
+            raise ChaosError(f"elastic: unclean workers: {unclean}")
+        if report["orphan_spill_files"]:
+            raise ChaosError(f"elastic: orphan spill files: "
+                             f"{report['orphan_spill_files']}")
+        if os.path.exists(fd.fleet_dir):
+            raise ChaosError("elastic: fleet dir survived shutdown")
+        for _ in range(40):  # reader threads exit async after close
+            stragglers = [t.name for t in threading.enumerate()
+                          if t.name.startswith("frontdoor-")]
+            if not stragglers:
+                break
+            time.sleep(0.05)
+        if stragglers:
+            raise ChaosError(
+                f"elastic: live supervisor threads after shutdown: "
+                f"{stragglers}")
+        h = hashlib.sha256()
+        for r in results:  # position-stable: tenant i's digest at slot i
+            h.update((r or "<none>").encode())
+        return {"digest": h.hexdigest(),
+                "extra": {"tenant_kills": kills,
+                          "scale_ups": fleet["scale_ups"],
+                          "scale_downs": fleet["scale_downs"],
+                          "retired": report["retired"],
+                          "fleet": {k: v for k, v in fleet.items()
+                                    if k != "liveness"}}}
+
+
 SCENARIOS = {s.name: s for s in (SpillScenario(), ShuffleScenario(),
                                  Q95Scenario(), SortScenario(),
                                  StreamingScanScenario(), JniScenario(),
@@ -1161,7 +1323,8 @@ SCENARIOS = {s.name: s for s in (SpillScenario(), ShuffleScenario(),
                                  StoreRecoveryScenario(),
                                  MultihostScenario(),
                                  DataPlaneScenario(),
-                                 ResultCacheScenario())}
+                                 ResultCacheScenario(),
+                                 ElasticScenario())}
 
 
 # ---------------------------------------------------------------------------
@@ -1454,6 +1617,21 @@ def single_fault_trials(fast: bool = False) -> List[Trial]:
         one("result_cache", "worker_result", "worker_crash")
         one("result_cache", "serve_step", "oom")
 
+    # elastic scenario: the launcher and retirement seams.
+    # scale_up_fail / drain_stuck fire ONLY here and in the elastic
+    # tests — these trials keep both kinds in the coverage check.  The
+    # failed launch lands at the launcher boundary (construction OR an
+    # autoscale spawn, whichever crossing comes first) and must resolve
+    # through the respawn ladder; the wedged drain must escalate to the
+    # drain-deadline kill with the retired generation fenced; the crash
+    # trial overlaps a worker loss with in-flight autoscaling.
+    if not fast:
+        one("elastic", "launcher_spawn", "scale_up_fail")
+        one("elastic", "launcher_spawn", "scale_up_fail", skip=1)
+        one("elastic", "worker_drain", "drain_stuck")
+        one("elastic", "serve_step", "worker_crash")
+        one("elastic", "serve_step", "oom")
+
     # multihost scenario: the three network kinds fired at the worker
     # side of both directions, link drops at the supervisor side of
     # both, and the partition trial.  net_drop / net_stall / net_torn
@@ -1526,6 +1704,10 @@ _MULTI_POOL = {
                      ("cache_insert", "cache_corrupt"),
                      ("serve_step", "worker_crash"),
                      ("serve_step", "oom")],
+    "elastic": [("launcher_spawn", "scale_up_fail"),
+                ("worker_drain", "drain_stuck"),
+                ("serve_step", "worker_crash"),
+                ("serve_step", "oom")],
 }
 
 

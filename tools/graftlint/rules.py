@@ -1551,6 +1551,120 @@ class GL015ResultCacheKeyDrift(Rule):
                     "config boundary it must never cross")
 
 
+# ---------------------------------------------------------------------------
+# GL016 — launcher / autoscaler handle leak
+# ---------------------------------------------------------------------------
+
+_GL016_CLASSES = {"Launcher", "LocalLauncher", "RemoteLauncher",
+                  "AutoScaler"}
+_GL016_RELEASE_METHODS = {"stop", "drain", "reap", "close", "kill",
+                          "wait", "shutdown", "release", "__exit__"}
+
+
+class GL016LauncherHandleLeak(Rule):
+    """A ``Launcher`` owns the spawn channel for executor worker
+    processes and every ``launch()`` hands back a ``LaunchedWorker``
+    wrapping a live child (or an adopted remote pid); an ``AutoScaler``
+    carries the fleet's sizing state (dwell clocks, per-generation idle
+    tracking).  One constructed and never closed / stopped — or a
+    ``launch()`` result that never reaches the retirement ladder
+    (``stop``/``drain``/``reap``/``kill``/``close``/``wait``) — strands
+    a live OS process or a stale sizing clock past the fleet that made
+    it: exactly the orphan class the elastic chaos scenario hunts at
+    runtime, caught here statically.  GL012's analysis applied to the
+    elastic layer: flags launcher-class constructions and ``launch()``
+    results (on a variable bound to a launcher construction in the same
+    scope) that are discarded or never released, returned, stored,
+    passed on, or used as a context manager."""
+
+    id = "GL016"
+
+    def check(self, pf: ParsedFile) -> Iterable[Finding]:
+        for node in ast.walk(pf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_fn(pf, node)
+
+    @staticmethod
+    def _ctor_name(call: ast.AST) -> Optional[str]:
+        if not isinstance(call, ast.Call):
+            return None
+        func = call.func
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else None)
+        return name if name in _GL016_CLASSES else None
+
+    @staticmethod
+    def _is_launch(call: ast.AST, launchers: Set[str]) -> bool:
+        return (isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)
+                and call.func.attr == "launch"
+                and isinstance(call.func.value, ast.Name)
+                and call.func.value.id in launchers)
+
+    def _check_fn(self, pf, fn):
+        managed: Set[int] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    managed.add(id(item.context_expr))
+        body_nodes = list(_walk_scope(fn, into_functions=False))
+        # variables bound to a launcher construction in THIS scope: only
+        # their .launch() is flagged, so multiprocessing/executor
+        # launch() on unrelated receivers never false-positives
+        launchers = {node.targets[0].id for node in body_nodes
+                     if isinstance(node, ast.Assign)
+                     and len(node.targets) == 1
+                     and isinstance(node.targets[0], ast.Name)
+                     and self._ctor_name(node.value) in ("LocalLauncher",
+                                                         "RemoteLauncher",
+                                                         "Launcher")}
+        for node in body_nodes:
+            if not isinstance(node, ast.Expr):
+                continue
+            if id(node.value) in managed:
+                continue
+            name = self._ctor_name(node.value)
+            if name:
+                yield pf.finding(
+                    self.id, node,
+                    f"`{name}(...)` constructed and immediately "
+                    "discarded — its spawn channel / sizing state can "
+                    "never be stopped")
+            elif self._is_launch(node.value, launchers):
+                yield pf.finding(
+                    self.id, node,
+                    "`launch(...)` worker handle discarded — a live "
+                    "child process nobody can wait()/kill(); it "
+                    "outlives the fleet as exactly the orphan the "
+                    "elastic chaos scenario hunts")
+        for node in body_nodes:
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            var = node.targets[0].id
+            name = self._ctor_name(node.value)
+            if name:
+                if not _name_escapes(fn, node, var,
+                                     _GL016_RELEASE_METHODS):
+                    yield pf.finding(
+                        self.id, node,
+                        f"`{var} = {name}(...)` never reaches the "
+                        "release ladder (stop/drain/reap/close), is "
+                        "never returned, stored, or used as a context "
+                        "manager in this scope — the spawn channel / "
+                        "sizing clocks leak")
+            elif self._is_launch(node.value, launchers):
+                if not _name_escapes(fn, node, var,
+                                     _GL016_RELEASE_METHODS):
+                    yield pf.finding(
+                        self.id, node,
+                        f"`{var} = ...launch(...)` worker handle is "
+                        "never waited, killed, closed, stored, or "
+                        "passed on — the launched process is "
+                        "unreapable from this scope")
+
+
 _ALL: List[Rule] = [GL001TracerLeak(), GL002HostSyncUnderJit(),
                     GL003RetraceHazard(), GL004SpillHandleLeak(),
                     GL005ConfigDrift(), GL006FaultKindDrift(),
@@ -1561,7 +1675,8 @@ _ALL: List[Rule] = [GL001TracerLeak(), GL002HostSyncUnderJit(),
                     GL012FrontDoorHandleLeak(),
                     GL013PallasInterpretDrift(),
                     GL014DecodeAtWrongSeam(),
-                    GL015ResultCacheKeyDrift()]
+                    GL015ResultCacheKeyDrift(),
+                    GL016LauncherHandleLeak()]
 
 
 def all_rules(only: Optional[Sequence[str]] = None) -> List[Rule]:
