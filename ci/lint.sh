@@ -1,25 +1,53 @@
 #!/usr/bin/env bash
 # Lint-clean gate: graftlint (tools/graftlint/) is the Python/JAX-layer
-# analogue of the reference's test-with-sanitizer profile — ten AST rules
-# (GL001-GL010)
-# encoding bug classes this repo has actually shipped (GL001 is the PR 2
-# module-level-jnp UnexpectedTracerError class).  Fails on any finding
-# that is neither per-line-suppressed nor grandfathered in
-# tools/graftlint/baseline.json (the baseline only ever shrinks).
+# analogue of the reference's test-with-sanitizer profile — twenty AST
+# rules (GL001-GL020) encoding bug classes this repo has actually
+# shipped (GL001 is the PR 2 module-level-jnp UnexpectedTracerError
+# class; GL017-GL020 are the whole-program lock-discipline and
+# chaos-coverage rules).  Fails on any finding that is neither
+# per-line-suppressed nor grandfathered in tools/graftlint/baseline.json
+# (the baseline only ever shrinks).
+#
+# The gate is the COLD full-tree run (fresh content-hash index), with a
+# hard 60s budget so the analyzer can never silently eat the premerge
+# budget; the warm re-run exercises the .graftlint_index.json cache and
+# prints both timings.  A SARIF report lands next to the cache for
+# code-scanning tooling.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+CACHE=".graftlint_index.json"
+SARIF="${GRAFTLINT_SARIF:-/tmp/graftlint.sarif}"
+BUDGET_S=60
+
 OUT="$(mktemp)"
 trap 'rm -f "$OUT"' EXIT
+
+rm -f "$CACHE"
+t0=$(python -c 'import time; print(time.monotonic())')
 if python -m tools.graftlint spark_rapids_jni_tpu tests \
-    --format json >"$OUT"; then
-  python - "$OUT" <<'EOF'
+    --cache --format json >"$OUT"; then
+  t1=$(python -c 'import time; print(time.monotonic())')
+  python -m tools.graftlint spark_rapids_jni_tpu tests \
+      --cache --format sarif >"$SARIF"
+  t2=$(python -c 'import time; print(time.monotonic())')
+  python - "$OUT" "$t0" "$t1" "$t2" "$BUDGET_S" "$SARIF" <<'EOF'
 import json, sys
 doc = json.load(open(sys.argv[1]))
+t0, t1, t2 = float(sys.argv[2]), float(sys.argv[3]), float(sys.argv[4])
+budget, sarif = float(sys.argv[5]), sys.argv[6]
 c = doc["counts"]
+cold, warm = t1 - t0, t2 - t1
 print(f"graftlint: clean ({c['baselined']} baselined, "
       f"{c['suppressed']} suppressed, "
       f"{len(doc['stale_baseline'])} stale baseline entries)")
+print(f"graftlint: timing cold={cold:.2f}s warm={warm:.2f}s "
+      f"(index cache), budget={budget:.0f}s")
+print(f"graftlint: SARIF report at {sarif}")
+if cold > budget:
+    print(f"graftlint: FAIL — cold full-tree run {cold:.2f}s exceeds "
+          f"the {budget:.0f}s gate budget", file=sys.stderr)
+    sys.exit(1)
 EOF
 else
   echo "graftlint: NEW findings (full JSON report follows)" >&2

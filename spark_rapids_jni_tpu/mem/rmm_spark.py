@@ -127,8 +127,11 @@ def _load_lib() -> ctypes.CDLL:
         stale = (not os.path.exists(_LIB_PATH)
                  or os.path.getmtime(_LIB_PATH) < os.path.getmtime(src_path))
         if stale:
-            proc = subprocess.run(["make", "-C", _NATIVE_DIR, "-B"],
-                                  capture_output=True, text=True)
+            # one-time native build: the lock exists precisely to
+            # serialize make — a concurrent build would corrupt the .so
+            proc = subprocess.run(  # graftlint: disable=GL019
+                ["make", "-C", _NATIVE_DIR, "-B"],
+                capture_output=True, text=True)
             if proc.returncode != 0:
                 raise RuntimeError(
                     "building libtpu_resource_adaptor.so failed:\n"

@@ -32,6 +32,15 @@ a few dozen bytes, and it now carries everything placement needs):
   supervisor-resident and fleet-shared, so they are consistent across
   generations by construction.
 
+Concurrency contract: neither class owns a lock ON PURPOSE.  Every
+entry point (placement scoring, the autoscale tick, ``snapshot``) is
+called by the front door with the fleet lock already held — the
+supervisor's ``FrontDoor._lock`` is the single guard for all mutable
+state here, which is also why no method may block (no I/O, no sleeps:
+the whole-program lint's GL017/GL019 lock discipline holds across the
+frontdoor → elastic call edge).  ``stop()`` is the one exception —
+lock-free, monotonic flag, safe to call from teardown paths.
+
 graftlint GL016 flags AutoScaler constructions that can't reach
 ``stop()`` (or another release) on some path.
 """

@@ -492,7 +492,9 @@ class FrontDoor:
         under the same knobs is served straight from the fleet result
         cache: the session finishes here, BEFORE admission — no shed
         check, no worker dispatch, no ticket, no compute."""
-        if self._shutdown_started:
+        # benign race: monotonic flag, re-checked under the lock by the
+        # drain — a submit that slips past here is cancelled by shutdown
+        if self._shutdown_started:  # graftlint: guarded-by(_lock)
             raise ServeError("front door is shut down")
         sid = next(self._sids)
         sess = FrontDoorSession(
@@ -566,6 +568,7 @@ class FrontDoor:
         """Cancel wherever the session is: pending (finished here),
         placed/running (forwarded to its worker, which unwinds it
         kill-safe and reports ``cancelled``)."""
+        link = None
         with self._lock:
             if sess._done.is_set():
                 return
@@ -578,8 +581,13 @@ class FrontDoor:
                 return
             w = self._workers.get(sess.worker_id)
             if w is not None and w.link is not None and w.state == "healthy":
-                with contextlib.suppress(OSError):
-                    w.link.send({"op": "cancel", "sid": sess.sid})
+                link = w.link
+        # the forward crosses a process boundary — never under the fleet
+        # lock (a wedged worker pipe would stall every submit/monitor
+        # tick behind this cancel)
+        if link is not None:
+            with contextlib.suppress(OSError):
+                link.send({"op": "cancel", "sid": sess.sid})
 
     def sessions(self) -> List[FrontDoorSession]:
         with self._lock:
@@ -699,14 +707,18 @@ class FrontDoor:
         report["launcher"] = getattr(self._launcher, "name", "local")
         self._launcher.close()
         report["placement"] = self._placement.mode
-        report["quota"] = {
-            "quota_bytes": self._quota_bytes,
-            "quota_s": self._quota_s,
-            "tenant_bytes": dict(self._tenant_bytes),
-            "tenant_seconds": {t: round(s, 6) for t, s
-                               in self._tenant_seconds.items()},
-            "rejections": dict(self._quota_rejected),
-        }
+        # quota counters are mutated under the fleet lock by completion
+        # bookkeeping; snapshot them the same way (a straggler
+        # _note_session_done may still be finishing a cancelled session)
+        with self._lock:
+            report["quota"] = {
+                "quota_bytes": self._quota_bytes,
+                "quota_s": self._quota_s,
+                "tenant_bytes": dict(self._tenant_bytes),
+                "tenant_seconds": {t: round(s, 6) for t, s
+                                   in self._tenant_seconds.items()},
+                "rejections": dict(self._quota_rejected),
+            }
         report["result_cache"] = self.result_cache.metrics()
         # entries ride spill handles: close them so arena charges and
         # demoted disk files release before the fleet dir reap
@@ -915,24 +927,27 @@ class FrontDoor:
                 # old link died (or whose "running" ack died) was lost
                 # with it — re-send every placed-but-unacked session; the
                 # worker dedups by sid, so a duplicate is a re-ack, never
-                # a second run
-                for sess in list(w.sessions.values()):
-                    if sess.status == "placed" and not sess._done.is_set():
-                        try:
-                            link.send({
-                                "op": "submit", "sid": sess.sid,
-                                "kind": sess.kind, "params": sess.params,
-                                "tenant": str(sess.tenant),
-                                "priority": sess.priority,
-                                "est_bytes": sess.est_bytes,
-                                "timeout_s": sess.timeout_s,
-                            })
-                        except OSError:
-                            break  # link died again: next reattach retries
-                threading.Thread(
-                    target=self._reader, args=(w, link),
-                    name=f"frontdoor-reader-{slot}-{w.gen}",
-                    daemon=True).start()
+                # a second run.  Payloads are captured under the lock,
+                # sent after release: the sends cross a process boundary
+                # and must not wedge the fleet lock behind a slow pipe.
+                resend = [
+                    {"op": "submit", "sid": sess.sid,
+                     "kind": sess.kind, "params": sess.params,
+                     "tenant": str(sess.tenant),
+                     "priority": sess.priority,
+                     "est_bytes": sess.est_bytes,
+                     "timeout_s": sess.timeout_s}
+                    for sess in list(w.sessions.values())
+                    if sess.status == "placed" and not sess._done.is_set()]
+                reader_name = f"frontdoor-reader-{slot}-{w.gen}"
+            for payload in resend:
+                try:
+                    link.send(payload)
+                except OSError:
+                    break  # link died again: next reattach retries
+            threading.Thread(
+                target=self._reader, args=(w, link),
+                name=reader_name, daemon=True).start()
             self._wake.set()
 
     def _reader(self, w: WorkerHandle, link: wire.Transport):
@@ -1195,6 +1210,7 @@ class FrontDoor:
             if self._stop.is_set():
                 return
             now = time.monotonic()
+            to_ping = []
             with self._lock:
                 for w in list(self._workers.values()):
                     if w.state == "dead":
@@ -1219,10 +1235,8 @@ class FrontDoor:
                             "stalls", now)
                         continue
                     if w.state == "healthy":
-                        link = w.link
-                        if link is not None:
-                            with contextlib.suppress(OSError):
-                                link.send({"op": "ping", "t": now})
+                        if w.link is not None:
+                            to_ping.append(w.link)
                         if now - w.last_pong > self._hb_s * _MISS_BUDGET:
                             w.kill()
                             self._on_worker_lost_locked(
@@ -1253,6 +1267,13 @@ class FrontDoor:
                 self._autoscale_tick_locked(now)
                 self._maybe_shed_locked()
                 self._dispatch_locked(now)
+            # pings cross process boundaries: sent after the fleet lock
+            # drops so one wedged pipe can't stall dispatch/admission
+            # for the whole tick (a link killed above just raises into
+            # the suppress)
+            for link in to_ping:
+                with contextlib.suppress(OSError):
+                    link.send({"op": "ping", "t": now})
 
     def _merge_fired(self, w: WorkerHandle):
         """Merge the worker's injection trace into this process's log —

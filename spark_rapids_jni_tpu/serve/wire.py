@@ -335,7 +335,9 @@ class Transport:
                 self.close()
                 raise WireError(f"injected link drop on send: {e}") from e
             except faultinj.NetStallError as e:
-                time.sleep(self.stall_s)
+                # the injected stall MUST wedge the send path — that is
+                # the fault being simulated
+                time.sleep(self.stall_s)  # graftlint: disable=GL019
                 self.close()
                 raise WireError(f"injected link stall on send: {e}") from e
             except faultinj.NetTornError as e:

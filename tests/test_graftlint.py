@@ -1172,6 +1172,431 @@ class TestGL016:
 
 
 # ---------------------------------------------------------------------------
+# GL017 — lock-order cycle (whole-program)
+# ---------------------------------------------------------------------------
+
+
+class TestGL017:
+    def test_nested_with_cycle_flagged(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import threading
+
+            class A:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            pass
+                def two(self):
+                    with self._b:
+                        with self._a:
+                            pass
+        """}, rules=["GL017"])
+        assert new_rules(res) == [("GL017", "mod.py")]
+        assert "lock-order cycle" in res.new[0].message
+
+    def test_cycle_through_call_graph_flagged(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import threading
+
+            class B:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                def outer(self):
+                    with self._a:
+                        self._grab_b()
+                def _grab_b(self):
+                    with self._b:
+                        pass
+                def reverse(self):
+                    with self._b:
+                        with self._a:
+                            pass
+        """}, rules=["GL017"])
+        assert new_rules(res) == [("GL017", "mod.py")]
+
+    def test_cross_class_cycle_via_attribute_receiver(self, tmp_path):
+        # the PR-9 BUFN shape: the door holds its lock calling into the
+        # scaler, whose method takes its own lock and calls back
+        res = lint(tmp_path, {"mod.py": """
+            import threading
+
+            class Scaler:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._door = Door()
+                def tick(self):
+                    with self._lock:
+                        self._door.wake()
+
+            class Door:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._scaler = Scaler()
+                def step(self):
+                    with self._lock:
+                        self._scaler.tick()
+                def wake(self):
+                    with self._lock:
+                        pass
+        """}, rules=["GL017"])
+        assert new_rules(res) == [("GL017", "mod.py")]
+
+    def test_consistent_order_and_reentrant_self_clean(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._a = threading.RLock()
+                    self._b = threading.Lock()
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            pass
+                def two(self):
+                    with self._a:
+                        with self._b:
+                            pass
+                def reenter(self):
+                    with self._a:
+                        self._helper()
+                def _helper(self):
+                    with self._a:
+                        pass
+        """}, rules=["GL017"])
+        assert res.new == []
+
+
+# ---------------------------------------------------------------------------
+# GL018 — unguarded shared field
+# ---------------------------------------------------------------------------
+
+GL018_HEAD = """
+    import threading
+
+    class W:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._count = 0
+            self._t = threading.Thread(target=self._tick, daemon=True)
+        def bump(self):
+            with self._lock:
+                self._count += 1
+"""
+
+
+class TestGL018:
+    def test_lockfree_read_from_thread_entry_flagged(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": GL018_HEAD + """\
+        def _tick(self):
+            return self._count
+"""}, rules=["GL018"])
+        assert new_rules(res) == [("GL018", "mod.py")]
+        assert "_count" in res.new[0].message
+
+    def test_guarded_read_clean(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": GL018_HEAD + """\
+        def _tick(self):
+            with self._lock:
+                return self._count
+"""}, rules=["GL018"])
+        assert res.new == []
+
+    def test_guarded_by_annotation_escape(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": GL018_HEAD + """\
+        def _tick(self):
+            return self._count  # graftlint: guarded-by(_lock)
+"""}, rules=["GL018"])
+        assert res.new == []
+
+    def test_reachability_through_self_calls(self, tmp_path):
+        # the entry point reaches the access two hops down the call graph
+        res = lint(tmp_path, {"mod.py": GL018_HEAD + """\
+        def _tick(self):
+            self._hop()
+        def _hop(self):
+            return self._count
+"""}, rules=["GL018"])
+        assert new_rules(res) == [("GL018", "mod.py")]
+
+    def test_double_checked_locking_clean(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._done = False
+                    self._t = threading.Thread(target=self.close)
+                def close(self):
+                    if self._done:
+                        return
+                    with self._lock:
+                        self._done = True
+        """}, rules=["GL018"])
+        assert res.new == []
+
+    def test_no_thread_entry_no_finding(self, tmp_path):
+        # without a thread entry point nothing else races the field
+        res = lint(tmp_path, {"mod.py": """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._count = 0
+                def bump(self):
+                    with self._lock:
+                        self._count += 1
+                def peek(self):
+                    return self._count
+        """}, rules=["GL018"])
+        assert res.new == []
+
+
+# ---------------------------------------------------------------------------
+# GL019 — blocking while holding a lock
+# ---------------------------------------------------------------------------
+
+
+class TestGL019:
+    def test_blocking_inside_lock_flagged(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import threading
+            import time
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.sock = None
+                def naps(self):
+                    with self._lock:
+                        time.sleep(1.0)
+                def sends(self):
+                    with self._lock:
+                        self.sock.send(b"x")
+        """}, rules=["GL019"])
+        assert new_rules(res) == [("GL019", "mod.py")] * 2
+
+    def test_blocking_after_release_clean(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.sock = None
+                def good(self):
+                    with self._lock:
+                        payload = b"x"
+                    self.sock.send(payload)
+        """}, rules=["GL019"])
+        assert res.new == []
+
+    def test_condition_wait_timeout_distinction(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cond = threading.Condition()
+                def bad(self):
+                    with self._lock:
+                        self._cond.wait()
+                def good(self):
+                    with self._lock:
+                        self._cond.wait(0.5)
+        """}, rules=["GL019"])
+        assert len(res.new) == 1 and "wait" in res.new[0].message
+
+    def test_module_level_lock_and_suppression(self, tmp_path):
+        res = lint(tmp_path, {"mod.py": """
+            import threading
+            import time
+
+            _lock = threading.Lock()
+
+            def build():
+                with _lock:
+                    time.sleep(0.1)  # graftlint: disable=GL019
+
+            def stall():
+                with _lock:
+                    time.sleep(0.1)
+        """}, rules=["GL019"])
+        assert len(res.new) == 1 and res.counts()["suppressed"] == 1
+
+
+# ---------------------------------------------------------------------------
+# GL020 — probe-reachability drift
+# ---------------------------------------------------------------------------
+
+
+class TestGL020:
+    def test_orphan_probe_and_orphan_pattern_flagged(self, tmp_path):
+        res = lint(tmp_path, {
+            "app.py": """
+                import faultinj
+                ok = faultinj.instrument(lambda: None, "serve_step")
+                lonely = faultinj.instrument(lambda: None, "lonely_probe")
+            """,
+            "trials.py": """
+                TRIALS = [
+                    {"match": "serve_step", "fault": "oom"},
+                    {"match": "ghost_*", "fault": "oom"},
+                ]
+            """,
+        }, rules=["GL020"])
+        assert sorted(new_rules(res)) == [("GL020", "app.py"),
+                                          ("GL020", "trials.py")]
+
+    def test_glob_pattern_and_loop_fed_trials_cover(self, tmp_path):
+        res = lint(tmp_path, {
+            "app.py": """
+                import faultinj
+                a = faultinj.instrument(lambda: None, "spill_io_write")
+                b = faultinj.instrument(lambda: None, "spill_io_read")
+                c = faultinj.instrument(lambda: None, "worker_recv")
+            """,
+            "trials.py": """
+                def one(scenario, match, kind):
+                    pass
+
+                def build():
+                    one("s", "spill_io_*", "spill_io")
+                    for match in ("worker_recv",):
+                        one("s", match, "worker_crash")
+            """,
+        }, rules=["GL020"])
+        assert res.new == []
+
+    def test_dynamic_probe_prefix_relates_to_patterns(self, tmp_path):
+        files = {
+            "app.py": """
+                import faultinj
+                def make(role):
+                    return faultinj.instrument(
+                        lambda: None, f"net_send_{role}")
+            """,
+            "trials.py": 'T = [{"match": "net_send_wk", "fault": "oom"}]\n',
+        }
+        res = lint(tmp_path, dict(files), rules=["GL020"])
+        assert res.new == []
+        files["trials.py"] = 'T = [{"match": "cache_serve", "fault": "x"}]\n'
+        res = lint(tmp_path, dict(files), rules=["GL020"])
+        assert sorted(new_rules(res)) == [("GL020", "app.py"),
+                                          ("GL020", "trials.py")]
+
+    def test_no_trial_tables_means_out_of_scope(self, tmp_path):
+        res = lint(tmp_path, {"app.py": """
+            import faultinj
+            p = faultinj.instrument(lambda: None, "serve_step")
+        """}, rules=["GL020"])
+        assert res.new == []
+
+    def test_probes_in_test_files_ignored(self, tmp_path):
+        res = lint(tmp_path, {
+            "app.py": """
+                import faultinj
+                p = faultinj.instrument(lambda: None, "serve_step")
+            """,
+            "trials.py": 'T = [{"match": "serve_step", "fault": "oom"}]\n',
+            "tests/test_toy.py": """
+                import faultinj
+                toy = faultinj.instrument(lambda: None, "toy_probe")
+                T = [{"match": "toy_*", "fault": "oom"}]
+            """,
+        }, rules=["GL020"])
+        assert res.new == []
+
+
+# ---------------------------------------------------------------------------
+# project index cache
+# ---------------------------------------------------------------------------
+
+
+class TestProjectIndexCache:
+    def test_warm_run_replays_and_edit_invalidates(self, tmp_path,
+                                                   monkeypatch):
+        mod = tmp_path / "mod.py"
+        mod.write_text("import jax.numpy as jnp\nT = jnp.asarray([1])\n")
+        cache = str(tmp_path / ".graftlint_index.json")
+        res = engine.run([str(tmp_path)], root=str(tmp_path), baseline=[],
+                         cache_path=cache)
+        assert [f.rule for f in res.new] == ["GL001"]
+
+        # warm: every file replays from the content-hash cache — the
+        # parser is never invoked, findings are byte-identical
+        real = engine.parse_file
+        calls = []
+
+        def counting(*a, **k):
+            calls.append(a)
+            return real(*a, **k)
+
+        monkeypatch.setattr(engine, "parse_file", counting)
+        res2 = engine.run([str(tmp_path)], root=str(tmp_path), baseline=[],
+                          cache_path=cache)
+        assert calls == []
+        assert ([f.as_dict() for f in res2.findings]
+                == [f.as_dict() for f in res.findings])
+
+        # edit: the hash misses, the file re-parses, the result tracks
+        # the new content
+        mod.write_text("import numpy as np\nT = np.asarray([1])\n")
+        res3 = engine.run([str(tmp_path)], root=str(tmp_path), baseline=[],
+                          cache_path=cache)
+        assert [a[0] for a in calls[-1:]] and res3.new == []
+
+    def test_rule_set_change_invalidates_whole_cache(self, tmp_path):
+        mod = tmp_path / "mod.py"
+        mod.write_text("import jax.numpy as jnp\nT = jnp.asarray([1])\n")
+        cache = str(tmp_path / ".graftlint_index.json")
+        engine.run([str(tmp_path)], root=str(tmp_path), baseline=[],
+                   cache_path=cache)
+        # a subset run must not replay findings cached under the full
+        # rule signature
+        res = engine.run([str(tmp_path)], root=str(tmp_path), baseline=[],
+                         rules=["GL002"], cache_path=cache)
+        assert res.findings == []
+
+    def test_suppressions_respected_on_cache_replay(self, tmp_path):
+        mod = tmp_path / "mod.py"
+        mod.write_text("import jax.numpy as jnp\n"
+                       "T = jnp.asarray([1])  # graftlint: disable=GL001\n")
+        cache = str(tmp_path / ".graftlint_index.json")
+        for _ in range(2):      # cold, then replayed from cache
+            res = engine.run([str(tmp_path)], root=str(tmp_path),
+                             baseline=[], cache_path=cache)
+            assert res.new == [] and res.counts()["suppressed"] == 1
+
+
+# ---------------------------------------------------------------------------
+# cross-file anchoring: project findings land on real file:line
+# ---------------------------------------------------------------------------
+
+
+class TestCrossFileAnchoring:
+    def test_project_finding_anchored_to_declaring_file(self, tmp_path):
+        res = lint(tmp_path, {
+            "app.py": """
+                import faultinj
+                ok = faultinj.instrument(lambda: None, "serve_step")
+                lonely = faultinj.instrument(lambda: None, "lonely_probe")
+            """,
+            "trials.py": 'T = [{"match": "serve_step", "fault": "oom"}]\n',
+        }, rules=["GL020"])
+        assert [(f.rule, f.path, f.line) for f in res.new] \
+            == [("GL020", "app.py", 4)]
+        assert "lonely_probe" in res.new[0].snippet
+
+
+# ---------------------------------------------------------------------------
 # baseline ratchet
 # ---------------------------------------------------------------------------
 
@@ -1262,6 +1687,68 @@ class TestCli:
         assert proc.returncode == 1
         assert json.loads(proc.stdout)["counts"]["new"] == 1
 
+    def test_sarif_format(self, tmp_path, capsys):
+        mod = tmp_path / "mod.py"
+        mod.write_text("import jax.numpy as jnp\nT = jnp.asarray([1])\n")
+        rc = cli_main([str(mod), "--root", str(tmp_path), "--format",
+                       "sarif", "--no-baseline", "--rules", "GL001"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 1 and doc["version"] == "2.1.0"
+        result = doc["runs"][0]["results"][0]
+        assert result["ruleId"] == "GL001"
+        assert result["level"] == "error"
+        loc = result["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == "mod.py"
+        assert loc["region"]["startLine"] == 2
+
+    def test_sarif_omits_suppressed(self, tmp_path, capsys):
+        mod = tmp_path / "mod.py"
+        mod.write_text("import jax.numpy as jnp\n"
+                       "T = jnp.asarray([1])  # graftlint: disable=GL001\n")
+        rc = cli_main([str(mod), "--root", str(tmp_path), "--format",
+                       "sarif", "--no-baseline", "--rules", "GL001"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0 and doc["runs"][0]["results"] == []
+
+    def test_diff_mode_filters_to_changed_lines(self, tmp_path, capsys):
+        def git(*a):
+            subprocess.run(["git", "-C", str(tmp_path), *a], check=True,
+                           capture_output=True, timeout=60)
+        git("init", "-q")
+        mod = tmp_path / "mod.py"
+        mod.write_text("import jax.numpy as jnp\nT = jnp.asarray([1])\n")
+        git("add", "-A")
+        git("-c", "user.email=ci@example.invalid", "-c", "user.name=ci",
+            "commit", "-qm", "seed")
+        # both lines violate, but only the appended one is new since HEAD
+        mod.write_text("import jax.numpy as jnp\nT = jnp.asarray([1])\n"
+                       "U = jnp.zeros((4,))\n")
+        rc = cli_main([str(mod), "--root", str(tmp_path), "--diff", "HEAD",
+                       "--no-baseline", "--rules", "GL001",
+                       "--format", "json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert [f["line"] for f in doc["findings"]] == [3]
+
+    def test_diff_bad_rev_is_usage_error(self, tmp_path, capsys):
+        def git(*a):
+            subprocess.run(["git", "-C", str(tmp_path), *a], check=True,
+                           capture_output=True, timeout=60)
+        git("init", "-q")
+        (tmp_path / "mod.py").write_text("x = 1\n")
+        assert cli_main([str(tmp_path), "--root", str(tmp_path),
+                         "--diff", "no-such-rev"]) == 2
+
+    def test_cache_flag_roundtrip(self, tmp_path, capsys):
+        mod = tmp_path / "mod.py"
+        mod.write_text("import jax.numpy as jnp\nT = jnp.asarray([1])\n")
+        for _ in range(2):      # cold run populates, warm run replays
+            rc = cli_main([str(mod), "--root", str(tmp_path), "--cache",
+                           "--no-baseline", "--format", "json"])
+            doc = json.loads(capsys.readouterr().out)
+            assert rc == 1 and doc["counts"]["new"] == 1
+        assert (tmp_path / ".graftlint_index.json").exists()
+
 
 # ---------------------------------------------------------------------------
 # live-tree meta-gate: the repo itself stays lint-clean
@@ -1282,9 +1769,22 @@ class TestLiveTree:
         # the GL001 burn-down left nothing grandfathered; keep it that way
         assert engine.load_baseline(engine.default_baseline_path()) == []
 
+    def test_live_tree_concurrency_rules_pin_zero(self):
+        # GL017-GL020 hold at zero findings with NO baseline at all: the
+        # serve fleet's lock discipline and chaos coverage are clean, not
+        # grandfathered
+        res = engine.run(
+            [os.path.join(REPO_ROOT, "spark_rapids_jni_tpu"),
+             os.path.join(REPO_ROOT, "tests")],
+            root=REPO_ROOT, baseline=[],
+            rules=["GL017", "GL018", "GL019", "GL020"])
+        assert res.parse_errors == []
+        assert res.new == [], "\n" + res.to_text()
+
     def test_every_rule_is_registered(self):
         from tools.graftlint import rules as rules_mod
         ids = [r.id for r in rules_mod.all_rules()]
         assert ids == ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
                        "GL007", "GL008", "GL009", "GL010", "GL011", "GL012",
-                       "GL013", "GL014", "GL015", "GL016"]
+                       "GL013", "GL014", "GL015", "GL016", "GL017", "GL018",
+                       "GL019", "GL020"]

@@ -23,15 +23,31 @@ GL007     donated-buffer reuse: a variable passed at a donated position of
           a ``jax.jit(..., donate_argnums=...)`` callable and read again
 ========  ==================================================================
 
+...through GL020.  GL008–GL016 extend the same idea to I/O handles,
+late materialization, sharding, the serve/elastic lifecycles, pallas
+interpret mode, decode seams, and result-cache keys; GL017–GL020 are
+the whole-program concurrency and chaos-coverage rules (lock-order
+cycles, unguarded shared fields, blocking under locks,
+probe-reachability drift) computed over the cross-module project index
+in ``project.py``.  See ``tools/graftlint/README.md`` for the full
+catalogue with the motivating incident per rule.
+
 Run ``python -m tools.graftlint spark_rapids_jni_tpu tests``; see
 ``tools/graftlint/README.md`` for rule rationale, suppressions
-(``# graftlint: disable=GLnnn``) and the baseline ratchet.
+(``# graftlint: disable=GLnnn``), the ``guarded-by`` annotation, the
+baseline ratchet, and the content-hash index cache (``--cache``).
 """
 
 from .engine import (  # noqa: F401
     Finding,
     LintResult,
     ParsedFile,
+    ProjectRule,
     load_baseline,
     run,
+)
+from .project import (  # noqa: F401
+    IndexCache,
+    ProjectIndex,
+    extract_facts,
 )

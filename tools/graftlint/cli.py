@@ -3,6 +3,9 @@
     python -m tools.graftlint spark_rapids_jni_tpu tests
     python -m tools.graftlint --format json --baseline tools/graftlint/baseline.json ...
     python -m tools.graftlint --write-baseline ...   # grandfather current findings
+    python -m tools.graftlint --cache ...            # content-hash index cache
+    python -m tools.graftlint --diff HEAD~1 ...      # changed lines only
+    python -m tools.graftlint --format sarif ...     # SARIF 2.1.0 for tooling
 
 Exit codes: 0 clean (baselined/suppressed findings allowed), 1 new
 findings, 2 bad usage.
@@ -11,20 +14,52 @@ findings, 2 bad usage.
 from __future__ import annotations
 
 import argparse
+import os
+import re
+import subprocess
 import sys
-from typing import Optional, Sequence
+from typing import Dict, Optional, Sequence, Set
 
 from . import engine
+
+_HUNK_RE = re.compile(r"^@@ -\d+(?:,\d+)? \+(\d+)(?:,(\d+))? @@")
+
+
+def changed_lines(root: str, rev: str) -> Dict[str, Set[int]]:
+    """relpath -> set of line numbers added/modified since ``rev``,
+    parsed from ``git diff -U0`` (zero context, so every + line in a
+    hunk is a real change)."""
+    proc = subprocess.run(
+        ["git", "-C", root, "diff", "--unified=0", rev, "--"],
+        capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise ValueError(
+            f"git diff {rev} failed: {proc.stderr.strip() or proc.stdout.strip()}")
+    out: Dict[str, Set[int]] = {}
+    current: Optional[str] = None
+    for line in proc.stdout.splitlines():
+        if line.startswith("+++ b/"):
+            current = line[6:]
+        elif line.startswith("+++ "):
+            current = None          # /dev/null (deleted file)
+        elif current is not None:
+            m = _HUNK_RE.match(line)
+            if m:
+                start = int(m.group(1))
+                count = int(m.group(2)) if m.group(2) is not None else 1
+                out.setdefault(current, set()).update(
+                    range(start, start + count))
+    return out
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tools.graftlint",
-        description="AST-based JAX-hazard linter (rules GL001-GL007); "
-                    "see tools/graftlint/README.md")
+        description="AST-based JAX-hazard + concurrency linter (rules "
+                    "GL001-GL020); see tools/graftlint/README.md")
     parser.add_argument("paths", nargs="+",
                         help="files or directories to lint")
-    parser.add_argument("--format", choices=("text", "json"),
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
                         default="text")
     parser.add_argument("--baseline", default=None,
                         help="baseline file (default: "
@@ -39,14 +74,34 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "this tool)")
     parser.add_argument("--rules", default=None,
                         help="comma list restricting to these rule ids")
+    parser.add_argument("--cache", nargs="?", const="", default=None,
+                        metavar="PATH",
+                        help="content-hash index cache: unchanged files "
+                             "skip re-parsing (default path: "
+                             "<root>/.graftlint_index.json)")
+    parser.add_argument("--diff", default=None, metavar="REV",
+                        help="report only findings on lines changed "
+                             "since REV (git diff -U0); the whole-program "
+                             "analysis still sees the full tree")
     args = parser.parse_args(argv)
 
     baseline_path = args.baseline or engine.default_baseline_path()
     baseline = [] if args.no_baseline else engine.load_baseline(baseline_path)
     rules = args.rules.split(",") if args.rules else None
+    root = os.path.abspath(args.root) if args.root else os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    cache_path = None
+    if args.cache is not None:
+        cache_path = args.cache or os.path.join(root,
+                                                ".graftlint_index.json")
     try:
-        result = engine.run(args.paths, root=args.root, baseline=baseline,
-                            rules=rules)
+        result = engine.run(args.paths, root=root, baseline=baseline,
+                            rules=rules, cache_path=cache_path)
+        if args.diff is not None:
+            touched = changed_lines(root, args.diff)
+            result.findings = [
+                f for f in result.findings
+                if f.line in touched.get(f.path, ())]
     except ValueError as e:
         print(f"graftlint: {e}", file=sys.stderr)
         return 2
@@ -58,7 +113,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
               f"{'y' if kept == 1 else 'ies'} to {baseline_path}")
         return 0
 
-    out = result.to_json() if args.format == "json" else result.to_text()
+    if args.format == "json":
+        out = result.to_json()
+    elif args.format == "sarif":
+        out = result.to_sarif()
+    else:
+        out = result.to_text()
     sys.stdout.write(out)
     if result.parse_errors:
         return 2

@@ -4,9 +4,16 @@ Everything here is stdlib-only (``ast`` + ``json``); rules live in
 ``rules.py`` and come in two shapes:
 
 * per-file rules:    ``check(pf: ParsedFile) -> Iterable[Finding]``
-* project rules:     ``check_project(files, project) -> Iterable[Finding]``
-  (GL005/GL006 need cross-file context: the config registry vs README,
-  the fault-kind registry vs every use site)
+* project rules:     subclasses of ``ProjectRule`` — they run once after
+  every file parses, over the whole-program ``ProjectIndex`` built by
+  ``project.py`` (GL005/GL006 need the config/fault registries vs every
+  use site; GL017–GL020 need the cross-class lock graph and the
+  probe/trial tables).  They emit findings anchored to real file:line so
+  baselines and suppressions work unchanged.
+
+Passing ``cache_path`` to ``run`` enables the content-hash index cache:
+unchanged files skip re-parsing AND re-running per-file rules (their
+facts and findings replay from ``.graftlint_index.json``).
 
 Suppression is per line: ``# graftlint: disable=GL001`` (or a comma list,
 or bare ``disable`` for all rules) on the finding's line.
@@ -131,6 +138,29 @@ def parse_file(path: str, root: str) -> Optional[ParsedFile]:
                       suppressions=_scan_suppressions(source))
 
 
+class ProjectRule:
+    """Protocol for whole-program rules.
+
+    ``check_index`` runs once, after all files parse, over the
+    ``project.ProjectIndex``; ``linted`` is the ordered list of relpaths
+    actually being linted this run (the index itself covers the whole
+    tree — rules use ``linted`` to keep findings on the files the user
+    asked about).  Findings must anchor to real file:line positions so
+    the baseline ratchet and per-line suppressions work unchanged.
+    """
+
+    id: str = ""
+    per_file: bool = False
+    uses_index: bool = True
+
+    def check(self, pf: "ParsedFile") -> Iterable[Finding]:
+        return ()
+
+    def check_index(self, index, linted: List[str],
+                    project: "Project") -> Iterable[Finding]:
+        return ()
+
+
 def _walk_py(target: str) -> Iterable[str]:
     if os.path.isfile(target):
         if target.endswith(".py"):
@@ -205,6 +235,44 @@ class LintResult:
              "exit_code": self.exit_code},
             indent=2, sort_keys=False) + "\n"
 
+    def to_sarif(self) -> str:
+        """Minimal SARIF 2.1.0 — enough for code-scanning uploads and
+        editor ingestion.  Suppressed findings are omitted; baselined
+        ones downgrade to ``note``."""
+        results = []
+        for f in sorted(self.findings,
+                        key=lambda f: (f.path, f.line, f.rule)):
+            if f.status == "suppressed":
+                continue
+            results.append({
+                "ruleId": f.rule,
+                "level": "error" if f.status == "new" else "note",
+                "message": {"text": f.message},
+                "locations": [{
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.path},
+                        "region": {"startLine": f.line,
+                                   "startColumn": f.col + 1},
+                    }}],
+            })
+        doc = {
+            "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                        "sarif-spec/master/Schemata/sarif-schema-2.1.0"
+                        ".json"),
+            "version": "2.1.0",
+            "runs": [{
+                "tool": {"driver": {
+                    "name": "graftlint",
+                    "informationUri":
+                        "tools/graftlint/README.md",
+                    "rules": [{"id": rid} for rid in sorted(
+                        {f.rule for f in self.findings})],
+                }},
+                "results": results,
+            }],
+        }
+        return json.dumps(doc, indent=2) + "\n"
+
     def to_text(self) -> str:
         out = []
         for f in sorted(self.findings,
@@ -249,15 +317,32 @@ def write_baseline(path: str, findings: Sequence[Finding]) -> None:
         f.write("\n")
 
 
+def _facts_suppressed(facts: Optional[dict], line: int, rule: str) -> bool:
+    """Suppression check for findings on files we never re-parsed (cache
+    hits and universe files) — the suppression table travels with the
+    facts record."""
+    if not facts:
+        return False
+    entry = facts.get("suppressions", {}).get(str(line), "absent")
+    if entry == "absent":
+        return False
+    return entry is None or rule in entry
+
+
 def run(paths: Sequence[str], root: Optional[str] = None,
         baseline: Optional[Sequence[dict]] = None,
-        rules: Optional[Sequence[str]] = None) -> LintResult:
+        rules: Optional[Sequence[str]] = None,
+        cache_path: Optional[str] = None) -> LintResult:
     """Lint ``paths`` (files or directories) and classify findings.
 
     ``root`` anchors relative paths, README lookup and the read-universe;
     it defaults to the repo root (two levels above this file).  ``rules``
     optionally restricts to a subset of rule ids (for tests).
+    ``cache_path`` enables the content-hash index cache: unchanged files
+    replay their facts and per-file findings from the cache instead of
+    being re-parsed.
     """
+    from . import project as project_mod
     from . import rules as rules_mod
 
     if root is None:
@@ -265,32 +350,109 @@ def run(paths: Sequence[str], root: Optional[str] = None,
             os.path.dirname(os.path.abspath(__file__))))
     root = os.path.abspath(root)
 
+    active = rules_mod.all_rules(only=rules)
+    per_file_rules = [r for r in active if r.per_file]
+    index_rules = [r for r in active
+                   if not r.per_file and getattr(r, "uses_index", False)]
+    legacy_rules = [r for r in active
+                    if not r.per_file and not getattr(r, "uses_index",
+                                                      False)]
+
+    cache = None
+    if cache_path:
+        sig = "|".join(r.id for r in active)
+        cache = project_mod.IndexCache(cache_path, sig)
+    # legacy (non-index) project rules inspect real ParsedFiles, so cache
+    # hits cannot stand in for parses while one is active
+    reuse = cache is not None and not legacy_rules
+
     files: List[ParsedFile] = []
     parse_errors: List[str] = []
     seen: Set[str] = set()
+    linted_rels: List[str] = []
+    facts_by_rel: Dict[str, dict] = {}
+    findings: List[Finding] = []
+
     for target in paths:
         for path in _walk_py(target):
             ap = os.path.abspath(path)
             if ap in seen:
                 continue
             seen.add(ap)
+            rel = os.path.relpath(ap, root).replace(os.sep, "/")
+            if cache is not None:
+                try:
+                    with open(ap, encoding="utf-8") as f:
+                        digest = project_mod.content_hash(f.read())
+                except OSError:
+                    parse_errors.append(rel)
+                    continue
+                entry = cache.lookup(rel, digest) if reuse else None
+                if entry is not None and entry.get("findings") is not None:
+                    linted_rels.append(rel)
+                    facts_by_rel[rel] = entry["facts"]
+                    for fd in entry["findings"]:
+                        findings.append(Finding(
+                            rule=fd["rule"], path=fd["path"],
+                            line=fd["line"], col=fd["col"],
+                            message=fd["message"], snippet=fd["snippet"]))
+                    continue
             pf = parse_file(ap, root)
             if pf is None:
-                parse_errors.append(
-                    os.path.relpath(ap, root).replace(os.sep, "/"))
-            else:
-                files.append(pf)
+                parse_errors.append(rel)
+                continue
+            files.append(pf)
+            linted_rels.append(pf.relpath)
 
     project = Project(root=root, files=files)
-    active = rules_mod.all_rules(only=rules)
 
-    findings: List[Finding] = []
-    for rule in active:
-        if rule.per_file:
-            for pf in files:
-                findings.extend(rule.check(pf))
-        else:
-            findings.extend(rule.check_project(files, project))
+    for pf in files:
+        pf_findings: List[Finding] = []
+        for rule in per_file_rules:
+            pf_findings.extend(rule.check(pf))
+        findings.extend(pf_findings)
+        if cache is not None or index_rules:
+            facts = project_mod.extract_facts(pf)
+            facts_by_rel[pf.relpath] = facts
+            if cache is not None:
+                cache.store(pf.relpath,
+                            project_mod.content_hash(pf.source), facts,
+                            [f.as_dict() for f in pf_findings])
+
+    if index_rules:
+        # the index spans the whole tree, not just the linted paths —
+        # registries and their use sites may live on either side
+        for path in _walk_py(root):
+            ap = os.path.abspath(path)
+            rel = os.path.relpath(ap, root).replace(os.sep, "/")
+            if rel in facts_by_rel:
+                continue
+            try:
+                with open(ap, encoding="utf-8") as f:
+                    digest = project_mod.content_hash(f.read())
+            except OSError:
+                continue
+            entry = cache.lookup(rel, digest) if cache is not None else None
+            if entry is not None:
+                facts_by_rel[rel] = entry["facts"]
+                continue
+            pf = parse_file(ap, root)
+            if pf is None:
+                continue
+            facts = project_mod.extract_facts(pf)
+            facts_by_rel[rel] = facts
+            if cache is not None:
+                cache.store(rel, digest, facts, None)
+        index = project_mod.ProjectIndex(root=root, modules=facts_by_rel,
+                                         readme=project.readme_text())
+        for rule in index_rules:
+            findings.extend(rule.check_index(index, linted_rels, project))
+
+    for rule in legacy_rules:
+        findings.extend(rule.check_project(files, project))
+
+    if cache is not None:
+        cache.save()
 
     by_path = {pf.relpath: pf for pf in files}
     base_index: Dict[Tuple[str, str, str], dict] = {
@@ -298,7 +460,12 @@ def run(paths: Sequence[str], root: Optional[str] = None,
     matched: Set[Tuple[str, str, str]] = set()
     for f in findings:
         pf = by_path.get(f.path)
-        if pf is not None and pf.suppressed(f):
+        if pf is not None:
+            sup = pf.suppressed(f)
+        else:
+            sup = _facts_suppressed(facts_by_rel.get(f.path), f.line,
+                                    f.rule)
+        if sup:
             f.status = "suppressed"
         elif f.fingerprint() in base_index:
             f.status = "baselined"

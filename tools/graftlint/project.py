@@ -1,0 +1,571 @@
+"""Whole-program project index for graftlint.
+
+``extract_facts`` distills one parsed module into a JSON-serializable
+facts record in a single AST pass: the import graph, every string
+constant (GL005's read-universe), the config-knob and ``FAULT_KINDS``
+registries with their use sites (GL005/GL006), ``faultinj.instrument``
+probe registrations and chaos-trial ``match`` patterns (GL020), and a
+per-class symbol table — lock fields, attribute-typed receivers, and
+per-method operation records (acquires with the locks held at that
+point, field reads/writes, self/attr calls, blocking calls) that
+GL017/GL018/GL019 run their compositional RacerD-style lock-domain
+inference over.  Module-level functions ride along as the pseudo-class
+``""`` so module-lock discipline is visible too.
+
+``ProjectIndex`` aggregates the per-module facts; rules never touch an
+AST again — which is what makes the content-hash cache work:
+``IndexCache`` persists ``{relpath: {hash, facts, findings}}`` to
+``.graftlint_index.json`` so a warm run skips both re-parsing and
+re-running per-file rules for unchanged modules.
+
+This module deliberately imports nothing from ``engine``/``rules``
+(facts records are plain dicts, ParsedFile is duck-typed) so the
+package has no import cycles.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+FACTS_VERSION = 1
+
+_GUARDED_RE = re.compile(r"#\s*graftlint:\s*guarded-by\(([^)]*)\)")
+
+# lock-object constructors: ``self._lock = threading.RLock()`` et al.
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+
+# attribute calls that block on a peer: the socket family.  ``.wait``
+# is handled separately (only the timeout-less form blocks unboundedly).
+_SOCKET_METHODS = {"recv", "recv_into", "recvfrom", "recvmsg", "send",
+                   "sendall", "sendmsg", "accept", "connect"}
+
+
+def content_hash(source: str) -> str:
+    return hashlib.sha256(source.encode("utf-8", "replace")).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# small local mirrors of the rules.py alias helpers (no package imports
+# here — see module docstring)
+# ---------------------------------------------------------------------------
+
+
+def _aliases(tree: ast.AST) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    out[a.asname] = a.name
+                else:
+                    top = a.name.split(".")[0]
+                    out[top] = top
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and node.level == 0:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def _resolve(node: ast.AST, aliases: Dict[str, str]) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    base = aliases.get(node.id)
+    if base is None:
+        return None
+    parts.append(base)
+    return ".".join(reversed(parts))
+
+
+def _is_test_rel(relpath: str) -> bool:
+    parts = relpath.split("/")
+    base = parts[-1]
+    return ("tests" in parts[:-1] or base.startswith("test_")
+            or base.startswith("conftest"))
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _site(pf, node: ast.AST) -> Tuple[int, int, str]:
+    line = getattr(node, "lineno", 1)
+    col = getattr(node, "col_offset", 0)
+    return line, col, pf.line_text(line)
+
+
+def _scan_guarded(source: str) -> Dict[str, str]:
+    """Line -> lock name for ``# graftlint: guarded-by(<lock>)``."""
+    out: Dict[str, str] = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _GUARDED_RE.search(text)
+        if m:
+            out[str(i)] = m.group(1).strip()
+    return out
+
+
+def _probe_of(node: ast.Call, aliases: Dict[str, str]):
+    """``faultinj.instrument(fn, "<name>")`` -> (name_or_None, prefix)."""
+    func = node.func
+    is_instr = (isinstance(func, ast.Attribute)
+                and func.attr == "instrument")
+    if isinstance(func, ast.Name):
+        is_instr = aliases.get(func.id, "").endswith("faultinj.instrument")
+    if not is_instr:
+        return None
+    arg: Optional[ast.AST] = None
+    if len(node.args) >= 2:
+        arg = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "name":
+            arg = kw.value
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value, None
+    if isinstance(arg, ast.JoinedStr):
+        # dynamic name (f"net_send_{role}"): record the literal prefix so
+        # GL020 can still relate it to the trial tables
+        prefix = ""
+        for part in arg.values:
+            if isinstance(part, ast.Constant) and isinstance(part.value, str):
+                prefix += part.value
+            else:
+                break
+        if prefix:
+            return None, prefix
+    return None
+
+
+def _lock_ctor(value: ast.AST) -> bool:
+    return (isinstance(value, ast.Call)
+            and isinstance(value.func, (ast.Name, ast.Attribute))
+            and (value.func.attr if isinstance(value.func, ast.Attribute)
+                 else value.func.id) in _LOCK_CTORS)
+
+
+def _ctor_class_name(value: ast.AST) -> Optional[str]:
+    """Simple class name if ``value`` contains a ``Foo(...)``-shaped call
+    (covers ``Foo(...)``, ``mod.Foo(...)``, ``Foo(...) if c else None``)."""
+    for node in ast.walk(value):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if name and name[:1].isupper():
+            return name
+    return None
+
+
+class _MethodScan:
+    """One method body, walked with the lexically-held lock stack.
+
+    Lock tokens: a bare attr name for ``with self.<attr>:`` on a known
+    class lock field, ``"::<name>"`` for ``with <name>:`` on a
+    module-level lock.  Nested function/lambda bodies are NOT descended
+    into — a closure defined under a lock does not run under it.
+    """
+
+    def __init__(self, pf, aliases, class_locks, module_locks):
+        self.pf = pf
+        self.aliases = aliases
+        self.class_locks = class_locks
+        self.module_locks = module_locks
+        self.acquires: List[list] = []
+        self.reads: List[list] = []
+        self.writes: List[list] = []
+        self.blocking: List[list] = []
+        self.calls: List[list] = []
+
+    def _lock_token(self, expr: ast.AST) -> Optional[str]:
+        attr = _self_attr(expr)
+        if attr is not None and attr in self.class_locks:
+            return attr
+        if isinstance(expr, ast.Name) and expr.id in self.module_locks:
+            return "::" + expr.id
+        return None
+
+    def _blocking_desc(self, node: ast.Call) -> Optional[str]:
+        dotted = _resolve(node.func, self.aliases)
+        if dotted == "time.sleep":
+            return "time.sleep"
+        if dotted and (dotted == "subprocess"
+                       or dotted.startswith("subprocess.")):
+            return dotted
+        fname = node.func.attr if isinstance(node.func, ast.Attribute) \
+            else (node.func.id if isinstance(node.func, ast.Name) else "")
+        if fname == "run_with_retry":
+            return "run_with_retry"
+        if isinstance(node.func, ast.Attribute):
+            if fname in _SOCKET_METHODS:
+                return f".{fname}()"
+            if fname == "wait" and not node.args and not any(
+                    kw.arg == "timeout" for kw in node.keywords):
+                return ".wait() with no timeout"
+        return None
+
+    def scan(self, fn: ast.AST) -> dict:
+        for stmt in fn.body:
+            self._visit(stmt, ())
+        return {"acquires": self.acquires, "reads": self.reads,
+                "writes": self.writes, "blocking": self.blocking,
+                "calls": self.calls}
+
+    def _visit(self, node: ast.AST, held: tuple):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new_held = held
+            for item in node.items:
+                self._visit(item.context_expr, held)
+                tok = self._lock_token(item.context_expr)
+                if tok is not None:
+                    self.acquires.append(
+                        [tok, list(held)] + list(_site(self.pf, node)))
+                    if tok not in new_held:
+                        new_held = new_held + (tok,)
+            for stmt in node.body:
+                self._visit(stmt, new_held)
+            return
+        if isinstance(node, ast.Call):
+            desc = self._blocking_desc(node)
+            if desc is not None:
+                self.blocking.append(
+                    [desc, list(held)] + list(_site(self.pf, node)))
+            attr = _self_attr(node.func)
+            if attr is not None:
+                self.calls.append(
+                    ["self", attr, "", list(held)]
+                    + list(_site(self.pf, node)))
+            elif isinstance(node.func, ast.Attribute):
+                recv = _self_attr(node.func.value)
+                if recv is not None:
+                    self.calls.append(
+                        ["attr", recv, node.func.attr, list(held)]
+                        + list(_site(self.pf, node)))
+        if isinstance(node, ast.Attribute):
+            field = _self_attr(node)
+            if field is not None:
+                kind = self.writes if isinstance(
+                    node.ctx, (ast.Store, ast.Del)) else self.reads
+                kind.append(
+                    [field, list(held)] + list(_site(self.pf, node)))
+        if isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            field = _self_attr(node.value)
+            if field is not None:
+                self.writes.append(
+                    [field, list(held)] + list(_site(self.pf, node)))
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, held)
+
+
+def _class_facts(pf, cls: ast.ClassDef, aliases, module_locks) -> dict:
+    methods = [n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    locks: List[str] = []
+    attr_types: Dict[str, str] = {}
+    thread_targets: List[str] = []
+    for fn in methods:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = _self_attr(node.targets[0])
+                if tgt is None:
+                    continue
+                if _lock_ctor(node.value):
+                    if tgt not in locks:
+                        locks.append(tgt)
+                else:
+                    cname = _ctor_class_name(node.value)
+                    if cname is not None:
+                        attr_types.setdefault(tgt, cname)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                tgt = _self_attr(node.target)
+                if tgt is None:
+                    continue
+                if _lock_ctor(node.value):
+                    if tgt not in locks:
+                        locks.append(tgt)
+                else:
+                    cname = _ctor_class_name(node.value)
+                    if cname is not None:
+                        attr_types.setdefault(tgt, cname)
+            elif isinstance(node, ast.Call):
+                fname = node.func.attr \
+                    if isinstance(node.func, ast.Attribute) else (
+                        node.func.id
+                        if isinstance(node.func, ast.Name) else "")
+                if fname == "Thread" or fname == "Timer":
+                    cands: List[ast.AST] = []
+                    for kw in node.keywords:
+                        if kw.arg in ("target", "function"):
+                            cands.append(kw.value)
+                    if fname == "Timer" and len(node.args) >= 2:
+                        cands.append(node.args[1])
+                    for cand in cands:
+                        m = _self_attr(cand)
+                        if m is not None and m not in thread_targets:
+                            thread_targets.append(m)
+    out_methods: Dict[str, dict] = {}
+    for fn in methods:
+        scan = _MethodScan(pf, aliases, set(locks), module_locks)
+        out_methods[fn.name] = scan.scan(fn)
+    return {"locks": locks, "attr_types": attr_types,
+            "thread_targets": thread_targets, "methods": out_methods}
+
+
+def extract_facts(pf) -> dict:
+    """Distill one ParsedFile into the serializable facts record."""
+    tree = pf.tree
+    aliases = _aliases(tree)
+    strings: List[str] = []
+    config_keys: List[list] = []
+    fault_registry: List[list] = []
+    fault_uses: List[list] = []
+    probes: List[list] = []
+    probe_prefixes: List[list] = []
+    trial_matches: List[list] = []
+    imported: List[str] = []
+
+    module_locks = {
+        node.targets[0].id
+        for node in tree.body
+        if isinstance(node, ast.Assign) and len(node.targets) == 1
+        and isinstance(node.targets[0], ast.Name)
+        and _lock_ctor(node.value)}
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            strings.append(node.value)
+        elif isinstance(node, ast.Import):
+            imported.extend(a.name for a in node.names)
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            imported.append(node.module)
+        elif isinstance(node, ast.Assign):
+            if (len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "FAULT_KINDS"
+                    and isinstance(node.value, ast.Dict)):
+                for k in node.value.keys:
+                    if isinstance(k, ast.Constant) \
+                            and isinstance(k.value, str):
+                        fault_registry.append(
+                            [k.value] + list(_site(pf, k)))
+        elif isinstance(node, ast.For):
+            # trial tables batch-register probes through loops:
+            #   for match in ("worker_recv", ...): one(scn, match, kind)
+            if (isinstance(node.target, ast.Name)
+                    and isinstance(node.iter, (ast.Tuple, ast.List))
+                    and node.iter.elts
+                    and all(isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)
+                            for e in node.iter.elts)):
+                var = node.target.id
+                feeds = any(
+                    isinstance(c, ast.Call)
+                    and isinstance(c.func, ast.Name)
+                    and c.func.id == "one" and len(c.args) >= 2
+                    and isinstance(c.args[1], ast.Name)
+                    and c.args[1].id == var
+                    for b in node.body for c in ast.walk(b))
+                if feeds:
+                    for e in node.iter.elts:
+                        trial_matches.append(
+                            [e.value] + list(_site(pf, e)))
+        elif isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                if not (isinstance(k, ast.Constant)
+                        and isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)):
+                    continue
+                if k.value == "fault":
+                    fault_uses.append([v.value] + list(_site(pf, v)))
+                elif k.value == "match":
+                    trial_matches.append([v.value] + list(_site(pf, v)))
+        elif isinstance(node, ast.Call):
+            probe = _probe_of(node, aliases)
+            if probe is not None:
+                name, prefix = probe
+                if name is not None:
+                    probes.append([name] + list(_site(pf, node)))
+                else:
+                    probe_prefixes.append([prefix] + list(_site(pf, node)))
+            if (isinstance(node.func, ast.Name)
+                    and node.func.id == "_register"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                config_keys.append(
+                    [node.args[0].value] + list(_site(pf, node)))
+            elif (isinstance(node.func, ast.Name) and node.func.id == "one"
+                    and len(node.args) >= 2
+                    and isinstance(node.args[1], ast.Constant)
+                    and isinstance(node.args[1].value, str)):
+                # the chaos trial-table helper: one(scenario, match, kind)
+                trial_matches.append(
+                    [node.args[1].value] + list(_site(pf, node.args[1])))
+
+    classes: Dict[str, dict] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name not in classes:
+            classes[node.name] = _class_facts(pf, node, aliases,
+                                              module_locks)
+    # module-level functions ride as pseudo-class "" (module-lock
+    # discipline for GL019)
+    mod_methods: Dict[str, dict] = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scan = _MethodScan(pf, aliases, set(), module_locks)
+            mod_methods[node.name] = scan.scan(node)
+    if mod_methods:
+        classes[""] = {"locks": [], "attr_types": {},
+                       "thread_targets": [], "methods": mod_methods}
+
+    return {
+        "version": FACTS_VERSION,
+        "is_test": _is_test_rel(pf.relpath),
+        "imports": aliases,
+        "imported_modules": sorted(set(imported)),
+        "module_locks": sorted(module_locks),
+        "strings": strings,
+        "config_keys": config_keys,
+        "fault_registry": fault_registry,
+        "fault_uses": fault_uses,
+        "probes": probes,
+        "probe_prefixes": probe_prefixes,
+        "trial_matches": trial_matches,
+        "classes": classes,
+        "suppressions": {str(line): (sorted(rules) if rules is not None
+                                     else None)
+                         for line, rules in pf.suppressions.items()},
+        "guarded": _scan_guarded(pf.source),
+    }
+
+
+class ProjectIndex:
+    """The aggregated whole-program view handed to ProjectRules."""
+
+    def __init__(self, root: str, modules: Dict[str, dict],
+                 readme: str = ""):
+        self.root = root
+        self.modules = modules
+        self.readme = readme
+        self._class_map: Optional[Dict[str, List[Tuple[str, str]]]] = None
+
+    def iter_modules(self, include_tests: bool = True
+                     ) -> Iterator[Tuple[str, dict]]:
+        for rel in sorted(self.modules):
+            facts = self.modules[rel]
+            if not include_tests and facts.get("is_test"):
+                continue
+            yield rel, facts
+
+    def iter_classes(self, include_tests: bool = True
+                     ) -> Iterator[Tuple[str, str, dict]]:
+        for rel, facts in self.iter_modules(include_tests):
+            for cname in sorted(facts.get("classes", {})):
+                yield rel, cname, facts["classes"][cname]
+
+    def class_map(self) -> Dict[str, List[Tuple[str, str]]]:
+        """Simple class name -> [(relpath, class name)] across the tree
+        (test modules excluded: cross-class lock edges target production
+        receivers)."""
+        if self._class_map is None:
+            cmap: Dict[str, List[Tuple[str, str]]] = {}
+            for rel, cname, _cf in self.iter_classes(include_tests=False):
+                if cname:
+                    cmap.setdefault(cname, []).append((rel, cname))
+            self._class_map = cmap
+        return self._class_map
+
+    def resolve_attr_class(self, rel: str, cname: str
+                           ) -> Optional[Tuple[str, str, dict]]:
+        """(relpath, class, facts) for class ``cname`` as seen from
+        module ``rel``: same module first, then an imported/unique one."""
+        facts = self.modules.get(rel, {})
+        if cname in facts.get("classes", {}):
+            return rel, cname, facts["classes"][cname]
+        cands = self.class_map().get(cname, [])
+        if len(cands) == 1:
+            crel, cn = cands[0]
+            return crel, cn, self.modules[crel]["classes"][cn]
+        return None
+
+    def suppressed_at(self, rel: str, line: int, rule: str) -> bool:
+        sup = self.modules.get(rel, {}).get("suppressions", {})
+        entry = sup.get(str(line), "absent")
+        if entry == "absent":
+            return False
+        return entry is None or rule in entry
+
+    def guarded_at(self, rel: str, line: int) -> Optional[str]:
+        return self.modules.get(rel, {}).get("guarded", {}).get(str(line))
+
+
+class IndexCache:
+    """Content-hash cache behind ``.graftlint_index.json``.
+
+    Entries carry the per-module facts and (for linted files) the raw
+    per-file-rule findings, keyed on a sha256 of the source — an edited
+    file misses and is re-parsed; an unchanged one costs one hash.  The
+    file is rewritten each run with only the entries the run touched, so
+    deletions age out.  ``rules_sig`` invalidates everything when the
+    rule set itself changes.
+    """
+
+    def __init__(self, path: str, rules_sig: str):
+        self.path = path
+        self.rules_sig = rules_sig
+        self._old: Dict[str, dict] = {}
+        self._new: Dict[str, dict] = {}
+        self.hits = 0
+        self.misses = 0
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+            if (doc.get("version") == FACTS_VERSION
+                    and doc.get("rules_sig") == rules_sig):
+                self._old = dict(doc.get("files", {}))
+        except (OSError, ValueError):
+            self._old = {}
+
+    def lookup(self, relpath: str, digest: str) -> Optional[dict]:
+        entry = self._old.get(relpath)
+        if entry is not None and entry.get("hash") == digest:
+            self._new[relpath] = entry
+            self.hits += 1
+            return entry
+        self.misses += 1
+        return None
+
+    def store(self, relpath: str, digest: str, facts: dict,
+              findings: Optional[List[dict]]) -> None:
+        self._new[relpath] = {"hash": digest, "facts": facts,
+                              "findings": findings}
+
+    def save(self) -> None:
+        doc = {"version": FACTS_VERSION, "rules_sig": self.rules_sig,
+               "files": self._new}
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(doc, f, separators=(",", ":"))
+            os.replace(tmp, self.path)
+        except OSError:
+            pass

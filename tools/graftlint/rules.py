@@ -5,9 +5,12 @@ rationale with the motivating incident per rule."""
 from __future__ import annotations
 
 import ast
+import fnmatch
+import re
+from collections import deque
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
 
-from .engine import Finding, ParsedFile, Project
+from .engine import Finding, ParsedFile, Project, ProjectRule
 
 # ---------------------------------------------------------------------------
 # shared AST helpers
@@ -511,56 +514,50 @@ def _name_escapes(fn, assign_node, var: str,
 # ---------------------------------------------------------------------------
 
 
-class GL005ConfigDrift(Rule):
+def _site_finding(rule_id: str, relpath: str, site: Sequence,
+                  message: str) -> Finding:
+    """Finding from a facts site triple ``[line, col, snippet]`` — the
+    index stores real positions precisely so baselines/suppressions see
+    the same fingerprints the AST path produced."""
+    line, col, snippet = site
+    return Finding(rule=rule_id, path=relpath, line=line, col=col,
+                   message=message, snippet=snippet)
+
+
+class GL005ConfigDrift(ProjectRule):
     """Every knob registered in ``config.py`` must be (a) documented in
     README.md and (b) read somewhere outside ``config.py`` — PR 2 left
     ``bench_rows`` registered after the bench stopped reading it, and
     nothing noticed.  Dead knobs are worse than no knobs: operators tune
-    them and see no effect."""
+    them and see no effect.  Computed over the project index: the
+    read-universe is every string constant in the tree."""
 
     id = "GL005"
-    per_file = False
 
-    def check_project(self, files, project) -> Iterable[Finding]:
-        cfg = next((pf for pf in files
-                    if pf.relpath.endswith("config.py")
-                    and self._register_calls(pf)), None)
+    def check_index(self, index, linted, project) -> Iterable[Finding]:
+        cfg = next((rel for rel in linted
+                    if rel.endswith("config.py")
+                    and index.modules.get(rel, {}).get("config_keys")),
+                   None)
         if cfg is None:
             return
-        keys = self._register_calls(cfg)
-        readme = project.readme_text()
+        readme = index.readme
         read_strings: Set[str] = set()
-        for pf in project.universe():
-            if pf.path == cfg.path:
+        for rel, facts in index.iter_modules():
+            if rel == cfg:
                 continue
-            for node in ast.walk(pf.tree):
-                if (isinstance(node, ast.Constant)
-                        and isinstance(node.value, str)):
-                    read_strings.add(node.value)
-        for key, node in keys:
+            read_strings.update(facts.get("strings", ()))
+        for key, *site in index.modules[cfg]["config_keys"]:
             if key not in readme:
-                yield cfg.finding(
-                    self.id, node,
+                yield _site_finding(
+                    self.id, cfg, site,
                     f"config knob `{key}` is not documented in README.md")
             if key not in read_strings:
-                yield cfg.finding(
-                    self.id, node,
+                yield _site_finding(
+                    self.id, cfg, site,
                     f"config knob `{key}` is registered but never read "
                     "outside config.py — dead knob (tune it and nothing "
                     "changes)")
-
-    @staticmethod
-    def _register_calls(pf) -> List[Tuple[str, ast.AST]]:
-        out = []
-        for node in ast.walk(pf.tree):
-            if (isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Name)
-                    and node.func.id == "_register"
-                    and node.args
-                    and isinstance(node.args[0], ast.Constant)
-                    and isinstance(node.args[0].value, str)):
-                out.append((node.args[0].value, node))
-        return out
 
 
 # ---------------------------------------------------------------------------
@@ -568,71 +565,56 @@ class GL005ConfigDrift(Rule):
 # ---------------------------------------------------------------------------
 
 
-class GL006FaultKindDrift(Rule):
+class GL006FaultKindDrift(ProjectRule):
     """``faultinj.FAULT_KINDS`` is the registry of injectable fault
     flavors.  A config dict naming a kind that isn't registered fails
     only when its rule first *fires* (``_Rule`` raises at configure
     time, but only if that code path runs); a registered kind no test
     ever injects is untested error handling.  Both directions drift
-    silently, so both are checked statically."""
+    silently, so both are checked statically.
+
+    Since PR 18 this is a thin wrapper over the project index: the
+    registry and every dict-literal ``"fault": "<kind>"`` use site are
+    extracted once by ``project.extract_facts`` (the same pass GL020
+    reads its probe/trial tables from), keeping one source of truth and
+    the old per-file string-scan retired.  Messages and anchor lines are
+    unchanged, so baseline fingerprints stay stable."""
 
     id = "GL006"
-    per_file = False
 
-    def check_project(self, files, project) -> Iterable[Finding]:
-        finj = next((pf for pf in project.universe()
-                     if pf.relpath.endswith("faultinj.py")
-                     and self._registry(pf)), None)
+    def check_index(self, index, linted, project) -> Iterable[Finding]:
+        finj = next(
+            (rel for rel in list(linted) + sorted(index.modules)
+             if rel.endswith("faultinj.py")
+             and index.modules.get(rel, {}).get("fault_registry")),
+            None)
         if finj is None:
             return
-        registry = self._registry(finj)
-        known = {k for k, _ in registry}
+        registry = index.modules[finj]["fault_registry"]
+        known = {k for k, *_ in registry}
         used: Set[str] = set()
-        for pf in project.universe():
-            if pf.path == finj.path:
+        for rel, facts in index.iter_modules():
+            if rel == finj:
                 continue
-            for kind, _node in self._uses(pf):
-                used.add(kind)
-        for pf in files:
-            for kind, node in self._uses(pf):
+            used.update(k for k, *_ in facts.get("fault_uses", ()))
+        for rel in linted:
+            facts = index.modules.get(rel)
+            if facts is None:
+                continue
+            for kind, *site in facts["fault_uses"]:
                 if kind not in known:
-                    yield pf.finding(
-                        self.id, node,
+                    yield _site_finding(
+                        self.id, rel, site,
                         f"fault kind `{kind}` is not in "
                         "faultinj.FAULT_KINDS — this rule can never fire "
                         f"(known: {sorted(known)})")
-        for kind, node in registry:
+        for kind, *site in registry:
             if kind not in used:
-                yield finj.finding(
-                    self.id, node,
+                yield _site_finding(
+                    self.id, finj, site,
                     f"fault kind `{kind}` is registered in FAULT_KINDS but "
                     "never injected anywhere in the linted tree — "
                     "untested fault-handling path")
-
-    @staticmethod
-    def _registry(pf) -> List[Tuple[str, ast.AST]]:
-        for node in ast.walk(pf.tree):
-            if (isinstance(node, ast.Assign)
-                    and len(node.targets) == 1
-                    and isinstance(node.targets[0], ast.Name)
-                    and node.targets[0].id == "FAULT_KINDS"
-                    and isinstance(node.value, ast.Dict)):
-                return [(k.value, k) for k in node.value.keys
-                        if isinstance(k, ast.Constant)
-                        and isinstance(k.value, str)]
-        return []
-
-    @staticmethod
-    def _uses(pf) -> Iterator[Tuple[str, ast.AST]]:
-        """Dict literals carrying ``"fault": "<kind>"``."""
-        for node in ast.walk(pf.tree):
-            if not isinstance(node, ast.Dict):
-                continue
-            for k, v in zip(node.keys, node.values):
-                if (isinstance(k, ast.Constant) and k.value == "fault"
-                        and isinstance(v, ast.Constant)
-                        and isinstance(v.value, str)):
-                    yield v.value, v
 
 
 # ---------------------------------------------------------------------------
@@ -1665,6 +1647,398 @@ class GL016LauncherHandleLeak(Rule):
                         "unreapable from this scope")
 
 
+# ---------------------------------------------------------------------------
+# GL017 — lock-order cycle (whole-program, RacerD-style lock domains)
+# ---------------------------------------------------------------------------
+
+
+def _lock_node(rel: str, cls: str, tok: str) -> Tuple[str, str, str]:
+    """Identity of a lock in the global order graph.  Module locks
+    (``::name`` tokens) belong to the module, not the class scanning
+    them."""
+    if tok.startswith("::"):
+        return (rel, "", tok)
+    return (rel, cls, tok)
+
+
+def _fmt_lock(node: Tuple[str, str, str]) -> str:
+    rel, cls, tok = node
+    base = rel.rsplit("/", 1)[-1]
+    if tok.startswith("::"):
+        return f"{base}:{tok[2:]}"
+    return f"{base}:{cls}.{tok}"
+
+
+class GL017LockOrderCycle(ProjectRule):
+    """Two threads acquiring the same locks in opposite orders deadlock
+    the first time their critical sections overlap — the PR-9 BUFN
+    incident class (FrontDoor holding its lock while calling into a
+    component whose method takes its own lock and calls back).  Per
+    class, the index records which locks each method acquires and which
+    it acquires *while already holding* another (including transitively
+    through self-method and attribute-typed receiver calls); any cycle
+    in the resulting global lock-order graph is a finding.  Reentrant
+    self-edges (RLock re-acquisition) are not cycles."""
+
+    id = "GL017"
+
+    def check_index(self, index, linted, project) -> Iterable[Finding]:
+        edges: Dict[Tuple[Tuple[str, str, str], Tuple[str, str, str]],
+                    Tuple[str, int, int, str]] = {}
+        memo: Dict[Tuple[str, str, str], Set[Tuple[str, str, str]]] = {}
+
+        def method_facts(rel: str, cls: str, name: str) -> Optional[dict]:
+            return (index.modules.get(rel, {}).get("classes", {})
+                    .get(cls, {}).get("methods", {}).get(name))
+
+        def eff_acquires(rel, cls, mname, stack):
+            """Every lock node a call to (rel, cls, mname) may acquire,
+            transitively (compositional summary, memoized)."""
+            key = (rel, cls, mname)
+            if key in memo:
+                return memo[key]
+            if key in stack:
+                return set()
+            mf = method_facts(rel, cls, mname)
+            if mf is None:
+                memo[key] = set()
+                return memo[key]
+            out: Set[Tuple[str, str, str]] = set()
+            for tok, *_rest in mf["acquires"]:
+                out.add(_lock_node(rel, cls, tok))
+            cf = index.modules[rel]["classes"][cls]
+            for kind, recv, meth, _held, *_site in mf["calls"]:
+                if kind == "self":
+                    out |= eff_acquires(rel, cls, recv, stack | {key})
+                else:
+                    ctype = cf["attr_types"].get(recv)
+                    if ctype:
+                        hit = index.resolve_attr_class(rel, ctype)
+                        if hit is not None:
+                            out |= eff_acquires(hit[0], hit[1], meth,
+                                                stack | {key})
+            memo[key] = out
+            return out
+
+        def add_edge(src, dst, rel, site):
+            if src == dst:
+                return
+            key = (src, dst)
+            at = (rel, site[0], site[1], site[2])
+            if key not in edges or at < edges[key]:
+                edges[key] = at
+
+        for rel, cls, cf in index.iter_classes(include_tests=False):
+            for mname in sorted(cf["methods"]):
+                mf = cf["methods"][mname]
+                for tok, held, *site in mf["acquires"]:
+                    dst = _lock_node(rel, cls, tok)
+                    for h in held:
+                        add_edge(_lock_node(rel, cls, h), dst, rel, site)
+                for kind, recv, meth, held, *site in mf["calls"]:
+                    if not held:
+                        continue
+                    if kind == "self":
+                        targets = eff_acquires(rel, cls, recv, frozenset())
+                    else:
+                        ctype = cf["attr_types"].get(recv)
+                        targets = set()
+                        if ctype:
+                            hit = index.resolve_attr_class(rel, ctype)
+                            if hit is not None:
+                                targets = eff_acquires(hit[0], hit[1],
+                                                       meth, frozenset())
+                    for h in held:
+                        src = _lock_node(rel, cls, h)
+                        for dst in targets:
+                            add_edge(src, dst, rel, site)
+
+        # Tarjan SCCs over the lock graph; any SCC of ≥2 locks is a cycle
+        graph: Dict[Tuple[str, str, str], List] = {}
+        for (src, dst) in edges:
+            graph.setdefault(src, []).append(dst)
+            graph.setdefault(dst, [])
+        idx: Dict[Tuple, int] = {}
+        low: Dict[Tuple, int] = {}
+        on: Set[Tuple] = set()
+        stack: List[Tuple] = []
+        sccs: List[List[Tuple]] = []
+        counter = [0]
+
+        def strongconnect(v):
+            work = [(v, iter(sorted(graph[v])))]
+            idx[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in idx:
+                        idx[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on.add(w)
+                        work.append((w, iter(sorted(graph[w]))))
+                        advanced = True
+                        break
+                    if w in on:
+                        low[node] = min(low[node], idx[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    low[work[-1][0]] = min(low[work[-1][0]], low[node])
+                if low[node] == idx[node]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        on.discard(w)
+                        scc.append(w)
+                        if w == node:
+                            break
+                    sccs.append(scc)
+
+        for v in sorted(graph):
+            if v not in idx:
+                strongconnect(v)
+
+        for scc in sccs:
+            if len(scc) < 2:
+                continue
+            members = set(scc)
+            in_cycle = sorted(
+                (at, src, dst) for (src, dst), at in edges.items()
+                if src in members and dst in members)
+            if not in_cycle:
+                continue
+            at, src, dst = in_cycle[0]
+            names = " ↔ ".join(_fmt_lock(n) for n in sorted(members))
+            yield Finding(
+                rule=self.id, path=at[0], line=at[1], col=at[2],
+                message=(f"lock-order cycle: {names} — acquiring "
+                         f"`{_fmt_lock(dst)}` while holding "
+                         f"`{_fmt_lock(src)}` here closes the cycle; two "
+                         "threads taking these locks in opposite orders "
+                         "deadlock (the PR-9 BUFN class). Pick one global "
+                         "order or hand off outside the lock."),
+                snippet=at[3])
+
+
+# ---------------------------------------------------------------------------
+# GL018 — unguarded shared field
+# ---------------------------------------------------------------------------
+
+
+class GL018UnguardedSharedField(ProjectRule):
+    """A field written under ``with self._lock`` in one method is a
+    declaration: this state is shared and the lock is its guard.
+    Reading or writing it lock-free from any method reachable from a
+    thread entry point (``threading.Thread(target=...)``, ``Timer``
+    callbacks, public API methods callers hit from their own threads)
+    is a data race — torn reads of dicts mid-resize, lost updates on
+    counters.  Provably-benign races (monotonic flags read on a fast
+    path) get an explicit ``# graftlint: guarded-by(<lockname>)``
+    annotation on the access line.  Double-checked locking (the same
+    method re-checks under the lock) is recognized and not flagged."""
+
+    id = "GL018"
+
+    def check_index(self, index, linted, project) -> Iterable[Finding]:
+        linted_set = set(linted)
+        for rel, cls, cf in index.iter_classes(include_tests=False):
+            if rel not in linted_set or not cls:
+                continue
+            if not cf["locks"] or not cf["thread_targets"]:
+                continue
+            methods = cf["methods"]
+            # guard inference: lock(s) held at each non-__init__ write
+            guards: Dict[str, Set[str]] = {}
+            guarded_writers: Dict[str, Set[str]] = {}
+            for mname, mf in methods.items():
+                if mname == "__init__":
+                    continue
+                for fieldname, held, *_site in mf["writes"]:
+                    held_locks = {h for h in held if h in cf["locks"]}
+                    if held_locks:
+                        guards.setdefault(fieldname, set()).update(
+                            held_locks)
+                        guarded_writers.setdefault(fieldname,
+                                                   set()).add(mname)
+            if not guards:
+                continue
+            # reachability: thread entries + public methods, propagating
+            # the held-lock context through self-calls
+            entries = list(cf["thread_targets"]) + sorted(
+                m for m in methods if not m.startswith("_"))
+            states: Dict[Tuple[str, frozenset], str] = {}
+            queue: deque = deque()
+            for e in entries:
+                if e in methods and (e, frozenset()) not in states:
+                    states[(e, frozenset())] = e
+                    queue.append((e, frozenset()))
+            while queue:
+                mname, held = queue.popleft()
+                for kind, recv, _meth, site_held, *_s in \
+                        methods[mname]["calls"]:
+                    if kind != "self" or recv not in methods:
+                        continue
+                    nh = held | frozenset(site_held)
+                    if (recv, nh) not in states:
+                        states[(recv, nh)] = states[(mname, held)]
+                        queue.append((recv, nh))
+            flagged: Set[Tuple[str, str]] = set()
+            out: List[Tuple[int, int, Finding]] = []
+            for (mname, held), entry in states.items():
+                if mname == "__init__":
+                    continue
+                mf = methods[mname]
+                for fieldname, site_held, line, col, snippet in (
+                        mf["reads"] + mf["writes"]):
+                    guard = guards.get(fieldname)
+                    if not guard:
+                        continue
+                    if (held | frozenset(site_held)) & guard:
+                        continue
+                    if mname in guarded_writers.get(fieldname, ()):
+                        continue        # double-checked locking idiom
+                    if index.guarded_at(rel, line) is not None:
+                        continue
+                    if (fieldname, mname) in flagged:
+                        continue
+                    flagged.add((fieldname, mname))
+                    lock = sorted(guard)[0]
+                    out.append((line, col, Finding(
+                        rule=self.id, path=rel, line=line, col=col,
+                        message=(
+                            f"field `self.{fieldname}` is written under "
+                            f"`self.{lock}` (in "
+                            f"{', '.join(sorted(guarded_writers[fieldname]))}"
+                            f") but accessed lock-free in `{mname}`, "
+                            f"reachable from thread entry `{entry}` — "
+                            "data race; hold the lock, or annotate the "
+                            "access `# graftlint: "
+                            f"guarded-by({lock})` if provably benign"),
+                        snippet=snippet)))
+            for _line, _col, f in sorted(out, key=lambda t: (t[0], t[1])):
+                yield f
+
+
+# ---------------------------------------------------------------------------
+# GL019 — blocking call while holding a lock
+# ---------------------------------------------------------------------------
+
+
+class GL019BlockingWhileHolding(ProjectRule):
+    """A blocking call inside a critical section turns one slow peer
+    into a fleet-wide stall: every thread contending for the lock wedges
+    behind a socket recv/send, ``subprocess`` spawn, ``time.sleep``,
+    timeout-less ``Condition.wait``, or ``run_with_retry`` ladder — the
+    wedged-watchdog class PR 10's stall breaker exists to mitigate.
+    Lexical by design: the finding is exactly the ``with`` region the
+    fix shrinks (capture under the lock, do the slow I/O after)."""
+
+    id = "GL019"
+
+    def check_index(self, index, linted, project) -> Iterable[Finding]:
+        for rel in linted:
+            facts = index.modules.get(rel)
+            if not facts or facts.get("is_test"):
+                continue
+            for cls in sorted(facts["classes"]):
+                for mname in sorted(facts["classes"][cls]["methods"]):
+                    mf = facts["classes"][cls]["methods"][mname]
+                    for desc, held, line, col, snippet in mf["blocking"]:
+                        if not held:
+                            continue
+                        inner = held[-1]
+                        disp = (inner[2:] if inner.startswith("::")
+                                else f"self.{inner}")
+                        yield Finding(
+                            rule=self.id, path=rel, line=line, col=col,
+                            message=(
+                                f"blocking call `{desc}` inside "
+                                f"`with {disp}:` — one stalled peer "
+                                "wedges every thread contending for the "
+                                "lock (the PR-10 stall-breaker class); "
+                                "capture state under the lock and do the "
+                                "blocking work after release"),
+                            snippet=snippet)
+
+
+# ---------------------------------------------------------------------------
+# GL020 — probe-reachability drift (chaos blind spots)
+# ---------------------------------------------------------------------------
+
+
+_GLOB_SPLIT_RE = re.compile(r"[*?\[]")
+
+
+class GL020ProbeReachabilityDrift(ProjectRule):
+    """Every ``faultinj.instrument`` probe must be reachable from at
+    least one chaos scenario's trial table, and every trial ``match``
+    pattern must reach at least one probe.  An unreachable probe is a
+    chaos blind spot (the recovery path it guards is never exercised);
+    an unmatched pattern is a trial that silently never fires — both
+    directions drifted under GL006's old per-file string scan, which
+    could not see the trial tables and the probe sites at once.
+    Dynamic probe names (``f"net_send_{role}"``) are related to
+    patterns by literal prefix."""
+
+    id = "GL020"
+
+    def check_index(self, index, linted, project) -> Iterable[Finding]:
+        probes: List[Tuple[str, str, list]] = []
+        prefixes: List[Tuple[str, str, list]] = []
+        patterns: List[Tuple[str, str, list]] = []
+        for rel, facts in index.iter_modules(include_tests=False):
+            for name, *site in facts.get("probes", ()):
+                probes.append((name, rel, site))
+            for pre, *site in facts.get("probe_prefixes", ()):
+                prefixes.append((pre, rel, site))
+            for pat, *site in facts.get("trial_matches", ()):
+                patterns.append((pat, rel, site))
+        if not patterns or not (probes or prefixes):
+            return
+
+        pat_names = [p for p, _r, _s in patterns]
+        probe_names = [p for p, _r, _s in probes]
+        prefix_names = [p for p, _r, _s in prefixes]
+
+        def prefix_related(pattern: str, prefix: str) -> bool:
+            literal = _GLOB_SPLIT_RE.split(pattern)[0]
+            return (literal.startswith(prefix)
+                    or prefix.startswith(literal))
+
+        for name, rel, site in probes:
+            if any(fnmatch.fnmatchcase(name, p) for p in pat_names):
+                continue
+            yield _site_finding(
+                self.id, rel, site,
+                f"faultinj probe `{name}` is reachable from no chaos "
+                "scenario trial table — chaos blind spot: the recovery "
+                "path behind it is never exercised")
+        for pre, rel, site in prefixes:
+            if any(prefix_related(p, pre) for p in pat_names):
+                continue
+            yield _site_finding(
+                self.id, rel, site,
+                f"dynamic faultinj probe `{pre}*` is reachable from no "
+                "chaos scenario trial table — chaos blind spot: the "
+                "recovery path behind it is never exercised")
+        for pat, rel, site in patterns:
+            if any(fnmatch.fnmatchcase(name, pat)
+                   for name in probe_names):
+                continue
+            if any(prefix_related(pat, pre) for pre in prefix_names):
+                continue
+            yield _site_finding(
+                self.id, rel, site,
+                f"chaos trial pattern `{pat}` matches no faultinj probe "
+                "in the tree — this trial can never fire")
+
+
 _ALL: List[Rule] = [GL001TracerLeak(), GL002HostSyncUnderJit(),
                     GL003RetraceHazard(), GL004SpillHandleLeak(),
                     GL005ConfigDrift(), GL006FaultKindDrift(),
@@ -1676,7 +2050,11 @@ _ALL: List[Rule] = [GL001TracerLeak(), GL002HostSyncUnderJit(),
                     GL013PallasInterpretDrift(),
                     GL014DecodeAtWrongSeam(),
                     GL015ResultCacheKeyDrift(),
-                    GL016LauncherHandleLeak()]
+                    GL016LauncherHandleLeak(),
+                    GL017LockOrderCycle(),
+                    GL018UnguardedSharedField(),
+                    GL019BlockingWhileHolding(),
+                    GL020ProbeReachabilityDrift()]
 
 
 def all_rules(only: Optional[Sequence[str]] = None) -> List[Rule]:
