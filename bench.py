@@ -1592,6 +1592,169 @@ def compress_main():
 
 
 # --------------------------------------------------------------------------
+# selectivity scenario (--selectivity): compressed-domain skip sweep
+# --------------------------------------------------------------------------
+
+def selectivity_main():
+    """Skip-level evidence: one q6-style filter swept at ~1%/10%/90%
+    selectivity over a SORTED FoR-packed column, reporting throughput
+    plus blocks skipped at BOTH levels — zone-map morsel skipping
+    (``MorselSource.from_batch`` + the encode-time sidecar) and footer
+    row-group pruning (``MorselSource.from_parquet`` over the same data
+    written as Parquet).  Every selectivity's pruned stream is asserted
+    bit-identical to the filtered full stream in-child; the 1% point
+    must skip at both levels (``blocks_skipped > 0`` AND
+    ``row_groups_pruned > 0``) or the child fails.  ``vs_baseline`` is
+    the 1% point's morsel-level skip fraction
+    blocks_skipped / (skipped + scanned) — the only-shrinks
+    ``blocks_skipped_floor`` in ci/q95_floor.json.  CPU-smoke caveat:
+    the throughput column documents the 8-virtual-device CPU shape, not
+    accelerator rates."""
+    if os.environ.get("BENCH_FORCE_CPU"):
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+
+    import jax
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        platform = jax.devices()[0].platform
+    except Exception as e:  # backend init failure → parent falls back
+        print(f"# backend init failed: {e}", file=sys.stderr, flush=True)
+        return 17
+
+    import tempfile
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spark_rapids_jni_tpu.columnar import types as T
+    from spark_rapids_jni_tpu.columnar.column import Column, ColumnBatch
+    from spark_rapids_jni_tpu.columnar.encoded import encode_for
+    from spark_rapids_jni_tpu.parallel import data_mesh, shard_batch
+    from spark_rapids_jni_tpu.shuffle import MorselSource, ShuffleService
+
+    P = len(jax.devices())
+    mesh = data_mesh(P)
+    n_rows = int(os.environ.get("BENCH_SELECTIVITY_ROWS", str(1 << 15)))
+    n_rows -= n_rows % P
+    rng = np.random.default_rng(29)
+    vals = np.sort(rng.integers(0, 1 << 20, n_rows)).astype(np.int64)
+    keys = rng.integers(0, 256, n_rows).astype(np.int64)
+
+    def col(a, t):
+        a = np.asarray(a)
+        return Column(jnp.asarray(a), jnp.ones((len(a),), jnp.bool_), t)
+
+    # the sidecar comes from the encode step: sharding is a pytree
+    # round-trip, which deliberately drops the column-attached copy
+    zone = encode_for(col(vals, T.INT64), block=256).zone
+    if zone is None:
+        print("# selectivity scenario failed: encode_for attached no "
+              "zone sidecar", file=sys.stderr, flush=True)
+        return 1
+    batch = shard_batch(ColumnBatch({
+        "k": col(keys, T.INT64), "x": col(vals, T.INT64)}), mesh)
+    svc = ShuffleService(mesh)
+    morsel_rows = max(n_rows // P // 8, 1)
+
+    # the same rows as Parquet for the footer level: sorted order gives
+    # the row-group stats the same locality the zone blocks get
+    tmpdir = tempfile.mkdtemp(prefix="bench_selectivity_")
+    path = os.path.join(tmpdir, "sweep.parquet")
+    try:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        pq.write_table(pa.table({"k": pa.array(keys, pa.int64()),
+                                 "x": pa.array(vals, pa.int64())}),
+                       path, row_group_size=max(n_rows // 16, 1))
+    except Exception as e:
+        print(f"# selectivity scenario failed: parquet write: {e!r}",
+              file=sys.stderr, flush=True)
+        return 1
+
+    def survivors(res, thresh):
+        b = res.batch
+        xs = np.asarray(jax.device_get(b["x"].data)).reshape(-1)
+        vs = np.asarray(jax.device_get(b["x"].validity)).reshape(-1)
+        ks = np.asarray(jax.device_get(b["k"].data)).reshape(-1)
+        keep = vs & (xs < thresh)
+        return sorted(zip(ks[keep].tolist(), xs[keep].tolist()))
+
+    failures = []
+    sweep = []
+    try:
+        full_src = MorselSource.from_batch(batch, mesh,
+                                           morsel_rows=morsel_rows)
+        full_res = svc.exchange_stream(full_src, key_names=["k"])
+        jax.block_until_ready(full_res.occupancy)
+        for sel in (0.01, 0.10, 0.90):
+            thresh = int(np.quantile(vals, sel))
+            pred = ("x", "<", thresh)
+            src = MorselSource.from_batch(batch, mesh,
+                                          morsel_rows=morsel_rows,
+                                          predicate=pred, zone_map=zone)
+            t0 = time.perf_counter()
+            res = svc.exchange_stream(src, key_names=["k"])
+            jax.block_until_ready(res.occupancy)
+            dt = time.perf_counter() - t0
+            if survivors(res, thresh) != survivors(full_res, thresh):
+                failures.append(f"sel={sel}: pruned stream diverged "
+                                "from the filtered full stream")
+            counts = {}
+            pruned_src = MorselSource.from_parquet(
+                path, mesh, columns=["k", "x"],
+                morsel_rows=morsel_rows, predicate=pred)
+            counts["row_groups_pruned"] = pruned_src.row_groups_pruned
+            counts["row_groups_scanned"] = pruned_src.row_groups_scanned
+            sweep.append({
+                "selectivity": sel,
+                "throughput_mrows_s": round(n_rows / dt / 1e6, 2),
+                "blocks_skipped": int(src.blocks_skipped),
+                "blocks_scanned": int(src.blocks_scanned),
+                **counts,
+            })
+        one_pct = sweep[0]
+        if one_pct["blocks_skipped"] <= 0:
+            failures.append("1% selectivity skipped no zone-map blocks")
+        if one_pct["row_groups_pruned"] <= 0:
+            failures.append("1% selectivity pruned no row groups")
+    except Exception as e:
+        failures.append(repr(e))
+    if failures:
+        print(f"# selectivity scenario failed: {failures}",
+              file=sys.stderr, flush=True)
+        return 1
+    consulted = one_pct["blocks_skipped"] + one_pct["blocks_scanned"]
+    skip_frac = one_pct["blocks_skipped"] / max(consulted, 1)
+    print(json.dumps({
+        "metric": "selectivity_skip_throughput",
+        "value": one_pct["throughput_mrows_s"],
+        "unit": "Mrows/s",
+        "vs_baseline": round(skip_frac, 2),
+        "platform": platform,
+        "rows": n_rows,
+        "devices": P,
+        "note": {
+            "sweep": sweep,
+            "bit_identical": True,
+            "blocks_skipped": one_pct["blocks_skipped"],
+            "blocks_scanned": one_pct["blocks_scanned"],
+            "row_groups_pruned": one_pct["row_groups_pruned"],
+            "row_groups_scanned": one_pct["row_groups_scanned"],
+            "skip_fraction": round(skip_frac, 2),
+            "morsel_rows": morsel_rows,
+            "zone_block": int(zone.block),
+        },
+    }), flush=True)
+    return 0
+
+
+# --------------------------------------------------------------------------
 # scan scenario (--scan): streaming morsel-driven scan→shuffle pipeline
 # --------------------------------------------------------------------------
 
@@ -2939,6 +3102,8 @@ def main():
         sys.exit(scan_main())
     if mode == "--child-compress":
         sys.exit(compress_main())
+    if mode == "--child-selectivity":
+        sys.exit(selectivity_main())
     if mode == "--child-multidevice":
         sys.exit(multidevice_main())
     if mode == "--child-cache":
@@ -2955,6 +3120,7 @@ def main():
     run_plan = mode == "--plan"
     run_scan = mode == "--scan"
     run_compress = mode == "--compress"
+    run_selectivity = mode == "--selectivity"
     run_multidevice = mode == "--multidevice"
     run_cache = mode == "--cache"
     run_elastic = mode == "--elastic"
@@ -2965,6 +3131,7 @@ def main():
                   else "--child-plan" if run_plan
                   else "--child-scan" if run_scan
                   else "--child-compress" if run_compress
+                  else "--child-selectivity" if run_selectivity
                   else "--child-multidevice" if run_multidevice
                   else "--child-cache" if run_cache
                   else "--child-elastic" if run_elastic
@@ -3013,6 +3180,7 @@ def main():
                   else "q6_ir_throughput" if run_plan
                   else "scan_stream_throughput" if run_scan
                   else "shuffle_compressed_throughput" if run_compress
+                  else "selectivity_skip_throughput" if run_selectivity
                   else "multidevice_shuffle_throughput" if run_multidevice
                   else "result_cache_replay_throughput" if run_cache
                   else "elastic_placement_throughput" if run_elastic

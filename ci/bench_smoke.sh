@@ -44,6 +44,13 @@ BENCH_FORCE_CPU=1 python bench.py --multidevice \
 # spill-codec frame round-trip micro row
 BENCH_FORCE_CPU=1 BENCH_COMPRESS_ROWS=32768 python bench.py --compress \
   | tee /tmp/bench_smoke_compress.out
+# selectivity sweep: a q6-style filter at 1%/10%/90% selectivity over a
+# sorted FoR-packed column — zone-map morsel skipping AND footer
+# row-group pruning both counted per point, pruned streams asserted
+# bit-identical in-child; the 1% skip fraction rides
+# blocks_skipped_floor (only-shrinks)
+BENCH_FORCE_CPU=1 BENCH_SELECTIVITY_ROWS=32768 python bench.py --selectivity \
+  | tee /tmp/bench_smoke_selectivity.out
 # result-cache scenario: a zipf-skewed q6/q95/q9-shaped replay trace
 # through a 2-worker FrontDoor with the fleet result cache on — repeats
 # served from sealed cached Arrow segments bit-identically with zero
@@ -68,6 +75,7 @@ python ci/check_q95_line.py /tmp/bench_smoke_q6.out \
   /tmp/bench_smoke_plan.out /tmp/bench_smoke_scan.out \
   /tmp/bench_smoke_serve.out /tmp/bench_smoke_pallas.out \
   /tmp/bench_smoke_multidevice.out /tmp/bench_smoke_compress.out \
+  /tmp/bench_smoke_selectivity.out \
   /tmp/bench_smoke_cache.out /tmp/bench_smoke_elastic.out
 # spill scenario: device arena capped below q6's working set; the emitted
 # line carries spill-bytes counters so BENCH_*.json tracks spill overhead

@@ -110,6 +110,15 @@ trace's light latencies — rides ``placement_p99_floor`` (1.0: load-aware
 placement must keep beating round-robin at p99), and its ``note`` must
 prove the autoscale loop alive: ``scaled_up >= 1``, ``scaled_down >= 1``
 and non-negative ``scale_up_ms``/``scale_down_ms`` reaction latencies.
+
+Since r19 the compressed-domain skip row (``bench.py --selectivity``)
+gets the same treatment: ``selectivity_skip_throughput`` must exist,
+its ``note.bit_identical`` must be true (every pruned stream equaled
+the filtered full stream), its 1% point must skip at BOTH levels
+(``note.blocks_skipped > 0`` zone-map morsel blocks and
+``note.row_groups_pruned > 0`` footer row groups), and its
+``vs_baseline`` — the 1% morsel-level skip fraction — rides
+``blocks_skipped_floor``.
 """
 import json
 import os
@@ -465,6 +474,39 @@ def main(paths) -> int:
             errs.append("spill-codec line's note.codec_ratio <= 1: the "
                         "frames no longer shrink the payloads "
                         f"(note={json.dumps(sc_note)})")
+    # selectivity row: the compressed-domain skip sweep must exist, its
+    # 1% point must skip at BOTH levels (zone-map morsel blocks AND
+    # footer row groups), every pruned stream must have been asserted
+    # bit-identical to the filtered full stream in-child, and the
+    # morsel-level skip fraction rides blocks_skipped_floor
+    skip_floor = floors["blocks_skipped_floor"]
+    sv_line = lines.get("selectivity_skip_throughput")
+    if sv_line is None:
+        errs.append("no selectivity_skip_throughput line: the "
+                    "selectivity sweep row fell out of the smoke "
+                    "(bench.py selectivity_main)")
+    else:
+        sv_note = sv_line.get("note")
+        if (not isinstance(sv_note, dict)
+                or sv_note.get("bit_identical") is not True):
+            errs.append("selectivity line's note.bit_identical is not "
+                        "true: a pruned stream no longer proves itself "
+                        "equal to the filtered full stream "
+                        f"(note={json.dumps(sv_note)})")
+        elif int(sv_note.get("blocks_skipped", 0)) <= 0:
+            errs.append("selectivity line's note.blocks_skipped <= 0 at "
+                        "1%: the zone-map sidecar skipped nothing "
+                        f"(note={json.dumps(sv_note)})")
+        elif int(sv_note.get("row_groups_pruned", 0)) <= 0:
+            errs.append("selectivity line's note.row_groups_pruned <= 0 "
+                        "at 1%: footer stats pruned no row groups "
+                        f"(note={json.dumps(sv_note)})")
+        if sv_line.get("vs_baseline", 0.0) < skip_floor:
+            errs.append(f"selectivity vs_baseline "
+                        f"{sv_line.get('vs_baseline')} (1% skip "
+                        f"fraction) fell below the recorded floor "
+                        f"{skip_floor} (ci/q95_floor.json): zone-map "
+                        f"skipping degraded")
     # elastic row: load-aware placement must keep beating round-robin at
     # p99 on the skewed-tenant trace, and the autoscale phase must have
     # actually grown AND retired capacity with its reaction latencies
@@ -523,6 +565,9 @@ def main(paths) -> int:
           f"result-cache {(rc_line or {}).get('vs_baseline')} >= floor "
           f"{cache_floor} (hit rate "
           f"{((rc_line or {}).get('note') or {}).get('hit_rate')}); "
+          f"selectivity {(sv_line or {}).get('vs_baseline')} >= floor "
+          f"{skip_floor} (row groups pruned "
+          f"{((sv_line or {}).get('note') or {}).get('row_groups_pruned')}); "
           f"elastic {(el_line or {}).get('vs_baseline')} >= floor "
           f"{elastic_floor} (scale up/down "
           f"{((el_line or {}).get('note') or {}).get('scale_up_ms')}/"

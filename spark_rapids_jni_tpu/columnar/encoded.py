@@ -54,6 +54,8 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import operator
+import zlib
 from typing import Optional, Sequence
 
 import jax
@@ -67,6 +69,22 @@ from . import column as _column_mod
 
 # monotone token source: equal tokens <=> provably the same dictionary
 _TOKENS = itertools.count(1)
+
+# decode-counter hook: the packed-predicate acceptance bar is ZERO
+# materialization on the fast path, so the packed pair's decode()
+# increments this (inside a trace it counts traces, like _TRACE_COUNT —
+# still zero when nothing decodes).  A one-slot list, not a module
+# global, so the traced closures never capture a stale int.
+_PACKED_DECODES = [0]
+
+
+def packed_decode_count() -> int:
+    """How many times a packed column materialized via ``decode()``."""
+    return _PACKED_DECODES[0]
+
+
+def reset_packed_decode_count() -> None:
+    _PACKED_DECODES[0] = 0
 
 
 def _host(arr) -> np.ndarray:
@@ -325,6 +343,122 @@ def choose_pack_width(lo: int, hi: int):
     return None
 
 
+# ---- zone maps (host-side sidecar) ----------------------------------------
+
+# zone block for the global-reference encoding (FoR zones reuse the
+# column's own reference blocks, which already partition the rows)
+_ZONE_BLOCK = 1024
+
+
+@dataclasses.dataclass(frozen=True)
+class ZoneMap:
+    """Per-block min/max sidecar over a packed column's DECODED values.
+
+    Host-side metadata, never a pytree child: the stats are consulted at
+    host boundaries (morsel scheduling, storage pruning), so they must
+    not ride jit cache keys, and a pytree round-trip (shuffle, gather,
+    spill) drops the sidecar rather than shipping stats the permutation
+    invalidated.  Stats cover decoded values of ALL rows — decode() is
+    validity-independent (invalid rows decode to the frame reference) —
+    so a zone-map skip is exactly conservative against the raw
+    decode-then-compare mask, with no validity subtlety.
+
+    The stats are CRC32'd like the PR-15 stored-bytes: :meth:`verify`
+    recomputes the stamp and raises ``ZoneMapCorruptionError`` LOUDLY on
+    any mismatch — a lying sidecar may never silently skip rows.
+
+    ``column`` names the source column when the encode step knows it
+    (``encode_batch`` threads the batch name through); it is folded into
+    the CRC stamp, and the skip decision refuses a sidecar whose tag
+    names a different column than the predicate's — a wrong-column
+    sidecar with a matching row count must never skip rows the real
+    filter column would keep.  ``None`` means untagged (a hand-built
+    sidecar the caller vouches for).
+    """
+
+    mins: np.ndarray   # int64 [nblocks] min decoded value per block
+    maxs: np.ndarray   # int64 [nblocks] max decoded value per block
+    block: int         # rows per zone block
+    rows: int          # rows covered (the tail block may be partial)
+    crc: int           # crc32 over stats + geometry + column tag
+    column: Optional[str] = None  # source column name (None = untagged)
+
+    @staticmethod
+    def _stamp(mins, maxs, block: int, rows: int,
+               column: Optional[str] = None) -> int:
+        h = zlib.crc32(np.ascontiguousarray(mins, np.int64).tobytes())
+        h = zlib.crc32(np.ascontiguousarray(maxs, np.int64).tobytes(), h)
+        h = zlib.crc32(np.array([block, rows], np.int64).tobytes(), h)
+        return zlib.crc32((column or "").encode("utf-8"), h)
+
+    @classmethod
+    def build(cls, values: np.ndarray, block: int,
+              column: Optional[str] = None) -> "ZoneMap":
+        """Stats over ``values`` (int64[n] decoded, padding excluded —
+        callers slice to the real row count first, so a partial tail
+        block never sees padding lanes)."""
+        block = max(int(block), 1)
+        values = np.ascontiguousarray(values, np.int64)
+        n = values.shape[0]
+        if n:
+            starts = np.arange(0, n, block)
+            mins = np.minimum.reduceat(values, starts)
+            maxs = np.maximum.reduceat(values, starts)
+        else:
+            mins = np.zeros((0,), np.int64)
+            maxs = np.zeros((0,), np.int64)
+        return cls(mins, maxs, block, n,
+                   cls._stamp(mins, maxs, block, n, column), column)
+
+    @property
+    def num_blocks(self) -> int:
+        return self.mins.shape[0]
+
+    def verify(self) -> None:
+        """CRC check — raises ``ZoneMapCorruptionError`` on mismatch."""
+        from .. import faultinj
+
+        if self._stamp(self.mins, self.maxs, self.block,
+                       self.rows, self.column) != self.crc:
+            raise faultinj.ZoneMapCorruptionError(
+                f"zone map CRC mismatch over {self.num_blocks} blocks "
+                f"({self.rows} rows, block={self.block}): the sidecar "
+                f"no longer describes its column — refusing to skip")
+
+    def block_may_match(self, op: str, value) -> np.ndarray:
+        """bool[nblocks]: may ANY row of the block satisfy
+        ``row <op> value``?  False blocks are provably cold."""
+        v = int(value)
+        info = np.iinfo(np.int64)
+        if v > info.max:
+            hit = op in ("<", "<=", "!=")
+            return np.full((self.num_blocks,), hit, bool)
+        if v < info.min:
+            hit = op in (">", ">=", "!=")
+            return np.full((self.num_blocks,), hit, bool)
+        v = np.int64(v)
+        m, M = self.mins, self.maxs
+        if op == "<":
+            return m < v
+        if op == "<=":
+            return m <= v
+        if op == ">":
+            return M > v
+        if op == ">=":
+            return M >= v
+        if op == "==":
+            return (m <= v) & (M >= v)
+        if op == "!=":
+            return ~((m == v) & (M == v))
+        raise ValueError(f"unsupported zone-map op {op!r}")
+
+
+def _zone_maps_enabled() -> bool:
+    from .. import config
+
+    return bool(config.get("zone_maps"))
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class BitPackedColumn:
@@ -342,6 +476,9 @@ class BitPackedColumn:
     reference: int        # host-static min over valid rows
     width: int            # 1..32 bits per residual
     dtype: T.SparkType
+    # host-side zone-map sidecar: NOT a pytree child (numpy stats in aux
+    # would break jit cache-key hashing) — a tree round-trip drops it
+    zone: Optional[ZoneMap] = None
 
     def tree_flatten(self):
         return (self.lanes, self.validity), (
@@ -363,6 +500,7 @@ class BitPackedColumn:
 
     def decode(self) -> Column:
         """Materialize the plain column (the late-materialization point)."""
+        _PACKED_DECODES[0] += 1
         vals = self.residuals().astype(jnp.int64) + self.reference
         return Column(vals.astype(self.dtype.jnp_dtype), self.validity,
                       self.dtype)
@@ -392,6 +530,9 @@ class FrameOfReferenceColumn:
     width: int            # 1..32 bits per residual
     block: int            # rows per reference block
     dtype: T.SparkType
+    # host-side zone-map sidecar over the reference blocks; dropped on
+    # any pytree round-trip (see BitPackedColumn.zone)
+    zone: Optional[ZoneMap] = None
 
     def tree_flatten(self):
         return (self.refs, self.lanes, self.validity), (
@@ -422,6 +563,7 @@ class FrameOfReferenceColumn:
 
     def decode(self) -> Column:
         """Materialize the plain column (the late-materialization point)."""
+        _PACKED_DECODES[0] += 1
         return Column(self.values64().astype(self.dtype.jnp_dtype),
                       self.validity, self.dtype)
 
@@ -559,13 +701,14 @@ def _pack_stats(col):
     return data, valid, ref, rng
 
 
-def encode_bitpacked(col):
+def encode_bitpacked(col, column: Optional[str] = None):
     """Bit-pack an int column (host-side; ingest-time op).
 
     The reference is the minimum over VALID rows; null rows pack a zero
     residual (the dictionary's borrowed-null rule — only valid rows must
     round-trip).  Ranges that need more than 32 residual bits return the
-    column unchanged: the lossless fallback.
+    column unchanged: the lossless fallback.  ``column`` tags the
+    zone-map sidecar with the source column's name (see ``ZoneMap``).
     """
     if isinstance(col, BitPackedColumn):
         return col
@@ -578,17 +721,26 @@ def encode_bitpacked(col):
         return col
     width = max(1, rng.bit_length())
     res = np.where(valid, data - ref, 0).astype(np.uint64).astype(np.uint32)
+    zone = None
+    if _zone_maps_enabled():
+        # stats over decoded values (ref + residual for EVERY row —
+        # decode() is validity-independent), so skips are exactly
+        # conservative against the raw compare mask
+        zone = ZoneMap.build(ref + res.astype(np.int64), _ZONE_BLOCK,
+                             column)
     return BitPackedColumn(pack_bits(jnp.asarray(res), width), col.validity,
-                           ref, width, col.dtype)
+                           ref, width, col.dtype, zone=zone)
 
 
-def encode_for(col, block: int = 1024):
+def encode_for(col, block: int = 1024, column: Optional[str] = None):
     """Frame-of-reference encode an int column (host-side; ingest-time op).
 
     Per-``block`` minima absorb drift, so clustered wide-range keys
     (timestamps, monotone ids) still pack narrow; the residual width is
     global (trace-static).  Any block whose residual range exceeds 32
-    bits returns the column unchanged (lossless fallback).
+    bits returns the column unchanged (lossless fallback).  ``column``
+    tags the zone-map sidecar with the source column's name (see
+    ``ZoneMap``).
     """
     if isinstance(col, FrameOfReferenceColumn):
         return col
@@ -612,9 +764,16 @@ def encode_for(col, block: int = 1024):
         return col
     width = max(1, rng.bit_length())
     res = res2.reshape(-1)[:n].astype(np.uint64).astype(np.uint32)
+    zone = None
+    if _zone_maps_enabled():
+        # decoded values, sliced to the REAL row count before stats: the
+        # tail block's padding lanes must never contribute to min/max
+        vals = (refs[:, None] + res2).reshape(-1)[:n]
+        zone = ZoneMap.build(vals, block, column)
     return FrameOfReferenceColumn(jnp.asarray(refs),
                                   pack_bits(jnp.asarray(res), width),
-                                  col.validity, width, block, col.dtype)
+                                  col.validity, width, block, col.dtype,
+                                  zone=zone)
 
 
 def gather_bitpacked(col: BitPackedColumn, idx, valid=None):
@@ -628,8 +787,9 @@ def gather_bitpacked(col: BitPackedColumn, idx, valid=None):
     v = col.validity[idx]
     if valid is not None:
         v = v & valid
+    # zone stats do not survive permutation — drop the sidecar
     return dataclasses.replace(col, lanes=pack_bits(res[idx], col.width),
-                               validity=v)
+                               validity=v, zone=None)
 
 
 def encode_batch(batch: ColumnBatch, dictionary: Optional[Sequence[str]] = None,
@@ -651,10 +811,10 @@ def encode_batch(batch: ColumnBatch, dictionary: Optional[Sequence[str]] = None,
             out[name] = encode_rle(col)
             continue
         if name in bitpack:
-            out[name] = encode_bitpacked(col)
+            out[name] = encode_bitpacked(col, column=name)
             continue
         if name in frame_of_reference:
-            out[name] = encode_for(col)
+            out[name] = encode_for(col, column=name)
             continue
         if dictionary is not None:
             out[name] = encode_column(col) if name in dictionary else col
@@ -696,6 +856,105 @@ def predicate_mask(col: DictionaryColumn, pred) -> jax.Array:
     if not isinstance(hits, jax.Array) and hasattr(hits, "data"):
         hits = hits.data  # pred returned a Column
     return hits.astype(jnp.bool_)[col.codes.astype(jnp.int32)] & col.validity
+
+
+_PACKED_FILTER_OPS = {
+    "<": operator.lt, "<=": operator.le, ">": operator.gt,
+    ">=": operator.ge, "==": operator.eq, "!=": operator.ne,
+}
+
+
+def _const_mask(n: int, hit: bool) -> jax.Array:
+    return jnp.full((n,), bool(hit), jnp.bool_)
+
+
+def _bitpacked_filter_mask(col: BitPackedColumn, op: str, value) -> jax.Array:
+    """Compare u32 residual lanes against the once-transformed literal.
+
+    ``t = value - reference`` is host-static (like the width), so
+    out-of-domain literals fold to constant masks at trace time and the
+    in-domain compare is a single u32 lane op — no widening, no decode.
+    """
+    n = col.num_rows
+    t = int(value) - int(col.reference)
+    if t < 0:
+        return _const_mask(n, op in (">", ">=", "!="))
+    if t > (1 << col.width) - 1:
+        return _const_mask(n, op in ("<", "<=", "!="))
+    return _PACKED_FILTER_OPS[op](col.residuals(), np.uint32(t))
+
+
+def _for_filter_mask(col: FrameOfReferenceColumn, op: str, value) -> jax.Array:
+    """Per-block literal transform for frame-of-reference columns.
+
+    The block minima are a traced child, so the transform runs in-trace:
+    ``t_b = value - refs`` per block, out-of-domain blocks resolve
+    through boolean composition, in-domain blocks compare u32 residuals
+    against the clamped per-block literal gathered to rows.  Differences
+    that overflow int64 are detected by sign and fold into the same
+    below/above composition, so the mask stays bit-identical to
+    decode-then-compare even when value and a block reference sit at
+    opposite ends of the int64 domain.
+    """
+    n = col.num_rows
+    hi = (1 << col.width) - 1
+    v = np.int64(value)
+    refs64 = col.refs.astype(jnp.int64)
+    t64 = v - refs64
+    # the int64 lanes wrap when |value - ref| exceeds the int64 domain
+    # (value and ref on opposite ends): the wrapped difference takes the
+    # wrong sign exactly when the operands' signs differ and the result
+    # does not take value's sign.  Those blocks are really out-of-domain
+    # on value's side — huge positive t (above) when value >= 0, huge
+    # negative t (below) when value < 0 — so classify them there instead
+    # of trusting the wrapped lanes.
+    wrapped = ((v >= 0) != (refs64 >= 0)) & ((t64 >= 0) != (v >= 0))
+    below = ((t64 < np.int64(0)) & ~wrapped) | (wrapped & bool(v < 0))
+    above = ((t64 > np.int64(hi)) & ~wrapped) | (wrapped & bool(v >= 0))
+    t32 = jnp.clip(t64, 0, hi).astype(jnp.uint32)
+    blk = jnp.arange(n, dtype=jnp.int32) // np.int32(max(col.block, 1))
+    r = col.residuals()
+    tb, lo_b, hi_b = t32[blk], below[blk], above[blk]
+    base = _PACKED_FILTER_OPS[op](r, tb)
+    if op == "==":
+        return jnp.where(lo_b | hi_b, False, base)
+    if op == "!=":
+        return jnp.where(lo_b | hi_b, True, base)
+    if op in ("<", "<="):
+        return jnp.where(lo_b, False, jnp.where(hi_b, True, base))
+    return jnp.where(lo_b, True, jnp.where(hi_b, False, base))
+
+
+def packed_filter_mask(col, op: str, value) -> jax.Array:
+    """bool[n] mask for ``col <op> value`` computed IN the packed domain.
+
+    Bit-identical to ``op(col.decode().data, value)`` — including null
+    rows, which decode to the frame reference — without materializing:
+    the literal is transformed once per frame (subtract the reference,
+    clamp to the pack-width domain; out-of-domain literals fold to
+    all-true/all-false) and the residual u32 lanes compare directly.
+
+    Falls back to decode-then-compare (the exact-parity path) when the
+    ``packed_predicates`` knob is off, the literal is not a plain int,
+    or it exceeds the int64 transform domain.
+    """
+    if op not in _PACKED_FILTER_OPS:
+        raise ValueError(f"unsupported packed filter op {op!r}")
+    if not isinstance(col, PACKED_COLUMNS):
+        raise TypeError(f"packed_filter_mask needs a packed column, "
+                        f"got {col!r}")
+    from .. import config
+
+    info = np.iinfo(np.int64)
+    pushable = (bool(config.get("packed_predicates"))
+                and isinstance(value, (int, np.integer))
+                and not isinstance(value, bool)
+                and info.min <= int(value) <= info.max)
+    if not pushable:
+        return _PACKED_FILTER_OPS[op](col.decode().data, value)
+    if isinstance(col, BitPackedColumn):
+        return _bitpacked_filter_mask(col, op, value)
+    return _for_filter_mask(col, op, value)
 
 
 def canon_key_column(col: DictionaryColumn) -> Column:

@@ -211,6 +211,32 @@ _register("encoded_execution", "auto", str,
           "relational operators accept encoded and plain columns "
           "mixed, so the knob only gates where encoding is "
           "INTRODUCED.")
+_register("packed_predicates", True, _parse_bool,
+          "Evaluate comparison filters (<, <=, ==, !=, >=, >) directly "
+          "on BitPackedColumn/FrameOfReferenceColumn residuals "
+          "(columnar/encoded.py packed_filter_mask): the literal is "
+          "transformed once per frame (subtract the reference, clamp to "
+          "the pack-width domain, out-of-domain literals fold to "
+          "constant masks) and u32 lanes compare without ever calling "
+          "decode().  Bit-identical to decode-then-compare; off = "
+          "always decode first (the exact-parity fallback).")
+_register("zone_maps", True, _parse_bool,
+          "Record a CRC32'd per-block min/max sidecar (ZoneMap) on "
+          "packed columns at encode time and let MorselSource skip "
+          "whole morsels a filter's zone-map check proves cold "
+          "(shuffle/morsel.py), counted as ShuffleMetrics "
+          "blocks_skipped/blocks_scanned.  A sidecar whose CRC or "
+          "stats disagree raises ZoneMapCorruptionError LOUDLY at skip "
+          "time — wrong rows are never silently returned.  Off = no "
+          "sidecars, every block scanned.")
+_register("scan_pruning", True, _parse_bool,
+          "Push scan-level predicates into the Parquet footer "
+          "(io/parquet.py / io/parquet_footer.py): row groups whose "
+          "column min/max statistics cannot satisfy the predicate are "
+          "dropped before any data page is read, and "
+          "MorselSource.from_parquet never builds replays for them.  "
+          "Groups with missing stats or nulls are conservatively kept; "
+          "off = read every split-surviving row group.")
 _register("plan_cache_size", 64, int,
           "Max compiled programs the plan cache (plan/cache.py) holds; "
           "LRU past it.  Keys are (canonical IR shape, input schema, "

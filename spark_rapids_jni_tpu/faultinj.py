@@ -457,6 +457,25 @@ def _raise_drain_stuck(name: str):
     raise DrainStuckError(f"injected stuck drain at {name}")
 
 
+class ZoneMapCorruptionError(OSError):
+    """A zone-map sidecar lies about its blocks (kind ``"zone_map_corrupt"``).
+
+    Raised at the ``zone_map_check`` probe (shuffle/morsel.py); the skip
+    path converts it into REAL damage — the sidecar's min/max stats are
+    flipped AFTER the CRC stamp, modelling a corrupted or stale sidecar
+    whose statistics no longer describe the blocks they claim to cover —
+    and the mandatory ``ZoneMap.verify()`` CRC check must catch the
+    mismatch and raise this same class LOUDLY at skip time.  Skipping on
+    a lying sidecar would silently drop rows the filter should have
+    kept, so corruption here may never degrade to wrong answers: the
+    only recovery is re-encoding from source (a fresh sidecar is the
+    lineage)."""
+
+
+def _raise_zone_map_corrupt(name: str):
+    raise ZoneMapCorruptionError(f"injected zone-map corruption at {name}")
+
+
 # The registry of injectable fault flavors: kind -> raiser.  graftlint's
 # GL006 keeps this in sync with every use site statically — a kind used
 # in a config dict but missing here would otherwise only fail when its
@@ -487,6 +506,7 @@ FAULT_KINDS = {
     "cache_corrupt": _raise_cache_corrupt,
     "scale_up_fail": _raise_scale_up_fail,
     "drain_stuck": _raise_drain_stuck,
+    "zone_map_corrupt": _raise_zone_map_corrupt,
 }
 
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
+import numpy as np
 import pyarrow.parquet as pq
 
 from ..columnar.arrow import from_arrow
@@ -52,6 +53,81 @@ def select_row_groups(meta, part_offset: int, part_length: int) -> list:
     return keep
 
 
+_PRUNE_OPS = ("<", "<=", "==", "!=", ">=", ">")
+
+
+def _stats_may_match(stats, op: str, value) -> bool:
+    """Conservative row-group stats check: False only when the chunk's
+    min/max PROVE every row fails ``row <op> value``.  Missing stats,
+    unset min/max, nulls, or cross-type comparisons all keep the group
+    — pruning never guesses."""
+    if stats is None or not stats.has_min_max:
+        return True
+    if stats.null_count is None or stats.null_count > 0:
+        # a null row's decoded fill value is not described by min/max;
+        # only all-valid chunks are provably cold
+        return True
+    lo, hi = stats.min, stats.max
+    try:
+        if op == "<":
+            return bool(lo < value)
+        if op == "<=":
+            return bool(lo <= value)
+        if op == ">":
+            return bool(hi > value)
+        if op == ">=":
+            return bool(hi >= value)
+        if op == "==":
+            return bool(lo <= value) and bool(hi >= value)
+        if op == "!=":
+            return not (bool(lo == value) and bool(hi == value))
+    except TypeError:
+        return True
+    return True
+
+
+def _find_chunk(rg, column: str, ignore_case: bool):
+    """Physical chunk index of top-level ``column`` in a row group."""
+    want = column.lower() if ignore_case else column
+    for ci in range(rg.num_columns):
+        name = rg.column(ci).path_in_schema
+        if (name.lower() if ignore_case else name) == want:
+            return ci
+    return None
+
+
+def prune_row_groups(meta, keep, predicate,
+                     ignore_case: bool = False) -> tuple:
+    """Drop row groups whose column stats cannot satisfy ``predicate``
+    (``(column, op, value)``), gated by the ``scan_pruning`` knob.
+
+    Returns ``(kept_indices, pruned_count)``.  When every group is
+    provably cold one schema-bearing group survives anyway (the morsel
+    stream needs a first morsel; an empty filtered result still needs
+    its schema) — its rows fail the predicate downstream.
+    """
+    from .. import config
+
+    keep = list(keep)
+    if predicate is None or not bool(config.get("scan_pruning")):
+        return keep, 0
+    column, op, value = predicate
+    if (op not in _PRUNE_OPS or isinstance(value, bool)
+            or not isinstance(value, (int, float, np.integer,
+                                      np.floating))):
+        return keep, 0
+    kept = []
+    for i in keep:
+        rg = meta.row_group(i)
+        ci = _find_chunk(rg, column, ignore_case)
+        if ci is None or _stats_may_match(rg.column(ci).statistics,
+                                          op, value):
+            kept.append(i)
+    if not kept and keep:
+        kept = keep[:1]
+    return kept, len(keep) - len(kept)
+
+
 def _match_columns(schema_names, columns, ignore_case: bool) -> list:
     if columns is None:
         return list(schema_names)
@@ -68,6 +144,7 @@ def read_parquet(
     part_offset: int = 0,
     part_length: int = 1 << 62,
     ignore_case: bool = False,
+    predicate=None,
 ) -> ColumnBatch:
     """Read (a split of) a parquet file into a device ColumnBatch.
 
@@ -77,11 +154,17 @@ def read_parquet(
     :class:`~spark_rapids_jni_tpu.columnar.DictionaryColumn` (codes +
     values), so the char-matrix padding cost is paid once per distinct
     value instead of once per row.
+
+    ``predicate`` (``(column, op, value)``) additionally drops row
+    groups whose footer stats cannot satisfy it (``scan_pruning``
+    knob): the split keeps only rows the filter may keep, so the caller
+    must apply the same filter downstream regardless.
     """
     from ..columnar.encoded import resolve_encoded_execution
 
     f = pq.ParquetFile(path)
     keep = select_row_groups(f.metadata, part_offset, part_length)
+    keep, _ = prune_row_groups(f.metadata, keep, predicate, ignore_case)
     schema = f.schema_arrow
     names = _match_columns(schema.names, columns, ignore_case)
     if resolve_encoded_execution():
@@ -107,6 +190,8 @@ def row_group_readers(
     part_offset: int = 0,
     part_length: int = 1 << 62,
     ignore_case: bool = False,
+    predicate=None,
+    counters: Optional[dict] = None,
 ) -> list:
     """Replayable per-row-group readers for the streaming scan.
 
@@ -117,9 +202,18 @@ def row_group_readers(
     corrupt morsel-derived buffer re-decodes from source instead of
     keeping a second copy resident).  ``rows`` comes from the footer, so
     the morsel schedule is planned without touching any data pages.
+
+    ``predicate`` prunes stats-cold row groups before any reader is
+    built (see :func:`prune_row_groups`); when ``counters`` is a dict it
+    receives the ``{"pruned", "scanned"}`` group counts.
     """
     f = pq.ParquetFile(path)
     keep = select_row_groups(f.metadata, part_offset, part_length)
+    keep, pruned = prune_row_groups(f.metadata, keep, predicate,
+                                    ignore_case)
+    if counters is not None:
+        counters["pruned"] = pruned
+        counters["scanned"] = len(keep)
     names = _match_columns(f.schema_arrow.names, columns, ignore_case)
 
     def make(i):

@@ -134,6 +134,48 @@ def read_footer_bytes(path: str) -> bytes:
         return f.read(flen)
 
 
+def predicate_prune_spans(path: str, predicate,
+                          ignore_case: bool = False) -> list:
+    """Byte windows covering the predicate-surviving row groups.
+
+    The native facade prunes by ONE ``[part_offset, part_offset +
+    part_length)`` split window (midpoint rule), so an arbitrary
+    stats-pruned subset is expressed as its maximal runs of consecutive
+    surviving groups: each returned ``(part_offset, part_length)``
+    window contains exactly one run's midpoints and no pruned group's
+    midpoint (row groups are laid out sequentially, so neighbouring
+    groups' midpoints fall outside the run's byte span).  Feed each
+    window to :meth:`ParquetFooter.read_and_filter`; their footers
+    union to exactly the stats-surviving groups.
+
+    Stats logic is shared with the pyarrow scan path
+    (:func:`~spark_rapids_jni_tpu.io.parquet.prune_row_groups`), so the
+    Python rule and the native facade cannot drift apart.
+    """
+    import pyarrow.parquet as pq
+
+    from .parquet import _row_group_span, prune_row_groups
+
+    meta = pq.ParquetFile(path).metadata
+    keep, _ = prune_row_groups(meta, range(meta.num_row_groups),
+                               predicate, ignore_case)
+    spans = []
+    run = []
+    for i in keep:
+        if run and i != run[-1] + 1:
+            spans.append(run)
+            run = []
+        run.append(i)
+    if run:
+        spans.append(run)
+    out = []
+    for run in spans:
+        start, _ = _row_group_span(meta.row_group(run[0]))
+        _, end = _row_group_span(meta.row_group(run[-1]))
+        out.append((start, end - start))
+    return out
+
+
 class ParquetFooter:
     """A parsed, filtered footer (reference ParquetFooter.java surface)."""
 

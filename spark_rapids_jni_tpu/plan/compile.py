@@ -47,7 +47,8 @@ import jax.numpy as jnp
 
 from .. import config
 from ..columnar.column import Column, ColumnBatch
-from ..columnar.encoded import is_encoded, predicate_mask
+from ..columnar.encoded import PACKED_COLUMNS, is_encoded, \
+    packed_filter_mask, predicate_mask
 from . import adaptive, ir
 from .cache import get_plan_cache
 
@@ -148,8 +149,13 @@ _FILTER_OPS = {
 
 def _filter_mask(col, op: str, value):
     """Row mask for ``col <op> value`` — pushed onto dictionary codes
-    for encoded columns (one d-entry predicate + one gather)."""
+    for encoded columns (one d-entry predicate + one gather), and onto
+    u32 residual lanes for packed columns (``packed_filter_mask``:
+    literal transformed once per frame, bit-identical to
+    decode-then-compare, zero decodes on the fast path)."""
     fn = _FILTER_OPS[op]
+    if isinstance(col, PACKED_COLUMNS):
+        return packed_filter_mask(col, op, value)
     if is_encoded(col) and hasattr(col, "codes"):
         return predicate_mask(col, lambda d: fn(d.data, value))
     return fn(col.data, value)

@@ -163,10 +163,11 @@ def spill_summary() -> dict:
 def shuffle_summary() -> dict:
     """ShuffleService counters for profile reports: shuffles/rounds run,
     rows and bytes moved, bytes spilled under pressure, out-of-range and
-    dropped row counts, transport retry count, and the worst skew ratio
-    seen — the per-shuffle analogue of :func:`spill_summary`.  Always
-    zeros-safe: the registry exists as soon as the shuffle package
-    imports."""
+    dropped row counts, transport retry count, zone-map block skipping
+    (``blocks_skipped``/``blocks_scanned`` from predicate-pruned morsel
+    streams), and the worst skew ratio seen — the per-shuffle analogue
+    of :func:`spill_summary`.  Always zeros-safe: the registry exists as
+    soon as the shuffle package imports."""
     from .shuffle import get_registry
 
     return get_registry().metrics.snapshot()

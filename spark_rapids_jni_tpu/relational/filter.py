@@ -18,6 +18,9 @@ from ..columnar.column import ColumnBatch
 from ..columnar.encoded import predicate_mask  # noqa: F401  (encoded filter
 # path: evaluate the predicate over the d-entry dictionary once, map to
 # rows with one gather — re-exported here as part of the filter API)
+from ..columnar.encoded import packed_filter_mask  # noqa: F401  (packed
+# filter path: compare u32 residual lanes against the once-transformed
+# literal, no decode — the compressed-domain half of the filter API)
 from .gather import gather_batch
 
 

@@ -30,16 +30,90 @@ Two sources:
 
 from __future__ import annotations
 
+import dataclasses
 import math
 from functools import lru_cache, partial
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
+from .. import faultinj
 from ..columnar.column import ColumnBatch
+
+# every zone-map consultation crosses this probe; kind "zone_map_corrupt"
+# rules convert into REAL sidecar damage (stats flipped after the CRC
+# stamp) that the mandatory ZoneMap.verify() must catch LOUDLY — a lying
+# sidecar may never silently skip rows
+_zone_probe = faultinj.instrument(lambda: None, "zone_map_check")
+
+_ZONE_OPS = ("<", "<=", "==", "!=", ">=", ">")
+
+
+def _zone_keep(batch: ColumnBatch, predicate, zone_map, P: int,
+               per_dev: int, k: int,
+               morsel_rows: int) -> Tuple[list, int, int]:
+    """Per-morsel keep decisions from the filter column's zone map.
+
+    Returns ``(keep bool[k], blocks_skipped, blocks_scanned)``.  Morsel
+    ``j`` covers, per shard ``p``, global rows ``[p*per_dev + j*M,
+    p*per_dev + (j+1)*M)`` — the same global order the sidecar was
+    built over (shard_batch keeps row order across shards); it is
+    skipped only when EVERY zone block overlapping any of those ranges
+    provably cannot match.  Blocks are counted per consulting morsel (a
+    block straddling two morsels is consulted twice).  At least one
+    morsel always survives: the stream needs a schema-bearing morsel
+    even when the filter excludes all.
+    """
+    from .. import config
+
+    all_kept = ([True] * k, 0, 0)
+    column, op, value = predicate
+    if not bool(config.get("zone_maps")):
+        return all_kept
+    if (op not in _ZONE_OPS
+            or not isinstance(value, (int, np.integer))
+            or isinstance(value, bool)):
+        return all_kept
+    zm = zone_map
+    if zm is None and column in batch.names:
+        # pytree round-trips (shard_batch, device_put) drop the sidecar,
+        # so callers usually pass the encode step's zone_map explicitly
+        zm = getattr(batch[column], "zone", None)
+    if zm is None or zm.rows != batch.num_rows or (
+            zm.column is not None and zm.column != column):
+        # no sidecar, or one describing a different row count or a
+        # DIFFERENT column than the predicate filters (a stale or
+        # wrong-column sidecar never skips — not skipping is always
+        # safe; untagged sidecars pass, the caller vouches for them)
+        return all_kept
+    try:
+        _zone_probe()
+    except faultinj.ZoneMapCorruptionError:
+        # injected -> REAL damage: flip the stats AFTER the CRC stamp
+        # (a lying sidecar); the verify below must refuse to skip on it
+        zm = dataclasses.replace(zm, maxs=zm.maxs ^ np.int64(1))
+    zm.verify()
+    hit = zm.block_may_match(op, value)
+    nblocks = zm.num_blocks
+    covered = []
+    for j in range(k):
+        blocks = set()
+        for p in range(P):
+            lo = p * per_dev + j * morsel_rows
+            hi = min(lo + morsel_rows, (p + 1) * per_dev)
+            if hi > lo:
+                blocks.update(range(lo // zm.block,
+                                    (hi - 1) // zm.block + 1))
+        covered.append({b for b in blocks if b < nblocks})
+    keep = [any(hit[b] for b in blocks) for blocks in covered]
+    if not any(keep):
+        keep[0] = True
+    skipped = sum(len(c) for c, kj in zip(covered, keep) if not kj)
+    scanned = sum(len(c) for c, kj in zip(covered, keep) if kj)
+    return keep, skipped, scanned
 
 
 def _pad_rows(x, pad: int):
@@ -98,6 +172,19 @@ class MorselSource:
         self._replays = list(replays)
         self.morsel_rows = int(morsel_rows)
         self.rows = int(rows)
+        # skip accounting (filled by the predicate-aware constructors):
+        # zone blocks the morsel-level check excluded vs consulted, and
+        # Parquet row groups the footer stats pruned vs scanned —
+        # exchange_stream folds the block counters into ShuffleMetrics
+        self.blocks_skipped = 0
+        self.blocks_scanned = 0
+        self.row_groups_pruned = 0
+        self.row_groups_scanned = 0
+        # the counters describe the source's ONE skip decision (made at
+        # construction); exchange_stream flips this after folding them
+        # into the registry aggregate so a reused source attributes
+        # them to its first exchange only
+        self._zone_counts_recorded = False
         # the mesh the morsels are sharded over — what lets the plan
         # compiler build the ShuffleService without a side channel
         self.mesh = mesh
@@ -119,7 +206,8 @@ class MorselSource:
     @classmethod
     def from_batch(cls, batch: ColumnBatch, mesh, axis_name: str = "data",
                    morsel_rows: Optional[int] = None,
-                   row_valid=None) -> "MorselSource":
+                   row_valid=None, predicate=None,
+                   zone_map=None) -> "MorselSource":
         """Slice a row-sharded batch into per-shard morsels.
 
         Each device shard is padded (invalid rows) to a whole number of
@@ -127,6 +215,18 @@ class MorselSource:
         every morsel reproduces each shard in row order, which is what
         makes the streamed exchange bit-identical to
         ``exchange(batch, ...)`` on the same batch.
+
+        ``predicate`` is an optional ``(column, op, value)`` filter the
+        CONSUMER is committed to applying downstream anyway: when the
+        named column carries a zone-map sidecar (``zone_maps`` knob),
+        morsels whose every overlapping block provably cannot match are
+        never built — the skipped rows are exactly rows the filter
+        would drop, so the filtered stream stays bit-identical to the
+        filtered full scan.  ``zone_map`` supplies the sidecar
+        explicitly (sharding is a pytree round-trip, which drops the
+        column-attached copy); it must cover ``batch``'s rows in the
+        same global order, and a sidecar tagged with a different column
+        name than the predicate's is refused (no skipping).
         """
         from .. import config
 
@@ -152,21 +252,37 @@ class MorselSource:
         def make(j):
             return lambda: sl(padded, valid, jnp.int32(j))
 
+        keep = [True] * k
+        skipped = scanned = 0
+        if predicate is not None:
+            keep, skipped, scanned = _zone_keep(
+                batch, predicate, zone_map, P, per_dev, k, morsel_rows)
+
         from ..serve.result_cache import snapshot_for_batch
 
-        return cls([make(j) for j in range(k)], morsel_rows,
-                   batch.num_rows, mesh=mesh, axis_name=axis_name,
-                   snapshot_id=snapshot_for_batch(batch))
+        src = cls([make(j) for j in range(k) if keep[j]], morsel_rows,
+                  batch.num_rows, mesh=mesh, axis_name=axis_name,
+                  snapshot_id=snapshot_for_batch(batch))
+        src.blocks_skipped = skipped
+        src.blocks_scanned = scanned
+        return src
 
     @classmethod
     def from_parquet(cls, path, mesh, axis_name: str = "data",
                      columns: Optional[Sequence[str]] = None,
                      morsel_rows: Optional[int] = None,
-                     ignore_case: bool = False) -> "MorselSource":
+                     ignore_case: bool = False,
+                     predicate=None) -> "MorselSource":
         """One morsel per ``P * morsel_rows``-row slice of each Parquet
         row group: the replay re-reads its row group from the file (the
         natural lineage — a damaged buffer costs one decode, not a
-        cached copy), pads to the fixed shape and row-shards it."""
+        cached copy), pads to the fixed shape and row-shards it.
+
+        ``predicate`` (``(column, op, value)``) pushes the scan filter
+        into the footer (``scan_pruning`` knob): row groups whose
+        column min/max statistics cannot satisfy it are pruned before
+        any replay is built, so cold groups never decode a page.
+        """
         from .. import config
         from ..io.parquet import row_group_readers
 
@@ -176,8 +292,11 @@ class MorselSource:
             raise ValueError("morsel_rows must be positive")
         P = mesh.shape[axis_name]
         gm = P * morsel_rows
+        prune_counts = {}
         readers = row_group_readers(path, columns=columns,
-                                    ignore_case=ignore_case)
+                                    ignore_case=ignore_case,
+                                    predicate=predicate,
+                                    counters=prune_counts)
         sharding = NamedSharding(mesh, PartitionSpec(axis_name))
 
         def make(read, lo, n):
@@ -205,6 +324,9 @@ class MorselSource:
                 replays.append(make(read, lo, max(n, 0)))
         from ..serve.result_cache import snapshot_for_path
 
-        return cls(replays, morsel_rows, total, mesh=mesh,
-                   axis_name=axis_name,
-                   snapshot_id=snapshot_for_path(path))
+        src = cls(replays, morsel_rows, total, mesh=mesh,
+                  axis_name=axis_name,
+                  snapshot_id=snapshot_for_path(path))
+        src.row_groups_pruned = int(prune_counts.get("pruned", 0))
+        src.row_groups_scanned = int(prune_counts.get("scanned", 0))
+        return src

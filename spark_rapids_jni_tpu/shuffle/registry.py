@@ -40,6 +40,8 @@ class ShuffleInfo:
     compressed_bytes_saved: int = 0  # wire bytes the pack plan saved
     #   (bytes_moved already reflects the packed size; this is the delta
     #   vs the raw grid the same rounds would have shipped)
+    blocks_skipped: int = 0        # zone blocks the morsel check excluded
+    blocks_scanned: int = 0        # zone blocks consulted and kept
 
 
 class ShuffleMetrics:
@@ -55,7 +57,7 @@ class ShuffleMetrics:
         "shuffles", "rounds", "rows_moved", "bytes_moved",
         "spilled_bytes", "oob_rows", "dropped_rows", "io_failures",
         "recovered_partitions", "adopted_shards", "lineage_rebuilds",
-        "compressed_bytes_saved",
+        "compressed_bytes_saved", "blocks_skipped", "blocks_scanned",
     )
 
     def __init__(self):
@@ -72,6 +74,8 @@ class ShuffleMetrics:
             self._c["spilled_bytes"] += info.spilled_bytes
             self._c["oob_rows"] += info.oob_rows
             self._c["compressed_bytes_saved"] += info.compressed_bytes_saved
+            self._c["blocks_skipped"] += info.blocks_skipped
+            self._c["blocks_scanned"] += info.blocks_scanned
             self._max_skew = max(self._max_skew, info.skew_ratio)
 
     def record_dropped(self, n: int):

@@ -128,6 +128,8 @@ class ShuffleResult:
     decode_ms: float = 0.0          # cumulative morsel decode+map time
     drain_ms: float = 0.0           # cumulative round drain time
     compressed_bytes_saved: int = 0  # wire bytes the pack plan saved
+    blocks_skipped: int = 0         # zone blocks the source's check excluded
+    blocks_scanned: int = 0         # zone blocks consulted and kept
 
 
 def _map_local(b: ColumnBatch, pid, P: int):
@@ -1103,6 +1105,21 @@ class ShuffleService:
         # the materialized planner over the FINAL counts supplies the
         # skew diagnostics; rounds/capacity record what actually ran
         plan = plan_rounds(cum, round_rows=round_rows)
+        # zone-map skip accounting rides the source (MorselSource fills
+        # it when a predicate pruned the stream; plain iterables read 0).
+        # The counters describe the source's ONE skip decision at
+        # construction time, so a reused source (replays are re-runnable)
+        # attributes them to its FIRST exchange only — re-recording the
+        # same counts would inflate the registry aggregate.
+        blocks_skipped = int(getattr(morsels, "blocks_skipped", 0))
+        blocks_scanned = int(getattr(morsels, "blocks_scanned", 0))
+        if getattr(morsels, "_zone_counts_recorded", False):
+            blocks_skipped = blocks_scanned = 0
+        else:
+            try:
+                morsels._zone_counts_recorded = True
+            except AttributeError:
+                pass  # plain iterables carry no counters to double-count
         info = ShuffleInfo(
             shuffle_id=sid, rounds=rounds, capacity=C,
             rows_moved=received, bytes_moved=bytes_moved,
@@ -1111,7 +1128,8 @@ class ShuffleService:
             streamed=True, morsels=n_morsels,
             rounds_overlapped=rounds_overlapped,
             decode_ms=decode_ms, drain_ms=drain_ms,
-            compressed_bytes_saved=compressed_saved)
+            compressed_bytes_saved=compressed_saved,
+            blocks_skipped=blocks_skipped, blocks_scanned=blocks_scanned)
         self.registry.record(info)
         return ShuffleResult(
             batch=final_batch, occupancy=final_occ, shuffle_id=sid,
@@ -1121,7 +1139,8 @@ class ShuffleService:
             recovered_partitions=recovered[0], streamed=True,
             morsels=n_morsels, rounds_overlapped=rounds_overlapped,
             decode_ms=decode_ms, drain_ms=drain_ms,
-            compressed_bytes_saved=compressed_saved)
+            compressed_bytes_saved=compressed_saved,
+            blocks_skipped=blocks_skipped, blocks_scanned=blocks_scanned)
 
     def plan(self, counts, round_rows: Optional[int] = None) -> RoundPlan:
         """Expose the planner on the service for callers that fetched

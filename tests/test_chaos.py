@@ -261,6 +261,48 @@ class TestShufflePartitionRecovery:
             config.set("shuffle_max_recoveries", old_budget)
 
 
+# -- zone-map corruption fails loud ----------------------------------------
+
+
+class TestZoneMapCorrupt:
+    def test_corrupt_zone_map_raises_at_skip_time(self, eight_devices):
+        """A lying sidecar must raise at the skip decision — never
+        silently return wrong rows.  The injected fault at the
+        ``zone_map_check`` probe becomes real post-CRC stat damage, so
+        the mandatory verify fails for real, and the fire is counted."""
+        from spark_rapids_jni_tpu.columnar.encoded import encode_for
+        from spark_rapids_jni_tpu.parallel import data_mesh, shard_batch
+        from spark_rapids_jni_tpu.shuffle import MorselSource
+
+        P = 8
+        n = P * 512
+        vals = np.arange(n, dtype=np.int64) * 7
+        enc = encode_for(Column(jnp.asarray(vals),
+                                jnp.ones((n,), jnp.bool_), T.INT64),
+                         block=128)
+        assert enc.zone is not None
+        mesh = data_mesh(P)
+        batch = shard_batch(ColumnBatch({
+            "x": Column(jnp.asarray(vals), jnp.ones((n,), jnp.bool_),
+                        T.INT64)}), mesh)
+        faultinj.configure({"faults": [
+            {"match": "zone_map_check", "fault": "zone_map_corrupt",
+             "count": 1}]})
+        with pytest.raises(faultinj.ZoneMapCorruptionError):
+            MorselSource.from_batch(batch, mesh, morsel_rows=128,
+                                    predicate=("x", "<", int(vals[8])),
+                                    zone_map=enc.zone)
+        assert faultinj.fire_counts().get("zone_map_check", 0) == 1
+        # rule exhausted: a fresh sidecar (re-encode = lineage) skips
+        src = MorselSource.from_batch(
+            batch, mesh, morsel_rows=128,
+            predicate=("x", "<", int(vals[8])),
+            zone_map=encode_for(
+                Column(jnp.asarray(vals), jnp.ones((n,), jnp.bool_),
+                       T.INT64), block=128).zone)
+        assert src.blocks_skipped > 0
+
+
 # -- the campaign ----------------------------------------------------------
 
 
@@ -273,7 +315,8 @@ class TestChaosCampaign:
                     for f in report["failures"]]
         assert report["ok"], failures
         # the fast subset still proves the distinctive recovery kinds
-        for kind in ("spill_io", "spill_corrupt", "shuffle_io"):
+        for kind in ("spill_io", "spill_corrupt", "shuffle_io",
+                     "zone_map_corrupt"):
             assert kind in report["kinds_fired"]
         # and every trial actually injected something
         assert all(t["fired"] for t in report["trials"])

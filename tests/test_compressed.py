@@ -26,6 +26,8 @@ every seam:
   exactly.
 """
 
+import dataclasses
+
 import numpy as np
 import pytest
 
@@ -45,6 +47,9 @@ from spark_rapids_jni_tpu.columnar.encoded import (
     gather_bitpacked,
     is_encoded,
     materialize_batch,
+    packed_decode_count,
+    packed_filter_mask,
+    reset_packed_decode_count,
     pack_bits,
     pack_bits_rows,
     unpack_bits,
@@ -496,3 +501,346 @@ class TestSpillCodecTierWalk:
         assert m["compressed_bytes"] == m["precompress_bytes"]
         assert m["codec_ratio"] == 1.0
         h.close()
+
+
+# ---------------------------------------------------------------------------
+# packed predicates: comparisons in the compressed domain (zero decodes)
+# ---------------------------------------------------------------------------
+
+_CMP_OPS = ("<", "<=", "==", "!=", ">=", ">")
+
+
+def _np_cmp(op, a, v):
+    import operator as _o
+
+    return {"<": _o.lt, "<=": _o.le, "==": _o.eq, "!=": _o.ne,
+            ">=": _o.ge, ">": _o.gt}[op](a, v)
+
+
+class TestPackedPredicates:
+    """``packed_filter_mask`` vs decode-then-compare, bit for bit, with
+    the decode counter proving the fast path NEVER materializes."""
+
+    def _sweep(self, enc, literals):
+        # the expected side is allowed to decode — once, up front
+        dec = np.asarray(enc.decode().data)
+        reset_packed_decode_count()
+        for op in _CMP_OPS:
+            for v in literals:
+                got = np.asarray(packed_filter_mask(enc, op, int(v)))
+                assert got.shape == dec.shape, (op, v)
+                assert np.array_equal(got, _np_cmp(op, dec, int(v))), (op, v)
+        assert packed_decode_count() == 0  # ZERO decodes on the fast path
+
+    @pytest.mark.parametrize(
+        "width", [1, 2, 3, 5, 8, 13, 16, 21, 27, 31, 32])
+    def test_bitpacked_parity_all_widths(self, width):
+        rng = np.random.default_rng(width)
+        n = 257  # not lane-aligned
+        hi = (1 << width) - 1
+        vals = rng.integers(0, hi + 1, n).astype(np.int64) - 7
+        vals[0], vals[1] = -7, hi - 7  # pin the range -> exact width
+        enc = encode_bitpacked(col_i64(vals))
+        assert isinstance(enc, BitPackedColumn) and enc.width == width
+        # domain edges, out-of-domain on both sides, and a mid literal
+        self._sweep(enc, sorted({-8, -7, 0, int(vals[n // 2]),
+                                 hi - 7, hi - 6}))
+
+    @pytest.mark.parametrize("block", [64, 100])
+    def test_for_parity_block_boundary_literals(self, block):
+        rng = np.random.default_rng(block)
+        n = 1000  # n % 64 != 0: the tail block is partial
+        nb = -(-n // block)
+        base = np.repeat(np.arange(nb, dtype=np.int64) * 10_000, block)[:n]
+        vals = base + rng.integers(0, 500, n)
+        enc = encode_for(col_i64(vals), block=block)
+        assert isinstance(enc, FrameOfReferenceColumn)
+        lits = {int(vals.min()) - 1, int(vals.max()) + 1}
+        for b in (0, 1, nb - 1):  # first, second, and partial-tail block
+            seg = vals[b * block:(b + 1) * block]
+            lits.update((int(seg.min()), int(seg.max())))
+        self._sweep(enc, sorted(lits))
+
+    def test_all_blocks_excluded_and_none_excluded(self):
+        # literals past either end: every mask folds to a constant
+        vals = np.arange(512, dtype=np.int64) + 100
+        for enc in (encode_bitpacked(col_i64(vals)),
+                    encode_for(col_i64(vals), block=64)):
+            reset_packed_decode_count()
+            assert not np.asarray(
+                packed_filter_mask(enc, "<", 100)).any()
+            assert np.asarray(
+                packed_filter_mask(enc, "<=", 10_000)).all()
+            assert not np.asarray(
+                packed_filter_mask(enc, ">", 10_000)).any()
+            assert np.asarray(
+                packed_filter_mask(enc, ">=", -5)).all()
+            assert packed_decode_count() == 0
+
+    def test_for_int64_extreme_frames_no_wrap(self):
+        # value - ref computed in int64 lanes wraps when the literal and
+        # a block reference sit at opposite ends of the int64 domain; a
+        # wrapped block must still classify as out-of-domain on the
+        # literal's side, bit-identical to decode-then-compare (before
+        # the sign-check fix, '<' over refs near -2**62 with a literal
+        # near +2**62 returned all-False where the truth is all-True)
+        big = 1 << 62
+        vals = np.concatenate([
+            -big + np.arange(128, dtype=np.int64),
+            big + np.arange(128, dtype=np.int64)])
+        enc = encode_for(col_i64(vals), block=64)
+        assert isinstance(enc, FrameOfReferenceColumn)
+        self._sweep(enc, [-big - 1, -big + 5, 0, big + 5, big + 200])
+
+    def test_null_rows_compare_on_decoded_values(self):
+        # decode() is validity-independent (invalid rows decode to the
+        # reference) — the packed mask must match that, NOT re-AND
+        # validity
+        vals = np.arange(64, dtype=np.int64) + 5
+        valid = np.ones(64, bool)
+        valid[::7] = False
+        for enc in (encode_bitpacked(col_i64(vals, valid)),
+                    encode_for(col_i64(vals, valid), block=16)):
+            self._sweep(enc, [4, 20, 69])
+
+    def test_knob_off_decodes_and_matches(self):
+        vals = np.arange(100, dtype=np.int64)
+        enc = encode_bitpacked(col_i64(vals))
+        config.set("packed_predicates", False)
+        reset_packed_decode_count()
+        got = np.asarray(packed_filter_mask(enc, "<", 50))
+        assert packed_decode_count() == 1  # the exact-parity fallback
+        assert np.array_equal(got, vals < 50)
+
+    def test_non_int_literal_falls_back(self):
+        vals = np.arange(100, dtype=np.int64)
+        enc = encode_for(col_i64(vals), block=32)
+        reset_packed_decode_count()
+        got = np.asarray(packed_filter_mask(enc, "<", 49.5))
+        assert packed_decode_count() == 1
+        assert np.array_equal(got, vals < 49.5)
+
+    def test_compile_routes_packed_filters(self):
+        # the IR Filter lowering must take the packed path, no decode
+        from spark_rapids_jni_tpu.plan.compile import _filter_mask
+
+        vals = np.arange(2048, dtype=np.int64) * 3
+        for enc in (encode_bitpacked(col_i64(vals)),
+                    encode_for(col_i64(vals), block=256)):
+            reset_packed_decode_count()
+            got = np.asarray(_filter_mask(enc, ">=", 3000))
+            assert packed_decode_count() == 0
+            assert np.array_equal(got, vals >= 3000)
+
+    def test_plan_filter_parity_on_packed_input(self):
+        # a full q6-shaped plan over a bit-packed filter column equals
+        # the same plan over the plain column
+        from spark_rapids_jni_tpu import plan
+        from spark_rapids_jni_tpu.plan.ir import Agg, Aggregate, Filter, Scan
+        from tests.test_plan import assert_bit_identical
+
+        rng = np.random.default_rng(5)
+        n = 2048
+        price = rng.integers(0, 100, n).astype(np.int64)
+        batch = {
+            "k": col(rng.integers(0, 10, n).astype(np.int32), T.INT32),
+            "v": col_i64(rng.integers(0, 1000, n)),
+            "price": col_i64(price),
+        }
+        p = Aggregate(Filter(Scan("batch"), "price", "<", 50),
+                      keys=("k",),
+                      aggs=(Agg("sum", "v", "sum_v"),
+                            Agg("count", None, "cnt")),
+                      domain=10, onehot=True)
+        want = plan.execute(p, {"batch": ColumnBatch(dict(batch))})
+        packed = dict(batch)
+        packed["price"] = encode_bitpacked(batch["price"])
+        got = plan.execute(p, {"batch": ColumnBatch(packed)})
+        assert_bit_identical(got, want)
+
+
+# ---------------------------------------------------------------------------
+# zone maps: the sidecar and morsel-level block skipping
+# ---------------------------------------------------------------------------
+
+class TestZoneMaps:
+    def test_sidecar_stats_exact_with_partial_tail(self):
+        # n % block != 0: the tail block's stats come from its REAL rows
+        # only — padding lanes must never widen (or narrow) the range
+        rng = np.random.default_rng(11)
+        n, block = 1000, 128
+        vals = rng.integers(-500, 500, n).astype(np.int64)
+        enc = encode_for(col_i64(vals), block=block)
+        zm = enc.zone
+        assert zm is not None and zm.rows == n and zm.block == block
+        assert zm.num_blocks == -(-n // block)
+        dec = np.asarray(enc.decode().data)
+        for b in range(zm.num_blocks):
+            seg = dec[b * block:(b + 1) * block]
+            assert zm.mins[b] == seg.min(), b
+            assert zm.maxs[b] == seg.max(), b
+        zm.verify()  # and the stamp matches what build() wrote
+
+    def test_bitpacked_sidecar_tail_and_skip_decision(self):
+        n = 1100  # 1024-row zone blocks -> 76-row partial tail
+        vals = np.arange(n, dtype=np.int64)
+        enc = encode_bitpacked(col_i64(vals))
+        zm = enc.zone
+        assert zm.num_blocks == 2 and zm.rows == n
+        assert zm.maxs[1] == n - 1  # real tail max, not padding
+        # a literal beyond the tail's real max excludes the tail block
+        assert not zm.block_may_match(">", n - 1)[1]
+        assert zm.block_may_match(">=", n - 1)[1]
+
+    def test_corrupt_sidecar_fails_loud(self):
+        enc = encode_for(col_i64(np.arange(256, dtype=np.int64)), block=64)
+        lying = dataclasses.replace(enc.zone,
+                                    maxs=enc.zone.maxs ^ np.int64(1))
+        with pytest.raises(faultinj.ZoneMapCorruptionError):
+            lying.verify()
+
+    def test_encode_batch_tags_sidecar_with_column_name(self):
+        from spark_rapids_jni_tpu.columnar.encoded import encode_batch
+
+        batch = ColumnBatch({"x": col_i64(np.arange(256)),
+                             "y": col_i64(np.arange(256))})
+        enc = encode_batch(batch, bitpack=["x"], frame_of_reference=["y"])
+        assert enc["x"].zone.column == "x"
+        assert enc["y"].zone.column == "y"
+        enc["x"].zone.verify()  # the tag is part of the stamp
+        enc["y"].zone.verify()
+
+    def test_tampered_column_tag_fails_crc(self):
+        enc = encode_for(col_i64(np.arange(256, dtype=np.int64)),
+                         block=64, column="x")
+        assert enc.zone.column == "x"
+        with pytest.raises(faultinj.ZoneMapCorruptionError):
+            dataclasses.replace(enc.zone, column="y").verify()
+
+    def test_knob_off_encodes_without_sidecar(self):
+        config.set("zone_maps", False)
+        enc = encode_for(col_i64(np.arange(256, dtype=np.int64)), block=64)
+        assert enc.zone is None
+
+    def test_tree_round_trip_drops_sidecar(self):
+        # the sidecar is host metadata, NOT a pytree child: any tree
+        # round-trip (shard, jit, device_put) reconstructs without it
+        enc = encode_for(col_i64(np.arange(256, dtype=np.int64)), block=64)
+        leaves, treedef = jax.tree_util.tree_flatten(enc)
+        back = jax.tree_util.tree_unflatten(treedef, leaves)
+        assert enc.zone is not None and back.zone is None
+
+
+class TestZoneMapMorselSkip:
+    def _setup(self, eight_devices, thresh_q=0.01):
+        from spark_rapids_jni_tpu.parallel import data_mesh, shard_batch
+
+        P, n = 8, 8192
+        rng = np.random.default_rng(7)
+        vals = np.sort(rng.integers(0, 1 << 20, n)).astype(np.int64)
+        keys = rng.integers(0, 64, n).astype(np.int64)
+        enc = encode_for(col_i64(vals), block=256)
+        mesh = data_mesh(P)
+        batch = shard_batch(ColumnBatch({
+            "k": col_i64(keys), "x": col_i64(vals)}), mesh)
+        thresh = int(np.quantile(vals, thresh_q))
+        return mesh, batch, enc.zone, thresh, vals
+
+    def test_skips_blocks_and_streams_bit_identical(self, eight_devices):
+        from spark_rapids_jni_tpu.shuffle import (MorselSource,
+                                                  ShuffleRegistry,
+                                                  ShuffleService)
+
+        mesh, batch, zone, thresh, _ = self._setup(eight_devices)
+        reg = ShuffleRegistry()
+        svc = ShuffleService(mesh, registry=reg)
+        src = MorselSource.from_batch(batch, mesh, morsel_rows=128,
+                                      predicate=("x", "<", thresh),
+                                      zone_map=zone)
+        assert src.blocks_skipped > 0  # 1% selectivity MUST skip
+        res = svc.exchange_stream(src, key_names=["k"])
+        full = svc.exchange_stream(
+            MorselSource.from_batch(batch, mesh, morsel_rows=128),
+            key_names=["k"])
+
+        def survivors(r):
+            xs = np.asarray(r.batch["x"].data).reshape(-1)
+            vs = np.asarray(r.batch["x"].validity).reshape(-1)
+            ks = np.asarray(r.batch["k"].data).reshape(-1)
+            return sorted((k, x) for k, x, v in zip(ks, xs, vs)
+                          if v and x < thresh)
+
+        assert survivors(res) == survivors(full)
+        # counters ride result AND registry metrics
+        assert res.blocks_skipped == src.blocks_skipped
+        snap = reg.metrics.snapshot()
+        assert snap["blocks_skipped"] >= src.blocks_skipped
+        assert snap["blocks_scanned"] >= src.blocks_scanned > 0
+
+    def test_all_excluded_keeps_schema_morsel(self, eight_devices):
+        from spark_rapids_jni_tpu.shuffle import MorselSource
+
+        mesh, batch, zone, _, vals = self._setup(eight_devices)
+        src = MorselSource.from_batch(
+            batch, mesh, morsel_rows=128,
+            predicate=("x", "<", int(vals.min())), zone_map=zone)
+        assert len(src) == 1  # the schema-bearing morsel survives
+        assert src.blocks_skipped > 0
+
+    def test_none_excluded_scans_everything(self, eight_devices):
+        from spark_rapids_jni_tpu.shuffle import MorselSource
+
+        mesh, batch, zone, _, vals = self._setup(eight_devices)
+        src = MorselSource.from_batch(
+            batch, mesh, morsel_rows=128,
+            predicate=("x", "<=", int(vals.max())), zone_map=zone)
+        assert src.blocks_skipped == 0 and src.blocks_scanned > 0
+
+    def test_wrong_column_sidecar_never_skips(self, eight_devices):
+        from spark_rapids_jni_tpu.shuffle import MorselSource
+
+        mesh, batch, _, thresh, vals = self._setup(eight_devices)
+        # same row count but tagged with a different column: refused —
+        # a wrong-column sidecar would skip morsels the x filter keeps
+        wrong = encode_for(col_i64(vals), block=256, column="k").zone
+        src = MorselSource.from_batch(batch, mesh, morsel_rows=128,
+                                      predicate=("x", "<", thresh),
+                                      zone_map=wrong)
+        assert src.blocks_skipped == 0 and src.blocks_scanned == 0
+        # tagged with the filter column, the same stats skip again
+        tagged = encode_for(col_i64(vals), block=256, column="x").zone
+        src = MorselSource.from_batch(batch, mesh, morsel_rows=128,
+                                      predicate=("x", "<", thresh),
+                                      zone_map=tagged)
+        assert src.blocks_skipped > 0
+
+    def test_reused_source_records_counters_once(self, eight_devices):
+        from spark_rapids_jni_tpu.shuffle import (MorselSource,
+                                                  ShuffleRegistry,
+                                                  ShuffleService)
+
+        mesh, batch, zone, thresh, _ = self._setup(eight_devices)
+        reg = ShuffleRegistry()
+        svc = ShuffleService(mesh, registry=reg)
+        src = MorselSource.from_batch(batch, mesh, morsel_rows=128,
+                                      predicate=("x", "<", thresh),
+                                      zone_map=zone)
+        first = svc.exchange_stream(src, key_names=["k"])
+        assert first.blocks_skipped == src.blocks_skipped > 0
+        base = reg.metrics.snapshot()["blocks_skipped"]
+        # replays are re-runnable: a second exchange over the SAME
+        # source must not re-record its one-time skip decision
+        second = svc.exchange_stream(src, key_names=["k"])
+        assert second.blocks_skipped == 0
+        assert reg.metrics.snapshot()["blocks_skipped"] == base
+        assert src.blocks_skipped > 0  # the public counter survives
+
+    def test_knob_off_never_skips(self, eight_devices):
+        from spark_rapids_jni_tpu.shuffle import MorselSource
+
+        config.set("zone_maps", False)
+        mesh, batch, zone, thresh, _ = self._setup(eight_devices)
+        src = MorselSource.from_batch(batch, mesh, morsel_rows=128,
+                                      predicate=("x", "<", thresh),
+                                      zone_map=zone)
+        assert src.blocks_skipped == 0 and src.blocks_scanned == 0

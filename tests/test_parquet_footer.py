@@ -256,3 +256,142 @@ def read_parquet_cols(path, **kw):
     from spark_rapids_jni_tpu.io.parquet import read_parquet
 
     return read_parquet(path, **kw)
+
+
+# ---------------------------------------------------------------------------
+# predicate pruning: row groups whose stats cannot satisfy the filter
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def gapped_file(tmp_path):
+    """Interleaved low/high ranges: groups 0,2 hold 0..99 and groups
+    1,3 hold 1000..1099, so a high predicate keeps NON-consecutive
+    groups — the span-mapping edge case."""
+    import numpy as np
+
+    path = str(tmp_path / "gapped.parquet")
+    a = np.r_[np.arange(100), np.arange(100) + 1000,
+              np.arange(100), np.arange(100) + 1000]
+    pq.write_table(pa.table({"a": pa.array(a, pa.int64())}), path,
+                   row_group_size=100)
+    return path
+
+
+class TestPredicatePruning:
+    @pytest.fixture(autouse=True)
+    def _reset_config(self):
+        from spark_rapids_jni_tpu import config
+
+        yield
+        config.reset()
+
+    def test_stats_prune_drops_cold_groups(self, flat_file):
+        from spark_rapids_jni_tpu.io.parquet import prune_row_groups
+
+        meta = pq.ParquetFile(flat_file).metadata
+        keep, pruned = prune_row_groups(meta, range(10), ("a", "<", 250))
+        assert keep == [0, 1, 2] and pruned == 7
+        keep, pruned = prune_row_groups(meta, range(10), ("a", ">=", 950))
+        assert keep == [9] and pruned == 9
+        keep, pruned = prune_row_groups(meta, range(10), ("a", "==", 437))
+        assert keep == [4] and pruned == 9
+
+    def test_pruned_read_unions_to_exact_result(self, flat_file):
+        import numpy as np
+
+        full = read_parquet_cols(flat_file, columns=["a"])
+        a_full = np.asarray(full["a"].data)
+        for pred in (("a", "<", 250), ("a", ">=", 950), ("a", "==", 437),
+                     ("a", "!=", 0), ("a", "<=", 99), ("a", ">", 998)):
+            col, op, v = pred
+            got = read_parquet_cols(flat_file, columns=["a"],
+                                    predicate=pred)
+            a_got = np.asarray(got["a"].data)
+            import operator as _o
+
+            fn = {"<": _o.lt, "<=": _o.le, "==": _o.eq, "!=": _o.ne,
+                  ">=": _o.ge, ">": _o.gt}[op]
+            # the filter applied downstream of the pruned scan must
+            # equal the filter over the full scan — nothing lost
+            assert sorted(a_got[fn(a_got, v)].tolist()) == \
+                sorted(a_full[fn(a_full, v)].tolist()), pred
+
+    def test_all_pruned_keeps_schema_group(self, flat_file):
+        from spark_rapids_jni_tpu.io.parquet import prune_row_groups
+
+        meta = pq.ParquetFile(flat_file).metadata
+        keep, pruned = prune_row_groups(meta, range(10), ("a", "<", -5))
+        assert keep == [0] and pruned == 9  # schema-bearing survivor
+
+    def test_unpushable_predicates_keep_everything(self, flat_file):
+        from spark_rapids_jni_tpu.io.parquet import prune_row_groups
+
+        meta = pq.ParquetFile(flat_file).metadata
+        # string literal: not a stats-comparable value
+        assert prune_row_groups(meta, range(10),
+                                ("a", "<", "zzz"))[1] == 0
+        # type-mismatched column (string stats vs int literal):
+        # conservative keep via the TypeError guard
+        assert prune_row_groups(meta, range(10), ("b", "<", 5))[1] == 0
+        # unknown column: nothing to consult
+        assert prune_row_groups(meta, range(10),
+                                ("nope", "<", 5))[1] == 0
+
+    def test_knob_off_keeps_everything(self, flat_file):
+        from spark_rapids_jni_tpu import config
+        from spark_rapids_jni_tpu.io.parquet import prune_row_groups
+
+        config.set("scan_pruning", False)
+        meta = pq.ParquetFile(flat_file).metadata
+        assert prune_row_groups(meta, range(10), ("a", "<", 250))[1] == 0
+
+    def test_prune_spans_union_to_surviving_groups(self, gapped_file):
+        from spark_rapids_jni_tpu.io.parquet_footer import (
+            predicate_prune_spans)
+
+        spans = predicate_prune_spans(gapped_file, ("a", ">=", 900))
+        assert len(spans) == 2  # non-consecutive survivors -> two runs
+        groups = rows = 0
+        for off, length in spans:
+            with ParquetFooter.read_and_filter(gapped_file, off,
+                                               length) as f:
+                groups += f.num_row_groups
+                rows += f.num_rows
+        assert groups == 2 and rows == 200  # exactly groups 1 and 3
+
+    def test_prune_spans_single_run(self, flat_file):
+        from spark_rapids_jni_tpu.io.parquet_footer import (
+            predicate_prune_spans)
+
+        spans = predicate_prune_spans(flat_file, ("a", "<", 250))
+        assert len(spans) == 1
+        off, length = spans[0]
+        with ParquetFooter.read_and_filter(flat_file, off, length) as f:
+            assert f.num_row_groups == 3 and f.num_rows == 300
+
+    def test_from_parquet_never_replays_pruned_groups(
+            self, flat_file, eight_devices):
+        import numpy as np
+
+        from spark_rapids_jni_tpu.parallel import data_mesh
+        from spark_rapids_jni_tpu.shuffle import MorselSource
+
+        mesh = data_mesh(8)
+        src = MorselSource.from_parquet(flat_file, mesh, columns=["a"],
+                                        morsel_rows=16,
+                                        predicate=("a", "<", 250))
+        assert src.row_groups_pruned == 7
+        assert src.row_groups_scanned == 3
+        full = MorselSource.from_parquet(flat_file, mesh, columns=["a"],
+                                         morsel_rows=16)
+        assert full.row_groups_pruned == 0
+        assert len(src) < len(full)  # pruned groups built NO replays
+        seen = []
+        for replay in src:
+            b, rv = replay()
+            a = np.asarray(b["a"].data)
+            seen.extend(a[np.asarray(rv)].tolist())
+        # every row the filter may keep is present, no cold-group rows
+        assert sorted(x for x in seen if x < 250) == list(range(250))
+        assert all(x < 300 for x in seen)  # only groups 0..2 decoded

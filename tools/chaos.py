@@ -1316,6 +1316,103 @@ class ElasticScenario:
                                     if k != "liveness"}}}
 
 
+class ZoneMapScenario:
+    """Zone-map block skipping under fire: a 1%-selective predicate over
+    a sorted FoR-encoded column prunes the morsel stream through its
+    sidecar before the streaming exchange drains it.
+    ``zone_map_corrupt`` fires ONLY here and in the compressed tests:
+    this trial keeps the kind in the coverage check.  The injected fault
+    at the ``zone_map_check`` probe becomes REAL damage (the sidecar's
+    max stats flipped after the CRC stamp) and the mandatory verify
+    raises ``ZoneMapCorruptionError`` LOUDLY at skip time — a lying
+    sidecar may never silently return wrong rows.  The scenario then
+    recovers the only sound way: re-encode from source (a fresh sidecar
+    is the lineage) and re-run the pruned stream, proving the recovered
+    result is bit-identical to the fault-free baseline AND still skipped
+    (``blocks_skipped > 0``) — corruption can't scare the planner into
+    permanent full scans."""
+
+    name = "zone_map"
+    task_id = 204
+
+    def run(self) -> Dict:
+        from spark_rapids_jni_tpu.columnar.encoded import encode_for
+        from spark_rapids_jni_tpu.faultinj import ZoneMapCorruptionError
+        from spark_rapids_jni_tpu.parallel import data_mesh, shard_batch
+        from spark_rapids_jni_tpu.shuffle import (
+            MorselSource,
+            ShuffleRegistry,
+            ShuffleService,
+        )
+
+        if len(jax.devices()) < 8:
+            raise ChaosError(
+                "zone_map scenario needs 8 devices; set XLA_FLAGS="
+                "--xla_force_host_platform_device_count=8 before jax init")
+        P = 8
+        n = P * 1024
+        # sorted values give the sidecar real locality: a 1%-selective
+        # "<" predicate leaves whole zone blocks provably empty (the
+        # 2^20 domain keeps per-block residuals inside FoR's u32 lanes)
+        vals = np.sort(
+            (np.arange(n, dtype=np.int64) * 2654435761) % (1 << 20))
+        keys = (np.arange(n, dtype=np.int64) * 40503) % 64
+        thresh = int(vals[n // 100])
+        mesh = data_mesh(P)
+        ones = jnp.ones((n,), jnp.bool_)
+        xcol = Column(jnp.asarray(vals), ones, T.INT64)
+        batch = shard_batch(ColumnBatch({
+            "k": Column(jnp.asarray(keys), ones, T.INT64),
+            "x": xcol}), mesh)
+        # roomy arenas: this scenario stresses the skip-decision seam,
+        # not the spill tiers (streaming_scan owns that fault domain)
+        with _harness(64 * MB, 16 * MB, self.name) as (fw, adaptor):
+            reg = ShuffleRegistry()
+            with TaskContext(self.task_id) as ctx:
+                def attempt():
+                    # sharding is a pytree round-trip (it drops the
+                    # column-attached sidecar), so the zone map rides
+                    # in explicitly from the encode step
+                    zone = encode_for(xcol, block=256).zone
+                    src = MorselSource.from_batch(
+                        batch, mesh, morsel_rows=128,
+                        predicate=("x", "<", thresh), zone_map=zone)
+                    res = ShuffleService(
+                        mesh, registry=reg).exchange_stream(
+                            src, key_names=["k"], ctx=ctx,
+                            round_rows=256)
+                    return (_digest((res.batch, res.occupancy)),
+                            src.blocks_skipped)
+
+                def body():
+                    reencodes = 0
+                    while True:
+                        try:
+                            d, skipped = attempt()
+                            return d, skipped, reencodes
+                        except ZoneMapCorruptionError:
+                            # the loud failure just proved itself; the
+                            # only recovery is a fresh encode — the
+                            # source column is the sidecar's lineage
+                            reencodes += 1
+                            if reencodes > 3:
+                                raise
+                digest, skipped, reencodes = run_with_retry(
+                    body, make_spillable=_always_retry(fw))
+            RmmSpark.task_done(self.task_id)
+            _check_invariants(fw, adaptor)
+        if skipped <= 0:
+            raise ChaosError(
+                "zone_map degenerated: blocks_skipped=0 — the "
+                "1%-selective stream no longer skips, the trial "
+                "proves nothing")
+        snap = reg.metrics.snapshot()
+        return {"digest": digest,
+                "extra": {"blocks_skipped": skipped,
+                          "blocks_scanned": snap["blocks_scanned"],
+                          "zone_reencodes": reencodes}}
+
+
 SCENARIOS = {s.name: s for s in (SpillScenario(), ShuffleScenario(),
                                  Q95Scenario(), SortScenario(),
                                  StreamingScanScenario(), JniScenario(),
@@ -1324,7 +1421,7 @@ SCENARIOS = {s.name: s for s in (SpillScenario(), ShuffleScenario(),
                                  MultihostScenario(),
                                  DataPlaneScenario(),
                                  ResultCacheScenario(),
-                                 ElasticScenario())}
+                                 ElasticScenario(), ZoneMapScenario())}
 
 
 # ---------------------------------------------------------------------------
@@ -1484,6 +1581,16 @@ def single_fault_trials(fast: bool = False) -> List[Trial]:
             engines=_PALLAS_STREAM)
         one("streaming_scan", "host_corrupt_probe", "host_corrupt",
             engines=_PALLAS_STREAM)
+
+    # zone_map scenario: the skip-decision seam.  zone_map_corrupt fires
+    # ONLY here and in the compressed tests — this trial keeps the kind
+    # in the coverage check.  The injected fault becomes real post-CRC
+    # stat damage, the mandatory verify fails LOUD, and the scenario
+    # recovers by re-encoding (fresh sidecar = lineage) to the
+    # fault-free baseline's exact digest, still skipping blocks.
+    one("zone_map", "zone_map_check", "zone_map_corrupt")
+    if not fast:
+        one("zone_map", "zone_map_check", "zone_map_corrupt", count=2)
 
     # sort scenario: the distributed-sort seam (pre-plan and post-sort)
     if not fast:
