@@ -884,6 +884,99 @@ def serve_main():
               file=sys.stderr, flush=True)
         return 1
 
+    # failover sub-wave: the SUPERVISOR itself killed mid-wave.  The
+    # write-ahead session journal (serve/journal.py) makes the front
+    # door recoverable: a journaled door takes the same ``q6_digest``
+    # query set, is crash-simulated once a live session is RUNNING on a
+    # worker, and a FRESH door adopts the same fleet dir — journal
+    # replay, dead-generation fencing, resume-token re-dial of the
+    # surviving workers, re-placement of every in-flight session.
+    # ``failover_recovery_ms`` is the adoption wall (replacement
+    # supervisor construction through a fully replayed state); every
+    # recovered result must STILL match solo bit for bit, and the
+    # note's failover fields ride the ci/q95_floor.json
+    # ``failover_recovery_floor`` ratchet.
+    ffd = FrontDoor(workers=2, pool_bytes=pool, host_pool_bytes=host_pool,
+                    max_concurrent=2, partition_grace_ms=8000.0,
+                    reconnect_max=60)
+    fo_fleet = ffd.fleet_dir
+    afd = None
+    try:
+        fo_sessions = {
+            (i, k): ffd.submit(
+                "q6_digest",
+                {"rows": n_rows, "stream": i, "query": k, "steps": steps},
+                tenant=f"stream-{i}", est_bytes=batch_bytes)
+            for i in range(n_streams) for k in range(n_queries)}
+        # kill only once the fleet is genuinely mid-wave — a live
+        # session placed on a worker — so the recovery claim is never
+        # vacuous; if the wave somehow outruns the poll, crash the
+        # idle-but-journaled door (adoption must still re-dial workers)
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            with ffd._lock:
+                placed_live = any(
+                    s.worker_id is not None and not s.done()
+                    for s in fo_sessions.values())
+                all_done = all(s.done() for s in fo_sessions.values())
+            if placed_live or all_done:
+                break
+            time.sleep(0.002)
+        else:
+            print("# serve failover wave: no session ever landed on a "
+                  "worker", file=sys.stderr, flush=True)
+            return 1
+        ffd._simulate_crash()
+        fo_t0 = time.perf_counter()
+        afd = FrontDoor(workers=2, pool_bytes=pool,
+                        host_pool_bytes=host_pool, max_concurrent=2,
+                        partition_grace_ms=8000.0, reconnect_max=60,
+                        adopt_dir=fo_fleet)
+        failover_ms = (time.perf_counter() - fo_t0) * 1e3
+        rec = afd.recovered()
+        adopt_snap = afd.metrics.snapshot()
+        fo = {}
+        for key, old in fo_sessions.items():
+            if old.sid in rec:
+                fo[key] = rec[old.sid].result(timeout=300.0)
+            else:  # finished (and delivered) before the crash landed
+                fo[key] = old.result(timeout=30.0)
+        # quiesce: every adopted worker must finish its resume-token
+        # reattach before the drain, or the graceful shutdown op has no
+        # link to ride and the worker is misreported wedged
+        quiet_by = time.monotonic() + 20.0
+        while time.monotonic() < quiet_by:
+            with afd._lock:
+                ws = list(afd._workers.values())
+                quiet = bool(ws) and all(w.state == "healthy"
+                                         for w in ws)
+            if quiet:
+                break
+            time.sleep(0.01)
+    except Exception as e:
+        print(f"# serve failover wave failed: {e!r}", file=sys.stderr,
+              flush=True)
+        return 1
+    finally:
+        fo_report = afd.shutdown() if afd is not None else None
+        ffd.shutdown()  # crashed-door no-op; real reap if crash never fired
+    fo_drift = [key for key in solo if solo[key][0] != fo[key][0]]
+    if fo_drift:
+        print(f"# serve scenario: failover results DIFFER from solo for "
+              f"{sorted(fo_drift)}", file=sys.stderr, flush=True)
+        return 1
+    if fo_report is None or not fo_report["clean"]:
+        print(f"# serve scenario: adopted fleet shutdown unclean: "
+              f"{(fo_report or {}).get('workers')}",
+              file=sys.stderr, flush=True)
+        return 1
+    adopted_workers = int(adopt_snap.get("adopted_workers", 0))
+    if adopted_workers < 1:
+        print("# serve scenario: failover adopted no workers — the "
+              "resume-token re-dial path is dead",
+              file=sys.stderr, flush=True)
+        return 1
+
     solo_lat = [dt * 1e3 for _, dt in solo.values()]
     conc_lat = [dt * 1e3 for _, dt in conc.values()]
     mp_lat = [dt * 1e3 for _, dt in mp.values()]
@@ -935,6 +1028,13 @@ def serve_main():
             "recovery_ms": round(recovery_ms, 2),
             "recovery_vs": round(replay_ms / recovery_ms, 3)
             if recovery_ms else 0.0,
+            "failover_recovery_ms": round(failover_ms, 2),
+            "adopted_workers": adopted_workers,
+            "recovered_sessions": int(
+                adopt_snap.get("recovered_sessions", 0)),
+            "replayed_sessions": int(
+                adopt_snap.get("replayed_sessions", 0)),
+            "failover_bit_identical": True,
         },
     }), flush=True)
     return 0

@@ -23,7 +23,14 @@
 # autoscaler is still adding capacity, launches failed at the launcher
 # boundary, and drains wedged past the deadline must all converge to
 # bit-identical digests with >=1 scale-up, >=1 retirement, and zero
-# fenced commits on every drained generation).
+# fenced commits on every drained generation; supervisor_failover = the
+# SUPERVISOR itself killed mid-wave — deliberately every run and again
+# wherever supervisor_crash/journal_torn rules land on the write-ahead
+# journal's append seam, plus an adopting generation killed mid-replay —
+# with every death resolved by a fresh FrontDoor adopting the same
+# fleet dir: journal replay, dead-generation fencing, resume-token
+# re-dial, re-placement, a double-restart leg that must resurrect
+# nothing, and a journal-proven zero-duplicate-run audit).
 #
 # Runs tools/chaos.py — every faultinj.FAULT_KINDS entry fired at every
 # instrumented boundary (one fault per trial, exhaustively) plus seeded
@@ -53,7 +60,7 @@ import json, sys
 doc = json.load(open(sys.argv[1]))
 for scenario in ("sort", "streaming_scan", "jni", "serving", "frontdoor",
                  "store_recovery", "multihost", "dataplane",
-                 "result_cache", "elastic"):
+                 "result_cache", "elastic", "supervisor_failover"):
     trials = [t for t in doc["trials"]
               if t["label"].startswith(scenario + ":")]
     assert trials, f"chaos report has no {scenario!r} trials"

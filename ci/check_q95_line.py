@@ -64,6 +64,17 @@ batches, and the payload-bytes / descriptor-JSON-bytes ``reduction``
 (both arms) must not shrink below ``serve_wire_floor`` — the proof that
 result payloads stay OFF the JSON control wire.
 
+Since r20 the note also carries the supervisor-failover evidence: the
+sub-wave crash-simulates the SUPERVISOR mid-wave and a fresh FrontDoor
+adopts the same fleet dir off the write-ahead session journal.
+``failover_bit_identical`` must be true (every recovered result matched
+the solo digest), ``adopted_workers >= 1`` (surviving workers actually
+re-attached over resume tokens), the
+``recovered_sessions``/``replayed_sessions`` counters must be present,
+and ``failover_recovery_ms`` — the replacement supervisor's adoption
+wall — must stay under the ``failover_recovery_floor`` budget (an
+upper bound that only shrinks, unlike the ratio floors).
+
 Since r14 the pallas device-kernel rows get the same treatment:
 
 * the three micro A/B rows (``slot_build_pallas``,
@@ -154,6 +165,7 @@ def main(paths) -> int:
     serve_floor = floors["serve_p99_floor"]
     recovery_floor = floors["serve_recovery_floor"]
     wire_floor = floors["serve_wire_floor"]
+    failover_floor = floors["failover_recovery_floor"]
     pallas_floor = floors["pallas_vs_lax_floor"]
     md_floor = floors["multidevice_vs_lax_floor"]
     md_q95_floor = floors["multidevice_q95_floor"]
@@ -288,6 +300,32 @@ def main(paths) -> int:
                         f"{serve_note.get('recovery_vs')} (replay wall / "
                         f"adopt wall) regressed below the recorded floor "
                         f"{recovery_floor} (ci/q95_floor.json)")
+        elif serve_note.get("failover_bit_identical") is not True:
+            errs.append("serve line's note.failover_bit_identical is not "
+                        "true: the supervisor-failover wave fell out of "
+                        "the smoke or its recovered results no longer "
+                        "prove themselves against the solo pass "
+                        f"(note={json.dumps(serve_note)})")
+        elif int(serve_note.get("adopted_workers", 0)) < 1:
+            errs.append("serve line's note.adopted_workers < 1: the "
+                        "replacement supervisor re-dialed no surviving "
+                        "workers — the resume-token adoption path is dead "
+                        f"(note={json.dumps(serve_note)})")
+        elif ("failover_recovery_ms" not in serve_note
+                or "recovered_sessions" not in serve_note
+                or "replayed_sessions" not in serve_note):
+            errs.append("serve line's failover_recovery_ms/"
+                        "recovered_sessions/replayed_sessions evidence "
+                        "is missing: the supervisor-failover sub-wave "
+                        "fell out of the smoke (bench.py serve_main) "
+                        f"(note={json.dumps(serve_note)})")
+        elif float(serve_note.get("failover_recovery_ms", 0.0)) \
+                > failover_floor:
+            errs.append(f"serve failover_recovery_ms "
+                        f"{serve_note.get('failover_recovery_ms')} "
+                        f"(supervisor adoption wall) exceeded the "
+                        f"recorded budget {failover_floor} "
+                        f"(ci/q95_floor.json failover_recovery_floor)")
         else:
             sw = serve_note.get("serve_wire")
             if (not isinstance(sw, dict)

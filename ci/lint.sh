@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # Lint-clean gate: graftlint (tools/graftlint/) is the Python/JAX-layer
 # analogue of the reference's test-with-sanitizer profile — twenty AST
-# rules (GL001-GL020) encoding bug classes this repo has actually
+# rules (GL001-GL021) encoding bug classes this repo has actually
 # shipped (GL001 is the PR 2 module-level-jnp UnexpectedTracerError
 # class; GL017-GL020 are the whole-program lock-discipline and
 # chaos-coverage rules).  Fails on any finding that is neither

@@ -476,6 +476,31 @@ _register("serve_plan_warm", 4, int,
           "them off the critical path so a fresh generation doesn't "
           "pay first-query compile for warm tenant classes.  0 "
           "disables the warm hand-off.")
+_register("serve_journal", True, _parse_bool,
+          "Write-ahead session journal of the front door (serve/"
+          "journal.py): every session lifecycle transition and fleet "
+          "fact is appended O_APPEND+fsync with a per-record CRC32 "
+          "trailer to <fleet_dir>/journal.wal BEFORE the in-memory "
+          "state mutates, so a supervisor crash loses no committed "
+          "fact.  Off = PR-19 behavior (supervisor death loses the "
+          "fleet).")
+_register("serve_adopt", True, _parse_bool,
+          "Restart adoption: a FrontDoor constructed with adopt_dir= "
+          "pointed at a dead supervisor's fleet dir replays the "
+          "journal, fences the dead generations (stamp/revoke), "
+          "re-dials surviving workers over the resume-token hello, and "
+          "re-places journal-known queued/replayable sessions.  Off = "
+          "adopt_dir is refused loudly.")
+_register("serve_orphan_grace_ms", 0.0, float,
+          "Orphaned-worker self-fence grace: a worker that has heard "
+          "NOTHING from its supervisor (no pings, no frames) for this "
+          "long — even over a socket that still looks up — assumes the "
+          "supervisor died without closing the link, and runs the "
+          "self-fence ladder (revoke own epoch, sentinel, drain, exit "
+          "rc=3) so a never-restarted supervisor leaks no processes "
+          "and no unfenced generations.  0 disables (the reconnect "
+          "ladder + serve_partition_grace_ms still cover dead-socket "
+          "orphans).")
 
 
 def get(key: str):

@@ -92,7 +92,19 @@ boundary — instrumented jitted callables — since there is no CUPTI:
   ``worker_drain`` probe (serve/worker.py) — a retiring worker that
   acknowledges the drain order but never finishes it, forcing the
   supervisor's drain deadline to escalate to a hard kill while the
-  retired generation still ends fenced with zero zombie commits.
+  retired generation still ends fenced with zero zombie commits,
+  ``"supervisor_crash"`` raises :class:`SupervisorCrash` at the session
+  journal's ``journal_append``/``journal_replay`` probes
+  (serve/journal.py) — the front door converts it into REAL supervisor
+  death (``_simulate_crash``: listener and every worker link die
+  abruptly, no cleanup, no fencing, no journal finalize) and the only
+  recovery is a NEW FrontDoor adopting the fleet dir by journal replay,
+  ``"journal_torn"`` raises :class:`JournalTornError` at the journal's
+  ``journal_append`` probe — the journal converts it into REAL damage
+  (the just-appended record's tail bytes are truncated on disk,
+  modelling a write torn by the crash that accompanies it) and then
+  surfaces the crash; replay must truncate the torn tail cleanly and
+  the lost transition replays through the adoption ladder.
 * ``dynamic: true`` re-reads the file when its mtime changes, matching
   the injector's ``dynamicReconfig`` thread without needing one.
 
@@ -457,6 +469,41 @@ def _raise_drain_stuck(name: str):
     raise DrainStuckError(f"injected stuck drain at {name}")
 
 
+class SupervisorCrash(RuntimeError):
+    """The supervisor died abruptly (kind ``"supervisor_crash"``).
+
+    Raised at the session journal's ``journal_append`` /
+    ``journal_replay`` probes (serve/journal.py).  The front door's
+    journal helper converts it into real supervisor death —
+    ``FrontDoor._simulate_crash()`` closes the listener and every
+    worker link with NO cleanup (no fencing, no reaping, no journal
+    finalize, sessions left hanging) — exactly the state a SIGKILLed
+    supervisor process leaves behind, minus the interpreter exit the
+    in-process chaos harness cannot survive.  Recovery is a fresh
+    FrontDoor adopting the same fleet dir: journal replay, dead-gen
+    fencing, resume-token re-dials from the orphaned workers."""
+
+
+class JournalTornError(OSError):
+    """The just-appended journal record tore (kind ``"journal_torn"``).
+
+    Raised at the journal's ``journal_append`` probe; the journal
+    converts it into REAL on-disk damage — the tail of the record it
+    just wrote is truncated mid-bytes, before any fsync — and then
+    re-raises, because a torn tail only ever exists when the writer
+    died mid-write (O_APPEND + fsync ordering).  The front door treats
+    it exactly like :class:`SupervisorCrash`; replay must truncate the
+    torn record cleanly and resume from the last intact one."""
+
+
+def _raise_supervisor_crash(name: str):
+    raise SupervisorCrash(f"injected supervisor crash at {name}")
+
+
+def _raise_journal_torn(name: str):
+    raise JournalTornError(f"injected torn journal record at {name}")
+
+
 class ZoneMapCorruptionError(OSError):
     """A zone-map sidecar lies about its blocks (kind ``"zone_map_corrupt"``).
 
@@ -507,6 +554,8 @@ FAULT_KINDS = {
     "scale_up_fail": _raise_scale_up_fail,
     "drain_stuck": _raise_drain_stuck,
     "zone_map_corrupt": _raise_zone_map_corrupt,
+    "supervisor_crash": _raise_supervisor_crash,
+    "journal_torn": _raise_journal_torn,
 }
 
 

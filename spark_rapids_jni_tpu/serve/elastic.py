@@ -158,6 +158,23 @@ class AutoScaler:
         self._stopped = True
         self._idle_since.clear()
 
+    def adopt_state(self, now: Optional[float], scale_ups: int = 0,
+                    scale_downs: int = 0):
+        """Rebuild loop state from journal facts after a supervisor
+        restart (serve/journal.py adoption): restore the lifetime
+        counters the dead generation had accumulated and open a full
+        idle-dwell cooldown — adopted workers reattach over seconds,
+        and a fresh loop judging that quiet window live would retire
+        capacity the fleet is about to need."""
+        if now is None:
+            now = time.monotonic()
+        self.scale_ups = max(self.scale_ups, int(scale_ups))
+        self.scale_downs = max(self.scale_downs, int(scale_downs))
+        self._above_since = None
+        self._idle_since.clear()
+        self._cooldown_until = max(self._cooldown_until,
+                                   now + max(self.idle_s, self.hold_s))
+
     def __enter__(self):
         return self
 

@@ -81,6 +81,26 @@ makes for production query fleets):
   lineage re-running (``adopted_shards`` vs ``lineage_rebuilds``).
   ``shuffle_store_retain`` keeps the store past ``shutdown()``.
 
+* **Supervisor recovery** — the front door itself is no longer a
+  single point of failure: every session lifecycle transition and
+  fleet fact is journaled WRITE-AHEAD (O_APPEND + fsync + per-record
+  CRC32, serve/journal.py) into the fleet dir before the in-memory
+  state mutates.  A new FrontDoor pointed at a dead supervisor's fleet
+  dir (``adopt_dir=``) replays the journal, fences the dead
+  generations via the store's ``fence_handoff`` (revoke each, raise
+  the floor to the OLDEST survivor), re-binds the recorded listener
+  address so surviving workers' reconnect ladders re-attach over the
+  resume-token hello (their live sessions and queued results adopt
+  instead of dying), re-places journal-known queued/replayable
+  sessions through the ordinary backoff ladder, and serves
+  already-completed results straight from the handed-over result
+  cache.  Double restart is idempotent — the adoption records append
+  to the same journal, so a second replay folds to the same state.
+  A worker whose supervisor goes silent without the socket ever dying
+  self-fences past ``serve_orphan_grace_ms`` (serve/worker.py), so a
+  never-restarted supervisor leaks no processes and no unfenced
+  generations.
+
 The chaos ``frontdoor`` scenario (tools/chaos.py) SIGKILLs workers at
 every session lifecycle point and asserts survivors' digests are
 bit-identical, victims re-placed or loudly failed, every worker arena
@@ -96,6 +116,7 @@ import itertools
 import json
 import os
 import shutil
+import signal
 import socket
 import subprocess
 import sys
@@ -108,6 +129,7 @@ from .. import config, faultinj
 from ..shuffle import store as store_mod
 from . import data_plane, wire
 from . import elastic as elastic_mod
+from . import journal as journal_mod
 from . import result_cache as result_cache_mod
 from .launcher import launcher_from_config
 from .runtime import QueryCancelled, QueryTimeout, ServeError
@@ -161,7 +183,9 @@ class FleetMetrics:
               "data_batches", "data_payload_bytes", "data_json_bytes",
               "data_plane_errors", "cache_hits", "hit_bytes_served",
               "scale_ups", "scale_downs", "scale_up_failures",
-              "quota_rejections", "plan_warm_shipped")
+              "quota_rejections", "plan_warm_shipped",
+              "recovered_sessions", "adopted_workers",
+              "replayed_sessions")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -255,16 +279,28 @@ class FrontDoorSession:
                 status: Optional[str] = None):
         if self._done.is_set():
             return
+        if status is not None:
+            final = status
+        elif error is not None:
+            final = "failed"
+        else:
+            final = "done"
+        door = self._door
+        if door is not None:
+            # write-ahead: the terminal transition is durable before
+            # any in-memory state observes it.  ``seconds`` is only
+            # charged for completed compute — replay rebuilds tenant
+            # wall-clock quotas from exactly these records.
+            secs = 0.0
+            if final == "done" and not self.served_from_cache:
+                secs = max(0.0, time.monotonic() - self.submitted_at)
+            door._jrec("result", sid=self.sid, status=final,
+                       from_cache=bool(self.served_from_cache),
+                       tenant=str(self.tenant), seconds=round(secs, 6))
         self.result_value = value
         self.error = error
-        if status is not None:
-            self.status = status
-        elif error is not None:
-            self.status = "failed"
-        else:
-            self.status = "done"
+        self.status = final
         self._done.set()
-        door = self._door
         if door is not None:
             with contextlib.suppress(Exception):
                 door._note_session_done(self)
@@ -322,6 +358,57 @@ class WorkerHandle:
             link.close()
 
 
+class _AdoptedProc:
+    """Process handle for a worker this supervisor did NOT spawn: the
+    journal recorded its pid, the dead supervisor was its parent-slash-
+    launcher, and adoption needs the same pid/poll/wait/kill surface a
+    :class:`~.launcher.LaunchedWorker` gives.  ``poll`` prefers
+    ``waitpid(WNOHANG)`` (the worker IS our child when the crash was
+    simulated in-process — this also reaps zombies the dead generation
+    never collected) and falls back to ``kill(pid, 0)`` liveness."""
+
+    def __init__(self, pid: int):
+        self.pid = int(pid)
+        self.returncode: Optional[int] = None
+
+    def owns_pid(self, pid) -> bool:
+        return pid is not None and int(pid) == self.pid
+
+    def poll(self) -> Optional[int]:
+        if self.returncode is not None:
+            return self.returncode
+        try:
+            done, status = os.waitpid(self.pid, os.WNOHANG)
+            if done == self.pid:
+                self.returncode = os.waitstatus_to_exitcode(status)
+        except ChildProcessError:
+            try:
+                os.kill(self.pid, 0)
+            except ProcessLookupError:
+                self.returncode = -9
+            except OSError:
+                pass
+        except OSError:
+            pass
+        return self.returncode
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        deadline = None if timeout is None \
+            else time.monotonic() + float(timeout)
+        while True:
+            rc = self.poll()
+            if rc is not None:
+                return rc
+            if deadline is not None and time.monotonic() >= deadline:
+                raise subprocess.TimeoutExpired(
+                    f"adopted pid {self.pid}", timeout)
+            time.sleep(0.02)
+
+    def kill(self):
+        with contextlib.suppress(OSError):
+            os.kill(self.pid, signal.SIGKILL)
+
+
 class FrontDoor:
     """The supervisor: ``submit(kind, params)`` → session handle pinned
     to a worker process; ``shutdown()`` drains the fleet and returns a
@@ -347,7 +434,9 @@ class FrontDoor:
                  placement: Optional[str] = None,
                  autoscale: Optional[bool] = None,
                  tenant_quota_bytes: Optional[int] = None,
-                 tenant_quota_s: Optional[float] = None):
+                 tenant_quota_s: Optional[float] = None,
+                 adopt_dir: Optional[str] = None,
+                 result_cache=None):
         global _last_metrics
         self._n_workers = int(workers if workers is not None
                               else config.get("serve_workers"))
@@ -428,22 +517,45 @@ class FrontDoor:
         self._quota_rejected: Dict[str, int] = {}
         self._plan_warm_max = int(config.get("serve_plan_warm"))
         self._plan_warmth: Dict[str, dict] = {}
-        self.fleet_dir = tempfile.mkdtemp(prefix="sptpu_frontdoor_")
+        # supervisor recovery: ``adopt_dir`` points at a DEAD
+        # supervisor's fleet dir.  Replay its journal BEFORE any
+        # resource opens — a crash mid-replay (the journal_replay fault
+        # point) must leave nothing to leak, so the next adoption
+        # attempt starts from exactly the same journal.
+        self._adopt_state: Optional[journal_mod.JournalState] = None
+        if adopt_dir is not None:
+            if not bool(config.get("serve_adopt")):
+                raise ServeError(
+                    "adopt_dir given but serve_adopt is off — refusing "
+                    "to silently start a fresh fleet over an existing "
+                    "fleet dir")
+            self.fleet_dir = os.path.abspath(adopt_dir)
+            self._adopt_state = journal_mod.replay(
+                journal_mod.journal_path(self.fleet_dir))
+        else:
+            self.fleet_dir = tempfile.mkdtemp(prefix="sptpu_frontdoor_")
         # the durable shuffle plane: fleet-shared, survives any worker.
         # store=False runs PR-10 style (pure lineage recovery) — the
         # comparison arm for the store_recovery chaos scenario.
         self.store_dir: Optional[str] = None
         self._store: Optional[store_mod.ShuffleStore] = None
         if store:
+            jmeta = self._adopt_state.meta if self._adopt_state else {}
             self.store_dir = os.path.abspath(
-                store_dir or os.path.join(self.fleet_dir, "shuffle-store"))
+                store_dir or jmeta.get("store_dir")
+                or os.path.join(self.fleet_dir, "shuffle-store"))
             self._store = store_mod.ShuffleStore(self.store_dir)
         self.metrics = FleetMetrics()
         _last_metrics = self.metrics
         # the fleet-wide result cache: supervisor-resident, so an entry
         # one worker computed serves every worker's tenants and
-        # survives any worker loss (serve/result_cache.py)
-        self.result_cache = result_cache_mod.ResultCache()
+        # survives any worker loss (serve/result_cache.py).  An
+        # adoption may be handed the dead door's cache object (the
+        # model for a cache tier that outlives the supervisor): its
+        # completed entries then serve recovered sessions with zero
+        # recompute.
+        self.result_cache = result_cache if result_cache is not None \
+            else result_cache_mod.ResultCache()
         self._cache_gen = 0  # supervisor epoch stamped on hit descriptors
         self._cache_seq = itertools.count(1)
         self._lock = threading.RLock()
@@ -460,23 +572,258 @@ class FrontDoor:
         self._shutdown_started = False
         self._shutdown_done = threading.Event()
         self._shutdown_result: Optional[dict] = None
+        self._crashed = False
+        # adoption bookkeeping: the dead supervisor's sid -> the
+        # session this door resurrected for it
+        self._recovered: Dict[int, FrontDoorSession] = {}
+        self._adopt_stats = {"adopted_workers": 0,
+                             "recovered_sessions": 0,
+                             "replayed_sessions": 0}
 
         self._self_fenced: List[dict] = []
         where = os.path.join(self.fleet_dir, "frontdoor.sock") \
             if self._transport == "unix" else "127.0.0.1:0"
-        self._listener, self._sock_addr = wire.listen(
-            self._transport, where, backlog=self._n_workers * 2)
+        if self._adopt_state is not None:
+            if self._transport == "unix":
+                # the dead supervisor's socket file survived it: unlink
+                # so the rebind lands on the SAME path the surviving
+                # workers' reconnect ladders keep re-dialling
+                with contextlib.suppress(OSError):
+                    os.unlink(where)
+            elif self._adopt_state.meta.get("addr"):
+                # rebind the journal-recorded port (free: its owner is
+                # dead) so survivors re-dial straight back to us
+                where = self._adopt_state.meta["addr"]
+        try:
+            self._listener, self._sock_addr = wire.listen(
+                self._transport, where, backlog=self._n_workers * 2)
+        except OSError:
+            if self._adopt_state is None or self._transport != "tcp":
+                raise
+            # the recorded port got taken after all: bind fresh —
+            # survivors can't find us and self-fence via their
+            # partition grace; journal-known sessions still replay
+            # onto freshly spawned workers
+            self._listener, self._sock_addr = wire.listen(
+                self._transport, "127.0.0.1:0",
+                backlog=self._n_workers * 2)
         self._listener.settimeout(0.2)
 
+        # the write-ahead journal opens AFTER the listener (the meta
+        # record carries the live address) and appends to the adopted
+        # fleet's existing file — one journal per fleet dir, across
+        # supervisor generations
+        self._journal: Optional[journal_mod.SessionJournal] = None
+        if bool(config.get("serve_journal")):
+            self._journal = journal_mod.SessionJournal(
+                journal_mod.journal_path(self.fleet_dir))
+        self._jrec("meta", addr=self._sock_addr,
+                   transport=self._transport, store_dir=self.store_dir,
+                   n_workers=self._n_workers, hosts=list(self._hosts),
+                   data_plane=self._data_plane)
+
         with self._lock:
-            for slot in range(self._n_workers):
-                self._spawn_locked(slot)
+            if self._adopt_state is not None:
+                self._adopt_locked()
+            else:
+                for slot in range(self._n_workers):
+                    self._spawn_locked(slot)
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="frontdoor-accept", daemon=True)
         self._monitor_thread = threading.Thread(
             target=self._monitor_loop, name="frontdoor-monitor", daemon=True)
         self._accept_thread.start()
         self._monitor_thread.start()
+
+    # -- write-ahead journal + crash simulation -------------------------
+    def _jrec(self, rec: str, **fields):
+        """Append one write-ahead record BEFORE the matching in-memory
+        mutation (graftlint GL021 enforces the ordering statically).
+        The two supervisor-death faults surface here: ``supervisor_
+        crash`` raises at the append probe and ``journal_torn``
+        converts to real tail damage then raises — in both cases THIS
+        process is the dead supervisor now, so the death is made real
+        (:meth:`_simulate_crash`) and re-raised for the caller's test
+        harness to observe.  A real journal I/O failure degrades to
+        unjournaled operation rather than taking the fleet down."""
+        j = self._journal
+        if j is None or j.closed:
+            return
+        try:
+            j.append(rec, **fields)
+        except (faultinj.SupervisorCrash, faultinj.JournalTornError):
+            self._simulate_crash()
+            raise
+        except OSError:
+            pass
+
+    def _simulate_crash(self):
+        """Become a dead supervisor, abruptly: stop the loops, drop the
+        listener and every worker link mid-stream, abandon the journal
+        fd with NO finalize record.  Nothing is fenced, reaped, or
+        removed — exactly the mess a SIGKILL leaves behind, which is
+        what an adopting FrontDoor on this fleet dir must clean up.
+        Idempotent."""
+        with self._lock:
+            if self._crashed:
+                return
+            self._crashed = True
+            self._shutdown_started = True
+        self._stop.set()
+        self._wake.set()
+        with contextlib.suppress(OSError):
+            self._listener.close()
+        if self._journal is not None:
+            self._journal.abandon()
+        # closing the supervisor side leaves the worker with EOF — the
+        # same thing the kernel delivers when a real supervisor dies —
+        # so its reconnect ladder starts dialling the fleet address
+        for w in list(self._workers.values()):
+            w.close()
+
+    @property
+    def crashed(self) -> bool:
+        # benign race: monotonic flag (False -> True once, never back)
+        return self._crashed  # graftlint: guarded-by(_lock)
+
+    def recovered(self) -> Dict[int, FrontDoorSession]:
+        """Adoption map: the dead supervisor's sid -> the session this
+        door resurrected for it (attached to a surviving worker,
+        re-placed under a new sid, served from the result cache, or
+        loudly failed if it was running and not replayable)."""
+        with self._lock:
+            return dict(self._recovered)
+
+    def _adopt_locked(self):
+        """Rebuild the fleet from the replayed journal: seed every
+        counter past the dead generation's high-water marks, fence its
+        dead generations (never past a survivor), pre-register
+        surviving workers for resume-token reattach, and resurrect
+        every journal-live session."""
+        st = self._adopt_state
+        now = time.monotonic()
+        # a reused sid would collide with a surviving worker's dedup
+        # table; a reused gen with the fence state of the generation
+        # just revoked
+        self._sids = itertools.count(st.max_sid + 1)
+        self._gens = itertools.count(st.max_gen + 1)
+        self._extra_slots = itertools.count(
+            max(self._n_workers, st.max_slot + 1))
+        # quota facts replay so a restart can't launder a tenant's
+        # spent budget
+        self._tenant_bytes = dict(st.tenant_bytes)
+        self._tenant_seconds = dict(st.tenant_seconds)
+
+        survivors: Dict[int, dict] = {}
+        for slot, jw in st.workers.items():
+            if jw["state"] != "alive" or jw["gen"] in st.revoked \
+                    or jw["gen"] < st.stamped_floor:
+                continue
+            proc = _AdoptedProc(jw["pid"])
+            if proc.poll() is None:
+                survivors[slot] = dict(jw, proc=proc)
+        # the generation handoff: revoke every non-surviving gen
+        # surgically, raise the floor to the OLDEST survivor (or past
+        # every known gen when nothing survived) — the dead
+        # supervisor's generations can never zombie-commit, while the
+        # survivors stay exactly as committable as before the crash
+        alive_gens = {jw["gen"] for jw in survivors.values()}
+        floor = min(alive_gens) if alive_gens else st.max_gen + 1
+        dead_gens = sorted(set(st.all_gens) - alive_gens)
+        # write-ahead, then fence, then rebuild: the adopt record marks
+        # this journal as taken over, so a second restart replays both
+        # generations to the same state (idempotence)
+        self._jrec("adopt", floor=floor, dead_gens=dead_gens,
+                   survivors=sorted(survivors),
+                   truncated_tail=bool(st.truncated_tail))
+        for g in dead_gens:
+            self._jrec("revoke", gen=g)
+        self._jrec("stamp", floor=floor)
+        if self._store is not None:
+            with contextlib.suppress(OSError):
+                self._store.fence_handoff(dead_gens, floor)
+        for slot, jw in sorted(survivors.items()):
+            w = WorkerHandle(slot, jw["gen"], jw["wdir"], jw["proc"],
+                             host=jw["host"], token=jw["token"])
+            w.pool_bytes = self._pool_bytes
+            w.ever_connected = True
+            # an adopted worker is a live process behind a downed link:
+            # its reconnect ladder re-dials the fleet address, our
+            # partition grace bounds how long we wait for the hello
+            w.state = "reconnecting"
+            w.conn_lost_at = now
+            self._workers[slot] = w
+            self._respawn_count.setdefault(slot, 0)
+            self.metrics.bump("adopted_workers")
+            self.metrics.set_liveness(slot, "reconnecting")
+            self._adopt_stats["adopted_workers"] += 1
+        # base slots with no survivor get fresh incarnations
+        for slot in range(self._n_workers):
+            if slot not in self._workers:
+                self._spawn_locked(slot)
+        for sid, s in sorted(st.live_sessions().items()):
+            self._resurrect_locked(sid, s, now)
+        if self._autoscaler is not None:
+            self._autoscaler.adopt_state(
+                now, scale_downs=st.retired_count)
+
+    def _resurrect_locked(self, old_sid: int, s: dict, now: float):
+        """One journal-live session, three recovery paths: re-attach to
+        its surviving worker (placed-but-unacked: the reattach hello's
+        resend + the worker's sid dedup make delivery exactly-once in
+        effect), serve from the handed-over result cache, or re-place
+        through the ordinary backoff ladder under a FRESH sid."""
+        kind = s.get("kind")
+        if kind is None:
+            return  # terminal-only stub: a result for an unseen sid
+        slot, gen = s.get("slot"), s.get("gen")
+        w = self._workers.get(slot) if slot is not None else None
+        if s["status"] in ("placed", "running") and w is not None \
+                and w.state != "dead" and w.gen == gen:
+            sess = FrontDoorSession(
+                self, old_sid, kind, s.get("params"), s.get("tenant"),
+                int(s.get("priority") or 0),
+                int(s.get("est_bytes") or 0), s.get("timeout_s"),
+                bool(s.get("replayable", True)),
+                snapshot=s.get("snapshot"))
+            self._jrec("placed", sid=old_sid, slot=slot, gen=gen)
+            sess.status = "placed"
+            sess.worker_id = slot
+            w.sessions[old_sid] = sess
+            self._pins.setdefault(sess.tenant, slot)
+            self.metrics.bump("recovered_sessions")
+            self._adopt_stats["recovered_sessions"] += 1
+            self._recovered[old_sid] = sess
+            return
+        # its worker died with the old supervisor
+        sess = FrontDoorSession(
+            self, next(self._sids), kind, s.get("params"),
+            s.get("tenant"), int(s.get("priority") or 0),
+            int(s.get("est_bytes") or 0), s.get("timeout_s"),
+            bool(s.get("replayable", True)), snapshot=s.get("snapshot"))
+        self._recovered[old_sid] = sess
+        if s["status"] == "running" and not sess.replayable:
+            self.metrics.bump("worker_lost")
+            sess._finish(error=WorkerLost(
+                f"session {old_sid} was running (not replayable) when "
+                f"the supervisor died"))
+            return
+        if sess.snapshot is not None and self.result_cache.enabled():
+            # completed work whose terminal record died with the crash:
+            # the handed-over cache still holds the bytes — serve them,
+            # never recompute
+            sig = result_cache_mod.query_signature(kind, sess.params)
+            fp = result_cache_mod.knob_fingerprint()
+            sess.cache_key = (sig, sess.snapshot, fp)
+            view = self.result_cache.serve(sig, sess.snapshot, fp)
+            if view is not None and self._serve_cache_hit(sess, view):
+                self.metrics.bump("recovered_sessions")
+                self._adopt_stats["recovered_sessions"] += 1
+                return
+        self._jrec("replayed", sid=old_sid, new_sid=sess.sid)
+        self.metrics.bump("replayed_sessions")
+        self._adopt_stats["replayed_sessions"] += 1
+        self._pending.append([now, sess])
 
     # -- public API -----------------------------------------------------
     def submit(self, kind: str, params: Optional[dict] = None, tenant=None,
@@ -511,6 +858,15 @@ class FrontDoor:
         now = time.monotonic()
         with self._lock:
             self._charge_admission_locked(sess)
+            # write-ahead: the admission is durable before the session
+            # is queued — a quota rejection above never journals (the
+            # session was never admitted, replay must not re-charge it)
+            self._jrec("submit", sid=sid, kind=kind, params=sess.params,
+                       tenant=str(sess.tenant), priority=sess.priority,
+                       est_bytes=sess.est_bytes,
+                       timeout_s=sess.timeout_s,
+                       replayable=sess.replayable,
+                       snapshot=sess.snapshot)
             self._pending.append([now, sess])
             self._maybe_shed_locked()
             self._dispatch_locked(now)
@@ -604,6 +960,14 @@ class FrontDoor:
         per-worker cleanliness, fleet counters, and any orphan spill
         files found before the reap.  Idempotent: later (or racing)
         calls wait for the first and return its report."""
+        # benign race: monotonic flag, a crash racing this check still
+        # reaps nothing (the drain below only touches workers it owns)
+        if self._crashed:  # graftlint: guarded-by(_lock)
+            # a dead supervisor owns NOTHING any more: the fleet dir,
+            # journal, store, and workers belong to whichever door
+            # adopts them — reaping here would destroy the very state
+            # the recovery contract preserves
+            return {"clean": False, "crashed": True, "workers": {}}
         with self._lock:
             first = not self._shutdown_started
             self._shutdown_started = True
@@ -725,6 +1089,11 @@ class FrontDoor:
         self.result_cache.clear()
         if self._store is not None:
             report["store"] = self._store.snapshot()
+        report["recovery"] = dict(self._adopt_stats)
+        report["recovery"]["adopted_fleet"] = self._adopt_state is not None
+        if self._journal is not None:
+            report["recovery"]["journal_appends"] = self._journal.appended
+            self._journal.close()
         retain = self.store_dir is not None \
             and bool(config.get("shuffle_store_retain"))
         if retain and self.store_dir.startswith(self.fleet_dir + os.sep):
@@ -805,6 +1174,8 @@ class FrontDoor:
                "--host", host,
                "--resume-token", token,
                "--partition-grace-ms", str(self._grace_s * 1000.0),
+               "--orphan-grace-ms",
+               str(float(config.get("serve_orphan_grace_ms"))),
                "--reconnect-max", str(self._reconnect_max),
                "--pool-bytes", str(self._pool_bytes),
                "--host-pool-bytes", str(self._host_pool_bytes),
@@ -854,6 +1225,12 @@ class FrontDoor:
             return None
         w = WorkerHandle(slot, gen, wdir, proc, host=host, token=token)
         w.pool_bytes = self._pool_bytes
+        # write-ahead fleet fact: the incarnation exists (pid + resume
+        # token + fencing epoch) before the fleet table says so — an
+        # adopting supervisor can only re-attach workers it can prove
+        self._jrec("spawn", slot=slot, gen=gen,
+                   pid=int(getattr(proc, "pid", 0) or 0), token=token,
+                   host=host, wdir=wdir)
         self._workers[slot] = w
         self.metrics.bump("workers_spawned")
         self.metrics.set_liveness(slot, "starting")
@@ -936,21 +1313,47 @@ class FrontDoor:
                      "tenant": str(sess.tenant),
                      "priority": sess.priority,
                      "est_bytes": sess.est_bytes,
-                     "timeout_s": sess.timeout_s}
+                     "timeout_s": sess.timeout_s,
+                     "snapshot": sess.snapshot}
                     for sess in list(w.sessions.values())
                     if sess.status == "placed" and not sess._done.is_set()]
+                # a cancel issued while this link was down had no pipe
+                # to ride (FrontDoor.cancel only forwards to a healthy
+                # link) — re-forward it now; the worker's unwind is
+                # idempotent, so a duplicate cancel is a no-op
+                recancel = [sess.sid for sess in list(w.sessions.values())
+                            if sess._cancel_requested
+                            and not sess._done.is_set()]
+                # adoption reconciliation: the hello's active_sids are
+                # what the worker ACTUALLY holds — any sid we no longer
+                # track (the journal never committed its placement, or
+                # a data-retry moved the session to a fresh sid) is
+                # cancelled worker-side rather than left computing for
+                # a supervisor that will drop its result
+                stale_sids = [int(s) for s in
+                              (hello.get("active_sids") or [])
+                              if int(s) not in w.sessions]
                 reader_name = f"frontdoor-reader-{slot}-{w.gen}"
             for payload in resend:
                 try:
                     link.send(payload)
                 except OSError:
                     break  # link died again: next reattach retries
+            for sid in stale_sids + recancel:
+                with contextlib.suppress(OSError):
+                    link.send({"op": "cancel", "sid": sid})
             threading.Thread(
                 target=self._reader, args=(w, link),
                 name=reader_name, daemon=True).start()
             self._wake.set()
 
     def _reader(self, w: WorkerHandle, link: wire.Transport):
+        try:
+            self._reader_loop(w, link)
+        except (faultinj.SupervisorCrash, faultinj.JournalTornError):
+            return  # this process just became a dead supervisor
+
+    def _reader_loop(self, w: WorkerHandle, link: wire.Transport):
         while True:
             if w.link is not link:
                 return  # superseded by a reattached connection
@@ -977,6 +1380,7 @@ class FrontDoor:
                 with self._lock:
                     sess = w.sessions.get(int(msg.get("sid", -1)))
                     if sess is not None and not sess._done.is_set():
+                        self._jrec("running", sid=sess.sid)
                         sess.status = "running"
             elif op == "result":
                 self._on_result(w, msg)
@@ -1134,7 +1538,9 @@ class FrontDoor:
             if not sess.replayable or sess.data_retries > self._replace_max:
                 sess._finish(error=exc, status="failed")
                 return
-            sess.sid = next(self._sids)
+            new_sid = next(self._sids)
+            self._jrec("requeued", sid=sess.sid, new_sid=new_sid)
+            sess.sid = new_sid
             sess.status = "pending"
             sess.worker_id = None
             self._pending.append(
@@ -1204,6 +1610,12 @@ class FrontDoor:
 
     # -- monitor loop ---------------------------------------------------
     def _monitor_loop(self):
+        try:
+            self._monitor_ticks()
+        except (faultinj.SupervisorCrash, faultinj.JournalTornError):
+            return  # this process just became a dead supervisor
+
+    def _monitor_ticks(self):
         while not self._stop.is_set():
             self._wake.wait(self._hb_s)
             self._wake.clear()
@@ -1300,6 +1712,7 @@ class FrontDoor:
 
     def _on_worker_lost_locked(self, w: WorkerHandle, why: str,
                                kind: str, now: float):
+        self._jrec("loss", slot=w.worker_id, gen=w.gen, why=why)
         w.state = "dead"
         self.metrics.bump(kind)
         self.metrics.set_liveness(w.worker_id, "dead")
@@ -1323,6 +1736,7 @@ class FrontDoor:
         # its UNcommitted tmp remnants: the committed shards are exactly
         # what the replacement adopts instead of re-running
         if self._store is not None:
+            self._jrec("revoke", gen=w.gen)
             with contextlib.suppress(OSError):
                 self._store.revoke(w.gen)
                 self._store.reap_uncommitted(epoch=w.gen)
@@ -1341,6 +1755,7 @@ class FrontDoor:
                     and sess.replacements < self._replace_max:
                 sess.replacements += 1
                 self.metrics.bump("replacements")
+                self._jrec("requeued", sid=sess.sid)
                 sess.status = "pending"
                 sess.worker_id = None
                 not_before = now + self._backoff_s * (
@@ -1434,6 +1849,7 @@ class FrontDoor:
     def _on_worker_retired_locked(self, w: WorkerHandle):
         """A retiring worker completed its drain -> self-fence -> exit
         ladder: reap it, shrink the fleet, never respawn it."""
+        self._jrec("retired", slot=w.worker_id, gen=w.gen)
         w.state = "dead"
         self.metrics.set_liveness(w.worker_id, "retired")
         self._merge_fired(w)
@@ -1441,6 +1857,7 @@ class FrontDoor:
         # the worker already revoked its OWN epoch before the bye; the
         # supervisor-side revoke + tmp reap is the idempotent backstop
         if self._store is not None:
+            self._jrec("revoke", gen=w.gen)
             with contextlib.suppress(OSError):
                 self._store.revoke(w.gen)
                 self._store.reap_uncommitted(epoch=w.gen)
@@ -1453,6 +1870,7 @@ class FrontDoor:
                 continue
             sess.replacements += 1
             self.metrics.bump("replacements")
+            self._jrec("requeued", sid=sess.sid)
             sess.status = "pending"
             sess.worker_id = None
             self._pending.append([now, sess])
@@ -1557,6 +1975,13 @@ class FrontDoor:
             if w is None:
                 still.append(entry)
                 continue
+            # write-ahead: placement is durable before the send and
+            # the in-memory transition.  If the send then fails, the
+            # journal over-claims a placement that never landed — safe
+            # direction: adoption re-sends placed-but-unacked sessions
+            # and the worker's sid dedup absorbs the duplicate.
+            self._jrec("placed", sid=sess.sid, slot=w.worker_id,
+                       gen=w.gen)
             try:
                 w.link.send({
                     "op": "submit", "sid": sess.sid, "kind": sess.kind,

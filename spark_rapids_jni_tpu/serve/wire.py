@@ -44,7 +44,12 @@ Messages (``op`` discriminates):
 sender   op           payload
 ======== ============ ====================================================
 worker   ``hello``    ``worker_id``, ``pid``, ``fence_epoch``,
-                      ``resume_token`` — sent after every (re)connect
+                      ``resume_token`` — sent after every (re)connect;
+                      ``active_sids`` (optional) lists the sessions the
+                      worker still holds, so an ADOPTING supervisor
+                      (serve/journal.py restart path) can reconcile its
+                      replayed placements against reality and cancel
+                      sids its journal never committed
 super    ``ping``     ``t`` (echo token)
 worker   ``pong``     ``t``, ``stall_breaks`` (native stall-breaker
                       epoch), ``live_sessions``, ``queue_depth`` /
@@ -263,12 +268,19 @@ def _recv_exact(sock: socket.socket, n: int, *,
 
 
 def hello_msg(worker_id: int, pid: int, fence_epoch: int,
-              resume_token: str) -> dict:
+              resume_token: str,
+              active_sids: Optional[List[int]] = None) -> dict:
     """The idempotent connection opener: safe to re-send after every
-    reconnect — the supervisor re-attaches on (pid, token) match."""
-    return {"op": "hello", "worker_id": int(worker_id), "pid": int(pid),
-            "fence_epoch": int(fence_epoch),
-            "resume_token": str(resume_token)}
+    reconnect — the supervisor re-attaches on (pid, token) match.
+    ``active_sids`` carries the worker's live session ids so a
+    restarted supervisor adopting the fleet can reconcile them against
+    its journal replay (unknown sids are cancelled, not orphaned)."""
+    msg = {"op": "hello", "worker_id": int(worker_id), "pid": int(pid),
+           "fence_epoch": int(fence_epoch),
+           "resume_token": str(resume_token)}
+    if active_sids is not None:
+        msg["active_sids"] = sorted(int(s) for s in active_sids)
+    return msg
 
 
 class Transport:

@@ -327,6 +327,10 @@ class _SupervisorLink:
         self._unsent: List[tuple] = []
         self.last_contact = time.monotonic()
         self.reconnects = 0
+        # set by main once the session table exists: () -> live sids,
+        # carried on every (re)hello so an ADOPTING supervisor can
+        # reconcile journal placements against what we actually hold
+        self.active_sids_fn = None
 
     def down(self) -> bool:
         with self._lock:
@@ -337,8 +341,13 @@ class _SupervisorLink:
         :meth:`reconnect` is the retry policy)."""
         t = self._wire.connect(self.kind, self.address, role="wk",
                                timeout_s=2.0)
+        extra = {}
+        if self.active_sids_fn is not None:
+            with contextlib.suppress(Exception):
+                extra["active_sids"] = sorted(self.active_sids_fn())
         try:
-            t.hello(self.worker_id, os.getpid(), self.epoch, self.token)
+            t.hello(self.worker_id, os.getpid(), self.epoch, self.token,
+                    **extra)
         except (self._wire.WireError, OSError):
             t.close()
             raise
@@ -473,6 +482,15 @@ def main(argv=None) -> int:
                     help="incarnation identity echoed in every hello so "
                          "a reconnect reattaches instead of replacing")
     ap.add_argument("--partition-grace-ms", type=float, default=1500.0)
+    ap.add_argument("--orphan-grace-ms", type=float, default=0.0,
+                    help="supervisor-silence bound (serve_orphan_grace_ms"
+                         "): a link that LOOKS up but has carried nothing "
+                         "— no pings, no frames — for this long means the "
+                         "supervisor died without closing the socket; the "
+                         "worker self-fences instead of serving a ghost. "
+                         "0 disables (dead-socket orphans are still "
+                         "covered by the reconnect ladder + partition "
+                         "grace)")
     ap.add_argument("--reconnect-max", type=int, default=4)
     ap.add_argument("--data-plane", default="auto",
                     choices=("auto", "shm", "frames", "json"),
@@ -564,6 +582,8 @@ def main(argv=None) -> int:
             partitioned = True
 
     sessions: Dict[int, object] = {}
+    link.active_sids_fn = lambda: [
+        sid for sid, s in sessions.items() if not s.done()]
     watchers: list = []
     warmed = [0]
     if args.warm and not partitioned:
@@ -748,6 +768,7 @@ def main(argv=None) -> int:
 
     # -- main loop -------------------------------------------------------
     last_fence_check = time.monotonic()
+    orphan_grace_s = max(0.0, args.orphan_grace_ms / 1000.0)
     draining = False
     retired = False
     while not partitioned:
@@ -774,6 +795,20 @@ def main(argv=None) -> int:
             if fenced:
                 revoked_out = True
                 break
+        # orphan self-fence: the socket still LOOKS up, but the
+        # supervisor has sent nothing — no pings, no frames — past the
+        # orphan grace.  A live supervisor pings every heartbeat; total
+        # silence this long means it died without the kernel ever
+        # noticing (SIGKILL leaves established sockets half-open).  Run
+        # the same self-fence ladder as a detected partition so a
+        # never-restarted supervisor leaks neither this process nor an
+        # unfenced generation.
+        if orphan_grace_s > 0.0 and not link.down() \
+                and now - link.last_contact > orphan_grace_s:
+            self_fence("orphaned: supervisor silent past "
+                       "serve_orphan_grace_ms")
+            partitioned = True
+            break
         if link.down():
             if link.reconnect():
                 continue

@@ -316,6 +316,29 @@ class ShuffleStore:
         st["revoked"] = sorted(st["revoked"] + [int(epoch)])
         self._write_fence(st)
 
+    def fence_handoff(self, dead_epochs, floor: int) -> dict:
+        """Supervisor-restart generation handoff (serve/journal.py
+        adoption): revoke every dead generation surgically, raise the
+        floor to the oldest SURVIVING generation — never past it, or
+        the survivors the new supervisor is about to re-adopt would be
+        fenced out of their own commits — and reap each dead
+        generation's uncommitted tmp entries.  One fence-state write:
+        the dead supervisor's generations can never zombie-commit from
+        the instant the adopting one takes over, while every committed
+        shard stays adoptable."""
+        st = self._fence_state()
+        dead = sorted({int(e) for e in dead_epochs}
+                      - set(st["revoked"]))
+        if dead:
+            st["revoked"] = sorted(st["revoked"] + dead)
+        st["floor"] = max(st["floor"], int(floor))
+        self._write_fence(st)
+        reaped = 0
+        for e in dead:
+            reaped += self.reap_uncommitted(epoch=e)
+        return {"revoked": dead, "floor": st["floor"],
+                "reaped_uncommitted": reaped}
+
     # -- paths -----------------------------------------------------------
     def _shard_dir(self, key: str, shard: str) -> str:
         return os.path.join(self.root, _safe(key), f"shard-{_safe(shard)}")

@@ -423,13 +423,162 @@ class TestMultiHostTransport:
         assert entry["fenced_commits"] == 0  # nothing slipped through
 
 
+class TestFailover:
+    """Supervisor crash → a fresh FrontDoor adopts the same fleet dir
+    off the write-ahead journal (serve/journal.py)."""
+
+    @staticmethod
+    def _adopt(fleet_dir, **kw):
+        kw.setdefault("workers", 1)
+        kw.setdefault("heartbeat_ms", 80.0)
+        kw.setdefault("partition_grace_ms", 8000.0)
+        kw.setdefault("reconnect_max", 60)
+        return FrontDoor(adopt_dir=fleet_dir, **kw)
+
+    def test_adoption_recovers_a_live_session(self):
+        from spark_rapids_jni_tpu.serve import journal
+        fd = FrontDoor(workers=1, heartbeat_ms=80.0,
+                       partition_grace_ms=8000.0, reconnect_max=60)
+        fleet = fd.fleet_dir
+        sess = fd.submit("sleep", {"seconds": 3.0}, tenant="t")
+        assert _poll(lambda: sess.worker_id is not None)
+        fd._simulate_crash()
+        assert fd.crashed
+        nd = self._adopt(fleet)
+        try:
+            rec = nd.recovered()
+            assert sess.sid in rec
+            assert rec[sess.sid].result(timeout=60.0) == "slept"
+            snap = nd.metrics.snapshot()
+            assert snap["adopted_workers"] >= 1
+            assert snap["recovered_sessions"] + \
+                snap["replayed_sessions"] >= 1
+            # the journal proves the adoption AND that the logical
+            # query ran exactly once — follow the sid through any
+            # re-keying to its single terminal record
+            entries = journal.scan(journal.journal_path(fleet))
+            assert any(e["rec"] == "adopt" for e in entries)
+            sid, done = sess.sid, 0
+            for e in entries:
+                if e["rec"] in ("requeued", "replayed") \
+                        and e.get("sid") == sid \
+                        and e.get("new_sid") is not None:
+                    sid = int(e["new_sid"])
+                elif e["rec"] == "result" and e.get("sid") == sid \
+                        and e.get("status") == "done":
+                    done += 1
+            assert done == 1
+        finally:
+            report = nd.shutdown()
+            fd.shutdown()
+        assert report["clean"]
+        assert report["recovery"]["adopted_workers"] >= 1
+        assert _no_stragglers()
+
+    def test_double_restart_resurrects_nothing(self, tmp_path):
+        from spark_rapids_jni_tpu.serve import journal
+        fd = FrontDoor(workers=1, heartbeat_ms=80.0,
+                       partition_grace_ms=8000.0, reconnect_max=60)
+        fleet = fd.fleet_dir
+        jpath = journal.journal_path(fleet)
+        try:
+            for i in range(2):
+                assert fd.submit("echo", {"value": i},
+                                 tenant="t").result(timeout=60.0) == i
+            fd._simulate_crash()
+            nd = self._adopt(fleet)
+            fd = nd
+            # the wave was terminal before the crash: adoption must
+            # resurrect NOTHING
+            assert nd.recovered() == {}
+            state_a = journal.replay(jpath)
+            nd._simulate_crash()
+            fd = self._adopt(fleet)
+            assert fd.recovered() == {}
+            state_b = journal.replay(jpath)
+            # double restart is idempotent: same folded session states
+            assert {s: v["status"] for s, v in state_a.sessions.items()} \
+                == {s: v["status"] for s, v in state_b.sessions.items()}
+            # and the twice-adopted door still serves
+            assert fd.submit("echo", {"value": "z"},
+                             tenant="t").result(timeout=60.0) == "z"
+        finally:
+            report = fd.shutdown()
+        assert report["clean"]
+        assert _no_stragglers()
+
+    def test_adoption_replays_past_a_self_fenced_worker(self):
+        # the worker ORPHANS itself (supervisor silent past the grace)
+        # before any new door adopts: the journal-alive pid is gone, so
+        # adoption must fence its generation and REPLAY the session on
+        # a fresh worker instead of re-dialing a corpse
+        config.set("serve_orphan_grace_ms", 200.0)
+        try:
+            fd = FrontDoor(workers=1, heartbeat_ms=40.0)
+            fleet = fd.fleet_dir
+            sess = fd.submit("sleep", {"seconds": 30.0}, tenant="t")
+            assert _poll(lambda: sess.worker_id is not None)
+            with fd._lock:
+                proc = list(fd._workers.values())[0].proc
+            fd._simulate_crash()
+            # rc=3: the orphan drained and self-fenced its generation
+            assert _poll(lambda: proc.poll() is not None, timeout=30.0)
+            assert proc.poll() == 3
+            nd = self._adopt(fleet)
+            try:
+                rec = nd.recovered()
+                assert sess.sid in rec
+                assert rec[sess.sid].result(timeout=120.0) == "slept"
+                snap = nd.metrics.snapshot()
+                assert snap["adopted_workers"] == 0
+                assert snap["replayed_sessions"] >= 1
+            finally:
+                report = nd.shutdown()
+                fd.shutdown()
+            assert report["clean"]
+            # the fenced generation's sentinel surfaced in the report
+            assert any("orphaned" in s.get("reason", "")
+                       for s in report["self_fenced"]) or \
+                report["recovery"]["adopted_workers"] == 0
+        finally:
+            config.reset("serve_orphan_grace_ms")
+        assert _no_stragglers()
+
+    def test_cancel_during_adoption_unwinds_cleanly(self):
+        from spark_rapids_jni_tpu.serve import QueryCancelled
+        fd = FrontDoor(workers=1, heartbeat_ms=80.0,
+                       partition_grace_ms=8000.0, reconnect_max=60)
+        fleet = fd.fleet_dir
+        sess = fd.submit("sleep", {"seconds": 60.0}, tenant="t")
+        assert _poll(lambda: sess.worker_id is not None)
+        fd._simulate_crash()
+        nd = self._adopt(fleet)
+        try:
+            rec = nd.recovered()
+            assert sess.sid in rec
+            ns = rec[sess.sid]
+            ns.cancel()
+            with pytest.raises(QueryCancelled):
+                ns.result(timeout=60.0)
+            assert ns.status == "cancelled"
+        finally:
+            report = nd.shutdown()
+            fd.shutdown()
+        # the unwound session left nothing behind: clean fleet, no
+        # orphan spill files, fleet dir gone
+        assert report["clean"]
+        assert not os.path.exists(fleet)
+        assert _no_stragglers()
+
+
 class TestFleetMetrics:
     def test_zeros_safe_surface(self):
         snap = fleet_metrics()
         for field in ("workers_spawned", "crashes", "stalls", "sheds",
                       "respawns", "worker_lost", "circuit_open",
                       "replacements", "reconnects", "partitions_detected",
-                      "self_fenced_workers"):
+                      "self_fenced_workers", "recovered_sessions",
+                      "adopted_workers", "replayed_sessions"):
             assert field in snap and snap[field] >= 0
         from spark_rapids_jni_tpu.mem.rmm_spark import RmmSpark
         assert RmmSpark.fleet_metrics() == fleet_metrics()

@@ -1516,6 +1516,101 @@ class TestGL020:
 
 
 # ---------------------------------------------------------------------------
+# GL021 — journal write discipline
+# ---------------------------------------------------------------------------
+
+
+class TestGL021:
+    def test_write_behind_status_mutation_flagged(self, tmp_path):
+        res = lint(tmp_path, {"frontdoor.py": """
+            class FrontDoor:
+                def _jrec(self, rec, **kw):
+                    pass
+
+                def good(self, sess):
+                    self._jrec("placed", sid=sess.sid)
+                    sess.status = "placed"
+
+                def bad(self, sess):
+                    sess.status = "running"  # never journaled
+
+                def bad_subscript(self, s):
+                    s["status"] = "pending"
+        """}, rules=["GL021"])
+        assert new_rules(res) == [("GL021", "frontdoor.py")] * 2
+
+    def test_init_and_non_frontdoor_files_exempt(self, tmp_path):
+        res = lint(tmp_path, {
+            "frontdoor.py": """
+                class FrontDoorSession:
+                    def __init__(self):
+                        self.status = "pending"
+            """,
+            "other.py": """
+                class Widget:
+                    def flip(self):
+                        self.status = "on"
+            """,
+        }, rules=["GL021"])
+        assert res.new == []
+
+    def test_frontdoor_class_in_any_file_is_in_scope(self, tmp_path):
+        res = lint(tmp_path, {"door2.py": """
+            class FrontDoorV2:
+                def place(self, sess):
+                    sess.status = "placed"
+        """}, rules=["GL021"])
+        assert new_rules(res) == [("GL021", "door2.py")]
+
+    def test_raw_journal_open_flagged_outside_journal_py(self, tmp_path):
+        res = lint(tmp_path, {
+            "frontdoor.py": """
+                import os
+                from serve import journal
+
+                def peek(fleet):
+                    with open(journal.journal_path(fleet)) as f:
+                        return f.read()
+
+                def poke(fleet):
+                    return os.open(fleet + "/journal.wal", os.O_WRONLY)
+            """,
+            "serve/journal.py": """
+                import os
+
+                def journal_path(d):
+                    return d + "/journal.wal"
+
+                def scan(path):
+                    with open(path, "rb") as f:
+                        return f.read()
+            """,
+        }, rules=["GL021"])
+        assert sorted(new_rules(res)) == [("GL021", "frontdoor.py")] * 2
+
+    def test_sanctioned_readers_and_suppression(self, tmp_path):
+        res = lint(tmp_path, {"audit.py": """
+            from serve import journal
+
+            def audit(fleet):
+                return journal.scan(journal.journal_path(fleet))
+
+            def forced(fleet):
+                return open(journal.journal_path(fleet))  # graftlint: disable=GL021
+        """}, rules=["GL021"])
+        assert res.new == []
+        assert [f.rule for f in res.findings
+                if f.status == "suppressed"] == ["GL021"]
+
+    def test_live_tree_frontdoor_is_clean(self):
+        res = engine.run(
+            [os.path.join(REPO_ROOT, "spark_rapids_jni_tpu"),
+             os.path.join(REPO_ROOT, "tools")],
+            root=REPO_ROOT, baseline=None, rules=["GL021"])
+        assert res.new == [], [f.as_dict() for f in res.new]
+
+
+# ---------------------------------------------------------------------------
 # project index cache
 # ---------------------------------------------------------------------------
 
@@ -1770,14 +1865,14 @@ class TestLiveTree:
         assert engine.load_baseline(engine.default_baseline_path()) == []
 
     def test_live_tree_concurrency_rules_pin_zero(self):
-        # GL017-GL020 hold at zero findings with NO baseline at all: the
-        # serve fleet's lock discipline and chaos coverage are clean, not
-        # grandfathered
+        # GL017-GL021 hold at zero findings with NO baseline at all: the
+        # serve fleet's lock discipline, chaos coverage, and journal
+        # write-ahead discipline are clean, not grandfathered
         res = engine.run(
             [os.path.join(REPO_ROOT, "spark_rapids_jni_tpu"),
              os.path.join(REPO_ROOT, "tests")],
             root=REPO_ROOT, baseline=[],
-            rules=["GL017", "GL018", "GL019", "GL020"])
+            rules=["GL017", "GL018", "GL019", "GL020", "GL021"])
         assert res.parse_errors == []
         assert res.new == [], "\n" + res.to_text()
 
@@ -1787,4 +1882,4 @@ class TestLiveTree:
         assert ids == ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006",
                        "GL007", "GL008", "GL009", "GL010", "GL011", "GL012",
                        "GL013", "GL014", "GL015", "GL016", "GL017", "GL018",
-                       "GL019", "GL020"]
+                       "GL019", "GL020", "GL021"]

@@ -3,7 +3,7 @@
 The premerge gate (ci/chaos.sh) that proves the fault-domain story
 end-to-end, the way ci/q95_floor.json proves perf: it sweeps every
 registered ``faultinj.FAULT_KINDS`` entry across every instrumented
-boundary of thirteen scenarios — a spill walk (device→host→disk→back), an
+boundary of fourteen scenarios — a spill walk (device→host→disk→back), an
 out-of-core skewed shuffle, the single-chip q95 pipeline, a global
 distributed sort across the 8-device mesh, a JNI host-boundary
 round-trip, a streaming morsel scan, a multi-tenant serving wave
@@ -33,7 +33,16 @@ while the autoscaler is still adding capacity, launches are failed at
 the launcher boundary (``scale_up_fail``), drains are wedged past the
 deadline (``drain_stuck``), and the fleet must still converge: ≥1
 scale-up, ≥1 retire, every drained generation fenced with zero zombie
-commits, bit-identical digests) — one fault per trial exhaustively,
+commits, bit-identical digests), and a supervisor-failover wave
+(supervisor_failover: the SUPERVISOR itself dies mid-wave — once
+deliberately every run, and again wherever ``supervisor_crash`` /
+``journal_torn`` rules land on the write-ahead journal's append seam or
+``journal_replay`` kills an adopting generation mid-replay — and every
+death resolves by a fresh FrontDoor adopting the same fleet dir:
+journal replay, dead-generation fencing, resume-token re-dial of the
+surviving workers, re-placement of everything still owed, a
+double-restart leg that must resurrect nothing, and a journal-proven
+zero-duplicate-run audit) — one fault per trial exhaustively,
 plus ``chaos_trials`` seeded multi-fault trials per scenario.  The q95
 and streaming_scan matrices additionally repeat their seam trials with
 the engine knobs pinned to the pallas device-kernel tier (``+pallas``
@@ -1316,6 +1325,318 @@ class ElasticScenario:
                                     if k != "liveness"}}}
 
 
+class SupervisorFailoverScenario:
+    """Supervisor crash recovery under fire: a three-tenant wave through
+    a journaled :class:`FrontDoor` whose SUPERVISOR dies mid-wave — the
+    deliberate kill lands once every run (baseline included), and the
+    fault rules land ``supervisor_crash`` / ``journal_torn`` at the
+    ``journal_append`` seam so additional deaths hit distinct lifecycle
+    points (sessions still queued, just placed, result in flight) plus
+    ``journal_replay`` so an ADOPTING supervisor dies mid-replay.  Every
+    death is resolved the same way: a fresh FrontDoor pointed at the
+    SAME fleet dir replays the write-ahead journal, fences every dead
+    generation, re-dials surviving workers over their resume tokens, and
+    re-places whatever the journal proves was still owed.  After the
+    wave completes, the scenario crashes the ADOPTING door too and
+    adopts a third time — the double-restart leg: a journal whose every
+    session is terminal must resurrect NOTHING and recompute nothing.
+    The trial contract on top of the campaign's bit-identity check:
+    zero duplicate runs PROVEN FROM THE JOURNAL (per logical
+    (tenant, kind, params) key, at most one non-cached ``done`` result
+    record), zero zombie commits from any revoked generation, zero
+    orphan spill files, and no straggler supervisor threads."""
+
+    name = "supervisor_failover"
+    n_tenants = 3
+    seeds = (91, 92, 93)
+
+    def run(self) -> Dict:
+        from spark_rapids_jni_tpu.mem import RetryOOM
+        from spark_rapids_jni_tpu.serve import (AdmissionShed, FrontDoor,
+                                                QueryCancelled, WorkerLost)
+        from spark_rapids_jni_tpu.serve import journal as journal_mod
+        from spark_rapids_jni_tpu.shuffle import store as store_mod
+
+        results: List[Optional[str]] = [None] * self.n_tenants
+        kills = 0
+        failovers = 0
+        recovery = {"adopted_workers": 0, "recovered_sessions": 0,
+                    "replayed_sessions": 0}
+        config.set("serve_backoff_ms", 30.0)
+
+        def construct(adopt_dir=None, cache=None):
+            # the generous reconnect ladder keeps surviving workers
+            # dialling while the adopting door rebinds the fleet address
+            nonlocal failovers
+            while True:
+                try:
+                    return FrontDoor(workers=2, pool_bytes=2 * MB,
+                                     host_pool_bytes=512 * KB,
+                                     max_concurrent=2, heartbeat_ms=60.0,
+                                     respawn_max=4,
+                                     partition_grace_ms=8000.0,
+                                     reconnect_max=60,
+                                     adopt_dir=adopt_dir,
+                                     result_cache=cache)
+                except (faultinj.SupervisorCrash,
+                        faultinj.JournalTornError):
+                    # died DURING construction/adoption (the
+                    # journal_replay fault): the double-restart path —
+                    # the next generation adopts the same journal again
+                    failovers += 1
+                    if failovers > _MAX_ATTEMPTS:
+                        raise ChaosError(
+                            f"{self.name}: supervisor died more than "
+                            f"{_MAX_ATTEMPTS} times during adoption")
+
+        fd = construct()
+        fleet = fd.fleet_dir
+        jpath = journal_mod.journal_path(fleet)
+        sessions: Dict[int, object] = {}
+        try:
+            def failover():
+                nonlocal fd, failovers
+                failovers += 1
+                if failovers > _MAX_ATTEMPTS:
+                    raise ChaosError(
+                        f"{self.name}: supervisor died more than "
+                        f"{_MAX_ATTEMPTS} times")
+                nd = construct(adopt_dir=fleet, cache=fd.result_cache)
+                snap = nd.metrics.snapshot()
+                for k in recovery:
+                    recovery[k] += snap[k]
+                rec = nd.recovered()
+                # rebind: the dead door's session handles are inert —
+                # adopt whatever the new door resurrected, keyed back to
+                # tenants.  A tenant the journal knows but the CLIENT
+                # does not (the crash unwound ``submit`` after its
+                # record landed) is adopted here too — re-submitting it
+                # would be the duplicate run the journal exists to
+                # prevent.  Only a tenant absent from BOTH re-submits.
+                for i in range(self.n_tenants):
+                    s = sessions.get(i)
+                    if s is not None and s.done():
+                        continue
+                    mine = [ns for ns in rec.values()
+                            if ns.tenant == f"tenant-{i}"]
+                    live = [ns for ns in mine if not ns.done()]
+                    if mine:
+                        sessions[i] = (live or mine)[0]
+                    elif s is not None:
+                        del sessions[i]
+                fd = nd
+
+            self_killed = False
+            done = set()
+            attempts = {i: 0 for i in range(self.n_tenants)}
+            deadline = time.monotonic() + 150.0
+            while len(done) < self.n_tenants:
+                if time.monotonic() > deadline:
+                    raise ChaosError(
+                        f"{self.name}: wave not complete after 150s "
+                        f"(done={sorted(done)}, failovers={failovers})")
+                if fd.crashed:
+                    failover()
+                    continue
+                try:
+                    for i in range(self.n_tenants):
+                        if i not in done and i not in sessions:
+                            sessions[i] = fd.submit(
+                                "spill_walk",
+                                {"seed": self.seeds[i], "rows": 8 * KB},
+                                tenant=f"tenant-{i}", priority=i,
+                                replayable=True)
+                except (faultinj.SupervisorCrash,
+                        faultinj.JournalTornError):
+                    continue  # crash picked up at the top of the loop
+                if not self_killed and len(sessions) == self.n_tenants:
+                    # the deliberate mid-wave kill: spin at millisecond
+                    # grain for the moment a live session lands on a
+                    # worker — the placed-but-unfinished window — so
+                    # the first supervisor dies with real sessions owed
+                    # and every run exercises adoption, faulted or not
+                    spin_by = time.monotonic() + 20.0
+                    while time.monotonic() < spin_by:
+                        live = [s for s in sessions.values()
+                                if not s.done()]
+                        if not live or any(s.worker_id is not None
+                                           for s in live):
+                            break
+                        time.sleep(0.002)
+                    fd._simulate_crash()
+                    self_killed = True
+                    continue
+                for i, sess in list(sessions.items()):
+                    if i in done:
+                        continue
+                    try:
+                        results[i] = sess.result(timeout=0.25)
+                        done.add(i)
+                    except TimeoutError:
+                        continue  # in flight (or the supervisor died)
+                    except faultinj.FatalInjectedFault:
+                        raise  # whole-scenario replacement
+                    except (WorkerLost, AdmissionShed,
+                            faultinj.TaskCancelled,
+                            faultinj.InjectedFault, QueryCancelled,
+                            RetryOOM):
+                        kills += 1
+                        attempts[i] += 1
+                        if attempts[i] >= _MAX_ATTEMPTS:
+                            raise ChaosError(
+                                f"{self.name}: tenant {i} not done "
+                                f"after {_MAX_ATTEMPTS} re-submissions")
+                        del sessions[i]  # fresh submit next pass
+
+            # -- double restart: every session is terminal, so the next
+            # generation must adopt the fleet and resurrect NOTHING
+            state_a = journal_mod.replay(jpath)
+            fd._simulate_crash()
+            failover()
+            if fd.recovered():
+                raise ChaosError(
+                    f"{self.name}: double restart resurrected terminal "
+                    f"sessions: {sorted(fd.recovered())}")
+            state_b = journal_mod.replay(jpath)
+            folded = [{sid: s.get("status") for sid, s
+                       in st.sessions.items()}
+                      for st in (state_a, state_b)]
+            if folded[0] != folded[1]:
+                raise ChaosError(
+                    f"{self.name}: double restart drifted the journal's "
+                    f"folded session states ({folded[0]} != {folded[1]})")
+
+            # -- the duplicate-run proof, straight from the journal: per
+            # logical (tenant, kind, params) key at most ONE non-cached
+            # ``done`` result record may exist, across every generation
+            by_sid: Dict[int, tuple] = {}
+            runs: Dict[tuple, int] = {}
+            for e in journal_mod.scan(jpath):
+                if e.get("rec") == "submit":
+                    by_sid[int(e["sid"])] = (
+                        str(e.get("tenant")), str(e.get("kind")),
+                        json.dumps(e.get("params") or {}, sort_keys=True))
+                elif e.get("rec") in ("requeued", "replayed") \
+                        and e.get("new_sid") is not None \
+                        and int(e["sid"]) in by_sid:
+                    by_sid[int(e["new_sid"])] = by_sid[int(e["sid"])]
+                elif e.get("rec") == "result" \
+                        and e.get("status") == "done" \
+                        and not e.get("from_cache"):
+                    key = by_sid.get(int(e.get("sid", 0)))
+                    runs[key] = runs.get(key, 0) + 1
+            dups = {k: n for k, n in runs.items() if n > 1}
+            if dups:
+                raise ChaosError(
+                    f"{self.name}: the journal proves duplicate runs — "
+                    f"{dups}")
+
+            # -- quiesce: the third generation's adopted workers must
+            # finish their resume-token reattach before shutdown, or
+            # the graceful bye has no link to ride (an unattached
+            # worker would self-fence at the grace instead)
+            quiet_by = time.monotonic() + 20.0
+            while time.monotonic() < quiet_by:
+                with fd._lock:
+                    ws = list(fd._workers.values())
+                    quiet = bool(ws) and all(w.state == "healthy"
+                                             for w in ws)
+                if quiet:
+                    break
+                time.sleep(0.05)
+
+            # -- the fence probe, while the store still exists: every
+            # generation ANY dead supervisor owned must be unable to
+            # commit an adoptable shard
+            if fd.store_dir and os.path.isdir(fd.store_dir):
+                reader = store_mod.ShuffleStore(fd.store_dir,
+                                                max_attempts=0)
+                for g in reader.revoked():
+                    zombie = store_mod.ShuffleStore(fd.store_dir,
+                                                    epoch=g,
+                                                    max_attempts=0)
+                    try:
+                        committed = zombie.put("chaos-failover-probe",
+                                               "zombie",
+                                               {"x": jnp.arange(4)})
+                    except faultinj.FatalInjectedFault:
+                        raise
+                    except Exception:
+                        committed = False  # aborted pre-rename
+                    if committed:
+                        raise ChaosError(
+                            f"{self.name}: revoked gen {g} committed "
+                            f"past its fence (zombie shard)")
+                    if reader.has_committed("chaos-failover-probe",
+                                            "zombie"):
+                        raise ChaosError(
+                            f"{self.name}: revoked gen {g}'s entry "
+                            f"became adoptable")
+        finally:
+            try:
+                if fd.crashed:
+                    # an aborting attempt still must not leak the
+                    # fleet: one more adoption purely so shutdown can
+                    # reap the workers and remove the fleet dir
+                    with contextlib.suppress(Exception):
+                        fd = construct(adopt_dir=fleet,
+                                       cache=fd.result_cache)
+                report = fd.shutdown()
+            finally:
+                config.reset("serve_backoff_ms")
+        if failovers < 2:
+            raise ChaosError(
+                f"{self.name}: only {failovers} failover(s) ran — the "
+                f"deliberate kill plus the double-restart leg demand "
+                f"at least two")
+        if recovery["adopted_workers"] < 1:
+            raise ChaosError(
+                f"{self.name}: no surviving worker was ever adopted "
+                f"({recovery})")
+        unclean = {wid: e for wid, e in report["workers"].items()
+                   if not e.get("clean")}
+        if unclean:
+            raise ChaosError(
+                f"{self.name}: unclean workers: {unclean}")
+        if report["orphan_spill_files"]:
+            raise ChaosError(f"{self.name}: orphan spill files: "
+                             f"{report['orphan_spill_files']}")
+        if os.path.exists(fd.fleet_dir):
+            raise ChaosError(
+                f"{self.name}: fleet dir survived shutdown")
+        for fenced in report["self_fenced"]:
+            if fenced.get("fenced_commits"):
+                raise ChaosError(
+                    f"{self.name}: self-fenced worker "
+                    f"{fenced['worker_id']} committed "
+                    f"{fenced['fenced_commits']} shard(s) past its own "
+                    f"revocation")
+        for _ in range(40):  # reader threads exit async after close
+            stragglers = [t.name for t in threading.enumerate()
+                          if t.name.startswith("frontdoor-")]
+            if not stragglers:
+                break
+            time.sleep(0.05)
+        if stragglers:
+            raise ChaosError(
+                f"{self.name}: live supervisor threads after shutdown: "
+                f"{stragglers}")
+        h = hashlib.sha256()
+        for r in results:  # position-stable: tenant i's digest at slot i
+            h.update((r or "<none>").encode())
+        return {"digest": h.hexdigest(),
+                "extra": {"tenant_kills": kills,
+                          "failovers": failovers,
+                          "adopted_workers": recovery["adopted_workers"],
+                          "recovered_sessions":
+                          recovery["recovered_sessions"],
+                          "replayed_sessions":
+                          recovery["replayed_sessions"],
+                          "fleet": {k: v for k, v in
+                                    report["fleet"].items()
+                                    if k != "liveness"}}}
+
+
 class ZoneMapScenario:
     """Zone-map block skipping under fire: a 1%-selective predicate over
     a sorted FoR-encoded column prunes the morsel stream through its
@@ -1421,7 +1742,9 @@ SCENARIOS = {s.name: s for s in (SpillScenario(), ShuffleScenario(),
                                  MultihostScenario(),
                                  DataPlaneScenario(),
                                  ResultCacheScenario(),
-                                 ElasticScenario(), ZoneMapScenario())}
+                                 ElasticScenario(),
+                                 SupervisorFailoverScenario(),
+                                 ZoneMapScenario())}
 
 
 # ---------------------------------------------------------------------------
@@ -1739,6 +2062,33 @@ def single_fault_trials(fast: bool = False) -> List[Trial]:
         one("elastic", "serve_step", "worker_crash")
         one("elastic", "serve_step", "oom")
 
+    # supervisor_failover scenario: the journal seams.  supervisor_crash
+    # and journal_torn fire ONLY here and in the journal tests — these
+    # trials keep both kinds in the coverage check.  Every run already
+    # kills its first supervisor deliberately; the skip bands land the
+    # INJECTED death at distinct lifecycle points of the occurrence
+    # clock (both doors share it): skip=3 is the first submit append
+    # (sessions still queued), the mid band lands among the placement
+    # appends, the late band among running/result appends or the
+    # adopting generation's own writes — and the journal_replay trial
+    # kills the ADOPTING supervisor mid-replay, the double-restart path
+    # under fire.  Torn variants convert the same appends into REAL
+    # tail damage that replay must truncate cleanly.
+    one("supervisor_failover", "journal_append", "supervisor_crash",
+        skip=3)
+    if not fast:
+        one("supervisor_failover", "journal_append", "supervisor_crash",
+            skip=6)
+        one("supervisor_failover", "journal_append", "supervisor_crash",
+            skip=9)
+        one("supervisor_failover", "journal_replay", "supervisor_crash",
+            skip=4)
+        one("supervisor_failover", "journal_append", "journal_torn",
+            skip=3)
+        one("supervisor_failover", "journal_append", "journal_torn",
+            skip=8)
+        one("supervisor_failover", "serve_step", "worker_crash")
+
     # multihost scenario: the three network kinds fired at the worker
     # side of both directions, link drops at the supervisor side of
     # both, and the partition trial.  net_drop / net_stall / net_torn
@@ -1815,6 +2165,16 @@ _MULTI_POOL = {
                 ("worker_drain", "drain_stuck"),
                 ("serve_step", "worker_crash"),
                 ("serve_step", "oom")],
+    # journal_append kinds stay OUT of the composite pool on purpose: a
+    # derived skip of 0-2 would land the death on the FIRST door's
+    # meta/spawn appends — a construction crash that orphans a fleet
+    # dir instead of exercising adoption.  journal_replay is safe (the
+    # probe is only crossed while adopting), and the worker kinds run
+    # concurrently with the scenario's deliberate failover.
+    "supervisor_failover": [("journal_replay", "supervisor_crash"),
+                            ("serve_step", "worker_crash"),
+                            ("serve_step", "oom"),
+                            ("spill_io_write", "spill_io")],
 }
 
 

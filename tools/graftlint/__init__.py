@@ -23,13 +23,15 @@ GL007     donated-buffer reuse: a variable passed at a donated position of
           a ``jax.jit(..., donate_argnums=...)`` callable and read again
 ========  ==================================================================
 
-...through GL020.  GL008–GL016 extend the same idea to I/O handles,
+...through GL021.  GL008–GL016 extend the same idea to I/O handles,
 late materialization, sharding, the serve/elastic lifecycles, pallas
 interpret mode, decode seams, and result-cache keys; GL017–GL020 are
 the whole-program concurrency and chaos-coverage rules (lock-order
 cycles, unguarded shared fields, blocking under locks,
 probe-reachability drift) computed over the cross-module project index
-in ``project.py``.  See ``tools/graftlint/README.md`` for the full
+in ``project.py``; GL021 guards the write-ahead session journal's
+write discipline (no write-behind status mutations in front-door
+code, no raw journal I/O outside ``serve/journal.py``).  See ``tools/graftlint/README.md`` for the full
 catalogue with the motivating incident per rule.
 
 Run ``python -m tools.graftlint spark_rapids_jni_tpu tests``; see

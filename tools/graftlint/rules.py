@@ -2039,6 +2039,117 @@ class GL020ProbeReachabilityDrift(ProjectRule):
                 "in the tree — this trial can never fire")
 
 
+# ---------------------------------------------------------------------------
+# GL021 — journal write discipline (write-ahead, through the one helper)
+# ---------------------------------------------------------------------------
+
+
+class GL021JournalWriteDiscipline(Rule):
+    """The supervisor-recovery contract (serve/journal.py) only holds if
+    every session-state transition is journaled BEFORE the in-memory
+    state observes it, and every journal byte goes through the one
+    sanctioned append path (``SessionJournal.append`` via the front
+    door's ``_jrec``).  Two drift shapes, caught statically:
+
+    * a ``status`` mutation in front-door code (``frontdoor.py``, or
+      any class named ``FrontDoor*``) inside a function with no
+      preceding ``_jrec(...)`` append — write-behind: a crash between
+      the mutation and a later append forgets a transition the journal
+      claims never happened (``__init__`` is exempt — constructing a
+      session in its initial state transitions nothing);
+    * a raw ``open``/``os.open`` of the journal file anywhere outside
+      ``serve/journal.py`` — bypassing the helper skips the O_APPEND +
+      CRC trailer + fsync discipline on writes and the torn-tail /
+      mid-log damage verdict on reads (use ``scan``/``replay``).
+    """
+
+    id = "GL021"
+
+    def check(self, pf: ParsedFile) -> Iterable[Finding]:
+        if pf.is_test_file:
+            return
+        base = pf.relpath.rsplit("/", 1)[-1]
+        if base != "journal.py":
+            yield from self._raw_journal_io(pf)
+        if base == "frontdoor.py" or self._defines_frontdoor(pf.tree):
+            yield from self._status_mutations(pf)
+
+    @staticmethod
+    def _defines_frontdoor(tree: ast.AST) -> bool:
+        return any(isinstance(n, ast.ClassDef)
+                   and n.name.startswith("FrontDoor")
+                   for n in ast.walk(tree))
+
+    @staticmethod
+    def _touches_journal_file(arg: ast.AST) -> bool:
+        for n in ast.walk(arg):
+            if isinstance(n, ast.Call):
+                fn = n.func
+                name = fn.attr if isinstance(fn, ast.Attribute) \
+                    else getattr(fn, "id", "")
+                if name == "journal_path":
+                    return True
+            if isinstance(n, ast.Constant) and isinstance(n.value, str) \
+                    and "journal.wal" in n.value:
+                return True
+        return False
+
+    def _raw_journal_io(self, pf: ParsedFile) -> Iterable[Finding]:
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            is_open = (isinstance(fn, ast.Name) and fn.id == "open") or \
+                (isinstance(fn, ast.Attribute) and fn.attr == "open"
+                 and isinstance(fn.value, ast.Name)
+                 and fn.value.id in ("os", "io"))
+            if not is_open:
+                continue
+            if any(self._touches_journal_file(a)
+                   for a in list(node.args)
+                   + [kw.value for kw in node.keywords]):
+                yield pf.finding(
+                    self.id, node,
+                    "raw open() of the session journal outside "
+                    "serve/journal.py — writes must go through "
+                    "SessionJournal.append (O_APPEND + CRC + fsync), "
+                    "reads through scan()/replay() (torn-tail vs "
+                    "mid-log damage verdict)")
+
+    def _status_mutations(self, pf: ParsedFile) -> Iterable[Finding]:
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                    or node.name == "__init__":
+                continue
+            jrec_lines = []
+            mutations = []
+            for child in _walk_scope(node, into_functions=False):
+                if isinstance(child, ast.Call):
+                    fn = child.func
+                    name = fn.attr if isinstance(fn, ast.Attribute) \
+                        else getattr(fn, "id", "")
+                    if name == "_jrec":
+                        jrec_lines.append(child.lineno)
+                elif isinstance(child, ast.Assign):
+                    for tgt in child.targets:
+                        if (isinstance(tgt, ast.Attribute)
+                                and tgt.attr == "status") or \
+                                (isinstance(tgt, ast.Subscript)
+                                 and isinstance(tgt.slice, ast.Constant)
+                                 and tgt.slice.value == "status"):
+                            mutations.append(child)
+            for mut in mutations:
+                if any(ln <= mut.lineno for ln in jrec_lines):
+                    continue
+                yield pf.finding(
+                    self.id, mut,
+                    f"session-state mutation in `{node.name}` with no "
+                    "preceding `_jrec(...)` journal append in the same "
+                    "function — write-behind: a crash here forgets a "
+                    "transition the write-ahead journal must survive")
+
+
 _ALL: List[Rule] = [GL001TracerLeak(), GL002HostSyncUnderJit(),
                     GL003RetraceHazard(), GL004SpillHandleLeak(),
                     GL005ConfigDrift(), GL006FaultKindDrift(),
@@ -2054,7 +2165,8 @@ _ALL: List[Rule] = [GL001TracerLeak(), GL002HostSyncUnderJit(),
                     GL017LockOrderCycle(),
                     GL018UnguardedSharedField(),
                     GL019BlockingWhileHolding(),
-                    GL020ProbeReachabilityDrift()]
+                    GL020ProbeReachabilityDrift(),
+                    GL021JournalWriteDiscipline()]
 
 
 def all_rules(only: Optional[Sequence[str]] = None) -> List[Rule]:
